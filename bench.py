@@ -1,0 +1,95 @@
+"""Benchmark: Llama decoder train-step throughput on the available device.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+
+Metric of record (BASELINE.json): tokens/sec/chip on a Llama-2-style decoder.
+A single TPU v5 lite chip cannot hold 7B for training, so the bench runs a
+scaled Llama (same architecture) in bf16 and reports achieved tokens/sec plus
+model FLOPs utilization; ``vs_baseline`` is achieved-MFU / 0.45 (the A100-class
+MFU target recorded in BASELINE.md — the reference published no numbers).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=2048)
+        batch, seq, steps = 8, 1024, 10
+        peak_flops = 197e12  # v5e bf16 peak per chip
+    else:  # CPU smoke config so the bench always runs
+        cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=4,
+                               kv_heads=4, inter=256, max_pos=256)
+        batch, seq, steps = 4, 128, 3
+        peak_flops = 1e12
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    if on_tpu:
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    @paddle.jit.to_static
+    def train_step(ids):
+        with paddle.amp.auto_cast(enable=on_tpu, level="O2", dtype="bfloat16"):
+            loss, _ = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq),
+                                        dtype=np.int32))
+
+    # warmup / compile
+    loss = train_step(ids)
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(ids)
+    _ = float(loss)  # sync
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_sec = tokens / dt
+    flops_per_token = model.flops_per_token(seq)
+    mfu = tok_per_sec * flops_per_token / peak_flops
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "detail": {
+            "device": str(dev), "params": model.num_params(),
+            "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+            "batch": batch, "seq": seq, "steps": steps,
+            "mfu": round(mfu, 4), "final_loss": round(float(loss), 4),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

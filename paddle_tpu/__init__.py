@@ -1,0 +1,69 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: data-mining/Paddle), built from scratch on
+JAX/XLA/Pallas/pjit.
+
+Usage mirrors paddle::
+
+    import paddle_tpu as paddle
+    paddle.set_device('tpu')
+    x = paddle.to_tensor([[1., 2.], [3., 4.]], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    x.grad  # Tensor([[2., 4.], [6., 8.]])
+
+See SURVEY.md at the repo root for the layer map from the reference onto this
+design.
+"""
+
+from . import flags as _flags_mod
+from .flags import get_flags, set_flags, define_flag  # noqa: F401
+
+from .device import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, CUDAPlace, CustomPlace,
+    set_device, get_device, device_count,
+    is_compiled_with_cuda, is_compiled_with_tpu,
+)
+
+from .core.dtype import (  # noqa: F401
+    bfloat16, float16, float32, float64,
+    int8, int16, int32, int64, uint8, uint16, uint32, uint64,
+    bool_ as bool8, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, finfo, iinfo, promote_types,
+    is_floating_point, is_integer, is_complex,
+)
+# paddle exposes `paddle.bool`
+bool = bool8  # noqa: A001
+
+from .core.tensor import Tensor, Parameter, to_tensor  # noqa: F401
+from .core.tracing import no_grad, enable_grad, set_grad_enabled  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core import autograd as _autograd_mod
+from .core.autograd import grad  # noqa: F401
+
+# install the op surface (also populates Tensor methods)
+from . import ops as _ops_pkg
+from .ops import OP_REGISTRY as _OP_REGISTRY
+
+
+def _install_ops() -> None:
+    g = globals()
+    for name, fn in _OP_REGISTRY.items():
+        if name not in g:
+            g[name] = fn
+
+
+_install_ops()
+
+# subpackage namespaces (imported lazily-ish at the end: they use the ops)
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import linalg  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import framework  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from .framework.io import save, load  # noqa: F401,E402
+
+__version__ = "0.1.0"

@@ -1,0 +1,191 @@
+"""Automatic mixed precision.
+
+Parity surface: python/paddle/amp/ (auto_cast O1/O2, GradScaler with dynamic
+loss scaling + found_inf, ``amp.decorate`` master weights; upstream C++ lists
+in paddle/fluid/eager/amp_utils.h). TPU-native defaults: bfloat16 — no loss
+scaling needed (GradScaler still provided for fp16 API parity and for
+reference scripts; with bf16 it becomes a pass-through when ``enable=False``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import dtype as _dtype
+from ..core.tensor import Tensor
+from ..core.tracing import AmpState, pop_amp_state, push_amp_state
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "white_list", "black_list"]
+
+# op lists mirroring the reference's amp lists (upstream:
+# paddle/fluid/eager/amp_auto_cast.h + python/paddle/amp/amp_lists.py)
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "addmm", "mv",
+    "scaled_dot_product_attention", "flash_attention",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "mean", "sum", "norm", "layer_norm", "batch_norm", "batch_norm_stats",
+    "group_norm", "instance_norm", "rms_norm", "cumsum", "logsumexp",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits", "nll_loss",
+    "kl_div", "mse_loss", "l1_loss", "smooth_l1_loss", "sigmoid_focal_loss",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16", use_promote: bool = True):
+    """``paddle.amp.auto_cast`` parity."""
+    wl = set(WHITE_LIST)
+    bl = set(BLACK_LIST)
+    if custom_white_list:
+        wl |= set(custom_white_list)
+        bl -= set(custom_white_list)
+    if custom_black_list:
+        bl |= set(custom_black_list)
+        wl -= set(custom_black_list)
+    state = AmpState(enable=enable, dtype=_dtype.convert_dtype(dtype),
+                     level=level, white_set=wl, black_set=bl)
+    push_amp_state(state)
+    try:
+        yield
+    finally:
+        pop_amp_state()
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight: Optional[bool] = None, save_dtype: Optional[str] = None):
+    """``paddle.amp.decorate``: cast model params to the low dtype (O2); the
+    optimizer keeps fp32 master weights automatically (see
+    Optimizer._ensure_master)."""
+    d = _dtype.convert_dtype(dtype)
+    is_list = isinstance(models, (list, tuple))
+    model_list = list(models) if is_list else [models]
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._set_data(p._data.astype(d))
+    if optimizers is None:
+        return models if is_list else model_list[0]
+    return (models if is_list else model_list[0]), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (parity: paddle.amp.GradScaler; upstream kernels
+    check_finite_and_unscale + update_loss_scaling)."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 65536.0,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000, decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def _unscale_grads(self, optimizer) -> None:
+        import jax.numpy as jnp
+        inv = 1.0 / self._scale
+        finite_acc = None  # single device scalar; ONE host sync at the end
+        for q in optimizer._param_groups:
+            if q.grad is None:
+                continue
+            g = q.grad._data * inv
+            q.grad._set_data(g)
+            f = jnp.all(jnp.isfinite(g))
+            finite_acc = f if finite_acc is None else jnp.logical_and(finite_acc, f)
+        if finite_acc is None or _is_tracing():
+            self._found_inf = False
+        else:
+            self._found_inf = not bool(finite_acc)
+        self._unscaled = True
+
+    def unscale_(self, optimizer) -> None:
+        if self._enable and not self._unscaled:
+            self._unscale_grads(optimizer)
+
+    def step(self, optimizer) -> None:
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self._unscale_grads(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self) -> None:
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def minimize(self, optimizer, scaled_loss) -> None:
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+def _is_tracing() -> bool:
+    from ..core.tracing import trace_state
+    return trace_state() is not None

@@ -1,0 +1,82 @@
+"""``paddle.autograd`` namespace: backward, grad, PyLayer, hooks.
+
+Parity surface: python/paddle/autograd/ (+ the C++ egr::Backward engine it
+fronts — see core/autograd.py for the TPU-native tape).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from .core.autograd import GradNode, backward, grad  # noqa: F401
+from .core.tensor import Tensor
+from .core.tracing import no_grad, set_grad_enabled  # noqa: F401
+
+__all__ = ["backward", "grad", "no_grad", "set_grad_enabled", "PyLayer",
+           "PyLayerContext"]
+
+
+class PyLayerContext:
+    """Context passed to PyLayer forward/backward (parity:
+    paddle.autograd.PyLayerContext)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined differentiable op (parity: paddle.autograd.PyLayer).
+
+    Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)`` static
+    methods; invoke via ``apply``. The backward is stitched onto the tape as a
+    GradNode whose vjp calls the user's backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        from .core.tracing import grad_enabled
+        needs_grad = grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if needs_grad:
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                ct_tensors = [Tensor(c) for c in cts]
+                with no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                gins = gin if isinstance(gin, (tuple, list)) else (gin,)
+                return tuple(g._data if isinstance(g, Tensor) else g for g in gins)
+
+            node = GradNode(cls.__name__, vjp_fn, tensor_inputs, len(outs),
+                            tuple((o._data.shape, o._data.dtype) for o in outs))
+            for i, o in enumerate(outs):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._grad_index = i
+        return out if multi else outs[0]

@@ -1,0 +1,1 @@
+"""Core: tensor, dtype, autograd tape, tracing contexts, RNG."""

@@ -1,0 +1,220 @@
+"""Define-by-run autograd tape.
+
+Capability parity with the reference's eager autograd engine (upstream:
+paddle/fluid/eager/ — ``GradNodeBase``, ``Edge``, ``egr::Backward`` topological
+queue, ``GradientAccumulator``). TPU-native design: instead of per-op C++ grad
+kernels, each forward op captures its vjp through ``jax.vjp`` at dispatch time
+(linearization is itself jax-traced, so under ``to_static`` the whole tape
+inlines into one XLA program). ``backward`` walks nodes in reverse creation
+order — a valid topological order for a tape — accumulating cotangents.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradNode", "backward", "grad"]
+
+_node_counter = itertools.count()
+
+# When non-None, _accumulate_leaf only writes .grad for these tensor ids
+# (used by paddle.grad to avoid polluting unrelated leaves).
+_leaf_filter: Optional[set] = None
+
+
+class GradNode:
+    """One recorded op on the tape (analogue of ``GradNodeBase``).
+
+    Input grad linkage (``Edge``s) is SNAPSHOTTED at record time — in-place
+    ops rebind a tensor onto the node they just produced, so reading the
+    *current* ``_grad_node`` of an input during backward would find a cycle.
+    """
+
+    __slots__ = ("id", "op_name", "vjp_fn", "inputs", "input_links",
+                 "n_outputs", "out_avals", "released")
+
+    def __init__(self, op_name: str, vjp_fn, inputs: Sequence[Any], n_outputs: int, out_avals):
+        self.id = next(_node_counter)
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.inputs = tuple(inputs)  # input Tensors (strong refs keep graph alive)
+        # (tensor, producing node or None, output slot) captured NOW:
+        self.input_links = tuple(
+            (t, t._grad_node, t._grad_index) for t in inputs)
+        self.n_outputs = n_outputs
+        self.out_avals = out_avals  # (shape, dtype) per output for zero-fill
+        self.released = False
+
+    def release(self) -> None:
+        self.vjp_fn = None
+        self.inputs = ()
+        self.input_links = ()
+        self.released = True
+
+    def __repr__(self):
+        return f"GradNode<{self.op_name}#{self.id}>"
+
+
+def _topo_nodes(roots: Sequence[GradNode]) -> List[GradNode]:
+    """All reachable nodes, descending creation id (reverse topological)."""
+    seen: Dict[int, GradNode] = {}
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if node.id in seen:
+            continue
+        seen[node.id] = node
+        for _, n, _idx in node.input_links:
+            if n is not None and n.id not in seen:
+                stack.append(n)
+    return [seen[i] for i in sorted(seen, reverse=True)]
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """``paddle.autograd.backward`` / ``Tensor.backward``.
+
+    Seeds the output cotangents (ones for scalar losses), walks the tape in
+    reverse creation order, and accumulates leaf gradients into ``.grad``.
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # cotangent store: node id -> list per output slot
+    cotangents: Dict[int, List[Optional[jnp.ndarray]]] = {}
+    roots: List[GradNode] = []
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True; "
+                "it is not connected to the autograd graph")
+        seed = g._data if isinstance(g, Tensor) else g
+        if seed is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "pass grad_tensors for non-scalar backward()")
+            seed = jnp.ones_like(t._data)
+        node, idx = t._grad_node, t._grad_index
+        if node is None:
+            _accumulate_leaf(t, seed)
+            continue
+        slots = cotangents.setdefault(node.id, [None] * node.n_outputs)
+        slots[idx] = seed if slots[idx] is None else slots[idx] + seed
+        roots.append(node)
+
+    for node in _topo_nodes(roots):
+        slots = cotangents.pop(node.id, None)
+        if slots is None:
+            continue
+        if node.released:
+            raise RuntimeError(
+                f"trying to backward through {node} a second time; "
+                "set retain_graph=True to allow this")
+        filled = [
+            s if s is not None else jnp.zeros(av[0], av[1])
+            for s, av in zip(slots, node.out_avals)
+        ]
+        in_grads = node.vjp_fn(tuple(filled) if node.n_outputs > 1 else filled[0])
+        for (t, sub, slot), g in zip(node.input_links, in_grads):
+            if t.stop_gradient or g is None:
+                continue
+            if getattr(g, "dtype", None) is not None and g.dtype == jax.dtypes.float0:
+                continue  # non-differentiable (integer) input
+            g = _apply_hooks(t, g)
+            if sub is None:
+                _accumulate_leaf(t, g)
+            else:
+                sl = cotangents.setdefault(sub.id, [None] * sub.n_outputs)
+                sl[slot] = g if sl[slot] is None else sl[slot] + g
+        if not retain_graph:
+            node.release()
+
+
+def _accumulate_leaf(t, g) -> None:
+    """GradientAccumulator parity: sum into ``.grad`` in place."""
+    from .tensor import Tensor
+
+    if _leaf_filter is not None and id(t) not in _leaf_filter:
+        return
+
+    if g.dtype != t._data.dtype and jnp.issubdtype(t._data.dtype, jnp.floating):
+        g = g.astype(t._data.dtype)
+    if t.grad is None:
+        gt = Tensor(g, stop_gradient=True)
+        gt.name = (t.name or "tensor") + "@GRAD"
+        t.grad = gt
+    else:
+        t.grad._set_data(t.grad._data + g)
+
+
+def _apply_hooks(t, g):
+    for hook in t._hooks.values():
+        out = hook(_wrap_hook_arg(t, g))
+        if out is not None:
+            g = out._data if hasattr(out, "_data") else out
+    return g
+
+
+def _wrap_hook_arg(t, g):
+    from .tensor import Tensor
+
+    return Tensor(g, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False):
+    """``paddle.grad``: returns grads of ``outputs`` w.r.t ``inputs`` without
+    touching ``.grad`` slots. Implemented by running backward on a shadow
+    accumulation map.
+
+    Note: ``create_graph=True`` (higher-order grads through the tape) is
+    supported by re-dispatching the vjp through the op layer is not yet
+    implemented — use ``to_static``/jax.grad composition for higher order.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is not supported by the eager tape yet; "
+            "wrap the computation in paddle.jit.to_static and use jax.grad")
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = False
+
+    # stash existing .grad, run backward, read, restore
+    global _leaf_filter
+    stash = [t.grad for t in inputs]
+    for t in inputs:
+        t.grad = None
+    _leaf_filter = {id(t) for t in inputs}
+    try:
+        backward(outputs, grad_outputs, retain_graph=retain_graph)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        f"one of the input tensors ({t.name}) was not used in the "
+                        "graph; pass allow_unused=True to return None for it")
+                results.append(None)
+            else:
+                results.append(t.grad)
+    finally:
+        _leaf_filter = None
+        for t, old in zip(inputs, stash):
+            t.grad = old
+    return results

@@ -1,0 +1,121 @@
+"""Data types.
+
+Parity surface: the reference's ``phi::DataType`` / ``paddle.float32`` style
+dtype taxonomy (upstream: paddle/phi/common/data_type.h, python/paddle dtype
+exports). Here every dtype is a thin alias of a ``jnp.dtype`` so tensors
+interoperate with jax with zero conversion.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances — what jax uses natively).
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float16 = jnp.dtype(jnp.float16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+uint8 = jnp.dtype(jnp.uint8)
+uint16 = jnp.dtype(jnp.uint16)
+uint32 = jnp.dtype(jnp.uint32)
+uint64 = jnp.dtype(jnp.uint64)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+float8_e4m3fn = jnp.dtype(jnp.float8_e4m3fn)
+float8_e5m2 = jnp.dtype(jnp.float8_e5m2)
+
+_ALIASES = {
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+_FLOATS = (bfloat16, float16, float32, float64, float8_e4m3fn, float8_e5m2)
+_INTS = (int8, int16, int32, int64, uint8, uint16, uint32, uint64)
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize any dtype spec (str, np/jnp dtype, python type) to jnp.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        d = _ALIASES.get(dtype)
+        if d is None:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return d
+    if dtype is float:
+        return float32
+    if dtype is int:
+        return int64
+    if dtype is bool:
+        return bool_
+    return jnp.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATS
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTS
+
+
+def is_complex(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in (complex64, complex128)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return "bool" if d == bool_ else d.name
+
+
+# Default dtype handling (parity: paddle.get_default_dtype/set_default_dtype).
+_default_dtype = float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))
+
+
+def promote_types(a, b):
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))
+
+
+def canonicalize(dtype):
+    """Map 64-bit dtypes to their 32-bit forms when x64 is disabled (jax
+    default). Keeps paddle's int64-by-default API surface warning-free; on
+    TPU 32-bit is the native width anyway."""
+    import jax
+    d = convert_dtype(dtype)
+    if d is None or jax.config.jax_enable_x64:
+        return d
+    return {int64: int32, uint64: uint32, float64: float32,
+            complex128: complex64}.get(d, d)

@@ -1,0 +1,471 @@
+"""Tensor: an imperative, autograd-capable wrapper over ``jax.Array``.
+
+Parity surface: ``paddle.Tensor`` (upstream: paddle/phi/api/include/tensor.h,
+pybind eager tensor in paddle/fluid/pybind/eager.cc, method surface in
+python/paddle/tensor/). TPU-native design: the payload is always a jax array
+(or a jax tracer while ``to_static`` is tracing); every op goes through one
+dispatch function, ``apply``, which is the analogue of the reference's
+generated ``*_ad_func`` + Phi API path — it handles AMP autocast, autograd
+tape recording (via ``jax.vjp``), trace-state read logging, and NaN checks.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import flags as _flags
+from .. import device as _device
+from . import dtype as _dtype
+from . import tracing as _tracing
+from .autograd import GradNode, backward as _backward
+
+try:
+    from jax.core import Tracer as _Tracer
+except Exception:  # pragma: no cover
+    from jax._src.core import Tracer as _Tracer
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "apply", "register_tensor_method"]
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, _Tracer)
+
+
+class RemovableHandle:
+    _next_id = 0
+
+    def __init__(self, hooks: dict):
+        self._hooks = hooks
+        self.hook_id = RemovableHandle._next_id
+        RemovableHandle._next_id += 1
+
+    def remove(self) -> None:
+        self._hooks.pop(self.hook_id, None)
+
+
+class Tensor:
+    __slots__ = (
+        "_data", "stop_gradient", "_grad", "_grad_node", "_grad_index",
+        "name", "persistable", "trainable", "_hooks", "__weakref__",
+    )
+
+    # let binary dunders win over numpy array ops
+    __array_priority__ = 100
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad: Optional["Tensor"] = None
+        self._grad_node: Optional[GradNode] = None
+        self._grad_index: int = 0
+        self.name = name
+        self.persistable = False
+        self.trainable = True
+        self._hooks: dict = {}
+
+    # --- payload mutation (the single write seam; trace-visible) ------------
+    def _set_data(self, value) -> None:
+        ts = _tracing.trace_state()
+        if ts is not None:
+            ts.record_mutation("data", self)
+        self._data = value
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, value: Optional["Tensor"]) -> None:
+        ts = _tracing.trace_state()
+        if ts is not None:
+            ts.record_mutation("grad", self)
+        self._grad = value
+
+    # --- metadata -----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    ndimension = ndim
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._data.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        d = getattr(self._data, "devices", None)
+        if d is None or _is_tracer(self._data):
+            return _device.current_place()
+        dev = next(iter(self._data.devices()))
+        kind = "cpu" if dev.platform == "cpu" else "tpu"
+        return _device.Place(kind, dev.id)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def numel(self) -> int:
+        return self.size
+
+    def element_size(self) -> int:
+        return self.dtype.itemsize
+
+    def dim(self) -> int:
+        return self.ndim
+
+    # --- host interop -------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        if _is_tracer(self._data):
+            raise RuntimeError("Tensor.numpy() is not available while tracing "
+                               "inside paddle.jit.to_static")
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if _is_tracer(self._data):
+            body = f"<traced {self._data.aval}>"
+        else:
+            body = np.array2string(np.asarray(self._data), separator=", ")
+        return (f"Tensor(shape={self.shape}, dtype={_dtype.dtype_name(self.dtype)}, "
+                f"place={self.place}, stop_gradient={sg},\n       {body})")
+
+    # --- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None, retain_graph: bool = False):
+        _backward([self], [grad_tensor] if grad_tensor is not None else None,
+                  retain_graph=retain_graph)
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_grad(self) -> None:
+        self.grad = None
+
+    def register_hook(self, hook: Callable) -> RemovableHandle:
+        h = RemovableHandle(self._hooks)
+        self._hooks[h.hook_id] = hook
+        return h
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self._grad_index = 0
+        self.stop_gradient = True
+        return self
+
+    @property
+    def requires_grad(self) -> bool:
+        return not self.stop_gradient
+
+    @requires_grad.setter
+    def requires_grad(self, v: bool) -> None:
+        self.stop_gradient = not v
+
+    # --- device movement ----------------------------------------------------
+    def to(self, *args, **kwargs) -> "Tensor":
+        device = kwargs.pop("device", None)
+        dtype = kwargs.pop("dtype", None)
+        blocking = kwargs.pop("blocking", None)  # noqa: F841  (async is native)
+        for a in args:
+            if isinstance(a, (str, _device.Place)):
+                device = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            place = device if isinstance(device, _device.Place) else _parse_place(device)
+            if out is self:
+                out = Tensor(self._data, stop_gradient=self.stop_gradient, name=self.name)
+                out._grad_node, out._grad_index = self._grad_node, self._grad_index
+            if not _is_tracer(out._data):
+                out._data = jax.device_put(out._data, place.jax_device())
+        return out
+
+    def cpu(self) -> "Tensor":
+        return self.to(device="cpu")
+
+    def cuda(self, device_id=None) -> "Tensor":
+        return self.to(device="tpu")
+
+    def tpu(self) -> "Tensor":
+        return self.to(device="tpu")
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def clone(self) -> "Tensor":
+        return apply("clone", jnp.copy, self)
+
+    # --- misc parity --------------------------------------------------------
+    def copy_(self, other: "Tensor") -> "Tensor":
+        src = other._data if isinstance(other, Tensor) else jnp.asarray(other)
+        self._set_data(jnp.broadcast_to(src, self._data.shape).astype(self._data.dtype))
+        return self
+
+    def set_value(self, value) -> None:
+        self.copy_(value if isinstance(value, Tensor) else to_tensor(value))
+
+    def get_tensor(self):  # LoDTensor parity shim
+        return self
+
+    def value(self):
+        return self
+
+    def _rebind(self, out: "Tensor") -> "Tensor":
+        """Adopt another tensor's payload + grad linkage (in-place op seam)."""
+        self._set_data(out._data)
+        self._grad_node = out._grad_node
+        self._grad_index = out._grad_index
+        self.stop_gradient = out.stop_gradient
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable tensor (parity: paddle Parameter / EagerParamBase)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
+
+    _param_counter = 0
+
+    def __init__(self, data, name: Optional[str] = None, trainable: bool = True):
+        if name is None:
+            name = f"param_{Parameter._param_counter}"
+            Parameter._param_counter += 1
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.need_clip = True
+        _state_registry.register(self)
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+class _StateRegistry:
+    """All live parameters / optimizer accumulators / RNG states.
+
+    ``to_static`` consults this to decide which concrete tensors may legally
+    become jit inputs (anything else that is read gets baked as a constant).
+    """
+
+    def __init__(self):
+        import weakref
+        self._items = weakref.WeakValueDictionary()
+        self._next = 0
+
+    def register(self, t: Tensor) -> None:
+        self._items[self._next] = t
+        self._next += 1
+
+    def alive(self):
+        return [t for _, t in sorted(self._items.items())]
+
+    def alive_items(self):
+        """[(registration id, tensor)] — ids are never reused, so they make a
+        stable cache key distinguishing same-length registries over time."""
+        return sorted(self._items.items())
+
+
+_state_registry = _StateRegistry()
+
+
+def register_state_tensor(t: Tensor) -> None:
+    _state_registry.register(t)
+
+
+def _parse_place(device) -> _device.Place:
+    if isinstance(device, _device.Place):
+        return device
+    dev = str(device).lower()
+    if dev in ("gpu", "cuda", "xpu", "tpu"):
+        return _device.TPUPlace() if _device.is_compiled_with_tpu() else _device.CPUPlace()
+    if ":" in dev:
+        kind, _, idx = dev.partition(":")
+        return _device.Place("tpu" if kind in ("gpu", "cuda", "tpu", "xpu") else kind, int(idx))
+    return _device.Place(dev, 0)
+
+
+# ---------------------------------------------------------------------------
+# op dispatch
+# ---------------------------------------------------------------------------
+
+def _autocast_targets(op_name: str, arrays):
+    """Per-input cast target dtypes for the active autocast state (or None).
+
+    Returns None when no casting applies. The actual cast happens INSIDE the
+    vjp'd function so the cast itself is differentiated — cotangents then
+    arrive in each producer's original dtype.
+    """
+    st = _tracing.amp_state()
+    if st is None or not st.enable:
+        return None
+    low = st.dtype
+    fp32 = jnp.float32
+
+    if st.level == "O2":
+        target = fp32 if op_name in st.black_set else low
+    elif op_name in st.white_set:
+        target = low
+    elif op_name in st.black_set:
+        target = fp32
+    else:
+        return None
+    out = [target if jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != target
+           else None for a in arrays]
+    return out if any(t is not None for t in out) else None
+
+
+def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
+          differentiable: bool = True, amp: bool = True, **static_kwargs) -> Any:
+    """Dispatch one op: the TPU analogue of ad_func → Phi API → kernel.
+
+    ``fn`` is a pure jax function over arrays. Tensor inputs are unwrapped,
+    autocast applied, and — when grad is enabled and some input requires grad
+    — the op is linearized with ``jax.vjp`` and a ``GradNode`` recorded.
+    """
+    ts = _tracing.trace_state()
+    arrays = []
+    for t in tensor_inputs:
+        a = t._data
+        if ts is not None and not _is_tracer(a):
+            ts.record_read(t)
+        arrays.append(a)
+
+    cast_targets = _autocast_targets(op_name, arrays) if amp else None
+
+    needs_grad = (differentiable and _tracing.grad_enabled()
+                  and any(not t.stop_gradient for t in tensor_inputs))
+
+    def f(*xs):
+        if cast_targets is not None:
+            xs = [x.astype(d) if d is not None else x
+                  for x, d in zip(xs, cast_targets)]
+        r = fn(*xs, **static_kwargs) if static_kwargs else fn(*xs)
+        return tuple(r) if isinstance(r, list) else r
+
+    if needs_grad:
+        outs, vjp_fn = jax.vjp(f, *arrays)
+    else:
+        outs = f(*arrays)
+        vjp_fn = None
+
+    multi = isinstance(outs, tuple)
+    out_arrays = outs if multi else (outs,)
+
+    if _flags.flag("check_nan_inf"):
+        for oa in out_arrays:
+            if not _is_tracer(oa) and jnp.issubdtype(oa.dtype, jnp.inexact):
+                if not bool(jnp.all(jnp.isfinite(oa))):
+                    raise FloatingPointError(f"op {op_name} produced nan/inf")
+
+    out_tensors = []
+    if needs_grad:
+        node = GradNode(op_name, vjp_fn, tensor_inputs, len(out_arrays),
+                        tuple((oa.shape, oa.dtype) for oa in out_arrays))
+        for i, oa in enumerate(out_arrays):
+            t = Tensor(oa, stop_gradient=False)
+            t._grad_node = node
+            t._grad_index = i
+            out_tensors.append(t)
+    else:
+        for oa in out_arrays:
+            out_tensors.append(Tensor(oa, stop_gradient=True))
+
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]
+
+
+def register_tensor_method(name: str, fn: Callable) -> None:
+    """Install a method on Tensor (ops modules use this to build the ~400
+    method surface without circular imports)."""
+    setattr(Tensor, name, fn)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """``paddle.to_tensor`` parity."""
+    dtype = _dtype.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        arr = data._data
+        if dtype is not None and arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        t = Tensor(arr, stop_gradient=stop_gradient)
+        return t
+    if isinstance(data, (jnp.ndarray, jax.Array)) and not isinstance(data, np.ndarray):
+        arr = data
+    else:
+        np_arr = np.asarray(data)
+        if np_arr.dtype == np.float64 and dtype is None:
+            np_arr = np_arr.astype(np.float32)
+        elif np_arr.dtype == np.int32 and dtype is None and isinstance(data, (int, numbers.Integral)):
+            np_arr = np_arr.astype(np.int64)
+        arr = np_arr
+    if dtype is not None and arr.dtype != dtype:
+        arr = jnp.asarray(arr, dtype=dtype) if _is_tracer(arr) else np.asarray(arr).astype(dtype) if isinstance(arr, np.ndarray) else arr.astype(dtype)
+    if not _is_tracer(arr):
+        target = _parse_place(place) if place is not None else _device.current_place()
+        arr = jax.device_put(arr, target.jax_device())
+    return Tensor(arr, stop_gradient=stop_gradient)

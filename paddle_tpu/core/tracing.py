@@ -1,0 +1,153 @@
+"""Execution-mode contexts shared by the whole framework.
+
+Three orthogonal modes thread through every op dispatch (the analogue of the
+thread-local state the reference keeps in its eager engine — upstream:
+paddle/fluid/eager/ tracer + amp state):
+
+* grad mode   — whether ops record autograd tape nodes (``no_grad``).
+* amp state   — autocast level/dtype and op allow/deny lists.
+* trace state — active while ``to_static`` functionalizes a user function:
+  records which concrete tensors were *read* (future jit inputs) and which
+  tensor locations were *mutated* (future jit outputs).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "grad_enabled", "no_grad", "enable_grad", "set_grad_enabled",
+    "amp_state", "AmpState", "push_amp_state", "pop_amp_state",
+    "trace_state", "TraceState", "push_trace_state", "pop_trace_state",
+]
+
+
+class _ModeStack(threading.local):
+    def __init__(self):
+        self.grad = [True]
+        self.amp: List["AmpState"] = []
+        self.trace: List["TraceState"] = []
+
+
+_modes = _ModeStack()
+
+
+# --- grad mode ---------------------------------------------------------------
+
+def grad_enabled() -> bool:
+    return _modes.grad[-1]
+
+
+class _GradMode(contextlib.ContextDecorator):
+    def __init__(self, enabled: bool):
+        self._enabled = enabled
+
+    def __enter__(self):
+        _modes.grad.append(self._enabled)
+        return self
+
+    def __exit__(self, *exc):
+        _modes.grad.pop()
+        return False
+
+
+def no_grad(func=None):
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+    if func is not None:
+        return _GradMode(False)(func)
+    return _GradMode(False)
+
+
+def enable_grad(func=None):
+    if func is not None:
+        return _GradMode(True)(func)
+    return _GradMode(True)
+
+
+@contextlib.contextmanager
+def set_grad_enabled(enabled: bool):
+    with _GradMode(enabled):
+        yield
+
+
+# --- amp state ---------------------------------------------------------------
+
+class AmpState:
+    __slots__ = ("enable", "dtype", "level", "white_set", "black_set")
+
+    def __init__(self, enable, dtype, level, white_set, black_set):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level  # 'O1' | 'O2'
+        self.white_set = white_set
+        self.black_set = black_set
+
+
+def amp_state() -> Optional[AmpState]:
+    return _modes.amp[-1] if _modes.amp else None
+
+
+def push_amp_state(s: AmpState) -> None:
+    _modes.amp.append(s)
+
+
+def pop_amp_state() -> None:
+    _modes.amp.pop()
+
+
+# --- to_static trace state ---------------------------------------------------
+
+class TraceState:
+    """Read/mutation log for functionalization.
+
+    ``reads``: id(tensor) -> tensor, for tensors whose concrete ``_data`` was
+    consumed while tracing (these must become jit inputs or they would be baked
+    into the compiled program as constants).
+    ``mutations``: ordered unique locations written while tracing. A location
+    is ('data', ref) — tensor._data replaced in place — or ('grad', ref) —
+    tensor.grad re-assigned. Locations are resolved again at rebind time so a
+    ``.grad`` slot that received a brand-new Tensor during tracing still maps
+    back onto whatever object currently occupies the slot.
+    """
+
+    def __init__(self):
+        self.reads: Dict[int, Any] = {}
+        self._mut_keys: set = set()
+        self.mutations: List[Tuple[str, Any]] = []
+        self._saved: List[Tuple[str, Any, Any]] = []  # (kind, tensor, old value)
+
+    def record_read(self, tensor) -> None:
+        self.reads.setdefault(id(tensor), tensor)
+
+    def record_mutation(self, kind: str, tensor) -> None:
+        key = (kind, id(tensor))
+        if key in self._mut_keys:
+            return
+        self._mut_keys.add(key)
+        self.mutations.append((kind, weakref.ref(tensor)))
+        old = tensor._data if kind == "data" else tensor._grad
+        self._saved.append((kind, tensor, old))
+
+    def restore(self) -> None:
+        """Undo every mutation made under this trace (leaves no tracers
+        behind in live tensors)."""
+        for kind, tensor, old in reversed(self._saved):
+            if kind == "data":
+                tensor._data = old
+            else:
+                tensor._grad = old
+
+
+def trace_state() -> Optional[TraceState]:
+    return _modes.trace[-1] if _modes.trace else None
+
+
+def push_trace_state(s: TraceState) -> None:
+    _modes.trace.append(s)
+
+
+def pop_trace_state() -> TraceState:
+    return _modes.trace.pop()

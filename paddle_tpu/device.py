@@ -1,0 +1,151 @@
+"""Device / Place taxonomy.
+
+Parity surface: ``phi::Place`` (upstream: paddle/phi/common/place.h) and
+``paddle.device.set_device`` (python/paddle/device/__init__.py). TPU-native
+design: a Place names a jax device; ``set_device`` selects the default
+placement used by tensor factories; cross-place copies are ``jax.device_put``.
+No DeviceContext/stream pool is needed — XLA/PJRT owns streams and events.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Union
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CustomPlace",
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_cuda", "is_compiled_with_tpu", "current_place",
+]
+
+
+class Place:
+    """Identity of a physical device: (device_type, device_id)."""
+
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- mapping to jax ------------------------------------------------------
+    def jax_device(self) -> jax.Device:
+        devs = _devices_of_type(self.device_type)
+        if not devs:
+            raise RuntimeError(f"no {self.device_type!r} devices visible to jax")
+        return devs[self.device_id % len(devs)]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+
+def CPUPlace(device_id: int = 0) -> Place:
+    return Place("cpu", device_id)
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def CUDAPlace(device_id: int = 0) -> Place:
+    # Parity alias: there is no CUDA on TPU systems; accepted so reference
+    # scripts run, mapped to the accelerator if present else CPU.
+    return Place("tpu", device_id) if _accelerator_type() == "tpu" else Place("cpu", device_id)
+
+
+def CustomPlace(device_type: str, device_id: int = 0) -> Place:
+    return Place(device_type, device_id)
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_of_type(device_type: str):
+    try:
+        all_devs = jax.devices()
+    except RuntimeError:
+        all_devs = []
+    if device_type == "cpu":
+        try:
+            return tuple(jax.devices("cpu"))
+        except RuntimeError:
+            return tuple(d for d in all_devs if d.platform == "cpu")
+    # A TPU may surface as platform 'tpu' or (via tunnel) an experimental
+    # platform; treat any non-cpu accelerator as the 'tpu' place.
+    accel = tuple(d for d in all_devs if d.platform != "cpu")
+    if device_type in ("tpu", "gpu", "xpu"):
+        return accel
+    return tuple(d for d in all_devs if d.platform == device_type)
+
+
+@functools.lru_cache(maxsize=1)
+def _accelerator_type() -> str:
+    try:
+        if any(d.platform != "cpu" for d in jax.devices()):
+            return "tpu"
+    except RuntimeError:
+        pass
+    return "cpu"
+
+
+_current_place: Optional[Place] = None
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    """``paddle.device.set_device('tpu')`` / ``('tpu:0')`` / ``('cpu')``."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    dev = device.lower()
+    if dev in ("gpu", "cuda", "xpu"):
+        dev = "tpu" if _accelerator_type() == "tpu" else "cpu"
+    if ":" in dev:
+        kind, _, idx = dev.partition(":")
+        _current_place = Place(kind, int(idx))
+    else:
+        _current_place = Place(dev, 0)
+    _current_place.jax_device()  # validate eagerly
+    return _current_place
+
+
+def current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = Place(_accelerator_type(), 0)
+    return _current_place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def get_all_devices():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    return len(_devices_of_type(device_type or current_place().device_type))
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return _accelerator_type() == "tpu"
+
+
+def default_jax_device() -> jax.Device:
+    return current_place().jax_device()

@@ -1,0 +1,124 @@
+"""Global flags registry.
+
+Capability parity with the reference's gflags-style ``FLAGS_*`` system
+(upstream: paddle/common/flags.h, paddle/phi/core/flags.cc — settable via
+``FLAGS_x=y`` env vars or ``paddle.set_flags``/``get_flags`` at runtime).
+Here it is a plain Python registry: flags are declared with a type, default,
+and help string; environment variables named ``FLAGS_<name>`` override the
+default at first read; ``set_flags`` overrides at runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Optional
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: lambda s: int(s, 0),
+    float: float,
+    str: str,
+}
+
+
+@dataclass
+class _Flag:
+    name: str
+    type: type
+    default: Any
+    help: str
+    value: Any = None
+    env_checked: bool = False
+
+
+class _FlagRegistry:
+    def __init__(self) -> None:
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, type_: type, default: Any, help_: str = "") -> None:
+        name = self._canon(name)
+        with self._lock:
+            if name in self._flags:
+                return
+            self._flags[name] = _Flag(name, type_, default, help_)
+
+    @staticmethod
+    def _canon(name: str) -> str:
+        return name if name.startswith("FLAGS_") else "FLAGS_" + name
+
+    def get(self, name: str) -> Any:
+        name = self._canon(name)
+        with self._lock:
+            f = self._flags.get(name)
+            if f is None:
+                raise KeyError(f"flag {name!r} is not defined")
+            if f.value is not None:
+                return f.value
+            if not f.env_checked:
+                f.env_checked = True
+                env = os.environ.get(f.name)
+                if env is not None:
+                    f.value = _PARSERS.get(f.type, str)(env)
+                    return f.value
+            return f.default
+
+    def set(self, name: str, value: Any) -> None:
+        name = self._canon(name)
+        with self._lock:
+            f = self._flags.get(name)
+            if f is None:
+                raise KeyError(f"flag {name!r} is not defined")
+            f.value = f.type(value) if not isinstance(value, f.type) else value
+
+    def names(self) -> Iterable[str]:
+        with self._lock:
+            return list(self._flags)
+
+
+_registry = _FlagRegistry()
+
+
+def define_flag(name: str, default: Any, help: str = "", flag_type: Optional[type] = None) -> None:
+    """Declare a flag (analogue of ``PHI_DEFINE_EXPORTED_*``)."""
+    _registry.define(name, flag_type or type(default), default, help)
+
+
+def flag(name: str) -> Any:
+    """Read a single flag value."""
+    return _registry.get(name)
+
+
+def get_flags(names) -> Dict[str, Any]:
+    """Parity with ``paddle.get_flags``: accepts a name or list of names."""
+    if isinstance(names, str):
+        names = [names]
+    return {_FlagRegistry._canon(n): _registry.get(n) for n in names}
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Parity with ``paddle.set_flags({'FLAGS_x': v})``."""
+    for k, v in flags.items():
+        _registry.set(k, v)
+
+
+# --- core flags used across the framework -----------------------------------
+define_flag("eager_op_jit", True, "jit-compile each eager op (per-op kernel cache)")
+define_flag("check_nan_inf", False, "check every op output for nan/inf (debug)")
+define_flag("amp_dtype", "bfloat16", "default autocast dtype on TPU")
+define_flag("allocator_strategy", "auto_growth", "accepted for parity; XLA/PJRT manages memory")
+define_flag("use_stream_safe_cuda_allocator", False, "parity no-op on TPU")
+# fp32 matmuls run at full fp32 (paddle semantics). The MXU's native
+# bf16xbf16->fp32 path is reached through bf16 dtypes / AMP, where this flag
+# is irrelevant; lower it only to allow bf16-split passes for fp32 inputs.
+define_flag("tpu_matmul_precision", "highest", "jax matmul precision: default|high|highest")
+define_flag("log_level", 0, "framework VLOG-style verbosity")

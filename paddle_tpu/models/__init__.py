@@ -1,0 +1,10 @@
+"""Model zoo capability surface.
+
+Parity: the out-of-repo zoos named by BASELINE.json (PaddleClas ResNet,
+PaddleNLP BERT/ERNIE + Llama, PaddleRec DeepFM, PaddleDetection PP-YOLOE).
+Each family lives here as a first-class citizen of the TPU framework.
+"""
+
+from . import llama  # noqa: F401
+from . import bert  # noqa: F401
+from . import deepfm  # noqa: F401

@@ -1,0 +1,164 @@
+"""BERT / ERNIE-3.0 family (BASELINE config #2).
+
+Parity surface: PaddleNLP BertModel/ErnieModel (encoder stack with learned
+position embeddings, token-type embeddings, pooler; ERNIE-3.0-base shares the
+same trunk with task-specific heads). Built on the framework's
+TransformerEncoder so TP/SP variants compose the same way as Llama.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import to_tensor
+from ..nn import functional as F
+from ..ops.creation import arange
+from ..ops.manipulation import reshape, unsqueeze
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def ernie3_base():
+        # ERNIE-3.0-base-zh trunk dims (PaddleNLP ernie-3.0-base-zh)
+        return BertConfig(vocab_size=40000, hidden_size=768,
+                          num_hidden_layers=12, num_attention_heads=12,
+                          intermediate_size=3072)
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, inter=128, max_pos=64):
+        return BertConfig(vocab_size=vocab, hidden_size=hidden,
+                          num_hidden_layers=layers, num_attention_heads=heads,
+                          intermediate_size=inter, max_position_embeddings=max_pos)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size, config.hidden_size,
+                                            padding_idx=config.pad_token_id)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings,
+                                                config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        L = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = arange(L, dtype="int32")
+        if token_type_ids is None:
+            from ..ops.creation import zeros_like
+            token_type_ids = zeros_like(input_ids)
+        x = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids) \
+            + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return nn.functional.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = nn.TransformerEncoderLayer(
+            d_model=config.hidden_size, nhead=config.num_attention_heads,
+            dim_feedforward=config.intermediate_size,
+            dropout=config.hidden_dropout_prob, activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(enc_layer, config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # (B, L) padding mask -> (B, 1, 1, L) additive
+            m = unsqueeze(unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - m.astype("float32")) * -1e4
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(x, attention_mask)
+        pooled = self.pooler(seq)
+        return seq, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels), logits
+        return logits
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size)
+        self.nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                mlm_labels=None, nsp_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        from ..ops.manipulation import transpose
+        mlm_logits = F.linear(
+            h, transpose(self.bert.embeddings.word_embeddings.weight, [1, 0]))
+        nsp_logits = self.nsp(pooled)
+        if mlm_labels is not None:
+            loss = F.cross_entropy(
+                reshape(mlm_logits, [-1, self.bert.config.vocab_size]),
+                reshape(mlm_labels, [-1]), ignore_index=-100 if True else 0)
+            if nsp_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits, nsp_labels)
+            return loss, mlm_logits
+        return mlm_logits, nsp_logits
+
+
+ErnieModel = BertModel
+ErnieConfig = BertConfig
+ErnieForSequenceClassification = BertForSequenceClassification

@@ -1,0 +1,83 @@
+"""DeepFM (PaddleRec; BASELINE config #5) with the PS→ICI sharded-embedding
+path.
+
+Parity surface: PaddleRec models/rank/deepfm. The reference trains this with
+a brpc parameter server hosting the sparse embedding table (upstream
+paddle/fluid/distributed/ps/). TPU-native replacement per the north star:
+the embedding table is a dense sharded tensor over the mesh's dp/sharding
+axis; lookups are gathers and gradient exchange rides XLA collectives over
+ICI (see distributed.sharded_embedding.ShardedEmbedding).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.manipulation import concat, reshape
+from ..ops.reduce import sum as psum
+
+
+@dataclass
+class DeepFMConfig:
+    sparse_feature_number: int = 1000  # vocab per the criteo hashing space
+    sparse_feature_dim: int = 9
+    num_sparse_fields: int = 26
+    dense_feature_dim: int = 13
+    fc_sizes: tuple = (512, 256, 128, 32)
+
+    @staticmethod
+    def tiny():
+        return DeepFMConfig(sparse_feature_number=100, sparse_feature_dim=8,
+                            num_sparse_fields=6, dense_feature_dim=4,
+                            fc_sizes=(32, 16))
+
+
+class DeepFM(nn.Layer):
+    def __init__(self, config: DeepFMConfig, sharded: bool = False):
+        super().__init__()
+        self.config = config
+        emb_cls = nn.Embedding
+        if sharded:
+            from ..distributed.sharded_embedding import ShardedEmbedding
+            emb_cls = ShardedEmbedding
+        # first-order weights (one scalar per sparse id) + dense linear
+        self.fo_embedding = emb_cls(config.sparse_feature_number, 1)
+        self.fo_dense = nn.Linear(config.dense_feature_dim, 1)
+        # second-order latent vectors
+        self.embedding = emb_cls(config.sparse_feature_number,
+                                 config.sparse_feature_dim)
+        self.dense_latent = nn.Linear(config.dense_feature_dim,
+                                      config.dense_feature_dim *
+                                      config.sparse_feature_dim)
+        # DNN tower
+        layers = []
+        in_dim = config.num_sparse_fields * config.sparse_feature_dim
+        for h in config.fc_sizes:
+            layers += [nn.Linear(in_dim, h), nn.ReLU()]
+            in_dim = h
+        layers += [nn.Linear(in_dim, 1)]
+        self.dnn = nn.Sequential(*layers)
+
+    def forward(self, sparse_ids, dense_feats):
+        """sparse_ids: (B, F) int; dense_feats: (B, D) float."""
+        cfg = self.config
+        b = sparse_ids.shape[0]
+        # ---- first order
+        fo_sparse = psum(reshape(self.fo_embedding(sparse_ids), [b, -1]),
+                         axis=1, keepdim=True)
+        fo = fo_sparse + self.fo_dense(dense_feats)
+        # ---- second order (FM): 0.5 * ((sum v)^2 - sum v^2)
+        emb = self.embedding(sparse_ids)  # (B, F, K)
+        sum_sq = psum(emb, axis=1) ** 2
+        sq_sum = psum(emb ** 2, axis=1)
+        fm = 0.5 * psum(sum_sq - sq_sum, axis=1, keepdim=True)
+        # ---- deep tower
+        deep = self.dnn(reshape(emb, [b, -1]))
+        return F.sigmoid(fo + fm + deep)
+
+    def loss(self, sparse_ids, dense_feats, labels):
+        pred = self(sparse_ids, dense_feats)
+        return F.binary_cross_entropy(reshape(pred, [-1]),
+                                      labels.astype("float32"))

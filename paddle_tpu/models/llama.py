@@ -1,0 +1,222 @@
+"""Llama-2 family (flagship LLM; BASELINE config #4).
+
+Parity surface: PaddleNLP ``llm/`` LlamaForCausalLM under Fleet hybrid
+parallel. TPU-native design decisions:
+
+* attention runs through ``F.scaled_dot_product_attention`` (XLA-fused; the
+  Pallas flash-attention kernel slots in through the same seam for long
+  sequences),
+* GQA via kv-head broadcast,
+* rotary embeddings precomputed once per (max_len, head_dim) and gathered,
+* tensor-parallel variants of q/k/v/o and MLP projections come from
+  ``distributed.fleet.mp_layers`` when a hybrid mesh is active — the layer
+  chooses plain Linear on a 1-device mesh so the same model code serves both.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, to_tensor
+from .. import nn
+from ..nn import functional as F
+from ..ops.creation import zeros
+from ..ops.manipulation import concat, reshape, transpose
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2, inter=128,
+             max_pos=128) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                           num_hidden_layers=layers, num_attention_heads=heads,
+                           num_key_value_heads=kv_heads, intermediate_size=inter,
+                           max_position_embeddings=max_pos)
+
+
+def _rope_cache(max_len: int, head_dim: int, theta: float):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    t = np.arange(max_len, dtype=np.float32)
+    freqs = np.outer(t, inv)  # (L, D/2)
+    return np.cos(freqs), np.sin(freqs)
+
+
+def apply_rotary(x: Tensor, cos: Tensor, sin: Tensor, position_offset: int = 0):
+    """x: (B, L, H, D). cos/sin: (max_len, D/2)."""
+    L = x.shape[1]
+
+    def f(a, c, s):
+        c = c[position_offset:position_offset + L][None, :, None, :]
+        s = s[position_offset:position_offset + L][None, :, None, :]
+        x1, x2 = jnp.split(a, 2, axis=-1)
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+    return apply("rope", f, x, cos, sin)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h, nh, nkv = config.hidden_size, config.num_attention_heads, \
+            config.num_key_value_heads
+        self.head_dim = h // nh
+        self.num_heads = nh
+        self.num_kv_heads = nkv
+        LinearCls = _maybe_parallel_linear()
+        self.q_proj = LinearCls(h, nh * self.head_dim, bias_attr=False)
+        self.k_proj = LinearCls(h, nkv * self.head_dim, bias_attr=False)
+        self.v_proj = LinearCls(h, nkv * self.head_dim, bias_attr=False)
+        self.o_proj = _maybe_parallel_linear(row=True)(
+            nh * self.head_dim, h, bias_attr=False)
+
+    def forward(self, x, cos, sin, attn_mask=None, cache=None):
+        b, l = x.shape[0], x.shape[1]
+        q = reshape(self.q_proj(x), [b, l, -1, self.head_dim])
+        k = reshape(self.k_proj(x), [b, l, -1, self.head_dim])
+        v = reshape(self.v_proj(x), [b, l, -1, self.head_dim])
+        offset = 0 if cache is None else cache[0].shape[1]
+        q = apply_rotary(q, cos, sin, offset)
+        k = apply_rotary(k, cos, sin, offset)
+        if cache is not None:
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+            training=self.training)
+        out = self.o_proj(reshape(out, [b, l, -1]))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        LinearCls = _maybe_parallel_linear()
+        self.gate_proj = LinearCls(config.hidden_size, config.intermediate_size,
+                                   bias_attr=False)
+        self.up_proj = LinearCls(config.hidden_size, config.intermediate_size,
+                                 bias_attr=False)
+        self.down_proj = _maybe_parallel_linear(row=True)(
+            config.intermediate_size, config.hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, cos, sin, attn_mask=None, cache=None):
+        res = x
+        h = self.self_attn(self.input_layernorm(x), cos, sin, attn_mask, cache)
+        if cache is not None:
+            h, new_cache = h
+        x = res + h
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = _rope_cache(config.max_position_embeddings,
+                               config.hidden_size // config.num_attention_heads,
+                               config.rope_theta)
+        self.register_buffer("rope_cos", to_tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", to_tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, self.rope_cos, self.rope_sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        h = self.model(input_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            logits = F.linear(h, transpose(self.model.embed_tokens.weight, [1, 0]))
+        if labels is not None:
+            loss = F.cross_entropy(
+                reshape(logits[:, :-1, :], [-1, self.config.vocab_size]),
+                reshape(labels[:, 1:], [-1]))
+            return loss, logits
+        return logits
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approximate training FLOPs/token (6N + attention terms)."""
+        n = self.num_params()
+        c = self.config
+        attn = 12 * c.num_hidden_layers * c.hidden_size * seq_len
+        return 6.0 * n + attn
+
+
+def _maybe_parallel_linear(row: bool = False):
+    """Return ColumnParallelLinear/RowParallelLinear when a hybrid mesh with
+    mp_degree > 1 is active, else nn.Linear (same ctor signature subset)."""
+    try:
+        from ..distributed import fleet
+        hcg = fleet.get_hybrid_communicate_group()
+        if hcg is not None and hcg.get_model_parallel_world_size() > 1:
+            from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                                       RowParallelLinear)
+            return RowParallelLinear if row else ColumnParallelLinear
+    except Exception:
+        pass
+    return nn.Linear

@@ -1,0 +1,36 @@
+"""``paddle.nn`` namespace. Parity: python/paddle/nn/__init__.py."""
+
+from .layer import Layer, ParamAttr  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
+    Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D,
+    Pad3D, CosineSimilarity, PixelShuffle, Unfold,
+)
+from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose  # noqa: F401
+from .norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, RMSNorm,
+    LocalResponseNorm, SpectralNorm,
+)
+from .pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+)
+from .activation import (  # noqa: F401
+    ReLU, ReLU6, GELU, Sigmoid, Tanh, Softmax, LogSoftmax, LeakyReLU, ELU, SELU,
+    CELU, SiLU, Swish, Mish, Hardswish, Hardsigmoid, Hardtanh, Hardshrink,
+    Softshrink, Softplus, Softsign, Tanhshrink, ThresholdedReLU, LogSigmoid,
+    Maxout, PReLU, GLU,
+)
+from .container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, CosineEmbeddingLoss,
+    TripletMarginLoss, HingeEmbeddingLoss,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)

@@ -1,0 +1,123 @@
+"""Conv layers. Parity: python/paddle/nn/layer/conv.py."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import functional as F
+from .initializer import KaimingUniform
+from .layer import Layer
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "Conv1DTranspose"]
+
+
+def _ntuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, spatial, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, spatial)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.padding_mode = padding_mode
+        self.data_format = data_format
+        fan_in = (in_channels // groups) * int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + self.kernel_size,
+            attr=weight_attr, default_initializer=KaimingUniform(fan_in=fan_in))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, "
+                f"kernel_size={self.kernel_size}, stride={self.stride}, "
+                f"padding={self.padding}")
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding,
+                         dilation, groups, padding_mode, weight_attr, bias_attr,
+                         data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, 2)
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        fan_in = in_channels * int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + self.kernel_size,
+            attr=weight_attr, default_initializer=KaimingUniform(fan_in=fan_in))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding, self.groups,
+                                  self.dilation, self.data_format, output_size)
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, groups=1, dilation=1, weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__()
+        self.conv2dt = Conv2DTranspose(in_channels, out_channels,
+                                       (1, kernel_size), (1, stride), (0, padding),
+                                       (0, output_padding), (1, dilation), groups,
+                                       weight_attr, bias_attr)
+
+    def forward(self, x):
+        from ..ops.manipulation import squeeze, unsqueeze
+        return squeeze(self.conv2dt(unsqueeze(x, 2)), 2)

@@ -1,0 +1,75 @@
+"""``paddle.nn.functional`` namespace: re-exports the functional op surface.
+
+Parity: python/paddle/nn/functional/__init__.py.
+"""
+
+from ..ops.activation import (  # noqa: F401
+    relu, relu6, silu, swish, softsign, tanhshrink, mish, hardswish,
+    hardsigmoid, log_sigmoid, gelu, softmax, log_softmax, softplus, leaky_relu,
+    elu, selu, celu, prelu, hardtanh, hardshrink, softshrink, thresholded_relu,
+    glu, maxout, gumbel_softmax,
+)
+from ..ops.math import sigmoid, tanh  # noqa: F401
+from ..ops.nn_ops import (  # noqa: F401
+    linear, embedding, dropout, dropout2d, dropout3d, alpha_dropout,
+    layer_norm, rms_norm, batch_norm, instance_norm, group_norm,
+    local_response_norm, normalize, scaled_dot_product_attention,
+    softmax_mask_fuse_upper_triangle,
+)
+from ..ops.conv_pool import (  # noqa: F401
+    conv1d, conv2d, conv3d, conv2d_transpose, max_pool1d, max_pool2d,
+    max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
+    adaptive_avg_pool2d, adaptive_max_pool2d, interpolate, upsample,
+    pixel_shuffle, unfold,
+)
+from ..ops.loss_ops import (  # noqa: F401
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    kl_div, hinge_embedding_loss, margin_ranking_loss, cosine_embedding_loss,
+    triplet_margin_loss, square_error_cost, log_loss, sigmoid_focal_loss,
+)
+from ..ops.manipulation import pad  # noqa: F401
+from ..ops.indexing import one_hot  # noqa: F401
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    from ..ops._helpers import ensure_tensor
+    from ..core.tensor import apply
+    import jax.numpy as jnp
+    label = ensure_tensor(label)
+    n = label._data.shape[-1]
+
+    def f(y):
+        return (1.0 - epsilon) * y + epsilon / n
+
+    return apply("label_smooth", f, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    from ..ops._helpers import ensure_tensor
+    from ..core.tensor import apply
+    import jax.numpy as jnp
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply("cosine_similarity", f, x1, x2)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ..ops._helpers import ensure_tensor
+    from ..core.tensor import apply
+    import jax.numpy as jnp
+    x = ensure_tensor(x)
+    if maxlen is None:
+        import numpy as np
+        maxlen = int(np.asarray(x._data).max())
+
+    def f(lens):
+        r = jnp.arange(maxlen)
+        return (r[None, :] < lens[..., None]).astype(jnp.dtype(dtype))
+
+    return apply("sequence_mask", f, x, differentiable=False)

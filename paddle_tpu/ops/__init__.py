@@ -1,0 +1,20 @@
+"""Op library: importing this package installs the full op surface onto the
+``paddle_tpu`` namespace and the Tensor method table.
+
+Analogue of the reference's kernel registration pass (upstream: the
+PD_REGISTER_KERNEL expansions + generated python bindings): ``OP_REGISTRY``
+maps op name -> callable.
+"""
+
+from ._helpers import OP_REGISTRY, register_op  # noqa: F401
+
+from . import math  # noqa: F401
+from . import reduce  # noqa: F401
+from . import manipulation  # noqa: F401
+from . import creation  # noqa: F401
+from . import indexing  # noqa: F401
+from . import linalg  # noqa: F401
+from . import activation  # noqa: F401
+from . import conv_pool  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import loss_ops  # noqa: F401

@@ -1,0 +1,78 @@
+"""Op-definition helpers.
+
+The analogue of the reference's YAML op codegen (upstream:
+paddle/phi/ops/yaml/ops.yaml + generators): instead of generating C++ from
+YAML, ops here are declared with tiny factories over pure jax functions and
+installed onto both the ``paddle_tpu`` namespace and the ``Tensor`` method
+surface. ``OP_REGISTRY`` is the runtime op registry (KernelFactory parity).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, register_tensor_method, to_tensor
+
+OP_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_op(name: str, fn: Callable, methods=(), inplace_method: Optional[str] = None):
+    """Register a paddle-level op function and optional Tensor methods."""
+    OP_REGISTRY[name] = fn
+    fn.__name__ = name
+    for m in methods:
+        register_tensor_method(m, fn)
+    if inplace_method:
+        def _inplace(self, *args, **kwargs):
+            out = fn(self, *args, **kwargs)
+            return self._rebind(out)
+        _inplace.__name__ = inplace_method
+        register_tensor_method(inplace_method, _inplace)
+    return fn
+
+
+def ensure_tensor(x, ref: Optional[Tensor] = None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(x)
+
+
+def make_unary(name: str, jfn: Callable, methods=(), differentiable: bool = True,
+               inplace: Optional[str] = None):
+    def op(x, name=None):
+        return apply(op.__name__, jfn, ensure_tensor(x), differentiable=differentiable)
+    op.__name__ = name
+    return register_op(name, op, methods=methods or (name,), inplace_method=inplace)
+
+
+def make_binary(name: str, jfn: Callable, methods=(), differentiable: bool = True,
+                inplace: Optional[str] = None):
+    def op(x, y, name=None):
+        x = ensure_tensor(x)
+        if isinstance(y, Tensor):
+            return apply(op.__name__, jfn, x, y, differentiable=differentiable)
+        # python scalar second operand: keep weak typing, close over it
+        return apply(op.__name__, lambda a: jfn(a, y), x, differentiable=differentiable)
+    op.__name__ = name
+    return register_op(name, op, methods=methods or (name,), inplace_method=inplace)
+
+
+def make_reduction(name: str, jfn: Callable, methods=(), bool_out: bool = False):
+    def op(x, axis=None, keepdim=False, dtype=None, name=None):
+        x = ensure_tensor(x)
+        if isinstance(axis, (list, tuple)):
+            axis = tuple(int(a) for a in axis)
+        elif axis is not None and not isinstance(axis, int):
+            axis = int(axis)
+
+        def f(a):
+            r = jfn(a, axis=axis, keepdims=keepdim)
+            if dtype is not None:
+                r = r.astype(jnp.dtype(dtype))
+            return r
+
+        return apply(op.__name__, f, x, differentiable=not bool_out)
+    op.__name__ = name
+    return register_op(name, op, methods=methods or (name,))

@@ -1,0 +1,201 @@
+"""Activation ops (functional layer backs nn.functional).
+
+Parity surface: python/paddle/nn/functional/activation.py + phi activation
+kernels. One jnp/jax.nn call each; XLA fuses them into adjacent matmuls on
+TPU, which is the whole fusion story the reference needs fused kernels for.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, make_unary, register_op
+
+relu = make_unary("relu", jax.nn.relu, inplace="relu_")
+relu6 = make_unary("relu6", jax.nn.relu6)
+silu = make_unary("silu", jax.nn.silu)
+swish = make_unary("swish", jax.nn.silu)
+softsign = make_unary("softsign", jax.nn.soft_sign)
+tanhshrink = make_unary("tanhshrink", lambda x: x - jnp.tanh(x))
+mish = make_unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hardswish = make_unary("hardswish", jax.nn.hard_swish)
+hardsigmoid = make_unary("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+log_sigmoid = make_unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    x = ensure_tensor(x)
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+register_op("gelu", gelu)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        if dtype is not None:
+            a = a.astype(jnp.dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply("softmax", f, x)
+
+
+register_op("softmax", softmax, methods=("softmax",))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        if dtype is not None:
+            a = a.astype(jnp.dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply("log_softmax", f, x)
+
+
+register_op("log_softmax", log_softmax)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = ensure_tensor(x)
+    return apply("softplus",
+                 lambda a: jnp.where(a * beta > threshold, a,
+                                     (1.0 / beta) * jnp.log1p(jnp.exp(beta * a))), x)
+
+
+register_op("softplus", softplus)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = ensure_tensor(x)
+    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+register_op("leaky_relu", leaky_relu)
+
+
+def elu(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+register_op("elu", elu)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    x = ensure_tensor(x)
+    return apply("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+register_op("selu", selu)
+
+
+def celu(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+register_op("celu", celu)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def f(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, w * a)
+
+    return apply("prelu", f, x, weight)
+
+
+register_op("prelu", prelu)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    x = ensure_tensor(x)
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+register_op("hardtanh", hardtanh)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = ensure_tensor(x)
+    return apply("hardshrink",
+                 lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+register_op("hardshrink", hardshrink)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = ensure_tensor(x)
+    return apply("softshrink",
+                 lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold, 0.0)), x)
+
+
+register_op("softshrink", softshrink)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    x = ensure_tensor(x)
+    return apply("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), x)
+
+
+register_op("thresholded_relu", thresholded_relu)
+
+
+def glu(x, axis=-1, name=None):
+    x = ensure_tensor(x)
+    return apply("glu", lambda a: jax.nn.glu(a, axis=axis), x)
+
+
+register_op("glu", glu)
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return apply("maxout", f, x)
+
+
+register_op("maxout", maxout)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..core.random import default_generator
+    x = ensure_tensor(x)
+    key = default_generator.split_key()
+
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx,
+                                        jnp.ones_like(idx, y.dtype), axis=axis,
+                                        inplace=False)
+            # straight-through estimator
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply("gumbel_softmax", f, x)
+
+
+register_op("gumbel_softmax", gumbel_softmax)

@@ -1,0 +1,335 @@
+"""Convolution / pooling / resize ops.
+
+Parity surface: python/paddle/nn/functional/conv.py + pooling.py and phi
+conv/pool kernels (the reference's cuDNN seam, upstream
+paddle/phi/kernels/gpudnn/). TPU-native: ``lax.conv_general_dilated`` maps
+convs straight onto the MXU; pooling is ``lax.reduce_window``. Default layout
+NCHW matches paddle; XLA relayouts internally for the TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, register_op
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, spatial, strides, kernel, dilation):
+    """Resolve paddle padding spec -> lax padding list."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' | 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if len(padding) == spatial:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * spatial:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(spatial)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, spatial, data_format,
+          op_name):
+    x = ensure_tensor(x)
+    weight = ensure_tensor(weight)
+    strides = _pair(stride, spatial)
+    dil = _pair(dilation, spatial)
+    pad = _conv_padding(padding, spatial, strides, None, dil)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + "DHW"[3 - spatial:]
+    else:
+        lhs_spec = "N" + "DHW"[3 - spatial:] + "C"
+    rhs_spec = "OI" + "DHW"[3 - spatial:]
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x._data.shape), tuple(weight._data.shape), (lhs_spec, rhs_spec, out_spec))
+
+    def f(a, w, *maybe_bias):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad, rhs_dilation=dil,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b.size
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return apply(op_name, f, x, weight, ensure_tensor(bias))
+    return apply(op_name, f, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None,
+                     name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    spatial = 2
+    strides = _pair(stride, spatial)
+    dil = _pair(dilation, spatial)
+    pads = _conv_padding(padding, spatial, strides, None, dil)
+    opad = _pair(output_padding, spatial)
+    # paddle weight layout for transpose conv: (in_channels, out_channels/groups, kH, kW)
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x._data.shape),
+        (weight._data.shape[1] * groups, weight._data.shape[0] // groups,
+         weight._data.shape[2], weight._data.shape[3]),
+        ("NCHW", "OIHW", "NCHW"))
+
+    def f(a, w, *maybe_bias):
+        # gradient-of-conv formulation: transpose conv = lhs-dilated conv with
+        # flipped kernel, swapping I/O axes of the weight
+        wt = jnp.swapaxes(w, 0, 1)  # (out/g, in, kH, kW) -> treat as OIHW
+        if groups > 1:
+            ic = w.shape[0]
+            oc_g = w.shape[1]
+            wg = w.reshape(groups, ic // groups, oc_g, *w.shape[2:])
+            wt = jnp.concatenate([jnp.swapaxes(g, 0, 1) for g in wg], axis=0)
+        wt = jnp.flip(wt, axis=(-1, -2))
+        if isinstance(pads, str):
+            pad_cfg = pads
+        else:
+            pad_cfg = [
+                (dil[i] * (w.shape[2 + i] - 1) - pads[i][0],
+                 dil[i] * (w.shape[2 + i] - 1) - pads[i][1] + opad[i])
+                for i in range(spatial)
+            ]
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1, 1), padding=pad_cfg, lhs_dilation=strides,
+            rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups)
+        if maybe_bias:
+            b = maybe_bias[0]
+            out = out + b.reshape(1, -1, 1, 1)
+        return out
+
+    if bias is not None:
+        return apply("conv2d_transpose", f, x, weight, ensure_tensor(bias))
+    return apply("conv2d_transpose", f, x, weight)
+
+
+def _pool(x, op_name, kernel_size, stride, padding, spatial, reducer, init,
+          ceil_mode=False, data_format="NCHW", count_include_pad=True,
+          exclusive=True):
+    x = ensure_tensor(x)
+    k = _pair(kernel_size, spatial)
+    s = _pair(stride if stride is not None else kernel_size, spatial)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding, spatial)
+        pad = [(pp, pp) for pp in p]
+    channel_first = data_format in ("NCHW", "NCL", "NCDHW")
+    if channel_first:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pad_full = [(0, 0), (0, 0)] + (pad if not isinstance(pad, str) else [])
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pad_full = [(0, 0)] + (pad if not isinstance(pad, str) else []) + [(0, 0)]
+    pad_cfg = pad if isinstance(pad, str) else pad_full
+
+    def f(a):
+        if reducer == "max":
+            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window, strides,
+                                         pad_cfg)
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pad_cfg)
+        if isinstance(pad_cfg, str) or not exclusive or all(p == (0, 0) for p in pad_full):
+            denom = float(np.prod(k))
+            if exclusive and not isinstance(pad_cfg, str):
+                return summed / denom
+            counts = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
+                                           window, strides, pad_cfg)
+            return summed / counts
+        if count_include_pad:
+            return summed / float(np.prod(k))
+        counts = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
+                                       window, strides, pad_cfg)
+        return summed / counts
+
+    return apply(op_name, f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "max_pool1d", kernel_size, stride, padding, 1, "max", -jnp.inf,
+                 ceil_mode, data_format)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, "max_pool2d", kernel_size, stride, padding, 2, "max", -jnp.inf,
+                 ceil_mode, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, "max_pool3d", kernel_size, stride, padding, 3, "max", -jnp.inf,
+                 ceil_mode, data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "avg_pool1d", kernel_size, stride, padding, 1, "avg", 0.0,
+                 ceil_mode, data_format, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, "avg_pool2d", kernel_size, stride, padding, 2, "avg", 0.0,
+                 ceil_mode, data_format, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, "avg_pool3d", kernel_size, stride, padding, 3, "avg", 0.0,
+                 ceil_mode, data_format, exclusive=exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    out_hw = _pair(output_size, 2)
+
+    def f(a):
+        h, w = (a.shape[2], a.shape[3]) if data_format == "NCHW" else (a.shape[1], a.shape[2])
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            kh, kw = h // oh, w // ow
+            window = (1, 1, kh, kw) if data_format == "NCHW" else (1, kh, kw, 1)
+            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, window, "VALID")
+            return summed / (kh * kw)
+        # general: mean over interpolated bins via resize-style gather
+        return jax.image.resize(a, a.shape[:2] + (oh, ow) if data_format == "NCHW"
+                                else (a.shape[0], oh, ow, a.shape[3]), method="linear")
+
+    return apply("adaptive_avg_pool2d", f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    x = ensure_tensor(x)
+    o = int(output_size) if not isinstance(output_size, (list, tuple)) else int(output_size[0])
+
+    def f(a):
+        l = a.shape[2]
+        if l % o == 0:
+            k = l // o
+            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k), (1, 1, k),
+                                           "VALID")
+            return summed / k
+        return jax.image.resize(a, a.shape[:2] + (o,), method="linear")
+
+    return apply("adaptive_avg_pool1d", f, x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = ensure_tensor(x)
+    out_hw = _pair(output_size, 2)
+
+    def f(a):
+        h, w = a.shape[2], a.shape[3]
+        oh, ow = out_hw
+        kh, kw = h // oh, w // ow
+        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, kh, kw),
+                                     (1, 1, kh, kw), "VALID")
+
+    return apply("adaptive_max_pool2d", f, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    nd = x._data.ndim
+    spatial = nd - 2
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size._data)]
+        out_sp = tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                       for s in (size if isinstance(size, (list, tuple)) else [size]))
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * spatial
+        in_sp = x._data.shape[2:] if data_format.startswith("NC") else x._data.shape[1:-1]
+        out_sp = tuple(int(d * f) for d, f in zip(in_sp, sf))
+    channel_first = data_format.startswith("NC")
+    if channel_first:
+        out_shape = x._data.shape[:2] + out_sp
+    else:
+        out_shape = (x._data.shape[0],) + out_sp + (x._data.shape[-1],)
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(a):
+        return jax.image.resize(a, out_shape, method=method)
+
+    return apply("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format, name)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = int(upscale_factor)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply("pixel_shuffle", f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+    k = _pair(kernel_sizes, 2)
+    s = _pair(strides, 2)
+    p = _pair(paddings, 2)
+    d = _pair(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: (N, C*kh*kw, oh, ow) -> (N, C*kh*kw, L)
+        return patches.reshape(n, patches.shape[1], -1)
+
+    return apply("unfold", f, x)
+
+
+for _n in ("conv1d", "conv2d", "conv3d", "conv2d_transpose", "max_pool1d",
+           "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
+           "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+           "interpolate", "upsample", "pixel_shuffle", "unfold"):
+    register_op(_n, globals()[_n])

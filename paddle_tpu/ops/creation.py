@@ -1,0 +1,267 @@
+"""Tensor creation ops (deterministic + random).
+
+Parity surface: python/paddle/tensor/creation.py + random.py. Random ops draw
+from the global splittable PRNG (core/random.py) so they are reproducible and
+functionalize under ``to_static``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dtype
+from ..core.random import default_generator
+from ..core.tensor import Tensor, apply, to_tensor
+from ._helpers import ensure_tensor, register_op
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = _dtype.convert_dtype(dtype)
+    if d is None:
+        d = default or _dtype.get_default_dtype()
+    return _dtype.canonicalize(d)
+
+
+def zeros(shape, dtype=None, name=None):
+    return to_tensor(jnp.zeros(_shape_tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return to_tensor(jnp.ones(_shape_tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        arr = jnp.full(_shape_tuple(shape), fill_value)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(_dtype.get_default_dtype())
+        return to_tensor(arr)
+    return to_tensor(jnp.full(_shape_tuple(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return apply("zeros_like", lambda a: jnp.zeros_like(a, dtype=_dtype.canonicalize(dtype)),
+                 x, differentiable=False)
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return apply("ones_like", lambda a: jnp.ones_like(a, dtype=_dtype.canonicalize(dtype)),
+                 x, differentiable=False)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return apply("full_like",
+                 lambda a: jnp.full_like(a, fill_value, dtype=_dtype.canonicalize(dtype)),
+                 x, differentiable=False)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    arr = jnp.arange(start, end, step, dtype=_dtype.canonicalize(dtype))
+    if dtype is None and arr.dtype == jnp.float64:
+        arr = arr.astype(_dtype.get_default_dtype())
+    return to_tensor(arr)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    arr = jnp.linspace(val(start), val(stop), int(val(num)),
+                       dtype=_dt(dtype, _dtype.float32))
+    return to_tensor(arr)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+    arr = jnp.logspace(val(start), val(stop), int(val(num)), base=val(base),
+                       dtype=_dt(dtype, _dtype.float32))
+    return to_tensor(arr)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return to_tensor(jnp.eye(int(num_rows),
+                             int(num_columns) if num_columns is not None else None,
+                             dtype=_dt(dtype)))
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return to_tensor(jnp.stack([r, c]).astype(_dt(dtype, _dtype.int64)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return to_tensor(jnp.stack([r, c]).astype(_dt(dtype, _dtype.int64)))
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x) if not isinstance(x, (np.ndarray, list, tuple, int, float)) else to_tensor(np.asarray(x))
+    out = apply("assign", jnp.copy, x)
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def complex(real, imag, name=None):
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return apply("complex", jax.lax.complex, real, imag)
+
+
+# --- random -----------------------------------------------------------------
+
+def _key():
+    return default_generator.split_key()
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = _dt(dtype)
+    key = _key()
+    arr = jax.random.uniform(key, _shape_tuple(shape), dtype=d,
+                             minval=float(min), maxval=float(max))
+    return Tensor(arr)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    d = _dt(dtype)
+    return Tensor(jax.random.normal(_key(), _shape_tuple(shape), dtype=d))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(np.shape(m), np.shape(s))
+        arr = jax.random.normal(_key(), shp, dtype=_dtype.get_default_dtype())
+        return Tensor(arr * s + m)
+    arr = jax.random.normal(_key(), _shape_tuple(shape), dtype=_dtype.get_default_dtype())
+    return Tensor(arr * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    arr = jax.random.randint(_key(), _shape_tuple(shape), int(low), int(high),
+                             dtype=_dt(dtype, _dtype.int64))
+    return Tensor(arr)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, tuple(x._data.shape), dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    arr = jax.random.permutation(_key(), int(n)).astype(_dt(dtype, _dtype.int64))
+    return Tensor(arr)
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    key = _key()
+    return apply("bernoulli",
+                 lambda p: jax.random.bernoulli(key, p).astype(p.dtype), x,
+                 differentiable=False)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = _key()
+
+    def f(p):
+        logits = jnp.log(jnp.clip(p, 1e-30, None))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1,
+                                          shape=p.shape[:-1] + (num_samples,))
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+
+    return apply("multinomial", f, x, differentiable=False)
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    key = _key()
+    return apply("poisson", lambda lam: jax.random.poisson(key, lam).astype(lam.dtype),
+                 x, differentiable=False)
+
+
+def rand_like(x, name=None):
+    x = ensure_tensor(x)
+    return rand(tuple(x._data.shape), x.dtype)
+
+
+def randn_like(x, name=None):
+    x = ensure_tensor(x)
+    return standard_normal(tuple(x._data.shape), x.dtype)
+
+
+def normal_(tensor, mean=0.0, std=1.0):
+    arr = jax.random.normal(_key(), tuple(tensor._data.shape),
+                            dtype=tensor._data.dtype) * std + mean
+    tensor._set_data(arr)
+    return tensor
+
+
+def uniform_(tensor, min=-1.0, max=1.0, seed=0, name=None):
+    arr = jax.random.uniform(_key(), tuple(tensor._data.shape),
+                             dtype=tensor._data.dtype, minval=min, maxval=max)
+    tensor._set_data(arr)
+    return tensor
+
+
+for _name in ("zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+              "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+              "tril_indices", "triu_indices", "clone", "assign", "complex",
+              "rand", "uniform", "randn", "standard_normal", "normal", "randint",
+              "randint_like", "randperm", "bernoulli", "multinomial", "poisson",
+              "rand_like", "randn_like"):
+    register_op(_name, globals()[_name])
+
+from ..core.tensor import register_tensor_method
+register_tensor_method("normal_", normal_)
+register_tensor_method("uniform_", uniform_)
+register_tensor_method("zero_", lambda self: (self._set_data(jnp.zeros_like(self._data)), self)[1])
+register_tensor_method("fill_", lambda self, v: (self._set_data(jnp.full_like(self._data, v)), self)[1])

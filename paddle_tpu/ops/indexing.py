@@ -1,0 +1,474 @@
+"""Indexing / gather / scatter / search ops + Tensor.__getitem__/__setitem__.
+
+Parity surface: python/paddle/tensor/manipulation.py + search.py and the phi
+gather/scatter kernel family. Static-shape ops lower to XLA gather/scatter;
+ops with data-dependent output shapes (masked_select, nonzero, unique) run
+eagerly only and raise under ``to_static`` tracing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, register_tensor_method, _is_tracer
+from ._helpers import ensure_tensor, register_op
+from ..core.dtype import canonicalize as _canon
+_i64 = _canon("int64")
+
+_py_slice = slice
+
+
+def _reject_dynamic(op_name, *tensors):
+    if any(_is_tracer(t._data) for t in tensors):
+        raise RuntimeError(
+            f"{op_name} has a data-dependent output shape and cannot run under "
+            "paddle.jit.to_static / XLA tracing; run it eagerly or use a "
+            "masked/padded formulation")
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    return apply("gather", lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), x, index)
+
+
+register_op("gather", gather, methods=("gather",))
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return apply("gather_nd", f, x, index)
+
+
+register_op("gather_nd", gather_nd, methods=("gather_nd",))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def f(a, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # paddle overwrite=False: zero the rows then accumulate
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply("scatter", f, x, index, updates)
+
+
+register_op("scatter", scatter, methods=("scatter",), inplace_method="scatter_")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def f(a, i, u):
+        i = i.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply("scatter_nd_add", f, x, index, updates)
+
+
+register_op("scatter_nd_add", scatter_nd_add, methods=("scatter_nd_add",))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    shape = tuple(int(s) for s in shape)
+
+    def f(i, u):
+        z = jnp.zeros(shape, u.dtype)
+        return z.at[tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))].add(u)
+
+    return apply("scatter_nd", f, index, updates)
+
+
+register_op("scatter_nd", scatter_nd)
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply("index_select",
+                 lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), x, index)
+
+
+register_op("index_select", index_select, methods=("index_select",))
+
+
+def index_sample(x, index):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return apply("index_sample",
+                 lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1), x, index)
+
+
+register_op("index_sample", index_sample, methods=("index_sample",))
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        moved = jnp.moveaxis(a, axis, 0)
+        vmoved = jnp.moveaxis(v, axis, 0)
+        out = moved.at[i].add(vmoved)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("index_add", f, x, index, value)
+
+
+register_op("index_add", index_add, methods=("index_add",), inplace_method="index_add_")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+    idx_tensors = [ensure_tensor(i) for i in indices]
+
+    def f(a, v, *idx):
+        idx = tuple(i if i.dtype == jnp.bool_ else i.astype(jnp.int32) for i in idx)
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+
+    return apply("index_put", f, x, value, *idx_tensors)
+
+
+register_op("index_put", index_put, methods=("index_put",), inplace_method="index_put_")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    return apply("take_along_axis",
+                 lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+                 arr, indices)
+
+
+register_op("take_along_axis", take_along_axis, methods=("take_along_axis",))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    arr, indices = ensure_tensor(arr), ensure_tensor(indices)
+    values = ensure_tensor(values)
+
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        out = jnp.moveaxis(a, axis, -1)
+        idx = jnp.moveaxis(i, axis, -1)
+        val = jnp.moveaxis(v, axis, -1)
+        if reduce in ("add", "sum"):
+            return jnp.moveaxis(_scatter_last(out, idx, val, "add"), -1, axis)
+        if reduce in ("mul", "multiply"):
+            return jnp.moveaxis(_scatter_last(out, idx, val, "mul"), -1, axis)
+        raise ValueError(f"unsupported reduce {reduce}")
+
+    return apply("put_along_axis", f, arr, indices, values)
+
+
+def _scatter_last(out, idx, val, mode):
+    """scatter along last axis with batch dims via vmap."""
+    def one(o, i, v):
+        return o.at[i].add(v) if mode == "add" else o.at[i].multiply(v)
+    flat_o = out.reshape(-1, out.shape[-1])
+    flat_i = idx.reshape(-1, idx.shape[-1])
+    flat_v = val.reshape(-1, val.shape[-1])
+    res = jax.vmap(one)(flat_o, flat_i, flat_v)
+    return res.reshape(out.shape)
+
+
+register_op("put_along_axis", put_along_axis, methods=("put_along_axis",))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+register_op("where", where, methods=("where",))
+
+
+def masked_select(x, mask, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    _reject_dynamic("masked_select", x, mask)
+    return Tensor(x._data[np.asarray(mask._data)], stop_gradient=x.stop_gradient)
+
+
+register_op("masked_select", masked_select, methods=("masked_select",))
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    if isinstance(value, Tensor):
+        return apply("masked_fill", lambda a, m, v: jnp.where(m, v.astype(a.dtype), a),
+                     x, mask, value)
+    return apply("masked_fill", lambda a, m: jnp.where(m, jnp.asarray(value, a.dtype), a),
+                 x, mask)
+
+
+register_op("masked_fill", masked_fill, methods=("masked_fill",), inplace_method="masked_fill_")
+
+
+def nonzero(x, as_tuple=False):
+    x = ensure_tensor(x)
+    _reject_dynamic("nonzero", x)
+    idx = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=-1).astype(np.int32)))
+
+
+register_op("nonzero", nonzero, methods=("nonzero",))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    _reject_dynamic("unique", x)
+    res = np.unique(np.asarray(x._data), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+register_op("unique", unique, methods=("unique",))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    x = ensure_tensor(x)
+    _reject_dynamic("unique_consecutive", x)
+    a = np.asarray(x._data)
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+        out = a[keep]
+    else:
+        raise NotImplementedError("unique_consecutive with axis")
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int32))))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        cnt = np.diff(np.concatenate([idx, [len(a)]]))
+        outs.append(Tensor(jnp.asarray(cnt.astype(np.int32))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+register_op("unique_consecutive", unique_consecutive)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        r = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(r, axis=axis) if descending else r
+
+    return apply("sort", f, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        r = jnp.argsort(a, axis=axis, stable=stable)
+        return (jnp.flip(r, axis=axis) if descending else r).astype(_i64)
+
+    return apply("argsort", f, x, differentiable=False)
+
+
+register_op("sort", sort, methods=("sort",))
+register_op("argsort", argsort, methods=("argsort",))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k._data)
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis).astype(_i64))
+
+    return apply("topk", f, x)
+
+
+register_op("topk", topk, methods=("topk",))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        s = jnp.sort(moved, axis=-1)
+        si = jnp.argsort(moved, axis=-1)
+        v = s[..., k - 1]
+        i = si[..., k - 1].astype(_i64)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            i = jnp.expand_dims(i, axis)
+        return v, i
+
+    return apply("kthvalue", f, x)
+
+
+register_op("kthvalue", kthvalue, methods=("kthvalue",))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        moved = jnp.moveaxis(a, axis, -1)
+        sorted_a = jnp.sort(moved, axis=-1)
+        n = sorted_a.shape[-1]
+        same = sorted_a[..., 1:] == sorted_a[..., :-1]
+        run = jnp.concatenate([jnp.zeros_like(same[..., :1]), same], axis=-1)
+        # run length ending at each position
+        def scan_fn(carry, x_t):
+            c = jnp.where(x_t, carry + 1, 0)
+            return c, c
+        _, runlens = jax.lax.scan(scan_fn, jnp.zeros(moved.shape[:-1], jnp.int32),
+                                  jnp.moveaxis(run, -1, 0))
+        runlens = jnp.moveaxis(runlens, 0, -1)
+        best = jnp.argmax(runlens, axis=-1)
+        vals = jnp.take_along_axis(sorted_a, best[..., None], axis=-1)[..., 0]
+        # index of first occurrence in original array
+        eq = moved == vals[..., None]
+        idx = jnp.argmax(eq, axis=-1).astype(_i64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+
+    return apply("mode", f, x)
+
+
+register_op("mode", mode, methods=("mode",))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    sorted_sequence, values = ensure_tensor(sorted_sequence), ensure_tensor(values)
+
+    def f(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            r = jnp.searchsorted(s, v, side=side)
+        else:
+            flat_s = s.reshape(-1, s.shape[-1])
+            flat_v = v.reshape(-1, v.shape[-1])
+            r = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(flat_s, flat_v)
+            r = r.reshape(v.shape)
+        return r.astype(jnp.int32 if out_int32 else _i64)
+
+    return apply("searchsorted", f, sorted_sequence, values, differentiable=False)
+
+
+register_op("searchsorted", searchsorted)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+register_op("bucketize", bucketize, methods=("bucketize",))
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return apply("one_hot",
+                 lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes,
+                                          dtype=jnp.float32), x, differentiable=False)
+
+
+register_op("one_hot", one_hot)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    _reject_dynamic("bincount", x)
+    n = max(int(np.asarray(x._data).max(initial=-1)) + 1, minlength)
+    if weights is not None:
+        weights = ensure_tensor(weights)
+        return apply("bincount",
+                     lambda a, w: jnp.bincount(a.astype(jnp.int32), weights=w, length=n),
+                     x, weights)
+    return apply("bincount",
+                 lambda a: jnp.bincount(a.astype(jnp.int32), length=n).astype(_i64),
+                 x, differentiable=False)
+
+
+register_op("bincount", bincount, methods=("bincount",))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = ensure_tensor(input)
+    lo, hi = float(min), float(max)
+
+    def f(a):
+        l, h = (a.min(), a.max()) if lo == 0 and hi == 0 else (lo, hi)
+        hist, _ = jnp.histogram(a, bins=bins, range=(l, h))
+        return hist.astype(_i64)
+
+    return apply("histogram", f, input, differentiable=False)
+
+
+register_op("histogram", histogram, methods=("histogram",))
+
+
+# --- Tensor indexing ---------------------------------------------------------
+
+def _convert_index(item):
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, (list, np.ndarray)):
+        return jnp.asarray(np.asarray(item))
+    if isinstance(item, tuple):
+        return tuple(_convert_index(i) for i in item)
+    return item
+
+
+def _getitem(self, item):
+    idx = _convert_index(item)
+    # dynamic boolean mask on concrete data -> eager numpy path
+    return apply("getitem", lambda a: a[idx], self)
+
+
+def _setitem(self, item, value):
+    idx = _convert_index(item)
+    if isinstance(value, Tensor):
+        out = apply("setitem", lambda a, v: a.at[idx].set(v.astype(a.dtype)), self, value)
+    else:
+        out = apply("setitem",
+                    lambda a: a.at[idx].set(jnp.asarray(value).astype(a.dtype)), self)
+    self._rebind(out)
+
+
+register_tensor_method("__getitem__", _getitem)
+register_tensor_method("__setitem__", _setitem)

@@ -1,0 +1,298 @@
+"""Matmul / linear algebra ops.
+
+Parity surface: python/paddle/tensor/linalg.py + paddle/phi/kernels matmul
+family. Matmuls are THE MXU ops: they stay large and batched; precision is
+controlled by FLAGS_tpu_matmul_precision (default lets XLA pick bf16-on-MXU
+with fp32 accumulation).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags as _flags
+from ..core.tensor import Tensor, apply, register_tensor_method
+from ._helpers import ensure_tensor, register_op
+
+
+def _precision():
+    p = _flags.flag("tpu_matmul_precision")
+    return None if p == "default" else p
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_precision())
+
+    return apply("matmul", f, x, y)
+
+
+register_op("matmul", matmul, methods=("matmul", "mm", "__matmul__"))
+register_op("mm", matmul)
+
+
+def _rmatmul(self, other):
+    return matmul(ensure_tensor(other), self)
+
+
+register_tensor_method("__rmatmul__", _rmatmul)
+
+
+def bmm(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("bmm", lambda a, b: jnp.matmul(a, b, precision=_precision()), x, y)
+
+
+register_op("bmm", bmm, methods=("bmm",))
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+register_op("dot", dot, methods=("dot",))
+
+
+def inner(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("inner", lambda a, b: jnp.inner(a, b), x, y)
+
+
+def outer(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+register_op("inner", inner, methods=("inner",))
+register_op("outer", outer, methods=("outer",))
+
+
+def einsum(equation, *operands):
+    tensors = [ensure_tensor(t) for t in operands]
+    return apply("einsum",
+                 lambda *arrs: jnp.einsum(equation, *arrs, precision=_precision()),
+                 *tensors)
+
+
+register_op("einsum", einsum)
+
+
+def kron(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("kron", jnp.kron, x, y)
+
+
+register_op("kron", kron, methods=("kron",))
+
+
+def mv(x, vec, name=None):
+    x, vec = ensure_tensor(x), ensure_tensor(vec)
+    return apply("mv", lambda a, v: a @ v, x, vec)
+
+
+register_op("mv", mv, methods=("mv",))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return apply("addmm",
+                 lambda i, a, b: beta * i + alpha * jnp.matmul(a, b, precision=_precision()),
+                 input, x, y)
+
+
+register_op("addmm", addmm, methods=("addmm",))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def f(a):
+        if p == "fro":
+            if ax is None:
+                return jnp.sqrt(jnp.sum(a * a))
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p in ("inf", float("inf")):
+            r = jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+            return r
+        if p in ("-inf", float("-inf")):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply("norm", f, x)
+
+
+register_op("norm", norm, methods=("norm",))
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        d = jnp.abs(a - b)
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        return jnp.sum(d ** p) ** (1.0 / p)
+
+    return apply("dist", f, x, y)
+
+
+register_op("dist", dist, methods=("dist",))
+
+
+# linalg submodule-style ops
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, ensure_tensor(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian),
+                 ensure_tensor(x))
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, ensure_tensor(x))
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    out = apply("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), x)
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    return tuple(apply("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x))
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    return tuple(apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return tuple(apply("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x))
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    return tuple(apply("eig", lambda a: tuple(jnp.linalg.eig(a)), x))
+
+
+def eigvals(x, name=None):
+    return apply("eigvals", jnp.linalg.eigvals, ensure_tensor(x))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), ensure_tensor(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply("cholesky", f, ensure_tensor(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(b, l):
+        if upper:
+            l = jnp.swapaxes(l, -1, -2)
+        z = jax.scipy.linalg.solve_triangular(l, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(l, -1, -2), z, lower=False)
+
+    return apply("cholesky_solve", f, x, y)
+
+
+def solve(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+
+    return apply("triangular_solve", f, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    out = apply("lstsq", lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)), x, y)
+    return tuple(out)
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), ensure_tensor(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply("matrix_rank",
+                 lambda a: jnp.linalg.matrix_rank(a, rtol=tol),
+                 ensure_tensor(x), differentiable=False)
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda a: jnp.linalg.cond(a, p=p), ensure_tensor(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = ensure_tensor(x)
+    return apply("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+                 x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), ensure_tensor(x))
+
+
+def multi_dot(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return apply("multi_dot", lambda *arrs: jnp.linalg.multi_dot(arrs), *tensors)
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis if axis != 9 else (-1 if x._data.shape[-1] == 3 else
+                                 next(i for i, s in enumerate(x._data.shape) if s == 3))
+    return apply("cross", lambda a, b: jnp.cross(a, b, axis=ax), x, y)
+
+
+def householder_product(x, tau, name=None):
+    x, tau = ensure_tensor(x), ensure_tensor(tau)
+
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1:, i]])
+            q = q - t[i] * (q @ v[:, None]) @ v[None, :]
+        return q
+
+    return apply("householder_product", f, x, tau)
+
+
+for _n in ("inv", "pinv", "det", "slogdet", "svd", "qr", "eigh", "eig", "eigvals",
+           "eigvalsh", "cholesky", "cholesky_solve", "solve", "triangular_solve",
+           "lstsq", "matrix_power", "matrix_rank", "cond", "cov", "corrcoef",
+           "multi_dot", "cross", "householder_product"):
+    register_op(_n, globals()[_n])

@@ -1,0 +1,305 @@
+"""Loss ops.
+
+Parity surface: python/paddle/nn/functional/loss.py + phi cross_entropy
+kernels. ``cross_entropy`` keeps paddle semantics: hard labels (int) or soft
+labels, optional label_smoothing, ignore_index, weight, reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, register_op
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(logits, lab, *maybe_w):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) \
+            if use_softmax else jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            target = lab.astype(jnp.float32)
+        else:
+            li = lab.astype(jnp.int32)
+            if li.ndim == logp.ndim and li.shape[axis] == 1:
+                li = jnp.squeeze(li, axis=axis)
+            target = jax.nn.one_hot(li, n_classes, axis=axis, dtype=jnp.float32)
+        if label_smoothing > 0.0:
+            target = target * (1.0 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(target * logp, axis=axis)
+        if maybe_w:
+            w = maybe_w[0].astype(jnp.float32)
+            if soft_label:
+                cw = jnp.sum(target * w.reshape((1,) * (target.ndim - 1) + (-1,)), axis=axis)
+            else:
+                li = lab.astype(jnp.int32)
+                if li.ndim == loss.ndim + 1:
+                    li = jnp.squeeze(li, axis=axis)
+                cw = jnp.take(w, li)
+            loss = loss * cw
+        if not soft_label and ignore_index >= 0:
+            li = lab.astype(jnp.int32)
+            if li.ndim == loss.ndim + 1:
+                li = jnp.squeeze(li, axis=axis)
+            valid = (li != ignore_index)
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return apply("cross_entropy", f, *args)
+
+
+register_op("cross_entropy", cross_entropy)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    # paddle returns loss with a trailing singleton dim on hard labels
+    from .manipulation import unsqueeze
+    loss = unsqueeze(loss, axis if axis != -1 else -1)
+    if return_softmax:
+        from .activation import softmax as softmax_op
+        return loss, softmax_op(logits, axis=axis)
+    return loss
+
+
+register_op("softmax_with_cross_entropy", softmax_with_cross_entropy)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(logp, lab, *maybe_w):
+        li = lab.astype(jnp.int32)
+        picked = -jnp.take_along_axis(logp, li[..., None] if logp.ndim == li.ndim + 1
+                                      else li[:, None], axis=-1)[..., 0]
+        if maybe_w:
+            picked = picked * jnp.take(maybe_w[0], li)
+        if ignore_index >= 0:
+            valid = li != ignore_index
+            picked = jnp.where(valid, picked, 0.0)
+            if reduction == "mean":
+                return jnp.sum(picked) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(picked, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return apply("nll_loss", f, *args)
+
+
+register_op("nll_loss", nll_loss)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply("mse_loss", lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply("l1_loss", lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return apply("smooth_l1_loss", f, input, label)
+
+
+register_op("mse_loss", mse_loss)
+register_op("l1_loss", l1_loss)
+register_op("smooth_l1_loss", smooth_l1_loss)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(p, y, *maybe_w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p))
+        if maybe_w:
+            loss = loss * maybe_w[0]
+        return _reduce(loss, reduction)
+
+    args = [input, label]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return apply("binary_cross_entropy", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+
+    def f(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable bce-with-logits
+        neg_abs = -jnp.abs(z)
+        if pw is not None:
+            log_w = (pw - 1.0) * y + 1.0
+            loss = (1.0 - y) * z + log_w * (jnp.log1p(jnp.exp(neg_abs)) + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if pos_weight is not None:
+        args.append(ensure_tensor(pos_weight))
+    return apply("binary_cross_entropy_with_logits", f, *args)
+
+
+register_op("binary_cross_entropy", binary_cross_entropy)
+register_op("binary_cross_entropy_with_logits", binary_cross_entropy_with_logits)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = y * (jnp.log(jnp.clip(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply("kl_div", f, input, label)
+
+
+register_op("kl_div", kl_div)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(a, y):
+        loss = jnp.where(y == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return apply("hinge_embedding_loss", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    input, other, label = ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)
+
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return apply("margin_ranking_loss", f, input, other, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    input1, input2, label = ensure_tensor(input1), ensure_tensor(input2), ensure_tensor(label)
+
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(y == 1, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply("cosine_embedding_loss", f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    input, positive, negative = (ensure_tensor(input), ensure_tensor(positive),
+                                 ensure_tensor(negative))
+
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply("triplet_margin_loss", f, input, positive, negative)
+
+
+register_op("hinge_embedding_loss", hinge_embedding_loss)
+register_op("margin_ranking_loss", margin_ranking_loss)
+register_op("cosine_embedding_loss", cosine_embedding_loss)
+register_op("triplet_margin_loss", triplet_margin_loss)
+
+
+def square_error_cost(input, label):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+register_op("square_error_cost", square_error_cost)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply("log_loss",
+                 lambda p, y: -y * jnp.log(p + epsilon) - (1.0 - y) * jnp.log(1.0 - p + epsilon),
+                 input, label)
+
+
+register_op("log_loss", log_loss)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+
+    def f(z, y, *maybe_n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1.0 - p) * (1.0 - y)
+        a_t = alpha * y + (1.0 - alpha) * (1.0 - y)
+        loss = a_t * jnp.power(1.0 - p_t, gamma) * ce
+        if maybe_n:
+            loss = loss / maybe_n[0]
+        return _reduce(loss, reduction)
+
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(ensure_tensor(normalizer))
+    return apply("sigmoid_focal_loss", f, *args)
+
+
+register_op("sigmoid_focal_loss", sigmoid_focal_loss)

@@ -1,0 +1,437 @@
+"""Shape / layout manipulation ops.
+
+Parity surface: python/paddle/tensor/manipulation.py and the corresponding
+phi kernels. All static-shape (XLA requirement); ops whose output shape is
+data-dependent (masked_select, nonzero, unique) execute eagerly and are
+rejected under tracing with a clear error.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, register_tensor_method, _is_tracer
+from ._helpers import ensure_tensor, register_op
+
+# capture builtins before any same-named ops shadow them in this module
+_py_sum, _py_max, _py_min, _py_abs, _py_slice = sum, max, min, abs, slice
+
+
+def _norm_shape(shape, x_shape):
+    """Paddle reshape semantics: -1 infers, 0 copies the input dim."""
+    shape = [int(s._data) if isinstance(s, Tensor) else int(s) for s in shape]
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(x_shape[i])
+        else:
+            out.append(s)
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    shape = _norm_shape(shape, x._data.shape)
+    return apply("reshape", lambda a: jnp.reshape(a, shape), x)
+
+
+register_op("reshape", reshape, methods=("reshape", "view"), inplace_method="reshape_")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x._data.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = x._data.shape[:s] + (-1,) + x._data.shape[e + 1:]
+    return apply("flatten", lambda a: jnp.reshape(a, new_shape), x)
+
+
+register_op("flatten", flatten, methods=("flatten",), inplace_method="flatten_")
+
+
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    perm = tuple(int(p) for p in perm)
+    return apply("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+register_op("transpose", transpose, methods=("transpose",))
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x._data.ndim > 2:
+        raise ValueError("paddle.t only supports tensors with ndim <= 2")
+    return apply("t", lambda a: a.T, x)
+
+
+register_op("t", t, methods=("t",))
+register_tensor_method("T", property(lambda self: apply("T", lambda a: a.T, self)))
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % x._data.ndim for a in axes if x._data.shape[a % x._data.ndim] == 1)
+    return apply("squeeze", lambda a: jnp.squeeze(a, axis=ax), x)
+
+
+register_op("squeeze", squeeze, methods=("squeeze",), inplace_method="squeeze_")
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a._data) if isinstance(a, Tensor) else int(a) for a in axes)
+
+    def f(a):
+        for ax in sorted(ax0 if ax0 >= 0 else ax0 + a.ndim + 1 for ax0 in axes):
+            a = jnp.expand_dims(a, ax)
+        return a
+
+    return apply("unsqueeze", f, x)
+
+
+register_op("unsqueeze", unsqueeze, methods=("unsqueeze",), inplace_method="unsqueeze_")
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    return apply("concat", lambda *arrs: jnp.concatenate(arrs, axis=axis), *tensors)
+
+
+register_op("concat", concat)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return apply("stack", lambda *arrs: jnp.stack(arrs, axis=axis), *tensors)
+
+
+register_op("stack", stack)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis._data)
+    dim = x._data.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        n_unknown = _py_sum(1 for s in sections if s == -1)
+        if n_unknown:
+            known = _py_sum(s for s in sections if s != -1)
+            sections = [dim - known if s == -1 else s for s in sections]
+    offsets = np.cumsum([0] + sections[:-1]).tolist()
+
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=axis)
+                     for o, s in zip(offsets, sections))
+
+    return list(apply("split", f, x))
+
+
+register_op("split", split, methods=("split",))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    x = ensure_tensor(x)
+    return split(x, chunks, axis=axis)
+
+
+register_op("chunk", chunk, methods=("chunk",))
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x._data.shape[axis]
+
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+
+    return list(apply("unbind", f, x))
+
+
+register_op("unbind", unbind, methods=("unbind",))
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    reps = tuple(int(r._data) if isinstance(r, Tensor) else int(r) for r in repeat_times) \
+        if isinstance(repeat_times, (list, tuple)) else (int(repeat_times),)
+    return apply("tile", lambda a: jnp.tile(a, reps), x)
+
+
+register_op("tile", tile, methods=("tile",))
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    shape = _norm_shape(shape, x._data.shape)
+    # paddle expand: -1 means keep input dim
+    nd_in = x._data.ndim
+    full = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(x._data.shape[i - (len(shape) - nd_in)])
+        else:
+            full.append(s)
+    return apply("expand", lambda a: jnp.broadcast_to(a, tuple(full)), x)
+
+
+register_op("expand", expand, methods=("expand",))
+
+
+def expand_as(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+register_op("expand_as", expand_as, methods=("expand_as",))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape, name=name)
+
+
+register_op("broadcast_to", broadcast_to, methods=("broadcast_to",))
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [ensure_tensor(t) for t in inputs]
+    return list(apply("broadcast_tensors", lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)),
+                      *tensors))
+
+
+register_op("broadcast_tensors", broadcast_tensors)
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else shifts
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("roll", lambda a: jnp.roll(a, sh, axis=ax), x)
+
+
+register_op("roll", roll, methods=("roll",))
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply("flip", lambda a: jnp.flip(a, axis=ax), x)
+
+
+register_op("flip", flip, methods=("flip",))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = ensure_tensor(x)
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+register_op("rot90", rot90, methods=("rot90",))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        total = int(jnp.sum(repeats._data)) if not _is_tracer(repeats._data) else None
+        return apply("repeat_interleave",
+                     lambda a, r: jnp.repeat(a, r, axis=axis, total_repeat_length=total),
+                     x, repeats)
+    return apply("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+register_op("repeat_interleave", repeat_interleave, methods=("repeat_interleave",))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in np.asarray(pad._data)]
+    pad = [int(p) for p in pad]
+    nd = x._data.ndim
+    if len(pad) == 2 * nd:
+        # full-rank form: [d0_before, d0_after, d1_before, ...]
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # spatial form, innermost-dim-first: NCHW pad=[left,right,top,bottom]
+        n_spatial = len(pad) // 2
+        spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        spatial = list(reversed(spatial))  # -> outermost spatial dim first
+        if data_format.endswith("C") and nd >= 3:  # NHWC/NLC/NDHWC
+            pairs = [(0, 0)] * (nd - n_spatial - 1) + spatial + [(0, 0)]
+        else:  # NCHW/NCL/NCDHW
+            pairs = [(0, 0)] * (nd - n_spatial) + spatial
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode="constant", constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+
+    return apply("pad", f, x)
+
+
+register_op("pad", pad)
+
+
+def tril(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return apply("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+register_op("tril", tril, methods=("tril",))
+register_op("triu", triu, methods=("triu",))
+
+
+def diag(x, offset=0, padding_value=0.0, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        if a.ndim == 1:
+            d = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+                d = jnp.where(mask, d, padding_value)
+            return d
+        return jnp.diag(a, k=offset)
+
+    return apply("diag", f, x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return apply("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                                    axis2=axis2), x)
+
+
+register_op("diag", diag, methods=("diag",))
+register_op("diagonal", diagonal, methods=("diagonal",))
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        n = a.shape[-1] + _py_abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + _py_max(-offset, 0)
+        c = idx + _py_max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+
+    return apply("diag_embed", f, x)
+
+
+register_op("diag_embed", diag_embed)
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    tensors = [ensure_tensor(t) for t in args]
+    return list(apply("meshgrid", lambda *arrs: tuple(jnp.meshgrid(*arrs, indexing="ij")),
+                      *tensors))
+
+
+register_op("meshgrid", meshgrid)
+
+
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    x = ensure_tensor(x)
+    d = convert_dtype(dtype)
+    return apply("cast", lambda a: a.astype(d), x, amp=False)
+
+
+register_op("cast", cast, methods=("cast", "astype"), inplace_method="cast_")
+
+
+def slice(input, axes, starts, ends):
+    input = ensure_tensor(input)
+    axes = [int(a) for a in axes]
+    starts = [int(s._data) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e._data) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def f(a):
+        idx = [_py_slice(None)] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            dim = a.shape[ax]
+            st2 = _py_max(st + dim, 0) if st < 0 else _py_min(st, dim)
+            en2 = _py_max(en + dim, 0) if en < 0 else _py_min(en, dim)
+            idx[ax] = _py_slice(st2, en2)
+        return a[tuple(idx)]
+
+    return apply("slice", f, input)
+
+
+register_op("slice", slice)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    axes = [int(a) for a in axes]
+    starts = [int(s) for s in starts]
+    ends = [int(e) for e in ends]
+    strides = [int(s) for s in strides]
+
+    def f(a):
+        idx = [_py_slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = _py_slice(st, en, sd)
+        return a[tuple(idx)]
+
+    return apply("strided_slice", f, x)
+
+
+register_op("strided_slice", strided_slice)
+
+
+def moveaxis(x, source, destination, name=None):
+    x = ensure_tensor(x)
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    x = ensure_tensor(x)
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, axis1, axis2), x)
+
+
+register_op("moveaxis", moveaxis, methods=("moveaxis",))
+register_op("swapaxes", swapaxes, methods=("swapaxes",))
+
+
+def as_real(x, name=None):
+    x = ensure_tensor(x)
+    return apply("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def as_complex(x, name=None):
+    x = ensure_tensor(x)
+    return apply("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+register_op("as_real", as_real, methods=("as_real",))
+register_op("as_complex", as_complex, methods=("as_complex",))

@@ -1,0 +1,247 @@
+"""Elementwise math ops (unary, binary, logic, bitwise) + Tensor operators.
+
+Parity surface: upstream paddle/phi/kernels/{cpu,gpu}/ elementwise & unary
+kernels and python/paddle/tensor/math.py. Each op is one jnp call dispatched
+through ``apply`` so autograd/AMP/tracing come for free; XLA fuses chains of
+these into single kernels on TPU (the reference needs CINN for that).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, register_tensor_method, to_tensor
+from ._helpers import ensure_tensor, make_binary, make_unary, register_op
+
+# --- unary ------------------------------------------------------------------
+abs = make_unary("abs", jnp.abs, methods=("abs", "__abs__"))
+acos = make_unary("acos", jnp.arccos)
+acosh = make_unary("acosh", jnp.arccosh)
+asin = make_unary("asin", jnp.arcsin)
+asinh = make_unary("asinh", jnp.arcsinh)
+atan = make_unary("atan", jnp.arctan)
+atanh = make_unary("atanh", jnp.arctanh)
+ceil = make_unary("ceil", jnp.ceil, inplace="ceil_")
+cos = make_unary("cos", jnp.cos)
+cosh = make_unary("cosh", jnp.cosh)
+deg2rad = make_unary("deg2rad", jnp.deg2rad)
+rad2deg = make_unary("rad2deg", jnp.rad2deg)
+digamma = make_unary("digamma", jax.scipy.special.digamma)
+erf = make_unary("erf", jax.scipy.special.erf)
+erfinv = make_unary("erfinv", jax.scipy.special.erfinv, inplace="erfinv_")
+exp = make_unary("exp", jnp.exp, inplace="exp_")
+expm1 = make_unary("expm1", jnp.expm1)
+floor = make_unary("floor", jnp.floor, inplace="floor_")
+frac = make_unary("frac", lambda x: x - jnp.trunc(x))
+i0 = make_unary("i0", jax.scipy.special.i0)
+i1 = make_unary("i1", jax.scipy.special.i1)
+lgamma = make_unary("lgamma", jax.scipy.special.gammaln)
+log = make_unary("log", jnp.log)
+log10 = make_unary("log10", jnp.log10)
+log1p = make_unary("log1p", jnp.log1p)
+log2 = make_unary("log2", jnp.log2)
+neg = make_unary("neg", jnp.negative, methods=("neg", "__neg__"))
+reciprocal = make_unary("reciprocal", jnp.reciprocal, inplace="reciprocal_")
+round = make_unary("round", jnp.round, inplace="round_")
+rsqrt = make_unary("rsqrt", jax.lax.rsqrt, inplace="rsqrt_")
+sigmoid = make_unary("sigmoid", jax.nn.sigmoid)
+sign = make_unary("sign", jnp.sign)
+sgn = make_unary("sgn", jnp.sign)
+sin = make_unary("sin", jnp.sin)
+sinh = make_unary("sinh", jnp.sinh)
+sqrt = make_unary("sqrt", jnp.sqrt, inplace="sqrt_")
+square = make_unary("square", jnp.square)
+tan = make_unary("tan", jnp.tan)
+tanh = make_unary("tanh", jnp.tanh, inplace="tanh_")
+trunc = make_unary("trunc", jnp.trunc)
+angle = make_unary("angle", jnp.angle)
+conj = make_unary("conj", jnp.conj)
+real = make_unary("real", jnp.real)
+imag = make_unary("imag", jnp.imag)
+
+isnan = make_unary("isnan", jnp.isnan, differentiable=False)
+isinf = make_unary("isinf", jnp.isinf, differentiable=False)
+isfinite = make_unary("isfinite", jnp.isfinite, differentiable=False)
+logical_not = make_unary("logical_not", jnp.logical_not, differentiable=False)
+bitwise_not = make_unary("bitwise_not", jnp.bitwise_not, differentiable=False)
+
+# --- binary -----------------------------------------------------------------
+add = make_binary("add", jnp.add, inplace="add_")
+subtract = make_binary("subtract", jnp.subtract, inplace="subtract_")
+multiply = make_binary("multiply", jnp.multiply, inplace="multiply_")
+divide = make_binary("divide", jnp.true_divide, inplace="divide_")
+floor_divide = make_binary("floor_divide", jnp.floor_divide)
+mod = make_binary("mod", jnp.mod, methods=("mod", "remainder"))
+remainder = mod
+pow = make_binary("pow", jnp.power, methods=("pow",))
+maximum = make_binary("maximum", jnp.maximum)
+minimum = make_binary("minimum", jnp.minimum)
+fmax = make_binary("fmax", jnp.fmax)
+fmin = make_binary("fmin", jnp.fmin)
+atan2 = make_binary("atan2", jnp.arctan2)
+hypot = make_binary("hypot", jnp.hypot)
+logaddexp = make_binary("logaddexp", jnp.logaddexp)
+nextafter = make_binary("nextafter", jnp.nextafter)
+copysign = make_binary("copysign", jnp.copysign)
+heaviside = make_binary("heaviside", jnp.heaviside)
+gcd = make_binary("gcd", jnp.gcd, differentiable=False)
+lcm = make_binary("lcm", jnp.lcm, differentiable=False)
+
+logical_and = make_binary("logical_and", jnp.logical_and, differentiable=False)
+logical_or = make_binary("logical_or", jnp.logical_or, differentiable=False)
+logical_xor = make_binary("logical_xor", jnp.logical_xor, differentiable=False)
+bitwise_and = make_binary("bitwise_and", jnp.bitwise_and, differentiable=False)
+bitwise_or = make_binary("bitwise_or", jnp.bitwise_or, differentiable=False)
+bitwise_xor = make_binary("bitwise_xor", jnp.bitwise_xor, differentiable=False)
+bitwise_left_shift = make_binary("bitwise_left_shift", jnp.left_shift, differentiable=False)
+bitwise_right_shift = make_binary("bitwise_right_shift", jnp.right_shift, differentiable=False)
+
+equal = make_binary("equal", jnp.equal, differentiable=False)
+not_equal = make_binary("not_equal", jnp.not_equal, differentiable=False)
+greater_than = make_binary("greater_than", jnp.greater, differentiable=False)
+greater_equal = make_binary("greater_equal", jnp.greater_equal, differentiable=False)
+less_than = make_binary("less_than", jnp.less, differentiable=False)
+less_equal = make_binary("less_equal", jnp.less_equal, differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("equal_all", lambda a, b: jnp.array_equal(a, b), x, y,
+                 differentiable=False)
+
+
+register_op("equal_all", equal_all, methods=("equal_all",))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                 equal_nan=equal_nan), x, y, differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                 equal_nan=equal_nan), x, y, differentiable=False)
+
+
+register_op("isclose", isclose, methods=("isclose",))
+register_op("allclose", allclose, methods=("allclose",))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """paddle.scale parity (upstream phi scale kernel)."""
+    x = ensure_tensor(x)
+    s, b = scale, bias
+    if isinstance(s, Tensor):
+        def f(a, sv):
+            return a * sv + b if bias_after_scale else (a + b) * sv
+        out = apply("scale", f, x, s)
+    else:
+        def f(a):
+            return a * s + b if bias_after_scale else (a + b) * s
+        out = apply("scale", f, x)
+    return out
+
+
+register_op("scale", scale, methods=("scale",), inplace_method="scale_")
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply("lerp", lambda a, b: a + weight * (b - a), x, y)
+
+
+register_op("lerp", lerp, methods=("lerp",), inplace_method="lerp_")
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    lo = float(min) if min is not None and not isinstance(min, Tensor) else min
+    hi = float(max) if max is not None and not isinstance(max, Tensor) else max
+    if isinstance(lo, Tensor) or isinstance(hi, Tensor):
+        lo_t = lo if isinstance(lo, Tensor) else to_tensor(lo if lo is not None else -jnp.inf)
+        hi_t = hi if isinstance(hi, Tensor) else to_tensor(hi if hi is not None else jnp.inf)
+        return apply("clip", lambda a, l, h: jnp.clip(a, l, h), x, lo_t, hi_t)
+    return apply("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+register_op("clip", clip, methods=("clip",), inplace_method="clip_")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = ensure_tensor(x)
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+register_op("stanh", stanh, methods=("stanh",))
+
+
+def multiplex(inputs, index, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    index = ensure_tensor(index)
+
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0)[0]
+
+    return apply("multiplex", f, index, *ts)
+
+
+register_op("multiplex", multiplex)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return apply("nan_to_num",
+                 lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+register_op("nan_to_num", nan_to_num, methods=("nan_to_num",))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        return apply("trapezoid", lambda a, b: jnp.trapezoid(a, b, axis=axis),
+                     y, ensure_tensor(x))
+    return apply("trapezoid", lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis), y)
+
+
+register_op("trapezoid", trapezoid)
+
+# --- Tensor dunder operators -------------------------------------------------
+
+def _install_operators():
+    def rev(fn):
+        def r(self, other):
+            return fn(to_tensor(other) if not isinstance(other, Tensor) else other, self)
+        return r
+
+    ops_map = {
+        "__add__": add, "__radd__": rev(add),
+        "__sub__": subtract, "__rsub__": rev(subtract),
+        "__mul__": multiply, "__rmul__": rev(multiply),
+        "__truediv__": divide, "__rtruediv__": rev(divide),
+        "__floordiv__": floor_divide, "__rfloordiv__": rev(floor_divide),
+        "__mod__": mod, "__rmod__": rev(mod),
+        "__pow__": pow, "__rpow__": rev(pow),
+        "__matmul__": None,  # installed by linalg module
+        "__eq__": equal, "__ne__": not_equal,
+        "__lt__": less_than, "__le__": less_equal,
+        "__gt__": greater_than, "__ge__": greater_equal,
+        "__and__": bitwise_and, "__or__": bitwise_or, "__xor__": bitwise_xor,
+        "__invert__": bitwise_not,
+        "__lshift__": bitwise_left_shift, "__rshift__": bitwise_right_shift,
+    }
+    for name, fn in ops_map.items():
+        if fn is not None:
+            register_tensor_method(name, fn)
+    register_tensor_method("__pos__", lambda self: self)
+
+
+_install_operators()

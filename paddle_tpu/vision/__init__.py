@@ -1,0 +1,8 @@
+"""``paddle.vision`` capability surface (PaddleClas-adjacent).
+
+Parity: python/paddle/vision/ (models, transforms, datasets).
+"""
+
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401

@@ -1,0 +1,4 @@
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152, wide_resnet50_2)
+from .lenet import LeNet  # noqa: F401
+from .mobilenet import MobileNetV3Small  # noqa: F401

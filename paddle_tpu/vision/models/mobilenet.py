@@ -1,0 +1,82 @@
+"""MobileNetV3-Small (parity: python/paddle/vision/models/mobilenetv3.py,
+trimmed config)."""
+
+from ... import nn
+
+
+class _SEModule(nn.Layer):
+    def __init__(self, channels, reduction=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, channels // reduction, 1)
+        self.fc2 = nn.Conv2D(channels // reduction, channels, 1)
+
+    def forward(self, x):
+        s = self.pool(x)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers += [nn.Conv2D(in_c, exp_c, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp_c), act()]
+        layers += [nn.Conv2D(exp_c, exp_c, k, stride=stride, padding=k // 2,
+                             groups=exp_c, bias_attr=False),
+                   nn.BatchNorm2D(exp_c), act()]
+        if use_se:
+            layers.append(_SEModule(exp_c))
+        layers += [nn.Conv2D(exp_c, out_c, 1, bias_attr=False),
+                   nn.BatchNorm2D(out_c)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3Small(nn.Layer):
+    CFG = [
+        # k, exp, out, se, act, stride
+        (3, 16, 16, True, nn.ReLU, 2),
+        (3, 72, 24, False, nn.ReLU, 2),
+        (3, 88, 24, False, nn.ReLU, 1),
+        (5, 96, 40, True, nn.Hardswish, 2),
+        (5, 240, 40, True, nn.Hardswish, 1),
+        (5, 240, 40, True, nn.Hardswish, 1),
+        (5, 120, 48, True, nn.Hardswish, 1),
+        (5, 144, 48, True, nn.Hardswish, 1),
+        (5, 288, 96, True, nn.Hardswish, 2),
+        (5, 576, 96, True, nn.Hardswish, 1),
+        (5, 576, 96, True, nn.Hardswish, 1),
+    ]
+
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 16, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(16), nn.Hardswish())
+        blocks = []
+        in_c = 16
+        for k, exp, out, se, act, s in self.CFG:
+            blocks.append(_InvertedResidual(in_c, exp, out, k, s, se, act))
+            in_c = out
+        self.blocks = nn.Sequential(*blocks)
+        self.head_conv = nn.Sequential(
+            nn.Conv2D(in_c, 576, 1, bias_attr=False), nn.BatchNorm2D(576),
+            nn.Hardswish())
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(
+            nn.Linear(576, 1024), nn.Hardswish(), nn.Dropout(0.2),
+            nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        x = self.pool(x)
+        from ...ops.manipulation import flatten
+        return self.classifier(flatten(x, 1))

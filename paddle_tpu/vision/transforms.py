@@ -1,0 +1,222 @@
+"""Vision transforms (numpy-based, host-side; parity:
+python/paddle/vision/transforms/). Operate on HWC uint8/float numpy arrays
+(or CHW float); composed in the DataLoader worker before device transfer.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "RandomResizedCrop", "ColorJitter", "Grayscale",
+]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if a.dtype == np.uint8:
+            a = a.astype(np.float32) / 255.0
+        if a.ndim == 2:
+            a = a[:, :, None]
+        if self.data_format == "CHW":
+            a = a.transpose(2, 0, 1)
+        return np.ascontiguousarray(a, np.float32)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean, std, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (a - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _resize_np(a: np.ndarray, size) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+    h, w = size if isinstance(size, (tuple, list)) else (size, size)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    if chw:
+        out_shape = (a.shape[0], h, w)
+    elif a.ndim == 3:
+        out_shape = (h, w, a.shape[2])
+    else:
+        out_shape = (h, w)
+    return np.asarray(jax.image.resize(jnp.asarray(a, jnp.float32), out_shape,
+                                       method="linear")).astype(a.dtype)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+
+    def __call__(self, img):
+        return _resize_np(np.asarray(img), self.size)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        H, W = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+        th, tw = self.size
+        i, j = max((H - th) // 2, 0), max((W - tw) // 2, 0)
+        return a[:, i:i + th, j:j + tw] if chw else a[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+        self.padding = padding
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        if self.padding:
+            p = self.padding
+            pads = ((0, 0), (p, p), (p, p)) if chw else \
+                ((p, p), (p, p)) + (((0, 0),) if a.ndim == 3 else ())
+            a = np.pad(a, pads)
+        H, W = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+        th, tw = self.size
+        i = np.random.randint(0, H - th + 1)
+        j = np.random.randint(0, W - tw + 1)
+        return a[:, i:i + th, j:j + tw] if chw else a[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if np.random.rand() < self.prob:
+            return a[..., ::-1].copy() if a.ndim == 3 and a.shape[0] in (1, 3, 4) \
+                else a[:, ::-1].copy()
+        return a
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if np.random.rand() < self.prob:
+            chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+            return a[:, ::-1].copy() if chw else a[::-1].copy()
+        return a
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        H, W = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+        area = H * W
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                i = np.random.randint(0, H - h + 1)
+                j = np.random.randint(0, W - w + 1)
+                crop = a[:, i:i + h, j:j + w] if chw else a[i:i + h, j:j + w]
+                return _resize_np(crop, self.size)
+        return _resize_np(CenterCrop(min(H, W))(a), self.size)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        p = self.padding
+        if isinstance(p, numbers.Number):
+            p = (p, p, p, p)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        if chw:
+            return np.pad(a, ((0, 0), (p[1], p[3]), (p[0], p[2])))
+        pads = ((p[1], p[3]), (p[0], p[2])) + (((0, 0),) if a.ndim == 3 else ())
+        return np.pad(a, pads)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.brightness = brightness
+        self.contrast = contrast
+
+    def __call__(self, img):
+        a = np.asarray(img, np.float32)
+        if self.brightness:
+            a = a * np.random.uniform(1 - self.brightness, 1 + self.brightness)
+        if self.contrast:
+            m = a.mean()
+            a = (a - m) * np.random.uniform(1 - self.contrast, 1 + self.contrast) + m
+        return np.clip(a, 0, 255 if a.max() > 1.5 else 1.0)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        a = np.asarray(img, np.float32)
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        w = np.array([0.299, 0.587, 0.114], np.float32)
+        g = np.tensordot(w, a, axes=([0], [0])) if chw else a @ w
+        g = g[None] if chw else g[..., None]
+        reps = [self.n, 1, 1] if chw else [1, 1, self.n]
+        return np.tile(g, reps)

@@ -1,0 +1,30 @@
+"""Test env: force CPU backend with 8 virtual devices BEFORE backend init.
+
+This is the CPU-backed fake-device pattern from SURVEY.md §4 (the analogue of
+the reference's custom_cpu plugin / Gloo backend): the whole distributed stack
+runs in CI on an 8-device CPU mesh.
+
+NOTE: this environment pre-imports jax (axon TPU plugin), so plain env vars
+are latched already — ``jax.config.update`` still works because the backend
+itself initializes lazily on first device query.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

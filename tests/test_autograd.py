@@ -1,0 +1,128 @@
+"""Autograd tape semantics (parity with eager engine behaviors in
+paddle/fluid/eager/: accumulation, hooks, no_grad, retain_graph, paddle.grad)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_accumulates():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    g1 = x.grad.numpy().copy()
+    y2 = (x * 3).sum()
+    y2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), g1 + 3.0)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0])  # stop_gradient default True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    d = (x * 2).detach()
+    assert d.stop_gradient
+    z = (x + d).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y.stop_gradient
+    y2 = x * 5
+    assert not y2.stop_gradient
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+    with pytest.raises(RuntimeError):
+        y.backward()  # graph released now
+
+
+def test_non_scalar_backward_needs_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y2 = x * 2
+    y2.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = paddle.to_tensor([4.0], stop_gradient=False)
+    z = x * x * y
+    gx, gy = paddle.grad(z, [x, y])
+    np.testing.assert_allclose(gx.numpy(), [24.0])
+    np.testing.assert_allclose(gy.numpy(), [9.0])
+    assert x.grad is None and y.grad is None  # .grad slots untouched
+
+
+def test_tensor_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 10)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+    h.remove()
+    x.clear_grad()
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b, c = paddle.split(x, 3, axis=1)
+    loss = (a * 1 + b * 2 + c * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 2, 3], [1, 2, 3]])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_setitem_grad_flow():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[1] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 0.0, 2.0])
+
+
+def test_getitem_grad_flow():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32), stop_gradient=False)
+    y = x[0:2, 1]
+    y.sum().backward()
+    expected = np.zeros((3, 3), np.float32)
+    expected[0, 1] = expected[1, 1] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)

@@ -1,0 +1,135 @@
+"""DataLoader/samplers + paddle.save/load + AMP autocast/GradScaler."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           TensorDataset)
+
+
+class _Squares(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batches():
+    dl = DataLoader(_Squares(20), batch_size=8, shuffle=False, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [8, 1]
+    np.testing.assert_allclose(x.numpy().ravel(), np.arange(8))
+    assert batches[-1][0].shape == [4, 1]
+
+
+def test_dataloader_shuffle_and_drop_last():
+    dl = DataLoader(_Squares(20), batch_size=8, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2
+    seen = np.concatenate([b[0].numpy().ravel() for b in batches])
+    assert len(np.unique(seen)) == 16
+
+
+def test_iterable_dataset():
+    class It(IterableDataset):
+        def __iter__(self):
+            for i in range(10):
+                yield np.float32([i])
+
+    dl = DataLoader(It(), batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert [b.shape[0] for b in batches] == [4, 4, 2]
+
+
+def test_distributed_batch_sampler_shards():
+    ds = _Squares(16)
+    all_idx = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=rank)
+        idx = [i for batch in s for i in batch]
+        assert len(idx) == 4
+        all_idx.extend(idx)
+    assert sorted(all_idx) == list(range(16))
+
+
+def test_tensor_dataset_and_save_load(tmp_path):
+    t = TensorDataset([paddle.randn([6, 3]), paddle.arange(6)])
+    x, y = t[2]
+    assert x.shape == [3]
+    obj = {"a": paddle.to_tensor([1.0, 2.0]), "b": [paddle.ones([2, 2]), 3],
+           "c": {"d": paddle.zeros([1])}}
+    p = str(tmp_path / "obj.pdparams")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["a"].numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(loaded["b"][0].numpy(), np.ones((2, 2)))
+    assert loaded["b"][1] == 3
+
+
+def test_autocast_o1_dtype():
+    m = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        y = m(x)
+        assert y.dtype == paddle.bfloat16  # linear is white-listed
+        s = paddle.softmax(y)
+        assert s.dtype == paddle.float32  # softmax black-listed -> fp32
+    y2 = m(x)
+    assert y2.dtype == paddle.float32
+
+
+def test_autocast_disabled_noop():
+    m = nn.Linear(4, 4)
+    with paddle.amp.auto_cast(enable=False):
+        assert m(paddle.randn([2, 4])).dtype == paddle.float32
+
+
+def test_grad_scaler_fp16_style():
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([8, 4])
+    loss = m(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    # grads were unscaled before the step: weight change must be O(lr*grad)
+    assert float(paddle.abs(m.weight).max()) < 100
+
+
+def test_grad_scaler_skips_on_inf():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "w_inf"
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+    w.grad = paddle.to_tensor([np.inf])
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(w.numpy(), [1.0])  # step skipped
+    assert scaler.get_loss_scaling() < 2.0  # scale decreased
+
+
+def test_amp_decorate_o2_master_weights():
+    m = nn.Linear(4, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=m.parameters())
+    m, opt = paddle.amp.decorate(m, opt, level="O2", dtype="bfloat16")
+    assert m.weight.dtype == paddle.bfloat16
+    x = paddle.randn([2, 4]).astype("bfloat16")
+    with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+        loss = m(x).astype("float32").sum()
+    loss.backward()
+    opt.step()
+    # master weight exists in fp32
+    assert len(opt._master_weights) > 0
+    mw = list(opt._master_weights.values())[0]
+    assert mw.dtype == paddle.float32

@@ -1,0 +1,148 @@
+"""nn.Layer system + layers: shapes, state_dict, train/eval semantics."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_linear_shapes_and_numerics():
+    l = nn.Linear(4, 3)
+    x = paddle.randn([5, 4])
+    y = l(x)
+    assert y.shape == [5, 3]
+    expected = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_matches_reference():
+    import jax
+    conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 16, 16]
+    # against lax reference
+    ref = jax.lax.conv_general_dilated(
+        x._data, conv.weight._data, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = ref + conv.bias._data.reshape(1, -1, 1, 1)
+    np.testing.assert_allclose(y.numpy(), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5]) * 3 + 1
+    bn.train()
+    y = bn(x)
+    # normalized output ~ zero mean unit var per channel
+    yn = y.numpy()
+    assert abs(yn.mean()) < 1e-4
+    assert abs(yn.std() - 1) < 1e-2
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 3, 8])
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x)
+    zeros = float((y.numpy() == 0).mean())
+    assert 0.3 < zeros < 0.7
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_embedding_padding_idx():
+    e = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([0, 3, 0, 5]))
+    out = e(ids)
+    np.testing.assert_allclose(out.numpy()[0], 0)
+    np.testing.assert_allclose(out.numpy()[2], 0)
+    assert not np.allclose(out.numpy()[1], 0)
+
+
+def test_state_dict_roundtrip(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.BatchNorm1D(8), nn.Linear(8, 2))
+    sd = m.state_dict()
+    assert any("weight" in k for k in sd)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(sd, path)
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.BatchNorm1D(8), nn.Linear(8, 2))
+    loaded = paddle.load(path)
+    m2.set_state_dict(loaded)
+    for (k1, v1), (k2, v2) in zip(m.state_dict().items(), m2.state_dict().items()):
+        assert k1 == k2
+        np.testing.assert_allclose(np.asarray(v1._data), np.asarray(v2._data))
+
+
+def test_named_parameters_and_apply():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert "0.weight" in names and "1.bias" in names
+    seen = []
+    m.apply(lambda l: seen.append(type(l).__name__))
+    assert "Sequential" in seen and seen.count("Linear") == 2
+
+
+def test_sublayer_replacement_and_hooks():
+    m = nn.Sequential(nn.Linear(2, 2))
+    calls = []
+    h = m.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    m(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    m(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_mha_forward():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    y = enc(x)
+    assert y.shape == [2, 6, 16]
+    # clone must be independent params
+    w0 = enc.layers[0].linear1.weight.numpy()
+    w1 = enc.layers[1].linear1.weight.numpy()
+    assert not np.allclose(w0, w1)
+
+
+def test_losses():
+    logits = paddle.randn([8, 5])
+    labels = paddle.randint(0, 5, [8])
+    ce = nn.CrossEntropyLoss()(logits, labels)
+    lp = np.log(np.exp(logits.numpy()) / np.exp(logits.numpy()).sum(-1, keepdims=True))
+    expected = -lp[np.arange(8), labels.numpy()].mean()
+    np.testing.assert_allclose(ce.numpy(), expected, rtol=1e-5)
+    mse = nn.MSELoss()(paddle.ones([3]), paddle.zeros([3]))
+    assert float(mse) == 1.0
+
+
+def test_pooling():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D(1)(x).numpy()[0, 0, 0, 0],
+        x.numpy()[0, 0].mean(), rtol=1e-5)

@@ -1,0 +1,122 @@
+"""Optimizers, grad clip, LR schedulers, weight decay semantics."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _quad_problem():
+    w = paddle.to_tensor(np.array([3.0, -2.0], np.float32), stop_gradient=False)
+    w.name = "w"
+
+    def loss_fn():
+        return ((w - paddle.to_tensor([1.0, 1.0])) ** 2).sum()
+
+    return w, loss_fn
+
+
+@pytest.mark.parametrize("opt_name,kwargs", [
+    ("SGD", {"learning_rate": 0.1}),
+    ("Momentum", {"learning_rate": 0.05, "momentum": 0.9}),
+    ("Adam", {"learning_rate": 0.1}),
+    ("AdamW", {"learning_rate": 0.1, "weight_decay": 0.01}),
+    ("Adagrad", {"learning_rate": 0.5}),
+    ("RMSProp", {"learning_rate": 0.05}),
+    ("Adamax", {"learning_rate": 0.1}),
+    ("Adadelta", {"learning_rate": 50.0}),  # adadelta's effective step starts ~lr*sqrt(eps): slow by design
+    ("Lamb", {"learning_rate": 0.1}),
+])
+def test_optimizer_converges(opt_name, kwargs):
+    w, loss_fn = _quad_problem()
+    opt = getattr(paddle.optimizer, opt_name)(parameters=[w], **kwargs)
+    first = float(loss_fn())
+    for _ in range(60):
+        loss = loss_fn()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss_fn()) < first * 0.1, f"{opt_name} failed to converge"
+
+
+def test_sgd_exact_update():
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "w0"
+    opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+    (w * 3).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.5 * 3.0])
+
+
+def test_adamw_decoupled_decay():
+    # with zero grad, AdamW still shrinks weights; Adam(weight_decay) couples
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "w1"
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                 parameters=[w])
+    w.grad = paddle.zeros([1])
+    opt.step()
+    assert float(w) < 1.0  # decayed despite zero grad
+
+
+def test_global_norm_clip():
+    from paddle_tpu.optimizer import ClipGradByGlobalNorm
+    w = paddle.to_tensor([10.0, 0.0], stop_gradient=False)
+    w.name = "w2"
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                               grad_clip=ClipGradByGlobalNorm(1.0))
+    (w * paddle.to_tensor([3.0, 4.0])).sum().backward()
+    opt.step()
+    # grad (3,4) norm 5 -> clipped to (0.6, 0.8)
+    np.testing.assert_allclose(w.numpy(), [10.0 - 0.6, -0.8], rtol=1e-5)
+
+
+def test_lr_scheduler_basic():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+    w = paddle.to_tensor([1.0], stop_gradient=False)
+    w.name = "w3"
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+    lrs = []
+    for _ in range(6):
+        lrs.append(opt.get_lr())
+        sched.step()
+    assert lrs == [1.0, 1.0, 0.5, 0.5, 0.25, 0.25]
+
+
+def test_cosine_and_warmup():
+    import math
+    c = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    assert abs(c() - 1.0) < 1e-6
+    for _ in range(10):
+        c.step()
+    assert c() < 1e-6
+    w = paddle.optimizer.lr.LinearWarmup(learning_rate=0.1, warmup_steps=5,
+                                         start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(6):
+        vals.append(round(w(), 4))
+        w.step()
+    assert vals[0] == 0.0 and abs(vals[-1] - 0.1) < 1e-6
+
+
+def test_optimizer_state_dict_roundtrip():
+    w, loss_fn = _quad_problem()
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    for _ in range(3):
+        loss_fn().backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    assert sd["step"] == 3
+    w2, loss_fn2 = _quad_problem()
+    w2.name = "w"
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+    loss_fn2().backward()
+    opt2.step()  # create accumulators
+    opt2.clear_grad()
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 3
+    m1 = list(opt._accumulators["moment1"].values())[0].numpy()
+    m2 = list(opt2._accumulators["moment1"].values())[0].numpy()
+    np.testing.assert_allclose(m1, m2)

@@ -1,0 +1,100 @@
+"""Regressions for review findings: donation safety, param groups, resume
+before first step, spectral-norm convergence."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_donation_does_not_kill_readonly_state():
+    """A param that is read but never mutated must survive a compiled call
+    (donated buffers for un-mutated state are carried through as aliases)."""
+    w = paddle.to_tensor(np.ones((4, 4), np.float32))
+    paddle.core_register = getattr(paddle, "core_register", None)
+    from paddle_tpu.core.tensor import register_state_tensor
+    w.name = "ro_w"
+    register_state_tensor(w)
+    other = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    other.name = "mut"
+    register_state_tensor(other)
+
+    @paddle.jit.to_static
+    def f(x):
+        other._set_data(other._data + 1.0)  # mutate one, read the other
+        return paddle.matmul(x, w)
+
+    y = f(paddle.ones([4, 4]))
+    # both state tensors must still be alive and correct
+    np.testing.assert_allclose(w.numpy(), np.ones((4, 4)))
+    np.testing.assert_allclose(other.numpy(), np.ones((4, 4)))
+    np.testing.assert_allclose(y.numpy(), np.full((4, 4), 4.0))
+    y2 = f(paddle.ones([4, 4]))
+    np.testing.assert_allclose(other.numpy(), np.full((4, 4), 2.0))
+    np.testing.assert_allclose(w.numpy(), np.ones((4, 4)))
+
+
+def test_param_groups_dict_form():
+    m1 = nn.Linear(4, 4)
+    m2 = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[
+        {"params": m1.parameters(), "learning_rate": 0.1},
+        {"params": m2.parameters()},
+    ])
+    w1 = m1.weight.numpy().copy()
+    w2 = m2.weight.numpy().copy()
+    for p in list(m1.parameters()) + list(m2.parameters()):
+        p.grad = paddle.ones(p.shape)
+    opt.step()
+    np.testing.assert_allclose(m1.weight.numpy(), w1 - 0.1, rtol=1e-6)
+    np.testing.assert_allclose(m2.weight.numpy(), w2 - 1.0, rtol=1e-6)
+    # state_dict sees params in dict groups
+    sd = opt.state_dict()
+    assert "step" in sd
+    opt.clear_grad()
+    assert all(p.grad is None for p in m1.parameters())
+
+
+def test_resume_before_first_step():
+    """set_state_dict on a FRESH optimizer must materialize accumulators."""
+    m = nn.Linear(3, 3)
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    x = paddle.randn([4, 3])
+    for _ in range(3):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+
+    m2 = nn.Linear(3, 3)
+    # rename params to match checkpoint keys
+    for p2, p1 in zip(m2.parameters(), m.parameters()):
+        p2.name = p1.name
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=m2.parameters())
+    opt2.set_state_dict(sd)  # BEFORE any step
+    assert opt2._step_count == 3
+    m1_acc = sorted((k for k in sd if k.endswith("_moment1")))
+    assert m1_acc, "checkpoint must contain moment keys"
+    # accumulators materialized with checkpoint values
+    assert "moment1" in opt2._accumulators
+    loaded = list(opt2._accumulators["moment1"].values())[0].numpy()
+    orig = sd[m1_acc[0]].numpy()
+    assert not np.allclose(loaded, 0), "loaded moments must not be zero"
+
+
+def test_spectral_norm_buffers_advance():
+    sn = nn.SpectralNorm((8, 8), power_iters=1)
+    w = paddle.randn([8, 8])
+    u0 = sn.weight_u.numpy().copy()
+    sn(w)
+    u1 = sn.weight_u.numpy().copy()
+    assert not np.allclose(u0, u1), "power iteration must advance u buffer"
+    for _ in range(50):
+        sn(w)
+    # after many iterations sigma should approximate the top singular value
+    out = sn(w)
+    top = np.linalg.svd(w.numpy(), compute_uv=False)[0]
+    ratio = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+    np.testing.assert_allclose(ratio, 1.0, rtol=1e-2)

@@ -1,0 +1,101 @@
+"""to_static: compiled/eager equivalence, state functionalization, caching."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _fresh_pair(seed):
+    paddle.seed(seed)
+    m1 = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 3))
+    paddle.seed(seed)
+    m2 = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 3))
+    return m1, m2
+
+
+def test_forward_equivalence():
+    m1, m2 = _fresh_pair(7)
+    x = paddle.randn([4, 6])
+    eager = m1(x).numpy()
+    compiled_fn = paddle.jit.to_static(m2.forward)
+    compiled = compiled_fn(x).numpy()
+    np.testing.assert_allclose(eager, compiled, rtol=1e-5, atol=1e-6)
+
+
+def test_train_step_equivalence():
+    m1, m2 = _fresh_pair(11)
+    o1 = paddle.optimizer.Adam(learning_rate=0.01, parameters=m1.parameters())
+    o2 = paddle.optimizer.Adam(learning_rate=0.01, parameters=m2.parameters())
+    x = paddle.randn([8, 6])
+    y = paddle.randn([8, 3])
+
+    def step(model, opt):
+        loss = nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    cstep = paddle.jit.to_static(lambda: step(m2, o2))
+    for i in range(5):
+        le = float(step(m1, o1))
+        lc = float(cstep())
+        assert abs(le - lc) < 1e-4, (i, le, lc)
+    np.testing.assert_allclose(m1[0].weight.numpy(), m2[0].weight.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_buffers_update_under_jit():
+    paddle.seed(3)
+    m = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    m.train()
+    step = paddle.jit.to_static(m.forward)
+    before = m[1]._mean.numpy().copy()
+    step(paddle.randn([16, 4]) + 5.0)
+    after = m[1]._mean.numpy()
+    assert not np.allclose(before, after), "running mean must update through jit"
+
+
+def test_rng_advances_under_jit():
+    paddle.seed(0)
+    d = nn.Dropout(0.5)
+    d.train()
+    f = paddle.jit.to_static(d.forward)
+    a = f(paddle.ones([100])).numpy()
+    b = f(paddle.ones([100])).numpy()
+    assert not np.allclose(a, b), "dropout mask must differ between steps"
+
+
+def test_shape_polymorphism_recompiles():
+    m = nn.Linear(4, 2)
+    f = paddle.jit.to_static(m.forward)
+    y1 = f(paddle.randn([3, 4]))
+    y2 = f(paddle.randn([7, 4]))
+    assert y1.shape == [3, 2] and y2.shape == [7, 2]
+
+
+def test_grads_cleared_after_compiled_step():
+    m = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = m(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step(paddle.randn([2, 4]))
+    assert all(p.grad is None for p in m.parameters())
+
+
+def test_dynamic_shape_op_raises_under_jit():
+    @paddle.jit.to_static
+    def f(x):
+        return paddle.nonzero(x)
+
+    with pytest.raises(Exception):
+        f(paddle.to_tensor([0.0, 1.0, 0.0]))
