@@ -55,6 +55,8 @@ def _install_ops() -> None:
 _install_ops()
 
 # subpackage namespaces (imported lazily-ish at the end: they use the ops)
+from . import distributed  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
 from . import optimizer  # noqa: F401,E402
 from . import io  # noqa: F401,E402

@@ -49,9 +49,11 @@ class RemovableHandle:
 
 
 class Tensor:
+    # __dict__ is included deliberately: paddle code (and users) attach
+    # ad-hoc attributes to tensors (is_distributed, placements, ...)
     __slots__ = (
         "_data", "stop_gradient", "_grad", "_grad_node", "_grad_index",
-        "name", "persistable", "trainable", "_hooks", "__weakref__",
+        "name", "persistable", "trainable", "_hooks", "__weakref__", "__dict__",
     )
 
     # let binary dunders win over numpy array ops
@@ -466,6 +468,16 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
     if dtype is not None and arr.dtype != dtype:
         arr = jnp.asarray(arr, dtype=dtype) if _is_tracer(arr) else np.asarray(arr).astype(dtype) if isinstance(arr, np.ndarray) else arr.astype(dtype)
     if not _is_tracer(arr):
-        target = _parse_place(place) if place is not None else _device.current_place()
-        arr = jax.device_put(arr, target.jax_device())
+        if place is not None:
+            # explicit placement commits the array to that device
+            arr = jax.device_put(arr, _parse_place(place).jax_device())
+        else:
+            cur = _device.current_place()
+            default_platform = "cpu" if not _device.is_compiled_with_tpu() else "tpu"
+            if cur.device_type != default_platform or cur.device_id != 0:
+                arr = jax.device_put(arr, cur.jax_device())
+            else:
+                # UNCOMMITTED on the default device: lets eager ops mix with
+                # mesh-committed (sharded) arrays without transfer errors
+                arr = jnp.asarray(arr)
     return Tensor(arr, stop_gradient=stop_gradient)

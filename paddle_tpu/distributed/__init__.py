@@ -1,0 +1,43 @@
+"""``paddle.distributed``: the TPU-native Fleet-capability stack.
+
+Layer map (vs upstream python/paddle/distributed/ + C++ collective runtime):
+  env.py        — init_parallel_env / rank/world (jax.distributed bootstrap)
+  topology.py   — CommunicateTopology / HybridCommunicateGroup → jax Mesh
+  comm.py       — eager collective API (shard_map programs over ICI)
+  fleet/        — fleet facade, DistributedStrategy, hybrid-parallel layers
+  parallel.py   — DataParallel
+  sharding/     — ZeRO stage 1/2/3 (group_sharded_parallel)
+  auto_parallel — ProcessMesh / shard_tensor / reshard (DistTensor parity)
+  checkpoint/   — sharded save/load with reshard-on-load
+  launch/       — process launcher CLI
+"""
+
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, is_initialized,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, ProcessGroup, new_group,
+    get_hybrid_communicate_group, global_mesh,
+)
+from .comm import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, all_gather_object, reduce_scatter,
+    alltoall, alltoall_single, broadcast, reduce, scatter, barrier, send, recv,
+    shard_stack, unstack, ppermute_shift, wait, stream,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from .auto_parallel_api import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
+    reshard, shard_layer,
+)
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Parity: paddle.distributed.spawn. On TPU the SPMD model drives all
+    local devices from ONE process, so spawn degenerates to calling ``func``
+    once with the mesh active (per-device process fan-out is an anti-pattern
+    on TPU; multi-host fan-out is the launcher's job)."""
+    init_parallel_env()
+    func(*args)

@@ -1,0 +1,175 @@
+"""Auto-parallel (DTensor) API.
+
+Parity surface: paddle.distributed auto-parallel surface —
+``ProcessMesh``, placements (``Shard(d)``, ``Replicate()``, ``Partial()``),
+``shard_tensor``, ``dtensor_from_fn``, ``reshard``, ``shard_layer``
+(upstream python/paddle/distributed/auto_parallel/ + C++ DistTensor in
+paddle/phi/core/distributed/auto_parallel/). TPU-native: a DistTensor IS a
+jax array with a NamedSharding — placements translate 1:1 to PartitionSpec
+entries, reshard is ``device_put``, and the reference's per-op SPMD rules are
+XLA GSPMD propagation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, to_tensor
+from ..nn.layer import Layer
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "dtensor_from_fn", "reshard", "shard_layer", "get_mesh", "set_mesh"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. XLA tracks partial values internally; at
+    the API boundary we materialize the reduction (device_put cannot express
+    'partial'), which matches reshard(Partial->Replicate) semantics."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """Parity: paddle.distributed.ProcessMesh(mesh, dim_names)."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[Sequence[str]] = None, shape=None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self.dim_names = list(dim_names)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.reshape(-1).tolist()
+        devs = jax.devices()
+        dev_arr = np.array([devs[i % len(devs)] for i in self.process_ids]
+                           ).reshape(arr.shape)
+        self.jax_mesh = Mesh(dev_arr, tuple(self.dim_names))
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def get_dim_size(self, name: str) -> int:
+        return self.shape[self.dim_names.index(name)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                        ndim: int) -> P:
+    """placements[i] says how mesh dim i maps onto tensor dims."""
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            axis_name = mesh.dim_names[mesh_dim]
+            cur = entries[pl.dim]
+            if cur is None:
+                entries[pl.dim] = axis_name
+            elif isinstance(cur, tuple):
+                entries[pl.dim] = cur + (axis_name,)
+            else:
+                entries[pl.dim] = (cur, axis_name)
+        # Replicate/Partial: no entry
+    return P(*entries)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Parity: paddle.distributed.shard_tensor."""
+    t = data if isinstance(data, Tensor) else to_tensor(data, dtype=dtype)
+    spec = _placements_to_spec(placements, mesh, t._data.ndim)
+    arr = jax.device_put(t._data, NamedSharding(mesh.jax_mesh, spec))
+    out = Tensor(arr, stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient, name=t.name)
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs) -> Tensor:
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """Parity: paddle.distributed.reshard — relayout via device_put (XLA
+    emits the minimal collective: all-gather / all-to-all / slice)."""
+    spec = _placements_to_spec(placements, mesh, dist_tensor._data.ndim)
+    arr = jax.device_put(dist_tensor._data, NamedSharding(mesh.jax_mesh, spec))
+    out = Tensor(arr, stop_gradient=dist_tensor.stop_gradient)
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh,
+                shard_fn=None, input_fn=None, output_fn=None) -> Layer:
+    """Parity: paddle.distributed.shard_layer — apply shard_fn(name, layer,
+    mesh) to every sublayer (it calls shard_tensor on the params it wants
+    distributed); default replicates every parameter on the mesh."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for p in sublayer.parameters(include_sublayers=False):
+                p._set_data(jax.device_put(
+                    p._data, NamedSharding(mesh.jax_mesh, P())))
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer

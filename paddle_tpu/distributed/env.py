@@ -1,0 +1,117 @@
+"""Distributed environment bootstrap.
+
+Parity surface: python/paddle/distributed/parallel.py ``init_parallel_env`` +
+env-var contract (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+PADDLE_TRAINER_ENDPOINTS) and the C++ TCPStore rendezvous (upstream
+paddle/phi/core/distributed/store/). TPU-native design: the process model is
+one process per HOST (jax norm), not per device; rendezvous is
+``jax.distributed.initialize`` against the coordination service — the
+TCPStore equivalent. Inside a process, "ranks" are mesh positions: the
+eager collective API operates on group-stacked sharded arrays (see comm.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "is_initialized", "local_device_count",
+]
+
+_initialized = False
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def init_parallel_env(strategy=None):
+    """Initialize the distributed context.
+
+    Multi-host: if the paddle launcher env contract is present
+    (PADDLE_TRAINERS_NUM > 1), call ``jax.distributed.initialize`` with the
+    first endpoint as coordinator. Single-host: no-op beyond building the
+    default topology; the local device mesh carries all parallelism.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    nprocs = _env_int("PADDLE_TRAINERS_NUM", 1)
+    pid = _env_int("PADDLE_TRAINER_ID", 0)
+    if nprocs > 1 and jax.process_count() == 1:
+        endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        coordinator = endpoints[0] if endpoints and endpoints[0] else None
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nprocs, process_id=pid)
+    from .topology import _ensure_default_topology
+    _ensure_default_topology()
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    """Process-level rank (paddle's trainer id). Inside SPMD programs, use
+    mesh axis indices instead."""
+    if group is not None:
+        return group.rank
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    try:
+        if jax.process_count() > 1:
+            return jax.process_count()
+    except RuntimeError:
+        pass
+    return 1
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+class ParallelEnv:
+    """Parity: paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return 0
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self) -> List[str]:
+        return [e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def local_rank(self) -> int:
+        return self.rank

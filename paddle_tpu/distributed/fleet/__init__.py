@@ -1,0 +1,116 @@
+"""Fleet facade.
+
+Parity surface: python/paddle/distributed/fleet/ (``fleet.init``,
+``DistributedStrategy``, ``fleet.distributed_model``,
+``fleet.distributed_optimizer``, RoleMaker). TPU-native: ``init`` builds the
+HybridCommunicateGroup → one jax Mesh; ``distributed_model`` wraps for
+dp/pp; TP layers (mp_layers) are sharded-storage layers that need no
+wrapping; ``distributed_optimizer`` applies sharding stages.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..env import init_parallel_env
+from ..topology import (HybridCommunicateGroup, get_hybrid_communicate_group,
+                        set_hybrid_communicate_group)
+from .strategy import DistributedStrategy
+from . import mp_layers  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
+from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
+                        VocabParallelEmbedding, ParallelCrossEntropy)
+
+__all__ = [
+    "init", "DistributedStrategy", "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group", "HybridCommunicateGroup",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "PipelineLayer", "LayerDesc", "SharedLayerDesc",
+]
+
+_fleet_initialized = False
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level=None):
+    """``fleet.init`` parity: parse the hybrid config, build the mesh."""
+    global _fleet_initialized, _strategy
+    init_parallel_env()
+    _strategy = strategy or DistributedStrategy()
+    hc = _strategy.hybrid_configs
+    import jax
+    ndev = len(jax.devices())
+    degrees = {
+        "dp": hc.get("dp_degree", -1),
+        "mp": hc.get("mp_degree", 1),
+        "pp": hc.get("pp_degree", 1),
+        "sharding": hc.get("sharding_degree", 1),
+        "sep": hc.get("sep_degree", 1),
+    }
+    fixed = 1
+    for k, v in degrees.items():
+        if k != "dp" and v > 1:
+            fixed *= v
+    if degrees["dp"] == -1:
+        degrees["dp"] = max(ndev // fixed, 1)
+    HybridCommunicateGroup(
+        dp_degree=degrees["dp"], mp_degree=degrees["mp"],
+        pp_degree=degrees["pp"], sharding_degree=degrees["sharding"],
+        sep_degree=degrees["sep"])
+    _fleet_initialized = True
+    return
+
+
+def is_initialized() -> bool:
+    return _fleet_initialized
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def distributed_model(model):
+    """Wrap per active parallelism (parity:
+    python/paddle/distributed/fleet/base/fleet_base.py distributed_model)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        init()
+        hcg = get_hybrid_communicate_group()
+    from .pipeline_parallel import PipelineLayer, PipelineParallel
+    if hcg.get_pipe_parallel_world_size() > 1:
+        if not isinstance(model, PipelineLayer):
+            raise TypeError(
+                "pp_degree > 1 requires the model to be a fleet PipelineLayer")
+        return PipelineParallel(model, hcg, get_strategy())
+    if hcg.get_data_parallel_world_size() > 1:
+        from ..parallel import DataParallel
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """Apply hybrid/sharding wrappers (parity: HybridParallelOptimizer /
+    DygraphShardingOptimizer selection in fleet_base)."""
+    hcg = get_hybrid_communicate_group()
+    st = strategy or _strategy or DistributedStrategy()
+    if hcg is not None and hcg.get_sharding_parallel_world_size() > 1:
+        from ..sharding.sharding_optimizer import DygraphShardingOptimizer
+        stage = st.hybrid_configs.get("sharding_configs", {}).get("stage", 1)
+        return DygraphShardingOptimizer(optimizer, hcg, stage=stage)
+    return optimizer
+
+
+# surface the PP classes at fleet namespace parity locations
+from .pipeline_parallel import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401,E402
+
+
+class UtilBase:
+    def all_reduce(self, input, mode="sum"):
+        from .. import all_reduce as _ar
+        return _ar(input)
+
+
+util = UtilBase()

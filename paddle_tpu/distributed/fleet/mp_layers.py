@@ -1,0 +1,215 @@
+"""Tensor-parallel (mp) layers.
+
+Parity surface: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+ParallelCrossEntropy) + mp_ops.py (c_identity/c_concat/mp_allreduce ops).
+
+TPU-native design (SURVEY.md §7.4): the weights are FULL logical arrays whose
+storage is sharded over the ``mp`` mesh axis via NamedSharding — forward is a
+plain matmul with sharding constraints, and XLA inserts the column/row
+collectives (identity / all-gather / psum) itself. No hand-written c_* comm
+ops; the same layer code runs eagerly (SPMD eager) and under to_static.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.random import Generator, default_generator
+from ...core.tensor import Tensor, apply
+from ...nn import functional as F
+from ...nn.initializer import XavierUniform
+from ...nn.layer import Layer
+from ..topology import get_hybrid_communicate_group
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "get_rng_state_tracker", "RNGStatesTracker",
+]
+
+
+def _mp_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.get_model_parallel_world_size() <= 1:
+        return None, None
+    return hcg.mesh, "mp"
+
+
+def _shard_param(p: Tensor, spec) -> Tensor:
+    mesh, axis = _mp_mesh()
+    if mesh is not None:
+        p._set_data(jax.device_put(p._data, NamedSharding(mesh, spec)))
+    return p
+
+
+def _constrain(t: Tensor, spec) -> Tensor:
+    """Apply a sharding constraint (works eagerly and under tracing)."""
+    mesh, _ = _mp_mesh()
+    if mesh is None:
+        return t
+    return apply("sharding_constraint",
+                 lambda a: jax.lax.with_sharding_constraint(
+                     a, NamedSharding(mesh, spec)), t)
+
+
+class ColumnParallelLinear(Layer):
+    """Y = XW, W (in, out) column-sharded over mp; X replicated."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, bias_attr=None, name=None):
+        super().__init__()
+        if bias_attr is False:
+            has_bias = False
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = _mp_mesh()[0] is not None
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.is_distributed = True
+        _shard_param(self.weight, P(None, "mp"))
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), attr=bias_attr,
+                                              is_bias=True)
+            self.bias.is_distributed = True
+            _shard_param(self.bias, P("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        nd = out._data.ndim
+        if self.is_mp:
+            if self.gather_output:
+                out = _constrain(out, P(*([None] * nd)))
+            else:
+                out = _constrain(out, P(*([None] * (nd - 1)), "mp"))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Y = XW, W (in, out) row-sharded over mp; X arrives sharded on its last
+    dim when ``input_is_parallel`` (the XLA-psum pairs with an upstream
+    ColumnParallelLinear(gather_output=False))."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, bias_attr=None, name=None):
+        super().__init__()
+        if bias_attr is False:
+            has_bias = False
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = _mp_mesh()[0] is not None
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.is_distributed = True
+        _shard_param(self.weight, P("mp", None))
+        if has_bias:
+            # bias applied AFTER the reduction: replicated
+            self.bias = self.create_parameter((out_features,), attr=bias_attr,
+                                              is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.is_mp and self.input_is_parallel:
+            nd = x._data.ndim
+            x = _constrain(x, P(*([None] * (nd - 1)), "mp"))
+        out = F.linear(x, self.weight)
+        if self.is_mp:
+            nd = out._data.ndim
+            out = _constrain(out, P(*([None] * nd)))  # forces the psum
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dimension sharded over mp."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.is_mp = _mp_mesh()[0] is not None
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.is_distributed = True
+        _shard_param(self.weight, P("mp", None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        if self.is_mp:
+            nd = out._data.ndim
+            out = _constrain(out, P(*([None] * nd)))
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over vocab-sharded logits (parity:
+    mpu ParallelCrossEntropy; the reference does a custom comm softmax —
+    XLA derives the same reduce pattern from the shardings)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+class RNGStatesTracker:
+    """Per-name RNG streams (parity: fleet/layers/mpu/random.py — the
+    model-parallel RNG tracker that keeps dropout identical across mp ranks
+    for replicated activations and distinct for sharded ones)."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self._states:
+            raise ValueError(f"state {name!r} already exists")
+        self._states[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states) -> None:
+        self._states = dict(states)
+
+    def rng_state(self, name: str = "model_parallel_rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            if name not in self._states:
+                self.add(name, hash(name) % (2 ** 31))
+            gen = self._states[name]
+            saved = default_generator._key._data
+            default_generator._key._set_data(gen._key._data)
+            try:
+                yield
+            finally:
+                gen._key._set_data(default_generator._key._data)
+                default_generator._key._set_data(saved)
+
+        return _ctx()
+
+
+_rng_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _rng_tracker

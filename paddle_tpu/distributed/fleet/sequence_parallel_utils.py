@@ -1,0 +1,150 @@
+"""Megatron-style sequence parallelism utilities.
+
+Parity surface: python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py (ScatterOp, GatherOp, AllGatherOp,
+ReduceScatterOp, ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+register_sequence_parallel_allreduce_hooks).
+
+TPU-native design (SURVEY.md §5 long-context item 1): "scatter" and
+"gather" are sharding constraints on the SEQUENCE dimension over the mp
+axis — outside attention/FFN blocks activations live seq-sharded (memory /
+mp_degree), and XLA materializes the all-gather/reduce-scatter exactly where
+the constraints flip, which is the Megatron SP communication pattern.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor, apply
+from ...nn import functional as F
+from ...nn.initializer import XavierUniform
+from ...nn.layer import Layer
+from ..topology import get_hybrid_communicate_group
+from .mp_layers import _constrain, _mp_mesh, _shard_param
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather",
+    "mark_as_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+]
+
+# activations are (B, L, H): the sequence axis is dim 1 (paddle SP uses dim 0
+# of (L, B, H) upstream; we keep batch-first and document the difference)
+_SEQ_DIM = 1
+
+
+def scatter(x: Tensor, seq_dim: int = _SEQ_DIM) -> Tensor:
+    """Shard the sequence dim over mp (paddle ScatterOp.forward)."""
+    mesh, axis = _mp_mesh()
+    if mesh is None:
+        return x
+    spec = [None] * x._data.ndim
+    spec[seq_dim] = "mp"
+    return _constrain(x, P(*spec))
+
+
+def all_gather(x: Tensor, seq_dim: int = _SEQ_DIM) -> Tensor:
+    """Replicate the sequence dim (paddle GatherOp / AllGatherOp)."""
+    mesh, axis = _mp_mesh()
+    if mesh is None:
+        return x
+    return _constrain(x, P(*([None] * x._data.ndim)))
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x, seq_dim: int = _SEQ_DIM):
+        return scatter(x, seq_dim)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, seq_dim: int = _SEQ_DIM):
+        return all_gather(x, seq_dim)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x, seq_dim: int = _SEQ_DIM):
+        # sum-over-mp then scatter the seq dim: with sharded matmul inputs the
+        # partial-sum + constraint lowers to one reduce-scatter in XLA
+        return scatter(x, seq_dim)
+
+
+def mark_as_sequence_parallel_parameter(param: Tensor) -> None:
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model: Layer, accumulation_steps=1,
+                                               fuse_allreduce=True) -> None:
+    """Parity shim: grads of sequence-parallel params (layernorms living on
+    seq-sharded activations) need an mp all-reduce in the reference; with
+    sharding constraints XLA already emits the correct grad collectives, so
+    this registers nothing and exists for API compatibility."""
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear whose INPUT is sequence-sharded: the implicit
+    all-gather of the sequence happens at the matmul (XLA materializes it
+    from the sharding flip)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.is_mp = _mp_mesh()[0] is not None
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.is_distributed = True
+        _shard_param(self.weight, P(None, "mp"))
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            _shard_param(self.bias, P("mp"))
+
+    def forward(self, x):
+        x = all_gather(x)  # seq-sharded -> full sequence at the matmul
+        out = F.linear(x, self.weight, self.bias)
+        if self.is_mp and not self.gather_output:
+            nd = out._data.ndim
+            out = _constrain(out, P(*([None] * (nd - 1)), "mp"))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear whose OUTPUT is sequence-sharded: the psum over
+    mp becomes a reduce-scatter onto the sequence dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = _mp_mesh()[0] is not None
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.is_distributed = True
+        _shard_param(self.weight, P("mp", None))
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if has_bias else None
+
+    def forward(self, x):
+        if self.is_mp and self.input_is_parallel:
+            nd = x._data.ndim
+            x = _constrain(x, P(*([None] * (nd - 1)), "mp"))
+        out = F.linear(x, self.weight)
+        out = scatter(out)  # reduce-scatter: sum over mp + shard seq dim
+        if self.bias is not None:
+            out = out + self.bias
+        return out

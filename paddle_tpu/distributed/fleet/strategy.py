@@ -1,0 +1,88 @@
+"""DistributedStrategy.
+
+Parity surface: the reference's protobuf-backed DistributedStrategy
+(upstream paddle/fluid/framework/distributed_strategy.proto + python facade
+python/paddle/distributed/fleet/base/distributed_strategy.py). TPU-native:
+a typed dataclass tree serialized to JSON (SURVEY.md §5 config design) —
+same nested strategy surface (hybrid_configs, sharding_configs, amp_configs,
+recompute_configs...), no protobuf dependency.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict
+
+
+def _default_hybrid() -> Dict[str, Any]:
+    return {
+        "dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+        "sharding_degree": 1, "sep_degree": 1,
+        "order": ["dp", "pp", "sharding", "sep", "mp"],
+        "mp_configs": {}, "pp_configs": {}, "sharding_configs": {"stage": 1},
+    }
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs: Dict[str, Any] = _default_hybrid()
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 65536.0, "custom_white_list": [],
+            "custom_black_list": [], "use_pure_fp16": False,
+            "use_bf16": True, "level": "O1",
+        }
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {
+            "stage": 1, "degree": 1, "offload": False,
+        }
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {
+            "accumulate_steps": 1, "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.dgc = False
+        self.a_sync = False  # PS mode toggle (parity)
+        self.a_sync_configs: Dict[str, Any] = {}
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True  # accepted; XLA fuses natively
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1  # accepted no-op: ICI has no comm objects
+
+    # hybrid_configs is settable with a partial dict (paddle behavior)
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and isinstance(value, dict) and \
+                getattr(self, "hybrid_configs", None):
+            merged = dict(self.hybrid_configs)
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+        else:
+            object.__setattr__(self, key, value)
+
+    def to_json(self) -> str:
+        return json.dumps({k: v for k, v in self.__dict__.items()},
+                          default=str, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "DistributedStrategy":
+        st = cls()
+        st.__dict__.update(json.loads(s))
+        return st
+
+    def save_to_prototxt(self, path: str) -> None:  # parity name
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    def load_from_prototxt(self, path: str) -> None:
+        with open(path) as f:
+            self.__dict__.update(json.loads(f.read()))
+
+    def __repr__(self):
+        return "DistributedStrategy(" + self.to_json() + ")"

@@ -1,0 +1,13 @@
+"""Launcher.
+
+Parity surface: python/paddle/distributed/launch/ (``python -m
+paddle.distributed.launch --devices 0,1 train.py`` — per-device worker
+processes, rank/endpoint env assignment, log management). TPU-native
+process model: ONE worker process per host drives all local chips (SPMD), so
+``--devices`` selects visibility rather than forking per device; multi-host
+jobs get one process per host with the paddle env contract
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS) that
+``init_parallel_env`` maps onto jax.distributed.
+"""
+
+from .main import launch_main  # noqa: F401

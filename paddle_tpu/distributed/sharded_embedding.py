@@ -1,0 +1,57 @@
+"""Sharded embedding: the PS → ICI path.
+
+Parity surface (BASELINE config #5 north-star item): the reference trains
+sparse-embedding models (DeepFM) against a brpc parameter server hosting
+``MemorySparseTable`` shards (upstream paddle/fluid/distributed/ps/). The
+TPU replacement per the north star ("PS → ICI allreduce path"): the table is
+a DENSE tensor row-sharded over the mesh; lookups are XLA gathers that ride
+ICI to the owning shard, and gradients reduce-scatter back — no RPC, no
+separate server processes, exact (non-async) updates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.initializer import XavierUniform
+from ..nn.layer import Layer
+from .topology import get_hybrid_communicate_group, global_mesh
+
+__all__ = ["ShardedEmbedding"]
+
+
+class ShardedEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, axis: str = None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=XavierUniform())
+        mesh, ax = self._resolve_axis(axis)
+        if mesh is not None and num_embeddings % int(mesh.shape[ax]) == 0:
+            self.weight._set_data(jax.device_put(
+                self.weight._data, NamedSharding(mesh, P(ax, None))))
+            self.weight.is_distributed = True
+
+    @staticmethod
+    def _resolve_axis(axis):
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            for cand in ([axis] if axis else []) + ["mp", "sharding", "dp"]:
+                if cand in hcg.mesh.axis_names and int(hcg.mesh.shape[cand]) > 1:
+                    return hcg.mesh, cand
+        mesh = global_mesh()
+        ax = axis or mesh.axis_names[0]
+        if int(mesh.shape[ax]) > 1:
+            return mesh, ax
+        return None, None
+
+    def forward(self, ids):
+        return F.embedding(ids, self.weight, padding_idx=self.padding_idx)

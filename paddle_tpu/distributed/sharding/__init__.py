@@ -1,0 +1,20 @@
+"""ZeRO sharding stages 1/2/3.
+
+Parity surface: python/paddle/distributed/sharding/ (``group_sharded_parallel``,
+GroupShardedOptimizerStage2, GroupShardedStage3) and the fleet
+DygraphShardingOptimizer (upstream
+python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/).
+
+TPU-native design (SURVEY.md §7.4): stages are STORAGE SHARDINGS over the
+``sharding`` mesh axis, enforced with NamedSharding on the relevant arrays —
+stage 1 shards optimizer state, stage 2 additionally keeps grads sharded
+through the update, stage 3 shards parameter storage so XLA gathers weights
+just-in-time per layer and reduce-scatters their grads (the DeepSpeed
+gather/release dance becomes GSPMD's job).
+"""
+
+from .sharding_optimizer import (DygraphShardingOptimizer,  # noqa: F401
+                                 group_sharded_parallel, shard_model_params)
+
+__all__ = ["DygraphShardingOptimizer", "group_sharded_parallel",
+           "shard_model_params"]
