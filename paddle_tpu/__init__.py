@@ -66,6 +66,9 @@ from . import linalg  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import device  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import models  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
 
 __version__ = "0.1.0"

@@ -1,0 +1,167 @@
+"""Context / sequence parallelism across the ``sep`` mesh axis.
+
+Parity surface (SURVEY.md §5 long-context items 2-3):
+* Ulysses-style segment parallelism — PaddleNLP's ``sep_group`` alltoall
+  that flips activations between sequence-sharded and head-sharded layouts
+  around attention;
+* ring attention — PaddleNLP ``ring_flash_attention.py``: K/V blocks rotate
+  around the ring with online-softmax accumulation, so sequences longer than
+  one device's memory train exactly.
+
+TPU-native: Ulysses = two sharding constraints (XLA emits the all-to-alls);
+ring attention = ``shard_map`` over the sep axis with ``lax.ppermute``
+K/V rotation — collectives ride ICI and jax AD differentiates through the
+ring (no hand-written backward).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor, apply
+from ...nn.layer import Layer
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["ulysses_attention", "ring_flash_attention", "RingFlashAttention",
+           "split_inputs_sequence_dim"]
+
+_NEG_INF = -1e30
+
+
+def _sep_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None or hcg.get_sep_parallel_world_size() <= 1:
+        return None, None
+    return hcg.mesh, "sep"
+
+
+def split_inputs_sequence_dim(x: Tensor, seq_dim: int = 1) -> Tensor:
+    """Shard the sequence dim of (B, L, ...) over the sep axis (parity:
+    PaddleNLP split_inputs_sequence_dim)."""
+    mesh, axis = _sep_mesh()
+    if mesh is None:
+        return x
+    spec = [None] * x._data.ndim
+    spec[seq_dim] = axis
+    return apply("sep_split", lambda a: jax.lax.with_sharding_constraint(
+        a, NamedSharding(mesh, P(*spec))), x)
+
+
+def ulysses_attention(q: Tensor, k: Tensor, v: Tensor, causal: bool = False,
+                      training: bool = True) -> Tensor:
+    """DeepSpeed-Ulysses pattern on (B, L, H, D) seq-sharded inputs: flip to
+    head-sharded via alltoall, full-sequence attention per device on H/g
+    heads, flip back."""
+    mesh, axis = _sep_mesh()
+    from ...ops.flash_attention import flash_attention
+    if mesh is None:
+        return flash_attention(q, k, v, causal=causal, training=training)
+
+    head_spec = P(None, None, axis, None)
+    seq_spec = P(None, axis, None, None)
+
+    def constrain(t, spec):
+        return apply("sep_constraint",
+                     lambda a: jax.lax.with_sharding_constraint(
+                         a, NamedSharding(mesh, spec)), t)
+
+    q = constrain(q, head_spec)  # alltoall: seq-shard -> head-shard
+    k = constrain(k, head_spec)
+    v = constrain(v, head_spec)
+    out = flash_attention(q, k, v, causal=causal, training=training)
+    return constrain(out, seq_spec)  # alltoall back
+
+
+def _ring_attention_global(q, k, v, mesh: Mesh, axis: str, causal: bool,
+                           sm_scale: float):
+    """q/k/v global (B, L, H, D), L sharded over ``axis``. Pure-jax ring with
+    online softmax; AD-differentiable."""
+    g = int(mesh.shape[axis])
+    spec = P(None, axis, None, None)
+
+    def local_fn(ql, kl, vl):
+        # local (B, Lc, H, D) -> (B, H, Lc, D)
+        qh = jnp.swapaxes(ql, 1, 2).astype(jnp.float32) * sm_scale
+        my = jax.lax.axis_index(axis)
+        b, h, lc, d = qh.shape
+
+        # carries must be device-varying for the scan over ppermute steps
+        def vary(x):
+            return jax.lax.pcast(x, axis, to="varying")
+        m0 = vary(jnp.full((b, h, lc, 1), _NEG_INF, jnp.float32))
+        l0 = vary(jnp.zeros((b, h, lc, 1), jnp.float32))
+        acc0 = vary(jnp.zeros((b, h, lc, d), jnp.float32))
+        perm = [(i, (i + 1) % g) for i in range(g)]
+
+        def step(carry, s):
+            m, l, acc, kc, vc = carry
+            src = (my - s) % g  # which rank's block we currently hold
+            kh = jnp.swapaxes(kc, 1, 2).astype(jnp.float32)
+            vh = jnp.swapaxes(vc, 1, 2).astype(jnp.float32)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh)
+            if causal:
+                q_ids = my * lc + jax.lax.broadcasted_iota(
+                    jnp.int32, (lc, lc), 0)
+                k_ids = src * lc + jax.lax.broadcasted_iota(
+                    jnp.int32, (lc, lc), 1)
+                mask = q_ids[None, None] >= k_ids[None, None]
+                logits = jnp.where(mask, logits, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+            # guard fully-masked rows (exp(-inf - -inf))
+            m_safe = jnp.maximum(m_new, _NEG_INF / 2)
+            p = jnp.exp(logits - m_safe)
+            alpha = jnp.exp(jnp.maximum(m, _NEG_INF / 2) - m_safe)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = alpha * acc + jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+            kc2 = jax.lax.ppermute(kc, axis, perm)
+            vc2 = jax.lax.ppermute(vc, axis, perm)
+            return (m_new, l_new, acc_new, kc2, vc2), None
+
+        (m, l, acc, _, _), _ = jax.lax.scan(
+            step, (m0, l0, acc0, kl, vl), jnp.arange(g))
+        out = acc / jnp.maximum(l, 1e-30)
+        return jnp.swapaxes(out, 1, 2).astype(ql.dtype)
+
+    mapped = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec)
+    return mapped(q, k, v)
+
+
+def ring_flash_attention(q: Tensor, k: Tensor, v: Tensor, causal: bool = True,
+                         group=None, training: bool = True) -> Tensor:
+    """PaddleNLP RingFlashAttention parity. Inputs (B, L, H, D) with L
+    sharded (or shardable) over the sep axis."""
+    mesh, axis = _sep_mesh()
+    if group is not None:
+        mesh, axis = group.mesh, group.axis_name
+    from ...ops.flash_attention import flash_attention
+    if mesh is None or int(mesh.shape[axis]) == 1:
+        return flash_attention(q, k, v, causal=causal, training=training)
+    d = q._data.shape[-1]
+    sm_scale = 1.0 / math.sqrt(d)
+
+    def f(qa, ka, va):
+        spec = P(None, axis, None, None)
+        qa = jax.lax.with_sharding_constraint(qa, NamedSharding(mesh, spec))
+        ka = jax.lax.with_sharding_constraint(ka, NamedSharding(mesh, spec))
+        va = jax.lax.with_sharding_constraint(va, NamedSharding(mesh, spec))
+        return _ring_attention_global(qa, ka, va, mesh, axis, causal, sm_scale)
+
+    return apply("ring_flash_attention", f, q, k, v)
+
+
+class RingFlashAttention(Layer):
+    def __init__(self, causal: bool = True, group=None):
+        super().__init__()
+        self.causal = causal
+        self.group = group
+
+    def forward(self, q, k, v):
+        return ring_flash_attention(q, k, v, causal=self.causal,
+                                    group=self.group, training=self.training)

@@ -1,0 +1,100 @@
+"""Compiled pipeline parallelism over the ``pp`` mesh axis.
+
+The truly-pipelined schedule (SURVEY.md §7 hard-part #1): for a UNIFORM stack
+of blocks (the transformer case), per-stage parameters are stacked along a
+leading axis sharded over ``pp``; one ``shard_map`` program runs the GPipe
+schedule — a ``lax.scan`` over M + S - 1 ticks where every stage computes a
+different microbatch each tick and activations hop stages with
+``lax.ppermute``. XLA overlaps the ppermute with the next tick's compute
+(async collective permute on ICI), which is exactly what the reference's
+p2p_communication + 1F1B scheduling achieves with NCCL streams. Backward is
+jax AD through the scan; ``jax.checkpoint`` on the stage body gives 1F1B's
+activation-memory profile (only per-tick boundaries are stored).
+
+Use through ``pipelined_forward`` (functional) or wire stacked params from a
+PipelineLayer of identical LayerDescs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["pipelined_forward", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params, mesh: Mesh, axis: str = "pp"):
+    """Stack a list of S per-stage param pytrees along a new leading axis and
+    shard it over ``axis``."""
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0),
+                                     *per_stage_params)
+
+    def place(a):
+        spec = P(axis, *([None] * (a.ndim - 1)))
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, stacked)
+
+
+def pipelined_forward(stage_fn: Callable, stacked_params, micro_inputs,
+                      mesh: Mesh, axis: str = "pp", remat: bool = True):
+    """Run the GPipe schedule.
+
+    stage_fn(stage_params, x) -> y       one stage's computation
+    stacked_params: pytree, leaves (S, ...) sharded over ``axis``
+    micro_inputs:   (M, B_mb, ...) microbatched input (replicated)
+    returns         (M, B_mb, ...) outputs of the last stage
+    """
+    S = int(mesh.shape[axis])
+    M = micro_inputs.shape[0]
+    T = M + S - 1
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def local_fn(params_local, micro):
+        # params_local leaves: (1, ...) — this stage's slice
+        p_mine = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        stage = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def vary(x):
+            return jax.lax.pcast(x, axis, to="varying")
+
+        act0 = vary(jnp.zeros_like(micro[0]))
+        out_buf0 = vary(jnp.zeros((M,) + micro.shape[1:], micro.dtype))
+
+        def tick(carry, t):
+            act_in, out_buf = carry
+            # stage 0 ingests microbatch t; later stages use the hopped act
+            mb_idx = jnp.clip(t, 0, M - 1)
+            x = jnp.where(stage == 0, micro[mb_idx], act_in)
+            y = body(p_mine, x)
+            # last stage records microbatch (t - S + 1) when it's valid
+            rec = t - (S - 1)
+            valid = jnp.logical_and(stage == S - 1,
+                                    jnp.logical_and(rec >= 0, rec < M))
+            out_buf = jax.lax.cond(
+                valid,
+                lambda ob: jax.lax.dynamic_update_index_in_dim(
+                    ob, y, jnp.clip(rec, 0, M - 1), axis=0),
+                lambda ob: ob, out_buf)
+            act_next = jax.lax.ppermute(y, axis, perm)
+            return (act_next, out_buf), None
+
+        (_, out_buf), _ = jax.lax.scan(tick, (act0, out_buf0), jnp.arange(T))
+        # only the last stage holds real outputs; broadcast them to every
+        # stage so the replicated out_spec is consistent
+        out_buf = jnp.where(stage == S - 1, out_buf, jnp.zeros_like(out_buf))
+        return jax.lax.psum(out_buf, axis)
+
+    n_param_dims = jax.tree_util.tree_map(lambda a: P(axis, *([None] * (a.ndim - 1))),
+                                          stacked_params)
+    mapped = jax.shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(n_param_dims, P()),
+        out_specs=P())
+    return mapped(stacked_params, micro_inputs)

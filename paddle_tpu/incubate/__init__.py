@@ -1,0 +1,9 @@
+"""``paddle.incubate`` capability surface (subset that the zoos use)."""
+
+from . import moe  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+
+
+class distributed:  # namespace parity: paddle.incubate.distributed.models.moe
+    class models:
+        from . import moe

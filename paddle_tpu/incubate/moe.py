@@ -1,0 +1,172 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Parity surface: paddle.incubate.distributed.models.moe (``MoELayer``,
+``GShardGate``, ``SwitchGate``, ``NaiveGate``; fused dispatch CUDA ops
+number_count/assign_pos/limit_by_capacity — upstream
+python/paddle/incubate/distributed/models/moe/ + paddle/fluid/operators moe
+ops).
+
+TPU-native design (SURVEY.md §2.5 item 10): token dispatch is the dense
+GShard einsum formulation — (tokens, experts, capacity) one-hot dispatch and
+combine tensors; no scatter kernels, XLA fuses the einsums onto the MXU. With
+an expert-parallel axis active, the (E, C, M) dispatched tensor gets a
+sharding constraint on E and XLA emits the all-to-all (the reference's
+Global_Scatter/Gather brpc+NCCL ops collapse into GSPMD)."""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, apply
+from ..nn import functional as F
+from ..nn.container import LayerList
+from ..nn.layer import Layer
+from ..distributed.topology import get_hybrid_communicate_group
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+class NaiveGate(Layer):
+    """Top-k softmax gate."""
+
+    def __init__(self, d_model: int, num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.5):
+        super().__init__()
+        from ..nn.common import Linear
+        self.gate_proj = Linear(d_model, num_experts, bias_attr=False)
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.l_aux: Optional[Tensor] = None
+
+    def capacity(self, num_tokens: int) -> int:
+        c = int(math.ceil(self.top_k * self.capacity_factor * num_tokens
+                          / self.num_experts))
+        return max(c, 4)
+
+    def forward(self, x: Tensor):
+        """x: (S, M) -> (dispatch (S,E,C), combine (S,E,C), aux loss)."""
+        logits = self.gate_proj(x)
+        s = x.shape[0]
+        cap = self.capacity(s)
+        e, k = self.num_experts, self.top_k
+
+        def route(lg):
+            probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)  # (S,E)
+            topv, topi = jax.lax.top_k(probs, k)  # (S,k)
+            # position of each routed token within its expert queue
+            onehot = jax.nn.one_hot(topi, e, dtype=jnp.float32)  # (S,k,E)
+            # priority: first choice before second choice (gshard)
+            flat = onehot.transpose(1, 0, 2).reshape(k * lg.shape[0], e)
+            pos_in_expert = jnp.cumsum(flat, axis=0) - flat  # (k*S, E)
+            pos = jnp.sum(flat * pos_in_expert, axis=-1).reshape(k, lg.shape[0])
+            pos = pos.transpose(1, 0)  # (S,k)
+            keep = pos < cap
+            gates = topv * keep  # drop overflow
+            denom = jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+            gates = gates / denom
+            cap_onehot = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                        dtype=jnp.float32)  # (S,k,C)
+            dispatch = jnp.einsum("ske,skc,sk->sec", onehot, cap_onehot,
+                                  keep.astype(jnp.float32))
+            combine = jnp.einsum("ske,skc,sk->sec", onehot, cap_onehot, gates)
+            # gshard aux loss: mean_prob * token_fraction per expert
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(onehot[:, 0, :], axis=0)
+            aux = jnp.sum(me * ce) * e
+            return dispatch, combine, aux
+
+        dispatch, combine, aux = apply("moe_gate", route, logits)
+        self.l_aux = aux
+        return dispatch, combine, aux
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.5,
+                 group=None, **kw):
+        super().__init__(d_model, num_experts, top_k=2,
+                         capacity_factor=capacity_factor)
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_experts, top_k=1, capacity_factor=1.25,
+                 group=None, **kw):
+        super().__init__(d_model, num_experts, top_k=1,
+                         capacity_factor=capacity_factor)
+
+
+def _ep_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return None, None
+    for axis in ("mp", "sharding", "dp"):
+        try:
+            if int(hcg.mesh.shape[axis]) > 1:
+                return hcg.mesh, axis
+        except KeyError:
+            continue
+    return None, None
+
+
+class MoELayer(Layer):
+    """Parity: paddle.incubate.distributed.models.moe.MoELayer.
+
+    ``experts`` is a list/LayerList of expert modules (each maps (C, M) ->
+    (C, M')). The dispatched tensor (E, C, M) carries an expert-axis sharding
+    constraint when an expert-parallel mesh axis is active.
+    """
+
+    def __init__(self, d_model: int, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval: int = 0, top_k: int = 2,
+                 **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = experts if isinstance(experts, LayerList) \
+            else LayerList(list(experts))
+        num_experts = len(self.experts)
+        if gate is None or isinstance(gate, dict):
+            cfg = gate or {}
+            gtype = cfg.get("type", "gshard")
+            cls = {"gshard": GShardGate, "switch": SwitchGate,
+                   "naive": NaiveGate}[gtype]
+            self.gate = cls(d_model, num_experts,
+                            top_k=cfg.get("top_k", top_k),
+                            capacity_factor=cfg.get("capacity_factor", 1.5))
+        else:
+            self.gate = gate
+        self.l_aux: Optional[Tensor] = None
+
+    def forward(self, x: Tensor) -> Tensor:
+        orig_shape = x.shape
+        from ..ops.manipulation import reshape
+        flat = reshape(x, [-1, self.d_model])  # (S, M)
+        dispatch, combine, aux = self.gate(flat)
+        self.l_aux = aux
+
+        # (S, E, C) x (S, M) -> (E, C, M)
+        expert_in = apply("moe_dispatch",
+                          lambda d, t: jnp.einsum("sec,sm->ecm", d, t),
+                          dispatch, flat)
+        mesh, axis = _ep_mesh()
+        if mesh is not None and len(self.experts) % int(mesh.shape[axis]) == 0:
+            expert_in = apply(
+                "moe_ep_constraint",
+                lambda a: jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, P(axis, None, None))), expert_in)
+
+        outs = []
+        for i, expert in enumerate(self.experts):
+            outs.append(expert(expert_in[i]))
+        from ..ops.manipulation import stack
+        expert_out = stack(outs, axis=0)  # (E, C, M')
+
+        out = apply("moe_combine",
+                    lambda c, eo: jnp.einsum("sec,ecm->sm", c, eo),
+                    combine, expert_out)
+        new_shape = orig_shape[:-1] + [out.shape[-1]]
+        return reshape(out, new_shape)

@@ -30,6 +30,7 @@ from ..ops.loss_ops import (  # noqa: F401
 )
 from ..ops.manipulation import pad  # noqa: F401
 from ..ops.indexing import one_hot  # noqa: F401
+from ..ops.flash_attention import flash_attention  # noqa: F401
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):

@@ -1,0 +1,58 @@
+"""Compiled (shard_map+ppermute) pipeline schedule vs serial reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.fleet.tpu_pipeline import (pipelined_forward,
+                                                       stack_stage_params)
+
+S, M, B, D = 4, 8, 2, 16
+
+
+def _setup():
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+    rng = np.random.default_rng(0)
+    per_stage = [{"w": jnp.asarray(rng.normal(0, 0.3, (D, D)).astype(np.float32)),
+                  "b": jnp.asarray(rng.normal(0, 0.1, (D,)).astype(np.float32))}
+                 for _ in range(S)]
+    micro = jnp.asarray(rng.normal(0, 1, (M, B, D)).astype(np.float32))
+    return mesh, per_stage, micro
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def test_pipelined_forward_matches_serial():
+    mesh, per_stage, micro = _setup()
+    stacked = stack_stage_params(per_stage, mesh, "pp")
+    out = pipelined_forward(_stage_fn, stacked, micro, mesh, "pp")
+    ref = micro
+    for p in per_stage:
+        ref = _stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipelined_grad_matches_serial():
+    mesh, per_stage, micro = _setup()
+    stacked = stack_stage_params(per_stage, mesh, "pp")
+
+    def loss_fn(params, mi):
+        return jnp.sum(pipelined_forward(_stage_fn, params, mi, mesh, "pp") ** 2)
+
+    g = jax.grad(loss_fn)(stacked, micro)
+
+    def ref_loss(params_list, mi):
+        y = mi
+        for p in params_list:
+            y = _stage_fn(p, y)
+        return jnp.sum(y ** 2)
+
+    gref = jax.grad(ref_loss)(per_stage, micro)
+    for s in range(S):
+        np.testing.assert_allclose(np.asarray(g["w"][s]),
+                                   np.asarray(gref[s]["w"]), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(g["b"][s]),
+                                   np.asarray(gref[s]["b"]), atol=1e-4)
