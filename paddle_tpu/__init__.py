@@ -69,6 +69,7 @@ from . import device  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import models  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
 
 __version__ = "0.1.0"

@@ -376,6 +376,11 @@ def _autocast_targets(op_name: str, arrays):
     return out if any(t is not None for t in out) else None
 
 
+# Set by paddle_tpu.profiler while a Profiler window is recording; called as
+# hook(op_name, t0, t1) after each dispatch. None ⇒ zero overhead.
+_op_profile_hook: Optional[Callable[[str, float, float], None]] = None
+
+
 def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
           differentiable: bool = True, amp: bool = True, **static_kwargs) -> Any:
     """Dispatch one op: the TPU analogue of ad_func → Phi API → kernel.
@@ -384,6 +389,22 @@ def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
     autocast applied, and — when grad is enabled and some input requires grad
     — the op is linearized with ``jax.vjp`` and a ``GradNode`` recorded.
     """
+    if _op_profile_hook is not None:
+        import time as _time
+        _t0 = _time.perf_counter()
+        try:
+            return _apply_impl(op_name, fn, *tensor_inputs,
+                               differentiable=differentiable, amp=amp,
+                               **static_kwargs)
+        finally:
+            _op_profile_hook(op_name, _t0, _time.perf_counter())
+    return _apply_impl(op_name, fn, *tensor_inputs,
+                       differentiable=differentiable, amp=amp, **static_kwargs)
+
+
+def _apply_impl(op_name: str, fn: Callable, *tensor_inputs: Tensor,
+                differentiable: bool = True, amp: bool = True,
+                **static_kwargs) -> Any:
     ts = _tracing.trace_state()
     arrays = []
     for t in tensor_inputs:

@@ -149,3 +149,103 @@ def is_compiled_with_tpu() -> bool:
 
 def default_jax_device() -> jax.Device:
     return current_place().jax_device()
+
+
+# ---------------------------------------------------------------------------
+# Device memory stats (parity: paddle.device.cuda.max_memory_allocated & co,
+# backed by the allocator StatAllocator counters in the reference — here by
+# PJRT per-device memory_stats(), which libtpu/XLA maintain natively).
+# ---------------------------------------------------------------------------
+
+def _memory_stats(device: Union[str, Place, None] = None) -> dict:
+    if device is None:
+        dev = default_jax_device()
+    elif isinstance(device, Place):
+        dev = device.jax_device()
+    else:
+        dev = Place(*_parse_device_str(device)).jax_device() if isinstance(
+            device, str) else device
+    try:
+        return dev.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def _parse_device_str(s: str):
+    if ":" in s:
+        kind, idx = s.split(":", 1)
+        return kind, int(idx)
+    return s, 0
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently in use on the device (PJRT ``bytes_in_use``)."""
+    return int(_memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes in use (PJRT ``peak_bytes_in_use``)."""
+    return int(_memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool (``bytes_reserved`` /
+    ``pool_bytes`` when the backend reports it; falls back to in-use)."""
+    st = _memory_stats(device)
+    return int(st.get("bytes_reserved", st.get("pool_bytes",
+                                               st.get("bytes_in_use", 0))))
+
+
+def max_memory_reserved(device=None) -> int:
+    st = _memory_stats(device)
+    return int(st.get("peak_bytes_reserved", st.get(
+        "largest_alloc_size", st.get("peak_bytes_in_use", 0))))
+
+
+def empty_cache() -> None:
+    """Parity no-op: PJRT owns its BFC pool; there is no user-facing cache
+    flush on TPU (documented divergence)."""
+
+
+class _DeviceStatsNS:
+    """Namespace so both ``paddle.device.tpu.*`` and ``paddle.device.cuda.*``
+    spellings resolve (model-zoo code calls the latter unconditionally)."""
+
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+
+    @staticmethod
+    def device_count() -> int:
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None) -> None:
+        # XLA dispatch is async. TPU executes enqueued programs in order per
+        # core, so enqueueing a trivial program on each local device and
+        # blocking on its result drains the pipeline (effects_barrier alone
+        # only waits for side-effecting computations).
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+        devs = ([default_jax_device()] if device is None
+                else [device.jax_device() if isinstance(device, Place)
+                      else default_jax_device()])
+        for d in devs:
+            jax.block_until_ready(
+                jax.jit(lambda x: x + 1, device=d)(jnp.zeros(())))
+
+
+tpu = _DeviceStatsNS()
+cuda = _DeviceStatsNS()
+xpu = _DeviceStatsNS()
+
+
+def synchronize(device=None) -> None:
+    _DeviceStatsNS.synchronize(device)

@@ -89,14 +89,14 @@ class NaiveGate(Layer):
 class GShardGate(NaiveGate):
     def __init__(self, d_model, num_experts, top_k=2, capacity_factor=1.5,
                  group=None, **kw):
-        super().__init__(d_model, num_experts, top_k=2,
+        super().__init__(d_model, num_experts, top_k=top_k,
                          capacity_factor=capacity_factor)
 
 
 class SwitchGate(NaiveGate):
     def __init__(self, d_model, num_experts, top_k=1, capacity_factor=1.25,
                  group=None, **kw):
-        super().__init__(d_model, num_experts, top_k=1,
+        super().__init__(d_model, num_experts, top_k=top_k,
                          capacity_factor=capacity_factor)
 
 

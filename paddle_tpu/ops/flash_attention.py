@@ -39,15 +39,20 @@ _NEG_INF = -1e30
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                      sm_scale: float, kv_len: int):
+                      sm_scale: float, kv_len: int, q_len: int):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
     Refs: q (1, Bq, D), k/v (1, Lk, D) in VMEM; o (1, Bq, D).
+
+    Causal masking is bottom-right aligned (row i attends keys
+    ``k <= i + kv_len - q_len``), matching ``_xla_attention`` and the
+    KV-cache decode convention — lq != lk must agree with the backward path.
     """
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (Bq, D)
     bq = q.shape[0]
     qi = pl.program_id(1)  # q-block index
     q_offset = qi * bq
+    causal_shift = kv_len - q_len  # bottom-right alignment offset
 
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
@@ -61,7 +66,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         v_blk = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # (Bq, Bk)
         if causal:
-            q_ids = q_offset + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+            q_ids = q_offset + causal_shift + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
             k_ids = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
@@ -75,7 +81,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
 
     if causal:
         # skip fully-masked K blocks beyond this Q block
-        last_kb = jnp.minimum((q_offset + bq + block_k - 1) // block_k, num_kb)
+        last_kb = jnp.clip(
+            (q_offset + bq + causal_shift + block_k - 1) // block_k, 0, num_kb)
     else:
         last_kb = num_kb
     m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
@@ -95,7 +102,8 @@ def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int,
     vf = v.reshape(b * h, lk, d)
 
     kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
-                               causal=causal, sm_scale=sm_scale, kv_len=lk)
+                               causal=causal, sm_scale=sm_scale, kv_len=lk,
+                               q_len=lq)
     grid = (b * h, lq // block_q)
     out = pl.pallas_call(
         kernel,
@@ -118,7 +126,12 @@ def _xla_attention(q, k, v, causal: bool, sm_scale: float):
         ql, kl = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
         logits = jnp.where(mask, logits, _NEG_INF)
-    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        p_raw = jax.nn.softmax(logits, axis=-1)
+        # fully-masked rows (lq > lk bottom-right) emit 0, flash convention
+        p_raw = jnp.where(mask.any(-1)[..., None], p_raw, 0.0)
+    else:
+        p_raw = jax.nn.softmax(logits, axis=-1)
+    p = p_raw.astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
@@ -148,11 +161,56 @@ def _flash_fwd(q, k, v, causal, sm_scale):
     return out, (q, k, v)
 
 
+def _chunked_attention(q, k, v, causal: bool, sm_scale: float, block: int):
+    """Blockwise attention over Q chunks with per-chunk remat.
+
+    Same math (and bottom-right causal alignment) as ``_xla_attention`` but
+    peak memory is O(block × Lk) per (B, H): the lax.map body runs one Q block
+    at a time and ``jax.checkpoint`` drops its logits for the backward,
+    which recomputes them blockwise — this is what makes the backward of the
+    flash path O(L) memory instead of materializing the (Lq, Lk) matrix.
+    """
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    nb = lq // block
+    qb = jnp.moveaxis(q.reshape(b, h, nb, block, d), 2, 0)  # (nb,B,H,blk,D)
+    offsets = jnp.arange(nb, dtype=jnp.int32) * block
+    shift = lk - lq
+
+    def one(args):
+        qi, off = args  # (B,H,blk,D), scalar
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qi, k).astype(
+            jnp.float32) * sm_scale
+        if causal:
+            rows = off + shift + jax.lax.broadcasted_iota(
+                jnp.int32, (block, lk), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (block, lk), 1)
+            keep = rows >= cols
+            logits = jnp.where(keep, logits, _NEG_INF)
+            p_raw = jax.nn.softmax(logits, axis=-1)
+            p_raw = jnp.where(keep.any(-1)[..., None], p_raw, 0.0)
+        else:
+            p_raw = jax.nn.softmax(logits, axis=-1)
+        p = p_raw.astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    out = jax.lax.map(jax.checkpoint(one), (qb, offsets))  # (nb,B,H,blk,D)
+    return jnp.moveaxis(out, 0, 2).reshape(b, h, lq, d)
+
+
 def _flash_bwd(causal, sm_scale, res, g):
     q, k, v = res
-    # flash-style rematerialized backward via jax AD of the reference form
-    _, vjp = jax.vjp(lambda a, b, c: _xla_attention(a, b, c, causal, sm_scale),
-                     q, k, v)
+    # flash-style rematerialized backward: AD through the blockwise form so
+    # the (Lq, Lk) matrix is never materialized (O(block x Lk) peak)
+    block = int(_flags.flag("flash_block_q"))
+    lq = q.shape[2]
+    if lq % min(block, lq) == 0:
+        block = min(block, lq)
+        fn = lambda a, b, c: _chunked_attention(a, b, c, causal, sm_scale,
+                                                block)
+    else:
+        fn = lambda a, b, c: _xla_attention(a, b, c, causal, sm_scale)
+    _, vjp = jax.vjp(fn, q, k, v)
     return vjp(g)
 
 

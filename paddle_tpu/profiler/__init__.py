@@ -1,0 +1,305 @@
+"""Profiler: API-parity tracing/profiling over the TPU-native stack.
+
+Parity surface (reference: python/paddle/profiler/, C++ HostTracer with
+RecordEvent RAII markers in paddle/fluid/platform/profiler/ and CUPTI-based
+CudaTracer — see SURVEY.md §5). TPU-native design:
+
+- **Host ranges** — ``RecordEvent`` markers plus an op-dispatch hook installed
+  into ``paddle_tpu.core.tensor.apply`` (the single dispatch seam, the
+  analogue of the reference's ad_func path that its RecordEvent markers
+  instrument) feed an in-process host tracer buffer.
+- **Device traces** — libtpu/XLA already emit device traces through
+  ``jax.profiler``; when ``ProfilerTarget.TPU`` is requested and a trace dir
+  is configured, the Profiler brackets the record window with
+  ``jax.profiler.start_trace/stop_trace`` (TensorBoard/XProf consumable).
+- **Export** — chrome-trace JSON of the host ranges; ``summary()`` renders
+  the op-level aggregation table (reference: op summary view).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = [
+    "ProfilerTarget", "ProfilerState", "Profiler", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class TracerEventType(Enum):
+    Operator = 0
+    UserDefined = 1
+    Forward = 2
+    Backward = 3
+    Optimization = 4
+    Dataloader = 5
+    ProfileStep = 6
+    Communication = 7
+
+
+class _HostTracer:
+    """Process-global buffer of completed host ranges."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self._lock = threading.Lock()
+        self.events: List[Dict[str, Any]] = []
+
+    def emit(self, name: str, t0: float, t1: float,
+             event_type: "TracerEventType") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append({
+                "name": name, "ts": t0, "dur": t1 - t0,
+                "tid": threading.get_ident(), "type": event_type.name,
+            })
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            ev, self.events = self.events, []
+        return ev
+
+
+_tracer = _HostTracer()
+
+
+def _op_hook(op_name: str, t0: float, t1: float) -> None:
+    _tracer.emit(op_name, t0, t1, TracerEventType.Operator)
+
+
+class RecordEvent:
+    """RAII host-range marker (reference: platform::RecordEvent).
+
+    Usable as a context manager or via explicit ``begin()``/``end()``::
+
+        with profiler.RecordEvent("data_augment"):
+            ...
+    """
+
+    def __init__(self, name: str,
+                 event_type: TracerEventType = TracerEventType.UserDefined):
+        self.name = name
+        self.event_type = event_type
+        self._t0: Optional[float] = None
+
+    def begin(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def end(self) -> None:
+        if self._t0 is not None:
+            _tracer.emit(self.name, self._t0, time.perf_counter(),
+                         self.event_type)
+            self._t0 = None
+
+    def __enter__(self) -> "RecordEvent":
+        self.begin()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.end()
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0
+                   ) -> Callable[[int], ProfilerState]:
+    """Step-indexed window scheduler, same contract as the reference's
+    ``paddle.profiler.make_scheduler``."""
+    period = closed + ready + record
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None
+                          ) -> Callable[["Profiler"], None]:
+    """``on_trace_ready`` factory writing chrome-trace JSON per window."""
+
+    def handler(prof: "Profiler") -> None:
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_step{prof.step_num}.json")
+        prof.export_chrome_tracing(path)
+
+    return handler
+
+
+def load_profiler_result(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """Parity: ``paddle.profiler.Profiler``.
+
+    ``timer_only=True`` skips tracing and only keeps step timing (the
+    reference's cheap benchmark mode).
+    """
+
+    def __init__(self, *,
+                 targets: Optional[Sequence[ProfilerTarget]] = None,
+                 scheduler: Optional[Callable[[int], ProfilerState]] = None,
+                 on_trace_ready: Optional[Callable[["Profiler"], None]] = None,
+                 trace_dir: Optional[str] = None,
+                 timer_only: bool = False,
+                 record_shapes: bool = False):
+        self.targets = list(targets or [ProfilerTarget.CPU])
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.trace_dir = trace_dir
+        self.timer_only = timer_only
+        self.record_shapes = record_shapes
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._events: List[Dict[str, Any]] = []
+        self._step_times: List[float] = []
+        self._step_t0: Optional[float] = None
+        self._device_tracing = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.state = (self.scheduler(self.step_num) if self.scheduler
+                      else ProfilerState.RECORD)
+        self._apply_state()
+        self._step_t0 = time.perf_counter()
+
+    def stop(self) -> None:
+        if self._step_t0 is not None:
+            self._step_times.append(time.perf_counter() - self._step_t0)
+            self._step_t0 = None
+        self._harvest()
+        self._set_tracing(False)
+        if self.state in (ProfilerState.RECORD,
+                          ProfilerState.RECORD_AND_RETURN):
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.state = ProfilerState.CLOSED
+
+    def step(self) -> None:
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._step_times.append(now - self._step_t0)
+        self._step_t0 = now
+        prev = self.state
+        self.step_num += 1
+        self.state = (self.scheduler(self.step_num) if self.scheduler
+                      else ProfilerState.RECORD)
+        if prev == ProfilerState.RECORD_AND_RETURN:
+            self._harvest()
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self._apply_state()
+
+    def __enter__(self) -> "Profiler":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- internals ---------------------------------------------------------
+    def _apply_state(self) -> None:
+        recording = (not self.timer_only and self.state in
+                     (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN))
+        self._set_tracing(recording)
+
+    def _set_tracing(self, on: bool) -> None:
+        _tracer.enabled = on
+        from ..core import tensor as _tensor_mod
+        _tensor_mod._op_profile_hook = _op_hook if on else None
+        wants_device = any(t in (ProfilerTarget.TPU, ProfilerTarget.GPU,
+                                 ProfilerTarget.CUSTOM_DEVICE)
+                           for t in self.targets)
+        if wants_device and self.trace_dir:
+            import jax
+            if on and not self._device_tracing:
+                try:
+                    jax.profiler.start_trace(self.trace_dir)
+                    self._device_tracing = True
+                except Exception:
+                    pass
+            elif not on and self._device_tracing:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                self._device_tracing = False
+
+    def _harvest(self) -> None:
+        self._events.extend(_tracer.drain())
+
+    # -- results -----------------------------------------------------------
+    def export_chrome_tracing(self, path: str) -> None:
+        trace = [{
+            "name": e["name"], "ph": "X", "pid": os.getpid(),
+            "tid": e["tid"], "ts": e["ts"] * 1e6, "dur": e["dur"] * 1e6,
+            "cat": e["type"],
+        } for e in self._events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": trace}, f)
+
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._events)
+
+    def benchmark_summary(self) -> Dict[str, float]:
+        times = self._step_times or [0.0]
+        return {
+            "steps": len(self._step_times),
+            "avg_step_s": sum(times) / len(times),
+            "min_step_s": min(times),
+            "max_step_s": max(times),
+        }
+
+    def summary(self, sorted_by: str = "total", max_rows: int = 30) -> str:
+        """Op-level aggregation table (reference: summary op view)."""
+        agg: Dict[str, List[float]] = {}
+        for e in self._events:
+            agg.setdefault(e["name"], []).append(e["dur"])
+        rows = [(name, len(ds), sum(ds), sum(ds) / len(ds), max(ds))
+                for name, ds in agg.items()]
+        key = {"total": 2, "calls": 1, "avg": 3, "max": 4}.get(sorted_by, 2)
+        rows.sort(key=lambda r: r[key], reverse=True)
+        out = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"
+               f"{'Avg(ms)':>12}{'Max(ms)':>12}"]
+        out.append("-" * 84)
+        for name, calls, tot, avg, mx in rows[:max_rows]:
+            out.append(f"{name[:39]:<40}{calls:>8}{tot * 1e3:>12.3f}"
+                       f"{avg * 1e3:>12.3f}{mx * 1e3:>12.3f}")
+        bench = self.benchmark_summary()
+        out.append("-" * 84)
+        out.append(f"steps: {bench['steps']}  "
+                   f"avg step: {bench['avg_step_s'] * 1e3:.3f} ms")
+        return "\n".join(out)

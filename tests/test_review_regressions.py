@@ -98,3 +98,51 @@ def test_spectral_norm_buffers_advance():
     top = np.linalg.svd(w.numpy(), compute_uv=False)[0]
     ratio = np.linalg.svd(out.numpy(), compute_uv=False)[0]
     np.testing.assert_allclose(ratio, 1.0, rtol=1e-2)
+
+
+# -- round-1 session-2 review findings ---------------------------------------
+
+def test_flash_causal_alignment_lq_ne_lk():
+    """Pallas, XLA, and chunked-backward paths must agree on bottom-right
+    causal alignment for lq != lk (KV-cache decode / cross-window)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.ops import flash_attention as fa
+
+    rng = np.random.default_rng(0)
+    for (lq, lk) in [(32, 64), (64, 32), (16, 128)]:
+        q = jnp.asarray(rng.normal(size=(1, 2, lq, 16)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 2, lk, 16)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 2, lk, 16)).astype(np.float32))
+        for causal in (True, False):
+            out_p = fa._pallas_flash(q, k, v, causal, 0.25, 16, 16, True)
+            out_x = fa._xla_attention(q, k, v, causal, 0.25)
+            out_c = fa._chunked_attention(q, k, v, causal, 0.25, 16)
+            assert float(jnp.abs(out_p - out_x).max()) < 1e-5
+            assert float(jnp.abs(out_c - out_x).max()) < 1e-5
+
+
+def test_flash_backward_matches_dense_grad():
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.ops import flash_attention as fa
+
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 2, 32, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 2, 64, 16)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(1, 2, 64, 16)).astype(np.float32))
+    g1 = jax.grad(lambda a, b, c: jnp.sum(
+        fa._flash_core(a, b, c, True, 0.25) ** 2), (0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda a, b, c: jnp.sum(
+        fa._xla_attention(a, b, c, True, 0.25) ** 2), (0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert float(jnp.abs(a - b).max()) < 2e-4
+
+
+def test_moe_gate_respects_top_k():
+    from paddle_tpu.incubate.moe import GShardGate, SwitchGate
+
+    assert GShardGate(8, 4, top_k=4).top_k == 4
+    assert SwitchGate(8, 4).top_k == 1
+    assert SwitchGate(8, 4, top_k=2).top_k == 2
