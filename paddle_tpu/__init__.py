@@ -70,6 +70,13 @@ from . import incubate  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from .static import enable_static, disable_static  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
+from . import hapi  # noqa: F401,E402
+from .hapi import Model, summary  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
 
 __version__ = "0.1.0"

@@ -380,6 +380,11 @@ def _autocast_targets(op_name: str, arrays):
 # hook(op_name, t0, t1) after each dispatch. None ⇒ zero overhead.
 _op_profile_hook: Optional[Callable[[str, float, float], None]] = None
 
+# Set by paddle_tpu.static while static-graph mode is capturing; called as
+# hook(op_name, pure_fn, tensor_inputs, out_tensors) after each dispatch so
+# the Program can record a replayable op node. None ⇒ zero overhead.
+_op_graph_hook: Optional[Callable] = None
+
 
 def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
           differentiable: bool = True, amp: bool = True, **static_kwargs) -> Any:
@@ -452,6 +457,9 @@ def _apply_impl(op_name: str, fn: Callable, *tensor_inputs: Tensor,
     else:
         for oa in out_arrays:
             out_tensors.append(Tensor(oa, stop_gradient=True))
+
+    if _op_graph_hook is not None:
+        _op_graph_hook(op_name, f, tensor_inputs, tuple(out_tensors))
 
     if multi:
         return tuple(out_tensors)

@@ -1,0 +1,295 @@
+"""High-level API: ``paddle.Model`` — Keras-like fit/evaluate/predict.
+
+Parity surface: python/paddle/hapi/model.py (Model, prepare/fit/evaluate/
+predict/train_batch/eval_batch/predict_batch/save/load/summary) and
+python/paddle/hapi/callbacks.py. The training loop is eager by design
+(matching the reference's dygraph loop); performance-critical users wrap
+their own step in ``paddle.jit.to_static``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from . import callbacks as callbacks_mod
+from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+
+__all__ = ["Model", "summary"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_float(x):
+    return float(np.asarray(x.numpy() if hasattr(x, "numpy") else x).ravel()[0])
+
+
+class Model:
+    """Wraps an ``nn.Layer`` with a training/eval/predict loop."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Any] = []
+        self._amp_level = None
+        self.stop_training = False
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        self._amp_level = None
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            else:
+                self._amp_level = amp_configs.get("level", "O1")
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+    # -- single-batch ops --------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _as_list(outputs)
+        lbls = _as_list(labels)
+        if self._loss is None:
+            raise RuntimeError("Model.prepare(loss=...) was not called")
+        return self._loss(*outs, *lbls)
+
+    def _forward(self, inputs):
+        import paddle_tpu as paddle
+
+        if self._amp_level:
+            with paddle.amp.auto_cast(level=self._amp_level,
+                                      dtype="bfloat16"):
+                return self.network(*_as_list(inputs))
+        return self.network(*_as_list(inputs))
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One eager train step; returns ([loss_value], [metric_results])."""
+        self.network.train()
+        outputs = self._forward(inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return [_to_float(loss)], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        import paddle_tpu as paddle
+
+        self.network.eval()
+        with paddle.no_grad():
+            outputs = self._forward(inputs)
+            loss = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return [_to_float(loss)], metrics
+
+    def predict_batch(self, inputs):
+        import paddle_tpu as paddle
+
+        self.network.eval()
+        with paddle.no_grad():
+            out = self._forward(inputs)
+        return [o.numpy() for o in _as_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        results = []
+        pred = _as_list(outputs)[0]
+        lbl = _as_list(labels)[0] if labels is not None else None
+        for m in self._metrics:
+            inputs = m.compute(pred, lbl)
+            if not isinstance(inputs, (list, tuple)):
+                inputs = (inputs,)
+            m.update(*inputs)
+            results.append(m.accumulate())
+        return results
+
+    # -- loops -------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        from ..io import DataLoader, Dataset
+
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    def _metric_logs(self, logs):
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, str):
+                names, vals = [names], [vals]
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers, False)
+        cbks = CallbackList(_as_list(callbacks))
+        if verbose:
+            cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir:
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbks.set_model(self)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps,
+                         "verbose": verbose, "save_dir": save_dir,
+                         "metrics": ["loss"]})
+        self.stop_training = False
+
+        cbks.on_train_begin()
+        history: Dict[str, List[Any]] = {"loss": []}
+        logs: Dict[str, Any] = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = self._split_batch(batch)
+                losses, _ = self.train_batch(ins, lbls)
+                logs = {"loss": losses[0]}
+                self._metric_logs(logs)
+                cbks.on_train_batch_end(step, logs)
+                if self.stop_training:
+                    break
+            history["loss"].append(logs.get("loss"))
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+                for k, v in eval_logs.items():
+                    history.setdefault("eval_" + k, []).append(v)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return history
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 1:  # unlabeled (predict-style) dataset
+                return batch[0], None
+            if len(batch) == 2:
+                return batch[0], batch[1]
+            return list(batch[:-1]), batch[-1]
+        return batch, None
+
+    def _run_eval(self, loader, cbks: CallbackList) -> Dict[str, Any]:
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        total, n = 0.0, 0
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbls = self._split_batch(batch)
+            losses, _ = self.eval_batch(ins, lbls)
+            total += losses[0]
+            n += 1
+            cbks.on_eval_batch_end(step, {"loss": losses[0]})
+        logs: Dict[str, Any] = {"loss": total / max(n, 1)}
+        self._metric_logs(logs)
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers,
+                                   False)
+        cbks = CallbackList(_as_list(callbacks))
+        if verbose:
+            cbks.append(ProgBarLogger(log_freq, verbose=min(verbose, 1)))
+        cbks.set_model(self)
+        cbks.set_params({"metrics": ["loss"]})
+        return self._run_eval(loader, cbks)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers,
+                                   False)
+        cbks = CallbackList(_as_list(callbacks))
+        cbks.set_model(self)
+        cbks.on_predict_begin()
+        outputs: List[List[np.ndarray]] = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            ins, _ = self._split_batch(batch)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step)
+        cbks.on_predict_end()
+        # regroup: list over model outputs, each a list (or stack) of batches
+        n_out = len(outputs[0]) if outputs else 0
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        return grouped
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        from ..framework.io import save as _save
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer=False):
+        from ..framework.io import load as _load
+
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network)
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Parameter-count summary (parity: paddle.summary). Returns the dict the
+    reference returns and prints a per-layer table."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if getattr(p, "trainable", True) and not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    w = max([len(r[0]) for r in rows], default=20) + 2
+    lines = [f"{'Layer (param)':<{w}}{'Shape':<24}{'Params':>12}"]
+    lines.append("-" * (w + 36))
+    for name, shape, n in rows:
+        lines.append(f"{name:<{w}}{str(shape):<24}{n:>12,}")
+    lines.append("-" * (w + 36))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,214 @@
+"""Callbacks for the high-level ``paddle.Model`` API.
+
+Parity surface: python/paddle/hapi/callbacks.py (Callback, ProgBarLogger,
+ModelCheckpoint, EarlyStopping, LRScheduler, VisualDL/WandbCallback stubs).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRScheduler", "CallbackList"]
+
+
+class Callback:
+    """Base callback: all hooks are no-ops; ``model`` and ``params`` are set
+    by the CallbackList before training starts."""
+
+    def __init__(self):
+        self.model = None
+        self.params: Dict[str, Any] = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    # mode ∈ {train, eval, predict}
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_predict_begin(self, logs=None): ...
+    def on_predict_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+    def on_predict_batch_begin(self, step, logs=None): ...
+    def on_predict_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb: Callback):
+        self.callbacks.append(cb)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def _call(self, name, *args):
+        for cb in self.callbacks:
+            getattr(cb, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch progress logging. ``verbose``: 0 silent, 1 epoch summary,
+    2 per-``log_freq``-step lines (reference default)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get("steps")
+        self._t0 = time.time()
+        if self.verbose and self.params.get("epochs"):
+            print(f"Epoch {epoch + 1}/{self.params['epochs']}")
+
+    def _fmt(self, logs):
+        parts = []
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple, np.ndarray)):
+                parts.append(f"{k}: {np.asarray(v).ravel()}")
+            elif isinstance(v, float):
+                parts.append(f"{k}: {v:.4f}")
+            else:
+                parts.append(f"{k}: {v}")
+        return " - ".join(parts)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose > 1 and step % self.log_freq == 0:
+            total = self.steps if self.steps is not None else "?"
+            print(f"step {step}/{total} - {self._fmt(logs)}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            print(f"Epoch {epoch + 1} done ({dt:.1f}s) - {self._fmt(logs)}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print(f"Eval - {self._fmt(logs)}")
+            sys.stdout.flush()
+
+
+class ModelCheckpoint(Callback):
+    """Save model+optimizer every ``save_freq`` epochs under ``save_dir``."""
+
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """Stop when ``monitor`` stops improving (parity: hapi EarlyStopping)."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "auto",
+                 patience: int = 0, verbose: int = 1, min_delta: float = 0.0,
+                 baseline=None, save_best_model: bool = True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode == "auto":
+            mode = "min" if "loss" in monitor else "max"
+        self.mode = mode
+        self.reset()
+
+    def reset(self):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.best = (np.inf if self.mode == "min" else -np.inf) \
+            if self.baseline is None else self.baseline
+
+    def _better(self, cur) -> bool:
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self.reset()
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(np.asarray(cur).ravel()[0])
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and self.model is not None \
+                    and self.params.get("save_dir"):
+                self.model.save(os.path.join(self.params["save_dir"],
+                                             "best_model"))
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                if self.model is not None:
+                    self.model.stop_training = True
+                if self.verbose:
+                    print(f"Early stopping: {self.monitor} has not improved "
+                          f"for {self.wait} evals (best {self.best:.6f})")
+
+
+class LRScheduler(Callback):
+    """Step the optimizer's LRScheduler each batch and/or epoch."""
+
+    def __init__(self, by_step: bool = True, by_epoch: bool = False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        try:
+            lr = self.model._optimizer._learning_rate
+        except AttributeError:
+            return None
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()

@@ -1,0 +1,183 @@
+"""Inference API: ``paddle.inference`` — Config / create_predictor / Predictor.
+
+Parity surface: the reference's AnalysisPredictor stack
+(paddle/fluid/inference/api/ — paddle_infer::Config, CreatePredictor,
+zero-copy input/output handles; see SURVEY.md §3.5).
+
+TPU-native design: the "analysis + IR passes + executor build" phase of the
+reference collapses into XLA — the artifact produced by ``paddle.jit.save``
+or ``paddle.static.save_inference_model`` already holds a serialized
+StableHLO module; the Predictor deserializes it, AOT-compiles once per input
+signature, and runs with zero host round-trips between ops. Handles mimic
+the zero-copy Tensor API (copy_from_cpu / copy_to_cpu).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class Config:
+    """Parity: paddle_infer::Config. GPU/TRT/MKLDNN toggles are accepted and
+    recorded but are no-ops on TPU (XLA owns optimization); documented
+    divergence."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accepted forms: Config(prefix), Config(prefix.pdmodel, prefix.pdiparams)
+        if prog_file and prog_file.endswith(".pdmodel"):
+            self._prefix = prog_file[:-len(".pdmodel")]
+        else:
+            self._prefix = prog_file
+        self._precision = PrecisionType.Float32
+        self._device = "tpu"
+        self._flags: Dict[str, Any] = {}
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self._prefix = (prog_file[:-len(".pdmodel")]
+                        if prog_file.endswith(".pdmodel") else prog_file)
+
+    def model_dir(self):
+        return self._prefix
+
+    # -- accepted no-op toggles (recorded for parity) ----------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator is the TPU on this stack
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, *a, **k):
+        self._flags["memory_optim"] = True
+
+    def switch_ir_optim(self, flag=True):
+        self._flags["ir_optim"] = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._flags["cpu_threads"] = n
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._flags["trt"] = False  # no TRT on TPU; XLA already fuses
+
+    def use_ortt(self, *a, **k):  # pragma: no cover - exotic parity stub
+        pass
+
+    def precision(self):
+        return self._precision
+
+
+class _IOHandle:
+    """Zero-copy-style tensor handle (parity: paddle_infer::Tensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._array: Optional[np.ndarray] = None
+
+    def reshape(self, shape):
+        if self._array is None:
+            self._array = np.zeros(shape, np.float32)
+        else:
+            self._array = self._array.reshape(shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._array = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._array)
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+
+class Predictor:
+    """Executes a saved inference artifact (jit.save or
+    save_inference_model output)."""
+
+    def __init__(self, config: Config):
+        prefix = config._prefix
+        if prefix is None:
+            raise ValueError("Config has no model path")
+        path = prefix + ".pdmodel"
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        fmt = payload.get("format", "")
+        from jax import export as jax_export
+
+        if fmt == "paddle_tpu.static_inference.v1":
+            self._exported = jax_export.deserialize(payload["stablehlo"])
+            self._input_names = list(payload["feed_names"])
+            self._output_names = list(payload["fetch_names"])
+            self._params = None
+        elif fmt == "paddle_tpu.jit.v1":
+            if not payload.get("stablehlo"):
+                raise RuntimeError(
+                    "artifact was saved without input_spec; re-save with "
+                    "paddle.jit.save(layer, path, input_spec=[...])")
+            self._exported = jax_export.deserialize(payload["stablehlo"])
+            import jax.numpy as jnp
+            self._params = [jnp.asarray(a) for a in payload["state"]]
+            self._input_names = list(payload.get(
+                "input_names",
+                [f"x{i}" for i in range(self._n_data_inputs(payload))]))
+            self._output_names = list(payload.get("output_names", ["out0"]))
+        else:
+            raise ValueError(f"unknown inference artifact format: {fmt!r}")
+        self._inputs = {n: _IOHandle(n) for n in self._input_names}
+        self._outputs = {n: _IOHandle(n) for n in self._output_names}
+
+    @staticmethod
+    def _n_data_inputs(payload) -> int:
+        return len(payload.get("input_specs", [])) or 1
+
+    # -- paddle_infer::Predictor surface -----------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Either positional-list style ``run([arr, ...]) -> [arr, ...]`` or
+        handle style (copy_from_cpu … run() … copy_to_cpu)."""
+        import jax.numpy as jnp
+
+        if inputs is not None:
+            arrs = [jnp.asarray(a) for a in inputs]
+        else:
+            arrs = [jnp.asarray(self._inputs[n].copy_to_cpu())
+                    for n in self._input_names]
+        if self._params is not None:
+            outs = self._exported.call(self._params, *arrs)
+        else:
+            outs = self._exported.call(*arrs)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        outs_np = [np.asarray(o) for o in outs]
+        for n, o in zip(self._output_names, outs_np):
+            self._outputs[n]._array = o
+        return outs_np if inputs is not None else None
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -75,12 +75,30 @@ def save(layer, path: str, input_spec: Optional[List[Any]] = None, **configs) ->
         except Exception:
             exported_bytes = None  # fall back to pickle-only (re-trace on load)
 
+    input_names, input_specs, output_names = [], [], ["out0"]
+    if input_spec:
+        for i, s in enumerate(input_spec):
+            if isinstance(s, InputSpec):
+                input_names.append(s.name or f"x{i}")
+                input_specs.append((tuple(s.shape), str(s.dtype)))
+            elif isinstance(s, Tensor):
+                input_names.append(getattr(s, "name", None) or f"x{i}")
+                input_specs.append((tuple(s._data.shape), str(s._data.dtype)))
+    if exported_bytes is not None:
+        try:
+            output_names = [f"out{i}" for i in range(len(exp.out_avals))]
+        except Exception:
+            pass
+
     payload = {
         "format": "paddle_tpu.jit.v1",
         "state_names": names,
         "state": [np.asarray(a) for a in param_arrays],
         "stablehlo": exported_bytes,
         "class_name": type(layer).__name__,
+        "input_names": input_names,
+        "input_specs": input_specs,
+        "output_names": output_names,
     }
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(payload, f, protocol=4)

@@ -1,0 +1,210 @@
+"""Metrics: parity with ``paddle.metric`` (reference: python/paddle/metric/
+metrics.py — Metric base with update/accumulate/reset/name, Accuracy,
+Precision, Recall, Auc).
+
+Metrics accumulate on host in numpy — they sit outside the jit boundary by
+design (the training step returns device arrays; metric update is host-side
+bookkeeping, so no XLA recompile per batch).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List, Sequence, Union
+
+import numpy as np
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _to_numpy(x) -> np.ndarray:
+    if hasattr(x, "numpy"):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base class: override ``update`` (per-batch, host-side), ``accumulate``
+    (return the aggregated result), ``reset`` and ``name``."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def update(self, *args):
+        ...
+
+    @abc.abstractmethod
+    def accumulate(self):
+        ...
+
+    @abc.abstractmethod
+    def reset(self):
+        ...
+
+    @abc.abstractmethod
+    def name(self):
+        ...
+
+    def compute(self, *args):
+        """Optional device-side pre-processing; default passthrough. Called
+        with (pred, label) inside the step; its outputs feed ``update``."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy. ``topk`` may be an int or tuple of ints."""
+
+    def __init__(self, topk: Union[int, Sequence[int]] = (1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = _to_numpy(pred)
+        label_np = _to_numpy(label)
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] != 1:
+            label_np = np.argmax(label_np, axis=-1)  # one-hot -> index
+        label_np = label_np.reshape(-1)
+        idx = np.argsort(-pred_np.reshape(len(label_np), -1), axis=-1)
+        top = idx[:, :self.maxk]
+        return (top == label_np[:, None]).astype(np.float32)
+
+    def update(self, correct):
+        correct = _to_numpy(correct)
+        accs = []
+        for i, k in enumerate(self.topk):
+            num = float(correct[:, :k].sum())
+            self.total[i] += num
+            self.count[i] += correct.shape[0]
+            accs.append(num / max(correct.shape[0], 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision: TP / (TP + FP). ``pred`` is P(class=1)."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_numpy(preds).reshape(-1)
+        labels = _to_numpy(labels).reshape(-1)
+        hard = (preds > 0.5).astype(np.int64)
+        self.tp += int(np.sum((hard == 1) & (labels == 1)))
+        self.fp += int(np.sum((hard == 1) & (labels == 0)))
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall: TP / (TP + FN)."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_numpy(preds).reshape(-1)
+        labels = _to_numpy(labels).reshape(-1)
+        hard = (preds > 0.5).astype(np.int64)
+        self.tp += int(np.sum((hard == 1) & (labels == 1)))
+        self.fn += int(np.sum((hard == 0) & (labels == 1)))
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC-AUC via the reference's thresholded-bucket estimator
+    (num_thresholds bins over [0, 1], trapezoid rule)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_numpy(preds)
+        labels = _to_numpy(labels).reshape(-1)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]  # P(class=1)
+        preds = preds.reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64),
+                      0, self.num_thresholds)
+        np.add.at(self._stat_pos, idx, labels == 1)
+        np.add.at(self._stat_neg, idx, labels == 0)
+
+    def accumulate(self):
+        tot_pos = float(self._stat_pos.sum())
+        tot_neg = float(self._stat_neg.sum())
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # sweep thresholds high→low accumulating TP/FP; trapezoid area
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(np.concatenate([[0.0], tpr]),
+                                  np.concatenate([[0.0], fpr])))
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.float64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.float64)
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (parity: paddle.metric.accuracy). Stays in
+    jax so it can live inside a jitted eval step."""
+    from ..core.tensor import apply
+    from ..ops._helpers import ensure_tensor
+    import jax.numpy as jnp
+
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(pred, lbl):
+        lbl2 = lbl.reshape(-1)
+        _, top_idx = __import__("jax").lax.top_k(pred, k)
+        hit = jnp.any(top_idx == lbl2[:, None], axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply("accuracy", f, input, label, differentiable=False)

@@ -202,6 +202,14 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from .. import static as _static
+        if _static.in_static_mode():
+            # static capture: record the train-step tail (backward + update)
+            # on the program; Executor.run replays it inside the compiled step
+            prog = _static.default_main_program()
+            prog._minimize = (self, loss)
+            prog._exec_cache.clear()  # runners built pre-minimize lack the update
+            return None, None
         loss.backward()
         self.step()
         return None, None

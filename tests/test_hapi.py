@@ -1,0 +1,176 @@
+"""hapi (paddle.Model) + paddle.metric + callbacks tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.hapi.callbacks import Callback, EarlyStopping
+
+
+class _TinyNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = paddle.nn.Linear(8, 16)
+        self.fc2 = paddle.nn.Linear(16, 3)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _toy_dataset(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, 8)).astype(np.float32)
+    ys = (xs.sum(axis=1) > 0).astype(np.int64) % 3
+    return paddle.io.TensorDataset(
+        [paddle.to_tensor(xs), paddle.to_tensor(ys)])
+
+
+def _prepared_model(lr=0.05):
+    paddle.seed(0)
+    net = _TinyNet()
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=lr,
+                                        parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    return model
+
+
+def test_fit_decreases_loss_and_tracks_accuracy():
+    model = _prepared_model()
+    ds = _toy_dataset()
+    hist = model.fit(ds, batch_size=16, epochs=3, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+    res = model.evaluate(ds, batch_size=16, verbose=0)
+    assert res["acc"] > 0.5
+    assert res["loss"] < 1.5
+
+
+def test_predict_shapes_and_stack():
+    model = _prepared_model()
+    ds = _toy_dataset(n=20)
+    outs = model.predict(ds, batch_size=8, stack_outputs=True)
+    assert len(outs) == 1
+    assert outs[0].shape == (20, 3)
+    outs2 = model.predict(ds, batch_size=8)
+    assert len(outs2[0]) == 3  # 3 batches: 8+8+4
+
+
+def test_train_eval_batch_api():
+    model = _prepared_model()
+    x = paddle.randn([16, 8])
+    y = paddle.to_tensor(np.zeros(16, np.int64))
+    l0, _ = model.train_batch([x], [y])
+    for _ in range(10):
+        l1, m = model.train_batch([x], [y])
+    assert l1[0] < l0[0]
+    le, me = model.eval_batch([x], [y])
+    assert np.isfinite(le[0]) and len(me) == 1
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    model = _prepared_model()
+    ds = _toy_dataset(n=32)
+    model.fit(ds, batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    model2 = _prepared_model()
+    model2.load(path)
+    x = paddle.randn([4, 8])
+    np.testing.assert_allclose(model.predict_batch([x])[0],
+                               model2.predict_batch([x])[0], rtol=1e-6)
+
+
+def test_callbacks_fire_and_early_stopping():
+    events = []
+
+    class Recorder(Callback):
+        def on_epoch_begin(self, epoch, logs=None):
+            events.append(("epoch_begin", epoch))
+
+        def on_train_batch_end(self, step, logs=None):
+            events.append(("batch_end", step))
+
+    model = _prepared_model(lr=0.0)  # frozen: eval loss never improves
+    ds = _toy_dataset(n=32)
+    es = EarlyStopping(monitor="loss", patience=1, verbose=0,
+                       save_best_model=False)
+    model.fit(ds, eval_data=ds, batch_size=16, epochs=10, verbose=0,
+              callbacks=[Recorder(), es])
+    epochs_run = len([e for e in events if e[0] == "epoch_begin"])
+    assert 2 <= epochs_run < 10  # stopped early
+    assert any(e[0] == "batch_end" for e in events)
+
+
+def test_model_checkpoint_callback(tmp_path):
+    model = _prepared_model()
+    ds = _toy_dataset(n=32)
+    save_dir = str(tmp_path / "ckpts")
+    model.fit(ds, batch_size=16, epochs=2, verbose=0, save_dir=save_dir)
+    assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+
+
+def test_summary_counts_params(capsys):
+    net = _TinyNet()
+    info = paddle.summary(net)
+    capsys.readouterr()
+    # fc1: 8*16+16, fc2: 16*3+3
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 3 + 3
+    assert info["trainable_params"] == info["total_params"]
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_accuracy_metric_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+    label = np.array([[1], [2]])
+    correct = m.compute(pred, label)
+    m.update(correct)
+    top1, top2 = m.accumulate()
+    assert top1 == pytest.approx(0.5)   # first correct, second wrong
+    assert top2 == pytest.approx(0.5)   # label 2 not in top2 of row 2
+    assert m.name() == ["acc_top1", "acc_top2"]
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.7])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)  # TP=2, FP=1
+    assert r.accumulate() == pytest.approx(2 / 3)  # TP=2, FN=1
+
+
+def test_auc_matches_sklearn_style_reference():
+    rng = np.random.default_rng(0)
+    n = 500
+    labels = rng.integers(0, 2, n)
+    # informative scores: higher for positives
+    preds = np.clip(labels * 0.3 + rng.normal(0.35, 0.25, n), 0, 1)
+    m = Auc()
+    m.update(preds, labels)
+    got = m.accumulate()
+
+    # exact AUC by rank statistic
+    pos = preds[labels == 1]
+    neg = preds[labels == 0]
+    exact = np.mean([(pos[:, None] > neg[None, :]).mean()
+                     + 0.5 * (pos[:, None] == neg[None, :]).mean()])
+    assert got == pytest.approx(exact, abs=0.01)
+
+
+def test_functional_accuracy_jittable():
+    x = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    y = paddle.to_tensor(np.array([1, 1]))
+    acc = paddle.metric.accuracy(x, y, k=1)
+    assert float(acc) == pytest.approx(0.5)

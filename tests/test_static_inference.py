@@ -1,0 +1,217 @@
+"""paddle.static (record/replay Program + Executor) and paddle.inference
+(Predictor over StableHLO artifacts) tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _leave_dynamic():
+    yield
+    paddle.disable_static()
+
+
+def test_static_forward_program():
+    paddle.enable_static()
+    x = static.data("x", [None, 4], "float32")
+    w = paddle.nn.Linear(4, 3)
+    y = w(x)
+    out = paddle.nn.functional.softmax(y)
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+
+    feed = np.random.default_rng(0).normal(size=(5, 4)).astype(np.float32)
+    res, = exe.run(static.default_main_program(),
+                   feed={"x": feed}, fetch_list=[out])
+    assert res.shape == (5, 3)
+    np.testing.assert_allclose(res.sum(axis=1), 1.0, rtol=1e-5)
+
+    paddle.disable_static()
+    # must equal the eager forward with the same params
+    eager = paddle.nn.functional.softmax(w(paddle.to_tensor(feed))).numpy()
+    np.testing.assert_allclose(res, eager, rtol=1e-5)
+
+
+def test_static_program_retraces_new_batch_size():
+    paddle.enable_static()
+    x = static.data("x", [None, 4], "float32")
+    lin = paddle.nn.Linear(4, 2)
+    y = lin(x)
+    exe = static.Executor()
+    for bs in (3, 7):
+        res, = exe.run(feed={"x": np.ones((bs, 4), np.float32)},
+                       fetch_list=[y], program=static.default_main_program())
+        assert res.shape == (bs, 2)
+
+
+def test_static_training_with_minimize():
+    paddle.seed(0)
+    paddle.enable_static()
+    x = static.data("x", [8, 4], "float32")
+    label = static.data("label", [8, 1], "float32")
+    lin = paddle.nn.Linear(4, 1)
+    pred = lin(x)
+    loss = paddle.nn.functional.mse_loss(pred, label)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(8, 4)).astype(np.float32)
+    ys = (xs @ np.array([[1.], [-2.], [0.5], [3.]], np.float32))
+    losses = []
+    for _ in range(30):
+        lv, = exe.run(static.default_main_program(),
+                      feed={"x": xs, "label": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+
+
+def test_program_clone_for_test_drops_minimize():
+    paddle.enable_static()
+    x = static.data("x", [2, 2], "float32")
+    lin = paddle.nn.Linear(2, 1)
+    loss = paddle.nn.functional.mse_loss(lin(x), x[:, :1])
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    opt.minimize(loss)
+    main = static.default_main_program()
+    assert main._minimize is not None
+    test_prog = main.clone(for_test=True)
+    assert test_prog._minimize is None
+    assert len(test_prog._records) == len(main._records)
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.seed(0)
+    paddle.enable_static()
+    x = static.data("x", [4, 8], "float32")
+    net = paddle.nn.Linear(8, 5)
+    out = paddle.nn.functional.relu(net(x))
+    exe = static.Executor()
+
+    feed = np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32)
+    want, = exe.run(feed={"x": feed}, fetch_list=[out],
+                    program=static.default_main_program())
+
+    prefix = str(tmp_path / "model" / "infer")
+    static.save_inference_model(prefix, [x], [out], exe)
+    paddle.disable_static()
+
+    prog, feed_names, fetch_names = static.load_inference_model(prefix)
+    assert feed_names == ["x"]
+    got, = static.Executor().run(prog, feed={"x": feed})
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_inference_predictor_from_static_artifact(tmp_path):
+    paddle.seed(0)
+    paddle.enable_static()
+    x = static.data("img", [2, 6], "float32")
+    net = paddle.nn.Linear(6, 3)
+    out = net(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "pred" / "m")
+    static.save_inference_model(prefix, [x], [out], exe)
+    paddle.disable_static()
+
+    config = paddle.inference.Config(prefix)
+    predictor = paddle.inference.create_predictor(config)
+    assert predictor.get_input_names() == ["img"]
+
+    feed = np.random.default_rng(2).normal(size=(2, 6)).astype(np.float32)
+    # handle style
+    h = predictor.get_input_handle("img")
+    h.copy_from_cpu(feed)
+    predictor.run()
+    got = predictor.get_output_handle(predictor.get_output_names()[0]).copy_to_cpu()
+    # positional style
+    got2 = predictor.run([feed])[0]
+    np.testing.assert_allclose(got, got2, rtol=1e-6)
+    assert got.shape == (2, 3)
+
+
+def test_inference_predictor_from_jit_save(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 8), paddle.nn.ReLU(), paddle.nn.Linear(8, 2))
+    net.eval()
+    prefix = str(tmp_path / "jit" / "m")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.static.InputSpec([2, 4], "float32",
+                                                        name="inp")])
+    config = paddle.inference.Config(prefix + ".pdmodel")
+    predictor = paddle.inference.create_predictor(config)
+    assert predictor.get_input_names() == ["inp"]
+    feed = np.random.default_rng(3).normal(size=(2, 4)).astype(np.float32)
+    got = predictor.run([feed])[0]
+    want = net(paddle.to_tensor(feed)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_static_mode_flag_roundtrip():
+    assert not static.in_static_mode()
+    paddle.enable_static()
+    assert static.in_static_mode()
+    paddle.disable_static()
+    assert not static.in_static_mode()
+
+
+def test_minimize_after_run_invalidates_cache():
+    """A runner compiled before minimize() must not be reused after."""
+    paddle.seed(0)
+    paddle.enable_static()
+    x = static.data("x", [4, 2], "float32")
+    y = static.data("y", [4, 1], "float32")
+    lin = paddle.nn.Linear(2, 1)
+    loss = paddle.nn.functional.mse_loss(lin(x), y)
+    exe = static.Executor()
+    feed = {"x": np.ones((4, 2), np.float32), "y": np.zeros((4, 1), np.float32)}
+    l0, = exe.run(static.default_main_program(), feed=feed, fetch_list=[loss])
+    opt = paddle.optimizer.SGD(learning_rate=0.2, parameters=lin.parameters())
+    opt.minimize(loss)
+    vals = [float(exe.run(static.default_main_program(), feed=feed,
+                          fetch_list=[loss])[0]) for _ in range(5)]
+    assert vals[-1] < float(l0) * 0.9, (float(l0), vals)
+
+
+def test_save_inference_model_dynamic_batch(tmp_path):
+    """None batch dim must survive export (shape-polymorphic StableHLO)."""
+    paddle.seed(0)
+    paddle.enable_static()
+    x = static.data("x", [None, 4], "float32")
+    net = paddle.nn.Linear(4, 2)
+    out = net(x)
+    exe = static.Executor()
+    prefix = str(tmp_path / "dyn" / "m")
+    static.save_inference_model(prefix, [x], [out], exe)
+    paddle.disable_static()
+    prog, _, _ = static.load_inference_model(prefix)
+    for bs in (1, 5, 32):
+        got, = static.Executor().run(
+            prog, feed={"x": np.ones((bs, 4), np.float32)})
+        assert got.shape == (bs, 2)
+
+
+def test_predict_unlabeled_dataset():
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    model = paddle.Model(net)  # no prepare: inference-only use
+    xs = paddle.to_tensor(np.ones((6, 4), np.float32))
+    outs = model.predict(paddle.io.TensorDataset([xs]), batch_size=3,
+                         stack_outputs=True)
+    assert outs[0].shape == (6, 2)
+
+
+def test_accuracy_duplicate_topk_slots():
+    from paddle_tpu.metric import Accuracy
+    m = Accuracy(topk=(1, 2, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+    label = np.array([[1], [2]])
+    m.update(m.compute(pred, label))
+    res = m.accumulate()
+    assert res[1] == res[2]  # duplicate k slots must agree
