@@ -1,0 +1,229 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its runtime seams native (TCPStore rendezvous, DataLoader
+BlockingQueue feed, HostTracer — SURVEY.md §2.1/§2.3/§5); this package holds
+our TPU-native equivalents, compiled from ``csrc/*.cc`` with the system g++ on
+first import and cached by source hash. Everything has a pure-Python fallback
+(``available() == False`` never breaks the framework).
+
+Exposes:
+    lib          — the loaded ctypes CDLL, or None
+    available()  — whether the native library is usable
+    BlockingQueue — token queue over the native library (Python objects are
+                   kept alive in a side table; the native queue carries ids)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Any, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CSRC = os.path.join(_HERE, "csrc")
+_BUILD = os.path.join(_HERE, "_build")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_loaded = False
+_load_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _sources():
+    return sorted(
+        os.path.join(_CSRC, f) for f in os.listdir(_CSRC) if f.endswith(".cc")
+    )
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for src in _sources():
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _compile() -> Optional[str]:
+    """Build (or reuse) the shared library; returns its path or None."""
+    global _build_error
+    try:
+        tag = _source_hash()
+    except OSError as e:
+        _build_error = str(e)
+        return None
+    so_path = os.path.join(_BUILD, f"libptnative_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(_BUILD, exist_ok=True)
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = ["g++", "-std=c++17", "-O2", "-fPIC", "-shared", "-pthread",
+           "-o", tmp] + _sources()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        _build_error = str(e)
+        return None
+    if r.returncode != 0:
+        _build_error = r.stderr[-2000:]
+        return None
+    os.replace(tmp, so_path)  # atomic under concurrent builders
+    return so_path
+
+
+def _bind(l: ctypes.CDLL) -> None:
+    c = ctypes
+    # tcp_store
+    l.pt_store_server_start.argtypes = [c.c_uint16]
+    l.pt_store_server_start.restype = c.c_void_p
+    l.pt_store_server_port.argtypes = [c.c_void_p]
+    l.pt_store_server_port.restype = c.c_int
+    l.pt_store_server_stop.argtypes = [c.c_void_p]
+    l.pt_store_client_new.argtypes = [c.c_char_p, c.c_uint16, c.c_double]
+    l.pt_store_client_new.restype = c.c_void_p
+    l.pt_store_client_free.argtypes = [c.c_void_p]
+    l.pt_store_set.argtypes = [c.c_void_p, c.c_char_p,
+                               c.POINTER(c.c_uint8), c.c_uint64]
+    l.pt_store_set.restype = c.c_int
+    l.pt_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_double,
+                               c.POINTER(c.POINTER(c.c_uint8))]
+    l.pt_store_get.restype = c.c_int64
+    l.pt_store_buf_free.argtypes = [c.POINTER(c.c_uint8)]
+    l.pt_store_add.argtypes = [c.c_void_p, c.c_char_p, c.c_int64]
+    l.pt_store_add.restype = c.c_int64
+    l.pt_store_wait.argtypes = [c.c_void_p, c.c_char_p, c.c_double]
+    l.pt_store_wait.restype = c.c_int
+    l.pt_store_check.argtypes = [c.c_void_p, c.c_char_p]
+    l.pt_store_check.restype = c.c_int
+    l.pt_store_del.argtypes = [c.c_void_p, c.c_char_p]
+    l.pt_store_del.restype = c.c_int
+    l.pt_store_num_keys.argtypes = [c.c_void_p]
+    l.pt_store_num_keys.restype = c.c_int64
+    # blocking_queue
+    l.pt_bq_new.argtypes = [c.c_uint64]
+    l.pt_bq_new.restype = c.c_void_p
+    l.pt_bq_free.argtypes = [c.c_void_p]
+    l.pt_bq_push.argtypes = [c.c_void_p, c.c_uint64, c.c_double]
+    l.pt_bq_push.restype = c.c_int
+    l.pt_bq_pop.argtypes = [c.c_void_p, c.POINTER(c.c_uint64), c.c_double]
+    l.pt_bq_pop.restype = c.c_int
+    l.pt_bq_close.argtypes = [c.c_void_p]
+    l.pt_bq_closed.argtypes = [c.c_void_p]
+    l.pt_bq_closed.restype = c.c_int
+    l.pt_bq_size.argtypes = [c.c_void_p]
+    l.pt_bq_size.restype = c.c_uint64
+    l.pt_bq_capacity.argtypes = [c.c_void_p]
+    l.pt_bq_capacity.restype = c.c_uint64
+    # host_tracer
+    l.pt_trace_enable.argtypes = [c.c_uint64]
+    l.pt_trace_disable.argtypes = []
+    l.pt_trace_enabled.restype = c.c_int
+    l.pt_trace_now_ns.restype = c.c_uint64
+    l.pt_trace_emit.argtypes = [c.c_char_p, c.c_uint64, c.c_uint64,
+                                c.c_uint32, c.c_uint64]
+    l.pt_trace_count.restype = c.c_uint64
+    l.pt_trace_clear.argtypes = []
+    l.pt_trace_dump.argtypes = [c.c_char_p, c.c_uint64]
+    l.pt_trace_dump.restype = c.c_uint64
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+        return None
+    so = _compile()
+    if so is None:
+        return None
+    try:
+        l = ctypes.CDLL(so)
+        _bind(l)
+        return l
+    except OSError as e:
+        global _build_error
+        _build_error = str(e)
+        return None
+
+
+def _ensure_loaded() -> Optional[ctypes.CDLL]:
+    """Compile+load on first use, not at import ('import paddle_tpu' must not
+    block on a g++ subprocess when no native feature is exercised)."""
+    global _lib, _lib_loaded
+    if not _lib_loaded:
+        with _load_lock:
+            if not _lib_loaded:
+                _lib = _load()
+                _lib_loaded = True
+    return _lib
+
+
+def __getattr__(name: str):  # PEP 562: lazy `_native.lib`
+    if name == "lib":
+        return _ensure_loaded()
+    raise AttributeError(name)
+
+
+def available() -> bool:
+    return _ensure_loaded() is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+class BlockingQueue:
+    """Bounded producer/consumer queue backed by the native library.
+
+    Python objects are parked in a side table keyed by a monotonically
+    increasing token; the native queue provides the blocking/backpressure
+    semantics (reference: C++ BlockingQueue DataLoader feed).
+    """
+
+    CLOSED = object()
+    TIMEOUT = object()
+
+    def __init__(self, capacity: int):
+        self._lib = _ensure_loaded()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable: %s" % _build_error)
+        self._h = self._lib.pt_bq_new(capacity)
+        self._objs: dict[int, Any] = {}
+        self._next = 0
+        self._mu = threading.Lock()
+
+    def push(self, obj: Any, timeout: float = -1.0) -> bool:
+        with self._mu:
+            token = self._next
+            self._next += 1
+            self._objs[token] = obj
+        rc = self._lib.pt_bq_push(self._h, token, timeout)
+        if rc != 0:
+            with self._mu:
+                self._objs.pop(token, None)
+            return False
+        return True
+
+    def pop(self, timeout: float = -1.0) -> Any:
+        out = ctypes.c_uint64()
+        rc = self._lib.pt_bq_pop(self._h, ctypes.byref(out), timeout)
+        if rc == -1:
+            return self.TIMEOUT
+        if rc == -2:
+            return self.CLOSED
+        with self._mu:
+            return self._objs.pop(out.value)
+
+    def close(self) -> None:
+        self._lib.pt_bq_close(self._h)
+
+    def __len__(self) -> int:
+        return int(self._lib.pt_bq_size(self._h))
+
+    def __del__(self):
+        try:
+            if self._lib is not None and self._h:
+                self._lib.pt_bq_close(self._h)
+                self._lib.pt_bq_free(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -1,0 +1,92 @@
+// Bounded blocking queue of opaque 64-bit tokens.
+//
+// TPU-native equivalent of the reference's C++ BlockingQueue feed used by its
+// DataLoader (paddle/fluid/operators/reader/ — no line cites: reference mount
+// was empty, see SURVEY.md provenance). The queue carries tokens (Python-side
+// object handles) so producer/consumer handoff and backpressure happen in
+// native code without the GIL; payload ownership stays with the caller.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+
+namespace {
+
+struct Queue {
+  explicit Queue(uint64_t cap) : capacity(cap ? cap : 1) {}
+  uint64_t capacity;
+  std::deque<uint64_t> items;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_bq_new(uint64_t capacity) { return new Queue(capacity); }
+
+void pt_bq_free(void* h) { delete static_cast<Queue*>(h); }
+
+// 0 = ok, -1 = timeout, -2 = closed.
+int pt_bq_push(void* h, uint64_t token, double timeout_s) {
+  auto* q = static_cast<Queue*>(h);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_s < 0) {
+    q->not_full.wait(lk, pred);
+  } else if (!q->not_full.wait_for(lk, std::chrono::duration<double>(timeout_s),
+                                   pred)) {
+    return -1;
+  }
+  if (q->closed) return -2;
+  q->items.push_back(token);
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// 0 = ok, -1 = timeout, -2 = closed-and-drained.
+int pt_bq_pop(void* h, uint64_t* token, double timeout_s) {
+  auto* q = static_cast<Queue*>(h);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || !q->items.empty(); };
+  if (timeout_s < 0) {
+    q->not_empty.wait(lk, pred);
+  } else if (!q->not_empty.wait_for(
+                 lk, std::chrono::duration<double>(timeout_s), pred)) {
+    return -1;
+  }
+  if (q->items.empty()) return -2;  // closed and drained
+  *token = q->items.front();
+  q->items.pop_front();
+  q->not_full.notify_one();
+  return 0;
+}
+
+void pt_bq_close(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  q->closed = true;
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+int pt_bq_closed(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  return q->closed ? 1 : 0;
+}
+
+uint64_t pt_bq_size(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  return q->items.size();
+}
+
+uint64_t pt_bq_capacity(void* h) { return static_cast<Queue*>(h)->capacity; }
+
+}  // extern "C"

@@ -1,0 +1,156 @@
+// Host tracer: lock-light ring buffer of completed host ranges.
+//
+// TPU-native equivalent of the reference's C++ HostTracer/RecordEvent
+// (paddle/fluid/platform/profiler/ — no line cites: reference mount was
+// empty, see SURVEY.md provenance). Device-side tracing is libtpu/XProf via
+// jax.profiler; this covers the host ranges the reference instruments with
+// RecordEvent RAII markers. Events are dumped as chrome-trace JSON fragments.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kNameLen = 64;
+
+struct Event {
+  char name[kNameLen];
+  uint64_t t0_ns;
+  uint64_t t1_ns;
+  uint64_t tid;
+  uint32_t cat;
+};
+
+struct Tracer {
+  std::mutex mu;
+  std::vector<Event> ring;
+  uint64_t head = 0;   // next write slot
+  uint64_t count = 0;  // total written (may exceed ring size)
+  std::atomic<bool> enabled{false};
+};
+
+Tracer g_tracer;
+
+uint64_t NowNs() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+uint64_t Tid() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id()) & 0xffffff;
+}
+
+// JSON string escaping for event names (quotes, backslashes, control bytes,
+// and any non-ASCII byte — names may arrive truncated mid-UTF-8-codepoint).
+std::string JsonEscape(const char* s) {
+  std::string out;
+  for (const char* p = s; *p; p++) {
+    unsigned char c = static_cast<unsigned char>(*p);
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += static_cast<char>(c);
+    } else if (c < 0x20 || c > 0x7e) {
+      char esc[8];
+      std::snprintf(esc, sizeof(esc), "\\u%04x", c);
+      out += esc;
+    } else {
+      out += static_cast<char>(c);
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(uint64_t capacity) {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  if (capacity == 0) capacity = 1 << 16;
+  g_tracer.ring.assign(capacity, Event{});
+  g_tracer.head = 0;
+  g_tracer.count = 0;
+  g_tracer.enabled.store(true);
+}
+
+void pt_trace_disable() { g_tracer.enabled.store(false); }
+
+int pt_trace_enabled() { return g_tracer.enabled.load() ? 1 : 0; }
+
+uint64_t pt_trace_now_ns() { return NowNs(); }
+
+// Record a completed range. Timestamps are steady-clock ns (pt_trace_now_ns).
+void pt_trace_emit(const char* name, uint64_t t0_ns, uint64_t t1_ns,
+                   uint32_t cat, uint64_t tid) {
+  if (!g_tracer.enabled.load()) return;
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  if (g_tracer.ring.empty()) return;
+  Event& e = g_tracer.ring[g_tracer.head];
+  std::strncpy(e.name, name, kNameLen - 1);
+  e.name[kNameLen - 1] = '\0';
+  e.t0_ns = t0_ns;
+  e.t1_ns = t1_ns;
+  e.cat = cat;
+  e.tid = tid ? tid : Tid();
+  g_tracer.head = (g_tracer.head + 1) % g_tracer.ring.size();
+  g_tracer.count++;
+}
+
+uint64_t pt_trace_count() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  return g_tracer.count;
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  g_tracer.head = 0;
+  g_tracer.count = 0;
+}
+
+// Serialize buffered events as a JSON array of
+// {"name":..,"ts":us,"dur":us,"tid":..,"cat":N} and clear the buffer.
+// Returns bytes needed (including NUL); writes up to buflen bytes into buf.
+// Call with buf=NULL to size, then again with a buffer.
+uint64_t pt_trace_dump(char* buf, uint64_t buflen) {
+  std::lock_guard<std::mutex> g(g_tracer.mu);
+  uint64_t n = g_tracer.count < g_tracer.ring.size() ? g_tracer.count
+                                                     : g_tracer.ring.size();
+  uint64_t start =
+      g_tracer.count <= g_tracer.ring.size()
+          ? 0
+          : g_tracer.head;  // oldest surviving slot when wrapped
+  std::string out = "[";
+  char tmp[448];
+  for (uint64_t i = 0; i < n; i++) {
+    const Event& e = g_tracer.ring[(start + i) % g_tracer.ring.size()];
+    std::snprintf(tmp, sizeof(tmp),
+                  "%s{\"name\":\"%s\",\"ts\":%.3f,\"dur\":%.3f,"
+                  "\"tid\":%llu,\"cat\":%u}",
+                  i ? "," : "", JsonEscape(e.name).c_str(), e.t0_ns / 1e3,
+                  (e.t1_ns - e.t0_ns) / 1e3,
+                  static_cast<unsigned long long>(e.tid), e.cat);
+    out += tmp;
+  }
+  out += "]";
+  uint64_t need = out.size() + 1;
+  if (buf && buflen) {
+    uint64_t c = need <= buflen ? need : buflen;
+    std::memcpy(buf, out.data(), c - 1);
+    buf[c - 1] = '\0';
+    if (need <= buflen) {  // only clear when the caller got everything
+      g_tracer.head = 0;
+      g_tracer.count = 0;
+    }
+  }
+  return need;
+}
+
+}  // extern "C"

@@ -1,0 +1,360 @@
+// TCPStore: rank-0-hosted key-value rendezvous store.
+//
+// TPU-native equivalent of the reference's C++ TCPStore
+// (paddle/phi/core/distributed/store/ — no line cites: reference mount was
+// empty, see SURVEY.md provenance). Same role: bootstrap KV + barrier
+// counters for multi-process jobs. Wire protocol (little-endian):
+//   request:  u8 op | u32 klen | key bytes | u64 vlen | value bytes
+//   response: u8 status | u64 vlen | value bytes        (status 0=ok 1=miss)
+// ops: 1=SET 2=GET(value=8B timeout_ms) 3=ADD(value=8B i64 delta)
+//      4=WAIT(value=8B timeout_ms) 5=CHECK 6=DEL 7=NUMKEYS
+// The Python fallback (paddle_tpu/distributed/store.py) speaks the same
+// protocol, so native and pure-Python ends interoperate.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kCheck = 5, kDel = 6, kNumKeys = 7,
+};
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  uint16_t port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
+  std::mutex conn_mu;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+
+  ~Server() { Stop(); }
+
+  void Stop() {
+    bool expected = false;
+    if (!stop.compare_exchange_strong(expected, true)) return;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    cv.notify_all();
+    {
+      // unblock Serve threads sitting in recv() on live connections;
+      // without this, Stop() would join() forever while any client
+      // (e.g. a straggler rank) still holds its connection open
+      std::lock_guard<std::mutex> g(conn_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::vector<std::thread> conns;
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      conns.swap(conn_threads);
+    }
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+  }
+
+  void Serve(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (!stop.load()) {
+      uint8_t op;
+      uint32_t klen;
+      uint64_t vlen;
+      if (!ReadFull(fd, &op, 1) || !ReadFull(fd, &klen, 4)) break;
+      if (klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (klen && !ReadFull(fd, &key[0], klen)) break;
+      if (!ReadFull(fd, &vlen, 8)) break;
+      if (vlen > (1ull << 32)) break;
+      std::string val(vlen, '\0');
+      if (vlen && !ReadFull(fd, &val[0], vlen)) break;
+
+      uint8_t status = 0;
+      std::string out;
+      switch (op) {
+        case kSet: {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = val;
+          cv.notify_all();
+          break;
+        }
+        case kGet:
+        case kWait: {
+          uint64_t timeout_ms = 0;
+          if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+          std::unique_lock<std::mutex> lk(mu);
+          bool ok = cv.wait_for(
+              lk, std::chrono::milliseconds(timeout_ms),
+              [&] { return stop.load() || kv.count(key) != 0; });
+          if (!ok || stop.load() || kv.count(key) == 0) {
+            status = 1;
+          } else if (op == kGet) {
+            out = kv[key];
+          }
+          break;
+        }
+        case kAdd: {
+          int64_t delta = 0;
+          if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+          std::lock_guard<std::mutex> g(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string enc(8, '\0');
+          std::memcpy(&enc[0], &cur, 8);
+          kv[key] = enc;
+          out = enc;
+          cv.notify_all();
+          break;
+        }
+        case kCheck: {
+          std::lock_guard<std::mutex> g(mu);
+          status = kv.count(key) ? 0 : 1;
+          break;
+        }
+        case kDel: {
+          std::lock_guard<std::mutex> g(mu);
+          status = kv.erase(key) ? 0 : 1;
+          cv.notify_all();
+          break;
+        }
+        case kNumKeys: {
+          std::lock_guard<std::mutex> g(mu);
+          int64_t n = static_cast<int64_t>(kv.size());
+          out.assign(8, '\0');
+          std::memcpy(&out[0], &n, 8);
+          break;
+        }
+        default:
+          status = 1;
+      }
+      uint64_t olen = out.size();
+      if (!WriteFull(fd, &status, 1) || !WriteFull(fd, &olen, 8)) break;
+      if (olen && !WriteFull(fd, out.data(), olen)) break;
+    }
+    ::close(fd);
+  }
+
+  void AcceptLoop() {
+    while (!stop.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) break;
+        continue;
+      }
+      std::lock_guard<std::mutex> g(conn_mu);
+      conn_fds.push_back(fd);
+      conn_threads.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+
+  ~Client() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  // Returns status byte, or -1 on transport error; response value in *out.
+  int Request(uint8_t op, const char* key, const void* val, uint64_t vlen,
+              std::string* out) {
+    std::lock_guard<std::mutex> g(mu);
+    uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+    if (!WriteFull(fd, &op, 1) || !WriteFull(fd, &klen, 4) ||
+        (klen && !WriteFull(fd, key, klen)) || !WriteFull(fd, &vlen, 8) ||
+        (vlen && !WriteFull(fd, val, vlen)))
+      return -1;
+    uint8_t status;
+    uint64_t olen;
+    if (!ReadFull(fd, &status, 1) || !ReadFull(fd, &olen, 8)) return -1;
+    out->assign(olen, '\0');
+    if (olen && !ReadFull(fd, &(*out)[0], olen)) return -1;
+    return status;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_store_server_start(uint16_t port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      ::listen(s->listen_fd, 64) < 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->AcceptLoop(); });
+  return s;
+}
+
+int pt_store_server_port(void* h) {
+  return h ? static_cast<Server*>(h)->port : -1;
+}
+
+void pt_store_server_stop(void* h) {
+  if (!h) return;
+  auto* s = static_cast<Server*>(h);
+  s->Stop();
+  delete s;
+}
+
+void* pt_store_client_new(const char* host, uint16_t port, double timeout_s) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_s);
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      // caller must resolve hostnames; a silent loopback fallback would
+      // rendezvous with the wrong store on multi-host jobs
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void pt_store_client_free(void* h) { delete static_cast<Client*>(h); }
+
+int pt_store_set(void* h, const char* key, const uint8_t* val, uint64_t len) {
+  std::string out;
+  return static_cast<Client*>(h)->Request(kSet, key, val, len, &out);
+}
+
+// Returns value length with *out a malloc'd copy the caller must release via
+// pt_store_buf_free (a per-call buffer: concurrent get()s on one client must
+// not share storage). -1 on timeout/miss, -2 on transport error.
+int64_t pt_store_get(void* h, const char* key, double timeout_s,
+                     uint8_t** out) {
+  auto* c = static_cast<Client*>(h);
+  uint64_t ms = timeout_s <= 0 ? 0 : static_cast<uint64_t>(timeout_s * 1e3);
+  std::string res;
+  int st = c->Request(kGet, key, &ms, 8, &res);
+  if (st < 0) return -2;
+  if (st != 0) return -1;
+  auto* buf = static_cast<uint8_t*>(::malloc(res.size() ? res.size() : 1));
+  if (!buf) return -2;
+  std::memcpy(buf, res.data(), res.size());
+  *out = buf;
+  return static_cast<int64_t>(res.size());
+}
+
+void pt_store_buf_free(uint8_t* p) { ::free(p); }
+
+int64_t pt_store_add(void* h, const char* key, int64_t delta) {
+  std::string out;
+  int st = static_cast<Client*>(h)->Request(kAdd, key, &delta, 8, &out);
+  if (st != 0 || out.size() != 8) return INT64_MIN;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+int pt_store_wait(void* h, const char* key, double timeout_s) {
+  uint64_t ms = timeout_s <= 0 ? 0 : static_cast<uint64_t>(timeout_s * 1e3);
+  std::string out;
+  int st = static_cast<Client*>(h)->Request(kWait, key, &ms, 8, &out);
+  return st == 0 ? 0 : -1;
+}
+
+int pt_store_check(void* h, const char* key) {
+  std::string out;
+  return static_cast<Client*>(h)->Request(kCheck, key, nullptr, 0, &out) == 0
+             ? 1
+             : 0;
+}
+
+int pt_store_del(void* h, const char* key) {
+  std::string out;
+  return static_cast<Client*>(h)->Request(kDel, key, nullptr, 0, &out) == 0 ? 1
+                                                                            : 0;
+}
+
+int64_t pt_store_num_keys(void* h) {
+  std::string out;
+  int st = static_cast<Client*>(h)->Request(kNumKeys, "", nullptr, 0, &out);
+  if (st != 0 || out.size() != 8) return -1;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+}  // extern "C"

@@ -32,6 +32,7 @@ from .auto_parallel_api import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .store import TCPStore, Store  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):

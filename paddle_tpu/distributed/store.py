@@ -1,0 +1,347 @@
+"""TCPStore: rank-0-hosted KV rendezvous store.
+
+Parity surface: ``paddle.distributed.TCPStore`` / the reference's C++ store
+(paddle/phi/core/distributed/store/ — no line cites: reference mount was
+empty, see SURVEY.md provenance). The heavy lifting is the native C++ server/
+client in ``paddle_tpu/_native``; a pure-Python implementation of the same
+wire protocol (see tcp_store.cc header comment) is the fallback, and the two
+interoperate. On TPU the rendezvous role is normally played by
+``jax.distributed.initialize``'s coordination service; TCPStore remains for
+API parity and for launcher/elastic bookkeeping that wants a plain KV store.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Union
+
+from .. import _native
+
+__all__ = ["TCPStore", "Store"]
+
+_OPS = {"set": 1, "get": 2, "add": 3, "wait": 4, "check": 5, "del": 6,
+        "numkeys": 7}
+
+
+# ---------------------------------------------------------------------------
+# pure-Python protocol server (fallback; interoperates with the C++ client)
+# ---------------------------------------------------------------------------
+class _PyServerState:
+    def __init__(self) -> None:
+        self.kv: Dict[bytes, bytes] = {}
+        self.cond = threading.Condition()
+
+
+class _PyHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:  # one connection, many requests
+        st: _PyServerState = self.server.state  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+        def read_full(n: int) -> Optional[bytes]:
+            buf = b""
+            while len(buf) < n:
+                chunk = sock.recv(n - len(buf))
+                if not chunk:
+                    return None
+                buf += chunk
+            return buf
+
+        while True:
+            hdr = read_full(5)
+            if hdr is None:
+                return
+            op, klen = struct.unpack("<BI", hdr)
+            key = read_full(klen) if klen else b""
+            vlen_b = read_full(8)
+            if key is None or vlen_b is None:
+                return
+            (vlen,) = struct.unpack("<Q", vlen_b)
+            val = read_full(vlen) if vlen else b""
+            if val is None:
+                return
+            status, out = 0, b""
+            if op == _OPS["set"]:
+                with st.cond:
+                    st.kv[key] = val
+                    st.cond.notify_all()
+            elif op in (_OPS["get"], _OPS["wait"]):
+                (timeout_ms,) = struct.unpack("<Q", val) if len(val) == 8 else (0,)
+                deadline = time.monotonic() + timeout_ms / 1e3
+                with st.cond:
+                    while key not in st.kv:
+                        left = deadline - time.monotonic()
+                        if left <= 0 or not st.cond.wait(left):
+                            if key not in st.kv:
+                                break
+                    if key not in st.kv:
+                        status = 1
+                    elif op == _OPS["get"]:
+                        out = st.kv[key]
+            elif op == _OPS["add"]:
+                (delta,) = struct.unpack("<q", val) if len(val) == 8 else (0,)
+                with st.cond:
+                    cur = struct.unpack("<q", st.kv[key])[0] \
+                        if len(st.kv.get(key, b"")) == 8 else 0
+                    out = struct.pack("<q", cur + delta)
+                    st.kv[key] = out
+                    st.cond.notify_all()
+            elif op == _OPS["check"]:
+                with st.cond:
+                    status = 0 if key in st.kv else 1
+            elif op == _OPS["del"]:
+                with st.cond:
+                    status = 0 if st.kv.pop(key, None) is not None else 1
+                    st.cond.notify_all()
+            elif op == _OPS["numkeys"]:
+                with st.cond:
+                    out = struct.pack("<q", len(st.kv))
+            else:
+                status = 1
+            sock.sendall(struct.pack("<BQ", status, len(out)) + out)
+
+
+class _PyServer:
+    def __init__(self, port: int):
+        class _TS(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _TS(("0.0.0.0", port), _PyHandler)
+        self._srv.state = _PyServerState()  # type: ignore[attr-defined]
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class _PyClient:
+    def __init__(self, host: str, port: int, timeout: float):
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"TCPStore connect to {host}:{port} failed") from last_err
+                time.sleep(0.05)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._mu = threading.Lock()
+
+    def _read_full(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("TCPStore connection closed")
+            buf += chunk
+        return buf
+
+    def request(self, op: int, key: bytes, val: bytes) -> tuple:
+        with self._mu:
+            self._sock.sendall(struct.pack("<BI", op, len(key)) + key +
+                               struct.pack("<Q", len(val)) + val)
+            self._sock.settimeout(None)
+            status, vlen = struct.unpack("<BQ", self._read_full(9))
+            out = self._read_full(vlen) if vlen else b""
+        return status, out
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# public store API
+# ---------------------------------------------------------------------------
+class Store:
+    """Abstract store interface (reference: phi::distributed::Store)."""
+
+    def set(self, key: str, value) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, delta: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys, timeout: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+
+class TCPStore(Store):
+    """Rank-0-hosted TCP key-value store.
+
+    ``TCPStore(host, port, is_master=True)`` starts the server (native C++
+    when available) and connects a client; non-masters just connect. ``port=0``
+    on the master picks an ephemeral port (read it back from ``.port``).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0, use_native: Optional[bool] = None):
+        self.host = host
+        self.is_master = is_master
+        self.world_size = world_size
+        self.timeout = timeout
+        native = _native.available() if use_native is None else (
+            use_native and _native.available())
+        self._native = native
+        self._server = None
+        self._server_native = None
+        if is_master:
+            if native:
+                self._server_native = _native.lib.pt_store_server_start(port)
+                if not self._server_native:
+                    raise RuntimeError(f"TCPStore: cannot bind port {port}")
+                self.port = _native.lib.pt_store_server_port(self._server_native)
+            else:
+                self._server = _PyServer(port)
+                self.port = self._server.port
+        else:
+            self.port = port
+        self._barrier_rounds: Dict[str, int] = {}
+        # resolve to an IPv4 literal for the native client (inet_pton);
+        # resolution failure must be loud — a fallback address would
+        # rendezvous with the wrong store on multi-host jobs
+        try:
+            addr = socket.gethostbyname(host)
+        except OSError as e:
+            raise ConnectionError(f"TCPStore: cannot resolve {host!r}") from e
+        if native:
+            self._client = _native.lib.pt_store_client_new(
+                addr.encode(), self.port, timeout)
+            if not self._client:
+                raise ConnectionError(
+                    f"TCPStore connect to {addr}:{self.port} failed")
+        else:
+            self._client = _PyClient(addr, self.port, timeout)
+
+    # -- ops ---------------------------------------------------------------
+    def set(self, key: str, value: Union[bytes, str]) -> None:
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        if self._native:
+            buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
+                else None
+            rc = _native.lib.pt_store_set(self._client, key.encode(), buf,
+                                          len(data))
+            if rc != 0:
+                raise ConnectionError("TCPStore set failed")
+        else:
+            status, _ = self._client.request(_OPS["set"], key.encode(), data)
+            if status != 0:
+                raise ConnectionError("TCPStore set failed")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        t = self.timeout if timeout is None else timeout
+        if self._native:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = _native.lib.pt_store_get(self._client, key.encode(), t,
+                                         ctypes.byref(out))
+            if n == -1:
+                raise TimeoutError(f"TCPStore get({key!r}) timed out")
+            if n < 0:
+                raise ConnectionError("TCPStore get transport error")
+            try:
+                return ctypes.string_at(out, n)
+            finally:
+                _native.lib.pt_store_buf_free(out)
+        status, val = self._client.request(
+            _OPS["get"], key.encode(), struct.pack("<Q", int(t * 1e3)))
+        if status != 0:
+            raise TimeoutError(f"TCPStore get({key!r}) timed out")
+        return val
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._native:
+            v = _native.lib.pt_store_add(self._client, key.encode(), delta)
+            if v == -(2 ** 63):
+                raise ConnectionError("TCPStore add failed")
+            return int(v)
+        status, out = self._client.request(
+            _OPS["add"], key.encode(), struct.pack("<q", delta))
+        if status != 0 or len(out) != 8:
+            raise ConnectionError("TCPStore add failed")
+        return struct.unpack("<q", out)[0]
+
+    def wait(self, keys: Union[str, List[str]],
+             timeout: Optional[float] = None) -> None:
+        t = self.timeout if timeout is None else timeout
+        for key in ([keys] if isinstance(keys, str) else keys):
+            if self._native:
+                if _native.lib.pt_store_wait(self._client, key.encode(), t) != 0:
+                    raise TimeoutError(f"TCPStore wait({key!r}) timed out")
+            else:
+                status, _ = self._client.request(
+                    _OPS["wait"], key.encode(), struct.pack("<Q", int(t * 1e3)))
+                if status != 0:
+                    raise TimeoutError(f"TCPStore wait({key!r}) timed out")
+
+    def check(self, key: str) -> bool:
+        if self._native:
+            return bool(_native.lib.pt_store_check(self._client, key.encode()))
+        status, _ = self._client.request(_OPS["check"], key.encode(), b"")
+        return status == 0
+
+    def delete_key(self, key: str) -> bool:
+        if self._native:
+            return bool(_native.lib.pt_store_del(self._client, key.encode()))
+        status, _ = self._client.request(_OPS["del"], key.encode(), b"")
+        return status == 0
+
+    def num_keys(self) -> int:
+        if self._native:
+            return int(_native.lib.pt_store_num_keys(self._client))
+        _, out = self._client.request(_OPS["numkeys"], b"", b"")
+        return struct.unpack("<q", out)[0]
+
+    # -- barrier (built on add/wait, the reference's pattern) --------------
+    def barrier(self, name: str = "barrier", timeout: Optional[float] = None
+                ) -> None:
+        # per-name round counter so the same barrier name is reusable: each
+        # round gets fresh keys (all ranks call barrier the same number of
+        # times, so local round counts agree across ranks)
+        rnd = self._barrier_rounds.get(name, 0)
+        self._barrier_rounds[name] = rnd + 1
+        arrived = self.add(f"__{name}_{rnd}__count", 1)
+        if arrived == self.world_size:
+            self.set(f"__{name}_{rnd}__go", b"1")
+        self.wait(f"__{name}_{rnd}__go", timeout)
+
+    def close(self) -> None:
+        if self._native:
+            if self._client:
+                _native.lib.pt_store_client_free(self._client)
+                self._client = None
+            if self._server_native:
+                _native.lib.pt_store_server_stop(self._server_native)
+                self._server_native = None
+        else:
+            if self._client:
+                self._client.close()
+                self._client = None
+            if self._server:
+                self._server.stop()
+                self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -324,7 +324,14 @@ class DataLoader:
         yield from self._thread_prefetch(self._iter_batches())
 
     def _thread_prefetch(self, gen):
-        """Background-thread double buffering (BlockingQueue parity)."""
+        """Background-thread double buffering: the native C++ BlockingQueue
+        (paddle_tpu/_native) when available — the analogue of the reference's
+        C++ BlockingQueue DataLoader feed — else a Python queue."""
+        from .. import _native
+
+        if _native.available():
+            yield from self._native_prefetch(gen)
+            return
         q: "queue.Queue" = queue.Queue(maxsize=max(2, self.prefetch_factor))
         sentinel = object()
         err: List[BaseException] = []
@@ -345,5 +352,34 @@ class DataLoader:
             if item is sentinel:
                 break
             yield item
+        if err:
+            raise err[0]
+
+    def _native_prefetch(self, gen):
+        from .. import _native
+
+        q = _native.BlockingQueue(max(2, self.prefetch_factor))
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for item in gen:
+                    if not q.push(item):  # queue closed by consumer
+                        return
+            except BaseException as e:  # noqa: BLE001
+                err.append(e)
+            finally:
+                q.close()
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.pop()
+                if item is _native.BlockingQueue.CLOSED:
+                    break
+                yield item
+        finally:
+            q.close()
         if err:
             raise err[0]

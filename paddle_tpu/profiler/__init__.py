@@ -57,16 +57,53 @@ class TracerEventType(Enum):
 
 
 class _HostTracer:
-    """Process-global buffer of completed host ranges."""
+    """Process-global buffer of completed host ranges.
+
+    When the native library is available, ranges land in the C++ ring buffer
+    (paddle_tpu/_native host_tracer.cc — the HostTracer equivalent); else in
+    a Python list. ``drain`` normalizes both to the same event dicts.
+    """
 
     def __init__(self) -> None:
-        self.enabled = False
+        self._enabled = False
         self._lock = threading.Lock()
         self.events: List[Dict[str, Any]] = []
+        # resolved on first enable — importing the profiler must not trigger
+        # the native build
+        self._native: Any = None
+        self._native_resolved = False
+
+    def _resolve_native(self) -> None:
+        if not self._native_resolved:
+            from .. import _native
+            self._native = _native.lib if _native.available() else None
+            self._native_resolved = True
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @enabled.setter
+    def enabled(self, on: bool) -> None:
+        if on == self._enabled:
+            return
+        if on:
+            self._resolve_native()
+        self._enabled = on
+        if self._native is not None:
+            if on:
+                self._native.pt_trace_enable(1 << 16)
+            else:
+                self._native.pt_trace_disable()
 
     def emit(self, name: str, t0: float, t1: float,
              event_type: "TracerEventType") -> None:
-        if not self.enabled:
+        if not self._enabled:
+            return
+        if self._native is not None:
+            self._native.pt_trace_emit(name.encode()[:63], int(t0 * 1e9),
+                                       int(t1 * 1e9), event_type.value,
+                                       threading.get_ident() & 0xFFFFFF)
             return
         with self._lock:
             self.events.append({
@@ -75,6 +112,25 @@ class _HostTracer:
             })
 
     def drain(self) -> List[Dict[str, Any]]:
+        if self._native is not None:
+            import ctypes
+            # quiesce emitters between the sizing and fill calls — a range
+            # emitted in between would grow past the sized buffer and
+            # truncate the JSON mid-document
+            was_enabled = self._enabled
+            if was_enabled:
+                self._native.pt_trace_disable()
+            need = self._native.pt_trace_dump(None, 0)
+            buf = ctypes.create_string_buffer(int(need))
+            self._native.pt_trace_dump(buf, need)
+            if was_enabled:
+                self._native.pt_trace_enable(1 << 16)
+            raw = json.loads(buf.value.decode())
+            return [{
+                "name": e["name"], "ts": e["ts"] / 1e6, "dur": e["dur"] / 1e6,
+                "tid": e["tid"],
+                "type": TracerEventType(e["cat"]).name,
+            } for e in raw]
         with self._lock:
             ev, self.events = self.events, []
         return ev

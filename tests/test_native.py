@@ -1,0 +1,279 @@
+"""Native C++ runtime components: TCPStore, BlockingQueue, host tracer.
+
+Covers the reference's native seams (SURVEY.md §2.1 BlockingQueue feed,
+§2.3 TCPStore rendezvous, §5 HostTracer) on our C++ implementations, plus the
+pure-Python protocol fallback and native<->Python interop.
+"""
+
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import _native
+from paddle_tpu.distributed.store import TCPStore
+
+
+def test_native_builds():
+    # g++ is a baked-in toolchain dependency; the native library must build.
+    assert _native.available(), _native.build_error()
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("use_native", [True, False])
+def test_tcp_store_basic(use_native):
+    if use_native and not _native.available():
+        pytest.skip("native unavailable")
+    master = TCPStore(is_master=True, world_size=1, use_native=use_native)
+    try:
+        master.set("alpha", b"hello")
+        assert master.get("alpha") == b"hello"
+        assert master.check("alpha")
+        assert not master.check("missing")
+        assert master.add("counter", 3) == 3
+        assert master.add("counter", -1) == 2
+        assert master.num_keys() == 2
+        assert master.delete_key("alpha")
+        assert not master.check("alpha")
+        with pytest.raises(TimeoutError):
+            master.get("missing", timeout=0.1)
+    finally:
+        master.close()
+
+
+@pytest.mark.parametrize("server_native,client_native", [
+    (True, False), (False, True)])
+def test_tcp_store_interop(server_native, client_native):
+    """The C++ and Python ends speak the same wire protocol."""
+    if not _native.available():
+        pytest.skip("native unavailable")
+    master = TCPStore(is_master=True, world_size=2, use_native=server_native)
+    try:
+        peer = TCPStore("127.0.0.1", master.port, world_size=2,
+                        use_native=client_native)
+        peer.set("from_peer", b"\x00\x01binary\xff")
+        assert master.get("from_peer") == b"\x00\x01binary\xff"
+        assert master.add("n", 5) == 5
+        assert peer.add("n", 5) == 10
+        peer.close()
+    finally:
+        master.close()
+
+
+def test_tcp_store_wait_blocks_until_set():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        result = {}
+
+        def setter():
+            time.sleep(0.2)
+            other = TCPStore("127.0.0.1", master.port)
+            other.set("late", b"now")
+            other.close()
+
+        t = threading.Thread(target=setter)
+        t.start()
+        t0 = time.monotonic()
+        master.wait("late", timeout=5)
+        result["elapsed"] = time.monotonic() - t0
+        t.join()
+        assert master.get("late") == b"now"
+        assert result["elapsed"] >= 0.1
+    finally:
+        master.close()
+
+
+def test_tcp_store_cross_process():
+    """A subprocess client rendezvouses through the in-process server."""
+    master = TCPStore(is_master=True, world_size=2)
+    try:
+        code = (
+            "from paddle_tpu.distributed.store import TCPStore\n"
+            f"s = TCPStore('127.0.0.1', {master.port}, world_size=2)\n"
+            "s.set('child_key', b'from-child')\n"
+            "assert s.get('parent_key', timeout=10) == b'from-parent'\n"
+            "s.add('rendezvous', 1)\n"
+            "s.close()\n"
+        )
+        proc = subprocess.Popen([sys.executable, "-c", code])
+        master.set("parent_key", b"from-parent")
+        assert master.get("child_key", timeout=10) == b"from-child"
+        master.wait("rendezvous", timeout=10)
+        assert proc.wait(timeout=20) == 0
+    finally:
+        master.close()
+
+
+def test_tcp_store_barrier():
+    master = TCPStore(is_master=True, world_size=3)
+    try:
+        peers = [TCPStore("127.0.0.1", master.port, world_size=3)
+                 for _ in range(2)]
+        done = []
+
+        def arrive(store, delay):
+            time.sleep(delay)
+            store.barrier("b0", timeout=10)
+            done.append(time.monotonic())
+
+        threads = [threading.Thread(target=arrive, args=(s, d))
+                   for s, d in zip(peers, (0.05, 0.15))]
+        for t in threads:
+            t.start()
+        arrive(master, 0.0)
+        for t in threads:
+            t.join()
+        assert len(done) == 3
+        # nobody passes the barrier before the last arrival (~0.15s)
+        assert max(done) - min(done) < 0.5
+        for s in peers:
+            s.close()
+    finally:
+        master.close()
+
+
+def test_tcp_store_barrier_reusable():
+    """The same barrier name must synchronize again on a second round."""
+    master = TCPStore(is_master=True, world_size=2)
+    try:
+        peer = TCPStore("127.0.0.1", master.port, world_size=2)
+        order = []
+
+        def worker():
+            peer.barrier("r", timeout=10)
+            time.sleep(0.2)
+            order.append("peer-before-2nd")
+            peer.barrier("r", timeout=10)
+
+        t = threading.Thread(target=worker)
+        t.start()
+        master.barrier("r", timeout=10)
+        master.barrier("r", timeout=10)  # must block until peer's 2nd arrival
+        order.append("master-after-2nd")
+        t.join()
+        assert order == ["peer-before-2nd", "master-after-2nd"]
+        peer.close()
+    finally:
+        master.close()
+
+
+def test_tcp_store_concurrent_get():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        payloads = {f"k{i}": bytes([i]) * (100 + i) for i in range(8)}
+        for k, v in payloads.items():
+            master.set(k, v)
+        results, errs = {}, []
+
+        def getter(k):
+            try:
+                for _ in range(50):
+                    assert master.get(k) == payloads[k]
+                results[k] = True
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=getter, args=(k,)) for k in payloads]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs and len(results) == 8
+    finally:
+        master.close()
+
+
+# ---------------------------------------------------------------------------
+# BlockingQueue
+# ---------------------------------------------------------------------------
+def test_blocking_queue_fifo_and_backpressure():
+    if not _native.available():
+        pytest.skip("native unavailable")
+    q = _native.BlockingQueue(2)
+    assert q.push({"i": 0}) and q.push({"i": 1})
+    assert not q.push({"i": 2}, timeout=0.05)  # full -> timeout
+    assert q.pop()["i"] == 0
+    assert q.push({"i": 2}, timeout=1.0)
+    assert [q.pop()["i"] for _ in range(2)] == [1, 2]
+    assert q.pop(timeout=0.05) is _native.BlockingQueue.TIMEOUT
+    q.close()
+    assert q.pop() is _native.BlockingQueue.CLOSED
+    assert not q.push({"i": 9})
+
+
+def test_blocking_queue_producer_consumer():
+    if not _native.available():
+        pytest.skip("native unavailable")
+    q = _native.BlockingQueue(4)
+    n = 200
+
+    def producer():
+        for i in range(n):
+            q.push(i)
+        q.close()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    got = []
+    while True:
+        item = q.pop()
+        if item is _native.BlockingQueue.CLOSED:
+            break
+        got.append(item)
+    t.join()
+    assert got == list(range(n))
+
+
+def test_dataloader_uses_native_queue():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    xs = np.arange(32, dtype=np.float32).reshape(16, 2)
+    ds = TensorDataset([paddle.to_tensor(xs)])
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = [b[0].numpy() for b in loader]
+    assert len(batches) == 4
+    np.testing.assert_allclose(np.concatenate(batches), xs)
+
+
+# ---------------------------------------------------------------------------
+# host tracer
+# ---------------------------------------------------------------------------
+def test_native_tracer_roundtrip(tmp_path):
+    import paddle_tpu.profiler as profiler
+
+    with profiler.Profiler() as prof:
+        with profiler.RecordEvent("stage_a"):
+            time.sleep(0.01)
+        with profiler.RecordEvent("stage_b"):
+            pass
+    names = {e["name"] for e in prof.events()}
+    assert {"stage_a", "stage_b"} <= names
+    a = next(e for e in prof.events() if e["name"] == "stage_a")
+    assert a["dur"] >= 0.005
+    assert a["type"] == "UserDefined"
+    out = tmp_path / "trace.json"
+    prof.export_chrome_tracing(str(out))
+    data = profiler.load_profiler_result(str(out))
+    assert any(e["name"] == "stage_a" for e in data["traceEvents"])
+
+
+def test_tracer_names_with_special_chars():
+    """Quotes/backslashes/non-ASCII in range names must survive the native
+    JSON dump (escaping regression)."""
+    import paddle_tpu.profiler as profiler
+
+    tricky = ['load "train" shard', "back\\slash", "日本語レンジ", "ctl\x01chr"]
+    with profiler.Profiler() as prof:
+        for name in tricky:
+            with profiler.RecordEvent(name):
+                pass
+    assert len(prof.events()) >= len(tricky)
+    names = {e["name"] for e in prof.events()}
+    assert any("train" in n for n in names)
