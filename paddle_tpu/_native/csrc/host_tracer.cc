@@ -48,8 +48,10 @@ uint64_t Tid() {
   return std::hash<std::thread::id>{}(std::this_thread::get_id()) & 0xffffff;
 }
 
-// JSON string escaping for event names (quotes, backslashes, control bytes,
-// and any non-ASCII byte — names may arrive truncated mid-UTF-8-codepoint).
+// JSON string escaping for event names: quotes, backslashes, control bytes.
+// Bytes >= 0x80 pass through untouched — the emitter guarantees valid UTF-8
+// (Python truncates on codepoint boundaries), and per-byte \u00XX escapes
+// would turn multi-byte characters into mojibake after json.loads.
 std::string JsonEscape(const char* s) {
   std::string out;
   for (const char* p = s; *p; p++) {
@@ -57,7 +59,7 @@ std::string JsonEscape(const char* s) {
     if (c == '"' || c == '\\') {
       out += '\\';
       out += static_cast<char>(c);
-    } else if (c < 0x20 || c > 0x7e) {
+    } else if (c < 0x20) {
       char esc[8];
       std::snprintf(esc, sizeof(esc), "\\u%04x", c);
       out += esc;
@@ -128,14 +130,15 @@ uint64_t pt_trace_dump(char* buf, uint64_t buflen) {
           ? 0
           : g_tracer.head;  // oldest surviving slot when wrapped
   std::string out = "[";
-  char tmp[448];
+  char tmp[128];  // numeric fields only — the name is appended unbounded
   for (uint64_t i = 0; i < n; i++) {
     const Event& e = g_tracer.ring[(start + i) % g_tracer.ring.size()];
+    if (i) out += ",";
+    out += "{\"name\":\"";
+    out += JsonEscape(e.name);
     std::snprintf(tmp, sizeof(tmp),
-                  "%s{\"name\":\"%s\",\"ts\":%.3f,\"dur\":%.3f,"
-                  "\"tid\":%llu,\"cat\":%u}",
-                  i ? "," : "", JsonEscape(e.name).c_str(), e.t0_ns / 1e3,
-                  (e.t1_ns - e.t0_ns) / 1e3,
+                  "\",\"ts\":%.3f,\"dur\":%.3f,\"tid\":%llu,\"cat\":%u}",
+                  e.t0_ns / 1e3, (e.t1_ns - e.t0_ns) / 1e3,
                   static_cast<unsigned long long>(e.tid), e.cat);
     out += tmp;
   }

@@ -179,6 +179,17 @@ struct Server {
       if (!WriteFull(fd, &status, 1) || !WriteFull(fd, &olen, 8)) break;
       if (olen && !WriteFull(fd, out.data(), olen)) break;
     }
+    {
+      // deregister before closing so Stop() never shutdown()s a recycled
+      // descriptor belonging to an unrelated connection
+      std::lock_guard<std::mutex> g(conn_mu);
+      for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it) {
+        if (*it == fd) {
+          conn_fds.erase(it);
+          break;
+        }
+      }
+    }
     ::close(fd);
   }
 

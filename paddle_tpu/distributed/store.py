@@ -205,6 +205,8 @@ class TCPStore(Store):
         self._native = native
         self._server = None
         self._server_native = None
+        self._client = None  # set before any fallible step so close() is
+        # always safe, even when __init__ raises partway
         if is_master:
             if native:
                 self._server_native = _native.lib.pt_store_server_start(port)
@@ -217,21 +219,26 @@ class TCPStore(Store):
         else:
             self.port = port
         self._barrier_rounds: Dict[str, int] = {}
-        # resolve to an IPv4 literal for the native client (inet_pton);
-        # resolution failure must be loud — a fallback address would
-        # rendezvous with the wrong store on multi-host jobs
         try:
-            addr = socket.gethostbyname(host)
-        except OSError as e:
-            raise ConnectionError(f"TCPStore: cannot resolve {host!r}") from e
-        if native:
-            self._client = _native.lib.pt_store_client_new(
-                addr.encode(), self.port, timeout)
-            if not self._client:
+            # resolve to an IPv4 literal for the native client (inet_pton);
+            # resolution failure must be loud — a fallback address would
+            # rendezvous with the wrong store on multi-host jobs
+            try:
+                addr = socket.gethostbyname(host)
+            except OSError as e:
                 raise ConnectionError(
-                    f"TCPStore connect to {addr}:{self.port} failed")
-        else:
-            self._client = _PyClient(addr, self.port, timeout)
+                    f"TCPStore: cannot resolve {host!r}") from e
+            if native:
+                self._client = _native.lib.pt_store_client_new(
+                    addr.encode(), self.port, timeout)
+                if not self._client:
+                    raise ConnectionError(
+                        f"TCPStore connect to {addr}:{self.port} failed")
+            else:
+                self._client = _PyClient(addr, self.port, timeout)
+        except Exception:
+            self.close()  # don't leak a started server on a failed init
+            raise
 
     # -- ops ---------------------------------------------------------------
     def set(self, key: str, value: Union[bytes, str]) -> None:

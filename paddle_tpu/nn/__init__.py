@@ -30,6 +30,10 @@ from .loss import (  # noqa: F401
     KLDivLoss, SmoothL1Loss, MarginRankingLoss, CosineEmbeddingLoss,
     TripletMarginLoss, HingeEmbeddingLoss,
 )
+from .rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
+    LSTM, GRU,
+)
 from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

@@ -1,0 +1,425 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU cells and multi-layer wrappers.
+
+Parity surface: python/paddle/nn/layer/rnn.py (upstream ``RNNCellBase``,
+``SimpleRNNCell``, ``LSTMCell``, ``GRUCell``, ``RNN``, ``BiRNN``,
+``SimpleRNN``, ``LSTM``, ``GRU`` — no line cites: reference mount was empty,
+see SURVEY.md provenance). TPU-native design: one full-sequence recurrence is
+ONE dispatched op whose body is a ``jax.lax.scan`` — static-shape,
+compiler-friendly control flow (no Python loop per timestep), with the vjp
+taken through the whole scan at dispatch time. Gate orders match the
+reference: LSTM chunks [i, f, g, o]; GRU chunks [r, z, c] with
+``h' = z*h + (1-z)*c``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply
+from . import functional as F
+from .initializer import Uniform
+from .layer import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+    "SimpleRNN", "LSTM", "GRU",
+]
+
+
+# ---------------------------------------------------------------------------
+# pure jax cell step functions (shared by cells and scans)
+# ---------------------------------------------------------------------------
+def _simple_step(xt, h, w_ih, w_hh, b_ih, b_hh, activation):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    return act(xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+
+
+def _lstm_step(xt, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = xt @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    return o * jnp.tanh(c_new), c_new  # h', c'
+
+
+def _gru_step(xt, h, w_ih, w_hh, b_ih, b_hh):
+    xg = xt @ w_ih.T + b_ih
+    hg = h @ w_hh.T + b_hh
+    xr, xz, xc = jnp.split(xg, 3, axis=-1)
+    hr, hz, hc = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    return z * h + (1.0 - z) * c
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+class RNNCellBase(Layer):
+    """Base: holds (gates*hidden, input) / (gates*hidden, hidden) weights with
+    the reference's Uniform(-1/sqrt(hidden), 1/sqrt(hidden)) init."""
+
+    def _make_params(self, input_size: int, hidden_size: int, n_gates: int):
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (n_gates * hidden_size, input_size), default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (n_gates * hidden_size, hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (n_gates * hidden_size,), is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            (n_gates * hidden_size,), is_bias=True, default_initializer=init)
+
+    def _zero_state(self, x: Tensor, hidden_size: int):
+        batch = x.shape[0]
+        return Tensor(jnp.zeros((batch, hidden_size), x._data.dtype))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: str = "tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError(f"unknown activation {activation!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self._make_params(input_size, hidden_size, 1)
+
+    def forward(self, inputs: Tensor, states: Optional[Tensor] = None):
+        h = states if states is not None else self._zero_state(
+            inputs, self.hidden_size)
+        out = apply("simple_rnn_cell", _simple_step, inputs, h,
+                    self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+                    activation=self.activation)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size: int, hidden_size: int, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_params(input_size, hidden_size, 4)
+
+    def forward(self, inputs: Tensor, states=None):
+        if states is None:
+            h = self._zero_state(inputs, self.hidden_size)
+            c = self._zero_state(inputs, self.hidden_size)
+        else:
+            h, c = states
+        h_new, c_new = apply("lstm_cell", _lstm_step, inputs, h, c,
+                             self.weight_ih, self.weight_hh, self.bias_ih,
+                             self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size: int, hidden_size: int, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self._make_params(input_size, hidden_size, 3)
+
+    def forward(self, inputs: Tensor, states: Optional[Tensor] = None):
+        h = states if states is not None else self._zero_state(
+            inputs, self.hidden_size)
+        out = apply("gru_cell", _gru_step, inputs, h, self.weight_ih,
+                    self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, out
+
+
+# ---------------------------------------------------------------------------
+# full-sequence scans (each is ONE dispatched op over lax.scan)
+# ---------------------------------------------------------------------------
+def _scan_layer(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_lens, *,
+                reverse: bool, activation: str):
+    """x: [B, T, I] batch-major. Returns (ys [B, T, H], h_T, c_T).
+
+    ``seq_lens`` (or None) masks padded steps: state freezes past the valid
+    length; reverse scans start at the last valid step (the reference's
+    sequence_length semantics).
+    """
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+    T = xs.shape[0]
+    ts = jnp.arange(T) if seq_lens is not None else None
+
+    def step(carry, inp):
+        t, xt = inp
+        h, c = carry
+        if mode == "LSTM":
+            h_new, c_new = _lstm_step(xt, h, c, w_ih, w_hh, b_ih, b_hh)
+        elif mode == "GRU":
+            h_new, c_new = _gru_step(xt, h, w_ih, w_hh, b_ih, b_hh), c
+        else:
+            h_new, c_new = _simple_step(xt, h, w_ih, w_hh, b_ih, b_hh,
+                                        activation), c
+        if seq_lens is not None:
+            valid = (t < seq_lens)[:, None]
+            h_new = jnp.where(valid, h_new, h)
+            c_new = jnp.where(valid, c_new, c)
+        return (h_new, c_new), h_new
+
+    if reverse and seq_lens is None:
+        xs = xs[::-1]
+    if reverse and seq_lens is not None:
+        # flip only the valid prefix of each row so the reverse scan starts
+        # at the last real token: index T-1-t clamped into the valid range
+        idx = jnp.clip(seq_lens[None, :] - 1 - jnp.arange(T)[:, None], 0, T - 1)
+        xs = jnp.take_along_axis(xs, idx[:, :, None], axis=0)
+
+    inp = (ts, xs) if seq_lens is not None else (jnp.zeros((T,)), xs)
+    (h_T, c_T), ys = lax.scan(step, (h0, c0), inp)
+
+    if reverse and seq_lens is None:
+        ys = ys[::-1]
+    if reverse and seq_lens is not None:
+        idx = jnp.clip(seq_lens[None, :] - 1 - jnp.arange(T)[:, None], 0, T - 1)
+        ys = jnp.take_along_axis(ys, idx[:, :, None], axis=0)
+    if seq_lens is not None:
+        ys = jnp.where((jnp.arange(T)[:, None] < seq_lens)[:, :, None], ys, 0.0)
+    return jnp.swapaxes(ys, 0, 1), h_T, c_T
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (parity: paddle.nn.RNN). The recurrence is
+    dispatched as one lax.scan op, not a Python timestep loop."""
+
+    def __init__(self, cell: RNNCellBase, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs: Tensor, initial_states=None,
+                sequence_length: Optional[Tensor] = None):
+        # exact-type cells take the fused lax.scan fast path; subclassed /
+        # custom cells may override forward, so they run through it step by
+        # step (correct but unfused)
+        if type(self.cell) is LSTMCell:
+            mode = "LSTM"
+        elif type(self.cell) is GRUCell:
+            mode = "GRU"
+        elif type(self.cell) is SimpleRNNCell:
+            mode = "RNN_TANH"
+        else:
+            return self._generic_forward(inputs, initial_states,
+                                         sequence_length)
+        x = inputs.transpose([1, 0, 2]) if self.time_major else inputs
+        hsz = self.cell.hidden_size
+        batch = x.shape[0]
+        if initial_states is None:
+            z = Tensor(jnp.zeros((batch, hsz), x._data.dtype))
+            h0, c0 = z, z
+        elif isinstance(initial_states, (tuple, list)):
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, initial_states
+        ys, h_T, c_T = _run_scan(mode, x, h0, c0, self.cell.weight_ih,
+                                 self.cell.weight_hh, self.cell.bias_ih,
+                                 self.cell.bias_hh, sequence_length,
+                                 reverse=self.is_reverse,
+                                 activation=getattr(self.cell, "activation",
+                                                    "tanh"))
+        if self.time_major:
+            ys = ys.transpose([1, 0, 2])
+        final = (h_T, c_T) if mode == "LSTM" else h_T
+        return ys, final
+
+    def _generic_forward(self, inputs: Tensor, initial_states,
+                         sequence_length):
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "sequence_length is only supported with the built-in cells")
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        state = initial_states
+        outs: list = [None] * T
+        for t in steps:
+            y, state = self.cell(x[t], state)
+            outs[t] = y
+        ys = _stack0(outs)
+        if not self.time_major:
+            ys = ys.transpose([1, 0, 2])
+        return ys, state
+
+
+def _run_scan(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_lens, *, reverse,
+              activation):
+    args = [x, h0, c0, w_ih, w_hh, b_ih, b_hh]
+    if seq_lens is not None:
+        sl = seq_lens if isinstance(seq_lens, Tensor) else Tensor(
+            jnp.asarray(seq_lens))
+        return apply(f"rnn_scan_{mode.lower()}",
+                     lambda x_, h_, c_, wi, wh, bi, bh, s: _scan_layer(
+                         mode, x_, h_, c_, wi, wh, bi, bh, s,
+                         reverse=reverse, activation=activation),
+                     *args, sl)
+    return apply(f"rnn_scan_{mode.lower()}",
+                 lambda x_, h_, c_, wi, wh, bi, bh: _scan_layer(
+                     mode, x_, h_, c_, wi, wh, bi, bh, None,
+                     reverse=reverse, activation=activation),
+                 *args)
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (parity: paddle.nn.BiRNN)."""
+
+    def __init__(self, cell_fw: RNNCellBase, cell_bw: RNNCellBase,
+                 time_major: bool = False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        y_bw, s_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        return _concat_last(y_fw, y_bw), (s_fw, s_bw)
+
+
+def _concat_last(a: Tensor, b: Tensor) -> Tensor:
+    return apply("concat", lambda x, y: jnp.concatenate([x, y], axis=-1), a, b)
+
+
+# ---------------------------------------------------------------------------
+# multi-layer recurrent networks
+# ---------------------------------------------------------------------------
+class _RNNBase(Layer):
+    MODE = "RNN_TANH"
+    N_GATES = 1
+
+    def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
+                 direction: str = "forward", time_major: bool = False,
+                 dropout: float = 0.0, activation: str = "tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirectional = direction != "forward"
+        self.num_directions = 2 if self.bidirectional else 1
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        gh = self.N_GATES * hidden_size
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+            for d in range(self.num_directions):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                setattr(self, f"weight_ih{sfx}", self.create_parameter(
+                    (gh, in_sz), default_initializer=init))
+                setattr(self, f"weight_hh{sfx}", self.create_parameter(
+                    (gh, hidden_size), default_initializer=init))
+                setattr(self, f"bias_ih{sfx}", self.create_parameter(
+                    (gh,), is_bias=True, default_initializer=init))
+                setattr(self, f"bias_hh{sfx}", self.create_parameter(
+                    (gh,), is_bias=True, default_initializer=init))
+
+    def _layer_params(self, layer: int, d: int):
+        sfx = f"_l{layer}" + ("_reverse" if d else "")
+        return (getattr(self, f"weight_ih{sfx}"),
+                getattr(self, f"weight_hh{sfx}"),
+                getattr(self, f"bias_ih{sfx}"),
+                getattr(self, f"bias_hh{sfx}"))
+
+    def forward(self, inputs: Tensor, initial_states=None,
+                sequence_length=None):
+        x = inputs.transpose([1, 0, 2]) if self.time_major else inputs
+        batch = x.shape[0]
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        is_lstm = self.MODE == "LSTM"
+
+        if initial_states is None:
+            zeros = Tensor(jnp.zeros((L * D, batch, H), x._data.dtype))
+            init_h, init_c = zeros, zeros
+        elif is_lstm:
+            init_h, init_c = initial_states
+        else:
+            init_h, init_c = initial_states, initial_states
+
+        h_finals, c_finals = [], []
+        out = x
+        for layer in range(L):
+            dir_outs = []
+            for d in range(D):
+                idx = layer * D + d
+                h0 = init_h[idx]
+                c0 = init_c[idx]
+                w_ih, w_hh, b_ih, b_hh = self._layer_params(layer, d)
+                ys, h_T, c_T = _run_scan(
+                    self.MODE, out, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                    sequence_length, reverse=bool(d),
+                    activation=self.activation)
+                dir_outs.append(ys)
+                h_finals.append(h_T)
+                c_finals.append(c_T)
+            out = dir_outs[0] if D == 1 else _concat_last(*dir_outs)
+            if self.dropout and layer < L - 1 and self.training:
+                out = F.dropout(out, p=self.dropout, training=True)
+
+        h_n = _stack0(h_finals)
+        if self.time_major:
+            out = out.transpose([1, 0, 2])
+        if is_lstm:
+            return out, (h_n, _stack0(c_finals))
+        return out, h_n
+
+
+def _stack0(ts) -> Tensor:
+    return apply("stack", lambda *xs: jnp.stack(xs, axis=0), *ts)
+
+
+class SimpleRNN(_RNNBase):
+    """Parity: paddle.nn.SimpleRNN."""
+    MODE = "RNN_TANH"
+    N_GATES = 1
+
+
+class LSTM(_RNNBase):
+    """Parity: paddle.nn.LSTM (gate order [i, f, g, o])."""
+    MODE = "LSTM"
+    N_GATES = 4
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class GRU(_RNNBase):
+    """Parity: paddle.nn.GRU (gate order [r, z, c], h' = z*h + (1-z)*c)."""
+    MODE = "GRU"
+    N_GATES = 3
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)

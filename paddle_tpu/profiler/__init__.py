@@ -101,8 +101,12 @@ class _HostTracer:
         if not self._enabled:
             return
         if self._native is not None:
-            self._native.pt_trace_emit(name.encode()[:63], int(t0 * 1e9),
-                                       int(t1 * 1e9), event_type.value,
+            raw = name.encode("utf-8")
+            if len(raw) > 63:  # truncate on a codepoint boundary: the native
+                # ring stores fixed 64-byte names and must stay valid UTF-8
+                raw = raw[:63].decode("utf-8", "ignore").encode("utf-8")
+            self._native.pt_trace_emit(raw, int(t0 * 1e9), int(t1 * 1e9),
+                                       event_type.value,
                                        threading.get_ident() & 0xFFFFFF)
             return
         with self._lock:
