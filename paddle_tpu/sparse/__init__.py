@@ -1,0 +1,497 @@
+"""``paddle.sparse``: COO/CSR sparse tensors and ops.
+
+Parity surface: python/paddle/sparse/ + paddle/phi/kernels/sparse/ (upstream
+``SparseCooTensor``/``SparseCsrTensor`` core types and the sparse op set —
+no line cites: reference mount was empty, see SURVEY.md provenance).
+
+TPU-native design: sparsity is a *format*, not a kernel library — XLA has no
+native sparse types, so COO/CSR are index+values pairs whose compute lowers
+to dense gathers/segment-sums (MXU/VPU-friendly, static shapes once nnz is
+fixed at construction). The values leaf is a framework ``Tensor``, so every
+sparse op that touches values flows through the op-dispatch layer and is
+autograd-capable (gradients w.r.t. values; indices are structural).
+``nnz`` is a trace-time constant — under ``jit`` the sparsity pattern is
+static, matching how detection/recsys workloads bucket their sparsity.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, to_tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "transpose", "reshape", "sum",
+    "relu", "tanh", "sin", "asin", "sinh", "asinh", "tan", "atan", "atanh",
+    "sqrt", "square", "abs", "pow", "neg", "expm1", "log1p", "cast",
+    "coalesce", "nn",
+]
+
+
+def _as_array(x, dtype=None):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return a.astype(dtype) if dtype is not None else a
+
+
+class SparseCooTensor:
+    """Coordinate-format sparse tensor: indices [sparse_dim, nnz] + values
+    [nnz, *dense_dims]."""
+
+    def __init__(self, indices, values: Tensor, shape: Sequence[int],
+                 coalesced: bool = False):
+        idx = jnp.asarray(indices)
+        self._indices = idx if jnp.issubdtype(idx.dtype, jnp.integer) \
+            else idx.astype(jnp.int32)
+        self._values = values if isinstance(values, Tensor) else to_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+        if self._indices.ndim != 2:
+            raise ValueError("indices must be [sparse_dim, nnz]")
+        if self._indices.shape[1] != self._values.shape[0]:
+            raise ValueError(
+                f"nnz mismatch: indices {self._indices.shape[1]} vs values "
+                f"{self._values.shape[0]}")
+
+    # -- meta --------------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v: bool) -> None:
+        self._values.stop_gradient = v
+
+    @property
+    def sparse_dim(self) -> int:
+        return int(self._indices.shape[0])
+
+    @property
+    def dense_dim(self) -> int:
+        return len(self._shape) - self.sparse_dim
+
+    def nnz(self) -> int:
+        return int(self._indices.shape[1])
+
+    def indices(self) -> Tensor:
+        return Tensor(self._indices)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # -- conversions -------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        idx = self._indices
+        shape = self._shape
+
+        def fn(v):
+            out = jnp.zeros(shape, v.dtype)
+            return out.at[tuple(idx)].add(v)
+
+        return apply("sparse_coo_to_dense", fn, self._values)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return coalesce(self)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self._shape) != 2 or self.dense_dim != 0:
+            raise ValueError("to_sparse_csr supports 2-D COO tensors")
+        coo = coalesce(self)  # sorts rows-major and merges duplicates
+        rows, cols = np.asarray(coo._indices)
+        m = self._shape[0]
+        crows = np.zeros(m + 1, np.int32)
+        np.add.at(crows[1:], rows, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(crows, cols, coo._values, self._shape)
+
+    # -- arithmetic sugar --------------------------------------------------
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __truediv__(self, other):
+        return divide(self, other)
+
+    def __neg__(self):
+        return neg(self)
+
+    def T(self):
+        return transpose(self, list(range(len(self._shape)))[::-1])
+
+    def astype(self, dtype) -> "SparseCooTensor":
+        return cast(self, value_dtype=dtype)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.to_dense()._data)
+
+    def backward(self, *args, **kwargs):
+        return self._values.backward(*args, **kwargs)
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+
+class SparseCsrTensor:
+    """Compressed-sparse-row tensor (2-D): crows [M+1], cols [nnz],
+    values [nnz]."""
+
+    def __init__(self, crows, cols, values: Tensor, shape: Sequence[int]):
+        crows = jnp.asarray(crows)
+        cols = jnp.asarray(cols)
+        self._crows = crows if jnp.issubdtype(crows.dtype, jnp.integer) \
+            else crows.astype(jnp.int32)
+        self._cols = cols if jnp.issubdtype(cols.dtype, jnp.integer) \
+            else cols.astype(jnp.int32)
+        self._values = values if isinstance(values, Tensor) else to_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) != 2:
+            raise ValueError("SparseCsrTensor supports 2-D shapes")
+        if self._crows.shape[0] != self._shape[0] + 1:
+            raise ValueError(f"crows must have {self._shape[0] + 1} entries")
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self) -> int:
+        return int(self._cols.shape[0])
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def _rows(self) -> jnp.ndarray:
+        """Expand crows to a per-nnz row index (static total length)."""
+        counts = jnp.diff(self._crows)
+        return jnp.repeat(jnp.arange(self._shape[0], dtype=jnp.int32), counts,
+                          total_repeat_length=self.nnz())
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        idx = jnp.stack([self._rows(), self._cols])
+        return SparseCooTensor(idx, self._values, self._shape, coalesced=True)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.to_dense()._data)
+
+    def backward(self, *args, **kwargs):
+        return self._values.backward(*args, **kwargs)
+
+    @property
+    def grad(self):
+        return self._values.grad
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient: bool = True
+                      ) -> SparseCooTensor:
+    """Parity: paddle.sparse.sparse_coo_tensor."""
+    idx = _as_array(indices, jnp.int32)
+    vals = values if isinstance(values, Tensor) else to_tensor(
+        _as_array(values, dtype))
+    if dtype is not None and str(vals.dtype) != str(dtype):
+        vals = vals.astype(dtype)
+    if shape is None:
+        sparse_shape = [int(x) + 1 for x in np.asarray(idx).max(axis=1)]
+        shape = sparse_shape + list(vals.shape[1:])
+    out = SparseCooTensor(idx, vals, shape)
+    out.stop_gradient = stop_gradient
+    return out
+
+
+def sparse_csr_tensor(crows, cols, values, shape: Sequence[int], dtype=None,
+                      place=None, stop_gradient: bool = True
+                      ) -> SparseCsrTensor:
+    """Parity: paddle.sparse.sparse_csr_tensor."""
+    vals = values if isinstance(values, Tensor) else to_tensor(
+        _as_array(values, dtype))
+    out = SparseCsrTensor(_as_array(crows, jnp.int32),
+                          _as_array(cols, jnp.int32), vals, shape)
+    out._values.stop_gradient = stop_gradient
+    return out
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# structural ops
+# ---------------------------------------------------------------------------
+def coalesce(x: SparseCooTensor) -> SparseCooTensor:
+    """Sort indices row-major and sum duplicates. Index bookkeeping is eager
+    (NumPy); the values reduction is a dispatched segment-sum, so gradients
+    flow."""
+    if x._coalesced:
+        return x
+    idx = np.asarray(x._indices)
+    lin = np.ravel_multi_index(idx, x._shape[:x.sparse_dim])
+    uniq, inv = np.unique(lin, return_inverse=True)
+    new_idx = np.stack(np.unravel_index(uniq, x._shape[:x.sparse_dim]))
+    n_out = len(uniq)
+    seg = jnp.asarray(inv, jnp.int32)
+
+    def fn(v):
+        return jax.ops.segment_sum(v, seg, num_segments=n_out)
+
+    vals = apply("sparse_coalesce", fn, x._values)
+    return SparseCooTensor(new_idx, vals, x._shape, coalesced=True)
+
+
+def transpose(x: SparseCooTensor, perm: Sequence[int]) -> SparseCooTensor:
+    """Permute dims (sparse dims only — dense-dim permutes reorder values)."""
+    sd = x.sparse_dim
+    if sorted(perm) != list(range(len(x._shape))):
+        raise ValueError(f"bad perm {perm}")
+    sp_perm = [p for p in perm if p < sd]
+    if [p for p in perm if p >= sd] != list(range(sd, len(x._shape))):
+        raise NotImplementedError("transpose across sparse/dense dims")
+    new_idx = x._indices[jnp.asarray(sp_perm)]
+    new_shape = tuple(x._shape[p] for p in perm)
+    return SparseCooTensor(new_idx, x._values, new_shape)
+
+
+def reshape(x: SparseCooTensor, shape: Sequence[int]) -> SparseCooTensor:
+    """Reshape over the sparse dims (dense part unchanged)."""
+    sd = x.sparse_dim
+    sp_shape = x._shape[:sd]
+    new_sp = tuple(int(s) for s in shape[:len(shape) - x.dense_dim])
+    if int(np.prod(sp_shape)) != int(np.prod(new_sp)):
+        raise ValueError("reshape must preserve sparse volume")
+    lin = jnp.ravel_multi_index(tuple(x._indices), sp_shape, mode="clip")
+    new_idx = jnp.stack(jnp.unravel_index(lin, new_sp))
+    return SparseCooTensor(new_idx, x._values,
+                           new_sp + x._shape[sd:], x._coalesced)
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+# ---------------------------------------------------------------------------
+def _same_pattern(a: SparseCooTensor, b: SparseCooTensor) -> bool:
+    return (a._indices.shape == b._indices.shape and
+            bool(jnp.all(a._indices == b._indices)))
+
+
+def _binary(a, b, op_name, fn, union_fn=None):
+    if isinstance(a, SparseCooTensor) and isinstance(b, SparseCooTensor):
+        if list(a._shape) != list(b._shape):
+            raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+        ac, bc = coalesce(a), coalesce(b)
+        if _same_pattern(ac, bc):
+            vals = apply(f"sparse_{op_name}", fn, ac._values, bc._values)
+            return SparseCooTensor(ac._indices, vals, ac._shape, True)
+        if union_fn is None:
+            raise ValueError(
+                f"sparse {op_name} requires matching sparsity patterns")
+        return union_fn(ac, bc)
+    if isinstance(a, SparseCooTensor) and isinstance(b, (int, float)):
+        vals = apply(f"sparse_{op_name}", lambda v: fn(v, b), a._values)
+        return SparseCooTensor(a._indices, vals, a._shape, a._coalesced)
+    if isinstance(a, SparseCooTensor) and isinstance(b, Tensor):
+        if op_name in ("add", "sub"):
+            # add/sub against dense would need values everywhere, i.e. a
+            # densified result — the reference disallows it too
+            raise TypeError(
+                f"sparse {op_name} with a dense Tensor would densify; "
+                "convert with to_dense() first")
+        # mul/div: zeros outside the pattern stay zero, so computing at the
+        # stored sites is exact
+        idx = a._indices
+        vals = apply(f"sparse_{op_name}_dense",
+                     lambda v, d: fn(v, d[tuple(idx)]), a._values, b)
+        return SparseCooTensor(a._indices, vals, a._shape, a._coalesced)
+    raise TypeError(f"unsupported operands for sparse {op_name}")
+
+
+def _union_add(sign: float):
+    def impl(ac: SparseCooTensor, bc: SparseCooTensor) -> SparseCooTensor:
+        idx = jnp.concatenate([ac._indices, bc._indices], axis=1)
+        if sign == 1.0:
+            vals = apply("sparse_concat",
+                         lambda u, v: jnp.concatenate([u, v]), ac._values,
+                         bc._values)
+        else:
+            vals = apply("sparse_concat",
+                         lambda u, v: jnp.concatenate([u, -v]), ac._values,
+                         bc._values)
+        return coalesce(SparseCooTensor(idx, vals, ac._shape))
+    return impl
+
+
+def add(a, b):
+    return _binary(a, b, "add", lambda u, v: u + v, _union_add(1.0))
+
+
+def subtract(a, b):
+    return _binary(a, b, "sub", lambda u, v: u - v, _union_add(-1.0))
+
+
+def multiply(a, b):
+    return _binary(a, b, "mul", lambda u, v: u * v)
+
+
+def divide(a, b):
+    return _binary(a, b, "div", lambda u, v: u / v)
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+def matmul(a, b) -> Tensor:
+    """sparse @ dense → dense. COO/CSR 2-D × dense 2-D: a gather +
+    segment-sum contraction (the TPU lowering of SpMM)."""
+    if isinstance(a, SparseCsrTensor):
+        a = a.to_sparse_coo()
+    if not isinstance(a, SparseCooTensor) or not isinstance(b, Tensor):
+        raise TypeError("matmul expects (sparse, dense Tensor)")
+    if len(a._shape) != 2 or a.dense_dim != 0:
+        raise ValueError("sparse matmul supports 2-D sparse operands")
+    rows, cols = a._indices[0], a._indices[1]
+    m = a._shape[0]
+
+    def fn(v, d):
+        contrib = v[:, None] * d[cols]  # [nnz, N]
+        return jax.ops.segment_sum(contrib, rows, num_segments=m)
+
+    return apply("sparse_matmul", fn, a._values, b)
+
+
+def masked_matmul(a: Tensor, b: Tensor, mask) -> Union[SparseCooTensor,
+                                                       SparseCsrTensor]:
+    """(a @ b) sampled at the sparsity pattern of ``mask`` (SDDMM)."""
+    is_csr = isinstance(mask, SparseCsrTensor)
+    coo = mask.to_sparse_coo() if is_csr else mask
+    rows, cols = coo._indices[0], coo._indices[1]
+
+    def fn(x, y):
+        return jnp.einsum("nk,nk->n", x[rows], y[:, cols].T)
+
+    vals = apply("sparse_masked_matmul", fn, a, b)
+    if is_csr:
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    return SparseCooTensor(coo._indices, vals, coo._shape, coo._coalesced)
+
+
+def sum(x: SparseCooTensor, axis: Optional[int] = None, dtype=None,
+        keepdim: bool = False):
+    """Reduce a COO tensor. Full reduction returns a dense scalar Tensor;
+    axis reductions return a dense Tensor (the reference returns sparse —
+    densifying is the XLA-friendly choice and documented divergence)."""
+    if axis is None:
+        out = apply("sparse_sum", lambda v: jnp.sum(v), x._values)
+        return out.astype(dtype) if dtype is not None else out
+    out = x.to_dense().sum(axis=axis, keepdim=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+# ---------------------------------------------------------------------------
+# unary value ops (pattern-preserving)
+# ---------------------------------------------------------------------------
+def _unary(name, fn):
+    def op(x, *args):  # args are static scalars (e.g. pow exponent)
+        vals = apply(f"sparse_{name}",
+                     (lambda v: fn(v, *args)) if args else fn, x._values)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        if isinstance(x, SparseCooTensor):
+            return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+        raise TypeError(f"sparse.{name} expects a sparse tensor")
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+tanh = _unary("tanh", jnp.tanh)
+sin = _unary("sin", jnp.sin)
+asin = _unary("asin", jnp.arcsin)
+sinh = _unary("sinh", jnp.sinh)
+asinh = _unary("asinh", jnp.arcsinh)
+tan = _unary("tan", jnp.tan)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+
+
+def pow(x, exponent):
+    return _unary("pow", jnp.power)(x, exponent)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    vals = x._values.astype(value_dtype) if value_dtype is not None else \
+        x._values
+    if isinstance(x, SparseCooTensor):
+        idx = x._indices.astype(index_dtype) if index_dtype is not None \
+            else x._indices
+        return SparseCooTensor(idx, vals, x._shape, x._coalesced)
+    crows = x._crows.astype(index_dtype) if index_dtype is not None \
+        else x._crows
+    cols = x._cols.astype(index_dtype) if index_dtype is not None else x._cols
+    return SparseCsrTensor(crows, cols, vals, x._shape)
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,219 @@
+"""``paddle.sparse.nn``: layers over sparse tensors.
+
+Parity surface: python/paddle/sparse/nn/ (ReLU, Softmax, Conv3D, SubmConv3D,
+BatchNorm — no line cites: reference mount was empty, see SURVEY.md
+provenance). TPU-native note: XLA has no sparse conv kernels (the reference
+uses gather-scatter CUDA rulebooks); Conv3D/SubmConv3D lower to a dense
+``lax.conv_general_dilated`` over the densified input — bit-identical
+semantics, efficient on MXU for the moderate resolutions TPUs favor, and the
+submanifold variant re-masks the output to the input's active sites. The
+active-site set (nnz) stays static under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer import Layer
+from ..nn.initializer import XavierUniform
+from . import SparseCooTensor, relu as _relu_fn
+
+__all__ = ["ReLU", "Softmax", "Conv3D", "SubmConv3D", "BatchNorm"]
+
+
+class ReLU(Layer):
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        return _relu_fn(x)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over the last sparse axis (parity:
+    paddle.sparse.nn.Softmax for 2-D COO/CSR): normalization runs per-row
+    over the *stored* entries via segment ops."""
+
+    def __init__(self, axis: int = -1):
+        super().__init__()
+        if axis != -1:
+            raise NotImplementedError("sparse softmax supports axis=-1")
+
+    def forward(self, x):
+        from . import SparseCsrTensor, coalesce
+        is_csr = hasattr(x, "crows") and x.is_sparse_csr()
+        coo = x.to_sparse_coo() if is_csr else coalesce(x)
+        if len(coo._shape) != 2 or coo.dense_dim != 0:
+            raise NotImplementedError("sparse softmax supports 2-D tensors")
+        rows = coo._indices[0]
+        m = coo._shape[0]
+
+        def fn(v):
+            row_max = jax.ops.segment_max(v, rows, num_segments=m)
+            e = jnp.exp(v - row_max[rows])
+            denom = jax.ops.segment_sum(e, rows, num_segments=m)
+            return e / denom[rows]
+
+        vals = apply("sparse_softmax", fn, coo._values)
+        if is_csr:
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        return SparseCooTensor(coo._indices, vals, coo._shape, True)
+
+
+class _SparseConv3D(Layer):
+    """Shared impl for Conv3D / SubmConv3D on NDHWC COO inputs."""
+
+    SUBM = False
+
+    def __init__(self, in_channels: int, out_channels: int,
+                 kernel_size=3, stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode: str = "zeros", weight_attr=None,
+                 bias_attr=None, data_format: str = "NDHWC"):
+        super().__init__()
+        if data_format != "NDHWC":
+            raise ValueError("sparse conv expects NDHWC")
+        k = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self.kernel_size = k
+        self.stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+        self.padding = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        self.dilation = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+        self.groups = groups
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        if self.SUBM:
+            if self.stride != (1, 1, 1):
+                raise ValueError("SubmConv3D requires stride 1")
+            # submanifold gathers output at *input* coordinates, so the conv
+            # must preserve spatial dims: 2p == dilation*(k-1) per axis
+            for p, d, kk in zip(self.padding, self.dilation, k):
+                if 2 * p != d * (kk - 1):
+                    raise ValueError(
+                        "SubmConv3D requires size-preserving padding "
+                        "(2*padding == dilation*(kernel-1)); got padding="
+                        f"{self.padding}, dilation={self.dilation}, "
+                        f"kernel={k}")
+        # reference kernel layout: [kd, kh, kw, in/groups, out]
+        self.weight = self.create_parameter(
+            (*k, in_channels // groups, out_channels),
+            attr=weight_attr, default_initializer=XavierUniform())
+        self.bias = self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        if x.sparse_dim != 4 or x.dense_dim != 1:
+            raise ValueError(
+                "sparse Conv3D expects COO with indices [N,D,H,W] and dense "
+                "channel values")
+        idx = x._indices
+        shape = x._shape
+        subm = self.SUBM
+        stride, padding, dilation = self.stride, self.padding, self.dilation
+        groups = self.groups
+
+        def fn(v, w, b):
+            # bias deliberately NOT added here: it belongs only at retained
+            # output sites (adding it grid-wide would densify the output)
+            dense = jnp.zeros(shape, v.dtype).at[tuple(idx)].add(v)
+            out = jax.lax.conv_general_dilated(
+                dense, w,
+                window_strides=stride,
+                padding=[(p, p) for p in padding],
+                rhs_dilation=dilation,
+                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+                feature_group_count=groups)
+            if subm:
+                return out[tuple(idx)] + b
+            return out
+
+        if self.SUBM:
+            vals = apply("subm_conv3d", fn, x._values, self.weight, self.bias)
+            return SparseCooTensor(idx, vals, shape[:4] + (self.out_channels,),
+                                   x._coalesced)
+        out_dense = apply("sparse_conv3d", fn, x._values, self.weight,
+                          self.bias)
+        return _dense_to_coo(out_dense, self.bias)
+
+
+def _dense_to_coo(x: Tensor, bias: Optional[Tensor] = None) -> SparseCooTensor:
+    """Eager re-sparsification of a dense NDHWC tensor (sites with any
+    non-zero channel); ``bias`` is added after site selection so it lands
+    only on retained sites."""
+    import numpy as np
+    arr = np.asarray(x._data)
+    mask = np.any(arr != 0, axis=-1)
+    sites = np.stack(np.nonzero(mask))  # [4, nnz]
+    idx_t = tuple(jnp.asarray(sites))
+
+    if bias is not None:
+        vals = apply("sparse_gather_sites", lambda d, b: d[idx_t] + b, x, bias)
+    else:
+        vals = apply("sparse_gather_sites", lambda d: d[idx_t], x)
+    return SparseCooTensor(sites, vals, x.shape, coalesced=True)
+
+
+class Conv3D(_SparseConv3D):
+    SUBM = False
+
+
+class SubmConv3D(_SparseConv3D):
+    SUBM = True
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last, dense) axis of a COO tensor —
+    statistics are computed over stored values only, matching the reference's
+    sparse BN semantics."""
+
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 data_format: str = "NDHWC", use_global_stats=None,
+                 name=None):
+        super().__init__()
+        from ..nn.initializer import Constant
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+        self._mean = self.create_buffer("_mean",
+                                        jnp.zeros((num_features,)))
+        self._variance = self.create_buffer("_variance",
+                                            jnp.ones((num_features,)))
+
+    def create_buffer(self, name, value):
+        t = Tensor(value)
+        self.register_buffer(name.lstrip("_"), t)
+        return t
+
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        eps = self.epsilon
+        mom = self.momentum
+        training = self.training
+
+        if training:
+            def fn(v, w, b):
+                mean = v.mean(axis=0)
+                var = v.var(axis=0)
+                y = (v - mean) / jnp.sqrt(var + eps) * w + b
+                return y, mean, var
+
+            vals, mean, var = apply("sparse_batch_norm", fn, x._values,
+                                    self.weight, self.bias)
+            self._mean._set_data(mom * self._mean._data +
+                                 (1 - mom) * mean._data)
+            self._variance._set_data(mom * self._variance._data +
+                                     (1 - mom) * var._data)
+        else:
+            rm, rv = self._mean, self._variance
+
+            def fn(v, w, b, m, s):
+                return (v - m) / jnp.sqrt(s + eps) * w + b
+
+            vals = apply("sparse_batch_norm_infer", fn, x._values,
+                         self.weight, self.bias, rm, rv)
+        return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)

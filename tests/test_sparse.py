@@ -1,0 +1,201 @@
+"""Sparse COO/CSR tensors and ops — numerics vs dense NumPy references
+(SURVEY.md §4 op-test pattern), plus autograd through sparse values."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0, dense_dims=0):
+    rng = np.random.default_rng(seed)
+    sp_shape = shape[:len(shape) - dense_dims]
+    lin = rng.choice(int(np.prod(sp_shape)), size=nnz, replace=False)
+    idx = np.stack(np.unravel_index(lin, sp_shape))
+    vals = rng.normal(size=(nnz,) + shape[len(sp_shape):]).astype(np.float32)
+    return idx, vals
+
+
+def test_coo_construct_and_to_dense():
+    idx, vals = _rand_coo()
+    x = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+    assert x.is_sparse() and x.is_sparse_coo() and not x.is_sparse_csr()
+    assert x.nnz() == 6 and x.shape == [4, 5]
+    dense = np.zeros((4, 5), np.float32)
+    dense[tuple(idx)] = vals
+    np.testing.assert_allclose(x.to_dense().numpy(), dense)
+    # infer shape when omitted
+    y = sparse.sparse_coo_tensor(idx, vals)
+    assert y.shape[0] >= idx[0].max() + 1
+
+
+def test_coalesce_merges_duplicates():
+    idx = np.array([[0, 0, 1], [2, 2, 3]])
+    vals = np.array([1.0, 2.0, 5.0], np.float32)
+    x = sparse.sparse_coo_tensor(idx, vals, (2, 4))
+    c = sparse.coalesce(x)
+    assert c.nnz() == 2
+    dense = np.zeros((2, 4), np.float32)
+    np.add.at(dense, tuple(idx), vals)
+    np.testing.assert_allclose(c.to_dense().numpy(), dense)
+
+
+def test_csr_roundtrip():
+    idx, vals = _rand_coo((4, 5), 7, seed=1)
+    coo = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+    csr = coo.to_sparse_csr()
+    assert csr.is_sparse_csr() and csr.nnz() == 7
+    np.testing.assert_allclose(csr.to_dense().numpy(), coo.to_dense().numpy())
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(), coo.to_dense().numpy())
+    # direct csr construction
+    csr2 = sparse.sparse_csr_tensor(csr.crows(), csr.cols(), csr.values(),
+                                    (4, 5))
+    np.testing.assert_allclose(csr2.to_dense().numpy(), coo.to_dense().numpy())
+
+
+def test_arithmetic():
+    idx, vals = _rand_coo((4, 5), 6, seed=2)
+    a = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+    b = sparse.sparse_coo_tensor(idx, vals * 2, (4, 5))
+    da, db = a.to_dense().numpy(), b.to_dense().numpy()
+    np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(), da + db,
+                               rtol=1e-6)
+    np.testing.assert_allclose(sparse.subtract(a, b).to_dense().numpy(),
+                               da - db, rtol=1e-6)
+    np.testing.assert_allclose(sparse.multiply(a, b).to_dense().numpy(),
+                               da * db, rtol=1e-6)
+    np.testing.assert_allclose((a * 3.0).to_dense().numpy(), da * 3, rtol=1e-6)
+    # different patterns: add works via union, multiply raises
+    idx2, vals2 = _rand_coo((4, 5), 5, seed=3)
+    c = sparse.sparse_coo_tensor(idx2, vals2, (4, 5))
+    np.testing.assert_allclose(sparse.add(a, c).to_dense().numpy(),
+                               da + c.to_dense().numpy(), rtol=1e-6)
+    with pytest.raises(ValueError):
+        sparse.multiply(a, c)
+    # sparse * dense
+    d = paddle.to_tensor(np.arange(20).reshape(4, 5).astype(np.float32))
+    np.testing.assert_allclose(sparse.multiply(a, d).to_dense().numpy(),
+                               da * d.numpy(), rtol=1e-6)
+    # sparse + dense would densify silently — must raise
+    with pytest.raises(TypeError):
+        sparse.add(a, d)
+    with pytest.raises(TypeError):
+        sparse.subtract(a, d)
+
+
+def test_matmul_and_masked_matmul():
+    rng = np.random.default_rng(0)
+    idx, vals = _rand_coo((4, 6), 8, seed=4)
+    a = sparse.sparse_coo_tensor(idx, vals, (4, 6))
+    dense = paddle.to_tensor(rng.normal(size=(6, 3)).astype(np.float32))
+    out = sparse.matmul(a, dense)
+    np.testing.assert_allclose(out.numpy(),
+                               a.to_dense().numpy() @ dense.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    # csr operand
+    out2 = sparse.matmul(a.to_sparse_csr(), dense)
+    np.testing.assert_allclose(out2.numpy(), out.numpy(), rtol=1e-6)
+    # SDDMM: (x @ y) sampled at mask
+    x = paddle.to_tensor(rng.normal(size=(4, 5)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(5, 6)).astype(np.float32))
+    mask = sparse.sparse_coo_tensor(idx, np.ones(8, np.float32), (4, 6))
+    got = sparse.masked_matmul(x, y, mask)
+    want = (x.numpy() @ y.numpy()) * (mask.to_dense().numpy() != 0)
+    np.testing.assert_allclose(got.to_dense().numpy(), want, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_unary_ops_and_cast():
+    idx, vals = _rand_coo((4, 5), 6, seed=5)
+    x = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+    np.testing.assert_allclose(sparse.relu(x).values().numpy(),
+                               np.maximum(vals, 0), rtol=1e-6)
+    np.testing.assert_allclose(sparse.tanh(x).values().numpy(),
+                               np.tanh(vals), rtol=1e-6)
+    np.testing.assert_allclose(sparse.pow(x, 2).values().numpy(), vals ** 2,
+                               rtol=1e-6)
+    assert str(sparse.cast(x, value_dtype="float16").dtype).endswith("float16")
+    t = sparse.transpose(x, [1, 0])
+    np.testing.assert_allclose(t.to_dense().numpy(),
+                               x.to_dense().numpy().T, rtol=1e-6)
+    r = sparse.reshape(x, [2, 10])
+    np.testing.assert_allclose(r.to_dense().numpy(),
+                               x.to_dense().numpy().reshape(2, 10), rtol=1e-6)
+    s = sparse.sum(x)
+    np.testing.assert_allclose(float(s), vals.sum(), rtol=1e-5)
+
+
+def test_autograd_through_sparse():
+    idx, vals = _rand_coo((4, 6), 8, seed=6)
+    a = sparse.sparse_coo_tensor(idx, vals, (4, 6), stop_gradient=False)
+    dense = paddle.to_tensor(np.ones((6, 2), np.float32))
+    out = sparse.matmul(a, dense)
+    out.sum().backward()
+    g = a.grad
+    assert g is not None
+    # d(sum(A@1))/dA_ij = sum_k 1 = 2 for every stored element
+    np.testing.assert_allclose(g.numpy(), np.full(8, 2.0), rtol=1e-6)
+
+
+def test_sparse_softmax():
+    idx, vals = _rand_coo((4, 5), 9, seed=7)
+    x = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+    sm = sparse.nn.Softmax()
+    y = sm(x)
+    d = y.to_dense().numpy()
+    rows_with = np.unique(idx[0])
+    for r in rows_with:
+        np.testing.assert_allclose(d[r][d[r] != 0].sum(), 1.0, rtol=1e-5)
+
+
+def test_sparse_conv3d_and_subm():
+    rng = np.random.default_rng(0)
+    N, D, H, W, C = 1, 4, 4, 4, 2
+    idx, _ = _rand_coo((N, D, H, W), 5, seed=8)
+    vals = rng.normal(size=(5, C)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(idx, vals, (N, D, H, W, C))
+
+    conv = sparse.nn.Conv3D(C, 3, kernel_size=3, padding=1)
+    conv.bias.set_value(np.full(3, 0.25, np.float32))
+    y = conv(x)
+    assert y.shape == [N, D, H, W, 3]
+    # dense reference: bias lands only at retained (conv-active) sites —
+    # a nonzero bias must NOT densify the output
+    dense_in = x.to_dense().numpy()
+    import jax
+    import jax.numpy as jnp
+    ref = np.array(jax.lax.conv_general_dilated(
+        jnp.asarray(dense_in), conv.weight._data, (1, 1, 1),
+        [(1, 1)] * 3, dimension_numbers=("NDHWC", "DHWIO", "NDHWC")))
+    active = np.any(ref != 0, axis=-1)
+    ref[active] += 0.25
+    assert y.nnz() == int(active.sum()) < N * D * H * W
+    np.testing.assert_allclose(y.to_dense().numpy(), ref, rtol=1e-4, atol=1e-4)
+    # submanifold without size-preserving padding is rejected
+    with pytest.raises(ValueError):
+        sparse.nn.SubmConv3D(C, 3, kernel_size=3, padding=0)
+
+    subm = sparse.nn.SubmConv3D(C, 3, kernel_size=3, padding=1)
+    ys = subm(x)
+    assert ys.nnz() == x.nnz()  # submanifold preserves active sites
+    out_d = ys.to_dense().numpy()
+    inactive = np.ones((N, D, H, W), bool)
+    inactive[tuple(idx)] = False
+    assert np.all(out_d[inactive] == 0)
+
+
+def test_sparse_batchnorm():
+    idx, _ = _rand_coo((2, 3, 3, 3), 10, seed=9)
+    vals = np.random.default_rng(1).normal(size=(10, 4)).astype(np.float32)
+    x = sparse.sparse_coo_tensor(idx, vals, (2, 3, 3, 3, 4))
+    bn = sparse.nn.BatchNorm(4)
+    y = bn(x)
+    got = y.values().numpy()
+    assert got.shape == (10, 4)
+    np.testing.assert_allclose(got.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(got.std(axis=0), 1.0, atol=1e-2)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.values().numpy().shape == (10, 4)
