@@ -1,0 +1,164 @@
+"""Quantization: STE fake-quant, observers, QAT/PTQ drivers, convert."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+
+
+def test_quant_dequant_numerics_and_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+    x.stop_gradient = False
+    scale = paddle.to_tensor(np.float32(1.0))
+    y = Q.quant_dequant(x, scale, bits=8)
+    # int8 grid: |error| <= scale / 127 / 2 inside range
+    err = np.abs(y.numpy() - x.numpy())
+    assert err.max() <= 1.0 / 127 / 2 + 1e-7
+    # STE: gradient passes straight through
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(11), rtol=1e-6)
+
+    # clipping: values beyond scale saturate
+    big = paddle.to_tensor(np.array([5.0, -5.0], np.float32))
+    out = Q.quant_dequant(big, scale, bits=8).numpy()
+    np.testing.assert_allclose(out, [1.0, -1.0], rtol=1e-6)
+
+
+def test_observers():
+    obs = Q.AbsMaxObserver()
+    obs(paddle.to_tensor(np.array([1.0, -3.0], np.float32)))
+    obs(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert float(obs.scales()) == 3.0
+
+    ema = Q.MovingAverageAbsMaxObserver(moving_rate=0.5)
+    ema(paddle.to_tensor(np.array([4.0], np.float32)))
+    ema(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert abs(float(ema.scales()) - 3.0) < 1e-6
+
+    pc = Q.PerChannelAbsMaxObserver(quant_axis=-1)
+    pc(paddle.to_tensor(np.array([[1.0, -2.0], [3.0, 0.5]], np.float32)))
+    np.testing.assert_allclose(pc.scales().numpy(), [3.0, 2.0])
+
+    hist = Q.HistObserver(bins_count=64, percent=1.0)
+    data = np.random.default_rng(0).normal(size=2048).astype(np.float32)
+    hist(paddle.to_tensor(data))
+    s = float(hist.scales())
+    assert 0.5 * np.abs(data).max() < s <= np.abs(data).max() * 1.01
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMax,
+                        weight=lambda: Q.FakeQuanterWithAbsMax(channel_axis=-1))
+    qat = Q.QAT(cfg)
+    qmodel = qat.quantize(model)
+    assert isinstance(qmodel[0], Q.QuantedLinear)
+    assert isinstance(qmodel[2], Q.QuantedLinear)
+
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=qmodel.parameters())
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32))
+    t = paddle.to_tensor(np.random.default_rng(1).normal(size=(16, 4)).astype(np.float32))
+    losses = []
+    for _ in range(20):
+        loss = ((qmodel(x) - t) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+    converted = qat.convert(qmodel)
+    y = converted(x)
+    assert y.shape == [16, 4]
+
+
+def test_ptq_calibrate_and_convert():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(6, 6), nn.ReLU(), nn.Linear(6, 2))
+    fp_ref = None
+    cfg = Q.QuantConfig(activation=Q.AbsMaxObserver,
+                        weight=lambda: Q.PerChannelAbsMaxObserver(quant_axis=-1))
+    ptq = Q.PTQ(cfg)
+    qmodel = ptq.quantize(model)
+
+    rng = np.random.default_rng(0)
+    calib = [rng.normal(size=(8, 6)).astype(np.float32) for _ in range(4)]
+    for batch in calib:
+        qmodel(paddle.to_tensor(batch))
+
+    x = paddle.to_tensor(calib[0])
+    fp_ref = qmodel(x).numpy()  # observers are identity in forward
+    inference = ptq.convert(qmodel)
+    got = inference(x).numpy()
+    # int8 PTQ on a small MLP: close to fp32 output
+    assert np.mean(np.abs(got - fp_ref)) < 0.1 * (np.abs(fp_ref).mean() + 1e-6)
+    # activation scale was baked from calibration data
+    scale = float(max(np.abs(b).max() for b in calib))
+    pre = inference[0][0]
+    assert isinstance(pre, Q.LinearQuanterDequanter)
+    np.testing.assert_allclose(float(pre.scale), scale, rtol=1e-6)
+
+
+def test_quantized_conv2d():
+    paddle.seed(0)
+    conv_model = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1))
+    cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMax,
+                        weight=Q.FakeQuanterWithAbsMax)
+    qmodel = Q.QAT(cfg).quantize(conv_model)
+    assert isinstance(qmodel[0], Q.QuantedConv2D)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(2, 3, 8, 8)).astype(np.float32))
+    y = qmodel(x)
+    assert y.shape == [2, 8, 8, 8]
+    # fake-quant output close to fp32 conv
+    ref = conv_model[0].inner(x) if hasattr(conv_model[0], "inner") else None
+    y2 = qmodel[0].inner(x)
+    rel = float((y - y2).abs().mean() / (y2.abs().mean() + 1e-6))
+    assert rel < 0.1
+
+
+def test_qat_scale_survives_state_dict():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 4))
+    cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMax,
+                        weight=Q.FakeQuanterWithAbsMax)
+    qmodel = Q.QAT(cfg).quantize(model)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32))
+    qmodel(x)  # seeds the scales
+    sd = qmodel.state_dict()
+    scale_keys = [k for k in sd if "scale" in k]
+    assert len(scale_keys) == 2, list(sd)
+
+    model2 = nn.Sequential(nn.Linear(4, 4))
+    q2 = Q.QAT(cfg).quantize(model2)
+    q2(x)  # materialize lazy buffers so shapes exist for loading
+    q2.set_state_dict(sd)
+    np.testing.assert_allclose(
+        q2[0].activation_quanter.scales().numpy(),
+        qmodel[0].activation_quanter.scales().numpy())
+
+
+def test_quantized_conv2d_nhwc():
+    """Regression: QuantedConv2D must preserve the inner conv's data_format."""
+    paddle.seed(0)
+    m = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1, data_format="NHWC"))
+    cfg = Q.QuantConfig(activation=Q.FakeQuanterWithAbsMax,
+                        weight=Q.FakeQuanterWithAbsMax)
+    q = Q.QAT(cfg).quantize(m)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(size=(2, 8, 8, 3)).astype(np.float32))
+    y = q(x)
+    assert y.shape == [2, 8, 8, 4]
+
+
+def test_layer_and_type_config():
+    l1, l2 = nn.Linear(4, 4), nn.Linear(4, 4)
+    model = nn.Sequential(l1, l2)
+    cfg = Q.QuantConfig()  # no global default
+    cfg.add_layer_config(l1, activation=Q.FakeQuanterWithAbsMax,
+                         weight=Q.FakeQuanterWithAbsMax)
+    q = Q.QAT(cfg).quantize(model)
+    assert isinstance(q[0], Q.QuantedLinear)
+    assert isinstance(q[1], nn.Linear)  # untouched
