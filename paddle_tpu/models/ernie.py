@@ -1,0 +1,219 @@
+"""ERNIE-3.0 model family (BASELINE config #2: ERNIE-3.0-base fine-tune).
+
+Parity surface: PaddleNLP ``ErnieModel`` and task heads
+(``ErnieForSequenceClassification`` / ``ErnieForTokenClassification`` /
+``ErnieForQuestionAnswering`` / ``ErnieForMaskedLM``). ERNIE's trunk is a
+BERT-style encoder with an extra *task-type* embedding table (the
+universal-representation trick of ERNIE 3.0); heads are thin linears over the
+sequence output / pooled output. Built on the framework's TransformerEncoder,
+so the TP/SP/Fleet machinery composes identically to Llama/BERT.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.creation import arange, zeros_like
+from ..ops.manipulation import unsqueeze
+
+__all__ = [
+    "ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+    "ErnieForTokenClassification", "ErnieForQuestionAnswering",
+    "ErnieForMaskedLM",
+]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+    @staticmethod
+    def ernie3_base():
+        """ernie-3.0-base-zh trunk dims (PaddleNLP model card)."""
+        return ErnieConfig()
+
+    @staticmethod
+    def ernie3_medium():
+        return ErnieConfig(num_hidden_layers=6)
+
+    @staticmethod
+    def tiny(vocab=256, hidden=64, layers=2, heads=4, inter=128, max_pos=64):
+        return ErnieConfig(vocab_size=vocab, hidden_size=hidden,
+                           num_hidden_layers=layers, num_attention_heads=heads,
+                           intermediate_size=inter,
+                           max_position_embeddings=max_pos)
+
+
+class ErnieEmbeddings(nn.Layer):
+    """Word + position + token-type (+ task-type) embeddings."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(
+            config.vocab_size, config.hidden_size,
+            padding_idx=config.pad_token_id)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size)
+        self.use_task_id = config.use_task_id
+        if config.use_task_id:
+            self.task_type_embeddings = nn.Embedding(
+                config.task_type_vocab_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        L = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = arange(L, dtype="int32")
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        x = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids) \
+            + self.token_type_embeddings(token_type_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = zeros_like(input_ids)
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class ErniePooler(nn.Layer):
+    def __init__(self, hidden_size: int):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+class ErnieModel(nn.Layer):
+    """Trunk: embeddings → TransformerEncoder → (sequence_output, pooled)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        encoder_layer = nn.TransformerEncoderLayer(
+            d_model=config.hidden_size, nhead=config.num_attention_heads,
+            dim_feedforward=config.intermediate_size,
+            dropout=config.hidden_dropout_prob, activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(encoder_layer,
+                                             config.num_hidden_layers)
+        self.pooler = ErniePooler(config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        if attention_mask is not None and len(attention_mask.shape) == 2:
+            # [B, L] padding mask → additive [B, 1, 1, L]
+            m = unsqueeze(unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - m.astype("float32")) * -1e4
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        seq = self.encoder(x, src_mask=attention_mask)
+        return seq, self.pooler(seq)
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2,
+                 dropout: float = None):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask, task_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return loss, logits
+        return logits
+
+
+class ErnieForTokenClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes: int = 2,
+                 dropout: float = None):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None, labels=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask, task_type_ids)
+        logits = self.classifier(self.dropout(seq))
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+            return loss, logits
+        return logits
+
+
+class ErnieForQuestionAnswering(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.classifier = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask, task_type_ids)
+        logits = self.classifier(seq)  # [B, L, 2]
+        start = logits[:, :, 0]
+        end = logits[:, :, 1]
+        return start, end
+
+
+class ErnieForMaskedLM(nn.Layer):
+    """MLM head tied to the word-embedding table (the reference ties too)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            (config.vocab_size,), is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None, labels=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, position_ids,
+                            attention_mask, task_type_ids)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        emb = self.ernie.embeddings.word_embeddings.weight  # [V, H]
+        logits = h.matmul(emb.t()) + self.decoder_bias
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]),
+                ignore_index=-100)
+            return loss, logits
+        return logits

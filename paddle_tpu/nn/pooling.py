@@ -12,50 +12,67 @@ __all__ = [
 
 
 class _Pool(Layer):
+    _DEFAULT_FORMAT = "NCHW"
+
     def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                 **kw):
+                 data_format=None, **kw):
         super().__init__()
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
+        self.data_format = data_format or self._DEFAULT_FORMAT
         self.kw = kw
 
 
 class MaxPool1D(_Pool):
+    _DEFAULT_FORMAT = "NCL"
+
     def forward(self, x):
         return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
 
 
 class MaxPool2D(_Pool):
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
 
 
 class MaxPool3D(_Pool):
+    _DEFAULT_FORMAT = "NCDHW"
+
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
 
 
 class AvgPool1D(_Pool):
+    _DEFAULT_FORMAT = "NCL"
+
     def forward(self, x):
         return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
 
 
 class AvgPool2D(_Pool):
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
 
 
 class AvgPool3D(_Pool):
+    _DEFAULT_FORMAT = "NCDHW"
+
     def forward(self, x):
         return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
-                            ceil_mode=self.ceil_mode)
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
 
 
 class AdaptiveAvgPool1D(Layer):

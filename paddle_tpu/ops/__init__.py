@@ -18,3 +18,4 @@ from . import activation  # noqa: F401
 from . import conv_pool  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
+from . import vision  # noqa: F401

@@ -1,0 +1,258 @@
+"""Detection stack: vision ops (IoU, NMS, box codecs), ERNIE heads, PP-YOLOE.
+
+Op numerics vs NumPy references (SURVEY.md §4), model forward shapes,
+loss-decreases training smoke, and jit-ability of the train step.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.ops import vision as V
+
+
+# ---------------------------------------------------------------------------
+# vision ops
+# ---------------------------------------------------------------------------
+def _np_iou(a, b):
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area = lambda x: (x[..., 2] - x[..., 0]) * (x[..., 3] - x[..., 1])
+    return inter / (area(a)[:, None] + area(b)[None] - inter + 1e-9)
+
+
+def test_bbox_iou_matches_numpy():
+    rng = np.random.default_rng(0)
+    a = np.sort(rng.uniform(0, 100, (5, 2, 2)), axis=1).reshape(5, 4)
+    b = np.sort(rng.uniform(0, 100, (7, 2, 2)), axis=1).reshape(7, 4)
+    a = a[:, [0, 2, 1, 3]].astype(np.float32)
+    b = b[:, [0, 2, 1, 3]].astype(np.float32)
+    got = V.bbox_iou(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-5, atol=1e-6)
+    giou = V.bbox_iou(paddle.to_tensor(a), paddle.to_tensor(b),
+                      mode="giou").numpy()
+    assert np.all(giou <= got + 1e-6)
+
+
+def test_box_codec_roundtrip():
+    rng = np.random.default_rng(1)
+    pts = rng.uniform(20, 80, (10, 2)).astype(np.float32)
+    dist = rng.uniform(1, 15, (10, 4)).astype(np.float32)
+    boxes = V.distance2bbox(paddle.to_tensor(pts), paddle.to_tensor(dist))
+    back = V.bbox2distance(paddle.to_tensor(pts), boxes)
+    np.testing.assert_allclose(back.numpy(), dist, rtol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11],   # heavy overlap with #0
+        [50, 50, 60, 60],                  # separate
+        [0, 0, 10, 10],                    # duplicate of #0
+    ], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
+    keep = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                 iou_threshold=0.5).numpy()
+    kept = [i for i in keep if i >= 0]
+    assert kept == [0, 2]
+
+
+def test_multiclass_nms_static_output():
+    B, N, C, K = 2, 30, 3, 10
+    rng = np.random.default_rng(2)
+    centers = rng.uniform(10, 90, (B, N, 2))
+    wh = rng.uniform(4, 10, (B, N, 2))
+    boxes = np.concatenate([centers - wh, centers + wh], -1).astype(np.float32)
+    scores = rng.uniform(0, 1, (B, C, N)).astype(np.float32)
+    out, num = V.multiclass_nms(paddle.to_tensor(boxes),
+                                paddle.to_tensor(scores),
+                                score_threshold=0.3, nms_top_k=20,
+                                keep_top_k=K, nms_threshold=0.5)
+    assert out.shape == [B, K, 6]
+    n = num.numpy()
+    o = out.numpy()
+    for b in range(B):
+        valid = o[b][o[b][:, 0] >= 0]
+        assert len(valid) == n[b]
+        # scores sorted desc, labels in range
+        assert np.all(np.diff(valid[:, 1]) <= 1e-6)
+        assert np.all((valid[:, 0] >= 0) & (valid[:, 0] < C))
+
+
+def test_nms_accepts_nonpositive_scores():
+    boxes = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
+    scores = np.array([-0.2, -1.3], np.float32)  # raw logits
+    keep = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                 iou_threshold=0.5).numpy()
+    assert sorted(i for i in keep if i >= 0) == [0, 1]
+
+
+def test_multiclass_nms_pads_to_keep_top_k():
+    """C * nms_top_k < keep_top_k must still produce [B, keep_top_k, 6]."""
+    boxes = paddle.to_tensor(np.array([[[0, 0, 10, 10], [50, 50, 60, 60]]],
+                                      np.float32))
+    scores = paddle.to_tensor(np.array([[[0.9, 0.8]]], np.float32))  # C=1,N=2
+    out, num = V.multiclass_nms(boxes, scores, score_threshold=0.1,
+                                nms_top_k=2, keep_top_k=10)
+    assert out.shape == [1, 10, 6]
+    assert int(num.numpy()[0]) == 2
+
+
+def test_multiclass_nms_background_label():
+    boxes = paddle.to_tensor(np.array([[[0, 0, 10, 10], [50, 50, 60, 60]]],
+                                      np.float32))
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 0] = 0.9   # class 0 = background
+    scores[0, 1] = 0.5
+    out, num = V.multiclass_nms(paddle.to_tensor(boxes._data),
+                                paddle.to_tensor(scores),
+                                score_threshold=0.1, keep_top_k=5,
+                                background_label=0)
+    o = out.numpy()[0]
+    assert np.all(o[o[:, 0] >= 0][:, 0] == 1)  # only class 1 emitted
+
+
+def test_backbone_out_strides():
+    from paddle_tpu.models.ppyoloe import CSPResNet
+    bb = CSPResNet(width_mult=0.25, depth_mult=0.33)
+    assert bb.out_strides == [8, 16, 32]
+    x = paddle.to_tensor(np.zeros((1, 64, 64, 3), np.float32))
+    feats = bb(x)
+    for f, s in zip(feats, bb.out_strides):
+        assert f.shape[1] == 64 // s
+
+
+# ---------------------------------------------------------------------------
+# ERNIE
+# ---------------------------------------------------------------------------
+def test_ernie_forward_and_heads():
+    from paddle_tpu.models.ernie import (ErnieConfig, ErnieModel,
+                                         ErnieForSequenceClassification,
+                                         ErnieForTokenClassification,
+                                         ErnieForQuestionAnswering,
+                                         ErnieForMaskedLM)
+    paddle.seed(0)
+    cfg = ErnieConfig.tiny()
+    B, L = 2, 16
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (B, L)).astype(np.int32))
+    model = ErnieModel(cfg)
+    seq, pooled = model(ids)
+    assert seq.shape == [B, L, cfg.hidden_size]
+    assert pooled.shape == [B, cfg.hidden_size]
+    # task-type embeddings shift the representation
+    task1 = paddle.to_tensor(np.ones((B, L), np.int32))
+    seq2, _ = model(ids, task_type_ids=task1)
+    assert not np.allclose(seq.numpy(), seq2.numpy())
+
+    logits = ErnieForSequenceClassification(cfg, num_classes=3)(ids)
+    assert logits.shape == [B, 3]
+    tok = ErnieForTokenClassification(cfg, num_classes=5)(ids)
+    assert tok.shape == [B, L, 5]
+    start, end = ErnieForQuestionAnswering(cfg)(ids)
+    assert start.shape == [B, L] and end.shape == [B, L]
+    mlm = ErnieForMaskedLM(cfg)(ids)
+    assert mlm.shape == [B, L, cfg.vocab_size]
+
+
+def test_ernie_finetune_converges():
+    from paddle_tpu.models.ernie import ErnieConfig, ErnieForSequenceClassification
+    paddle.seed(0)
+    cfg = ErnieConfig.tiny(vocab=64, hidden=32, layers=1, heads=2, inter=64,
+                           max_pos=16)
+    model = ErnieForSequenceClassification(cfg, num_classes=2)
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    # learnable rule: class = first token id is even
+    ids_np = rng.integers(0, 64, (16, 8)).astype(np.int32)
+    labels_np = (ids_np[:, 0] % 2).astype(np.int64)
+    ids = paddle.to_tensor(ids_np)
+    labels = paddle.to_tensor(labels_np)
+
+    @paddle.jit.to_static
+    def step(ids, labels):
+        loss, _ = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(step(ids, labels)) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# PP-YOLOE
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_detector():
+    from paddle_tpu.models.ppyoloe import PPYOLOE, PPYOLOEConfig
+    paddle.seed(0)
+    return PPYOLOE(PPYOLOEConfig.tiny(num_classes=4))
+
+
+def _synth_batch(B=2, size=64, M=3, C=4, seed=0):
+    rng = np.random.default_rng(seed)
+    imgs = rng.normal(size=(B, size, size, 3)).astype(np.float32)
+    centers = rng.uniform(10, size - 10, (B, M, 2))
+    wh = rng.uniform(6, 20, (B, M, 2))
+    boxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                           -1).astype(np.float32)
+    labels = rng.integers(0, C, (B, M)).astype(np.int32)
+    mask = np.ones((B, M), np.float32)
+    mask[:, -1] = 0.0  # exercise gt padding
+    return imgs, labels, boxes, mask
+
+
+def test_ppyoloe_forward_shapes(tiny_detector):
+    imgs, *_ = _synth_batch()
+    cls_logits, reg_dist = tiny_detector(paddle.to_tensor(imgs))
+    A = (64 // 8) ** 2 + (64 // 16) ** 2 + (64 // 32) ** 2
+    assert cls_logits.shape == [2, A, 4]
+    assert reg_dist.shape == [2, A, 4 * 17]
+
+
+def test_ppyoloe_loss_and_train_step(tiny_detector):
+    model = tiny_detector
+    imgs, labels, boxes, mask = _synth_batch()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+
+    t_img = paddle.to_tensor(imgs)
+    t_lab = paddle.to_tensor(labels)
+    t_box = paddle.to_tensor(boxes)
+    t_msk = paddle.to_tensor(mask)
+
+    @paddle.jit.to_static
+    def step(img, lab, box, msk):
+        out = model.loss(img, lab, box, msk)
+        out["loss"].backward()
+        opt.step()
+        opt.clear_grad()
+        return out["loss"], out["loss_cls"], out["loss_iou"], out["loss_dfl"]
+
+    losses = []
+    for _ in range(8):
+        l, lc, li, ld = step(t_img, t_lab, t_box, t_msk)
+        for v in (l, lc, li, ld):
+            assert np.isfinite(float(v))
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ppyoloe_predict_static_nms(tiny_detector):
+    imgs, *_ = _synth_batch()
+    out, num = tiny_detector.predict(paddle.to_tensor(imgs),
+                                     score_threshold=0.0, keep_top_k=20)
+    assert out.shape == [2, 20, 6]
+    assert num.shape == [2]
+    o = out.numpy()
+    # decoded coords bounded by the codec range: anchor ± reg_max * stride
+    valid = o[o[..., 0] >= 0]
+    if len(valid):
+        lim = 16 * 32  # reg_max * max stride
+        assert valid[:, 2:].min() > -lim and valid[:, 2:].max() < 64 + lim
