@@ -63,6 +63,7 @@ from . import io  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import linalg  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
 from . import autograd  # noqa: F401,E402
 from . import framework  # noqa: F401,E402
 from . import device  # noqa: F401,E402

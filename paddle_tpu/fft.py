@@ -1,0 +1,158 @@
+"""``paddle.fft`` — discrete Fourier transform family.
+
+Parity surface: upstream python/paddle/fft.py (backed by
+paddle/phi/kernels/*/fft_*). On TPU every transform is one jnp.fft call
+dispatched through ``apply``: XLA lowers to its native FFT HLO and jax
+provides the vjp, so the whole family is differentiable for free.
+
+Signature conventions follow paddle: 1-D transforms take ``(x, n, axis,
+norm)``; N-D transforms take ``(x, s, axes, norm)``; ``norm`` is one of
+"backward" (default), "forward", "ortho".
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor, apply
+from .ops._helpers import ensure_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "forward", "ortho")
+
+
+def _check_norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _NORMS:
+        raise ValueError(
+            f"norm should be one of {_NORMS}, but got '{norm}'")
+    return norm
+
+
+def _make_1d(name, jfn, real_in=False):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        norm_ = _check_norm(norm)
+        x = ensure_tensor(x)
+
+        if real_in and jnp.iscomplexobj(x._data):
+            raise TypeError(
+                f"{name_} only supports real input, but got "
+                f"{x._data.dtype}; use fft/fftn for complex input")
+
+        def f(a):
+            return jfn(a, n=n, axis=axis, norm=norm_)
+
+        return apply(name_, f, x)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+def _make_nd(name, jfn, real_in=False):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        norm_ = _check_norm(norm)
+        x = ensure_tensor(x)
+        s_ = tuple(int(v) for v in s) if s is not None else None
+        axes_ = tuple(int(v) for v in axes) if axes is not None else None
+        if real_in and jnp.iscomplexobj(x._data):
+            raise TypeError(
+                f"{name_} only supports real input, but got "
+                f"{x._data.dtype}; use fft/fftn for complex input")
+
+        def f(a):
+            return jfn(a, s=s_, axes=axes_, norm=norm_)
+
+        return apply(name_, f, x)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+def _make_2d(name, jfn, real_in=False):
+    nd = _make_nd(name, jfn, real_in=real_in)
+
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return nd(x, s=s, axes=axes, norm=norm)
+    op.__name__ = name
+    return op
+
+
+fft = _make_1d("fft", jnp.fft.fft)
+ifft = _make_1d("ifft", jnp.fft.ifft)
+rfft = _make_1d("rfft", jnp.fft.rfft, real_in=True)
+irfft = _make_1d("irfft", jnp.fft.irfft)
+hfft = _make_1d("hfft", jnp.fft.hfft)
+ihfft = _make_1d("ihfft", jnp.fft.ihfft, real_in=True)
+
+fft2 = _make_2d("fft2", jnp.fft.fftn)
+ifft2 = _make_2d("ifft2", jnp.fft.ifftn)
+rfft2 = _make_2d("rfft2", jnp.fft.rfftn, real_in=True)
+irfft2 = _make_2d("irfft2", jnp.fft.irfftn)
+
+fftn = _make_nd("fftn", jnp.fft.fftn)
+ifftn = _make_nd("ifftn", jnp.fft.ifftn)
+rfftn = _make_nd("rfftn", jnp.fft.rfftn, real_in=True)
+irfftn = _make_nd("irfftn", jnp.fft.irfftn)
+
+
+def _hfftn_impl(a, s=None, axes=None, norm="backward"):
+    # hermitian-input N-D transform: conjugate-reverse trick over irfftn,
+    # matching numpy.fft.hfft generalized to N dims (last axis hermitian).
+    if axes is None:
+        axes = tuple(range(a.ndim))
+    axes = tuple(ax % a.ndim for ax in axes)
+    inv_norm = {"backward": "forward", "forward": "backward",
+                "ortho": "ortho"}[norm]
+    if s is not None:
+        n_last = s[-1]
+    else:
+        n_last = 2 * (a.shape[axes[-1]] - 1)
+    full_s = (tuple(s[:-1]) if s is not None
+              else tuple(a.shape[ax] for ax in axes[:-1])) + (n_last,)
+    return jnp.fft.irfftn(jnp.conj(a), s=full_s, axes=axes, norm=inv_norm)
+
+
+def _ihfftn_impl(a, s=None, axes=None, norm="backward"):
+    inv_norm = {"backward": "forward", "forward": "backward",
+                "ortho": "ortho"}[norm]
+    return jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes, norm=inv_norm))
+
+
+hfftn = _make_nd("hfftn", _hfftn_impl)
+ihfftn = _make_nd("ihfftn", _ihfftn_impl, real_in=True)
+hfft2 = _make_2d("hfft2", _hfftn_impl)
+ihfft2 = _make_2d("ihfft2", _ihfftn_impl, real_in=True)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        out = out.astype(jnp.dtype(np.dtype(dtype)))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        out = out.astype(jnp.dtype(np.dtype(dtype)))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    axes_ = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return apply("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes_), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    axes_ = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return apply("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes_), x)

@@ -97,6 +97,15 @@ class Subset(Dataset):
         return len(self.indices)
 
 
+def _host_rng(generator=None):
+    """Host-side numpy RNG seeded from the framework generator, so that
+    ``paddle.seed`` makes shuffle order reproducible (upstream: samplers draw
+    from the global phi Generator)."""
+    gen = generator if generator is not None else default_generator
+    key = np.asarray(gen.split_key(), dtype=np.uint64)
+    return np.random.default_rng(key)
+
+
 def random_split(dataset, lengths, generator=None):
     if all(isinstance(l, float) for l in lengths):
         total = len(dataset)
@@ -104,7 +113,7 @@ def random_split(dataset, lengths, generator=None):
         lengths[-1] = total - sum(lengths[:-1])
     if sum(lengths) != len(dataset):
         raise ValueError("sum of lengths must equal dataset length")
-    perm = np.random.default_rng().permutation(len(dataset)).tolist()
+    perm = _host_rng(generator).permutation(len(dataset)).tolist()
     out, off = [], 0
     for l in lengths:
         out.append(Subset(dataset, perm[off:off + l]))
@@ -134,6 +143,7 @@ class RandomSampler(Sampler):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        self.generator = generator
 
     @property
     def num_samples(self):
@@ -141,7 +151,7 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = np.random.default_rng()
+        rng = _host_rng(self.generator)
         if self.replacement:
             return iter(rng.integers(0, n, self.num_samples).tolist())
         return iter(rng.permutation(n)[:self.num_samples].tolist())
@@ -158,7 +168,7 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        rng = np.random.default_rng()
+        rng = _host_rng()
         idx = rng.choice(len(self.weights), self.num_samples,
                          replace=self.replacement, p=p)
         return iter(idx.tolist())

@@ -17,7 +17,7 @@ stop-gradient boundaries exactly like the reference's detached assigner.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Sequence, Tuple
 
 import jax
@@ -301,7 +301,6 @@ def _vfl_giou_dfl_loss(cls_logits, pred_dist, anchors, strides, gt_labels,
 
     a_lab = jnp.take_along_axis(gt_labels, best_gt, axis=1)     # [B,A]
     a_box = jnp.take_along_axis(gt_boxes, best_gt[..., None], axis=1)
-    a_iou = jnp.take_along_axis(ious, best_gt[:, None, :], axis=1)[:, 0]
     a_metric = jnp.take_along_axis(metric, best_gt[:, None, :], axis=1)[:, 0]
     # normalize: target score = metric / max_metric_per_gt * max_iou_per_gt
     max_metric = jnp.max(jnp.where(cand, metric, 0), axis=-1, keepdims=True)

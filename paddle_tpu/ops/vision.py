@@ -125,7 +125,10 @@ def nms(boxes, scores, iou_threshold: float = 0.5,
         order = jnp.argsort(-s)[:k]
         bs = b[order]
         keep = _nms_suppress(bs, iou_threshold)
-        return jnp.where(keep, order, -1)
+        out = jnp.where(keep, order, -1)
+        if out.shape[0] < k:
+            out = jnp.pad(out, (0, k - out.shape[0]), constant_values=-1)
+        return out
 
     return apply("nms", fn, boxes, scores, differentiable=False)
 
