@@ -9,13 +9,164 @@ from __future__ import annotations
 from typing import Any
 
 import jax
+import numpy as np
 
 from .core.autograd import GradNode, backward, grad  # noqa: F401
 from .core.tensor import Tensor
 from .core.tracing import no_grad, set_grad_enabled  # noqa: F401
 
 __all__ = ["backward", "grad", "no_grad", "set_grad_enabled", "PyLayer",
-           "PyLayerContext"]
+           "PyLayerContext", "jacobian", "hessian", "Jacobian", "Hessian",
+           "jvp", "vjp"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_func(func):
+    """Lift a Tensor->Tensor function to a pure jax-array function."""
+    def pure(*arrays):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(_unwrap(o) for o in out)
+        return _unwrap(out)
+    return pure
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Full Jacobian (parity: paddle.autograd.jacobian).
+
+    Two call styles:
+    - ``jacobian(ys, xs)`` where ``ys`` was computed on the eager tape from
+      ``xs`` (``xs.stop_gradient == False``) — evaluated by running the tape
+      backward once per output element with a one-hot cotangent.
+    - ``jacobian(func, xs)`` with a callable — evaluated with ``jax.jacrev``
+      on the lifted pure function (preferred: one trace, XLA-fused).
+
+    Returns Tensor(s) of shape ``(*ys.shape, *xs.shape)`` per input.
+    """
+    import jax.numpy as jnp
+
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "batch_axis is not supported yet; vmap the function and call "
+            "jacobian per sample")
+    single = not isinstance(xs, (tuple, list))
+    xs_list = [xs] if single else list(xs)
+
+    if callable(ys) and not isinstance(ys, Tensor):
+        jac = jax.jacrev(_wrap_func(ys), argnums=tuple(range(len(xs_list))))
+        out = jac(*[_unwrap(x) for x in xs_list])
+        res = [Tensor(o) for o in out]
+        return res[0] if single else res
+
+    from .core.autograd import grad as _grad
+    ys_t = ys if isinstance(ys, Tensor) else Tensor(ys)
+    n_out = int(np.prod(ys_t.shape)) if ys_t.ndim else 1
+    rows = []  # one backward pass per output element
+    for i in range(n_out):
+        ct = jnp.zeros((n_out,), ys_t._data.dtype).at[i].set(1).reshape(
+            ys_t._data.shape if ys_t.ndim else ())
+        gs = _grad([ys_t], xs_list, grad_outputs=[Tensor(ct)],
+                   retain_graph=True, allow_unused=True)
+        rows.append([g._data if g is not None
+                     else jnp.zeros(x._data.shape, ys_t._data.dtype)
+                     for g, x in zip(gs, xs_list)])
+    res = []
+    for j, x in enumerate(xs_list):
+        stacked = jnp.stack([r[j] for r in rows]).reshape(
+            tuple(ys_t.shape) + tuple(x.shape))
+        res.append(Tensor(stacked))
+    return res[0] if single else res
+
+
+def hessian(func, xs, batch_axis=None):
+    """Hessian of a scalar-valued ``func`` at ``xs`` (parity:
+    paddle.autograd.hessian / paddle.incubate.autograd.Hessian).
+
+    The eager tape does not support ``create_graph`` (double backward), so the
+    Tensor-form ``hessian(ys, xs)`` is not available — pass the callable; it
+    is evaluated with ``jax.hessian`` on the lifted pure function.
+    """
+    if batch_axis is not None:
+        raise NotImplementedError(
+            "batch_axis is not supported yet; vmap the function and call "
+            "hessian per sample")
+    if isinstance(func, Tensor):
+        raise NotImplementedError(
+            "hessian(ys, xs) over the eager tape needs double-backward; pass "
+            "the function instead: paddle.autograd.hessian(func, xs)")
+    single = not isinstance(xs, (tuple, list))
+    xs_list = [xs] if single else list(xs)
+    h = jax.hessian(_wrap_func(func), argnums=tuple(range(len(xs_list))))
+    out = h(*[_unwrap(x) for x in xs_list])
+    if single:
+        return Tensor(out[0][0])
+    return [[Tensor(b) for b in row] for row in out]
+
+
+class Jacobian:
+    """Functional lazy Jacobian (parity: paddle.incubate.autograd.Jacobian).
+    With a sequence of inputs, ``self[i]`` is the Jacobian w.r.t. input i."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._val = jacobian(func, xs)
+
+    def __getitem__(self, idx):
+        return self._val[idx]
+
+    @property
+    def shape(self):
+        if isinstance(self._val, (list, tuple)):
+            return [v.shape for v in self._val]
+        return self._val.shape
+
+
+class Hessian(Jacobian):
+    """Functional lazy Hessian (parity: paddle.incubate.autograd.Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._val = hessian(func, xs)
+
+
+def _wrap_out(out):
+    if isinstance(out, tuple):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result) — parity: paddle.incubate.autograd.vjp.
+    Multi-output funcs are supported; default cotangent is ones per output."""
+    import jax.numpy as jnp
+    single = not isinstance(xs, (tuple, list))
+    xs_list = [xs] if single else list(xs)
+    out, pull = jax.vjp(_wrap_func(func), *[_unwrap(x) for x in xs_list])
+    if v is None:
+        v_arr = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_arr = jax.tree_util.tree_map(
+            _unwrap, tuple(v) if isinstance(v, (tuple, list)) else v,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    grads = pull(v_arr)
+    gres = Tensor(grads[0]) if single else [Tensor(g) for g in grads]
+    return _wrap_out(out), gres
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result) — parity: paddle.incubate.autograd.jvp.
+    Multi-output funcs are supported (tangent returned per output)."""
+    import jax.numpy as jnp
+    single = not isinstance(xs, (tuple, list))
+    xs_list = [_unwrap(x) for x in ([xs] if single else list(xs))]
+    if v is None:
+        vs = [jnp.ones_like(x) for x in xs_list]
+    else:
+        vs = [_unwrap(t) for t in ([v] if single else list(v))]
+    out, tangent = jax.jvp(_wrap_func(func), tuple(xs_list), tuple(vs))
+    return _wrap_out(out), _wrap_out(tangent)
 
 
 class PyLayerContext:

@@ -30,6 +30,8 @@ from .auto_parallel_api import (  # noqa: F401
     ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
     reshard, shard_layer,
 )
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore, Store  # noqa: F401

@@ -107,7 +107,10 @@ def _hfftn_impl(a, s=None, axes=None, norm="backward"):
     # hermitian-input N-D transform: conjugate-reverse trick over irfftn,
     # matching numpy.fft.hfft generalized to N dims (last axis hermitian).
     if axes is None:
-        axes = tuple(range(a.ndim))
+        # numpy/paddle convention: with s given, transform the trailing
+        # len(s) axes; otherwise all axes
+        axes = (tuple(range(a.ndim)) if s is None
+                else tuple(range(a.ndim - len(s), a.ndim)))
     axes = tuple(ax % a.ndim for ax in axes)
     inv_norm = {"backward": "forward", "forward": "backward",
                 "ortho": "ortho"}[norm]

@@ -38,3 +38,6 @@ from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
+from ..optimizer import (  # noqa: F401  (parity: paddle.nn.ClipGradBy*)
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+)

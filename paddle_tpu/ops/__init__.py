@@ -19,3 +19,4 @@ from . import conv_pool  # noqa: F401
 from . import nn_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import vision  # noqa: F401
+from . import array  # noqa: F401

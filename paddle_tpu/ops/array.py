@@ -1,0 +1,82 @@
+"""TensorArray ops: ``create_array`` / ``array_write`` / ``array_read`` /
+``array_length`` / ``tensor_array_to_tensor``.
+
+Parity surface: python/paddle/tensor/array.py backed by the reference's
+``phi::TensorArray`` (paddle/phi/core/ — a vector-of-DenseTensor used by the
+legacy while_op to carry per-iteration values).
+
+TPU-native design: in eager mode a TensorArray is a host-side Python list of
+device arrays (no device-side dynamic container exists on XLA, same reason
+the reference keeps TensorArray on the host). Inside ``jit``/``lax`` loops a
+dynamic-length array cannot exist — use ``lax.scan`` via ``paddle.jit`` or
+pre-size the array; ``tensor_array_to_tensor`` stacks/concats to a dense
+Tensor for compiled consumption.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ._helpers import OP_REGISTRY, ensure_tensor, register_op
+
+
+class TensorArray(list):
+    """List-of-Tensor with the reference's write/read/length surface."""
+
+    def write(self, i: int, value: Tensor) -> "TensorArray":
+        i = int(i)
+        if i < len(self):
+            self[i] = value
+        else:
+            self.extend([None] * (i - len(self)))  # sparse writes pad w/ None
+            self.append(value)
+        return self
+
+    def read(self, i: int) -> Tensor:
+        return self[int(i)]
+
+
+def create_array(dtype: str = "float32", initialized_list=None) -> TensorArray:
+    arr = TensorArray()
+    if initialized_list:
+        for v in initialized_list:
+            arr.append(ensure_tensor(v))
+    return arr
+
+
+def array_write(x, i, array: Optional[TensorArray] = None) -> TensorArray:
+    if array is None:
+        array = TensorArray()
+    array.write(int(i), ensure_tensor(x))
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    return array.read(int(i))
+
+
+def array_length(array: TensorArray) -> Tensor:
+    return Tensor(jnp.asarray(len(array), jnp.int32))
+
+
+def tensor_array_to_tensor(array: TensorArray, axis: int = 1,
+                           use_stack: bool = False):
+    """Dense-ify: stack (new axis) or concat along ``axis``. Returns
+    (tensor, index) like the reference (index = per-element sizes)."""
+    datas = [ensure_tensor(t)._data for t in array if t is not None]
+    if use_stack:
+        out = jnp.stack(datas, axis=axis)
+        sizes = jnp.asarray([1] * len(datas), jnp.int32)
+    else:
+        out = jnp.concatenate(datas, axis=axis)
+        sizes = jnp.asarray([d.shape[axis] for d in datas], jnp.int32)
+    return Tensor(out), Tensor(sizes)
+
+
+for _name, _fn in [("create_array", create_array), ("array_write", array_write),
+                   ("array_read", array_read), ("array_length", array_length),
+                   ("tensor_array_to_tensor", tensor_array_to_tensor)]:
+    register_op(_name, _fn, methods=())

@@ -1,0 +1,11 @@
+"""``paddle.regularizer`` namespace.
+
+Parity surface: python/paddle/regularizer.py (L1Decay / L2Decay weight-decay
+coefficients attached per-parameter via ParamAttr or globally on the
+optimizer). The decay math itself lives in ``optimizer`` where the update is a
+single fused jax expression per parameter.
+"""
+
+from .optimizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

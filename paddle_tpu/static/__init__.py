@@ -356,3 +356,6 @@ def load_inference_model(path_prefix: str, executor=None):
         payload = pickle.load(f)
     prog = _LoadedProgram(payload)
     return [prog, prog.feed_names, prog.fetch_names]
+
+
+from . import nn  # noqa: F401,E402  (control flow: while_loop/cond/case/switch_case)

@@ -1,0 +1,147 @@
+"""``paddle.static.nn`` — control-flow ops (+ thin layer aliases).
+
+Parity surface: python/paddle/static/nn/control_flow.py (``while_loop``,
+``cond``, ``case``, ``switch_case``; the reference lowers these to the legacy
+``while_op`` / ``conditional_block_op`` C++ operators —
+paddle/fluid/operators/controlflow/).
+
+TPU-native design: structured control flow maps 1:1 onto XLA's control-flow
+HLOs via ``lax.while_loop`` / ``lax.cond`` / ``lax.switch`` — traced once,
+compiled, no Python in the loop body at run time. When the predicate is a
+concrete Python/host value (pure eager, nothing traced) the branch is taken
+directly, mirroring the reference's eager fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.tracing import no_grad
+
+__all__ = ["while_loop", "cond", "case", "switch_case"]
+
+
+def _flatten(vars):  # Tensors are leaves; keep exact container shape
+    return jax.tree_util.tree_flatten(
+        vars, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _as_array(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _rewrap(leaves, template_leaves, treedef):
+    out = [Tensor(d) if isinstance(t, Tensor) else d
+           for d, t in zip(leaves, template_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: str | None = None) -> List:
+    """Run ``body`` while ``cond`` holds. ``cond``/``body`` take ``*loop_vars``
+    and ``body`` returns the next loop_vars (same structure & shapes — XLA's
+    fixed-shape loop-carried-state rule, identical to the reference's
+    requirement that while_op block outputs match inputs)."""
+    leaves, treedef = _flatten(list(loop_vars))
+    datas = [_as_array(l) for l in leaves]
+
+    def c(ds):
+        r = cond(*_rewrap(ds, leaves, treedef))
+        return _as_array(r).reshape(())
+
+    def b(ds):
+        r = body(*_rewrap(ds, leaves, treedef))
+        if not isinstance(r, (tuple, list)):
+            r = [r]
+        new_leaves, _ = _flatten(list(r))
+        return [_as_array(l) for l in new_leaves]
+
+    with no_grad():
+        final = jax.lax.while_loop(c, b, datas)
+    return list(_rewrap(final, leaves, treedef))
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _make_branch(fn, info):
+    """Wrap a user branch fn so it runs INSIDE the lax combinator's trace,
+    returning flat arrays; the output structure is captured on first trace."""
+    def branch(_):
+        with no_grad():
+            out = fn() if fn is not None else None
+        leaves, treedef = _flatten(out)
+        info.setdefault("leaves", leaves)
+        info.setdefault("treedef", treedef)
+        return [_as_array(l) for l in leaves]
+    return branch
+
+
+def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
+         name: str | None = None):
+    """Two-way branch (parity: paddle.static.nn.cond). Both branches must
+    return matching structures/shapes; lowers to ``lax.cond`` so only the
+    taken branch executes on device."""
+    parr = _as_array(pred)
+    if not _is_traced(parr):  # concrete: eager fast path
+        taken = true_fn if bool(parr) else false_fn
+        return taken() if taken is not None else None
+
+    info: dict = {}
+    out = jax.lax.cond(parr.reshape(()).astype(bool),
+                       _make_branch(true_fn, info),
+                       _make_branch(false_fn, info), 0)
+    return _rewrap(out, info["leaves"], info["treedef"])
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]],
+         default: Callable = None, name: str | None = None):
+    """First-match-wins chain of (pred, fn) (parity: paddle.static.nn.case),
+    built as nested ``cond``s."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+
+    def build(i):
+        if i == len(pred_fn_pairs):
+            if default is None:
+                return pred_fn_pairs[-1][1]  # reference semantics: last fn
+            return default
+        pred, fn = pred_fn_pairs[i]
+        return lambda: cond(pred, fn, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Callable = None,
+                name: str | None = None):
+    """Index-selected branch (parity: paddle.static.nn.switch_case); lowers to
+    ``lax.switch``. ``branch_fns`` is a dict {int: fn} or list of fns/pairs."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(k), f) for k, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+
+    idx_arr = _as_array(branch_index).reshape(()).astype(jnp.int32)
+    if not _is_traced(idx_arr):
+        return dict(items).get(int(idx_arr), default)()
+
+    # remap the (possibly sparse) keys to dense switch positions so the
+    # branch table has exactly len(keys)+1 entries regardless of key values
+    keys_arr = jnp.asarray(keys, jnp.int32)
+    hit = idx_arr == keys_arr
+    sel = jnp.where(hit.any(), jnp.argmax(hit), len(fns)).astype(jnp.int32)
+    table = fns + [default]
+    info: dict = {}
+    out = jax.lax.switch(sel, [_make_branch(f, info) for f in table], 0)
+    return _rewrap(out, info["leaves"], info["treedef"])

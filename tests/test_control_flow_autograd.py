@@ -1,0 +1,182 @@
+"""Control-flow ops, TensorArray, and functional autograd parity tests.
+
+Mirrors the reference's test style (test/legacy_test/test_while_loop_op.py,
+test_cond.py, test_switch_case.py, test_tensor_array_*.py,
+test_autograd_functional_dynamic.py): numpy references, eager + compiled.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static.nn as snn
+
+
+class TestWhileLoop:
+    def test_counter_sum(self):
+        i = paddle.to_tensor(0)
+        s = paddle.to_tensor(0.0)
+        i2, s2 = snn.while_loop(lambda i, s: i < 5,
+                                lambda i, s: [i + 1, s + 2.0], [i, s])
+        assert int(i2) == 5
+        assert float(s2) == 10.0
+
+    def test_matrix_state(self):
+        x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+        (y,) = snn.while_loop(lambda t: t.sum() < 24.0,
+                              lambda t: [t * 2.0], [x])
+        assert float(y.sum()) == 24.0
+
+    def test_inside_jit(self):
+        @paddle.jit.to_static
+        def f(n, x):
+            _, out = snn.while_loop(lambda i, a: i < n,
+                                    lambda i, a: [i + 1, a * 2.0],
+                                    [paddle.to_tensor(0), x])
+            return out
+
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        assert np.allclose(f(paddle.to_tensor(3), x).numpy(), 8.0)
+
+
+class TestCond:
+    def test_concrete_pred(self):
+        r = snn.cond(paddle.to_tensor(True), lambda: paddle.to_tensor(1.0),
+                     lambda: paddle.to_tensor(2.0))
+        assert float(r) == 1.0
+
+    def test_traced_pred(self):
+        @paddle.jit.to_static
+        def f(x):
+            return snn.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        assert np.allclose(f(x).numpy(), [2, 4])
+        assert np.allclose(f(-x).numpy(), [-2, -3])
+
+    def test_nested_structure(self):
+        @paddle.jit.to_static
+        def f(x):
+            a, b = snn.cond(x.sum() > 0,
+                            lambda: (x, x + 1), lambda: (x - 1, x))
+            return a + b
+
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        assert np.allclose(f(x).numpy(), [3.0])
+
+
+class TestCaseSwitch:
+    def test_case_first_match(self):
+        r = snn.case([(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0)),
+                      (paddle.to_tensor(True), lambda: paddle.to_tensor(2.0))],
+                     default=lambda: paddle.to_tensor(3.0))
+        assert float(r) == 2.0
+
+    def test_case_default(self):
+        r = snn.case([(paddle.to_tensor(False), lambda: paddle.to_tensor(1.0))],
+                     default=lambda: paddle.to_tensor(9.0))
+        assert float(r) == 9.0
+
+    def test_switch_case_jit(self):
+        @paddle.jit.to_static
+        def g(idx, x):
+            return snn.switch_case(idx, {0: lambda: x + 1, 2: lambda: x * 3},
+                                   default=lambda: x)
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        assert np.allclose(g(paddle.to_tensor(0), x).numpy(), [2, 3])
+        assert np.allclose(g(paddle.to_tensor(2), x).numpy(), [3, 6])
+        assert np.allclose(g(paddle.to_tensor(7), x).numpy(), [1, 2])
+
+    def test_switch_case_eager_list(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        r = snn.switch_case(paddle.to_tensor(1),
+                            [lambda: x, lambda: x * 5])
+        assert float(r) == 5.0
+
+
+class TestTensorArray:
+    def test_write_read_length(self):
+        arr = paddle.create_array("float32")
+        paddle.array_write(paddle.to_tensor([1.0, 2.0]), 0, arr)
+        paddle.array_write(paddle.to_tensor([3.0, 4.0]), 1, arr)
+        assert int(paddle.array_length(arr)) == 2
+        assert np.allclose(paddle.array_read(arr, 1).numpy(), [3, 4])
+
+    def test_to_tensor_stack_concat(self):
+        arr = paddle.create_array(
+            "float32", [np.ones((2,), np.float32), np.zeros((2,), np.float32)])
+        t, _ = paddle.tensor_array_to_tensor(arr, axis=0, use_stack=True)
+        assert list(t.shape) == [2, 2]
+        t2, sizes = paddle.tensor_array_to_tensor(arr, axis=0, use_stack=False)
+        assert list(t2.shape) == [4]
+        assert sizes.numpy().tolist() == [2, 2]
+
+    def test_overwrite(self):
+        arr = paddle.create_array("float32")
+        paddle.array_write(paddle.to_tensor([1.0]), 0, arr)
+        paddle.array_write(paddle.to_tensor([7.0]), 0, arr)
+        assert float(paddle.array_read(arr, 0)) == 7.0
+
+
+class TestFunctionalAutograd:
+    def test_jacobian_tensor_form(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        y = x * x
+        J = paddle.autograd.jacobian(y, x)
+        assert np.allclose(J.numpy(), np.diag([2.0, 4.0]))
+
+    def test_jacobian_matrix_out(self):
+        x = paddle.to_tensor(np.array([[1.0, 2.0]], np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(np.array([[1.0, 0.0], [0.0, 3.0]], np.float32))
+        y = paddle.matmul(x, w)
+        J = paddle.autograd.jacobian(y, x)  # (1,2,1,2)
+        assert list(J.shape) == [1, 2, 1, 2]
+        assert np.allclose(J.numpy().reshape(2, 2), w.numpy().T)
+
+    def test_jacobian_functional(self):
+        J = paddle.autograd.jacobian(
+            lambda t: t * t, paddle.to_tensor(np.array([1.0, 3.0], np.float32)))
+        assert np.allclose(J.numpy(), np.diag([2.0, 6.0]))
+
+    def test_hessian_functional(self):
+        H = paddle.autograd.hessian(
+            lambda t: (t ** 3).sum(),
+            paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+        assert np.allclose(H.numpy(), np.diag([6.0, 12.0]))
+
+    def test_hessian_tensor_form_raises(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        with pytest.raises(NotImplementedError):
+            paddle.autograd.hessian(y, x)
+
+    def test_jvp_vjp(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32))
+        _, t = paddle.autograd.jvp(lambda a: a * a, x)
+        assert np.allclose(t.numpy(), [6.0])
+        _, g = paddle.autograd.vjp(lambda a: a * a, x)
+        assert np.allclose(g.numpy(), [6.0])
+
+    def test_jacobian_class(self):
+        J = paddle.autograd.Jacobian(
+            lambda t: t * 2.0, paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+        assert np.allclose(np.asarray(J[0, 0]), 2.0)
+
+
+class TestNamespaceParity:
+    def test_clip_in_nn(self):
+        assert paddle.nn.ClipGradByGlobalNorm is not None
+        assert paddle.nn.ClipGradByNorm is not None
+        assert paddle.nn.ClipGradByValue is not None
+
+    def test_regularizer_module(self):
+        r = paddle.regularizer.L2Decay(1e-4)
+        assert r is not None
+        assert paddle.regularizer.L1Decay(1e-4) is not None
+
+    def test_sharding_namespace(self):
+        assert callable(paddle.distributed.sharding.group_sharded_parallel)
+        assert callable(paddle.distributed.group_sharded_parallel)
