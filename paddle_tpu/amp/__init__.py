@@ -85,6 +85,15 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
                     p._set_data(p._data.astype(d))
     if optimizers is None:
         return models if is_list else model_list[0]
+    opt_list = optimizers if isinstance(optimizers, (list, tuple)) \
+        else [optimizers]
+    for o in opt_list:
+        # create fp32 masters for the freshly cast params NOW — creating them
+        # lazily inside the first to_static trace would force a second
+        # whole-program compile (fused optimizers keep their pre-cast fp32
+        # flat master instead)
+        if hasattr(o, "_on_params_cast"):
+            o._on_params_cast()
     return (models if is_list else model_list[0]), optimizers
 
 

@@ -71,6 +71,8 @@ class Tensor:
         self.persistable = False
         self.trainable = True
         self._hooks: dict = {}
+        self._version = 0  # bumped on _set_data; lets derived state (AMP
+        #                    masters) detect external writes (state_dict load)
 
     # --- payload mutation (the single write seam; trace-visible) ------------
     def _set_data(self, value) -> None:
@@ -78,6 +80,7 @@ class Tensor:
         if ts is not None:
             ts.record_mutation("data", self)
         self._data = value
+        self._version += 1
 
     @property
     def grad(self) -> Optional["Tensor"]:

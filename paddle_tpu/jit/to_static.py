@@ -14,9 +14,29 @@ from ..core.tensor import Tensor, _state_registry, _is_tracer
 from ..core.tracing import (TraceState, pop_trace_state, push_trace_state,
                             trace_state)
 
-__all__ = ["StaticFunction", "to_static", "not_to_static", "ignore_module"]
+__all__ = ["StaticFunction", "to_static", "not_to_static", "ignore_module",
+           "register_pretrace_hook"]
 
 _ENABLED = True
+
+# Objects with lazily-derived state (e.g. optimizer AMP masters) register here;
+# before any (re)trace we give them a chance to reconcile derived state with
+# concrete values — inside the trace the data is symbolic and it's too late.
+_pretrace_refs: List = []
+
+
+def register_pretrace_hook(obj) -> None:
+    _pretrace_refs.append(weakref.ref(obj))
+
+
+def _run_pretrace_hooks() -> None:
+    alive = []
+    for r in _pretrace_refs:
+        o = r()
+        if o is not None:
+            alive.append(r)
+            o._refresh_derived_state()
+    _pretrace_refs[:] = alive
 
 
 def _set_enabled(flag: bool) -> None:
@@ -68,6 +88,12 @@ class StaticFunction:
             # nested to_static or globally disabled -> run eagerly/inline
             return self._fn(*args, **kwargs)
 
+        # runs on every call (not just cache misses): a state_dict load after
+        # compilation must be reconciled into derived state (fp32 masters)
+        # BEFORE the compiled step reads it — masters are carried state, so a
+        # data refresh needs no retrace
+        _run_pretrace_hooks()
+
         leaves, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=_is_tensor)
         arg_arrays: List[Any] = []
@@ -93,6 +119,11 @@ class StaticFunction:
         key = (treedef, static_key, tuple(rid for rid, _ in state_items))
         entry = self._cache.get(key)
         if entry is None:
+            # hooks may touch the registry; recompute the key before building
+            state_items = _state_registry.alive_items()
+            key = (treedef, static_key, tuple(rid for rid, _ in state_items))
+            entry = self._cache.get(key)
+        if entry is None:
             entry = self._build(treedef, proto, statics,
                                 [t for _, t in state_items])
             self._cache[key] = entry
@@ -108,7 +139,7 @@ class StaticFunction:
         out_arrays, new_state, mut_vals = jitted(state_arrays, arg_arrays)
         for t, arr in zip(state_tensors, new_state):
             t._data = arr
-        self._rebind(holder, mut_vals)
+        self._rebind(holder, mut_vals, leaves)
         return _wrap_outputs(out_arrays)
 
     # -------------------------------------------------------------------------
@@ -129,7 +160,8 @@ class StaticFunction:
                 it_arr = iter(arg_arrays)
                 it_static = iter(statics)
                 leaves2 = []
-                for p in proto:
+                arg_pos = {}  # id(inner arg Tensor) -> leaf position
+                for pos, p in enumerate(proto):
                     if p is _STATIC:
                         leaves2.append(next(it_static))
                     elif p is None:
@@ -137,6 +169,7 @@ class StaticFunction:
                     else:
                         t = Tensor(next(it_arr), stop_gradient=p.stop_gradient,
                                    name=p.name)
+                        arg_pos[id(t)] = pos
                         leaves2.append(t)
                 args2, kwargs2 = jax.tree_util.tree_unflatten(treedef, leaves2)
                 out = fn(*args2, **kwargs2)
@@ -162,7 +195,13 @@ class StaticFunction:
                         val = None if g is None else g._data
                     if val is not None and not _is_tracer(val):
                         val = jnp.asarray(val)
-                    spec.append((kind, ref))
+                    if id(tt) in arg_pos:
+                        # mutation of a traced ARG tensor: rebind onto the
+                        # caller's tensor for that leaf position at call time
+                        # (paddle parity: x.grad lands on the passed-in x)
+                        spec.append((f"arg_{kind}", arg_pos[id(tt)]))
+                    else:
+                        spec.append((kind, ref))
                     mut_vals.append(val)
                 holder["spec"] = spec
                 return out_arrays, new_state, mut_vals
@@ -177,11 +216,15 @@ class StaticFunction:
         return jitted, state_refs, holder
 
     @staticmethod
-    def _rebind(holder, mut_vals) -> None:
+    def _rebind(holder, mut_vals, leaves=None) -> None:
         spec = holder["spec"] or []
         for (kind, ref), val in zip(spec, mut_vals):
-            tt = ref()
-            if tt is None:
+            if kind.startswith("arg_"):
+                tt = leaves[ref] if leaves is not None else None
+                kind = kind[4:]
+            else:
+                tt = ref()
+            if tt is None or not isinstance(tt, Tensor):
                 continue
             if kind == "data":
                 if val is not None:

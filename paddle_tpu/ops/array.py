@@ -20,7 +20,7 @@ from typing import List, Optional
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
-from ._helpers import OP_REGISTRY, ensure_tensor, register_op
+from ._helpers import ensure_tensor, register_op
 
 
 class TensorArray(list):

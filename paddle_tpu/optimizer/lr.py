@@ -31,6 +31,12 @@ class LRScheduler:
         else:
             self.last_epoch = epoch
         self.last_lr = self.get_lr()
+        # push the new value into each bound optimizer's carried LR state so
+        # already-compiled train steps see it (see Optimizer._lr_value)
+        for ref in getattr(self, "_bound_opts", ()):
+            opt = ref()
+            if opt is not None:
+                opt._sync_lr_tensor()
         if self.verbose:
             print(f"Epoch {self.last_epoch}: setting learning rate to {self.last_lr}")
 

@@ -19,6 +19,7 @@ from typing import Any, Callable, List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..core import tracing as _tracing
 from ..core.tensor import Tensor
 from ..core.tracing import no_grad
 
@@ -48,6 +49,24 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
     requirement that while_op block outputs match inputs)."""
     leaves, treedef = _flatten(list(loop_vars))
     datas = [_as_array(l) for l in leaves]
+
+    needs_grad = (_tracing.grad_enabled() and
+                  any(isinstance(l, Tensor) and not l.stop_gradient
+                      for l in leaves))
+    if needs_grad and not any(_is_traced(d) for d in datas):
+        # differentiable eager path: unroll through the tape (the analogue of
+        # the reference while_op recording per-iteration blocks for backward)
+        vars_ = list(loop_vars)
+        while bool(_as_array(cond(*vars_))):
+            r = body(*vars_)
+            vars_ = list(r) if isinstance(r, (tuple, list)) else [r]
+        return vars_
+    if needs_grad:
+        raise RuntimeError(
+            "while_loop with differentiable loop_vars inside to_static is not "
+            "supported (XLA's while is not reverse-differentiable); mark the "
+            "loop_vars stop_gradient, wrap the loop in paddle.no_grad(), or "
+            "use a bounded-trip-count formulation")
 
     def c(ds):
         r = cond(*_rewrap(ds, leaves, treedef))
@@ -92,6 +111,30 @@ def cond(pred, true_fn: Callable = None, false_fn: Callable = None,
         taken = true_fn if bool(parr) else false_fn
         return taken() if taken is not None else None
 
+    if _tracing.grad_enabled():
+        # differentiable path: evaluate BOTH branches through the tape and
+        # select elementwise — where()'s vjp masks the untaken side, so
+        # gradients flow exactly like the reference's conditional_block_grad.
+        # (lax.cond would run the branches detached; select trades the
+        # run-one-branch saving for autograd support, the right default in a
+        # training graph.)
+        from ..ops import indexing as _ops
+        t_out = true_fn() if true_fn is not None else None
+        f_out = false_fn() if false_fn is not None else None
+        t_leaves, t_def = _flatten(t_out)
+        f_leaves, _ = _flatten(f_out)
+        pbool = parr.reshape(()).astype(bool)
+        sel = []
+        for a, b in zip(t_leaves, f_leaves):
+            if isinstance(a, Tensor) or isinstance(b, Tensor):
+                at = a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+                bt = b if isinstance(b, Tensor) else Tensor(jnp.asarray(b))
+                sel.append(_ops.where(Tensor(
+                    jnp.broadcast_to(pbool, at._data.shape)), at, bt))
+            else:
+                sel.append(jnp.where(pbool, jnp.asarray(a), jnp.asarray(b)))
+        return jax.tree_util.tree_unflatten(t_def, sel)
+
     info: dict = {}
     out = jax.lax.cond(parr.reshape(()).astype(bool),
                        _make_branch(true_fn, info),
@@ -129,19 +172,37 @@ def switch_case(branch_index, branch_fns, default: Callable = None,
         items = list(enumerate(branch_fns))
     keys = [k for k, _ in items]
     fns = [f for _, f in items]
-    if default is None:
-        default = fns[-1]
 
     idx_arr = _as_array(branch_index).reshape(()).astype(jnp.int32)
     if not _is_traced(idx_arr):
-        return dict(items).get(int(idx_arr), default)()
+        return dict(items).get(int(idx_arr), default or fns[-1])()
 
-    # remap the (possibly sparse) keys to dense switch positions so the
-    # branch table has exactly len(keys)+1 entries regardless of key values
+    # remap the (possibly sparse) keys to dense switch positions; with no
+    # explicit default the no-match case reuses the LAST branch's slot
+    # (reference semantics) instead of tracing it twice
     keys_arr = jnp.asarray(keys, jnp.int32)
     hit = idx_arr == keys_arr
-    sel = jnp.where(hit.any(), jnp.argmax(hit), len(fns)).astype(jnp.int32)
-    table = fns + [default]
+    miss_slot = len(fns) if default is not None else len(fns) - 1
+    sel = jnp.where(hit.any(), jnp.argmax(hit), miss_slot).astype(jnp.int32)
+    table = fns + ([default] if default is not None else [])
+
+    if _tracing.grad_enabled():
+        # differentiable: run every branch on the tape, fold with where()
+        # (see cond() — same select-for-autograd tradeoff)
+        from ..ops import indexing as _ops
+        branch_outs = [f() for f in table]
+        acc_leaves, treedef = _flatten(branch_outs[-1])
+        acc = [a if isinstance(a, Tensor) else Tensor(jnp.asarray(a))
+               for a in acc_leaves]
+        for i in range(len(fns)):
+            bl, _ = _flatten(branch_outs[i])
+            m = sel == i
+            acc = [_ops.where(Tensor(jnp.broadcast_to(m, a._data.shape)),
+                              b if isinstance(b, Tensor)
+                              else Tensor(jnp.asarray(b)), a)
+                   for a, b in zip(acc, bl)]
+        return jax.tree_util.tree_unflatten(treedef, acc)
+
     info: dict = {}
     out = jax.lax.switch(sel, [_make_branch(f, info) for f in table], 0)
     return _rewrap(out, info["leaves"], info["treedef"])

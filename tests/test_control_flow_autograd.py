@@ -180,3 +180,64 @@ class TestNamespaceParity:
     def test_sharding_namespace(self):
         assert callable(paddle.distributed.sharding.group_sharded_parallel)
         assert callable(paddle.distributed.group_sharded_parallel)
+
+
+class TestControlFlowGradients:
+    """Gradients THROUGH control-flow ops (reference: while_op/
+    conditional_block_op grad support in paddle/fluid/operators/controlflow/)."""
+
+    def test_cond_grad_eager(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        y = snn.cond(paddle.to_tensor(True), lambda: x * 2, lambda: x - 1)
+        y.sum().backward()
+        assert x.grad is not None
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_cond_grad_traced(self):
+        @paddle.jit.to_static
+        def f(x):
+            y = snn.cond(x.sum() > 0, lambda: x * 3, lambda: x * 5)
+            y.sum().backward()
+            return y
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        f(x)
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+        x2 = paddle.to_tensor(np.array([-1.0, -2.0], np.float32),
+                              stop_gradient=False)
+        f(x2)
+        np.testing.assert_allclose(x2.grad.numpy(), [5.0, 5.0])
+
+    def test_switch_case_grad_traced(self):
+        @paddle.jit.to_static
+        def f(idx, x):
+            y = snn.switch_case(idx, {0: lambda: x * 2, 1: lambda: x * 7},
+                                default=lambda: x * 0)
+            y.sum().backward()
+            return y
+
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        f(paddle.to_tensor(1), x)
+        np.testing.assert_allclose(x.grad.numpy(), [7.0, 7.0, 7.0])
+
+    def test_while_loop_grad_eager(self):
+        x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        i = paddle.to_tensor(0)
+        i2, y = snn.while_loop(lambda i, a: i < 3,
+                               lambda i, a: [i + 1, a * 2.0], [i, x])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])  # d(8x)/dx
+
+    def test_while_loop_grad_traced_raises(self):
+        @paddle.jit.to_static
+        def f(n, x):
+            _, y = snn.while_loop(lambda i, a: i < n,
+                                  lambda i, a: [i + 1, a * 2.0],
+                                  [paddle.to_tensor(0), x])
+            return y
+
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        with pytest.raises(RuntimeError, match="not .*differentiable|while_loop"):
+            f(paddle.to_tensor(3), x)
