@@ -65,10 +65,18 @@ class StaticFunction:
     """
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
-                 backend=None, full_graph=True, donate_states: bool = True):
+                 backend=None, full_graph=True, donate_states: bool = True,
+                 iters_per_call: int = 1):
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._donate = donate_states
+        # iters_per_call > 1: lax.scan ``fn`` over the leading axis of every
+        # tensor argument inside ONE compiled call (state is the scan carry).
+        # This is the standard TPU scan-over-steps trainer pattern — it
+        # amortizes per-dispatch overhead (which on a remote-attached chip is
+        # ~20ms/call for a model-sized buffer set) across K steps. The fn is
+        # still written per-step; the caller passes K-stacked inputs.
+        self._iters = int(iters_per_call)
         self._cache: Dict[Any, Tuple] = {}
         self.concrete_program = None  # parity attribute
 
@@ -110,6 +118,14 @@ class StaticFunction:
                 statics.append(leaf)
                 proto.append(_STATIC)
 
+        if self._iters > 1:
+            for arr in arg_arrays:
+                if arr.ndim == 0 or arr.shape[0] != self._iters:
+                    raise ValueError(
+                        f"iters_per_call={self._iters}: every tensor argument "
+                        f"must be stacked with leading dim {self._iters}, got "
+                        f"shape {tuple(arr.shape)}")
+
         state_items = _state_registry.alive_items()  # [(regid, tensor)]
         try:
             static_key = tuple(statics)
@@ -144,6 +160,8 @@ class StaticFunction:
 
     # -------------------------------------------------------------------------
     def _build(self, treedef, proto, statics, state_tensors):
+        if self._iters > 1:
+            return self._build_scan(treedef, proto, statics, state_tensors)
         holder: Dict[str, Any] = {"spec": None}
         fn = self._fn
         state_refs = [weakref.ref(t) for t in state_tensors]
@@ -157,21 +175,9 @@ class StaticFunction:
             ts = TraceState()
             push_trace_state(ts)
             try:
-                it_arr = iter(arg_arrays)
-                it_static = iter(statics)
-                leaves2 = []
                 arg_pos = {}  # id(inner arg Tensor) -> leaf position
-                for pos, p in enumerate(proto):
-                    if p is _STATIC:
-                        leaves2.append(next(it_static))
-                    elif p is None:
-                        leaves2.append(next(it_arr))
-                    else:
-                        t = Tensor(next(it_arr), stop_gradient=p.stop_gradient,
-                                   name=p.name)
-                        arg_pos[id(t)] = pos
-                        leaves2.append(t)
-                args2, kwargs2 = jax.tree_util.tree_unflatten(treedef, leaves2)
+                args2, kwargs2 = _rebuild_args(proto, statics, arg_arrays,
+                                               treedef, arg_pos)
                 out = fn(*args2, **kwargs2)
                 out_arrays = jax.tree_util.tree_map(
                     lambda x: x._data if isinstance(x, Tensor) else x, out,
@@ -215,6 +221,74 @@ class StaticFunction:
         jitted = jax.jit(pure_fn, donate_argnums=donate)
         return jitted, state_refs, holder
 
+    def _build_scan(self, treedef, proto, statics, state_tensors):
+        """iters_per_call mode: scan the per-step fn over K-stacked args.
+
+        Constraint: every per-step mutation must either be registered state
+        (rides the scan carry) or resolve to None by step end (grads after
+        ``clear_grad``) — anything else cannot escape the scan body.
+        """
+        holder: Dict[str, Any] = {"spec": None}
+        fn = self._fn
+        state_refs = [weakref.ref(t) for t in state_tensors]
+        state_ids = {id(t) for t in state_tensors}
+
+        def pure_fn(state_arrays, arg_arrays):
+            tensors = [r() for r in state_refs]
+            saved_state = [t._data for t in tensors]
+
+            def body(carry, xs):
+                for t, arr in zip(tensors, carry):
+                    t._data = arr
+                ts = TraceState()
+                push_trace_state(ts)
+                try:
+                    args2, kwargs2 = _rebuild_args(proto, statics, xs, treedef)
+                    out = fn(*args2, **kwargs2)
+                    out_arrays = jax.tree_util.tree_map(
+                        lambda x: x._data if isinstance(x, Tensor) else x, out,
+                        is_leaf=_is_tensor)
+                    spec = []
+                    for kind, ref in ts.mutations:
+                        tt = ref()
+                        if tt is None:
+                            continue
+                        if kind == "data":
+                            if id(tt) in state_ids:
+                                continue
+                            if _is_tracer(tt._data):
+                                raise RuntimeError(
+                                    "iters_per_call: the step mutates a "
+                                    f"non-state tensor ({tt.name or 'unnamed'})"
+                                    "; register it as state or drop "
+                                    "iters_per_call")
+                            continue  # concrete host-side write: ignore
+                        g = tt._grad
+                        if g is not None and _is_tracer(g._data):
+                            raise RuntimeError(
+                                "iters_per_call: gradients must be cleared "
+                                "within the step (call opt.clear_grad()) so "
+                                "no per-step value escapes the scan")
+                        spec.append(("grad", ref))
+                    holder["spec"] = spec
+                    new_state = [t._data for t in tensors]
+                    return new_state, out_arrays
+                finally:
+                    pop_trace_state()
+                    ts.restore()
+                    for t, arr in zip(tensors, saved_state):
+                        t._data = arr
+
+            final_state, outs = jax.lax.scan(body, list(state_arrays),
+                                             list(arg_arrays),
+                                             length=self._iters)
+            mut_vals = [None] * len(holder["spec"] or [])
+            return outs, final_state, mut_vals
+
+        donate = (0,) if self._donate else ()
+        jitted = jax.jit(pure_fn, donate_argnums=donate)
+        return jitted, state_refs, holder
+
     @staticmethod
     def _rebind(holder, mut_vals, leaves=None) -> None:
         spec = holder["spec"] or []
@@ -245,6 +319,27 @@ class _StaticMarker:
 _STATIC = _StaticMarker()
 
 
+def _rebuild_args(proto, statics, arrays, treedef, arg_pos=None):
+    """Reconstruct the traced call's (args, kwargs) from the flat pieces:
+    per-leaf proto (Tensor template | None | _STATIC), static values, and the
+    traced arrays. Shared by the single-step and scan build paths."""
+    it_arr = iter(arrays)
+    it_static = iter(statics)
+    leaves = []
+    for pos, p in enumerate(proto):
+        if p is _STATIC:
+            leaves.append(next(it_static))
+        elif p is None:
+            leaves.append(next(it_arr))
+        else:
+            t = Tensor(next(it_arr), stop_gradient=p.stop_gradient,
+                       name=p.name)
+            if arg_pos is not None:
+                arg_pos[id(t)] = pos
+            leaves.append(t)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def _wrap_outputs(out):
     return jax.tree_util.tree_map(
         lambda x: Tensor(x, stop_gradient=True)
@@ -255,14 +350,18 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
               **kwargs):
     """``paddle.jit.to_static`` parity decorator."""
 
+    sf_kwargs = {k: kwargs[k] for k in ("iters_per_call", "donate_states")
+                 if k in kwargs}
+
     def decorate(fn):
         # Layers: wrap forward, return the layer (paddle semantics)
         from ..nn.layer import Layer
         if isinstance(fn, Layer):
             fn.forward = StaticFunction(fn.forward, input_spec, build_strategy,
-                                        backend)
+                                        backend, **sf_kwargs)
             return fn
-        return StaticFunction(fn, input_spec, build_strategy, backend)
+        return StaticFunction(fn, input_spec, build_strategy, backend,
+                              **sf_kwargs)
 
     if function is not None:
         return decorate(function)

@@ -323,6 +323,11 @@ register_op("normalize", normalize)
 
 # --- attention ---------------------------------------------------------------
 
+_flags.define_flag(
+    "sdpa_flash_min_seqlen", 1024,
+    "scaled_dot_product_attention routes to the flash kernel above this "
+    "query length (0 = always flash)")
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None):
     """Paddle SDPA parity. Inputs (B, L, H, D) as in paddle's flash-attn API.
@@ -331,6 +336,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     available; falls back to the fused XLA softmax-attention otherwise.
     """
     query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    if (attn_mask is None and not (dropout_p > 0.0 and training)
+            and query._data.shape[1] > int(_flags.flag("sdpa_flash_min_seqlen"))):
+        # long sequences take the flash path (Pallas online-softmax kernel on
+        # TPU; blockwise-remat fallback elsewhere): O(L) instead of O(L^2)
+        # activation memory. Short sequences keep the fused XLA softmax
+        # attention — storing the probs for backward is cheaper there than
+        # flash's rematerialized attention FLOPs.
+        from .flash_attention import flash_attention
+        return flash_attention(query, key, value, causal=is_causal,
+                               training=training)
     dkey = default_generator.split_key() if (dropout_p > 0.0 and training) else None
 
     def f(q, k, v, *maybe_mask):

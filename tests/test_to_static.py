@@ -99,3 +99,66 @@ def test_dynamic_shape_op_raises_under_jit():
 
     with pytest.raises(Exception):
         f(paddle.to_tensor([0.0, 1.0, 0.0]))
+
+
+def test_iters_per_call_scan_matches_per_step():
+    """scan-over-steps mode: K stacked batches through ONE compiled call give
+    bit-identical training to K separate compiled steps."""
+    import paddle_tpu.nn as nn
+
+    def train(iters):
+        paddle.seed(5)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=model.parameters(),
+                                     use_multi_tensor=True)
+
+        def step(x, y):
+            loss = nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(8, 4, 8)).astype(np.float32)
+        Y = rng.normal(size=(8, 4, 4)).astype(np.float32)
+        if iters == 1:
+            sf = paddle.jit.to_static(step)
+            losses = [float(sf(paddle.to_tensor(X[i]), paddle.to_tensor(Y[i])))
+                      for i in range(8)]
+        else:
+            sf = paddle.jit.to_static(step, iters_per_call=iters)
+            losses = []
+            for i in range(0, 8, iters):
+                out = sf(paddle.to_tensor(X[i:i + iters]),
+                         paddle.to_tensor(Y[i:i + iters]))
+                losses.extend(np.asarray(out._data).tolist())
+        return losses, [np.asarray(p._data) for p in model.parameters()]
+
+    l1, p1 = train(1)
+    l4, p4 = train(4)
+    np.testing.assert_allclose(l1, l4, rtol=1e-5, atol=1e-6)
+    for a, b in zip(p1, p4):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_iters_per_call_rejects_uncleared_grads():
+    import paddle_tpu.nn as nn
+    import pytest
+
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+
+    @paddle.jit.to_static(iters_per_call=2)
+    def bad_step(x):
+        loss = model(x).mean()
+        loss.backward()
+        opt.step()
+        return loss  # grads NOT cleared -> per-step value would escape scan
+
+    x = paddle.to_tensor(np.ones((2, 2, 4), np.float32))
+    with pytest.raises(RuntimeError, match="cleared within the step"):
+        bad_step(x)
