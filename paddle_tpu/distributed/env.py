@@ -50,6 +50,10 @@ def init_parallel_env(strategy=None):
                                    num_processes=nprocs, process_id=pid)
     from .topology import _ensure_default_topology
     _ensure_default_topology()
+    # elastic launcher present? lease a heartbeat so the manager can tell a
+    # hung worker from a training one (no-op without PADDLE_ELASTIC_MASTER)
+    from .fleet.elastic import start_worker_heartbeat
+    start_worker_heartbeat(rank=pid)
     _initialized = True
     return ParallelEnv()
 

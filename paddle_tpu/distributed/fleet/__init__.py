@@ -18,26 +18,55 @@ from ..env import init_parallel_env
 from ..topology import (HybridCommunicateGroup, get_hybrid_communicate_group,
                         set_hybrid_communicate_group)
 from .strategy import DistributedStrategy
+from . import elastic  # noqa: F401
 from . import mp_layers  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
 from .mp_layers import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                         VocabParallelEmbedding, ParallelCrossEntropy)
+from .role_maker import (Role, RoleMakerBase,  # noqa: F401
+                         PaddleCloudRoleMaker, UserDefinedRoleMaker)
 
 __all__ = [
     "init", "DistributedStrategy", "distributed_model", "distributed_optimizer",
     "get_hybrid_communicate_group", "HybridCommunicateGroup",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "PipelineLayer", "LayerDesc", "SharedLayerDesc",
+    "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+    "is_server", "is_worker", "is_first_worker", "worker_index", "worker_num",
+    "server_num", "worker_endpoints", "server_endpoints", "init_server",
+    "run_server", "init_worker", "stop_worker", "barrier_worker",
 ]
 
 _fleet_initialized = False
 _strategy: Optional[DistributedStrategy] = None
+_role_maker = None
+_server_store = None
 
 
 def init(role_maker=None, is_collective: bool = True,
          strategy: Optional[DistributedStrategy] = None, log_level=None):
-    """``fleet.init`` parity: parse the hybrid config, build the mesh."""
-    global _fleet_initialized, _strategy
+    """``fleet.init`` parity: parse the hybrid config, build the mesh.
+
+    PS mode (``role_maker`` given, not collective): SERVER processes host
+    only the coordination KV plane (the sparse tables themselves are
+    mesh-sharded dense tensors on the workers — the north-star "PS → ICI
+    allreduce path"); WORKER processes form the collective training world.
+    """
+    global _fleet_initialized, _strategy, _role_maker
+    if role_maker is not None and not is_collective:
+        _role_maker = role_maker
+        _strategy = strategy or DistributedStrategy()
+        _fleet_initialized = True
+        if role_maker.is_server():
+            # servers never join the collective mesh; their lifecycle is
+            # init_server()/run_server()
+            return
+        # PS-mode workers are NOT one SPMD world: each drives its own
+        # device(s) and exchanges through the table plane (reference: async
+        # trainers against brpc tables). Build the local topology only.
+        from ..topology import _ensure_default_topology
+        _ensure_default_topology()
+        return
     init_parallel_env()
     _strategy = strategy or DistributedStrategy()
     hc = _strategy.hybrid_configs
@@ -70,6 +99,112 @@ def is_initialized() -> bool:
 
 def get_strategy() -> Optional[DistributedStrategy]:
     return _strategy
+
+
+# --- PS-mode surface (parity: fleet_base's PS lifecycle; backed by the
+# ICI sharded-embedding path, so "servers" host only the KV/rendezvous plane
+# — see distributed/sharded_embedding.py for where the tables actually live)
+
+def _rm():
+    if _role_maker is None:
+        raise RuntimeError("fleet.init(role_maker) was not called (PS mode)")
+    return _role_maker
+
+
+def is_server() -> bool:
+    return _role_maker is not None and _role_maker.is_server()
+
+
+def is_worker() -> bool:
+    return _role_maker is None or _role_maker.is_worker()
+
+
+def is_first_worker() -> bool:
+    return _role_maker is None or _role_maker.is_first_worker()
+
+
+def worker_index() -> int:
+    return 0 if _role_maker is None else _role_maker.worker_index()
+
+
+def worker_num() -> int:
+    return 1 if _role_maker is None else _role_maker.worker_num()
+
+
+def server_num() -> int:
+    return 0 if _role_maker is None else _role_maker.server_num()
+
+
+def worker_endpoints(to_string: bool = False):
+    eps = [] if _role_maker is None else _role_maker.get_trainer_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def server_endpoints(to_string: bool = False):
+    eps = [] if _role_maker is None else _role_maker.get_pserver_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def init_server(*args, **kwargs) -> None:
+    """Start this server's KV plane (reference: BrpcPsServer startup loading
+    table shards; here the coordination store only — tables are on-mesh)."""
+    global _server_store
+    from ..store import TCPStore
+    rm = _rm()
+    ep = rm.get_pserver_endpoints()[rm.server_index()]
+    port = int(ep.rsplit(":", 1)[1])
+    _server_store = TCPStore(is_master=True, port=port,
+                             world_size=rm.worker_num())
+
+
+def run_server() -> None:
+    """Serve until every worker has called ``stop_worker`` (reference:
+    brpc server loop until shutdown RPCs arrive). ``add(key, 0)`` is the
+    atomic counter read."""
+    import time as _time
+    rm = _rm()
+    if _server_store is None:
+        init_server()
+    while True:
+        try:
+            if _server_store.add("ps/shutdown", 0) >= rm.worker_num():
+                break
+        except TimeoutError:
+            pass  # transient: keep serving
+        # ConnectionError and friends propagate — the store lives in THIS
+        # process, so a broken store is fatal, and a silent spin here would
+        # mask the failure behind the launcher's kill timeout
+        _time.sleep(0.2)
+    _server_store.close()
+
+
+def init_worker(scopes=None) -> None:
+    """Reference: creates the brpc client + pulls dense params. ICI path:
+    tables are already mesh-resident; nothing to pull."""
+    _rm()  # assert PS mode
+
+
+def stop_worker() -> None:
+    """Signal every server's KV plane that this worker is done."""
+    from ..store import TCPStore
+    rm = _rm()
+    for ep in rm.get_pserver_endpoints():
+        host, port = ep.rsplit(":", 1)
+        try:
+            c = TCPStore(host or "127.0.0.1", int(port))
+            c.add("ps/shutdown", 1)
+            c.close()
+        except Exception:
+            pass  # server already gone
+
+
+def barrier_worker() -> None:
+    """Barrier across worker processes (uses the collective env when
+    multi-process; trivially passes single-process)."""
+    import jax
+    if jax.process_count() > 1:
+        from ..comm import barrier
+        barrier()
 
 
 def distributed_model(model):

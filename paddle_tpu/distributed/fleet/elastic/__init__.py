@@ -1,0 +1,26 @@
+"""Elastic training: fault detection + restart orchestration.
+
+Parity surface: python/paddle/distributed/fleet/elastic/ (upstream
+``ElasticManager`` watches etcd-registered workers with TTL leases; on
+membership change it recomputes ranks and restarts the job —
+``launch --elastic_level 1`` = restart on fault with the same world size
+from checkpoint, level 2 = resize).
+
+TPU-native design: no etcd. The coordination plane is the framework's own
+``TCPStore`` (paddle_tpu/distributed/store.py — the same rendezvous KV the
+collective init uses): workers lease a ``elastic/beat/{rank}`` key via a
+daemon heartbeat thread; the launcher-side :class:`ElasticManager` watches
+lease freshness plus child-process liveness, and on a fault kills the pod and
+respawns it with ``PADDLE_RESTART_COUNT`` bumped so training scripts reload
+their latest checkpoint. Slice health on real multi-host TPU rides the same
+watch loop (a host that loses its slice stops beating).
+"""
+
+from .manager import (ELASTIC_ENV_MASTER, ELASTIC_ENV_RESTARTS,
+                      ElasticLevel, ElasticManager, ElasticStatus,
+                      enable_elastic, start_worker_heartbeat)
+
+__all__ = [
+    "ElasticLevel", "ElasticManager", "ElasticStatus", "enable_elastic",
+    "start_worker_heartbeat", "ELASTIC_ENV_MASTER", "ELASTIC_ENV_RESTARTS",
+]

@@ -1,0 +1,190 @@
+"""ElasticManager: heartbeat watch + restart decisions.
+
+Reference behavior (upstream python/paddle/distributed/fleet/elastic/
+manager.py): workers register in etcd under a job prefix with TTL leases;
+the manager's watch loop classifies the job as HOLD (membership incomplete),
+RESTART (fault detected, respawn), COMPLETED, or EXIT (restarts exhausted).
+This module keeps those states and the watch-loop shape, over our TCPStore.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "ElasticLevel", "ElasticStatus", "ElasticManager", "enable_elastic",
+    "start_worker_heartbeat", "ELASTIC_ENV_MASTER", "ELASTIC_ENV_RESTARTS",
+]
+
+ELASTIC_ENV_MASTER = "PADDLE_ELASTIC_MASTER"      # host:port of the beat store
+ELASTIC_ENV_RESTARTS = "PADDLE_RESTART_COUNT"     # bumped on every respawn
+
+
+class ElasticLevel:
+    NONE = 0
+    FAULT_TOLERANCE = 1   # restart on fault, same world size
+    ELASTIC = 2           # resize on membership change
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+def enable_elastic(args=None, etcd_client=None) -> bool:
+    """Parity helper: elastic is on when an elastic level > 0 is requested
+    (upstream also requires an etcd endpoint; we self-host the store)."""
+    level = getattr(args, "elastic_level", None)
+    if level is None:
+        level = int(os.environ.get("PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL", 0))
+    return int(level) > 0
+
+
+def start_worker_heartbeat(rank: Optional[int] = None,
+                           interval: float = 2.0) -> Optional[threading.Thread]:
+    """Worker side: lease ``elastic/beat/{rank}`` in the manager's store from
+    a daemon thread. Called automatically by ``init_parallel_env`` when the
+    launcher exported :data:`ELASTIC_ENV_MASTER`; safe no-op otherwise."""
+    master = os.environ.get(ELASTIC_ENV_MASTER)
+    if not master:
+        return None
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    host, port = master.rsplit(":", 1)
+
+    from ...store import TCPStore
+    store = TCPStore(host, int(port))
+
+    def beat() -> None:
+        while True:
+            try:
+                store.set(f"elastic/beat/{rank}", str(time.time()))
+            except Exception:
+                return  # manager gone: job is shutting down
+            time.sleep(interval)
+
+    t = threading.Thread(target=beat, daemon=True,
+                         name=f"elastic-heartbeat-{rank}")
+    t.start()
+    return t
+
+
+class ElasticManager:
+    """Launcher-side watch loop.
+
+    ``procs`` liveness is the primary fault signal (a dead worker process is
+    definitive); heartbeat staleness catches hangs — a worker that is alive
+    but has stopped making progress past ``beat_timeout``.
+    """
+
+    def __init__(self, world_size: int,
+                 elastic_level: int = ElasticLevel.FAULT_TOLERANCE,
+                 beat_timeout: float = 30.0, max_restarts: int = 3,
+                 store=None, rank_offset: int = 0):
+        self.world_size = world_size
+        # first GLOBAL rank of the locally-supervised procs (multi-node:
+        # node_rank * nproc_per_node); beat keys are global-rank keyed
+        self.rank_offset = rank_offset
+        self.elastic_level = elastic_level
+        self.beat_timeout = beat_timeout
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        if store is None:
+            from ...store import TCPStore
+            store = TCPStore(is_master=True, world_size=world_size)
+        self.store = store
+        self._started = time.time()
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.store.port}"
+
+    def worker_env(self) -> Dict[str, str]:
+        """Extra env for spawned workers."""
+        return {
+            ELASTIC_ENV_MASTER: self.endpoint,
+            ELASTIC_ENV_RESTARTS: str(self.restarts),
+            "PADDLE_ELASTIC_FAULT_TOLERANC_LEVEL": str(self.elastic_level),
+        }
+
+    # --- fault classification -------------------------------------------------
+    def _beat_age(self, rank: int) -> Optional[float]:
+        try:
+            if not self.store.check(f"elastic/beat/{rank}"):
+                return None  # never registered: not hang-monitored
+            raw = self.store.get(f"elastic/beat/{rank}", timeout=1.0)
+        except Exception:
+            return None
+        try:
+            return time.time() - float(raw.decode())
+        except (ValueError, AttributeError):
+            return None
+
+    def classify(self, procs: List) -> str:
+        """One watch tick over child processes + leases."""
+        codes = [p.poll() for p in procs]
+        if all(c == 0 for c in codes):
+            return ElasticStatus.COMPLETED
+        if any(c is not None and c != 0 for c in codes):
+            return (ElasticStatus.RESTART
+                    if self.restarts < self.max_restarts
+                    else ElasticStatus.ERROR)
+        # remaining procs are running or exited clean: check RUNNING workers
+        # for hangs via lease freshness (a worker that exited 0 naturally
+        # stops beating — that is not a hang; and a script that never
+        # registered a beat simply isn't hang-monitored)
+        for i, code in enumerate(codes):
+            if code == 0:
+                continue
+            age = self._beat_age(self.rank_offset + i)
+            if age is not None and age > self.beat_timeout:
+                return (ElasticStatus.RESTART
+                        if self.restarts < self.max_restarts
+                        else ElasticStatus.ERROR)
+        return ElasticStatus.HOLD
+
+    # --- the loop -------------------------------------------------------------
+    def watch(self, procs: List, respawn: Callable[[int], List],
+              poll_interval: float = 1.0) -> int:
+        """Supervise ``procs`` until completion or restart exhaustion.
+
+        ``respawn(restart_count)`` must kill-and-recreate the worker list
+        (the launcher owns process creation). Returns the exit code."""
+        while True:
+            status = self.classify(procs)
+            if status == ElasticStatus.COMPLETED:
+                return 0
+            if status == ElasticStatus.ERROR:
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                return 1
+            if status == ElasticStatus.RESTART:
+                self.restarts += 1
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except Exception:
+                        p.kill()
+                self._clear_beats()
+                procs = respawn(self.restarts)
+                continue
+            time.sleep(poll_interval)
+
+    def _clear_beats(self) -> None:
+        """Delete (not re-seed) leases: a seeded key would falsely register a
+        worker that never heartbeats, turning every restart into a hang."""
+        for rank in range(self.world_size):
+            try:
+                self.store.delete_key(f"elastic/beat/{rank}")
+            except Exception:
+                pass

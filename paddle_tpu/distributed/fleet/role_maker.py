@@ -1,0 +1,122 @@
+"""RoleMaker: process identity for fleet PS-mode jobs.
+
+Parity surface: python/paddle/distributed/fleet/base/role_maker.py
+(``Role``, ``PaddleCloudRoleMaker`` parsing the PADDLE_* env contract,
+``UserDefinedRoleMaker``). The reference uses these to split a job into
+brpc parameter-server processes and trainer processes (upstream
+paddle/fluid/distributed/ps/service/).
+
+TPU-native meaning (north star: "PS → ICI allreduce path"): the embedding
+table is mesh-sharded (distributed.sharded_embedding) and updated by XLA
+collectives over ICI, so SERVER processes host only the rendezvous/KV plane
+(our TCPStore), not parameter shards; WORKER processes form the collective
+training world. The API shape (is_server/is_worker/worker_num/...) is kept
+so PaddleRec-style training scripts port unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role: Optional[int] = None
+        self._current_id: int = 0
+        self._worker_endpoints: List[str] = []
+        self._server_endpoints: List[str] = []
+
+    # --- identity ----------------------------------------------------------
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self._role == Role.WORKER and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id if self._role == Role.WORKER else -1
+
+    def server_index(self) -> int:
+        return self._current_id if self._role == Role.SERVER else -1
+
+    def role_id(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return max(len(self._worker_endpoints), 1)
+
+    def server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self) -> List[str]:
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self) -> List[str]:
+        return list(self._server_endpoints)
+
+    def to_string(self) -> str:
+        return (f"role={self._role} id={self._current_id} "
+                f"workers={self._worker_endpoints} "
+                f"servers={self._server_endpoints}")
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-contract role maker (the launcher/PaddleCloud sets PADDLE_*)."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        if is_collective:
+            self._role = Role.WORKER
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            self._worker_endpoints = [e for e in eps.split(",") if e]
+            return
+        training_role = os.environ.get("TRAINING_ROLE",
+                                       os.environ.get("PADDLE_TRAINING_ROLE",
+                                                      "TRAINER"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in eps.split(",") if e]
+        seps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                              os.environ.get("PADDLE_PORT", ""))
+        self._server_endpoints = [e for e in seps.split(",") if e]
+        if training_role in ("TRAINER", "WORKER"):
+            self._role = Role.WORKER
+            self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        elif training_role == "PSERVER":
+            self._role = Role.SERVER
+            cur = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+            self._current_id = (self._server_endpoints.index(cur)
+                                if cur in self._server_endpoints else 0)
+        else:
+            raise ValueError(f"unknown TRAINING_ROLE {training_role!r}")
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Explicitly-specified role (parity: fleet.UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective: bool = False, current_id: int = 0,
+                 role: int = Role.WORKER, worker_num: int = 1,
+                 server_endpoints: Optional[List[str]] = None,
+                 worker_endpoints: Optional[List[str]] = None, **kwargs):
+        super().__init__()
+        self._role = role
+        self._current_id = current_id
+        self._server_endpoints = list(server_endpoints or [])
+        self._worker_endpoints = list(
+            worker_endpoints or [""] * worker_num)

@@ -20,21 +20,72 @@ def _parse():
     p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_TRAINER_ID", 0)))
     p.add_argument("--log_dir", default="log")
     p.add_argument("--job_id", default="default")
-    p.add_argument("--run_mode", default="collective")
-    p.add_argument("--servers", default="")
-    p.add_argument("--trainers", default="")
+    p.add_argument("--run_mode", default="collective",
+                   help="collective | ps")
+    p.add_argument("--servers", default="", help="ps mode: ip:port list")
+    p.add_argument("--trainers", default="", help="ps mode: ip:port list")
+    p.add_argument("--server_num", type=int, default=0)
+    p.add_argument("--trainer_num", type=int, default=0)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0 off; 1 restart-on-fault (same world size); "
+                        "2 reserved for resize")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--elastic_timeout", type=float, default=30.0,
+                   help="heartbeat staleness that counts as a hang (s)")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
 
 
+def _launch_ps(args) -> int:
+    """PS-mode controller (parity: launch/controllers/ps.py): spawn server
+    processes (TRAINING_ROLE=PSERVER) and trainer processes on localhost."""
+    os.makedirs(args.log_dir, exist_ok=True)
+    base = 38000
+    servers = [e for e in args.servers.split(",") if e] or [
+        f"127.0.0.1:{base + i}" for i in range(args.server_num or 1)]
+    trainers = [e for e in args.trainers.split(",") if e] or [
+        f"127.0.0.1:{base + 100 + i}" for i in range(args.trainer_num or 1)]
+    cmd = [sys.executable, args.script] + list(args.script_args)
+    common = {
+        "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(servers),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(trainers),
+        "PADDLE_TRAINERS_NUM": str(len(trainers)),
+    }
+    procs: List[subprocess.Popen] = []
+    for i, ep in enumerate(servers):
+        env = dict(os.environ, TRAINING_ROLE="PSERVER",
+                   PADDLE_CURRENT_ENDPOINT=ep, **common)
+        logf = open(os.path.join(args.log_dir, f"serverlog.{i}"), "w")
+        procs.append(subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf))
+    worker_procs: List[subprocess.Popen] = []
+    for i, ep in enumerate(trainers):
+        env = dict(os.environ, TRAINING_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(i), PADDLE_CURRENT_ENDPOINT=ep,
+                   **common)
+        logf = open(os.path.join(args.log_dir, f"workerlog.{i}"), "w")
+        worker_procs.append(subprocess.Popen(cmd, env=env, stdout=logf,
+                                             stderr=logf))
+    code = 0
+    for pr in worker_procs:
+        code = pr.wait() or code
+    for pr in procs:  # servers exit once workers signal stop_worker
+        try:
+            code = pr.wait(timeout=60) or code
+        except subprocess.TimeoutExpired:
+            pr.terminate()
+            code = code or 1
+    return code
+
+
 def launch_main() -> int:
     args = _parse()
+    if args.run_mode == "ps" or args.servers or args.server_num:
+        return _launch_ps(args)
     nnodes = int(str(args.nnodes).split(":")[0])
     nproc = args.nproc_per_node or 1
     os.makedirs(args.log_dir, exist_ok=True)
 
-    procs: List[subprocess.Popen] = []
     base_port = 37777
     master = args.master or f"127.0.0.1:{base_port}"
     world = nnodes * nproc
@@ -42,26 +93,56 @@ def launch_main() -> int:
         f"127.0.0.1:{base_port + i}" for i in range(world)) if nnodes == 1 \
         else os.environ.get("PADDLE_TRAINER_ENDPOINTS", master)
 
-    for local_rank in range(nproc):
-        rank = args.rank * nproc + local_rank
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(world),
+    manager = None
+    if args.elastic_level > 0:
+        from ..fleet.elastic import ElasticManager
+        manager = ElasticManager(world_size=world,
+                                 elastic_level=args.elastic_level,
+                                 beat_timeout=args.elastic_timeout,
+                                 max_restarts=args.max_restarts,
+                                 rank_offset=args.rank * nproc)
+
+    def spawn(restart_count: int = 0) -> List[subprocess.Popen]:
+        out: List[subprocess.Popen] = []
+        for local_rank in range(nproc):
+            rank = args.rank * nproc + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": endpoints,
+                "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank]
+                if rank < len(endpoints.split(",")) else master,
+                "PADDLE_MASTER": master,
+                "FLAGS_selected_devices": args.devices or "",
+            })
+            if manager is not None:
+                env.update(manager.worker_env())
+            suffix = f".{restart_count}" if restart_count else ""
+            logf = open(os.path.join(
+                args.log_dir, f"workerlog.{local_rank}{suffix}"), "w")
+            cmd = [sys.executable, args.script] + list(args.script_args)
+            out.append(subprocess.Popen(cmd, env=env, stdout=logf,
+                                        stderr=logf))
+        return out
+
+    if world == 1 and manager is None:
+        # single worker: run inline so stdout/tty behave normally
+        rank_env = {
+            "PADDLE_TRAINER_ID": str(args.rank), "PADDLE_TRAINERS_NUM": "1",
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
-            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank]
-            if rank < len(endpoints.split(",")) else master,
+            "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[0],
             "PADDLE_MASTER": master,
             "FLAGS_selected_devices": args.devices or "",
-        })
-        logf = open(os.path.join(args.log_dir, f"workerlog.{local_rank}"), "w")
-        cmd = [sys.executable, args.script] + list(args.script_args)
-        if world == 1:
-            # single worker: run inline so stdout/tty behave normally
-            os.environ.update(env)
-            return subprocess.call(cmd)
-        procs.append(subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf))
+        }
+        os.environ.update(rank_env)
+        return subprocess.call(
+            [sys.executable, args.script] + list(args.script_args))
 
+    procs = spawn()
+    if manager is not None:
+        # elastic supervision: restart the pod from checkpoint on fault
+        return manager.watch(procs, spawn)
     code = 0
     for pr in procs:
         code = pr.wait() or code

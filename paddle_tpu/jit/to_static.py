@@ -94,6 +94,8 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _ENABLED or trace_state() is not None:
             # nested to_static or globally disabled -> run eagerly/inline
+            if self._iters > 1:
+                return self._run_iters_eager(args, kwargs)
             return self._fn(*args, **kwargs)
 
         # runs on every call (not just cache misses): a state_dict load after
@@ -157,6 +159,24 @@ class StaticFunction:
             t._data = arr
         self._rebind(holder, mut_vals, leaves)
         return _wrap_outputs(out_arrays)
+
+    def _run_iters_eager(self, args, kwargs):
+        """Eager-mode equivalent of the scan: slice the K-stacked tensor args
+        and run fn per step, stacking the outputs — so a debug run with
+        to_static disabled keeps the compiled run's semantics."""
+        def slice_leaf(i):
+            return lambda x: x[i] if isinstance(x, Tensor) else x
+
+        outs = []
+        for i in range(self._iters):
+            a_i, k_i = jax.tree_util.tree_map(
+                slice_leaf(i), (args, kwargs), is_leaf=_is_tensor)
+            outs.append(self._fn(*a_i, **k_i))
+        return jax.tree_util.tree_map(
+            lambda *xs: Tensor(jnp.stack([x._data for x in xs]),
+                               stop_gradient=True)
+            if isinstance(xs[0], Tensor) else xs[0],
+            *outs, is_leaf=_is_tensor)
 
     # -------------------------------------------------------------------------
     def _build(self, treedef, proto, statics, state_tensors):

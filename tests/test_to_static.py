@@ -162,3 +162,50 @@ def test_iters_per_call_rejects_uncleared_grads():
     x = paddle.to_tensor(np.ones((2, 2, 4), np.float32))
     with pytest.raises(RuntimeError, match="cleared within the step"):
         bad_step(x)
+
+
+def test_iters_per_call_eager_fallback_matches():
+    """With to_static globally disabled, an iters_per_call fn must still run
+    K per-step iterations (not one call on the stacked batch)."""
+    import paddle_tpu.nn as nn
+
+    paddle.seed(9)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+
+    @paddle.jit.to_static(iters_per_call=3)
+    def step(x):
+        loss = model(x).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = paddle.to_tensor(np.arange(3 * 2 * 4, dtype=np.float32)
+                         .reshape(3, 2, 4) / 10.0)
+    compiled = np.asarray(step(x)._data)
+
+    paddle.seed(9)
+    model2 = nn.Linear(4, 2)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.05,
+                                parameters=model2.parameters())
+
+    @paddle.jit.to_static(iters_per_call=3)
+    def step2(x):
+        loss = model2(x).mean()
+        loss.backward()
+        opt2.step()
+        opt2.clear_grad()
+        return loss
+
+    paddle.jit.enable_to_static(False)
+    try:
+        eager = np.asarray(step2(x)._data)
+    finally:
+        paddle.jit.enable_to_static(True)
+    assert eager.shape == (3,)
+    np.testing.assert_allclose(compiled, eager, rtol=1e-5, atol=1e-6)
+    for a, b in zip(model.parameters(), model2.parameters()):
+        np.testing.assert_allclose(np.asarray(a._data), np.asarray(b._data),
+                                   rtol=1e-5, atol=1e-6)
