@@ -81,6 +81,10 @@ from .hapi import callbacks  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import audio  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from .flops_counter import flops  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
 
 __version__ = "0.1.0"

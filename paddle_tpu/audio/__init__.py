@@ -1,0 +1,21 @@
+"""``paddle.audio``: signal-processing features and layers.
+
+Parity surface: python/paddle/audio/ (``functional`` window/filterbank math,
+``features`` Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC layers;
+upstream backends/ soundfile IO is gated — this module covers the compute
+path, which is what the reference's kernels implement).
+
+TPU-native design: everything is jnp over the framework op layer — STFT
+frames batch into one matmul against the DFT basis (MXU-friendly; jnp.fft
+handles the general case), mel filterbanks are precomputed host-side constants
+folded into a single (freq x mel) matmul, exactly the layout XLA fuses best.
+"""
+
+from . import functional  # noqa: F401
+from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,  # noqa: F401
+                       Spectrogram)
+
+from . import features  # noqa: F401
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,125 @@
+"""``paddle.audio.features`` layers (reference:
+python/paddle/audio/features/layers.py)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer import Layer
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_power(x, window, n_fft, hop_length, power, center, pad_mode):
+    """(…, T) -> (…, 1 + n_fft//2, frames) magnitude**power spectrogram."""
+    if center:
+        pad = n_fft // 2
+        mode = "reflect" if pad_mode == "reflect" else "constant"
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode=mode)
+    t = x.shape[-1]
+    n_frames = 1 + (t - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length +
+           jnp.arange(n_fft)[None, :])
+    frames = x[..., idx] * window  # (…, frames, n_fft)
+    spec = jnp.fft.rfft(frames, axis=-1)  # (…, frames, bins)
+    mag = jnp.abs(spec)
+    if power != 1.0:
+        mag = mag ** power
+    return jnp.swapaxes(mag, -1, -2)  # (…, bins, frames)
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        win_length = win_length or n_fft
+        w = AF.get_window(window, win_length)._data
+        if win_length < n_fft:  # center-pad the window to n_fft
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        self.window = Tensor(w)
+
+    def forward(self, x):
+        window = self.window._data
+
+        def f(arr):
+            return _stft_power(arr, window, self.n_fft, self.hop_length,
+                               self.power, self.center, self.pad_mode)
+
+        return apply("spectrogram", f, x if isinstance(x, Tensor)
+                     else Tensor(jnp.asarray(x)))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = AF.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm if isinstance(norm, str) else "none")
+        self.n_mels = n_mels
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        fb = self.fbank._data
+
+        def f(s):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return apply("mel_fbank", f, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, *args, ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, **kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(*args, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None, n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 top_db: Optional[float] = None, dtype: str = "float32",
+                 **kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, n_mels=n_mels,
+            f_min=f_min, f_max=f_max, top_db=top_db, dtype=dtype, **kwargs)
+        self.dct = AF.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        logmel = self.log_mel(x)
+        dct = self.dct._data
+
+        def f(s):
+            return jnp.einsum("mk,...mt->...kt", dct, s)
+
+        return apply("mfcc_dct", f, logmel)

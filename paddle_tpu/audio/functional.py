@@ -1,0 +1,172 @@
+"""``paddle.audio.functional`` (reference:
+python/paddle/audio/functional/functional.py + window.py)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq: Union[Tensor, float], htk: bool = False):
+    """Hertz → mel (Slaney by default, HTK optional — reference semantics)."""
+    scalar = not isinstance(freq, Tensor)
+    f = freq._data if isinstance(freq, Tensor) else np.asarray(freq, np.float32)
+    xp = jnp if isinstance(freq, Tensor) else np
+    if htk:
+        out = 2595.0 * xp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = xp.where(f >= min_log_hz,
+                       min_log_mel + xp.log(xp.maximum(f, 1e-10) / min_log_hz)
+                       / logstep, mels)
+    if scalar:
+        return float(out)
+    return Tensor(out)
+
+
+def mel_to_hz(mel: Union[Tensor, float], htk: bool = False):
+    scalar = not isinstance(mel, Tensor)
+    m = mel._data if isinstance(mel, Tensor) else np.asarray(mel, np.float32)
+    xp = jnp if isinstance(mel, Tensor) else np
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = xp.where(m >= min_log_mel,
+                       min_log_hz * xp.exp(logstep * (m - min_log_mel)),
+                       freqs)
+    if scalar:
+        return float(out)
+    return Tensor(out)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray([mel_to_hz(float(m), htk) for m in mels],
+                              jnp.float32))
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2, dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney"):
+    """(n_mels, 1 + n_fft//2) triangular mel filterbank."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fft_f = np.asarray(fft_frequencies(sr, n_fft)._data)
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk)._data)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    weights = np.zeros((n_mels, len(fft_f)), np.float32)
+    for i in range(n_mels):
+        lower = -ramps[i] / max(fdiff[i], 1e-10)
+        upper = ramps[i + 2] / max(fdiff[i + 1], 1e-10)
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights))
+
+
+def power_to_db(spect: Tensor, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0) -> Tensor:
+    from ..core.tensor import apply
+
+    def f(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    # through apply() so the tape records it — LogMelSpectrogram/MFCC must
+    # stay differentiable end-to-end (learnable-frontend training)
+    return apply("power_to_db", f, ensure_tensor(spect))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """(n_mels, n_mfcc) DCT-II basis (reference layout)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, jnp.float32))
+
+
+_WINDOWS = {}
+
+
+def _register_window(name):
+    def deco(fn):
+        _WINDOWS[name] = fn
+        return fn
+    return deco
+
+
+@_register_window("hann")
+def _hann(n, fftbins=True):
+    m = n if fftbins else n - 1
+    return 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n) / max(m, 1))
+
+
+@_register_window("hamming")
+def _hamming(n, fftbins=True):
+    m = n if fftbins else n - 1
+    return 0.54 - 0.46 * np.cos(2 * np.pi * np.arange(n) / max(m, 1))
+
+
+@_register_window("blackman")
+def _blackman(n, fftbins=True):
+    m = n if fftbins else n - 1
+    t = 2 * np.pi * np.arange(n) / max(m, 1)
+    return 0.42 - 0.5 * np.cos(t) + 0.08 * np.cos(2 * t)
+
+
+@_register_window("rectangular")
+def _rect(n, fftbins=True):
+    return np.ones(n)
+
+
+@_register_window("bohman")
+def _bohman(n, fftbins=True):
+    m = n if fftbins else n - 1
+    x = np.abs(np.linspace(-1, 1, max(m, 1) + 1))[:n]
+    return (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True) -> Tensor:
+    name = window[0] if isinstance(window, tuple) else window
+    if name not in _WINDOWS:
+        raise ValueError(f"unsupported window {window!r}; "
+                         f"one of {sorted(_WINDOWS)}")
+    return Tensor(jnp.asarray(_WINDOWS[name](win_length, fftbins),
+                              jnp.float32))

@@ -53,7 +53,14 @@ def init(role_maker=None, is_collective: bool = True,
     allreduce path"); WORKER processes form the collective training world.
     """
     global _fleet_initialized, _strategy, _role_maker
-    if role_maker is not None and not is_collective:
+    # PS mode when the role maker carries PS structure (server role or server
+    # endpoints) OR the caller said not collective — upstream defaults
+    # is_collective=False, so a ported `fleet.init(PaddleCloudRoleMaker())`
+    # with PS env vars must land here even with our collective-first default
+    ps_mode = role_maker is not None and (
+        not is_collective or role_maker.is_server()
+        or role_maker.server_num() > 0)
+    if ps_mode:
         _role_maker = role_maker
         _strategy = strategy or DistributedStrategy()
         _fleet_initialized = True

@@ -40,12 +40,24 @@ def _parse():
 def _launch_ps(args) -> int:
     """PS-mode controller (parity: launch/controllers/ps.py): spawn server
     processes (TRAINING_ROLE=PSERVER) and trainer processes on localhost."""
+    import socket
+
+    def _free_ports(n: int):
+        socks, ports = [], []
+        for _ in range(n):  # hold all sockets until every port is picked so
+            s = socket.socket()  # the OS can't hand the same one out twice
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+        for s in socks:
+            s.close()
+        return ports
+
     os.makedirs(args.log_dir, exist_ok=True)
-    base = 38000
     servers = [e for e in args.servers.split(",") if e] or [
-        f"127.0.0.1:{base + i}" for i in range(args.server_num or 1)]
+        f"127.0.0.1:{p}" for p in _free_ports(args.server_num or 1)]
     trainers = [e for e in args.trainers.split(",") if e] or [
-        f"127.0.0.1:{base + 100 + i}" for i in range(args.trainer_num or 1)]
+        f"127.0.0.1:{p}" for p in _free_ports(args.trainer_num or 1)]
     cmd = [sys.executable, args.script] + list(args.script_args)
     common = {
         "PADDLE_PSERVERS_IP_PORT_LIST": ",".join(servers),

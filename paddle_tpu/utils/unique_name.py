@@ -1,0 +1,47 @@
+"""``paddle.utils.unique_name`` (reference:
+python/paddle/base/unique_name.py): process-wide name generator with
+guard-scoped prefixes."""
+
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+from typing import Dict
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class _Generator:
+    def __init__(self):
+        self.ids: Dict[str, int] = defaultdict(int)
+        self.prefix = ""
+
+    def gen(self, key: str) -> str:
+        i = self.ids[key]
+        self.ids[key] += 1
+        return f"{self.prefix}{key}_{i}"
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    return _generator.gen(key)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None else _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_prefix: str = ""):
+    """Fresh name scope (reference semantics: names restart inside)."""
+    old = switch()
+    _generator.prefix = new_prefix or ""
+    try:
+        yield
+    finally:
+        switch(old)

@@ -40,6 +40,15 @@ class _InvertedResidual(nn.Layer):
         return x + out if self.use_res else out
 
 
+
+
+def _scale_c(c, scale):
+    """Width-multiplier channel rounding (reference _make_divisible)."""
+    v = max(8, int(c * scale + 4) // 8 * 8)
+    if v < 0.9 * c * scale:
+        v += 8
+    return v
+
 class MobileNetV3Small(nn.Layer):
     CFG = [
         # k, exp, out, se, act, stride
@@ -58,22 +67,78 @@ class MobileNetV3Small(nn.Layer):
 
     def __init__(self, num_classes=1000, scale=1.0):
         super().__init__()
+        sc = lambda c: _scale_c(c, scale)
+        stem_c = sc(16)
         self.stem = nn.Sequential(
-            nn.Conv2D(3, 16, 3, stride=2, padding=1, bias_attr=False),
-            nn.BatchNorm2D(16), nn.Hardswish())
+            nn.Conv2D(3, stem_c, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(stem_c), nn.Hardswish())
         blocks = []
-        in_c = 16
+        in_c = stem_c
         for k, exp, out, se, act, s in self.CFG:
-            blocks.append(_InvertedResidual(in_c, exp, out, k, s, se, act))
-            in_c = out
+            blocks.append(_InvertedResidual(in_c, sc(exp), sc(out), k, s, se,
+                                            act))
+            in_c = sc(out)
         self.blocks = nn.Sequential(*blocks)
+        head_c = sc(576)
         self.head_conv = nn.Sequential(
-            nn.Conv2D(in_c, 576, 1, bias_attr=False), nn.BatchNorm2D(576),
-            nn.Hardswish())
+            nn.Conv2D(in_c, head_c, 1, bias_attr=False),
+            nn.BatchNorm2D(head_c), nn.Hardswish())
         self.pool = nn.AdaptiveAvgPool2D(1)
         self.classifier = nn.Sequential(
-            nn.Linear(576, 1024), nn.Hardswish(), nn.Dropout(0.2),
+            nn.Linear(head_c, 1024), nn.Hardswish(), nn.Dropout(0.2),
             nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.head_conv(self.blocks(self.stem(x)))
+        x = self.pool(x)
+        from ...ops.manipulation import flatten
+        return self.classifier(flatten(x, 1))
+
+
+class MobileNetV3Large(nn.Layer):
+    """Parity: python/paddle/vision/models/mobilenetv3.py (large config)."""
+
+    CFG = [
+        # k, exp, out, se, act, stride
+        (3, 16, 16, False, nn.ReLU, 1),
+        (3, 64, 24, False, nn.ReLU, 2),
+        (3, 72, 24, False, nn.ReLU, 1),
+        (5, 72, 40, True, nn.ReLU, 2),
+        (5, 120, 40, True, nn.ReLU, 1),
+        (5, 120, 40, True, nn.ReLU, 1),
+        (3, 240, 80, False, nn.Hardswish, 2),
+        (3, 200, 80, False, nn.Hardswish, 1),
+        (3, 184, 80, False, nn.Hardswish, 1),
+        (3, 184, 80, False, nn.Hardswish, 1),
+        (3, 480, 112, True, nn.Hardswish, 1),
+        (3, 672, 112, True, nn.Hardswish, 1),
+        (5, 672, 160, True, nn.Hardswish, 2),
+        (5, 960, 160, True, nn.Hardswish, 1),
+        (5, 960, 160, True, nn.Hardswish, 1),
+    ]
+
+    def __init__(self, num_classes=1000, scale=1.0):
+        super().__init__()
+        sc = lambda c: _scale_c(c, scale)
+        stem_c = sc(16)
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, stem_c, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(stem_c), nn.Hardswish())
+        blocks = []
+        in_c = stem_c
+        for k, exp, out, se, act, s in self.CFG:
+            blocks.append(_InvertedResidual(in_c, sc(exp), sc(out), k, s, se,
+                                            act))
+            in_c = sc(out)
+        self.blocks = nn.Sequential(*blocks)
+        head_c = sc(960)
+        self.head_conv = nn.Sequential(
+            nn.Conv2D(in_c, head_c, 1, bias_attr=False),
+            nn.BatchNorm2D(head_c), nn.Hardswish())
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(
+            nn.Linear(head_c, 1280), nn.Hardswish(), nn.Dropout(0.2),
+            nn.Linear(1280, num_classes))
 
     def forward(self, x):
         x = self.head_conv(self.blocks(self.stem(x)))

@@ -1,0 +1,116 @@
+"""ShuffleNetV2 (parity: python/paddle/vision/models/shufflenetv2.py)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, reshape, transpose
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+
+def _channel_shuffle(x, groups: int):
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _conv_bn_act(in_c, out_c, k, stride=1, padding=0, groups=1, act=True):
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn_act(branch_c, branch_c, 1),
+                _conv_bn_act(branch_c, branch_c, 3, stride=1, padding=1,
+                             groups=branch_c, act=False),
+                _conv_bn_act(branch_c, branch_c, 1))
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn_act(in_c, in_c, 3, stride=stride, padding=1,
+                             groups=in_c, act=False),
+                _conv_bn_act(in_c, branch_c, 1))
+            self.branch2 = nn.Sequential(
+                _conv_bn_act(in_c, branch_c, 1),
+                _conv_bn_act(branch_c, branch_c, 3, stride=stride, padding=1,
+                             groups=branch_c, act=False),
+                _conv_bn_act(branch_c, branch_c, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    _STAGE_OUT = {
+        0.25: (24, 24, 48, 96, 512), 0.5: (24, 48, 96, 192, 1024),
+        1.0: (24, 116, 232, 464, 1024), 1.5: (24, 176, 352, 704, 1024),
+        2.0: (24, 244, 488, 976, 2048)}
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True, act=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stem_c, c2, c3, c4, last_c = self._STAGE_OUT[scale]
+        self.conv1 = _conv_bn_act(3, stem_c, 3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = stem_c
+        for out_c, repeats in ((c2, 4), (c3, 8), (c4, 4)):
+            units = [_ShuffleUnit(in_c, out_c, 2)]
+            units += [_ShuffleUnit(out_c, out_c, 1) for _ in range(repeats - 1)]
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn_act(in_c, last_c, 1)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(last_c, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)

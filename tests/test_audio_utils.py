@@ -1,0 +1,144 @@
+"""paddle.audio / paddle.utils / paddle.hub / paddle.flops tests.
+
+Audio numerics mirror the reference's test strategy (test/legacy_test/
+test_audio_functions.py compares against librosa): here the references are
+scipy-free numpy reimplementations of the same formulas.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+class TestAudioFunctional:
+    def test_hz_mel_roundtrip(self):
+        for htk in (False, True):
+            for f in (60.0, 440.0, 4000.0):
+                m = audio.functional.hz_to_mel(f, htk)
+                back = audio.functional.mel_to_hz(m, htk)
+                assert abs(back - f) / f < 1e-4
+
+    def test_fbank_matrix_rows_nonneg_and_cover(self):
+        fb = np.asarray(audio.functional.compute_fbank_matrix(
+            sr=16000, n_fft=512, n_mels=40)._data)
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()  # every filter hits some bin
+
+    def test_window_against_formula(self):
+        w = np.asarray(audio.functional.get_window("hann", 16)._data)
+        ref = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(16) / 16)
+        np.testing.assert_allclose(w, ref, rtol=1e-6)
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = np.asarray(audio.functional.power_to_db(x, top_db=None)._data)
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+
+class TestAudioFeatures:
+    def _sine(self, sr=8000, dur=0.5, freq=440.0):
+        t = np.arange(int(sr * dur)) / sr
+        return np.sin(2 * np.pi * freq * t).astype(np.float32)
+
+    def test_spectrogram_peak_at_tone(self):
+        sr, freq, n_fft = 8000, 1000.0, 256
+        layer = audio.Spectrogram(n_fft=n_fft, hop_length=128)
+        x = paddle.to_tensor(self._sine(sr=sr, freq=freq)[None])
+        spec = np.asarray(layer(x)._data)[0]  # (bins, frames)
+        peak_bin = spec.mean(axis=1).argmax()
+        expect = round(freq * n_fft / sr)
+        assert abs(int(peak_bin) - expect) <= 1
+
+    def test_spectrogram_matches_numpy_stft(self):
+        n_fft, hop = 64, 32
+        x = np.random.default_rng(0).normal(size=(1, 400)).astype(np.float32)
+        layer = audio.Spectrogram(n_fft=n_fft, hop_length=hop, power=2.0,
+                                  center=False, window="hann")
+        got = np.asarray(layer(paddle.to_tensor(x))._data)[0]
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)
+        frames = [x[0, i:i + n_fft] * w
+                  for i in range(0, 400 - n_fft + 1, hop)]
+        ref = np.abs(np.fft.rfft(np.stack(frames), axis=-1)) ** 2
+        np.testing.assert_allclose(got, ref.T, rtol=1e-4, atol=1e-5)
+
+    def test_mel_and_mfcc_shapes(self):
+        x = paddle.to_tensor(self._sine()[None])
+        mel = audio.MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert list(mel.shape)[:2] == [1, 32]
+        logmel = audio.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(x)
+        assert list(logmel.shape) == list(mel.shape)
+        mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32)(x)
+        assert list(mfcc.shape)[:2] == [1, 13]
+
+
+class TestUtils:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+        with unique_name.guard():
+            a = unique_name.generate("fc")
+            b = unique_name.generate("fc")
+        assert a == "fc_0" and b == "fc_1"
+        with unique_name.guard():
+            assert unique_name.generate("fc") == "fc_0"  # scope reset
+
+    def test_deprecated_warns(self):
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(update_to="new_api", since="2.0")
+        def old_api():
+            return 42
+
+        with pytest.warns(DeprecationWarning, match="new_api"):
+            assert old_api() == 42
+
+    def test_try_import(self):
+        from paddle_tpu.utils import try_import
+        assert try_import("math") is math
+        with pytest.raises(ImportError):
+            try_import("definitely_not_a_module_xyz")
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+    def test_download_gated(self):
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            paddle.utils.download("http://example.com/x")
+
+
+class TestHub:
+    def test_local_hub(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(width=4):\n"
+            "    '''A tiny model.'''\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(width, width)\n")
+        names = paddle.hub.list(str(tmp_path), source="local")
+        assert "tiny_model" in names
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+        m = paddle.hub.load(str(tmp_path), "tiny_model", width=8)
+        assert list(m.weight.shape) == [8, 8]
+
+    def test_remote_sources_gated(self):
+        with pytest.raises(RuntimeError, match="zero-egress"):
+            paddle.hub.load("user/repo", "m", source="github")
+
+
+class TestFlops:
+    def test_linear_flops_exact(self, capsys):
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                   paddle.nn.Linear(16, 4))
+        total = paddle.flops(net, [2, 8])
+        # (8+1)*16*2 + (16+1)*4*2
+        assert total == 2 * (9 * 16) + 2 * (17 * 4)
+
+    def test_conv_model_flops_positive(self, capsys):
+        net = paddle.vision.models.LeNet()
+        total = paddle.flops(net, [1, 1, 28, 28])
+        assert total > 100_000

@@ -1,0 +1,82 @@
+"""Vision model zoo smoke tests (reference: test/legacy_test/test_vision_
+models.py pattern — build each arch, forward a small batch, check the logits
+shape; plus one train step to catch broken autograd paths)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+BUILDERS = [
+    ("mobilenet_v1", lambda: models.mobilenet_v1(scale=0.25, num_classes=10)),
+    ("mobilenet_v2", lambda: models.mobilenet_v2(scale=0.25, num_classes=10)),
+    ("mobilenet_v3_small", lambda: models.mobilenet_v3_small(num_classes=10)),
+    ("mobilenet_v3_large", lambda: models.mobilenet_v3_large(num_classes=10)),
+    ("vgg11", lambda: models.vgg11(num_classes=10)),
+    ("vgg16_bn", lambda: models.vgg16(batch_norm=True, num_classes=10)),
+    ("alexnet", lambda: models.alexnet(num_classes=10)),
+    ("squeezenet1_0", lambda: models.squeezenet1_0(num_classes=10)),
+    ("squeezenet1_1", lambda: models.squeezenet1_1(num_classes=10)),
+    ("shufflenet_v2_x0_25", lambda: models.shufflenet_v2_x0_25(num_classes=10)),
+    ("densenet121", lambda: models.densenet121(num_classes=10)),
+    ("resnet18", lambda: models.resnet18(num_classes=10)),
+]
+
+
+@pytest.mark.parametrize("name,builder", BUILDERS,
+                         ids=[n for n, _ in BUILDERS])
+def test_model_forward_shape(name, builder):
+    paddle.seed(0)
+    model = builder()
+    model.eval()
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(2, 3, 64, 64)).astype(np.float32))
+    out = model(x)
+    assert list(out.shape) == [2, 10]
+
+
+def test_googlenet_train_aux_heads():
+    paddle.seed(0)
+    model = models.googlenet(num_classes=10)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(2, 3, 64, 64)).astype(np.float32))
+    model.train()
+    main, aux1, aux2 = model(x)
+    assert list(main.shape) == [2, 10]
+    assert list(aux1.shape) == [2, 10] and list(aux2.shape) == [2, 10]
+    model.eval()
+    out = model(x)
+    assert list(out.shape) == [2, 10]
+
+
+def test_train_step_grads_flow():
+    """Representative archs: every trainable param gets a finite grad (the
+    tape covers concat/shuffle/residual topologies) and a few steps keep the
+    loss finite. (Tiny-batch BatchNorm makes loss non-monotonic early, so
+    strict decrease is not asserted here — MNIST e2e covers learning.)"""
+    for builder in (lambda: models.mobilenet_v2(scale=0.25, num_classes=4),
+                    lambda: models.densenet121(num_classes=4),
+                    lambda: models.shufflenet_v2_x0_25(num_classes=4)):
+        paddle.seed(1)
+        model = builder()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        x = paddle.to_tensor(np.random.default_rng(1).normal(
+            size=(4, 3, 32, 32)).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        missing = [p.name for p in model.parameters()
+                   if p.trainable and p.grad is None]
+        assert not missing, (builder, missing[:5])
+        assert all(np.isfinite(np.asarray(p.grad._data)).all()
+                   for p in model.parameters() if p.grad is not None)
+        opt.step()
+        opt.clear_grad()
+        for _ in range(2):
+            loss = paddle.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.isfinite(float(loss))
