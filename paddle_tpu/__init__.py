@@ -82,6 +82,12 @@ from . import regularizer  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import version  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from .nn.layer import LazyGuard  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from .flops_counter import flops  # noqa: F401,E402

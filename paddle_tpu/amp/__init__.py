@@ -18,8 +18,10 @@ from ..core import dtype as _dtype
 from ..core.tensor import Tensor
 from ..core.tracing import AmpState, pop_amp_state, push_amp_state
 
+from . import debugging  # noqa: E402
+
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
-           "white_list", "black_list"]
+           "white_list", "black_list", "debugging"]
 
 # op lists mirroring the reference's amp lists (upstream:
 # paddle/fluid/eager/amp_auto_cast.h + python/paddle/amp/amp_lists.py)

@@ -1,0 +1,86 @@
+"""``paddle.amp.debugging`` (reference: python/paddle/amp/debugging.py —
+operator stats, nan/inf checks). Maps to the framework's check_nan_inf flag
+and tensor-level checks."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from .. import flags as _flags
+from ..core.tensor import Tensor
+
+__all__ = ["enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "check_numerics", "DebugMode"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+_op_stats: List[Tuple[str, str]] = []
+_collecting = False
+
+
+def _stats_hook(op_name, t0, t1):
+    if _collecting:
+        _op_stats.append((op_name, f"{(t1 - t0) * 1e3:.3f}ms"))
+
+
+def enable_operator_stats_collection() -> None:
+    global _collecting
+    from ..core import tensor as _core_tensor
+    _op_stats.clear()
+    _collecting = True
+    _core_tensor._op_profile_hook = _stats_hook
+
+
+def disable_operator_stats_collection() -> None:
+    global _collecting
+    from ..core import tensor as _core_tensor
+    _collecting = False
+    _core_tensor._op_profile_hook = None
+    if _op_stats:
+        print(f"<{'-' * 20} op list {'-' * 20}>")
+        for name, dt in _op_stats[-50:]:
+            print(f"  {name}: {dt}")
+        print(f"<{'-' * 49}>")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def enable_tensor_checker(checker_config=None) -> None:
+    _flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker() -> None:
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count nan/inf in a tensor; abort mode raises (reference semantics)."""
+    data = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.isnan(data).sum())
+    num_inf = int(jnp.isinf(data).sum())
+    if num_nan or num_inf:
+        msg = (f"[check_numerics] {op_type or 'tensor'} {var_name}: "
+               f"{num_nan} nan, {num_inf} inf")
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print(msg)
+    return Tensor(jnp.asarray([num_nan], jnp.int64)), \
+        Tensor(jnp.asarray([num_inf], jnp.int64))

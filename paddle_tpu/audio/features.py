@@ -76,7 +76,7 @@ class MelSpectrogram(Layer):
                                        power, center, pad_mode, dtype)
         self.fbank = AF.compute_fbank_matrix(
             sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
-            htk=htk, norm=norm if isinstance(norm, str) else "none")
+            htk=htk, norm=norm)
         self.n_mels = n_mels
 
     def forward(self, x):

@@ -73,8 +73,10 @@ def fft_frequencies(sr: int, n_fft: int):
 
 def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
                          f_min: float = 0.0, f_max: Optional[float] = None,
-                         htk: bool = False, norm: str = "slaney"):
-    """(n_mels, 1 + n_fft//2) triangular mel filterbank."""
+                         htk: bool = False, norm="slaney"):
+    """(n_mels, 1 + n_fft//2) triangular mel filterbank. ``norm``: "slaney"
+    (area normalization), a float p (per-filter Lp normalization — the
+    reference/librosa convention), or None."""
     if f_max is None:
         f_max = sr / 2.0
     fft_f = np.asarray(fft_frequencies(sr, n_fft)._data)
@@ -89,6 +91,11 @@ def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
     if norm == "slaney":
         enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
         weights *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        p = float(norm)
+        lp = np.maximum((np.abs(weights) ** p).sum(axis=1) ** (1.0 / p),
+                        1e-10)
+        weights /= lp[:, None]
     return Tensor(jnp.asarray(weights))
 
 

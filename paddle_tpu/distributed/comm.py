@@ -30,6 +30,7 @@ from .topology import ProcessGroup, global_mesh
 
 __all__ = [
     "ReduceOp", "all_reduce", "all_gather", "all_gather_object",
+    "scatter_object_list", "broadcast_object_list",
     "reduce_scatter", "alltoall", "alltoall_single", "broadcast", "reduce",
     "scatter", "barrier", "send", "recv", "ppermute_shift", "shard_stack",
     "unstack", "wait", "stream",
@@ -312,6 +313,32 @@ def all_gather_object(object_list: List, obj, group=None):
             np.frombuffer(__import__("pickle").dumps(obj), np.uint8))
         raise NotImplementedError("multi-host object gather: use broadcast")
     object_list.append(obj)
+    return object_list
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group=None):
+    """Process-level object scatter (parity:
+    paddle.distributed.scatter_object_list). Single-process SPMD: rank 0 is
+    the only process, so it keeps its own slot."""
+    import jax
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "multi-host object scatter: broadcast the full list and index by "
+            "rank (object collectives ride the coordination plane, not ICI)")
+    out_object_list.clear()
+    out_object_list.append(in_object_list[0] if in_object_list else None)
+    return out_object_list
+
+
+def broadcast_object_list(object_list: List, src: int = 0, group=None):
+    """Parity: paddle.distributed.broadcast_object_list (single-process:
+    identity; the src process's objects are already local)."""
+    import jax
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "multi-host object broadcast: serialize via the TCPStore "
+            "coordination plane")
     return object_list
 
 

@@ -1,0 +1,182 @@
+"""``paddle.geometric``: graph message passing + segment ops.
+
+Parity surface: python/paddle/geometric/ (send_u_recv, send_ue_recv,
+send_uv, segment_sum/mean/max/min, reindex_graph, sample_neighbors; upstream
+kernels paddle/phi/kernels/gpu/graph_send_recv_*).
+
+TPU-native design: message passing is segment-reduction — jax's
+``segment_sum``-family ops lower to XLA scatters with static output size
+(``out_size``/num_segments must be static, matching the reference's
+out_size argument).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import ensure_tensor, register_op
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "reindex_graph",
+           "sample_neighbors"]
+
+_REDUCES = {"sum", "mean", "max", "min"}
+
+
+def _segment_reduce(data, seg_ids, num, pool):
+    if pool == "sum":
+        return jax.ops.segment_sum(data, seg_ids, num)
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, seg_ids, num)
+        cnt = jax.ops.segment_sum(jnp.ones_like(seg_ids, data.dtype),
+                                  seg_ids, num)
+        return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (data.ndim - 1))
+    if pool == "max":
+        out = jax.ops.segment_max(data, seg_ids, num)
+        return jnp.where(jnp.isfinite(out), out, 0.0)  # empty segments -> 0
+    if pool == "min":
+        out = jax.ops.segment_min(data, seg_ids, num)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    raise ValueError(f"reduce_op must be one of {_REDUCES}, got {pool!r}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather x[src] → scatter-reduce at dst (reference: graph_send_recv)."""
+    x = ensure_tensor(x)
+    src = ensure_tensor(src_index)
+    dst = ensure_tensor(dst_index)
+    num = int(out_size) if out_size is not None else int(x.shape[0])
+
+    def f(xd, s, d):
+        return _segment_reduce(xd[s], d, num, reduce_op)
+
+    return apply("send_u_recv", f, x, src, dst)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Combine node features x[src] with edge features y, then reduce at dst."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    src = ensure_tensor(src_index)
+    dst = ensure_tensor(dst_index)
+    num = int(out_size) if out_size is not None else int(x.shape[0])
+
+    def f(xd, yd, s, d):
+        m = xd[s]
+        if message_op == "add":
+            m = m + yd
+        elif message_op == "sub":
+            m = m - yd
+        elif message_op == "mul":
+            m = m * yd
+        elif message_op == "div":
+            m = m / yd
+        else:
+            raise ValueError(f"message_op {message_op!r}")
+        return _segment_reduce(m, d, num, reduce_op)
+
+    return apply("send_ue_recv", f, x, y, src, dst)
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message from both endpoints (reference: graph_send_uv)."""
+    x = ensure_tensor(x)
+    y = ensure_tensor(y)
+    src = ensure_tensor(src_index)
+    dst = ensure_tensor(dst_index)
+
+    def f(xd, yd, s, d):
+        a, b = xd[s], yd[d]
+        if message_op == "add":
+            return a + b
+        if message_op == "sub":
+            return a - b
+        if message_op == "mul":
+            return a * b
+        if message_op == "div":
+            return a / b
+        raise ValueError(f"message_op {message_op!r}")
+
+    return apply("send_uv", f, x, y, src, dst)
+
+
+def _make_segment(pool):
+    def seg(data, segment_ids, name=None):
+        data = ensure_tensor(data)
+        seg_ids = ensure_tensor(segment_ids)
+        # static segment count: max id + 1 read host-side (reference
+        # semantics: ids must be sorted/valid; XLA needs the bound static)
+        num = int(jnp.max(seg_ids._data)) + 1 if seg_ids._data.size else 0
+
+        def f(d, s):
+            return _segment_reduce(d, s, num, pool)
+
+        return apply(f"segment_{pool}", f, data, seg_ids)
+
+    seg.__name__ = f"segment_{pool}"
+    return seg
+
+
+segment_sum = _make_segment("sum")
+segment_mean = _make_segment("mean")
+segment_max = _make_segment("max")
+segment_min = _make_segment("min")
+
+for _name, _fn in (("segment_sum", segment_sum), ("segment_mean", segment_mean),
+                   ("segment_max", segment_max), ("segment_min", segment_min)):
+    register_op(_name, _fn)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference: phi reindex kernel).
+    Host-side (graph sampling is a data-pipeline step, not a device op)."""
+    import numpy as np
+    xs = np.asarray(ensure_tensor(x)._data)
+    nb = np.asarray(ensure_tensor(neighbors)._data)
+    # paddle orders: x's ids first keep their order, then new neighbor ids
+    order = {int(v): i for i, v in enumerate(xs)}
+    nxt = len(order)
+    for v in nb:
+        if int(v) not in order:
+            order[int(v)] = nxt
+            nxt += 1
+    remap = np.vectorize(order.__getitem__)
+    reindex_src = remap(nb).astype(np.int64)
+    counts = np.asarray(ensure_tensor(count)._data)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), counts)
+    out_nodes = np.array(sorted(order, key=order.__getitem__), dtype=np.int64)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling over CSC (reference: graph_sample_neighbors).
+    Host-side numpy (data pipeline); deterministic via the global seed."""
+    import numpy as np
+
+    from ..core.random import default_generator
+    rowd = np.asarray(ensure_tensor(row)._data)
+    ptr = np.asarray(ensure_tensor(colptr)._data)
+    nodes = np.asarray(ensure_tensor(input_nodes)._data)
+    rng = np.random.default_rng(int(jax.random.randint(
+        default_generator.split_key(), (), 0, 2 ** 31 - 1)))
+    out_nb, out_cnt = [], []
+    for n in nodes:
+        lo, hi = int(ptr[n]), int(ptr[n + 1])
+        nbrs = rowd[lo:hi]
+        if 0 < sample_size < len(nbrs):
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+    nb = np.concatenate(out_nb) if out_nb else np.zeros((0,), rowd.dtype)
+    return (Tensor(jnp.asarray(nb)),
+            Tensor(jnp.asarray(np.asarray(out_cnt, np.int32))))

@@ -1,6 +1,9 @@
 """``paddle.incubate`` capability surface (subset that the zoos use)."""
 
 from . import moe  # noqa: F401
+from . import nn  # noqa: F401
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+from ..geometric import segment_sum, segment_mean, segment_max, segment_min  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 
 

@@ -1,0 +1,113 @@
+"""``paddle.incubate.nn`` fused transformer layers.
+
+Parity surface: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention, FusedFeedForward, FusedLinear — upstream backed by
+the fused_attention/fused_feedforward CUDA kernels in
+paddle/phi/kernels/fusion/).
+
+TPU-native design: "fused" is what XLA does to the plain composition inside
+one jit — these layers express the same single-op API surface but lower to
+SDPA (flash path for long sequences) + fused matmul epilogues; there is no
+separate kernel to call.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedLinear"]
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate: float = 0.5,
+                 attn_dropout_rate: float = 0.5, kdim=None, vdim=None,
+                 normalize_before: bool = False, need_weights: bool = False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon: float = 1e-5,
+                 nranks: int = 1, ring_id: int = -1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv = nn.Linear(embed_dim, 3 * embed_dim,
+                             weight_attr=qkv_weight_attr,
+                             bias_attr=qkv_bias_attr)
+        self.out_proj = nn.Linear(embed_dim, embed_dim,
+                                  weight_attr=linear_weight_attr,
+                                  bias_attr=linear_bias_attr)
+        self.pre_ln = nn.LayerNorm(embed_dim, epsilon=epsilon,
+                                   weight_attr=pre_ln_scale_attr,
+                                   bias_attr=pre_ln_bias_attr)
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon,
+                               weight_attr=ln_scale_attr,
+                               bias_attr=ln_bias_attr)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.pre_ln(x)
+        b, s, _ = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = (qkv[:, :, i] for i in range(3))  # (B, L, H, D)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        out = out.reshape([b, s, self.embed_dim])
+        out = self.dropout(self.out_proj(out))
+        out = residual + out
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate: float = 0.1,
+                 epsilon: float = 1e-5, activation: str = "relu",
+                 act_dropout_rate: Optional[float] = None,
+                 normalize_before: bool = False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks: int = 1, ring_id: int = -1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 weight_attr=linear1_weight_attr,
+                                 bias_attr=linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 weight_attr=linear2_weight_attr,
+                                 bias_attr=linear2_bias_attr)
+        self.ln = nn.LayerNorm(d_model, epsilon=epsilon,
+                               weight_attr=ln1_scale_attr,
+                               bias_attr=ln1_bias_attr)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+        self.activation = getattr(F, activation)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        x = self.act_dropout(self.activation(self.linear1(x)))
+        x = self.dropout(self.linear2(x))
+        x = residual + x
+        if not self.normalize_before:
+            x = self.ln(x)
+        return x
+
+
+class FusedLinear(nn.Linear):
+    """API parity: a Linear whose matmul+bias is one fused op (on TPU, XLA
+    already emits the fused epilogue — this subclass exists for imports)."""

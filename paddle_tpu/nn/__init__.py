@@ -3,6 +3,7 @@
 from .layer import Layer, ParamAttr  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
     Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D,

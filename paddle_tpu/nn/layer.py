@@ -20,7 +20,41 @@ from ..core import dtype as _dtype
 from ..core.tensor import Parameter, RemovableHandle, Tensor, register_state_tensor, to_tensor
 from .initializer import Constant, XavierUniform, _to_initializer
 
-__all__ = ["Layer", "ParamAttr"]
+__all__ = ["Layer", "ParamAttr", "LazyGuard"]
+
+# --- lazy init (parity: paddle.LazyGuard / python/paddle/nn/initializer/
+# lazy_init.py): parameters created inside the guard defer their initializer
+# (no device allocation at model construction); any Layer.__call__
+# materializes all pending params first.
+_lazy_mode = False
+_lazy_params: list = []
+
+
+def _lazy_guard_active() -> bool:
+    return _lazy_mode
+
+
+def _materialize_lazy_params() -> None:
+    pending, _lazy_params[:] = list(_lazy_params), []
+    for ref, init, shape, dtype in pending:
+        p = ref()  # weakref: a discarded lazy model must not be allocated
+        if p is not None and p._data is None:
+            p._set_data(init(shape, dtype))
+
+
+class LazyGuard:
+    """``with LazyGuard(): model = Net()`` — construct without allocating."""
+
+    def __enter__(self):
+        global _lazy_mode
+        self._prev = _lazy_mode
+        _lazy_mode = True
+        return self
+
+    def __exit__(self, *exc):
+        global _lazy_mode
+        _lazy_mode = self._prev
+        return False
 
 
 class ParamAttr:
@@ -120,6 +154,17 @@ class Layer:
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         init = _to_initializer(init)
+        if _lazy_guard_active():
+            # LazyGuard: defer running the initializer (no device allocation
+            # at construction); materialized at first Layer.__call__
+            p = Parameter(None, name=attr.name, trainable=attr.trainable)
+            import weakref
+            _lazy_params.append(
+                (weakref.ref(p), init, tuple(int(s) for s in shape), dtype))
+            p.optimize_attr["learning_rate"] = attr.learning_rate
+            p.regularizer = attr.regularizer
+            p.need_clip = attr.need_clip
+            return p
         data = init(tuple(int(s) for s in shape), dtype)
         p = Parameter(data, name=attr.name, trainable=attr.trainable)
         p.optimize_attr["learning_rate"] = attr.learning_rate
@@ -239,6 +284,8 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        if _lazy_params:
+            _materialize_lazy_params()
         for hook in self._forward_pre_hooks.values():
             result = hook(self, inputs)
             if result is not None:

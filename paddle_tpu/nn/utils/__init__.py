@@ -1,0 +1,12 @@
+"""``paddle.nn.utils`` (reference: python/paddle/nn/utils/ — weight_norm,
+spectral_norm hooks, clip_grad_*, parameters_to_vector)."""
+
+from .weight_norm_hook import remove_weight_norm, weight_norm  # noqa: F401
+from .spectral_norm_hook import spectral_norm  # noqa: F401
+from .clip_grad import clip_grad_norm_, clip_grad_value_  # noqa: F401
+from .transform_parameters import (parameters_to_vector,  # noqa: F401
+                                   vector_to_parameters)
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters"]

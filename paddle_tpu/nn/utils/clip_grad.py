@@ -1,0 +1,43 @@
+"""clip_grad_norm_ / clip_grad_value_ (reference:
+python/paddle/nn/utils/clip_grad_norm_.py, clip_grad_value_.py): in-place
+gradient clipping over a parameter list, returning the total norm."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_"]
+
+
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False) -> Tensor:
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad._data for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros((), jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.abs(g).max() for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of order {norm_type} is non-finite; gradients "
+            "contain inf/nan (set error_if_nonfinite=False to skip)")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._set_data((p.grad._data * scale).astype(p.grad._data.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value: float) -> None:
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._set_data(jnp.clip(p.grad._data, -clip_value, clip_value))

@@ -1,0 +1,71 @@
+"""spectral_norm (reference: python/paddle/nn/utils/spectral_norm_hook.py):
+weight / sigma_max(weight), sigma estimated by power iteration whose u/v
+vectors persist as buffers and update on every forward."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...core import random as _random
+from ..layer import Layer, Parameter
+
+__all__ = ["spectral_norm"]
+
+
+def _l2norm(x):
+    return x / jnp.maximum(jnp.linalg.norm(x), 1e-12)
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0) -> Layer:
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    wdata = w._data
+    if dim != 0:
+        perm = (dim,) + tuple(i for i in range(wdata.ndim) if i != dim)
+    else:
+        perm = None
+    wm = wdata.transpose(perm) if perm else wdata
+    h = wm.shape[0]
+    wflat = wm.reshape(h, -1)
+    key = _random.default_generator.split_key()
+    import jax
+    u0 = _l2norm(jax.random.normal(key, (h,), jnp.float32))
+    v0 = _l2norm(wflat.T @ u0)
+
+    v_param = Parameter(wdata, name=(w.name or name) + "_orig")
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", v_param)
+    layer.register_buffer(name + "_u", Tensor(u0, stop_gradient=True))
+    layer.register_buffer(name + "_v", Tensor(v0, stop_gradient=True))
+
+    def compute(lyr):
+        worig = getattr(lyr, name + "_orig")
+        u = getattr(lyr, name + "_u")
+        v = getattr(lyr, name + "_v")
+
+        def f(wd, ud, vd):
+            m = wd.transpose(perm) if perm else wd
+            flat = m.reshape(m.shape[0], -1)
+            uu, vv = ud, vd
+            for _ in range(n_power_iterations):
+                vv = _l2norm(flat.T @ uu)
+                uu = _l2norm(flat @ vv)
+            sigma = uu @ flat @ vv
+            return wd / jnp.maximum(sigma, eps), uu, vv
+
+        out, uu, vv = apply("spectral_norm", f, worig, u, v)
+        u._set_data(uu._data)
+        v._set_data(vv._data)
+        return out
+
+    setattr(layer, name, compute(layer))
+
+    def hook(lyr, inputs):
+        setattr(lyr, name, compute(lyr))
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer

@@ -1,0 +1,27 @@
+"""parameters_to_vector / vector_to_parameters (reference:
+python/paddle/nn/utils/transform_parameters.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["parameters_to_vector", "vector_to_parameters"]
+
+
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec: Tensor, parameters, name=None) -> None:
+    data = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = 1
+        for s in p._data.shape:
+            n *= int(s)
+        p._set_data(data[off:off + n].reshape(p._data.shape)
+                    .astype(p._data.dtype))
+        off += n

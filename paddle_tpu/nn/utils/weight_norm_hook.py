@@ -1,0 +1,66 @@
+"""weight_norm (reference: python/paddle/nn/utils/weight_norm_hook.py):
+reparameterize weight = g * v / ||v|| via a forward-pre hook, keeping g and v
+as the trainable parameters."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ..layer import Layer, Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except_dim(v, dim):
+    """dim=None: one Frobenius norm over everything (scalar-shaped); else
+    the norm over all axes except ``dim``."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v)).reshape((1,) * v.ndim)
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def _compute_weight(g, v, dim):
+    def f(g_, v_):
+        return g_ * v_ / jnp.maximum(_norm_except_dim(v_, dim), 1e-12)
+    return apply("weight_norm", f, g, v)
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    wdata = w._data
+    g0 = _norm_except_dim(wdata, dim)
+    g = Parameter(g0, name=(w.name or name) + "_g")
+    v = Parameter(wdata, name=(w.name or name) + "_v")
+    # replace the plain parameter with the two reparameterized ones
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    setattr(layer, name, _compute_weight(g, v, dim))
+
+    def hook(lyr, inputs):
+        setattr(lyr, name, _compute_weight(
+            getattr(lyr, name + "_g"), getattr(lyr, name + "_v"), dim))
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_state = (name, dim, handle)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None or state[0] != name:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    _, dim, handle = state
+    handle.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    w = _compute_weight(g, v, dim)
+    layer.add_parameter(name, Parameter(w._data, name=v.name[:-2] if v.name
+                                        else name))
+    del layer._weight_norm_state
+    return layer

@@ -1,0 +1,22 @@
+"""``paddle.onnx`` export façade (reference: python/paddle/onnx/export.py
+delegates to paddle2onnx). This build exports StableHLO instead — the
+TPU-native interchange format — and gates true ONNX on the optional
+paddle2onnx/onnx packages (not shipped in this environment)."""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise RuntimeError(
+            "ONNX export needs the 'onnx'/'paddle2onnx' packages, which are "
+            "not installed in this environment. Use paddle.jit.save for the "
+            "native deployment format (StableHLO-backed program + params).")
+    raise NotImplementedError(
+        "direct ONNX emission is not implemented; serialize via "
+        "paddle.jit.save and convert externally")

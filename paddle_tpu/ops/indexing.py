@@ -442,6 +442,46 @@ def histogram(input, bins=100, min=0, max=0, name=None):
 register_op("histogram", histogram, methods=("histogram",))
 
 
+def histogramdd(x, bins=10, ranges=None, density: bool = False, weights=None,
+                name=None):
+    """N-dimensional histogram (reference: paddle.histogramdd over the last
+    dim of an (N, D) sample matrix). Returns (hist, list-of-edges)."""
+    x = ensure_tensor(x)
+    w = ensure_tensor(weights) if weights is not None else None
+
+    def f(a, *maybe_w):
+        ww = maybe_w[0] if maybe_w else None
+        hist, edges = jnp.histogramdd(a, bins=bins, range=ranges,
+                                      density=density, weights=ww)
+        return (hist,) + tuple(edges)
+
+    args = (x, w) if w is not None else (x,)
+    out = apply("histogramdd", f, *args, differentiable=False)
+    return out[0], list(out[1:])
+
+
+register_op("histogramdd", histogramdd, methods=("histogramdd",))
+
+
+def vander(x, n=None, increasing: bool = False, name=None):
+    """Vandermonde matrix (reference: paddle.vander — output keeps the
+    input dtype, integer powers stay exact)."""
+    x = ensure_tensor(x)
+    cols = int(x._data.shape[0]) if n is None else int(n)
+
+    def f(a):
+        p = jnp.arange(cols, dtype=a.dtype)
+        out = a[:, None] ** p[None, :]
+        if not increasing:
+            out = out[:, ::-1]
+        return out
+
+    return apply("vander", f, x)
+
+
+register_op("vander", vander, methods=("vander",))
+
+
 # --- Tensor indexing ---------------------------------------------------------
 
 def _convert_index(item):

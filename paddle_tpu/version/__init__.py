@@ -1,0 +1,31 @@
+"""``paddle.version`` (reference: generated python/paddle/version/__init__.py)."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "unknown"
+with_pip_cuda_libraries = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+xpu_version = "False"
+tpu = "True"
+istaged = False
+
+
+def show() -> None:
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"commit: {commit}")
+    print("tpu: True (jax/XLA backend)")
+
+
+def cuda() -> str:
+    return "False"
+
+
+def cudnn() -> str:
+    return "False"

@@ -6,3 +6,4 @@ Parity: python/paddle/vision/ (models, transforms, datasets).
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+from . import ops  # noqa: F401

@@ -43,11 +43,9 @@ class _InvertedResidual(nn.Layer):
 
 
 def _scale_c(c, scale):
-    """Width-multiplier channel rounding (reference _make_divisible)."""
-    v = max(8, int(c * scale + 4) // 8 * 8)
-    if v < 0.9 * c * scale:
-        v += 8
-    return v
+    """Width-multiplier channel rounding (shared _make_divisible rule)."""
+    from .mobilenetv2 import _make_divisible
+    return _make_divisible(c * scale)
 
 class MobileNetV3Small(nn.Layer):
     CFG = [

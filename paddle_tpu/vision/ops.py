@@ -1,0 +1,334 @@
+"""``paddle.vision.ops`` (reference: python/paddle/vision/ops.py — roi_align,
+roi_pool, nms, box ops, DeformConv2D, PSRoIPool).
+
+TPU-native notes: ROI ops are static-shape gathers (bilinear sample grids
+computed per-box with fixed output resolution — XLA-friendly, no dynamic
+shapes); deformable conv samples the input at learned offsets via the same
+bilinear gather; NMS reuses the padded fixed-iteration kernel from
+ops/vision.py (the detection-op layer built for PP-YOLOE).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+from ..nn.layer import Layer
+from ..ops._helpers import ensure_tensor
+from ..ops.vision import bbox_iou, box_area, multiclass_nms, nms  # noqa: F401
+
+__all__ = ["roi_align", "roi_pool", "nms", "box_area", "bbox_iou",
+           "box_coder", "DeformConv2D", "deform_conv2d", "RoIAlign",
+           "RoIPool", "PSRoIPool", "psroi_pool"]
+
+
+def _bilinear_sample(feat, ys, xs):
+    """feat (C, H, W); ys/xs arbitrary same-shaped grids → (C, *grid)."""
+    h, w = feat.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy1 = ys - y0
+    wx1 = xs - x0
+    out = 0.0
+    for dy, wy in ((0, 1.0 - wy1), (1, wy1)):
+        for dx, wx in ((0, 1.0 - wx1), (1, wx1)):
+            yy = jnp.clip(y0 + dy, 0, h - 1).astype(jnp.int32)
+            xx = jnp.clip(x0 + dx, 0, w - 1).astype(jnp.int32)
+            # out-of-range taps contribute zero (exact torchvision/paddle
+            # boundary semantics)
+            valid = ((y0 + dy >= 0) & (y0 + dy <= h - 1) &
+                     (x0 + dx >= 0) & (x0 + dx <= w - 1))
+            tap = feat[:, yy, xx]
+            out = out + tap * (wy * wx * valid)[None]
+    return out
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True, name=None):
+    """ROI Align (reference: phi::RoiAlignKernel). ``x`` (N,C,H,W); ``boxes``
+    (R,4) x1y1x2y2 in input coords; ``boxes_num`` (N,) rois per image."""
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    boxes_num = ensure_tensor(boxes_num)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(feat, rois, rois_num):
+        n = feat.shape[0]
+        r = rois.shape[0]
+        # map each roi to its batch image: repeat image ids by rois_num
+        ends = jnp.cumsum(rois_num)
+        img_id = jnp.sum(jnp.arange(r)[:, None] >= ends[None, :], axis=1)
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        s = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid per roi: (ph*s, pw*s) points, averaged per bin
+        gy = (jnp.arange(ph * s) + 0.5) / s  # in bin units
+        gx = (jnp.arange(pw * s) + 0.5) / s
+
+        def one(roi_idx):
+            fy = y1[roi_idx] + gy * bin_h[roi_idx]      # (ph*s,)
+            fx = x1[roi_idx] + gx * bin_w[roi_idx]      # (pw*s,)
+            ys = jnp.broadcast_to(fy[:, None], (ph * s, pw * s))
+            xs = jnp.broadcast_to(fx[None, :], (ph * s, pw * s))
+            sampled = _bilinear_sample(feat[img_id[roi_idx]], ys, xs)
+            c = sampled.shape[0]
+            sampled = sampled.reshape(c, ph, s, pw, s)
+            return sampled.mean(axis=(2, 4))  # (C, ph, pw)
+
+        return jax.vmap(one)(jnp.arange(r))
+
+    return apply("roi_align", f, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+             name=None):
+    """ROI max-pool (reference: phi::RoiPoolKernel)."""
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    boxes_num = ensure_tensor(boxes_num)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def f(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        r = rois.shape[0]
+        ends = jnp.cumsum(rois_num)
+        img_id = jnp.sum(jnp.arange(r)[:, None] >= ends[None, :], axis=1)
+        x1 = jnp.round(rois[:, 0] * spatial_scale)
+        y1 = jnp.round(rois[:, 1] * spatial_scale)
+        x2 = jnp.round(rois[:, 2] * spatial_scale)
+        y2 = jnp.round(rois[:, 3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+
+        ys_all = jnp.arange(h, dtype=jnp.float32)
+        xs_all = jnp.arange(w, dtype=jnp.float32)
+
+        def one(roi_idx):
+            bin_h = rh[roi_idx] / ph
+            bin_w = rw[roi_idx] / pw
+            ys0 = y1[roi_idx] + jnp.arange(ph) * bin_h
+            xs0 = x1[roi_idx] + jnp.arange(pw) * bin_w
+            # membership mask per bin over the full H/W (static shapes)
+            ymask = ((ys_all[None, :] >= jnp.floor(ys0)[:, None]) &
+                     (ys_all[None, :] < jnp.ceil(ys0 + bin_h)[:, None]))
+            xmask = ((xs_all[None, :] >= jnp.floor(xs0)[:, None]) &
+                     (xs_all[None, :] < jnp.ceil(xs0 + bin_w)[:, None]))
+            m = (ymask[:, None, :, None] & xmask[None, :, None, :])
+            fimg = feat[img_id[roi_idx]]  # (C,H,W)
+            big = jnp.where(m[None], fimg[:, None, None, :, :], -jnp.inf)
+            return big.max(axis=(-1, -2))  # (C, ph, pw)
+
+        return jax.vmap(one)(jnp.arange(r))
+
+    return apply("roi_pool", f, x, boxes, boxes_num)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+               name=None):
+    """Position-sensitive ROI pooling (reference: phi::PsroiPoolKernel):
+    channel group (i,j) feeds output bin (i,j), average-pooled."""
+    x = ensure_tensor(x)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    c = int(x.shape[1])
+    if c % (ph * pw) != 0:
+        raise ValueError(f"channels {c} must be divisible by "
+                         f"output_size^2 {ph * pw}")
+    out_c = c // (ph * pw)
+    aligned = roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                        sampling_ratio=2, aligned=False)
+
+    def f(a):
+        r = a.shape[0]
+        # paddle channel layout: input channel (c*ph + i)*pw + j feeds output
+        # channel c at bin (i, j)
+        blocks = a.reshape(r, out_c, ph, pw, ph, pw)
+        ii = jnp.arange(ph)[:, None]
+        jj = jnp.arange(pw)[None, :]
+        return blocks[:, :, ii, jj, ii, jj]
+
+    return apply("psroi_pool", f, aligned)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size", box_normalized: bool = True,
+              axis: int = 0, name=None):
+    """Encode/decode boxes against priors (reference: phi::BoxCoderKernel)."""
+    prior = ensure_tensor(prior_box)
+    tb = ensure_tensor(target_box)
+    pbv = None if prior_box_var is None else ensure_tensor(prior_box_var)
+    norm = 0.0 if box_normalized else 1.0
+
+    def f(p, t, *maybe_var):
+        var = maybe_var[0] if maybe_var else jnp.ones_like(p)
+        pw = p[..., 2] - p[..., 0] + norm
+        ph_ = p[..., 3] - p[..., 1] + norm
+        pcx = p[..., 0] + pw * 0.5
+        pcy = p[..., 1] + ph_ * 0.5
+        if code_type == "encode_center_size":
+            tw = t[..., 2] - t[..., 0] + norm
+            th = t[..., 3] - t[..., 1] + norm
+            tcx = t[..., 0] + tw * 0.5
+            tcy = t[..., 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph_,
+                             jnp.log(tw / pw), jnp.log(th / ph_)], axis=-1)
+            return out / var
+        # decode
+        d = t * var
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph_ + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+    if pbv is not None:
+        return apply("box_coder", f, prior, tb, pbv)
+    return apply("box_coder", f, prior, tb)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: phi::DeformableConvKernel): bilinear
+    sampling at offset-shifted taps, then a dense matmul per output pixel."""
+    x = ensure_tensor(x)
+    offset = ensure_tensor(offset)
+    weight = ensure_tensor(weight)
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+
+    def f(inp, off, w, *rest):
+        msk = rest[0] if mask is not None else None
+        b = rest[-1] if bias is not None else None
+        n, cin, h, wid = inp.shape
+        inp_p = jnp.pad(inp, ((0, 0), (0, 0), (padding[0], padding[0]),
+                              (padding[1], padding[1])))
+        hp, wp = inp_p.shape[2], inp_p.shape[3]
+        out_h = (hp - (dilation[0] * (kh - 1) + 1)) // stride[0] + 1
+        out_w = (wp - (dilation[1] * (kw - 1) + 1)) // stride[1] + 1
+        # base sampling positions (out_h, out_w, kh, kw)
+        oy = jnp.arange(out_h) * stride[0]
+        ox = jnp.arange(out_w) * stride[1]
+        ky = jnp.arange(kh) * dilation[0]
+        kx = jnp.arange(kw) * dilation[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        off = off.reshape(n, deformable_groups, kh * kw, 2, out_h, out_w)
+        cg = cin // deformable_groups
+
+        def per_image(img, o, m):
+            cols = []
+            for g in range(deformable_groups):
+                dy = o[g, :, 0].transpose(1, 2, 0).reshape(out_h, out_w, kh, kw)
+                dx = o[g, :, 1].transpose(1, 2, 0).reshape(out_h, out_w, kh, kw)
+                ys = base_y + dy
+                xs = base_x + dx
+                sub = img[g * cg:(g + 1) * cg]
+                sampled = _bilinear_sample(sub, ys, xs)  # (cg,oh,ow,kh,kw)
+                if m is not None:
+                    mm = m[g].transpose(1, 2, 0).reshape(out_h, out_w, kh, kw)
+                    sampled = sampled * mm[None]
+                cols.append(sampled)
+            return jnp.concatenate(cols, axis=0)  # (cin,oh,ow,kh,kw)
+
+        if msk is not None:
+            msk = msk.reshape(n, deformable_groups, kh * kw, out_h, out_w)
+            cols = jax.vmap(per_image)(inp_p, off, msk)
+        else:
+            cols = jax.vmap(lambda i, o: per_image(i, o, None))(inp_p, off)
+        # conv as tensordot: w (cout, cin/groups, kh, kw)
+        cout = w.shape[0]
+        if groups == 1:
+            out = jnp.einsum("nchwyx,ocyx->nohw", cols, w)
+        else:
+            cpg_in = cin // groups
+            cpg_out = cout // groups
+            outs = []
+            for g in range(groups):
+                outs.append(jnp.einsum(
+                    "nchwyx,ocyx->nohw",
+                    cols[:, g * cpg_in:(g + 1) * cpg_in],
+                    w[g * cpg_out:(g + 1) * cpg_out]))
+            out = jnp.concatenate(outs, axis=1)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(ensure_tensor(mask))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return apply("deform_conv2d", f, *args)
+
+
+class DeformConv2D(Layer):
+    """paddle.vision.ops.DeformConv2D parity (v2 when a mask is passed)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups, groups=groups)
+        from ..nn.initializer import XavierUniform
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *k), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, mask=mask,
+                             **self._cfg)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._size = output_size
+        self._scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._size, self._scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._size = output_size
+        self._scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._size, self._scale)
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._size = output_size
+        self._scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._size, self._scale)

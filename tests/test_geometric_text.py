@@ -1,0 +1,167 @@
+"""paddle.geometric / paddle.text / incubate.nn tests (reference:
+test/legacy_test/test_graph_send_recv_op.py numpy refs, test_viterbi_decode,
+fused-transformer equivalence vs the unfused composition)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import geometric
+
+
+class TestMessagePassing:
+    def setup_method(self, _):
+        # graph: 0->1, 0->2, 1->2, 2->0
+        self.src = paddle.to_tensor(np.array([0, 0, 1, 2], np.int64))
+        self.dst = paddle.to_tensor(np.array([1, 2, 2, 0], np.int64))
+        self.x = paddle.to_tensor(np.array(
+            [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32))
+
+    def test_send_u_recv_sum(self):
+        out = geometric.send_u_recv(self.x, self.src, self.dst, "sum")
+        ref = np.array([[5, 6], [1, 2], [4, 6]], np.float32)
+        np.testing.assert_allclose(np.asarray(out._data), ref)
+
+    def test_send_u_recv_mean_max(self):
+        out = geometric.send_u_recv(self.x, self.src, self.dst, "mean")
+        ref = np.array([[5, 6], [1, 2], [2, 3]], np.float32)
+        np.testing.assert_allclose(np.asarray(out._data), ref)
+        out = geometric.send_u_recv(self.x, self.src, self.dst, "max")
+        ref = np.array([[5, 6], [1, 2], [3, 4]], np.float32)
+        np.testing.assert_allclose(np.asarray(out._data), ref)
+
+    def test_send_u_recv_grad(self):
+        x = paddle.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+        out = geometric.send_u_recv(x, self.src, self.dst, "sum")
+        out.sum().backward()
+        # node 0 sent twice, nodes 1/2 once each
+        np.testing.assert_allclose(np.asarray(x.grad._data),
+                                   [[2, 2], [1, 1], [1, 1]])
+
+    def test_send_ue_recv(self):
+        e = paddle.to_tensor(np.full((4, 2), 10.0, np.float32))
+        out = geometric.send_ue_recv(self.x, e, self.src, self.dst,
+                                     "add", "sum")
+        ref = np.array([[15, 16], [11, 12], [24, 26]], np.float32)
+        np.testing.assert_allclose(np.asarray(out._data), ref)
+
+    def test_send_uv(self):
+        out = geometric.send_uv(self.x, self.x, self.src, self.dst, "mul")
+        ref = np.asarray(self.x._data)[np.array([0, 0, 1, 2])] * \
+            np.asarray(self.x._data)[np.array([1, 2, 2, 0])]
+        np.testing.assert_allclose(np.asarray(out._data), ref)
+
+    def test_segment_ops(self):
+        data = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+        ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+        np.testing.assert_allclose(
+            np.asarray(geometric.segment_sum(data, ids)._data),
+            [[2, 4], [10, 12]])
+        np.testing.assert_allclose(
+            np.asarray(geometric.segment_mean(data, ids)._data),
+            [[1, 2], [5, 6]])
+        np.testing.assert_allclose(
+            np.asarray(geometric.segment_min(data, ids)._data),
+            [[0, 1], [4, 5]])
+
+    def test_sample_and_reindex(self):
+        # CSC: node j's neighbors = row[colptr[j]:colptr[j+1]]
+        row = paddle.to_tensor(np.array([1, 2, 0, 0, 1], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 5], np.int64))
+        nodes = paddle.to_tensor(np.array([0, 2], np.int64))
+        nbrs, cnt = geometric.sample_neighbors(row, colptr, nodes)
+        assert np.asarray(cnt._data).tolist() == [2, 2]
+        src, dst, uniq = geometric.reindex_graph(nodes, nbrs, cnt)
+        assert np.asarray(uniq._data)[0] == 0 and np.asarray(uniq._data)[1] == 2
+        assert np.asarray(dst._data).tolist() == [0, 0, 1, 1]
+
+
+class TestText:
+    def test_datasets_shapes(self):
+        ds = paddle.text.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        h = paddle.text.UCIHousing(mode="test")
+        x, y = h[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        c = paddle.text.Conll05st(mode="test")
+        words, pred, mark, labels = c[0]
+        assert len(words) == len(labels)
+        m = paddle.text.Movielens(mode="test")
+        assert len(m[0]) == 7
+
+    def test_viterbi_decode_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        b, l, t = 2, 5, 3
+        emis = rng.normal(size=(b, l, t)).astype(np.float32)
+        trans = rng.normal(size=(t, t)).astype(np.float32)
+        scores, path = paddle.text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            include_bos_eos_tag=False)
+        # brute force over all t^l paths
+        import itertools
+        for bi in range(b):
+            best, best_path = -1e9, None
+            for p in itertools.product(range(t), repeat=l):
+                s = emis[bi, 0, p[0]]
+                for i in range(1, l):
+                    s += trans[p[i - 1], p[i]] + emis[bi, i, p[i]]
+                if s > best:
+                    best, best_path = s, p
+            assert abs(float(scores._data[bi]) - best) < 1e-3
+            assert np.asarray(path._data)[bi].tolist() == list(best_path)
+
+
+class TestFusedLayers:
+    def test_fused_mha_runs_and_trains(self):
+        paddle.seed(0)
+        layer = paddle.incubate.nn.FusedMultiHeadAttention(
+            32, 4, dropout_rate=0.0, attn_dropout_rate=0.0)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 6, 32)).astype(np.float32))
+        out = layer(x)
+        assert list(out.shape) == [2, 6, 32]
+        out.mean().backward()
+        assert layer.qkv.weight.grad is not None
+
+    def test_fused_ffn_matches_manual(self):
+        paddle.seed(0)
+        ffn = paddle.incubate.nn.FusedFeedForward(16, 32, dropout_rate=0.0,
+                                                  act_dropout_rate=0.0)
+        ffn.eval()
+        x = paddle.to_tensor(np.random.default_rng(1).normal(
+            size=(2, 4, 16)).astype(np.float32))
+        got = np.asarray(ffn(x)._data)
+        import paddle_tpu.nn.functional as F
+        manual = ffn.ln(x + ffn.linear2(F.relu(ffn.linear1(x))))
+        np.testing.assert_allclose(got, np.asarray(manual._data), atol=1e-5)
+
+    def test_fused_linear(self):
+        lin = paddle.incubate.nn.FusedLinear(4, 8)
+        out = lin(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert list(out.shape) == [2, 8]
+
+
+    def test_viterbi_bos_eos_and_lengths(self):
+        rng = np.random.default_rng(3)
+        b, l, t = 2, 4, 5  # tags 3=BOS, 4=EOS under the reference convention
+        emis = rng.normal(size=(b, l, t)).astype(np.float32)
+        trans = rng.normal(size=(t, t)).astype(np.float32)
+        lengths = np.array([2, 4], np.int64)
+        scores, path = paddle.text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=True)
+        import itertools
+        for bi, ln in enumerate(lengths):
+            best, best_path = -1e9, None
+            for p in itertools.product(range(t), repeat=int(ln)):
+                s = trans[t - 2, p[0]] + emis[bi, 0, p[0]]
+                for i in range(1, int(ln)):
+                    s += trans[p[i - 1], p[i]] + emis[bi, i, p[i]]
+                s += trans[p[-1], t - 1]
+                if s > best:
+                    best, best_path = s, p
+            assert abs(float(scores._data[bi]) - best) < 1e-3
+            got = np.asarray(path._data)[bi][:int(ln)].tolist()
+            assert got == list(best_path), (bi, got, best_path)

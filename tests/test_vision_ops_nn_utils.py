@@ -1,0 +1,198 @@
+"""vision.ops / nn.utils / signal / LazyGuard / small tensor ops tests
+(reference patterns: test/legacy_test/test_roi_align_op.py numpy refs,
+test_weight_norm_hook.py, test_signal.py vs scipy)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision import ops as vops
+
+
+class TestRoIOps:
+    def test_roi_align_identity_box(self):
+        # aligned=True half-pixel offset puts the per-bin sample exactly on
+        # each pixel center, so a full-image box reproduces the feature
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        out = vops.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([1], np.int32)),
+                             output_size=4, sampling_ratio=1, aligned=True)
+        got = np.asarray(out._data)[0, 0]
+        np.testing.assert_allclose(got, feat[0, 0], atol=1e-4)
+
+    def test_roi_align_batch_mapping(self):
+        feat = np.stack([np.zeros((1, 4, 4), np.float32),
+                         np.ones((1, 4, 4), np.float32)])
+        boxes = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+        out = vops.roi_align(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([1, 1], np.int32)),
+                             output_size=2)
+        got = np.asarray(out._data)
+        assert np.allclose(got[0], 0.0) and np.allclose(got[1], 1.0)
+
+    def test_roi_pool_max(self):
+        feat = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = vops.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                            paddle.to_tensor(np.array([1], np.int32)),
+                            output_size=2)
+        got = np.asarray(out._data)[0, 0]
+        np.testing.assert_allclose(got, [[5, 7], [13, 15]])
+
+    def test_psroi_pool_shapes(self):
+        feat = np.random.default_rng(0).normal(
+            size=(1, 2 * 2 * 3, 8, 8)).astype(np.float32)
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        out = vops.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                              paddle.to_tensor(np.array([1], np.int32)),
+                              output_size=2)
+        assert list(out.shape) == [1, 3, 2, 2]
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.default_rng(0)
+        priors = np.abs(rng.normal(size=(5, 4))).astype(np.float32)
+        priors[:, 2:] = priors[:, :2] + 1.0 + np.abs(priors[:, 2:])
+        targets = priors + 0.3
+        enc = vops.box_coder(paddle.to_tensor(priors), None,
+                             paddle.to_tensor(targets),
+                             code_type="encode_center_size")
+        dec = vops.box_coder(paddle.to_tensor(priors), None, enc,
+                             code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec._data), targets, atol=1e-4)
+
+    def test_deform_conv2d_zero_offset_matches_conv(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(1, 3, 8, 8)).astype(np.float32)
+        w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32) * 0.2
+        off = np.zeros((1, 2 * 9, 6, 6), np.float32)
+        got = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                 paddle.to_tensor(w))
+        ref = nn.functional.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.asarray(ref._data), atol=1e-4)
+
+    def test_deform_conv2d_layer_trains(self):
+        paddle.seed(0)
+        layer = vops.DeformConv2D(3, 4, 3, padding=1)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(2, 3, 8, 8)).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((2, 18, 8, 8), np.float32))
+        out = layer(x, off)
+        assert list(out.shape) == [2, 4, 8, 8]
+        out.mean().backward()
+        assert layer.weight.grad is not None
+
+
+class TestNNUtils:
+    def test_weight_norm_preserves_output_and_trains(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(3, 4)).astype(np.float32))
+        lin = nn.Linear(4, 5)
+        before = np.asarray(lin(x)._data)
+        nn.utils.weight_norm(lin)
+        after = np.asarray(lin(x)._data)
+        np.testing.assert_allclose(before, after, atol=1e-5)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight_g" in names and "weight_v" in names
+        loss = lin(x).mean()
+        loss.backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+        nn.utils.remove_weight_norm(lin)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight" in names and "weight_g" not in names
+        np.testing.assert_allclose(np.asarray(lin(x)._data), before, atol=1e-5)
+
+    def test_spectral_norm_bounds_sigma(self):
+        paddle.seed(0)
+        lin = nn.Linear(6, 6)
+        lin.weight._set_data(lin.weight._data * 10.0)
+        nn.utils.spectral_norm(lin, n_power_iterations=5)
+        x = paddle.to_tensor(np.eye(6, dtype=np.float32))
+        lin(x)  # power-iteration update
+        w_eff = np.asarray(lin.weight._data)
+        sigma = np.linalg.svd(w_eff, compute_uv=False)[0]
+        assert sigma < 1.5  # ~1 up to power-iteration error
+
+    def test_clip_grad_norm_(self):
+        p = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+        (p * paddle.to_tensor(np.full(4, 3.0, np.float32))).sum().backward()
+        total = nn.utils.clip_grad_norm_([p], max_norm=1.0)
+        assert abs(float(total) - 6.0) < 1e-4  # ||[3,3,3,3]||
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(p.grad._data)),
+                                   1.0, rtol=1e-4)
+
+    def test_parameters_vector_roundtrip(self):
+        paddle.seed(0)
+        lin = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(lin.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        nn.utils.vector_to_parameters(vec * 0 + 1.0, lin.parameters())
+        for p in lin.parameters():
+            assert np.allclose(np.asarray(p._data), 1.0)
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 512)).astype(np.float32)
+        win = paddle.audio.functional.get_window("hann", 128)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=128,
+                                  hop_length=32, window=win)
+        rec = paddle.signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                                  length=512)
+        np.testing.assert_allclose(np.asarray(rec._data), x, atol=1e-4)
+
+    def test_stft_tone_peak(self):
+        sr, f0, n_fft = 8000, 500, 256
+        t = np.arange(sr) / sr
+        x = np.sin(2 * np.pi * f0 * t).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=n_fft)
+        mag = np.abs(np.asarray(spec._data))
+        assert abs(int(mag.mean(axis=1).argmax()) - f0 * n_fft // sr) <= 1
+
+
+class TestMisc:
+    def test_lazy_guard_defers_then_materializes(self):
+        with paddle.LazyGuard():
+            model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+            assert all(p._data is None for p in model.parameters())
+        out = model(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert list(out.shape) == [2, 2]
+        assert all(p._data is not None for p in model.parameters())
+
+    def test_vander(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        v = np.asarray(paddle.vander(x, 3)._data)
+        np.testing.assert_allclose(v, np.vander(np.array([1.0, 2.0, 3.0]), 3))
+        vi = np.asarray(paddle.vander(x, 3, increasing=True)._data)
+        np.testing.assert_allclose(
+            vi, np.vander(np.array([1.0, 2.0, 3.0]), 3, increasing=True))
+
+    def test_histogramdd(self):
+        pts = paddle.to_tensor(np.random.default_rng(0).uniform(
+            0, 1, size=(100, 2)).astype(np.float32))
+        hist, edges = paddle.histogramdd(pts, bins=4,
+                                         ranges=[(0, 1), (0, 1)])
+        assert list(hist.shape) == [4, 4]
+        assert int(np.asarray(hist._data).sum()) == 100
+        assert len(edges) == 2
+
+    def test_check_numerics(self):
+        good = paddle.to_tensor(np.ones(3, np.float32))
+        n_nan, n_inf = paddle.amp.debugging.check_numerics(good)
+        assert int(n_nan._data[0]) == 0
+        bad = paddle.to_tensor(np.array([1.0, np.nan, np.inf], np.float32))
+        with pytest.raises(FloatingPointError):
+            paddle.amp.debugging.check_numerics(bad)
+
+    def test_scatter_object_list(self):
+        out = []
+        paddle.distributed.scatter_object_list(out, [{"a": 1}, {"b": 2}])
+        assert out == [{"a": 1}]
+
+    def test_version(self):
+        assert paddle.version.full_version == paddle.__version__
