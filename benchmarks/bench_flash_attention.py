@@ -1,0 +1,67 @@
+"""Flash-attention kernel benchmark: Pallas vs XLA softmax attention.
+
+Usage: python benchmarks/bench_flash_attention.py [--seqs 1024 2048 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", type=int, nargs="+",
+                    default=[1024, 2048, 4096, 8192])
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    results = []
+    for seq in args.seqs:
+        q = paddle.to_tensor(np.random.default_rng(0).normal(
+            size=(args.batch, seq, args.heads, args.head_dim))
+            .astype(np.float32) * 0.1)
+        q._set_data(q._data.astype(jnp.bfloat16))
+        entry = {"seq": seq}
+        for name, flag in (("pallas", "pallas"), ("xla", "xla")):
+            paddle.set_flags({"FLAGS_flash_impl": flag})
+
+            @paddle.jit.to_static
+            def fwd(q):
+                return F.flash_attention(q, q, q, causal=True)
+
+            try:
+                out = fwd(q)
+                np.asarray(out._data[0, 0, 0, 0])
+                t0 = time.perf_counter()
+                for _ in range(10):
+                    out = fwd(q)
+                np.asarray(out._data[0, 0, 0, 0])
+                dt = (time.perf_counter() - t0) / 10
+                flops = 4 * args.batch * args.heads * seq * seq * \
+                    args.head_dim / 2  # causal
+                entry[name + "_ms"] = round(dt * 1e3, 2)
+                entry[name + "_tflops"] = round(flops / dt / 1e12, 1)
+            except Exception as e:  # XLA OOM at long seq is expected
+                entry[name + "_ms"] = f"OOM/{type(e).__name__}"
+        results.append(entry)
+        print(json.dumps(entry))
+
+
+if __name__ == "__main__":
+    main()

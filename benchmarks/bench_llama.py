@@ -1,0 +1,90 @@
+"""Llama decoder train throughput across sizes/sequence lengths.
+
+Usage: python benchmarks/bench_llama.py [--hidden 1024] [--layers 8]
+       [--batch 16] [--seq 1024] [--scan-k 4] [--steps 20]
+Same metric as the repo-root bench.py (the benchmark of record), but
+parameterized for sweeps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=1024)
+    ap.add_argument("--inter", type=int, default=2816)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--scan-k", type=int, default=4)
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    peak = 197e12 if on_tpu else 1e12
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=args.hidden,
+                      intermediate_size=args.inter,
+                      num_hidden_layers=args.layers,
+                      num_attention_heads=args.heads,
+                      num_key_value_heads=args.heads,
+                      max_position_embeddings=max(2048, args.seq))
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 use_multi_tensor=True)
+    if on_tpu:
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+
+    @paddle.jit.to_static(iters_per_call=args.scan_k)
+    def train_step(ids):
+        with paddle.amp.auto_cast(enable=on_tpu, level="O2",
+                                  dtype="bfloat16"):
+            loss, _ = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (args.scan_k, args.batch, args.seq),
+        dtype=np.int32))
+    for _ in range(2):
+        loss = train_step(ids)
+    np.asarray(loss._data)
+    steps_run = (args.steps // args.scan_k) * args.scan_k
+    t0 = time.perf_counter()
+    for _ in range(steps_run // args.scan_k):
+        loss = train_step(ids)
+    np.asarray(loss._data)
+    dt = time.perf_counter() - t0
+    tok = args.batch * args.seq * steps_run / dt
+    mfu = tok * model.flops_per_token(args.seq) / peak
+    print(json.dumps({
+        "benchmark": "llama_train", "tokens_per_sec": round(tok, 1),
+        "mfu": round(mfu, 4), "params": model.num_params(),
+        "hidden": args.hidden, "layers": args.layers, "batch": args.batch,
+        "seq": args.seq, "scan_k": args.scan_k,
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

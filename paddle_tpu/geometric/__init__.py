@@ -35,12 +35,15 @@ def _segment_reduce(data, seg_ids, num, pool):
         cnt = jax.ops.segment_sum(jnp.ones_like(seg_ids, data.dtype),
                                   seg_ids, num)
         return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (data.ndim - 1))
-    if pool == "max":
-        out = jax.ops.segment_max(data, seg_ids, num)
-        return jnp.where(jnp.isfinite(out), out, 0.0)  # empty segments -> 0
-    if pool == "min":
-        out = jax.ops.segment_min(data, seg_ids, num)
-        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if pool in ("max", "min"):
+        out = (jax.ops.segment_max if pool == "max"
+               else jax.ops.segment_min)(data, seg_ids, num)
+        # empty segments -> 0 (reference semantics), detected via counts so
+        # integer dtypes keep their dtype and legitimate +/-inf survive
+        cnt = jax.ops.segment_sum(jnp.ones_like(seg_ids, jnp.int32),
+                                  seg_ids, num)
+        mask = (cnt > 0).reshape((-1,) + (1,) * (data.ndim - 1))
+        return jnp.where(mask, out, jnp.zeros((), out.dtype))
     raise ValueError(f"reduce_op must be one of {_REDUCES}, got {pool!r}")
 
 
@@ -147,8 +150,7 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
         if int(v) not in order:
             order[int(v)] = nxt
             nxt += 1
-    remap = np.vectorize(order.__getitem__)
-    reindex_src = remap(nb).astype(np.int64)
+    reindex_src = np.asarray([order[int(v)] for v in nb], np.int64)
     counts = np.asarray(ensure_tensor(count)._data)
     reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64), counts)
     out_nodes = np.array(sorted(order, key=order.__getitem__), dtype=np.int64)
@@ -169,14 +171,21 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
     nodes = np.asarray(ensure_tensor(input_nodes)._data)
     rng = np.random.default_rng(int(jax.random.randint(
         default_generator.split_key(), (), 0, 2 ** 31 - 1)))
-    out_nb, out_cnt = [], []
+    eids_np = None if eids is None else np.asarray(ensure_tensor(eids)._data)
+    out_nb, out_cnt, out_eid = [], [], []
     for n in nodes:
         lo, hi = int(ptr[n]), int(ptr[n + 1])
-        nbrs = rowd[lo:hi]
-        if 0 < sample_size < len(nbrs):
-            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
-        out_nb.append(nbrs)
-        out_cnt.append(len(nbrs))
+        pos = np.arange(lo, hi)
+        if 0 < sample_size < len(pos):
+            pos = rng.choice(pos, size=sample_size, replace=False)
+        out_nb.append(rowd[pos])
+        out_cnt.append(len(pos))
+        if return_eids:
+            out_eid.append(eids_np[pos] if eids_np is not None
+                           else pos.astype(np.int64))
     nb = np.concatenate(out_nb) if out_nb else np.zeros((0,), rowd.dtype)
-    return (Tensor(jnp.asarray(nb)),
-            Tensor(jnp.asarray(np.asarray(out_cnt, np.int32))))
+    cnt = Tensor(jnp.asarray(np.asarray(out_cnt, np.int32)))
+    if return_eids:
+        ei = np.concatenate(out_eid) if out_eid else np.zeros((0,), np.int64)
+        return Tensor(jnp.asarray(nb)), cnt, Tensor(jnp.asarray(ei))
+    return Tensor(jnp.asarray(nb)), cnt

@@ -32,7 +32,8 @@ class FusedMultiHeadAttention(nn.Layer):
                  nranks: int = 1, ring_id: int = -1, name=None):
         super().__init__()
         if embed_dim % num_heads:
-            raise ValueError("embed_dim must divide num_heads")
+            raise ValueError(
+                f"num_heads ({num_heads}) must divide embed_dim ({embed_dim})")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -54,6 +55,10 @@ class FusedMultiHeadAttention(nn.Layer):
         self.dropout = nn.Dropout(dropout_rate)
 
     def forward(self, x, attn_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention KV-cache decode is not implemented; "
+                "use models.llama's cached attention path for decoding")
         residual = x
         if self.normalize_before:
             x = self.pre_ln(x)

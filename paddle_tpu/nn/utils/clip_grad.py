@@ -15,6 +15,7 @@ def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
                     error_if_nonfinite: bool = False) -> Tensor:
     if isinstance(parameters, Tensor):
         parameters = [parameters]
+    parameters = list(parameters)  # may be a generator; we iterate twice
     grads = [p.grad._data for p in parameters if p.grad is not None]
     if not grads:
         return Tensor(jnp.zeros((), jnp.float32))
@@ -38,6 +39,6 @@ def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
 def clip_grad_value_(parameters, clip_value: float) -> None:
     if isinstance(parameters, Tensor):
         parameters = [parameters]
-    for p in parameters:
+    for p in list(parameters):
         if p.grad is not None:
             p.grad._set_data(jnp.clip(p.grad._data, -clip_value, clip_value))

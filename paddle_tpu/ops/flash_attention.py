@@ -89,9 +89,146 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
+def _flash_fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                          block_k: int, causal: bool, sm_scale: float,
+                          kv_len: int, q_len: int):
+    """Forward that also emits the per-row logsumexp (the flash residual the
+    dedicated backward kernels consume). Same math as _flash_fwd_kernel."""
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    bq = q.shape[0]
+    qi = pl.program_id(1)
+    q_offset = qi * bq
+    causal_shift = kv_len - q_len
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+    num_kb = kv_len // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_ids = q_offset + causal_shift + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_ids = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jnp.dot(p, v_blk,
+                                        preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        last_kb = jnp.clip(
+            (q_offset + bq + causal_shift + block_k - 1) // block_k, 0, num_kb)
+    else:
+        last_kb = num_kb
+    m, l, acc = jax.lax.fori_loop(0, last_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # fully-masked rows get lse=+big so exp(s - lse) -> 0 in the backward
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), 1e30)
+    lse_ref[0, 0] = lse[:, 0]
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         sm_scale: float, kv_len: int, q_len: int):
+    """dq for one (batch*head, q-block): stream K/V, recompute p from lse."""
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0].astype(jnp.float32)[:, None]
+    delta = delta_ref[0, 0].astype(jnp.float32)[:, None]
+    bq = q.shape[0]
+    qi = pl.program_id(1)
+    q_offset = qi * bq
+    causal_shift = kv_len - q_len
+    num_kb = kv_len // block_k
+
+    def body(kb, acc):
+        k_blk = k_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.dslice(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_ids = q_offset + causal_shift + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_ids = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        return acc + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    if causal:
+        last_kb = jnp.clip(
+            (q_offset + bq + causal_shift + block_k - 1) // block_k, 0, num_kb)
+    else:
+        last_kb = num_kb
+    acc = jax.lax.fori_loop(0, last_kb, body,
+                            jnp.zeros((bq, q.shape[1]), jnp.float32))
+    dq_ref[0] = acc.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          sm_scale: float, kv_len: int, q_len: int):
+    """dk/dv for one (batch*head, k-block): stream Q/dO blocks."""
+    k_blk = k_ref[0].astype(jnp.float32)  # (Bk, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+    bk = k_blk.shape[0]
+    ki = pl.program_id(1)
+    k_offset = ki * bk
+    causal_shift = kv_len - q_len
+    num_qb = q_len // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.dslice(qb * block_q, block_q)].astype(
+            jnp.float32)[:, None]
+        delta = delta_ref[0, 0, pl.dslice(qb * block_q, block_q)].astype(
+            jnp.float32)[:, None]
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_ids = qb * block_q + causal_shift + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_ids = k_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # (Bq, Bk)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # first q block whose rows can attend this k block
+        first_qb = jnp.clip((k_offset - causal_shift) // block_q, 0, num_qb)
+    else:
+        first_qb = 0
+    d = k_blk.shape[1]
+    dk, dv = jax.lax.fori_loop(
+        first_qb, num_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pallas_tileable(lq, lk, d, bq, bk):
+    return lq % min(bq, lq) == 0 and lk % min(bk, lk) == 0 and d % 8 == 0
+
+
 def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int,
-                  block_k: int, interpret: bool):
-    """q/k/v: (B, H, L, D) -> (B, H, L, D)."""
+                  block_k: int, interpret: bool, with_lse: bool = False):
+    """q/k/v: (B, H, L, D) -> (B, H, L, D) [, lse (B, H, L) fp32]."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
     block_q = min(block_q, lq)
@@ -101,23 +238,101 @@ def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int,
     kf = k.reshape(b * h, lk, d)
     vf = v.reshape(b * h, lk, d)
 
-    kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+    grid = (b * h, lq // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
+    ]
+    if not with_lse:
+        kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
+                                   causal=causal, sm_scale=sm_scale,
+                                   kv_len=lk, q_len=lq)
+        out = pl.pallas_call(
+            kernel, grid=grid, in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+            interpret=interpret,
+        )(qf, kf, vf)
+        return out.reshape(b, h, lq, d)
+    kernel = functools.partial(_flash_fwd_kernel_lse, block_k=block_k,
                                causal=causal, sm_scale=sm_scale, kv_len=lk,
                                q_len=lq)
-    grid = (b * h, lq // block_q)
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
+    out, lse = pl.pallas_call(
+        kernel, grid=grid, in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            # (BH, 1, Lq) keeps the trailing dims (1, block_q) TPU-tileable
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, lq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, lq, d), lse.reshape(b, h, lq)
+
+
+def _pallas_flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
+                      block_q: int, block_k: int, interpret: bool):
+    """Dedicated flash backward: dq then fused dk/dv, both streaming."""
+    b, h, lq, d = q.shape
+    lk = k.shape[2]
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    qf = q.reshape(b * h, lq, d)
+    kf = k.reshape(b * h, lk, d)
+    vf = v.reshape(b * h, lk, d)
+    dof = g.reshape(b * h, lq, d)
+    lsef = lse.reshape(b * h, 1, lq)
+    # delta = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1).reshape(b * h, 1, lq)
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                                  causal=causal, sm_scale=sm_scale,
+                                  kv_len=lk, q_len=lq)
+    dq = pl.pallas_call(
+        dq_kernel, grid=(b * h, lq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, lq, d)
+    )(qf, kf, vf, dof, lsef, delta)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                                   causal=causal, sm_scale=sm_scale,
+                                   kv_len=lk, q_len=lq)
+    dk, dv = pl.pallas_call(
+        dkv_kernel, grid=(b * h, lk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, lq, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, lq, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, lq), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, lq), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, lk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, dof, lsef, delta)
+    return (dq.reshape(b, h, lq, d), dk.reshape(b, h, lk, d),
+            dv.reshape(b, h, lk, d))
 
 
 def _xla_attention(q, k, v, causal: bool, sm_scale: float):
@@ -156,9 +371,26 @@ def _flash_dispatch(q, k, v, causal, sm_scale):
     return _pallas_flash(q, k, v, causal, sm_scale, bq, bk, interpret)
 
 
+def _bwd_kernel_eligible(q, k):
+    impl = _flags.flag("flash_impl")
+    on_tpu = jax.default_backend() not in ("cpu",)
+    lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
+    bq = int(_flags.flag("flash_block_q"))
+    bk = int(_flags.flag("flash_block_k"))
+    return (impl == "pallas" and _pallas_tileable(lq, lk, d, bq, bk)
+            and d % 8 == 0), (not on_tpu)
+
+
 def _flash_fwd(q, k, v, causal, sm_scale):
+    use_kernel, interpret = _bwd_kernel_eligible(q, k)
+    if use_kernel:
+        bq = int(_flags.flag("flash_block_q"))
+        bk = int(_flags.flag("flash_block_k"))
+        out, lse = _pallas_flash(q, k, v, causal, sm_scale, bq, bk,
+                                 interpret, with_lse=True)
+        return out, (q, k, v, out, lse)
     out = _flash_dispatch(q, k, v, causal, sm_scale)
-    return out, (q, k, v)
+    return out, (q, k, v, None, None)
 
 
 def _chunked_attention(q, k, v, causal: bool, sm_scale: float, block: int):
@@ -199,9 +431,17 @@ def _chunked_attention(q, k, v, causal: bool, sm_scale: float, block: int):
 
 
 def _flash_bwd(causal, sm_scale, res, g):
-    q, k, v = res
-    # flash-style rematerialized backward: AD through the blockwise form so
-    # the (Lq, Lk) matrix is never materialized (O(block x Lk) peak)
+    q, k, v, out, lse = res
+    if lse is not None:
+        # dedicated Pallas backward (dq streaming K/V; fused dk/dv streaming
+        # Q/dO) — recompute-from-lse, never materializes (Lq, Lk)
+        _, interpret = _bwd_kernel_eligible(q, k)
+        bq = int(_flags.flag("flash_block_q"))
+        bk = int(_flags.flag("flash_block_k"))
+        return _pallas_flash_bwd(q, k, v, out, lse, g, causal, sm_scale,
+                                 bq, bk, interpret)
+    # fallback: AD through the blockwise-remat form so the (Lq, Lk) matrix is
+    # never materialized (O(block x Lk) peak)
     block = int(_flags.flag("flash_block_q"))
     lq = q.shape[2]
     if lq % min(block, lq) == 0:

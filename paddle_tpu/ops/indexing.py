@@ -448,6 +448,13 @@ def histogramdd(x, bins=10, ranges=None, density: bool = False, weights=None,
     dim of an (N, D) sample matrix). Returns (hist, list-of-edges)."""
     x = ensure_tensor(x)
     w = ensure_tensor(weights) if weights is not None else None
+    d = int(x._data.shape[-1])
+    if ranges is not None and len(ranges) == 2 * d and not hasattr(
+            ranges[0], "__len__"):
+        # paddle passes a FLAT [lo0, hi0, lo1, hi1, ...] list; numpy/jax
+        # want per-dimension pairs
+        ranges = [(float(ranges[2 * i]), float(ranges[2 * i + 1]))
+                  for i in range(d)]
 
     def f(a, *maybe_w):
         ww = maybe_w[0] if maybe_w else None

@@ -324,9 +324,11 @@ register_op("normalize", normalize)
 # --- attention ---------------------------------------------------------------
 
 _flags.define_flag(
-    "sdpa_flash_min_seqlen", 1024,
+    "sdpa_flash_min_seqlen", 0,
     "scaled_dot_product_attention routes to the flash kernel above this "
-    "query length (0 = always flash)")
+    "query length (default 0 = always flash when mask/dropout-free: with the "
+    "dedicated Pallas backward the flash path beats stored-probs XLA "
+    "attention at every measured length — see benchmarks/RESULTS.md)")
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None):
@@ -337,12 +339,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """
     query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
     if (attn_mask is None and not (dropout_p > 0.0 and training)
-            and query._data.shape[1] > int(_flags.flag("sdpa_flash_min_seqlen"))):
-        # long sequences take the flash path (Pallas online-softmax kernel on
-        # TPU; blockwise-remat fallback elsewhere): O(L) instead of O(L^2)
-        # activation memory. Short sequences keep the fused XLA softmax
-        # attention — storing the probs for backward is cheaper there than
-        # flash's rematerialized attention FLOPs.
+            and jax.default_backend() not in ("cpu",)
+            and query._data.shape[1] >= int(_flags.flag("sdpa_flash_min_seqlen"))):
+        # (CPU keeps the fused XLA path — the Pallas kernel would run in
+        # interpret mode there; call F.flash_attention directly to force it)
+        # mask-free attention takes the flash path: Pallas online-softmax
+        # forward + dedicated dq/dkv backward kernels — O(L) activation
+        # memory and faster than stored-probs XLA attention at every
+        # measured length (flip FLAGS_sdpa_flash_min_seqlen to re-threshold)
         from .flash_attention import flash_attention
         return flash_attention(query, key, value, causal=is_causal,
                                training=training)

@@ -1,10 +1,10 @@
 """``paddle.text``: NLP datasets (reference: python/paddle/text/datasets/ —
 Imdb, Movielens, Conll05st, UCIHousing, WMT14/16).
 
-Zero-egress build: each dataset accepts ``data_file``/``root`` pointing at a
-local copy; without one, a deterministic synthetic sample set is generated so
-pipelines and tests run hermetically (the same pattern as
-paddle_tpu.vision.datasets).
+Zero-egress build: parsing real corpus files is not implemented — every
+dataset generates a deterministic synthetic sample set (label-correlated so
+models can learn), the same hermetic pattern as paddle_tpu.vision.datasets.
+Passing ``data_file`` warns loudly rather than silently substituting.
 """
 
 from __future__ import annotations
@@ -26,6 +26,12 @@ class Imdb(Dataset):
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
                  cutoff: int = 150):
         super().__init__()
+        if data_file is not None:
+            import warnings
+            warnings.warn(
+                f"{type(self).__name__}: parsing data_file is not "
+                "implemented in this build; a deterministic SYNTHETIC "
+                "dataset is used instead", stacklevel=2)
         self.mode = mode
         rng = np.random.default_rng(0 if mode == "train" else 1)
         n = 2000 if mode == "train" else 500
@@ -52,6 +58,12 @@ class UCIHousing(Dataset):
 
     def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
         super().__init__()
+        if data_file is not None:
+            import warnings
+            warnings.warn(
+                f"{type(self).__name__}: parsing data_file is not "
+                "implemented in this build; a deterministic SYNTHETIC "
+                "dataset is used instead", stacklevel=2)
         rng = np.random.default_rng(0 if mode == "train" else 1)
         n = 404 if mode == "train" else 102
         self.x = rng.normal(size=(n, 13)).astype(np.float32)
@@ -72,6 +84,12 @@ class Conll05st(Dataset):
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
                  **kwargs):
         super().__init__()
+        if data_file is not None:
+            import warnings
+            warnings.warn(
+                f"{type(self).__name__}: parsing data_file is not "
+                "implemented in this build; a deterministic SYNTHETIC "
+                "dataset is used instead", stacklevel=2)
         rng = np.random.default_rng(0 if mode == "train" else 1)
         n = 500 if mode == "train" else 100
         self.samples = []
@@ -96,6 +114,12 @@ class Movielens(Dataset):
     def __init__(self, data_file: Optional[str] = None, mode: str = "train",
                  **kwargs):
         super().__init__()
+        if data_file is not None:
+            import warnings
+            warnings.warn(
+                f"{type(self).__name__}: parsing data_file is not "
+                "implemented in this build; a deterministic SYNTHETIC "
+                "dataset is used instead", stacklevel=2)
         rng = np.random.default_rng(0 if mode == "train" else 1)
         n = 3000 if mode == "train" else 600
         self.rows = []
