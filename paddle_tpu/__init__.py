@@ -84,6 +84,7 @@ from . import quantization  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import version  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402

@@ -1,0 +1,520 @@
+"""``paddle.distribution``: probability distributions.
+
+Parity surface: python/paddle/distribution/ (Distribution base with
+sample/rsample/log_prob/entropy/kl_divergence, Normal, Uniform, Categorical,
+Bernoulli, Beta, Dirichlet, Multinomial, Laplace, Gumbel, Exponential,
+Geometric, LogNormal, plus the kl_divergence registry).
+
+TPU-native design: samplers draw subkeys from the framework's carried RNG
+state (core.random.default_generator), so sampling inside a ``to_static``
+step is reproducible and re-keyed per call; log_prob/entropy are pure jnp
+and differentiable through the tape.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core.random import default_generator
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Multinomial", "Laplace", "Gumbel",
+           "Exponential", "Geometric", "LogNormal", "kl_divergence",
+           "register_kl"]
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):  # non-differentiable draw
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..ops import math as _m
+        return _m.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _key(self):
+        return default_generator.split_key()
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc) if not isinstance(loc, Tensor) else loc
+        self.scale = ensure_tensor(scale) if not isinstance(scale, Tensor) else scale
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply("normal_var", lambda s: s * s, self.scale)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+
+        def f(m, s):
+            eps = jax.random.normal(key, shp, jnp.float32)
+            return m + s * eps
+
+        return apply("normal_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, m, s):
+            var = s * s
+            return (-((v - m) ** 2) / (2 * var) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi))
+
+        return apply("normal_log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        return apply("normal_entropy",
+                     lambda s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                     self.scale)
+
+
+class LogNormal(Normal):
+    def rsample(self, shape=()):
+        from ..ops import math as _m
+        return _m.exp(super().rsample(shape))
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, m, s):
+            lv = jnp.log(v)
+            var = s * s
+            return (-((lv - m) ** 2) / (2 * var) - jnp.log(s) - lv
+                    - 0.5 * math.log(2 * math.pi))
+
+        return apply("lognormal_log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        def f(m, s):
+            return m + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+        return apply("lognormal_entropy", f, self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = ensure_tensor(low)
+        self.high = ensure_tensor(high)
+        super().__init__(jnp.broadcast_shapes(self.low._data.shape,
+                                              self.high._data.shape))
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+
+        def f(lo, hi):
+            u = jax.random.uniform(key, shp, jnp.float32)
+            return lo + (hi - lo) * u
+
+        return apply("uniform_rsample", f, self.low, self.high)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return apply("uniform_log_prob", f, value, self.low, self.high)
+
+    def entropy(self):
+        return apply("uniform_entropy", lambda lo, hi: jnp.log(hi - lo),
+                     self.low, self.high)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("provide logits or probs")
+        if logits is not None and not isinstance(logits, Tensor):
+            logits = ensure_tensor(logits)
+        if probs is not None and not isinstance(probs, Tensor):
+            probs = ensure_tensor(probs)
+        # paddle's Categorical(logits) actually treats the input as
+        # unnormalized PROBS if positive; we follow torch-style logits
+        self._logits = logits if logits is not None else apply(
+            "cat_log", lambda p: jnp.log(jnp.maximum(p, 1e-38)), probs)
+        super().__init__(self._logits._data.shape[:-1])
+
+    @property
+    def logits(self):
+        return apply("cat_norm_logits",
+                     lambda l: l - jax.scipy.special.logsumexp(
+                         l, axis=-1, keepdims=True), self._logits)
+
+    @property
+    def probs(self):
+        return apply("cat_probs", lambda l: jax.nn.softmax(l, -1),
+                     self._logits)
+
+    def sample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("cat_sample", lambda l: jax.random.categorical(
+            key, l, shape=shp), self._logits, differentiable=False)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, l):
+            norm = l - jax.scipy.special.logsumexp(l, axis=-1, keepdims=True)
+            return jnp.take_along_axis(
+                norm, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+        return apply("cat_log_prob", f, value, self._logits)
+
+    def entropy(self):
+        def f(l):
+            norm = l - jax.scipy.special.logsumexp(l, axis=-1, keepdims=True)
+            p = jnp.exp(norm)
+            return -jnp.sum(p * norm, axis=-1)
+
+        return apply("cat_entropy", f, self._logits)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = ensure_tensor(probs)
+        super().__init__(self.probs_t._data.shape)
+
+    def sample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("bern_sample", lambda p: jax.random.bernoulli(
+            key, p, shp).astype(jnp.float32), self.probs_t,
+            differentiable=False)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply("bern_log_prob", f, value, self.probs_t)
+
+    def entropy(self):
+        def f(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+        return apply("bern_entropy", f, self.probs_t)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = ensure_tensor(alpha)
+        self.beta = ensure_tensor(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha._data.shape,
+                                              self.beta._data.shape))
+
+    def sample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("beta_sample", lambda a, b: jax.random.beta(
+            key, a, b, shp), self.alpha, self.beta, differentiable=False)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            "Beta.rsample: implicit reparameterization is not implemented; "
+            "use sample() (no pathwise gradient) or a score-function "
+            "estimator over log_prob")
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, a, b):
+            from jax.scipy.special import betaln
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - betaln(a, b))
+
+        return apply("beta_log_prob", f, value, self.alpha, self.beta)
+
+    def entropy(self):
+        def f(a, b):
+            from jax.scipy.special import betaln, digamma
+            return (betaln(a, b) - (a - 1) * digamma(a)
+                    - (b - 1) * digamma(b)
+                    + (a + b - 2) * digamma(a + b))
+
+        return apply("beta_entropy", f, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = ensure_tensor(concentration)
+        shape = self.concentration._data.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    def sample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("dirichlet_sample", lambda c: jax.random.dirichlet(
+            key, c, shp if shp else None), self.concentration,
+            differentiable=False)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            "Dirichlet.rsample: implicit reparameterization is not "
+            "implemented; use sample()")
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, c):
+            from jax.scipy.special import gammaln
+            return (jnp.sum((c - 1) * jnp.log(v), axis=-1)
+                    + gammaln(jnp.sum(c, -1)) - jnp.sum(gammaln(c), -1))
+
+        return apply("dirichlet_log_prob", f, value, self.concentration)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_t = ensure_tensor(probs)
+        shape = self.probs_t._data.shape
+        super().__init__(shape[:-1], shape[-1:])
+
+    def sample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+
+        def f(p):
+            logits = jnp.log(jnp.maximum(p, 1e-38))
+            draws = jax.random.categorical(
+                key, logits, shape=(self.total_count,) + shp)
+            k = p.shape[-1]
+            return jax.nn.one_hot(draws, k).sum(axis=0)
+
+        return apply("multinomial_sample", f, self.probs_t,
+                     differentiable=False)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, p):
+            from jax.scipy.special import gammaln
+            logp = jnp.log(jnp.maximum(p, 1e-38))
+            return (gammaln(v.sum(-1) + 1) - gammaln(v + 1).sum(-1)
+                    + (v * logp).sum(-1))
+
+        return apply("multinomial_log_prob", f, value, self.probs_t)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+
+        def f(m, s):
+            u = jax.random.uniform(key, shp, jnp.float32, 1e-7, 1.0) - 0.5
+            return m - s * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+
+        return apply("laplace_rsample", f, self.loc, self.scale)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply("laplace_log_prob",
+                     lambda v, m, s: -jnp.abs(v - m) / s - jnp.log(2 * s),
+                     value, self.loc, self.scale)
+
+    def entropy(self):
+        return apply("laplace_entropy", lambda s: 1 + jnp.log(2 * s),
+                     self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+
+        def f(m, s):
+            return m + s * jax.random.gumbel(key, shp, jnp.float32)
+
+        return apply("gumbel_rsample", f, self.loc, self.scale)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, m, s):
+            z = (v - m) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return apply("gumbel_log_prob", f, value, self.loc, self.scale)
+
+    def entropy(self):
+        return apply("gumbel_entropy",
+                     lambda s: jnp.log(s) + 1.0 + jnp.euler_gamma, self.scale)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = ensure_tensor(rate)
+        super().__init__(self.rate._data.shape)
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("expo_rsample", lambda r: jax.random.exponential(
+            key, shp, jnp.float32) / r, self.rate)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply("expo_log_prob",
+                     lambda v, r: jnp.log(r) - r * v, value, self.rate)
+
+    def entropy(self):
+        return apply("expo_entropy", lambda r: 1.0 - jnp.log(r), self.rate)
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_t = ensure_tensor(probs)
+        super().__init__(self.probs_t._data.shape)
+
+    def sample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+
+        def f(p):
+            u = jax.random.uniform(key, shp, jnp.float32, 1e-7, 1.0)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        return apply("geom_sample", f, self.probs_t, differentiable=False)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply("geom_log_prob",
+                     lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+                     value, self.probs_t)
+
+
+# --- KL registry -------------------------------------------------------------
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(cls_p: Type, cls_q: Type):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    # EXACT-type dispatch: isinstance matching would silently hand a
+    # subclass pair (e.g. Normal vs LogNormal) to a base-class formula
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is not None:
+        return fn(p, q)
+    raise NotImplementedError(
+        f"no KL(p || q) registered for ({type(p).__name__}, "
+        f"{type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    def f(m1, s1, m2, s2):
+        return (jnp.log(s2 / s1) + (s1 * s1 + (m1 - m2) ** 2)
+                / (2 * s2 * s2) - 0.5)
+    return apply("kl_normal", f, p.loc, p.scale, q.loc, q.scale)
+
+
+# KL is invariant under the shared exp() bijection, so the LogNormal pair
+# reuses the Normal formula (registered explicitly — exact-type dispatch)
+register_kl(LogNormal, LogNormal)(_kl_normal_normal)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    def f(lp, lq):
+        np_ = lp - jax.scipy.special.logsumexp(lp, -1, keepdims=True)
+        nq = lq - jax.scipy.special.logsumexp(lq, -1, keepdims=True)
+        return jnp.sum(jnp.exp(np_) * (np_ - nq), axis=-1)
+    return apply("kl_cat", f, p._logits, q._logits)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def f(al, ah, bl, bh):
+        ratio = (bh - bl) / (ah - al)
+        return jnp.where((bl <= al) & (ah <= bh), jnp.log(ratio), jnp.inf)
+    return apply("kl_uniform", f, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    def f(pp, pq):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        pq = jnp.clip(pq, 1e-7, 1 - 1e-7)
+        return (pp * (jnp.log(pp) - jnp.log(pq))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-pq)))
+    return apply("kl_bern", f, p.probs_t, q.probs_t)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_expo_expo(p, q):
+    return apply("kl_expo",
+                 lambda rp, rq: jnp.log(rp) - jnp.log(rq) + rq / rp - 1.0,
+                 p.rate, q.rate)

@@ -165,11 +165,17 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_cos", to_tensor(cos), persistable=False)
         self.register_buffer("rope_sin", to_tensor(sin), persistable=False)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, caches=None):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x, self.rope_cos, self.rope_sin, attn_mask)
-        return self.norm(x)
+        if caches is None:
+            for layer in self.layers:
+                x = layer(x, self.rope_cos, self.rope_sin, attn_mask)
+            return self.norm(x)
+        new_caches = []
+        for layer, c in zip(self.layers, caches):
+            x, nc = layer(x, self.rope_cos, self.rope_sin, attn_mask, cache=c)
+            new_caches.append(nc)
+        return self.norm(x), new_caches
 
 
 class LlamaForCausalLM(nn.Layer):
@@ -185,16 +191,77 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
-        if self.lm_head is not None:
-            logits = self.lm_head(h)
-        else:
-            logits = F.linear(h, transpose(self.model.embed_tokens.weight, [1, 0]))
+        logits = self._logits(h)
         if labels is not None:
             loss = F.cross_entropy(
                 reshape(logits[:, :-1, :], [-1, self.config.vocab_size]),
                 reshape(labels[:, 1:], [-1]))
             return loss, logits
         return logits
+
+    def _logits(self, h):
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        return F.linear(h, transpose(self.model.embed_tokens.weight, [1, 0]))
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 do_sample: bool = False, eos_token_id=None):
+        """Autoregressive decode with a KV cache (reference surface:
+        PaddleNLP GenerationMixin.generate — greedy by default, optional
+        temperature/top-k/top-p sampling). Prefill processes the prompt in
+        one pass; each subsequent step feeds one token against the cache."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.random import default_generator
+        from ..core.tracing import no_grad
+
+        cfg = self.config
+        b = input_ids.shape[0]
+        kvh = cfg.num_key_value_heads
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        empty = jnp.zeros((b, 0, kvh, hd),
+                          self.model.embed_tokens.weight._data.dtype)
+        caches = [(Tensor(empty), Tensor(empty))
+                  for _ in range(cfg.num_hidden_layers)]
+
+        def pick(logits):
+            arr = logits._data.astype(jnp.float32)
+            if not do_sample or temperature == 0:
+                return jnp.argmax(arr, axis=-1)
+            if temperature != 1.0:
+                arr = arr / temperature
+            if top_k:
+                kth = jnp.sort(arr, axis=-1)[..., -top_k][..., None]
+                arr = jnp.where(arr < kth, -jnp.inf, arr)
+            if top_p < 1.0:
+                srt = jnp.sort(arr, axis=-1)[..., ::-1]
+                cdf = jnp.cumsum(jax.nn.softmax(srt, -1), axis=-1)
+                cut_idx = jnp.sum(cdf < top_p, axis=-1, keepdims=True)
+                cut = jnp.take_along_axis(srt, cut_idx, axis=-1)
+                arr = jnp.where(arr < cut, -jnp.inf, arr)
+            return jax.random.categorical(default_generator.split_key(), arr)
+
+        with no_grad():
+            tokens = [input_ids]
+            x = input_ids
+            finished = jnp.zeros((b,), bool)
+            for _ in range(max_new_tokens):
+                h, caches = self.model(x, caches=caches)
+                nxt = pick(self._logits(h[:, -1]))
+                if eos_token_id is not None:
+                    # rows already finished keep emitting eos (reference
+                    # generate freezes finished sequences to eos/pad)
+                    nxt = jnp.where(finished,
+                                    jnp.asarray(eos_token_id, nxt.dtype), nxt)
+                    finished = finished | (nxt == eos_token_id)
+                t = Tensor(nxt[:, None])
+                tokens.append(t)
+                x = t
+                if eos_token_id is not None and bool(finished.all()):
+                    break
+        return concat(tokens, axis=1)
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())

@@ -7,7 +7,8 @@ from . import utils  # noqa: F401
 from .common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
     Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D,
-    Pad3D, CosineSimilarity, PixelShuffle, Unfold,
+    Pad3D, CosineSimilarity, PixelShuffle, PixelUnshuffle,
+    ChannelShuffle, Unfold, Fold,
 )
 from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose  # noqa: F401
 from .norm import (  # noqa: F401

@@ -16,7 +16,8 @@ from .layer import Layer, ParamAttr
 __all__ = [
     "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D", "AlphaDropout",
     "Flatten", "Identity", "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
-    "Pad1D", "Pad2D", "Pad3D", "CosineSimilarity", "PixelShuffle", "Unfold",
+    "Pad1D", "Pad2D", "Pad3D", "CosineSimilarity", "PixelShuffle",
+    "PixelUnshuffle", "ChannelShuffle", "Unfold", "Fold",
 ]
 
 
@@ -215,3 +216,36 @@ class Unfold(Layer):
     def forward(self, x):
         return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
                         self.dilations)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes = output_sizes
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)

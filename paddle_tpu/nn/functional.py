@@ -20,7 +20,7 @@ from ..ops.conv_pool import (  # noqa: F401
     conv1d, conv2d, conv3d, conv2d_transpose, max_pool1d, max_pool2d,
     max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
     adaptive_avg_pool2d, adaptive_max_pool2d, interpolate, upsample,
-    pixel_shuffle, unfold,
+    pixel_shuffle, pixel_unshuffle, channel_shuffle, fold, unfold,
 )
 from ..ops.loss_ops import (  # noqa: F401
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,

@@ -298,6 +298,9 @@ def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=Fals
 
 def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     x = ensure_tensor(x)
+    if data_format != "NCHW":
+        raise ValueError(
+            f"{data_format!r} layout is not implemented; use NCHW")
     r = int(upscale_factor)
 
     def f(a):
@@ -328,8 +331,71 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     return apply("unfold", f, x)
 
 
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if data_format != "NCHW":
+        raise ValueError(
+            f"{data_format!r} layout is not implemented; use NCHW")
+    r = int(downscale_factor)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = a.transpose(0, 1, 3, 5, 2, 4)
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return apply("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if data_format != "NCHW":
+        raise ValueError(
+            f"{data_format!r} layout is not implemented; use NCHW")
+    g = int(groups)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, g, c // g, h, w)
+        return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return apply("channel_shuffle", f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """Inverse of unfold: sum patch columns back into an image (reference:
+    phi::FoldKernel). x (N, C*kh*kw, L) -> (N, C, H, W)."""
+    x = ensure_tensor(x)
+    oh, ow = _pair(output_sizes, 2)
+    kh, kw = _pair(kernel_sizes, 2)
+    s = _pair(strides, 2)
+    p = _pair(paddings, 2)
+    d = _pair(dilations, 2)
+
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (kh * kw)
+        n_h = (oh + 2 * p[0] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+        n_w = (ow + 2 * p[1] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+        cols = a.reshape(n, c, kh, kw, n_h, n_w)
+        img = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), a.dtype)
+        # scatter-add each kernel tap's grid (static python loops over kh/kw)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * d[0] + jnp.arange(n_h) * s[0]
+                xs = j * d[1] + jnp.arange(n_w) * s[1]
+                img = img.at[:, :, ys[:, None], xs[None, :]].add(
+                    cols[:, :, i, j])
+        return img[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+    return apply("fold", f, x)
+
 for _n in ("conv1d", "conv2d", "conv3d", "conv2d_transpose", "max_pool1d",
            "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
            "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
-           "interpolate", "upsample", "pixel_shuffle", "unfold"):
+           "interpolate", "upsample", "pixel_shuffle", "unfold",
+           "pixel_unshuffle", "channel_shuffle", "fold"):
     register_op(_n, globals()[_n])

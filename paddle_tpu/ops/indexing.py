@@ -519,3 +519,50 @@ def _setitem(self, item, value):
 
 register_tensor_method("__getitem__", _getitem)
 register_tensor_method("__setitem__", _setitem)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions of x from value's leading elements in order
+    (reference: paddle.masked_scatter)."""
+    x = ensure_tensor(x)
+    mask = ensure_tensor(mask)
+    value = ensure_tensor(value)
+    if not _is_tracer(mask._data):
+        needed = int(jnp.sum(jnp.broadcast_to(mask._data, x._data.shape)))
+        if needed > value._data.size:
+            raise ValueError(
+                f"masked_scatter: mask selects {needed} elements but value "
+                f"has only {value._data.size} (reference raises too)")
+
+    def f(a, m, v):
+        m = jnp.broadcast_to(m, a.shape)
+        flat_m = m.reshape(-1)
+        # position of each masked slot among masked slots
+        ord_idx = jnp.cumsum(flat_m.astype(jnp.int32)) - 1
+        src = v.reshape(-1)[jnp.clip(ord_idx, 0, v.size - 1)]
+        return jnp.where(flat_m, src.astype(a.dtype),
+                         a.reshape(-1)).reshape(a.shape)
+
+    return apply("masked_scatter", f, x, mask, value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    """Fill rows/slices selected by index along axis (reference:
+    paddle.index_fill)."""
+    x = ensure_tensor(x)
+    index = ensure_tensor(index)
+    vconst = float(value) if not isinstance(value, Tensor) else None
+    args = [x, index] + ([ensure_tensor(value)] if vconst is None else [])
+
+    def f(a, idx, *maybe_v):
+        v = maybe_v[0] if maybe_v else jnp.asarray(vconst, a.dtype)
+        mask1d = jnp.zeros((a.shape[axis],), bool).at[idx].set(True)
+        shape = [1] * a.ndim
+        shape[axis] = a.shape[axis]
+        return jnp.where(mask1d.reshape(shape), v.astype(a.dtype), a)
+
+    return apply("index_fill", f, *args)
+
+
+register_op("masked_scatter", masked_scatter, methods=("masked_scatter",))
+register_op("index_fill", index_fill, methods=("index_fill",))

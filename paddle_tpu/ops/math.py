@@ -245,3 +245,106 @@ def _install_operators():
 
 
 _install_operators()
+
+
+def frexp(x, name=None):
+    """Mantissa/exponent decomposition (reference: paddle.frexp)."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return apply("frexp", f, x, differentiable=False)
+
+
+def diff(x, n: int = 1, axis: int = -1, prepend=None, append=None, name=None):
+    """n-th forward difference (reference: paddle.diff)."""
+    x = ensure_tensor(x)
+    extras = [t for t in (prepend, append) if t is not None]
+
+    def f(a, *pa):
+        idx = 0
+        pre = pa[idx] if prepend is not None else None
+        idx += prepend is not None
+        app = pa[idx] if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    return apply("diff", f, x, *[ensure_tensor(t) for t in extras])
+
+
+def trapezoid(y, x=None, dx=None, axis: int = -1, name=None):
+    """Trapezoidal integration (reference: paddle.trapezoid)."""
+    y = ensure_tensor(y)
+    if x is not None:
+        xt = ensure_tensor(x)
+        return apply("trapezoid",
+                     lambda a, b: jnp.trapezoid(a, b, axis=axis), y, xt)
+    d = 1.0 if dx is None else float(dx)
+    return apply("trapezoid", lambda a: jnp.trapezoid(a, dx=d, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis: int = -1, name=None):
+    """Cumulative trapezoid (reference: paddle.cumulative_trapezoid)."""
+    y = ensure_tensor(y)
+
+    def core(a, b=None, d=1.0):
+        sl = [slice(None)] * a.ndim
+        sl_lo, sl_hi = list(sl), list(sl)
+        sl_lo[axis] = slice(None, -1)
+        sl_hi[axis] = slice(1, None)
+        avg = (a[tuple(sl_lo)] + a[tuple(sl_hi)]) * 0.5
+        if b is not None:
+            step = b[tuple(sl_hi)] - b[tuple(sl_lo)]
+        else:
+            step = d
+        return jnp.cumsum(avg * step, axis=axis)
+
+    if x is not None:
+        return apply("cumulative_trapezoid", lambda a, b: core(a, b),
+                     y, ensure_tensor(x))
+    d = 1.0 if dx is None else float(dx)
+    return apply("cumulative_trapezoid", lambda a: core(a, d=d), y)
+
+
+def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None,
+        aweights=None, name=None):
+    """Covariance matrix (reference: paddle.linalg.cov)."""
+    x = ensure_tensor(x)
+    extras = [t for t in (fweights, aweights) if t is not None]
+
+    def f(a, *wa):
+        idx = 0
+        fw = wa[idx] if fweights is not None else None
+        idx += fweights is not None
+        aw = wa[idx] if aweights is not None else None
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+
+    return apply("cov", f, x, *[ensure_tensor(t) for t in extras])
+
+
+def corrcoef(x, rowvar: bool = True, name=None):
+    x = ensure_tensor(x)
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    """Generalized tensor contraction (reference: paddle.tensordot)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(axes, (list, tuple)) and len(axes) == 2 and all(
+            isinstance(a, (list, tuple)) for a in axes):
+        ax = tuple(tuple(a) for a in axes)
+    else:
+        ax = axes
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+register_op("frexp", frexp, methods=("frexp",))
+register_op("diff", diff, methods=("diff",))
+register_op("trapezoid", trapezoid, methods=("trapezoid",))
+register_op("cumulative_trapezoid", cumulative_trapezoid,
+            methods=("cumulative_trapezoid",))
+register_op("cov", cov, methods=("cov",))
+register_op("corrcoef", corrcoef, methods=("corrcoef",))
+register_op("tensordot", tensordot, methods=("tensordot",))

@@ -196,3 +196,21 @@ def logcumsumexp(x, axis=None, name=None):
 
 
 register_op("logcumsumexp", logcumsumexp, methods=("logcumsumexp",))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    return apply("nanmedian", lambda a: jnp.nanmedian(
+        a, axis=axis, keepdims=keepdim), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    x = ensure_tensor(x)
+    return apply("nanquantile", lambda a: jnp.nanquantile(
+        a, jnp.asarray(q), axis=axis, keepdims=keepdim,
+        method=interpolation), x)
+
+
+register_op("nanmedian", nanmedian, methods=("nanmedian",))
+register_op("nanquantile", nanquantile, methods=("nanquantile",))

@@ -1,0 +1,167 @@
+"""paddle.distribution + new tensor ops + llama.generate tests (reference:
+test/distribution/ closed-form checks, test_diff_op/test_cov numpy refs,
+PaddleNLP generation equivalence)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+class TestDistributions:
+    def test_normal_closed_forms(self):
+        n = D.Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+        assert abs(float(n.log_prob(paddle.to_tensor(0.0)))
+                   + 0.5 * math.log(2 * math.pi)) < 1e-5
+        assert abs(float(n.entropy()) - 0.5 * (1 + math.log(2 * math.pi))) < 1e-5
+        paddle.seed(0)
+        s = n.sample((20000,))
+        assert abs(float(s.mean())) < 0.03
+        assert abs(float(s.std()) - 1.0) < 0.03
+
+    def test_normal_rsample_grad(self):
+        mu = paddle.to_tensor(1.5, stop_gradient=False)
+        sigma = paddle.to_tensor(2.0, stop_gradient=False)
+        paddle.seed(1)
+        s = D.Normal(mu, sigma).rsample((1000,))
+        s.mean().backward()
+        assert abs(float(mu.grad) - 1.0) < 1e-4  # d mean/d mu == 1
+
+    def test_kl_registry(self):
+        kl = D.kl_divergence(D.Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0)),
+                             D.Normal(paddle.to_tensor(1.0), paddle.to_tensor(2.0)))
+        expect = math.log(2.0) + (1 + 1) / 8.0 - 0.5
+        assert abs(float(kl) - expect) < 1e-5
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(D.Normal(paddle.to_tensor(0.0), paddle.to_tensor(1.0)),
+                            D.Gumbel(paddle.to_tensor(0.0), paddle.to_tensor(1.0)))
+
+    def test_categorical(self):
+        probs = np.array([0.2, 0.3, 0.5], np.float32)
+        c = D.Categorical(logits=paddle.to_tensor(np.log(probs)))
+        ent = -(probs * np.log(probs)).sum()
+        assert abs(float(c.entropy()) - ent) < 1e-5
+        paddle.seed(0)
+        s = np.asarray(c.sample((20000,))._data)
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, probs, atol=0.02)
+
+    def test_bernoulli_beta_laplace_gumbel_expo(self):
+        b = D.Bernoulli(paddle.to_tensor(0.3))
+        assert abs(float(b.log_prob(paddle.to_tensor(1.0))) - math.log(0.3)) < 1e-5
+        beta = D.Beta(paddle.to_tensor(2.0), paddle.to_tensor(3.0))
+        # pdf(0.5) = 12 * 0.5 * 0.25 = 1.5
+        assert abs(float(beta.log_prob(paddle.to_tensor(0.5)))
+                   - math.log(1.5)) < 1e-4
+        lap = D.Laplace(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+        assert abs(float(lap.log_prob(paddle.to_tensor(0.0)))
+                   + math.log(2.0)) < 1e-5
+        expo = D.Exponential(paddle.to_tensor(2.0))
+        assert abs(float(expo.log_prob(paddle.to_tensor(1.0)))
+                   - (math.log(2.0) - 2.0)) < 1e-5
+        paddle.seed(3)
+        g = D.Gumbel(paddle.to_tensor(0.0), paddle.to_tensor(1.0))
+        s = g.sample((20000,))
+        assert abs(float(s.mean()) - 0.5772) < 0.05  # Euler-Mascheroni
+
+    def test_dirichlet_multinomial(self):
+        d = D.Dirichlet(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        paddle.seed(0)
+        s = np.asarray(d.sample((1000,))._data)
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [1 / 6, 2 / 6, 3 / 6], atol=0.04)
+        m = D.Multinomial(10, paddle.to_tensor(
+            np.array([0.5, 0.5], np.float32)))
+        s = np.asarray(m.sample((200,))._data)
+        assert (s.sum(-1) == 10).all()
+
+
+class TestNewOps:
+    def test_diff_cov_corrcoef(self):
+        x = np.random.default_rng(0).normal(size=(3, 40)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.cov(paddle.to_tensor(x))._data),
+            np.cov(x), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(paddle.corrcoef(paddle.to_tensor(x))._data),
+            np.corrcoef(x), rtol=1e-4, atol=1e-5)
+        a = np.array([3.0, 1.0, 4.0, 1.0, 5.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.diff(paddle.to_tensor(a), n=2)._data),
+            np.diff(a, n=2))
+
+    def test_trapezoid_and_cumulative(self):
+        y = np.array([1.0, 2.0, 3.0], np.float32)
+        x = np.array([0.0, 1.0, 3.0], np.float32)
+        assert abs(float(paddle.trapezoid(paddle.to_tensor(y),
+                                          paddle.to_tensor(x)))
+                   - np.trapezoid(y, x)) < 1e-5
+        ct = np.asarray(paddle.cumulative_trapezoid(
+            paddle.to_tensor(y), paddle.to_tensor(x))._data)
+        np.testing.assert_allclose(ct, [1.5, 6.5])
+
+    def test_frexp(self):
+        m, e = paddle.frexp(paddle.to_tensor(np.array([0.5, 8.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(m._data), [0.5, 0.5])
+        np.testing.assert_array_equal(np.asarray(e._data), [0, 4])
+
+    def test_tensordot_matches_numpy(self):
+        a = np.random.default_rng(1).normal(size=(2, 3, 4)).astype(np.float32)
+        b = np.random.default_rng(2).normal(size=(4, 3, 5)).astype(np.float32)
+        got = paddle.tensordot(paddle.to_tensor(a), paddle.to_tensor(b),
+                               axes=[[1, 2], [1, 0]])
+        np.testing.assert_allclose(np.asarray(got._data),
+                                   np.tensordot(a, b, axes=[[1, 2], [1, 0]]),
+                                   rtol=1e-4)
+
+    def test_masked_scatter_index_fill(self):
+        out = paddle.masked_scatter(
+            paddle.to_tensor(np.zeros((2, 2), np.float32)),
+            paddle.to_tensor(np.array([[True, False], [True, True]])),
+            paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(out._data), [[1, 0], [2, 3]])
+        out = paddle.index_fill(
+            paddle.to_tensor(np.zeros((3, 2), np.float32)),
+            paddle.to_tensor(np.array([1])), 0, 7.0)
+        np.testing.assert_allclose(np.asarray(out._data)[1], [7, 7])
+
+    def test_nanmedian(self):
+        x = paddle.to_tensor(np.array([1.0, np.nan, 5.0, 3.0], np.float32))
+        assert float(paddle.nanmedian(x)) == 3.0
+
+
+class TestGenerate:
+    def test_cached_decode_matches_full_context(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        import jax.numpy as jnp
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               kv_heads=2, inter=64, max_pos=64)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(np.array([[1, 5, 9], [2, 6, 3]], np.int32))
+        out = m.generate(ids, max_new_tokens=6)
+        cur = np.asarray(ids._data)
+        for _ in range(6):
+            with paddle.no_grad():
+                logits = m(paddle.to_tensor(cur))
+            nxt = np.asarray(jnp.argmax(
+                logits._data[:, -1].astype(jnp.float32), -1))
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out._data), cur)
+
+    def test_generate_eos_stops(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=16, hidden=16, layers=1, heads=2,
+                               kv_heads=2, inter=32, max_pos=32)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(np.array([[1, 2]], np.int32))
+        full = m.generate(ids, max_new_tokens=8)
+        eos = int(np.asarray(full._data)[0, 2])  # first generated token
+        stopped = m.generate(ids, max_new_tokens=8, eos_token_id=eos)
+        assert stopped.shape[1] == 3  # prompt + the eos token
