@@ -48,8 +48,10 @@ class Distribution:
     def event_shape(self):
         return self._event_shape
 
-    def sample(self, shape=()):  # non-differentiable draw
-        return self.rsample(shape)
+    def sample(self, shape=()):
+        """Non-differentiable draw (reference semantics: sample() is
+        detached; use rsample() for pathwise gradients)."""
+        return self.rsample(shape).detach()
 
     def rsample(self, shape=()):
         raise NotImplementedError
@@ -113,6 +115,19 @@ class Normal(Distribution):
 
 
 class LogNormal(Normal):
+    @property
+    def mean(self):
+        return apply("lognormal_mean",
+                     lambda m, s: jnp.exp(m + 0.5 * s * s),
+                     self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply("lognormal_var",
+                     lambda m, s: (jnp.exp(s * s) - 1.0)
+                     * jnp.exp(2 * m + s * s),
+                     self.loc, self.scale)
+
     def rsample(self, shape=()):
         from ..ops import math as _m
         return _m.exp(super().rsample(shape))

@@ -222,18 +222,20 @@ class PixelUnshuffle(Layer):
     def __init__(self, downscale_factor, data_format="NCHW", name=None):
         super().__init__()
         self.downscale_factor = downscale_factor
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.pixel_unshuffle(x, self.downscale_factor)
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
 
 
 class ChannelShuffle(Layer):
     def __init__(self, groups, data_format="NCHW", name=None):
         super().__init__()
         self.groups = groups
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.channel_shuffle(x, self.groups)
+        return F.channel_shuffle(x, self.groups, self.data_format)
 
 
 class Fold(Layer):

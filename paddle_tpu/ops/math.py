@@ -332,9 +332,13 @@ def corrcoef(x, rowvar: bool = True, name=None):
 def tensordot(x, y, axes=2, name=None):
     """Generalized tensor contraction (reference: paddle.tensordot)."""
     x, y = ensure_tensor(x), ensure_tensor(y)
-    if isinstance(axes, (list, tuple)) and len(axes) == 2 and all(
-            isinstance(a, (list, tuple)) for a in axes):
-        ax = tuple(tuple(a) for a in axes)
+    if isinstance(axes, (list, tuple)):
+        if len(axes) == 2 and all(isinstance(a, (list, tuple)) for a in axes):
+            ax = tuple(tuple(a) for a in axes)
+        else:
+            # paddle's flat form: contract THESE axes of both tensors
+            flat = tuple(int(a) for a in axes)
+            ax = (flat, flat)
     else:
         ax = axes
     return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)

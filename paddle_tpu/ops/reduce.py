@@ -103,6 +103,9 @@ register_op("count_nonzero", count_nonzero, methods=("count_nonzero",))
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
     x = ensure_tensor(x)
+    if mode == "min":  # lower of the two middle values (reference option)
+        return apply("median", lambda a: jnp.quantile(
+            a, 0.5, axis=axis, keepdims=keepdim, method="lower"), x)
     return apply("median", lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
 
 
@@ -200,6 +203,9 @@ register_op("logcumsumexp", logcumsumexp, methods=("logcumsumexp",))
 
 def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
     x = ensure_tensor(x)
+    if mode == "min":
+        return apply("nanmedian", lambda a: jnp.nanquantile(
+            a, 0.5, axis=axis, keepdims=keepdim, method="lower"), x)
     return apply("nanmedian", lambda a: jnp.nanmedian(
         a, axis=axis, keepdims=keepdim), x)
 

@@ -3,17 +3,11 @@
 from __future__ import annotations
 
 from ... import nn
-from ...ops.manipulation import concat, reshape, transpose
+from ...ops.conv_pool import channel_shuffle as _channel_shuffle
+from ...ops.manipulation import concat
 
 __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
            "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
-
-
-def _channel_shuffle(x, groups: int):
-    b, c, h, w = x.shape
-    x = reshape(x, [b, groups, c // groups, h, w])
-    x = transpose(x, [0, 2, 1, 3, 4])
-    return reshape(x, [b, c, h, w])
 
 
 def _conv_bn_act(in_c, out_c, k, stride=1, padding=0, groups=1, act=True):
