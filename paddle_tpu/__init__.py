@@ -20,8 +20,12 @@ from .flags import get_flags, set_flags, define_flag  # noqa: F401
 
 from .device import (  # noqa: F401
     Place, CPUPlace, TPUPlace, CUDAPlace, CustomPlace,
+    XPUPlace, MLUPlace, IPUPlace, CUDAPinnedPlace,
     set_device, get_device, device_count,
     is_compiled_with_cuda, is_compiled_with_tpu,
+    is_compiled_with_xpu, is_compiled_with_rocm, is_compiled_with_ipu,
+    is_compiled_with_mlu, is_compiled_with_cinn, is_compiled_with_distribute,
+    is_compiled_with_custom_device,
 )
 
 from .core.dtype import (  # noqa: F401
@@ -91,7 +95,23 @@ from . import onnx  # noqa: F401,E402
 from .nn.layer import LazyGuard  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
+from . import tensor  # noqa: F401,E402
 from .flops_counter import flops  # noqa: F401,E402
 from .framework.io import save, load  # noqa: F401,E402
+from .framework import in_dynamic_mode, in_pir_mode  # noqa: F401,E402
+from .framework.random import (  # noqa: F401,E402
+    get_cuda_rng_state, set_cuda_rng_state,
+)
+from .core.tracing import grad_enabled as _grad_enabled  # noqa: E402
+
+
+def is_grad_enabled() -> bool:
+    """Whether autograd is recording (parity: paddle.is_grad_enabled)."""
+    return _grad_enabled()
+
+
+def in_static_mode() -> bool:
+    return not in_dynamic_mode()
 
 __version__ = "0.1.0"

@@ -200,3 +200,13 @@ class GradScaler:
 def _is_tracing() -> bool:
     from ..core.tracing import trace_state
     return trace_state() is not None
+
+
+def is_bfloat16_supported(place=None) -> bool:
+    """bf16 is the TPU-native compute dtype — always supported."""
+    return True
+
+
+def is_float16_supported(place=None) -> bool:
+    """fp16 compute is emulated on TPU (MXU prefers bf16) but available."""
+    return True

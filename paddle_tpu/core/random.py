@@ -46,7 +46,9 @@ class Generator:
         return self._key
 
     def get_state(self):
-        return self._key
+        # snapshot, not the live state tensor — saved states must not advance
+        # with the generator (paddle.get_rng_state contract)
+        return Tensor(self._key._data, stop_gradient=True)
 
     def set_state(self, state) -> None:
         self._key._set_data(state._data if isinstance(state, Tensor) else state)

@@ -16,6 +16,7 @@ import jax
 
 __all__ = [
     "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "CustomPlace",
+    "XPUPlace", "MLUPlace", "IPUPlace", "CUDAPinnedPlace",
     "set_device", "get_device", "get_all_devices", "device_count",
     "is_compiled_with_cuda", "is_compiled_with_tpu", "current_place",
 ]
@@ -67,6 +68,25 @@ def CUDAPlace(device_id: int = 0) -> Place:
 
 def CustomPlace(device_type: str, device_id: int = 0) -> Place:
     return Place(device_type, device_id)
+
+
+def XPUPlace(device_id: int = 0) -> Place:
+    # Parity alias (Kunlun XPU in the reference): maps to the accelerator.
+    return CUDAPlace(device_id)
+
+
+def MLUPlace(device_id: int = 0) -> Place:
+    return CUDAPlace(device_id)
+
+
+def IPUPlace(device_id: int = 0) -> Place:
+    return CUDAPlace(device_id)
+
+
+def CUDAPinnedPlace() -> Place:
+    # Pinned host memory: on TPU the host side is plain CPU memory (PJRT
+    # stages transfers itself), so this is the cpu place.
+    return Place("cpu", 0)
 
 
 @functools.lru_cache(maxsize=None)
@@ -145,6 +165,36 @@ def is_compiled_with_cuda() -> bool:
 
 def is_compiled_with_tpu() -> bool:
     return _accelerator_type() == "tpu"
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # the graph compiler role is filled by XLA itself (SURVEY §2.5.7)
+    return True
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str = "") -> bool:
+    # any non-cpu PJRT backend is a "custom device" in reference terms
+    return _accelerator_type() != "cpu"
 
 
 def default_jax_device() -> jax.Device:

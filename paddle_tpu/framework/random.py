@@ -1,0 +1,23 @@
+"""``paddle.framework.random`` — RNG state plumbing (reference:
+python/paddle/framework/random.py). The TPU build has ONE splittable
+generator (core/random.py); per-device CUDA states collapse onto it."""
+
+from __future__ import annotations
+
+from ..core.random import (  # noqa: F401
+    default_generator, get_rng_state, seed, set_rng_state,
+)
+
+
+def get_cuda_rng_state():
+    """Parity alias: there is no per-CUDA-device state; returns the global
+    generator's state list."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state) -> None:
+    set_rng_state(state)
+
+
+def get_random_seed_generator(name: str):
+    return default_generator

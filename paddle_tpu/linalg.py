@@ -1,10 +1,48 @@
 """``paddle.linalg`` namespace. Parity: python/paddle/linalg.py exports."""
 
+import jax.numpy as jnp
+
+from .core.tensor import apply
+from .ops._helpers import ensure_tensor
 from .ops.linalg import (  # noqa: F401
     matmul, bmm, dot, inner, outer, einsum, kron, mv, addmm, norm, dist,
     inv, pinv, det, slogdet, svd, qr, eigh, eig, eigvals, eigvalsh, cholesky,
     cholesky_solve, solve, triangular_solve, lstsq, matrix_power, matrix_rank,
     cond, cov, corrcoef, multi_dot, cross, householder_product,
+    vecdot, matrix_exp, lu, lu_unpack, ormqr,
 )
-vector_norm = norm
-matrix_norm = norm
+from .ops.math_ext import cdist  # noqa: F401
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """Vector p-norm over ``axis`` (reference: paddle.linalg.vector_norm)."""
+    x = ensure_tensor(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+    def f(a):
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply("vector_norm", f, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """Matrix norm over the two ``axis`` dims (fro / nuc / ±1 / ±2 / ±inf)."""
+    x = ensure_tensor(x)
+    ax = tuple(axis)
+
+    def f(a):
+        # move the two matrix dims last so jnp.linalg.norm sees (..., m, n)
+        mvd = jnp.moveaxis(a, ax, (-2, -1))
+        r = jnp.linalg.norm(mvd, ord=p, axis=(-2, -1))
+        if keepdim:
+            for d in sorted(d % a.ndim for d in ax):
+                r = jnp.expand_dims(r, d)
+        return r
+
+    return apply("matrix_norm", f, x)

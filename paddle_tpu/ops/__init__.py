@@ -9,6 +9,7 @@ maps op name -> callable.
 from ._helpers import OP_REGISTRY, register_op  # noqa: F401
 
 from . import math  # noqa: F401
+from . import math_ext  # noqa: F401
 from . import reduce  # noqa: F401
 from . import manipulation  # noqa: F401
 from . import creation  # noqa: F401

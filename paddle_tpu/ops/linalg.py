@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import flags as _flags
-from ..core.tensor import Tensor, apply, register_tensor_method
+from ..core.tensor import Tensor, apply, register_tensor_method, to_tensor
 from ._helpers import ensure_tensor, register_op
 
 
@@ -295,4 +295,101 @@ for _n in ("inv", "pinv", "det", "slogdet", "svd", "qr", "eigh", "eig", "eigvals
            "eigvalsh", "cholesky", "cholesky_solve", "solve", "triangular_solve",
            "lstsq", "matrix_power", "matrix_rank", "cond", "cov", "corrcoef",
            "multi_dot", "cross", "householder_product"):
+    register_op(_n, globals()[_n])
+
+
+def vecdot(x, y, axis=-1, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("vecdot", lambda a, b: jnp.sum(a * b, axis=axis), x, y)
+
+
+def matrix_exp(x, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        if a.ndim == 2:
+            return jax.scipy.linalg.expm(a)
+        batch = a.reshape((-1,) + a.shape[-2:])
+        out = jax.vmap(jax.scipy.linalg.expm)(batch)
+        return out.reshape(a.shape)
+
+    return apply("matrix_exp", f, x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization with 1-based LAPACK pivots (reference: paddle.linalg.lu)."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_mat, (piv + 1).astype(jnp.int32)
+
+    lu_mat, piv = apply("lu", f, x)
+    if get_infos:
+        info = to_tensor(jnp.zeros(x._data.shape[:-2], jnp.int32))
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack an LU factorization into (P, L, U)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    m, n = int(x._data.shape[-2]), int(x._data.shape[-1])
+    k = min(m, n)
+
+    def f2d(a, piv):
+        l = jnp.tril(a[:, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+        u = jnp.triu(a[:k, :])
+        # replay LAPACK row swaps to build the permutation matrix
+        perm = jnp.arange(m)
+        for i in range(piv.shape[-1]):
+            j = piv[i].astype(jnp.int32) - 1
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        p = jnp.eye(m, dtype=a.dtype)[perm].T
+        return p, l, u
+
+    def f(a, piv):
+        if a.ndim == 2:
+            return f2d(a, piv)
+        batch = a.shape[:-2]
+        af = a.reshape((-1,) + a.shape[-2:])
+        pf = piv.reshape((-1, piv.shape[-1]))
+        p, l, u = jax.vmap(f2d)(af, pf)
+        return (p.reshape(batch + p.shape[-2:]),
+                l.reshape(batch + l.shape[-2:]),
+                u.reshape(batch + u.shape[-2:]))
+
+    p, l, u = apply("lu_unpack", f, x, y, differentiable=False)
+    return p, l, u
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply ``other`` by Q from a householder factorization."""
+    x, tau, other = ensure_tensor(x), ensure_tensor(tau), ensure_tensor(other)
+
+    def f2d(a, t, c):
+        m, nr = a.shape[-2], t.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(nr):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype),
+                                 a[i + 1:, i]])
+            q = q - t[i] * (q @ v[:, None]) @ v[None, :]
+        if transpose:
+            q = jnp.swapaxes(q, -1, -2)
+        return q @ c if left else c @ q
+
+    def f(a, t, c):
+        if a.ndim == 2:
+            return f2d(a, t, c)
+        batch = a.shape[:-2]
+        out = jax.vmap(f2d)(a.reshape((-1,) + a.shape[-2:]),
+                            t.reshape((-1, t.shape[-1])),
+                            c.reshape((-1,) + c.shape[-2:]))
+        return out.reshape(batch + out.shape[-2:])
+
+    return apply("ormqr", f, x, tau, other)
+
+
+for _n in ("vecdot", "matrix_exp", "lu", "lu_unpack", "ormqr"):
     register_op(_n, globals()[_n])

@@ -359,3 +359,180 @@ def load_inference_model(path_prefix: str, executor=None):
 
 
 from . import nn  # noqa: F401,E402  (control flow: while_loop/cond/case/switch_case)
+
+
+# ---------------------------------------------------------------------------
+# Utility surface: gradients / guards / py_func / create_parameter / metrics
+# (reference: python/paddle/static/ + python/paddle/base/backward.py)
+# ---------------------------------------------------------------------------
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Sum-of-targets gradients w.r.t. inputs (parity: paddle.static.gradients).
+
+    The record/replay design keeps eager tensors behind the program, so this
+    is the autograd engine's ``grad`` over the captured tape.
+    """
+    from ..core.autograd import grad as _grad
+
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if target_gradients is not None and not isinstance(target_gradients,
+                                                       (list, tuple)):
+        target_gradients = [target_gradients]
+    hook = _tensor_mod._op_graph_hook
+    _tensor_mod._op_graph_hook = None  # the grad pass is not program ops
+    try:
+        return list(_grad(list(targets), list(inputs),
+                          grad_outputs=target_gradients, allow_unused=True))
+    finally:
+        _tensor_mod._op_graph_hook = hook
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Parity: records (param, grad-slot) pairs; grads materialize when the
+    Executor replays the minimize step."""
+    params = parameter_list
+    if params is None:
+        params = [t for t in _current_program().list_vars()
+                  if not t.stop_gradient]
+    return [(p, getattr(p, "grad", None)) for p in params]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Variable scopes collapse onto live tensors here; the guard simply
+    swaps the lookup table used by global_scope()."""
+    global _scope
+    old, _scope = _scope, scope
+    try:
+        yield
+    finally:
+        _scope = old
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str = None):
+    from ..utils import unique_name
+    with unique_name.guard(f"{prefix}/" if prefix else None):
+        yield
+
+
+@contextlib.contextmanager
+def device_guard(device: str = None):
+    """Pin ops to 'cpu'/'gpu'(=tpu) within the block (best-effort: XLA owns
+    placement inside a compiled program; eager factories honor it)."""
+    from .. import device as _device_mod
+    if device is None:
+        yield
+        return
+    old = _device_mod.get_device()
+    try:
+        _device_mod.set_device("cpu" if device == "cpu" else "tpu"
+                               if _device_mod.is_compiled_with_tpu() else "cpu")
+        yield
+    finally:
+        _device_mod.set_device(old)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-callback op (reference: paddle.static.py_func over
+    PyFuncRegistry): runs ``func`` on host numpy values. Under jit this
+    lowers to ``jax.pure_callback`` (XLA host callout)."""
+    import jax
+    from ..core.tensor import apply as _apply
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o._data.shape), o._data.dtype)
+              for o in outs]
+
+    def kernel(*arrays):
+        def host(*np_arrays):
+            r = func(*np_arrays)
+            r = r if isinstance(r, (list, tuple)) else [r]
+            return tuple(np.asarray(v) for v in r)
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            # under jit: lower to an XLA host callout
+            res = jax.pure_callback(host, tuple(shapes), *arrays)
+        else:  # eager: run on host directly (axon PJRT lacks send/recv)
+            import jax.numpy as jnp
+            res = tuple(jnp.asarray(v) for v in host(*(np.asarray(a)
+                                                       for a in arrays)))
+        return tuple(res) if len(outs) > 1 else res[0]
+
+    result = _apply("py_func", kernel, *[Executor._to_tensor(t) for t in xs],
+                    differentiable=False)
+    res_t = result if isinstance(result, tuple) else (result,)
+    for o, r in zip(outs, res_t):
+        o._rebind(r)
+    return out
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.tensor import Parameter
+    from ..nn import initializer as I
+
+    init = default_initializer
+    if init is None and attr is not None:
+        init = getattr(attr, "initializer", None)
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    from ..core.dtype import convert_dtype
+    p = Parameter(init(tuple(int(s) for s in shape), convert_dtype(dtype)),
+                  name=name)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+    from ..core.dtype import convert_dtype
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        convert_dtype(dtype)), stop_gradient=True)
+    t.name = name
+    t.persistable = persistable
+    return t
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy as a tensor (parity: paddle.static.accuracy)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply as _apply
+
+    def f(pred, lab):
+        topk = jnp.argsort(pred, axis=-1)[..., ::-1][..., :k]
+        lab2 = lab.reshape(lab.shape[0], -1)[:, :1]
+        hit = jnp.any(topk == lab2, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return _apply("accuracy", f, Executor._to_tensor(input),
+                  Executor._to_tensor(label), differentiable=False)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC via thresholded confusion counts (parity shape: returns
+    (auc_out, batch_auc_out, state...) reduced to the auc tensor here)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply as _apply
+
+    def f(pred, lab):
+        score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 else pred.reshape(-1)
+        lab2 = lab.reshape(-1).astype(jnp.float32)
+        thresholds = jnp.linspace(0.0, 1.0, num_thresholds)
+        pos = (score[None, :] >= thresholds[:, None]).astype(jnp.float32)
+        tp = jnp.sum(pos * lab2[None, :], axis=1)
+        fp = jnp.sum(pos * (1 - lab2)[None, :], axis=1)
+        tpr = tp / jnp.clip(jnp.sum(lab2), 1e-6, None)
+        fpr = fp / jnp.clip(jnp.sum(1 - lab2), 1e-6, None)
+        return -jnp.trapezoid(tpr, fpr)
+
+    return _apply("auc", f, Executor._to_tensor(input),
+                  Executor._to_tensor(label), differentiable=False)
+
+
+__all__ += ["gradients", "append_backward", "scope_guard", "name_scope",
+            "device_guard", "py_func", "create_parameter",
+            "create_global_var", "accuracy", "auc"]

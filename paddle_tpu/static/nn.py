@@ -206,3 +206,107 @@ def switch_case(branch_index, branch_fns, default: Callable = None,
     info: dict = {}
     out = jax.lax.switch(sel, [_make_branch(f, info) for f in table], 0)
     return _rewrap(out, info["leaves"], info["treedef"])
+
+
+# ---------------------------------------------------------------------------
+# Layer-building ops (reference: python/paddle/static/nn/common.py — fc,
+# conv2d, batch_norm…): declarative layers that create their parameters at
+# call time inside a Program.
+# ---------------------------------------------------------------------------
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from .. import nn
+    from . import create_parameter
+    from ..core.tensor import apply
+    import jax.numpy as jnp
+
+    def _one(t):
+        shp = tuple(t._data.shape)
+        in_dim = 1
+        for s in shp[num_flatten_dims:]:
+            in_dim *= int(s)
+        w = create_parameter([in_dim, size], t._data.dtype, attr=weight_attr)
+        # flatten relative to the runtime array — leading (batch) dims must
+        # not be baked in from the capture-time placeholder
+        out = apply("fc",
+                    lambda a, wt: a.reshape(a.shape[:num_flatten_dims] + (-1,)) @ wt,
+                    t, w)
+        return out
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = _one(xs[0])
+    for t in xs[1:]:
+        out = out + _one(t)
+    if bias_attr is not False:
+        b = create_parameter([size], out._data.dtype, attr=None
+                             if bias_attr in (None, True) else bias_attr,
+                             is_bias=True)
+        out = out + b
+    if activation:
+        out = getattr(nn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    from .. import nn
+    from . import create_parameter
+
+    ksize = (filter_size, filter_size) if isinstance(filter_size, int) else tuple(filter_size)
+    in_ch = int(input._data.shape[1 if data_format == "NCHW" else -1])
+    w = create_parameter([num_filters, in_ch // groups, *ksize],
+                         input._data.dtype, attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], input._data.dtype, is_bias=True,
+                             attr=None if bias_attr is True else bias_attr)
+    out = nn.functional.conv2d(input, w, bias=b, stride=stride,
+                               padding=padding, dilation=dilation,
+                               groups=groups, data_format=data_format)
+    if act:
+        out = getattr(nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True, use_global_stats=False):
+    from .. import nn
+    from . import create_parameter, create_global_var
+    from ..nn import initializer as I
+
+    ch = int(input._data.shape[1 if data_layout == "NCHW" else -1])
+    dt = input._data.dtype
+    scale = create_parameter([ch], dt, attr=param_attr,
+                             default_initializer=I.Constant(1.0))
+    bias = create_parameter([ch], dt, is_bias=True, attr=bias_attr)
+    mean = create_global_var([ch], 0.0, dt, persistable=True,
+                             name=moving_mean_name)
+    var = create_global_var([ch], 1.0, dt, persistable=True,
+                            name=moving_variance_name)
+    out = nn.functional.batch_norm(input, mean, var, weight=scale, bias=bias,
+                                   training=not is_test, momentum=momentum,
+                                   epsilon=epsilon, data_format=data_layout,
+                                   use_global_stats=use_global_stats)
+    if act:
+        out = getattr(nn.functional, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from .. import nn
+    from . import create_parameter
+    from ..core.dtype import convert_dtype
+    from ..nn import initializer as I
+
+    w = create_parameter(list(size), convert_dtype(dtype), attr=param_attr,
+                         default_initializer=I.XavierNormal())
+    return nn.functional.embedding(input, w, padding_idx=padding_idx,
+                                   sparse=is_sparse)
+
+
+__all__ += ["fc", "conv2d", "batch_norm", "embedding"]

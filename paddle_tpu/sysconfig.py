@@ -1,0 +1,20 @@
+"""``paddle.sysconfig`` (reference: python/paddle/sysconfig.py)."""
+
+from __future__ import annotations
+
+import os.path as osp
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = osp.dirname(osp.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of C/C++ headers shipped with the framework (the native
+    runtime's csrc tree)."""
+    return osp.join(_ROOT, "_native", "csrc")
+
+
+def get_lib() -> str:
+    """Directory of the built native shared libraries."""
+    return osp.join(_ROOT, "_native", "lib")

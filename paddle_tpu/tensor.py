@@ -1,0 +1,27 @@
+"""``paddle.tensor`` namespace (reference: python/paddle/tensor/): every
+registered tensor op is reachable here, as in the reference where the
+tensor package aggregates math/manipulation/creation/search/linalg/...
+
+The op registry is the single source of truth (ops/_helpers.OP_REGISTRY);
+this module resolves attributes against it lazily.
+"""
+
+from __future__ import annotations
+
+from .ops import OP_REGISTRY as _REG
+from .ops import (  # noqa: F401  (submodule parity spellings)
+    activation, array, creation, indexing, linalg, loss_ops, manipulation,
+    math, math_ext, reduce,
+)
+
+
+def __getattr__(name: str):
+    try:
+        return _REG[name]
+    except KeyError:
+        raise AttributeError(
+            f"module 'paddle.tensor' has no attribute {name!r}") from None
+
+
+def __dir__():
+    return sorted(set(list(globals()) + list(_REG)))

@@ -12,11 +12,16 @@ import functools
 import importlib
 import warnings
 
+from . import cpp_extension  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import profiler  # noqa: F401
 from . import unique_name  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
 
 __all__ = ["deprecated", "try_import", "run_check", "unique_name",
-           "require_version"]
+           "require_version", "dlpack", "download", "cpp_extension",
+           "profiler"]
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = "",
@@ -81,8 +86,3 @@ def run_check() -> None:
         print("PaddleTPU is installed successfully!")
 
 
-def download(url: str, *args, **kwargs):
-    raise RuntimeError(
-        "paddle.utils.download is unavailable: this build runs in a "
-        "zero-egress environment. Place files locally and pass paths "
-        "directly (datasets accept local roots; hub uses source='local').")

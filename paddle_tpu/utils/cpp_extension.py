@@ -1,0 +1,76 @@
+"""``paddle.utils.cpp_extension`` — build/load C++ extensions at runtime
+(reference: python/paddle/utils/cpp_extension/).
+
+TPU-native shape: extensions are host-side C++ (custom data loaders, RPC,
+CPU ops) compiled with the system toolchain and bound via ctypes — the
+same seam the in-tree native runtime uses (paddle_tpu/_native). CUDA
+sources are rejected: device code on TPU is written in Pallas, not C++.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig as _pysysconfig
+import tempfile
+
+__all__ = ["load", "CppExtension", "CUDAExtension", "BuildExtension",
+           "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(), "paddle_tpu_ext"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name: str, sources, extra_cxx_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose: bool = False):
+    """Compile C++ sources to a shared object and load it via ctypes.
+
+    Returns the loaded ``ctypes.CDLL``; exported ``extern "C"`` symbols are
+    callable directly. (The reference returns a python module of custom ops;
+    here custom *device* ops are Pallas kernels registered in python, so the
+    C++ seam is host-runtime only.)
+    """
+    if extra_cuda_cflags:
+        raise RuntimeError("CUDA sources are not supported on the TPU build; "
+                           "write device kernels in Pallas instead.")
+    build_dir = build_directory or get_build_directory()
+    out = os.path.join(build_dir, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not (os.path.exists(out) and os.path.getmtime(out) >= newest_src):
+        cmd = (["g++", "-O2", "-fPIC", "-shared", "-std=c++17"]
+               + (extra_cxx_cflags or [])
+               + [f"-I{p}" for p in (extra_include_paths or [])]
+               + [f"-I{_pysysconfig.get_paths()['include']}"]
+               + srcs + ["-o", out] + (extra_ldflags or []))
+        if verbose:
+            print(" ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)
+
+
+class CppExtension:
+    """setuptools-style extension spec (parity shim)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError("CUDAExtension is not available on the TPU build; "
+                       "device kernels are Pallas (see ops/flash_attention.py).")
+
+
+class BuildExtension:
+    """Parity shim for setup(cmdclass={'build_ext': BuildExtension})."""
+
+    @classmethod
+    def with_options(cls, **_):
+        return cls

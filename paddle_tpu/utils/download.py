@@ -1,0 +1,44 @@
+"""``paddle.utils.download`` (reference: python/paddle/utils/download.py).
+
+Zero-egress build: remote fetches are gated. Local files and pre-populated
+cache dirs work; a URL whose mapped cache file already exists resolves to it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = os.environ.get(
+    "PADDLE_WEIGHTS_HOME", osp.expanduser("~/.cache/paddle_tpu/weights"))
+
+
+def _md5check(fullname: str, md5sum: str | None) -> bool:
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def get_path_from_url(url: str, root_dir: str = WEIGHTS_HOME,
+                      md5sum: str | None = None, check_exist: bool = True):
+    """Resolve a URL to a local cache path; never fetches (zero egress)."""
+    if osp.exists(url):  # already a local path
+        return url
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    if osp.exists(fullname) and _md5check(fullname, md5sum):
+        return fullname
+    raise RuntimeError(
+        f"cannot download {url!r}: this build runs zero-egress. Place the "
+        f"file at {fullname!r} (or pass a local path) and retry.")
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

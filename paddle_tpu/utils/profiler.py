@@ -1,0 +1,44 @@
+"""``paddle.utils.profiler`` — legacy profiler entry points (reference:
+python/paddle/utils/profiler.py), forwarding to the modern
+``paddle.profiler`` package."""
+
+from __future__ import annotations
+
+from ..profiler import Profiler, ProfilerTarget, RecordEvent  # noqa: F401
+
+_active: Profiler | None = None
+
+
+def start_profiler(state: str = "All", tracer_option: str = "Default") -> None:
+    global _active
+    if _active is None:
+        _active = Profiler()
+        _active.start()
+
+
+def stop_profiler(sorted_key: str = "total",
+                  profile_path: str = "/tmp/profile") -> None:
+    global _active
+    if _active is not None:
+        _active.stop()
+        try:
+            _active.export_chrome_tracing(profile_path)
+        except Exception:
+            pass
+        _active = None
+
+
+class profiler:
+    """Context-manager parity for ``with paddle.utils.profiler.profiler(...)``."""
+
+    def __init__(self, state: str = "All", sorted_key: str = "total",
+                 profile_path: str = "/tmp/profile"):
+        self.profile_path = profile_path
+
+    def __enter__(self):
+        start_profiler()
+        return self
+
+    def __exit__(self, *exc):
+        stop_profiler(profile_path=self.profile_path)
+        return False

@@ -1,0 +1,321 @@
+"""Tests for the extended API-parity batch: math_ext ops, linalg additions,
+static utility surface, dlpack, namespace fills.
+
+Pattern per SURVEY.md §4: every op vs a NumPy reference.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+class TestMathExt:
+    def test_cdist(self):
+        x = np.random.randn(4, 5).astype("float32")
+        y = np.random.randn(3, 5).astype("float32")
+        d = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y))
+        ref = np.linalg.norm(x[:, None, :] - y[None, :, :], axis=-1)
+        np.testing.assert_allclose(d.numpy(), ref, atol=1e-4)
+
+    def test_cdist_p1(self):
+        x = np.random.randn(4, 5).astype("float32")
+        y = np.random.randn(3, 5).astype("float32")
+        d = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y), p=1.0)
+        ref = np.abs(x[:, None, :] - y[None, :, :]).sum(-1)
+        np.testing.assert_allclose(d.numpy(), ref, atol=1e-4)
+
+    def test_cdist_mm_path(self):
+        x = np.random.randn(80, 8).astype("float32")
+        y = np.random.randn(70, 8).astype("float32")
+        d = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y))
+        ref = np.linalg.norm(x[:, None, :] - y[None, :, :], axis=-1)
+        np.testing.assert_allclose(d.numpy(), ref, atol=1e-2)
+
+    def test_ldexp_signbit_inf_checks(self):
+        x = paddle.to_tensor([1.0, -2.0])
+        np.testing.assert_allclose(paddle.ldexp(x, paddle.to_tensor([2, 3])).numpy(),
+                                   [4.0, -16.0])
+        assert paddle.signbit(x).numpy().tolist() == [False, True]
+        inf = paddle.to_tensor([np.inf, -np.inf, 1.0])
+        assert paddle.isposinf(inf).numpy().tolist() == [True, False, False]
+        assert paddle.isneginf(inf).numpy().tolist() == [False, True, False]
+        assert paddle.isreal(x).numpy().all()
+
+    def test_isin(self):
+        out = paddle.isin(paddle.to_tensor([1, 2, 3, 4]),
+                          paddle.to_tensor([2, 4]))
+        assert out.numpy().tolist() == [False, True, False, True]
+
+    def test_renorm(self):
+        x = np.random.randn(3, 4).astype("float32") * 10
+        out = paddle.renorm(paddle.to_tensor(x), p=2.0, axis=0, max_norm=1.0)
+        norms = np.linalg.norm(out.numpy(), axis=1)
+        assert (norms <= 1.0 + 1e-4).all()
+
+    def test_combinations(self):
+        c = paddle.combinations(paddle.to_tensor([1, 2, 3, 4]), 3)
+        assert c.shape == [4, 3]
+        cr = paddle.combinations(paddle.to_tensor([1, 2]), 2,
+                                 with_replacement=True)
+        assert cr.numpy().tolist() == [[1, 1], [1, 2], [2, 2]]
+
+    def test_fill_diagonal_(self):
+        t = paddle.zeros([3, 4])
+        t.fill_diagonal_(7.0)
+        ref = np.zeros((3, 4), "float32")
+        np.fill_diagonal(ref, 7.0)
+        np.testing.assert_allclose(t.numpy(), ref)
+
+    def test_diagonal_scatter(self):
+        x = np.zeros((3, 3), "float32")
+        y = np.array([1.0, 2.0], "float32")
+        out = paddle.diagonal_scatter(paddle.to_tensor(x),
+                                      paddle.to_tensor(y), offset=1)
+        ref = x.copy()
+        ref[0, 1], ref[1, 2] = 1.0, 2.0
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_unfold_as_strided_view_as(self):
+        x = paddle.to_tensor(np.arange(10).astype("float32"))
+        u = x.unfold(0, 4, 3)
+        assert u.numpy().tolist() == [[0, 1, 2, 3], [3, 4, 5, 6], [6, 7, 8, 9]]
+        s = paddle.as_strided(x, [3, 2], [2, 1])
+        assert s.numpy().tolist() == [[0, 1], [2, 3], [4, 5]]
+        v = x.view_as(paddle.zeros([2, 5]))
+        assert v.shape == [2, 5]
+        assert x.contiguous() is x and x.is_contiguous()
+
+    def test_standard_gamma(self):
+        alpha = paddle.full([1000], 5.0)
+        g = paddle.standard_gamma(alpha)
+        assert abs(float(g.numpy().mean()) - 5.0) < 0.5
+
+    def test_top_p_sampling(self):
+        logits = np.full((2, 8), -10.0, "float32")
+        logits[:, 0] = 10.0  # all mass on token 0
+        vals, ids = paddle.top_p_sampling(paddle.to_tensor(logits),
+                                          paddle.to_tensor([0.9, 0.9]))
+        assert ids.numpy().reshape(-1).tolist() == [0, 0]
+
+    def test_gradients_flow(self):
+        x = paddle.to_tensor(np.random.randn(4, 5).astype("float32"),
+                             stop_gradient=False)
+        y = paddle.to_tensor(np.random.randn(3, 5).astype("float32"))
+        paddle.cdist(x, y).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+class TestLinalgExt:
+    def test_lu_roundtrip(self):
+        a = np.random.randn(5, 5).astype("float32")
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        p, l, u = paddle.linalg.lu_unpack(lu_t, piv)
+        np.testing.assert_allclose(p.numpy() @ l.numpy() @ u.numpy(), a,
+                                   atol=1e-4)
+
+    def test_lu_get_infos(self):
+        a = np.random.randn(4, 4).astype("float32")
+        lu_t, piv, info = paddle.linalg.lu(paddle.to_tensor(a), get_infos=True)
+        assert int(info.numpy()) == 0
+
+    def test_matrix_exp(self):
+        a = np.diag([1.0, 2.0]).astype("float32")
+        out = paddle.linalg.matrix_exp(paddle.to_tensor(a))
+        np.testing.assert_allclose(out.numpy(), np.diag(np.exp([1.0, 2.0])),
+                                   rtol=1e-5)
+
+    def test_ormqr(self):
+        a = np.random.randn(4, 3).astype("float32")
+        h, tau = np.linalg.qr(a, mode="raw")  # h is packed transposed (n, m)
+        packed = np.asarray(h.T, "float32")
+        tau = np.asarray(tau, "float32")
+        c = np.random.randn(4, 2).astype("float32")
+        out = paddle.linalg.ormqr(paddle.to_tensor(packed),
+                                  paddle.to_tensor(tau),
+                                  paddle.to_tensor(c))
+        q = np.linalg.qr(a, mode="complete")[0].astype("float32")
+        np.testing.assert_allclose(np.abs(out.numpy()), np.abs(q @ c),
+                                   atol=1e-3)
+
+    def test_vector_matrix_norm(self):
+        x = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.vector_norm(paddle.to_tensor(x), 2.0, axis=1).numpy(),
+            np.linalg.norm(x, axis=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_norm(paddle.to_tensor(x)).numpy(),
+            np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.linalg.vecdot(paddle.to_tensor(x), paddle.to_tensor(x)).numpy(),
+            (x * x).sum(-1), rtol=1e-5)
+
+
+class TestStaticExt:
+    def test_fc_program(self):
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                inp = static.data("x", [None, 4], "float32")
+                h = static.nn.fc(inp, 8, activation="relu")
+                out = static.nn.fc(h, 2)
+            res = static.Executor().run(
+                prog, feed={"x": np.random.randn(3, 4).astype("float32")},
+                fetch_list=[out])
+            assert res[0].shape == (3, 2)
+        finally:
+            paddle.disable_static()
+
+    def test_conv_bn_embedding_program(self):
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                img = static.data("img", [None, 3, 8, 8], "float32")
+                c = static.nn.conv2d(img, 4, 3, padding=1, act="relu")
+                bn = static.nn.batch_norm(c)
+                ids = static.data("ids", [None, 5], "int64")
+                emb = static.nn.embedding(ids, [10, 6])
+            res = static.Executor().run(
+                prog,
+                feed={"img": np.random.randn(2, 3, 8, 8).astype("float32"),
+                      "ids": np.random.randint(0, 10, (2, 5))},
+                fetch_list=[bn, emb])
+            assert res[0].shape == (2, 4, 8, 8)
+            assert res[1].shape == (2, 5, 6)
+        finally:
+            paddle.disable_static()
+
+    def test_gradients(self):
+        x = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+        y = (x * x).sum()
+        g = static.gradients([y], [x])
+        np.testing.assert_allclose(g[0].numpy(), [6.0])
+
+    def test_py_func(self):
+        out = paddle.zeros([3])
+        static.py_func(lambda a: a + 1,
+                       paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32")),
+                       out)
+        assert out.numpy().tolist() == [2.0, 3.0, 4.0]
+
+    def test_accuracy_auc(self):
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], "float32"))
+        label = paddle.to_tensor(np.array([[1], [0]]))
+        assert abs(float(static.accuracy(pred, label)) - 1.0) < 1e-6
+        scores = paddle.to_tensor(
+            np.array([[0.3, 0.7], [0.6, 0.4], [0.2, 0.8], [0.9, 0.1]],
+                     "float32"))
+        labels = paddle.to_tensor(np.array([1, 0, 1, 0]))
+        assert float(static.auc(scores, labels)) > 0.9
+
+    def test_create_parameter_guards(self):
+        p = static.create_parameter([4, 4], "float32")
+        assert p.shape == [4, 4] and not p.stop_gradient
+        with static.name_scope("blk"):
+            pass
+        with static.device_guard("cpu"):
+            pass
+        v = static.create_global_var([2], 1.5, "float32")
+        np.testing.assert_allclose(v.numpy(), [1.5, 1.5])
+
+
+class TestNamespaceFills:
+    def test_flags_and_modes(self):
+        assert paddle.in_dynamic_mode()
+        assert not paddle.in_static_mode()
+        assert isinstance(paddle.is_grad_enabled(), bool)
+        assert paddle.amp.is_bfloat16_supported()
+        assert paddle.amp.is_float16_supported()
+        assert not paddle.is_compiled_with_xpu()
+        assert not paddle.is_compiled_with_rocm()
+        assert paddle.is_compiled_with_cinn()
+
+    def test_places(self):
+        for fn in (paddle.XPUPlace, paddle.MLUPlace, paddle.IPUPlace):
+            assert fn(0).device_type in ("cpu", "tpu")
+        assert paddle.CUDAPinnedPlace().device_type == "cpu"
+
+    def test_tensor_module(self):
+        assert paddle.tensor.abs is paddle.abs
+        assert paddle.tensor.matmul is paddle.matmul
+        with pytest.raises(AttributeError):
+            paddle.tensor.not_a_real_op_name
+
+    def test_rng_state_roundtrip(self):
+        st = paddle.get_cuda_rng_state()
+        a = paddle.rand([4]).numpy()
+        paddle.set_cuda_rng_state(st)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+    def test_sysconfig(self):
+        assert paddle.sysconfig.get_include().endswith("csrc")
+        assert paddle.sysconfig.get_lib()
+
+    def test_dlpack_roundtrip(self):
+        from paddle_tpu.utils import dlpack
+        x = paddle.to_tensor(np.random.randn(3, 3).astype("float32"))
+        y = dlpack.from_dlpack(dlpack.to_dlpack(x))
+        np.testing.assert_allclose(y.numpy(), x.numpy())
+
+    def test_cpp_extension_load(self, tmp_path):
+        src = tmp_path / "ext.cc"
+        src.write_text('extern "C" int add3(int x) { return x + 3; }\n')
+        from paddle_tpu.utils import cpp_extension
+        lib = cpp_extension.load("t_ext", [str(src)],
+                                 build_directory=str(tmp_path))
+        assert lib.add3(4) == 7
+
+    def test_download_local_passthrough(self, tmp_path):
+        f = tmp_path / "weights.bin"
+        f.write_bytes(b"x")
+        from paddle_tpu.utils import download
+        assert download.get_path_from_url(str(f)) == str(f)
+        with pytest.raises(RuntimeError):
+            download.get_weights_path_from_url("http://example.com/nope.bin")
+
+
+class TestReviewFixes:
+    def test_lu_unpack_batched(self):
+        a = np.random.randn(2, 4, 4).astype("float32")
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        p, l, u = paddle.linalg.lu_unpack(lu_t, piv)
+        rec = np.einsum("bij,bjk,bkl->bil", p.numpy(), l.numpy(), u.numpy())
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+
+    def test_ldexp_no_overflow(self):
+        out = paddle.ldexp(paddle.to_tensor([1e-30], "float32"),
+                           paddle.to_tensor([200]))
+        np.testing.assert_allclose(out.numpy(),
+                                   np.ldexp(np.float32(1e-30), 200),
+                                   rtol=1e-6)
+
+    def test_ormqr_batched(self):
+        packed = np.zeros((2, 4, 3), "float32")
+        tau = np.zeros((2, 3), "float32")  # zero reflectors -> Q = I
+        c = np.random.randn(2, 4, 2).astype("float32")
+        out = paddle.linalg.ormqr(paddle.to_tensor(packed),
+                                  paddle.to_tensor(tau), paddle.to_tensor(c))
+        np.testing.assert_allclose(out.numpy(), c, atol=1e-6)
+
+    def test_static_conv2d_bias_attr(self):
+        from paddle_tpu.nn import initializer as I
+
+        class Attr:
+            initializer = I.Constant(0.5)
+
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                img = static.data("img", [1, 1, 4, 4], "float32")
+                out = static.nn.conv2d(img, 2, 3, padding=1, bias_attr=Attr())
+            res = static.Executor().run(
+                prog, feed={"img": np.zeros((1, 1, 4, 4), "float32")},
+                fetch_list=[out])
+            np.testing.assert_allclose(res[0], 0.5, atol=1e-6)
+        finally:
+            paddle.disable_static()
