@@ -9,6 +9,8 @@ from .common import (  # noqa: F401
     Identity, Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, Pad1D, Pad2D,
     Pad3D, CosineSimilarity, PixelShuffle, PixelUnshuffle,
     ChannelShuffle, Unfold, Fold,
+    Unflatten, FeatureAlphaDropout, PairwiseDistance, Bilinear, RReLU,
+    MaxUnPool1D, MaxUnPool2D,
 )
 from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose  # noqa: F401
 from .norm import (  # noqa: F401
@@ -31,7 +33,10 @@ from .loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, MarginRankingLoss, CosineEmbeddingLoss,
     TripletMarginLoss, HingeEmbeddingLoss,
+    SoftMarginLoss, MultiMarginLoss, PoissonNLLLoss, GaussianNLLLoss,
+    CTCLoss, RNNTLoss, AdaptiveLogSoftmaxWithLoss,
 )
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .rnn import (  # noqa: F401
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN,
     LSTM, GRU,

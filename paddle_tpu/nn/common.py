@@ -251,3 +251,133 @@ class Fold(Layer):
     def forward(self, x):
         return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
                       self.paddings, self.dilations)
+
+
+class Unflatten(Layer):
+    """Reshape one axis into the given shape (reference: paddle.nn.Unflatten)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.unflattened_shape = axis, tuple(int(s) for s in shape)
+
+    def forward(self, x):
+        from ..ops.manipulation import reshape
+        shp = list(x.shape)
+        ax = self.axis if self.axis >= 0 else self.axis + len(shp)
+        new = shp[:ax] + list(self.unflattened_shape) + shp[ax + 1:]
+        return reshape(x, new)
+
+    def extra_repr(self):
+        return f"axis={self.axis}, shape={self.unflattened_shape}"
+
+
+class FeatureAlphaDropout(Layer):
+    """Alpha dropout over whole channels (SELU-preserving statistics)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        import jax
+        import jax.numpy as jnp
+        from ..core.random import default_generator
+        from ..core.tensor import apply
+
+        key = default_generator.split_key()
+        p = self.p
+        alpha_p = -1.7580993408473766  # -selu_alpha * selu_scale
+
+        def f(a):
+            shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+            keep = jax.random.bernoulli(key, 1.0 - p, shape)
+            av = 1.0 / jnp.sqrt((alpha_p ** 2 * p + 1.0) * (1.0 - p))
+            bv = -av * alpha_p * p
+            return (jnp.where(keep, a, alpha_p) * av + bv).astype(a.dtype)
+
+        return apply("feature_alpha_dropout", f, x)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+        from ..core.tensor import apply
+
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+
+        def f(a, b):
+            d = jnp.abs(a - b) + eps
+            if p == float("inf"):
+                return jnp.max(d, axis=-1, keepdims=keep)
+            return jnp.sum(d ** p, axis=-1, keepdims=keep) ** (1.0 / p)
+
+        return apply("pairwise_distance", f, x, y)
+
+
+class Bilinear(Layer):
+    """out[k] = x1 W[k] x2^T + b (reference: paddle.nn.Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter((1, out_features), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x1, x2):
+        import jax.numpy as jnp
+        from ..core.tensor import apply
+
+        if self.bias is None:
+            def f(a, b, w):
+                return jnp.einsum("bi,oij,bj->bo", a, w, b)
+            return apply("bilinear", f, x1, x2, self.weight)
+
+        def f(a, b, w, bias):
+            return jnp.einsum("bi,oij,bj->bo", a, w, b) + bias
+
+        return apply("bilinear", f, x1, x2, self.weight, self.bias)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        from ..ops.manipulation import unsqueeze, squeeze
+        k, s, p, osz = self.args
+        x4 = unsqueeze(x, 2)
+        i4 = unsqueeze(indices, 2)
+        out = F.max_unpool2d(x4, i4, (1, k), (1, s or k), (0, p),
+                             output_size=osz)
+        return squeeze(out, 2)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, osz = self.args
+        return F.max_unpool2d(x, indices, k, s, p, output_size=osz)

@@ -1,0 +1,239 @@
+"""Seq2seq decoding: ``BeamSearchDecoder`` + ``dynamic_decode``.
+
+Parity surface: python/paddle/nn/decode.py (Decoder/BeamSearchDecoder/
+dynamic_decode). TPU notes: generation is a host-driven loop over jitted
+cell steps (the per-step compute compiles once; the loop trip count is
+data-dependent, which XLA cannot trace) — the same shape the reference's
+dynamic decode takes in dygraph.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .layer import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract stepper: initialize() / step() / finalize()."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+_BeamState = namedtuple("_BeamState",
+                        ["cell_states", "log_probs", "finished", "lengths"])
+_BeamOutput = namedtuple("_BeamOutput",
+                         ["scores", "predicted_ids", "parent_ids"])
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (reference: paddle.nn.BeamSearchDecoder).
+
+    ``cell`` maps (inputs, states) -> (outputs, new_states); ``output_fn``
+    projects cell outputs to vocab logits; ``embedding_fn`` embeds token ids
+    to the next step's inputs.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int, beam_size: int,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token, self.end_token = int(start_token), int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers (reference API surface) ------------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(B, ...) -> (B*beam, ...) by repeating each row beam_size times."""
+        x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        a = x._data
+        tiled = jnp.repeat(a[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + a.shape[1:]))
+
+    def _merge(self, a):  # (B, beam, ...) -> (B*beam, ...)
+        return a.reshape((-1,) + a.shape[2:])
+
+    def _split(self, a):  # (B*beam, ...) -> (B, beam, ...)
+        return a.reshape((-1, self.beam_size) + a.shape[1:])
+
+    def _map_states(self, states, fn):
+        if isinstance(states, (list, tuple)):
+            return type(states)(self._map_states(s, fn) for s in states)
+        arr = states._data if isinstance(states, Tensor) else states
+        return Tensor(fn(arr))
+
+    # -- Decoder interface ---------------------------------------------------
+    def initialize(self, inits):
+        """``inits``: cell initial states batched (B, ...)."""
+        states = self._map_states(
+            inits, lambda a: self._merge(jnp.repeat(a[:, None],
+                                                    self.beam_size, axis=1)))
+        first = jnp.asarray(states[0]._data if isinstance(states,
+                                                          (list, tuple))
+                            else states._data)
+        batch = first.shape[0] // self.beam_size
+        ids = jnp.full((batch, self.beam_size), self.start_token, jnp.int32)
+        inputs = self._embed(ids)
+        # beam 0 active, others -inf so the first expansion is unique
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1),
+                        jnp.float32)[None, :], (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        state = _BeamState(states, log_probs, finished,
+                           jnp.zeros((batch, self.beam_size), jnp.int32))
+        return inputs, state, Tensor(finished)
+
+    def _embed(self, ids):
+        flat = Tensor(ids.reshape(-1))
+        if self.embedding_fn is not None:
+            emb = self.embedding_fn(flat)
+            return emb
+        return flat
+
+    def step(self, time, inputs, states: _BeamState, **kwargs):
+        cell_out, next_cell_states = self.cell(inputs, states.cell_states,
+                                               **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = cell_out._data.astype(jnp.float32)     # (B*beam, V)
+        vocab = logits.shape[-1]
+        logp = self._split(jax.nn.log_softmax(logits, axis=-1))  # (B, beam, V)
+        # finished beams only extend with end_token at no cost
+        fin = states.finished[:, :, None]
+        end_onehot = (jnp.arange(vocab) == self.end_token)[None, None, :]
+        logp = jnp.where(fin, jnp.where(end_onehot, 0.0, -1e9), logp)
+        total = states.log_probs[:, :, None] + logp     # (B, beam, V)
+        flat = total.reshape(total.shape[0], -1)
+        top_scores, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parent = (top_idx // vocab).astype(jnp.int32)   # (B, beam)
+        token = (top_idx % vocab).astype(jnp.int32)
+        batch = flat.shape[0]
+        bi = jnp.arange(batch)[:, None]
+        new_finished = jnp.take_along_axis(states.finished, parent, axis=1) \
+            | (token == self.end_token)
+        new_lengths = jnp.take_along_axis(states.lengths, parent, axis=1) + \
+            (~jnp.take_along_axis(states.finished, parent, axis=1)).astype(jnp.int32)
+
+        def reorder(a):
+            s = self._split(a)
+            g = s[bi, parent]
+            return self._merge(g)
+
+        next_states = _BeamState(
+            self._map_states(next_cell_states, reorder),
+            top_scores, new_finished, new_lengths)
+        outputs = _BeamOutput(Tensor(top_scores), Tensor(token),
+                              Tensor(parent))
+        next_inputs = self._embed(token)
+        return outputs, next_states, next_inputs, Tensor(new_finished)
+
+    def finalize(self, outputs: _BeamOutput, final_states, sequence_lengths):
+        """Backtrack parent pointers to materialize beams (B, T, beam)."""
+        preds = outputs.predicted_ids._data      # (T, B, beam)
+        parents = outputs.parent_ids._data
+        t_max = preds.shape[0]
+        beam = jnp.arange(self.beam_size)[None, :]
+        toks = []
+        cur = jnp.broadcast_to(beam, parents.shape[1:]).astype(jnp.int32)
+        for t in range(t_max - 1, -1, -1):
+            toks.append(jnp.take_along_axis(preds[t], cur, axis=1))
+            cur = jnp.take_along_axis(parents[t], cur, axis=1)
+        ids = jnp.stack(toks[::-1], axis=0)       # (T, B, beam)
+        return Tensor(ids), final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder: Decoder, inits=None, max_step_num: Optional[int] = None,
+                   output_time_major: bool = False, impute_finished: bool = False,
+                   is_test: bool = False, return_length: bool = False,
+                   **kwargs):
+    """Run ``decoder`` until every sequence finishes or ``max_step_num``.
+
+    Returns (outputs, final_states[, sequence_lengths]).
+    """
+    inputs, states, finished = decoder.initialize(inits)
+    max_steps = int(max_step_num) if max_step_num is not None else 256
+    if max_steps <= 0:
+        raise ValueError(f"max_step_num must be positive, got {max_steps}")
+
+    def _impute(new, old, mask):
+        """Copy ``old`` through where ``mask`` (finished before this step)."""
+        if isinstance(new, (list, tuple)):
+            return type(new)(_impute(n, o, mask) for n, o in zip(new, old))
+        if not isinstance(new, Tensor):
+            return new
+        m = mask.reshape(mask.shape + (1,) * (new._data.ndim - mask.ndim))
+        return Tensor(jnp.where(m, old._data, new._data))
+
+    step_outputs = []
+    time = 0
+    while time < max_steps:
+        prev_states, prev_finished = states, finished
+        outputs, states, inputs, finished = decoder.step(time, inputs, states,
+                                                         **kwargs)
+        if impute_finished and not decoder.tracks_own_finished:
+            mask = jnp.asarray(prev_finished._data)
+            states = _impute(states, prev_states, mask)
+            if hasattr(outputs, "_fields"):
+                outputs = type(outputs)(*[_impute(getattr(outputs, f),
+                                                  Tensor(jnp.zeros_like(
+                                                      getattr(outputs, f)._data)),
+                                                  mask)
+                                          for f in outputs._fields])
+            elif isinstance(outputs, Tensor):
+                outputs = Tensor(jnp.where(
+                    mask.reshape(mask.shape + (1,) * (outputs._data.ndim -
+                                                      mask.ndim)),
+                    jnp.zeros_like(outputs._data), outputs._data))
+        step_outputs.append(outputs)
+        time += 1
+        if bool(np.asarray(finished._data).all()):
+            break
+
+    if isinstance(step_outputs[0], tuple) and hasattr(step_outputs[0], "_fields"):
+        stacked = type(step_outputs[0])(*[
+            Tensor(jnp.stack([getattr(o, f)._data for o in step_outputs]))
+            for f in step_outputs[0]._fields])
+    else:
+        stacked = Tensor(jnp.stack([o._data for o in step_outputs]))
+
+    seq_len = getattr(states, "lengths", None)
+    final_outputs, final_states = decoder.finalize(stacked, states, seq_len)
+
+    if not output_time_major:
+        def to_batch_major(t):
+            a = t._data
+            return Tensor(jnp.swapaxes(a, 0, 1))
+        if isinstance(final_outputs, tuple) and hasattr(final_outputs, "_fields"):
+            final_outputs = type(final_outputs)(
+                *[to_batch_major(getattr(final_outputs, f))
+                  for f in final_outputs._fields])
+        else:
+            final_outputs = to_batch_major(final_outputs)
+
+    if return_length:
+        return final_outputs, final_states, Tensor(seq_len)
+    return final_outputs, final_states

@@ -18,6 +18,7 @@ from . import linalg  # noqa: F401
 from . import activation  # noqa: F401
 from . import conv_pool  # noqa: F401
 from . import nn_ops  # noqa: F401
+from . import nn_ext  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import vision  # noqa: F401
 from . import array  # noqa: F401

@@ -179,12 +179,33 @@ def _pool(x, op_name, kernel_size, stride, padding, spatial, reducer, init,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        from .manipulation import squeeze, unsqueeze
+        from .nn_ext import max_pool2d_with_index
+        if ceil_mode or data_format != "NCL" or isinstance(padding, str):
+            raise NotImplementedError(
+                "max_pool1d(return_mask=True) supports NCL, ceil_mode=False, "
+                "numeric padding")
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        s = stride if stride is None or isinstance(stride, int) else stride[0]
+        p = padding if isinstance(padding, int) else padding[0]
+        out, mask = max_pool2d_with_index(unsqueeze(x, 2), (1, k),
+                                          (1, s if s is not None else k),
+                                          (0, p))
+        return squeeze(out, 2), squeeze(mask, 2)
     return _pool(x, "max_pool1d", kernel_size, stride, padding, 1, "max", -jnp.inf,
                  ceil_mode, data_format)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        from .nn_ext import max_pool2d_with_index
+        if ceil_mode or data_format != "NCHW" or isinstance(padding, str):
+            raise NotImplementedError(
+                "max_pool2d(return_mask=True) supports NCHW, ceil_mode=False, "
+                "numeric padding")
+        return max_pool2d_with_index(x, kernel_size, stride, padding)
     return _pool(x, "max_pool2d", kernel_size, stride, padding, 2, "max", -jnp.inf,
                  ceil_mode, data_format)
 

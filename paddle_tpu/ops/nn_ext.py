@@ -1,0 +1,519 @@
+"""Extended nn functional ops: sampling grids, unpooling, shift ops, and the
+long tail of loss functions (CTC / RNN-T / margin family).
+
+Parity surface: python/paddle/nn/functional/{vision,pooling,loss,common}.py.
+TPU notes: CTC/RNN-T are log-space DP over ``lax.scan`` (static trip counts,
+AD-differentiable — the reference binds warpctc/warprnnt CUDA kernels);
+grid_sample/affine_grid are pure gather/matmul forms that XLA fuses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random import default_generator
+from ..core.tensor import Tensor, apply, register_tensor_method
+from ._helpers import ensure_tensor, register_op
+from .loss_ops import _reduce
+
+
+# --- sampling grids ----------------------------------------------------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Generate a 2D sampling grid from batched affine matrices (N, 2, 3)."""
+    theta = ensure_tensor(theta)
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in np.asarray(out_shape._data)]
+    n, c, h, w = (int(s) for s in out_shape)
+
+    def f(th):
+        def lin(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size, dtype=th.dtype)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size,
+                                dtype=th.dtype)
+        ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # (H, W, 3)
+        return jnp.einsum("hwk,njk->nhwj", base, th)
+
+    return apply("affine_grid", f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample ``x`` (N,C,H,W) at normalized ``grid`` (N,Hg,Wg,2) locations."""
+    x, grid = ensure_tensor(x), ensure_tensor(grid)
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) * (size - 1) / 2.0
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    def reflect(coord, size):
+        if align_corners:
+            span = 2.0 * (size - 1)
+            if size == 1:
+                return jnp.zeros_like(coord)
+            c = jnp.abs(coord) % span
+            return jnp.where(c > size - 1, span - c, c)
+        span = 2.0 * size
+        c = jnp.abs(coord + 0.5) % span
+        c = jnp.where(c > size, span - c, c) - 0.5
+        return jnp.clip(c, 0, size - 1)
+
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = unnormalize(g[..., 0], w)
+        gy = unnormalize(g[..., 1], h)
+        if padding_mode == "border":
+            gx, gy = jnp.clip(gx, 0, w - 1), jnp.clip(gy, 0, h - 1)
+        elif padding_mode == "reflection":
+            gx, gy = reflect(gx, w), reflect(gy, h)
+
+        def gather(ix, iy):
+            """Fetch a[n, :, iy, ix] with zero padding outside."""
+            valid = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            # batched gather: (N, Hg, Wg) index grids into (N, C, H, W)
+            out = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(a, iyc, ixc)
+            # out: (N, C, Hg, Wg); zero outside unless border/reflection
+            if padding_mode == "zeros":
+                out = out * valid[:, None, :, :].astype(a.dtype)
+            return out
+
+        if mode == "nearest":
+            return gather(jnp.round(gx), jnp.round(gy))
+        x0, y0 = jnp.floor(gx), jnp.floor(gy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = ((x1 - gx) * (y1 - gy))[:, None]
+        wb = ((x1 - gx) * (gy - y0))[:, None]
+        wc = ((gx - x0) * (y1 - gy))[:, None]
+        wd = ((gx - x0) * (gy - y0))[:, None]
+        return (gather(x0, y0) * wa + gather(x0, y1) * wb +
+                gather(x1, y0) * wc + gather(x1, y1) * wd)
+
+    return apply("grid_sample", f, x, grid)
+
+
+# --- pooling with indices / unpooling ---------------------------------------
+
+def _pool_window_indices(h, w, kh, kw, sh, sw, ph, pw):
+    """Static flat window index grid over the padded plane."""
+    hp, wp = h + 2 * ph, w + 2 * pw
+    ho = (hp - kh) // sh + 1
+    wo = (wp - kw) // sw + 1
+    rows = np.arange(ho)[:, None, None, None] * sh + np.arange(kh)[None, None, :, None]
+    cols = np.arange(wo)[None, :, None, None] * sw + np.arange(kw)[None, None, None, :]
+    flat = (rows * wp + cols).reshape(ho, wo, kh * kw)
+    return flat.astype(np.int32), hp, wp, ho, wo
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    """Max pool returning (out, flat-argmax-indices) — the mask the reference's
+    max_pool2d(return_mask=True) yields, consumed by max_unpool2d."""
+    x = ensure_tensor(x)
+    kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = stride if stride is not None else kernel_size
+    sh, sw = (st, st) if isinstance(st, int) else tuple(st)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    n_, c_, h, w = (int(s) for s in x._data.shape)
+    win, hp, wp, ho, wo = _pool_window_indices(h, w, kh, kw, sh, sw, ph, pw)
+    win_j = jnp.asarray(win)
+
+    def f(a):
+        apad = jnp.pad(a, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                       constant_values=-jnp.inf)
+        flat = apad.reshape(a.shape[0], a.shape[1], hp * wp)
+        g = flat[..., win_j]                       # (N, C, Ho, Wo, K)
+        out = jnp.max(g, axis=-1)
+        arg = jnp.argmax(g, axis=-1)               # window-local
+        pidx = jnp.take_along_axis(
+            jnp.broadcast_to(win_j, g.shape[:-1] + win_j.shape[-1:]),
+            arg[..., None], axis=-1)[..., 0]       # padded-plane flat idx
+        row, col = pidx // wp - ph, pidx % wp - pw
+        return out, (row * w + col).astype(jnp.int32)
+
+    out, mask = apply("max_pool2d_with_index", f, x)
+    return out, mask
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Scatter pooled values back to their argmax positions."""
+    x, indices = ensure_tensor(x), ensure_tensor(indices)
+    kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = stride if stride is not None else (kh, kw)
+    sh, sw = (st, st) if isinstance(st, int) else tuple(st)
+    ph, pw = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    n_, c_, ho, wo = (int(s) for s in x._data.shape)
+    if output_size is None:
+        h = (ho - 1) * sh - 2 * ph + kh
+        w = (wo - 1) * sw - 2 * pw + kw
+    else:
+        h, w = (int(s) for s in output_size[-2:])
+
+    def f(a, idx):
+        flat_val = a.reshape(a.shape[0], a.shape[1], -1)
+        flat_idx = idx.reshape(idx.shape[0], idx.shape[1], -1)
+        zeros = jnp.zeros((a.shape[0], a.shape[1], h * w), a.dtype)
+        out = jax.vmap(jax.vmap(lambda z, i, v: z.at[i].set(v)))(
+            zeros, flat_idx, flat_val)
+        return out.reshape(a.shape[0], a.shape[1], h, w)
+
+    return apply("max_unpool2d", f, x, indices)
+
+
+# --- misc activations / shifts ----------------------------------------------
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    """Randomized leaky ReLU: slope ~ U[lower, upper] in training, the mean
+    slope at inference."""
+    x = ensure_tensor(x)
+    if training:
+        key = default_generator.split_key()
+
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, a * slope)
+    else:
+        mid = (lower + upper) / 2.0
+
+        def f(a):
+            return jnp.where(a >= 0, a, a * mid)
+
+    return apply("rrelu", f, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM temporal shift (reference: paddle.nn.functional.temporal_shift):
+    shift 1/ratio of channels one step backward/forward along time."""
+    x = ensure_tensor(x)
+    if data_format == "NHWC":
+        x = apply("transpose", lambda a: jnp.transpose(a, (0, 3, 1, 2)), x)
+    nt, c, h, w = (int(s) for s in x._data.shape)
+    n = nt // seg_num
+    fold = int(c * shift_ratio)
+
+    def f(a):
+        v = a.reshape(n, seg_num, c, h, w)
+        back = jnp.concatenate(  # channels [0:fold) come from t+1
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        fwd = jnp.concatenate(  # channels [fold:2fold) come from t-1
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]), v[:, :-1, fold:2 * fold]],
+            axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([back, fwd, rest], axis=2).reshape(nt, c, h, w)
+
+    out = apply("temporal_shift", f, x)
+    if data_format == "NHWC":
+        out = apply("transpose", lambda a: jnp.transpose(a, (0, 2, 3, 1)), out)
+    return out
+
+
+# --- margin / probabilistic losses -------------------------------------------
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return apply("soft_margin_loss",
+                 lambda a, y: _reduce(jax.nn.softplus(-y.astype(a.dtype) * a),
+                                      reduction),
+                 input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    extras = [ensure_tensor(weight)] if weight is not None else []
+
+    def f(a, y, *wa):
+        n, c = a.shape
+        correct = jnp.take_along_axis(a, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.clip(margin - correct + a, 0.0, None) ** p
+        if wa:
+            m = m * wa[0][y.astype(jnp.int32)][:, None]
+        m = m * (1 - jax.nn.one_hot(y, c, dtype=a.dtype))
+        return _reduce(jnp.sum(m, axis=1) / c, reduction)
+
+    return apply("multi_margin_loss", f, input, label, *extras)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    anchor, positive, labels = (ensure_tensor(anchor), ensure_tensor(positive),
+                                ensure_tensor(labels))
+
+    def f(a, p, y):
+        y = y.reshape(-1)
+        sim = a @ p.T
+        eq = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce_r = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        logp_c = jax.nn.log_softmax(sim.T, axis=1)
+        ce_c = -jnp.mean(jnp.sum(tgt * logp_c, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
+                        jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return (ce_r + ce_c) * 0.5 + reg
+
+    return apply("npair_loss", f, anchor, positive, labels)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(a, y):
+        y = y.astype(a.dtype)
+        if log_input:
+            loss = jnp.exp(a) - y * a
+        else:
+            loss = a - y * jnp.log(a + epsilon)
+        if full:  # Stirling approximation for log(y!)
+            stir = y * jnp.log(y) - y + 0.5 * jnp.log(2 * jnp.pi * y)
+            loss = loss + jnp.where(y > 1, stir, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply("poisson_nll_loss", f, input, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    input, label, variance = (ensure_tensor(input), ensure_tensor(label),
+                              ensure_tensor(variance))
+
+    def f(mu, y, var):
+        var = jnp.clip(var, epsilon, None)
+        loss = 0.5 * (jnp.log(var) + (y.astype(mu.dtype) - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        return _reduce(loss, reduction)
+
+    return apply("gaussian_nll_loss", f, input, label, variance)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """ArcFace-style margin softmax (reference: fused margin_cross_entropy;
+    the model-parallel variant shards classes over the mp group — here the
+    single-shard math, sharded classes ride the TP layer)."""
+    logits, label = ensure_tensor(logits), ensure_tensor(label)
+
+    def f(z, y):
+        theta = jnp.arccos(jnp.clip(z, -1.0 + 1e-7, 1.0 - 1e-7))
+        yi = y.reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(yi, z.shape[-1], dtype=z.dtype)
+        target_theta = margin1 * theta + margin2
+        zt = jnp.cos(target_theta) - margin3
+        adj = onehot * zt + (1 - onehot) * z
+        slog = jax.nn.log_softmax(adj * scale, axis=-1)
+        loss = -jnp.sum(onehot * slog, axis=-1)
+        return _reduce(loss, reduction), jnp.exp(slog)
+
+    loss, sm = apply("margin_cross_entropy", f, logits, label)
+    return (loss, sm) if return_softmax else loss
+
+
+# --- CTC ---------------------------------------------------------------------
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """Connectionist temporal classification loss.
+
+    ``log_probs``: (T, B, C) logits (log_softmax applied internally, as the
+    reference's warpctc does). ``labels``: (B, L) padded. Log-space alpha
+    recursion over the extended label sequence via ``lax.scan`` — fully
+    differentiable by AD, no custom backward needed.
+    """
+    log_probs, labels = ensure_tensor(log_probs), ensure_tensor(labels)
+    input_lengths, label_lengths = (ensure_tensor(input_lengths),
+                                    ensure_tensor(label_lengths))
+    neg_inf = -1e30
+
+    def f(lp, lab, ilen, llen):
+        t_max, b, c = lp.shape
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        l_max = lab.shape[1]
+        s_max = 2 * l_max + 1
+        lab = lab.astype(jnp.int32)
+        # extended sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((b, s_max), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        pos = jnp.arange(s_max)[None, :]
+        in_seq = pos < (2 * llen[:, None] + 1)
+        # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+        ext_m2 = jnp.concatenate([jnp.full((b, 2), -1, jnp.int32), ext[:, :-2]],
+                                 axis=1)
+        can_skip = (ext != blank) & (ext != ext_m2)
+
+        def emit(t):
+            return jnp.take_along_axis(lp[t], ext, axis=1)  # (B, S)
+
+        alpha0 = jnp.full((b, s_max), neg_inf, jnp.float32)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lab = jnp.where(llen > 0, lab[:, 0], blank)
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(llen > 0,
+                      lp[0, jnp.arange(b), first_lab], neg_inf))
+
+        def step(alpha, t):
+            prev1 = jnp.concatenate(
+                [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(can_skip, prev2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            new = merged + emit(t)
+            new = jnp.where(in_seq, new, neg_inf)
+            # freeze once past this sample's input length
+            new = jnp.where((t < ilen)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+        send = 2 * llen  # index of final blank
+        a_last = jnp.take_along_axis(alpha, send[:, None].astype(jnp.int32),
+                                     axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.clip(send - 1, 0)[:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        a_prev = jnp.where(llen > 0, a_prev, neg_inf)
+        nll = -jnp.logaddexp(a_last, a_prev)
+        if norm_by_times:
+            nll = nll / ilen.astype(nll.dtype)
+        if reduction == "mean":
+            # reference semantics: divide by label length, then batch-mean
+            nll = nll / jnp.clip(llen.astype(nll.dtype), 1.0, None)
+        return _reduce(nll, reduction)
+
+    return apply("ctc_loss", f, log_probs, labels, input_lengths,
+                 label_lengths)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN transducer loss over the (T, U) lattice.
+
+    ``input``: (B, T, U+1, C) joint-network logits; alpha recursion runs as a
+    scan over T with an inner scan over U (the reference binds warprnnt).
+    FastEmit per-arc gradient scaling needs the beta recursion and is not
+    implemented — pass fastemit_lambda=0 (documented divergence).
+    """
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization (fastemit_lambda != 0) is "
+            "not implemented in this build; pass fastemit_lambda=0.")
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    input_lengths, label_lengths = (ensure_tensor(input_lengths),
+                                    ensure_tensor(label_lengths))
+    neg_inf = -1e30
+
+    def f(lg, lab, ilen, llen):
+        b, t_max, u1, c = lg.shape
+        u_max = u1 - 1
+        lp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+        lab = lab.astype(jnp.int32)
+        bi = jnp.arange(b)
+        blank_lp = lp[..., blank]                     # (B, T, U+1)
+        yidx = jnp.broadcast_to(lab[:, None, :], (b, t_max, u_max))
+        y_lp = jnp.take_along_axis(lp[:, :, :u_max, :], yidx[..., None],
+                                   axis=-1)[..., 0]  # (B, T, U)
+
+        us = jnp.arange(u1)[None, :]
+
+        def t_step(alpha_prev, t):
+            # horizontal move: consume frame t-1 with blank at same u
+            horiz = alpha_prev + blank_lp[:, t - 1, :]
+
+            def u_step(carry, u):
+                # vertical move inside frame t: emit label u-1
+                val = jnp.where(
+                    u > 0,
+                    carry + y_lp[bi, t, jnp.clip(u - 1, 0)],
+                    neg_inf)
+                new = jnp.logaddexp(horiz[:, u], val)
+                return new, new
+
+            _, cols = jax.lax.scan(u_step, jnp.full((b,), neg_inf),
+                                   jnp.arange(u1))
+            alpha_t = jnp.swapaxes(cols, 0, 1)        # (B, U+1)
+            alpha_t = jnp.where(us <= llen[:, None], alpha_t, neg_inf)
+            alpha_t = jnp.where((t < ilen)[:, None], alpha_t, alpha_prev)
+            return alpha_t, None
+
+        # t = 0 row: only vertical moves
+        def u0_step(carry, u):
+            val = jnp.where(u > 0, carry + y_lp[bi, 0, jnp.clip(u - 1, 0)],
+                            0.0)
+            return val, val
+
+        _, cols0 = jax.lax.scan(u0_step, jnp.zeros((b,)), jnp.arange(u1))
+        alpha0 = jnp.swapaxes(cols0, 0, 1)
+        alpha0 = jnp.where(us <= llen[:, None], alpha0, neg_inf)
+
+        alpha, _ = jax.lax.scan(t_step, alpha0, jnp.arange(1, t_max))
+        final = alpha[bi, llen.astype(jnp.int32)] + \
+            blank_lp[bi, jnp.clip(ilen - 1, 0).astype(jnp.int32),
+                     llen.astype(jnp.int32)]
+        return _reduce(-final, reduction)
+
+    return apply("rnnt_loss", f, input, label, input_lengths, label_lengths)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (hierarchical output layer for large vocabularies):
+    frequent classes score through the head matmul, rare ones through
+    down-projected tail clusters."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    head_weight = ensure_tensor(head_weight)
+    tails = [(ensure_tensor(w1), ensure_tensor(w2)) for w1, w2 in tail_weights]
+    extras = [head_weight] + [w for pair in tails for w in pair]
+    if head_bias is not None:
+        extras.append(ensure_tensor(head_bias))
+    n_clusters = len(cutoffs)
+    shortlist = int(cutoffs[0]) if cutoffs else 0
+    cut = [0] + [int(cv) for cv in cutoffs]
+
+    def f(a, y, hw, *rest):
+        # layout contract: hw (in_features, head_size); w1 (in_features, hsz);
+        # w2 (hsz, cluster_size) — as the AdaptiveLogSoftmaxWithLoss layer
+        # creates them. No shape sniffing: coinciding dims must not transpose.
+        tw = [(rest[2 * i], rest[2 * i + 1]) for i in range(n_clusters)]
+        hb = rest[2 * n_clusters] if head_bias is not None else None
+        head = a @ hw
+        if hb is not None:
+            head = head + hb
+        head_lsm = jax.nn.log_softmax(head, axis=-1)
+        y = y.reshape(-1).astype(jnp.int32)
+        # shortlist classes score directly from the head
+        out = jnp.take_along_axis(head_lsm,
+                                  jnp.clip(y, 0, shortlist - 1)[:, None],
+                                  axis=1)[:, 0]
+        # tail cluster i covers [cut[i+1], cut[i+1] + cluster_size)
+        for i, (w1, w2) in enumerate(tw):
+            lo = cut[i + 1]
+            tail_lsm = jax.nn.log_softmax((a @ w1) @ w2, axis=-1)
+            hi = lo + tail_lsm.shape[1]
+            in_tail = (y >= lo) & (y < hi)
+            cluster_lp = head_lsm[:, shortlist + i]
+            rel = jnp.clip(y - lo, 0, tail_lsm.shape[1] - 1)
+            tail_val = cluster_lp + jnp.take_along_axis(
+                tail_lsm, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(in_tail, tail_val, out)
+        loss = -jnp.mean(out)
+        return out, loss
+
+    out, loss = apply("adaptive_log_softmax_with_loss", f, input, label,
+                      *extras)
+    return out, loss
+
+
+for _name in ("affine_grid", "grid_sample", "max_unpool2d", "rrelu",
+              "temporal_shift", "soft_margin_loss", "multi_margin_loss",
+              "npair_loss", "poisson_nll_loss", "gaussian_nll_loss",
+              "margin_cross_entropy", "ctc_loss", "rnnt_loss",
+              "adaptive_log_softmax_with_loss", "max_pool2d_with_index"):
+    register_op(_name, globals()[_name])

@@ -109,7 +109,7 @@ class TestUtils:
 
     def test_download_gated(self):
         with pytest.raises(RuntimeError, match="zero-egress"):
-            paddle.utils.download("http://example.com/x")
+            paddle.utils.download.get_path_from_url("http://example.com/x")
 
 
 class TestHub:

@@ -1,0 +1,338 @@
+"""Tests for extended nn ops/layers: grid sampling, unpooling, CTC/RNN-T and
+margin losses, beam-search decoding. Torch (CPU) is the numeric reference
+where the reference framework's semantics match it (SURVEY.md §4 pattern)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestGridSampling:
+    def test_affine_grid_matches_torch(self):
+        theta = (np.random.randn(2, 2, 3) * 0.2 +
+                 np.array([[1, 0, 0], [0, 1, 0]])).astype("float32")
+        ref = TF.affine_grid(torch.tensor(theta), (2, 3, 5, 7),
+                             align_corners=True).numpy()
+        ours = F.affine_grid(paddle.to_tensor(theta), (2, 3, 5, 7),
+                             align_corners=True).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pm", ["zeros", "border", "reflection"])
+    def test_grid_sample_matches_torch(self, mode, pm):
+        x = np.random.randn(2, 3, 5, 6).astype("float32")
+        theta = (np.random.randn(2, 2, 3) * 0.3 +
+                 np.array([[1, 0, 0], [0, 1, 0]])).astype("float32")
+        grid = TF.affine_grid(torch.tensor(theta), (2, 3, 7, 8),
+                              align_corners=True)
+        ref = TF.grid_sample(torch.tensor(x), grid, mode=mode,
+                             padding_mode=pm, align_corners=True).numpy()
+        ours = F.grid_sample(paddle.to_tensor(x),
+                             paddle.to_tensor(grid.numpy()), mode=mode,
+                             padding_mode=pm, align_corners=True).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_grid_sample_grad_flows(self):
+        x = paddle.to_tensor(np.random.randn(1, 2, 4, 4).astype("float32"),
+                             stop_gradient=False)
+        theta = paddle.to_tensor(
+            np.array([[[1, 0, 0], [0, 1, 0]]], "float32"), stop_gradient=False)
+        grid = F.affine_grid(theta, (1, 2, 4, 4))
+        F.grid_sample(x, grid).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.isfinite(theta.grad.numpy()).all()
+
+
+class TestUnpool:
+    def test_pool_mask_matches_torch(self):
+        x = np.random.randn(2, 3, 8, 8).astype("float32")
+        ref_o, ref_m = TF.max_pool2d(torch.tensor(x), 2, 2,
+                                     return_indices=True)
+        o, m = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+        np.testing.assert_allclose(o.numpy(), ref_o.numpy())
+        np.testing.assert_array_equal(m.numpy(), ref_m.numpy())
+
+    def test_unpool_roundtrip(self):
+        x = np.random.randn(2, 3, 8, 8).astype("float32")
+        o, m = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+        up = F.max_unpool2d(o, m, 2, 2)
+        ref = TF.max_unpool2d(*TF.max_pool2d(torch.tensor(x), 2, 2,
+                                             return_indices=True), 2, 2)
+        np.testing.assert_allclose(up.numpy(), ref.numpy())
+
+    def test_unpool_layers(self):
+        x = paddle.to_tensor(np.random.randn(1, 2, 8, 8).astype("float32"))
+        o, m = F.max_pool2d(x, 2, 2, return_mask=True)
+        assert nn.MaxUnPool2D(2, 2)(o, m).shape == [1, 2, 8, 8]
+
+
+class TestLossExt:
+    def test_soft_margin_matches_torch(self):
+        a = np.random.randn(5, 7).astype("float32")
+        y = np.random.choice([-1.0, 1.0], (5, 7)).astype("float32")
+        ref = float(TF.soft_margin_loss(torch.tensor(a), torch.tensor(y)))
+        ours = float(F.soft_margin_loss(paddle.to_tensor(a),
+                                        paddle.to_tensor(y)))
+        assert abs(ref - ours) < 1e-5
+
+    def test_multi_margin_matches_torch(self):
+        a = np.random.randn(5, 7).astype("float32")
+        y = np.random.randint(0, 7, (5,))
+        ref = float(TF.multi_margin_loss(torch.tensor(a), torch.tensor(y)))
+        ours = float(F.multi_margin_loss(paddle.to_tensor(a),
+                                         paddle.to_tensor(y)))
+        assert abs(ref - ours) < 1e-5
+
+    def test_poisson_gaussian_nll_match_torch(self):
+        mu = (np.random.rand(4, 3) + 0.1).astype("float32")
+        y = np.random.rand(4, 3).astype("float32")
+        var = (np.random.rand(4, 3) + 0.1).astype("float32")
+        assert abs(float(TF.poisson_nll_loss(torch.tensor(mu), torch.tensor(y)))
+                   - float(F.poisson_nll_loss(paddle.to_tensor(mu),
+                                              paddle.to_tensor(y)))) < 1e-5
+        assert abs(float(TF.gaussian_nll_loss(torch.tensor(mu),
+                                              torch.tensor(y),
+                                              torch.tensor(var)))
+                   - float(F.gaussian_nll_loss(paddle.to_tensor(mu),
+                                               paddle.to_tensor(y),
+                                               paddle.to_tensor(var)))) < 1e-5
+
+    def test_npair_loss_finite_and_grad(self):
+        a = paddle.to_tensor(np.random.randn(6, 8).astype("float32"),
+                             stop_gradient=False)
+        p = paddle.to_tensor(np.random.randn(6, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 3, (6,)))
+        loss = F.npair_loss(a, p, y)
+        loss.backward()
+        assert np.isfinite(float(loss)) and np.isfinite(a.grad.numpy()).all()
+
+    def test_margin_cross_entropy_reduces_to_ce(self):
+        # margins (1, 0, 0): plain scaled softmax cross-entropy on cosines
+        z = np.random.randn(4, 10).astype("float32")
+        z = z / np.linalg.norm(z, axis=1, keepdims=True)
+        y = np.random.randint(0, 10, (4,))
+        ours = float(F.margin_cross_entropy(paddle.to_tensor(z),
+                                            paddle.to_tensor(y), margin1=1.0,
+                                            margin2=0.0, margin3=0.0,
+                                            scale=10.0))
+        ref = float(TF.cross_entropy(torch.tensor(z * 10.0),
+                                     torch.tensor(y)))
+        assert abs(ours - ref) < 1e-4
+
+
+class TestCTC:
+    def test_ctc_matches_torch(self):
+        t_max, b, c, l = 12, 3, 6, 4
+        logits = np.random.randn(t_max, b, c).astype("float32")
+        labels = np.random.randint(1, c, (b, l)).astype("int32")
+        ilen = np.array([12, 10, 8], "int32")
+        llen = np.array([4, 3, 2], "int32")
+        for reduction in ("none", "sum"):
+            ref = TF.ctc_loss(torch.tensor(logits).log_softmax(-1),
+                              torch.tensor(labels.astype("int64")),
+                              torch.tensor(ilen.astype("int64")),
+                              torch.tensor(llen.astype("int64")),
+                              blank=0, reduction=reduction)
+            ours = F.ctc_loss(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels),
+                              paddle.to_tensor(ilen), paddle.to_tensor(llen),
+                              blank=0, reduction=reduction)
+            np.testing.assert_allclose(np.asarray(ours.numpy()).reshape(-1),
+                                       ref.numpy().reshape(-1), atol=1e-4)
+
+    def test_ctc_mean_divides_by_label_len(self):
+        t_max, b, c, l = 12, 3, 6, 4
+        logits = np.random.randn(t_max, b, c).astype("float32")
+        labels = np.random.randint(1, c, (b, l)).astype("int32")
+        ilen = np.array([12, 10, 8], "int32")
+        llen = np.array([4, 3, 2], "int32")
+        none_v = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                            paddle.to_tensor(ilen), paddle.to_tensor(llen),
+                            reduction="none").numpy()
+        mean_v = float(F.ctc_loss(paddle.to_tensor(logits),
+                                  paddle.to_tensor(labels),
+                                  paddle.to_tensor(ilen),
+                                  paddle.to_tensor(llen), reduction="mean"))
+        assert abs(mean_v - float((none_v / llen).mean())) < 1e-5
+
+    def test_ctc_grad_flows(self):
+        logits = paddle.to_tensor(
+            np.random.randn(8, 2, 5).astype("float32"), stop_gradient=False)
+        loss = F.ctc_loss(logits,
+                          paddle.to_tensor(np.array([[1, 2], [3, 4]], "int32")),
+                          paddle.to_tensor(np.array([8, 8], "int32")),
+                          paddle.to_tensor(np.array([2, 2], "int32")))
+        loss.backward()
+        assert np.isfinite(logits.grad.numpy()).all()
+
+
+class TestRNNT:
+    def test_rnnt_matches_bruteforce(self):
+        import itertools
+        from scipy.special import log_softmax, logsumexp
+        t_max, u_max, c, blank = 3, 2, 4, 0
+        logits = np.random.randn(1, t_max, u_max + 1, c).astype("float32")
+        labels = np.array([[2, 3]], "int32")
+        lp = log_softmax(logits[0], axis=-1)
+        total = []
+        for perm in set(itertools.permutations(["B"] * t_max + ["E"] * u_max)):
+            t = u = 0
+            s = 0.0
+            ok = True
+            for mv in perm:
+                if t >= t_max:
+                    ok = False
+                    break
+                if mv == "B":
+                    s += lp[t, u, blank]
+                    t += 1
+                else:
+                    if u >= u_max:
+                        ok = False
+                        break
+                    s += lp[t, u, labels[0, u]]
+                    u += 1
+            if ok and t == t_max and u == u_max:
+                total.append(s)
+        ref = -logsumexp(total)
+        ours = float(F.rnnt_loss(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(np.array([t_max], "int32")),
+            paddle.to_tensor(np.array([u_max], "int32")),
+            blank=blank, reduction="none"))
+        assert abs(ref - ours) < 1e-4
+
+    def test_rnnt_grad_flows(self):
+        logits = paddle.to_tensor(
+            np.random.randn(2, 4, 3, 5).astype("float32"),
+            stop_gradient=False)
+        loss = F.rnnt_loss(logits,
+                           paddle.to_tensor(np.array([[1, 2], [3, 4]], "int32")),
+                           paddle.to_tensor(np.array([4, 3], "int32")),
+                           paddle.to_tensor(np.array([2, 1], "int32")))
+        loss.backward()
+        assert np.isfinite(logits.grad.numpy()).all()
+
+
+class TestLayersExt:
+    def test_unflatten_pairwise_bilinear(self):
+        x = paddle.to_tensor(np.random.randn(2, 12).astype("float32"))
+        assert nn.Unflatten(1, (3, 4))(x).shape == [2, 3, 4]
+        d = nn.PairwiseDistance()(
+            paddle.to_tensor(np.ones((2, 3), "float32")),
+            paddle.to_tensor(np.zeros((2, 3), "float32")))
+        np.testing.assert_allclose(d.numpy(), np.sqrt(3 * (1 + 1e-6) ** 2),
+                                   rtol=1e-4)
+        out = nn.Bilinear(3, 4, 5)(
+            paddle.to_tensor(np.random.randn(2, 3).astype("float32")),
+            paddle.to_tensor(np.random.randn(2, 4).astype("float32")))
+        assert out.shape == [2, 5]
+
+    def test_rrelu_modes(self):
+        x = paddle.to_tensor(np.array([-4.0, 4.0], "float32"))
+        layer = nn.RReLU(0.25, 0.25)
+        layer.eval()
+        np.testing.assert_allclose(layer(x).numpy(), [-1.0, 4.0])
+        layer.train()
+        out = layer(x).numpy()
+        assert out[1] == 4.0 and -4.0 * 0.25 - 1e-6 <= out[0] <= 0.0
+
+    def test_feature_alpha_dropout_stats(self):
+        fa = nn.FeatureAlphaDropout(0.3)
+        fa.train()
+        x = paddle.to_tensor(np.random.randn(8, 16, 4, 4).astype("float32"))
+        out = fa(x)
+        assert out.shape == x.shape
+        fa.eval()
+        np.testing.assert_allclose(fa(x).numpy(), x.numpy())
+
+    def test_temporal_shift(self):
+        x = np.random.randn(4, 8, 2, 2).astype("float32")
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        v = x.reshape(2, 2, 8, 2, 2)
+        # first quarter channels shifted backward: t takes t+1
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 0, :2],
+                                   v[:, 1, :2])
+        # second quarter shifted forward: t takes t-1
+        np.testing.assert_allclose(out.reshape(2, 2, 8, 2, 2)[:, 1, 2:4],
+                                   v[:, 0, 2:4])
+
+    def test_adaptive_log_softmax(self):
+        als = nn.AdaptiveLogSoftmaxWithLoss(16, 20, [5, 10])
+        x = paddle.to_tensor(np.random.randn(7, 16).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 20, (7,)))
+        out, loss = als(x, y)
+        assert out.shape == [7]
+        assert np.isfinite(float(loss))
+        assert (np.asarray(out.numpy()) < 0).all()  # log-probs
+
+
+class TestBeamSearch:
+    def test_greedy_path_recovered(self):
+        class ToyCell(nn.Layer):
+            """Prefers token 3 for two steps, then the end token."""
+
+            def forward(self, inputs, states):
+                import jax.numpy as jnp
+                from paddle_tpu.core.tensor import Tensor
+                h = states._data + 1.0
+                logits = jnp.zeros((h.shape[0], 5)).at[:, 3].set(5.0)
+                logits = jnp.where(h.sum(-1, keepdims=True) > 4.5,
+                                   jnp.asarray([10.0, 0, 0, 0, 0]), logits)
+                return Tensor(logits), Tensor(h)
+
+        emb = nn.Embedding(5, 4)
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=1, end_token=0,
+                                   beam_size=2, embedding_fn=emb)
+        ids, _ = nn.dynamic_decode(dec, inits=paddle.zeros([3, 4]),
+                                   max_step_num=8)
+        arr = np.asarray(ids.numpy())
+        assert arr.shape[0] == 3 and arr.shape[2] == 2
+        # best beam: token 3 emitted first step(s), end token closes it
+        assert arr[0, 0, 0] == 3
+
+    def test_tile_beam_merge(self):
+        x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+        t = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 4)
+        assert t.shape == [8, 3]
+        np.testing.assert_allclose(t.numpy()[0], t.numpy()[3])
+
+
+class TestReviewFixes2:
+    def test_soft_margin_stable(self):
+        out = float(F.soft_margin_loss(paddle.to_tensor([-100.0]),
+                                       paddle.to_tensor([1.0])))
+        assert np.isfinite(out) and abs(out - 100.0) < 1e-3
+
+    def test_bilinear_no_bias(self):
+        out = nn.Bilinear(3, 4, 2, bias_attr=False)(
+            paddle.to_tensor(np.random.randn(2, 3).astype("float32")),
+            paddle.to_tensor(np.random.randn(2, 4).astype("float32")))
+        assert out.shape == [2, 2]
+
+    def test_max_pool1d_return_mask(self):
+        x = np.random.randn(2, 3, 10).astype("float32")
+        ref_o, ref_m = TF.max_pool1d(torch.tensor(x), 2, 2,
+                                     return_indices=True)
+        o, m = F.max_pool1d(paddle.to_tensor(x), 2, 2, return_mask=True)
+        np.testing.assert_allclose(o.numpy(), ref_o.numpy())
+        np.testing.assert_array_equal(m.numpy(), ref_m.numpy())
+
+    def test_rnnt_fastemit_raises(self):
+        with pytest.raises(NotImplementedError):
+            F.rnnt_loss(paddle.zeros([1, 2, 2, 3]),
+                        paddle.to_tensor(np.array([[1]], "int32")),
+                        paddle.to_tensor(np.array([2], "int32")),
+                        paddle.to_tensor(np.array([1], "int32")),
+                        fastemit_lambda=0.01)
+
+    def test_pool_mask_string_padding_raises(self):
+        with pytest.raises(NotImplementedError):
+            F.max_pool2d(paddle.zeros([1, 1, 4, 4]), 2, padding="SAME",
+                         return_mask=True)
