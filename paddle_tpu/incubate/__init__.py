@@ -2,9 +2,15 @@
 
 from . import moe  # noqa: F401
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import multiprocessing  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import xpu  # noqa: F401
 from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
 from ..geometric import segment_sum, segment_mean, segment_max, segment_min  # noqa: F401
 from .moe import MoELayer  # noqa: F401
+from .nn_functional import softmax_mask_fuse  # noqa: F401
 
 
 class distributed:  # namespace parity: paddle.incubate.distributed.models.moe

@@ -1,0 +1,88 @@
+"""``paddle.incubate.asp`` — automatic structured (2:4) sparsity.
+
+Parity: python/paddle/incubate/asp/. The reference masks weights to the
+n:m sparse pattern the GPU sparse tensor cores consume; TPUs have no sparse
+MXU mode, so the capability kept here is the PRUNING algebra (mask
+computation, masked training via post-step re-masking) — useful for model
+compression even without a sparse speedup (documented divergence).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density"]
+
+_excluded: set = set()
+_masks: Dict[int, object] = {}
+
+
+def set_excluded_layers(param_names: List[str], main_program=None) -> None:
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None) -> None:
+    _excluded.clear()
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _nm_mask(arr: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-magnitude entries of every m-block of the last
+    axis."""
+    flat = arr.reshape(-1, m)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=1)
+    return mask.reshape(arr.shape)
+
+
+def _prunable(name: str, shape) -> bool:
+    if name in _excluded:
+        return False
+    return len(shape) == 2 and shape[-1] % 4 == 0
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Apply an n:m magnitude mask to every prunable weight in ``model``."""
+    from ..core.tensor import Tensor
+
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, tuple(p._data.shape)):
+            continue
+        mask = _nm_mask(np.asarray(p._data), n, m)
+        p._set_data(p._data * jnp.asarray(mask, p._data.dtype))
+        if with_mask:
+            t = Tensor(jnp.asarray(mask), stop_gradient=True,
+                       name=f"{name}_asp_mask")
+            masks[name] = t
+            _masks[id(p)] = t
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap ``optimizer.step`` to re-apply the sparsity masks after every
+    update (the reference's OptimizerWithSparsityGuarantee)."""
+    inner_step = optimizer.step
+
+    def masked_step():
+        inner_step()
+        for p in optimizer._param_groups:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._set_data(p._data * mask._data.astype(p._data.dtype))
+        refresh = getattr(optimizer, "_refresh_derived_state", None)
+        if refresh is not None:
+            refresh()
+
+    optimizer.step = masked_step
+    return optimizer

@@ -1,0 +1,31 @@
+"""``paddle.incubate.autograd`` — functional transforms (jacobian/hessian/
+jvp/vjp, prim toggles).
+
+Parity: python/paddle/incubate/autograd/. The stable entry points forward to
+``paddle.autograd``'s functional API (itself jax transforms); the prim
+program toggles are no-ops because jaxpr IS the primitive IR here.
+"""
+
+from __future__ import annotations
+
+from ..autograd import hessian, jacobian  # noqa: F401
+from ..autograd import jvp, vjp  # noqa: F401
+
+__all__ = ["jacobian", "hessian", "jvp", "vjp", "enable_prim",
+           "disable_prim", "prim_enabled"]
+
+_prim = True  # everything already lowers to primitives (jaxpr)
+
+
+def enable_prim() -> None:
+    global _prim
+    _prim = True
+
+
+def disable_prim() -> None:
+    global _prim
+    _prim = False
+
+
+def prim_enabled() -> bool:
+    return _prim

@@ -1,0 +1,39 @@
+"""``paddle.incubate.multiprocessing`` — multiprocessing with tensor-aware
+pickling.
+
+Parity: python/paddle/incubate/multiprocessing/. The reference installs
+CUDA-IPC / shared-memory reducers; device buffers cannot cross process
+boundaries on TPU (PJRT owns them), so tensors are serialized through host
+numpy — correct, if not zero-copy (documented divergence). DataLoader
+workers use the same strategy.
+"""
+
+from __future__ import annotations
+
+import copyreg
+import multiprocessing
+from multiprocessing import *  # noqa: F401,F403
+
+
+def _rebuild_tensor(arr, stop_gradient):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+
+
+def _reduce_tensor(t):
+    import numpy as np
+    return _rebuild_tensor, (np.asarray(t._data), t.stop_gradient)
+
+
+def _install_reducers() -> None:
+    from ..core.tensor import Tensor
+    copyreg.pickle(Tensor, _reduce_tensor)
+
+
+_install_reducers()
+
+
+def get_context(method=None):
+    return multiprocessing.get_context(method)

@@ -17,8 +17,11 @@ from typing import Optional
 
 from .. import nn
 from ..nn import functional as F
+from . import nn_functional as functional  # noqa: F401  (incubate.nn.functional)
+from .nn_functional import memory_efficient_attention  # noqa: F401
 
-__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedLinear"]
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedLinear",
+           "functional", "memory_efficient_attention"]
 
 
 class FusedMultiHeadAttention(nn.Layer):

@@ -1,0 +1,289 @@
+"""``paddle.incubate.nn.functional`` — fused-op surface.
+
+Parity: python/paddle/incubate/nn/functional/ (fused_rms_norm,
+fused_layer_norm, fused_rotary_position_embedding, swiglu, fused_dropout_add,
+fused_linear*, memory-efficient attention). The reference backs these with
+hand-written CUDA kernels (paddle/phi/kernels/fusion/); on TPU the same
+fusion happens in XLA — each function below is the algebra, written so the
+compiler fuses it into the surrounding matmuls — with flash attention
+(Pallas) behind the attention entries.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn import functional as F
+from ..ops._helpers import ensure_tensor
+from ..ops.linalg import _precision
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0, name=None):
+    """RMSNorm with optional pre-norm bias/residual add. Returns
+    (out, residual_out) when ``residual`` is given, else out."""
+    x = ensure_tensor(x)
+    extras, has = [], {}
+    for key, t in (("bias", bias), ("residual", residual),
+                   ("w", norm_weight), ("b", norm_bias)):
+        if t is not None:
+            has[key] = len(extras)
+            extras.append(ensure_tensor(t))
+
+    def f(a, *rest):
+        h = a
+        if "bias" in has:
+            h = h + rest[has["bias"]]
+        if "residual" in has:
+            h = h + rest[has["residual"]]
+        res_out = h
+        ms = jnp.mean(jnp.square(h.astype(jnp.float32)), axis=-1,
+                      keepdims=True)
+        out = (h.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon))
+        if "w" in has:
+            out = out * rest[has["w"]].astype(jnp.float32)
+        if "b" in has:
+            out = out + rest[has["b"]].astype(jnp.float32)
+        out = out.astype(a.dtype)
+        return (out, res_out) if "residual" in has else out
+
+    out = apply("fused_rms_norm", f, x, *extras)
+    return out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None,
+                     quant_scale=-1, name=None):
+    x = ensure_tensor(x)
+    extras, has = [], {}
+    for key, t in (("bias", bias), ("residual", residual),
+                   ("w", norm_weight), ("b", norm_bias)):
+        if t is not None:
+            has[key] = len(extras)
+            extras.append(ensure_tensor(t))
+
+    def f(a, *rest):
+        h = a
+        if "bias" in has:
+            h = h + rest[has["bias"]]
+        if "residual" in has:
+            h = h + rest[has["residual"]]
+        res_out = h
+        h32 = h.astype(jnp.float32)
+        mean = jnp.mean(h32, axis=-1, keepdims=True)
+        var = jnp.var(h32, axis=-1, keepdims=True)
+        out = (h32 - mean) * jax.lax.rsqrt(var + epsilon)
+        if "w" in has:
+            out = out * rest[has["w"]].astype(jnp.float32)
+        if "b" in has:
+            out = out + rest[has["b"]].astype(jnp.float32)
+        out = out.astype(a.dtype)
+        return (out, res_out) if "residual" in has else out
+
+    return apply("fused_layer_norm", f, x, *extras)
+
+
+def _apply_rope(a, cos, sin, neox):
+    """a: (B, S, H, D); cos/sin: (S, D) or broadcastable."""
+    if neox:  # rotate halves: (x1, x2) -> (x1 cos - x2 sin, x2 cos + x1 sin)
+        d = a.shape[-1] // 2
+        x1, x2 = a[..., :d], a[..., d:]
+        rot = jnp.concatenate([-x2, x1], axis=-1)
+    else:  # GPT-J interleaved pairs
+        x1 = a[..., 0::2]
+        x2 = a[..., 1::2]
+        rot = jnp.stack([-x2, x1], axis=-1).reshape(a.shape)
+    return a * cos + rot * sin
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0,
+                                    name=None):
+    """Apply RoPE to q (and k, v when given). ``sin``/``cos``: (1, S, 1, D)
+    or (S, D); generated from ``rotary_emb_base`` when omitted."""
+    q = ensure_tensor(q)
+    if time_major:  # (S, B, H, D) -> batch-major, swap back at the end
+        from ..ops.manipulation import transpose
+        perm = [1, 0, 2, 3]
+        outs = fused_rotary_position_embedding(
+            transpose(q, perm),
+            transpose(k, perm) if k is not None else None,
+            transpose(v, perm) if v is not None else None,
+            sin=sin, cos=cos, position_ids=position_ids,
+            use_neox_rotary_style=use_neox_rotary_style, time_major=False,
+            rotary_emb_base=rotary_emb_base)
+        return tuple(transpose(t, perm) if t is not None else None
+                     for t in outs)
+    b, s, h, d = (int(v_) for v_ in q._data.shape)
+    if cos is None or sin is None:
+        import numpy as np
+        inv = 1.0 / (rotary_emb_base ** (np.arange(0, d, 2,
+                                                   dtype=np.float32) / d))
+        t = np.arange(s, dtype=np.float32)
+        freqs = np.outer(t, inv)                       # (S, D/2)
+        if use_neox_rotary_style:
+            emb = np.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = np.repeat(freqs, 2, axis=-1)
+        cos = Tensor(jnp.asarray(np.cos(emb)[None, :, None, :]))
+        sin = Tensor(jnp.asarray(np.sin(emb)[None, :, None, :]))
+    cos, sin = ensure_tensor(cos), ensure_tensor(sin)
+
+    tensors = [t for t in (q, k, v) if t is not None]
+    n = len(tensors)
+
+    def f(cc, ss, *qkv):
+        if cc.ndim == 2:  # documented (S, D) form -> (1, S, 1, D)
+            cc, ss = cc[None, :, None, :], ss[None, :, None, :]
+        if position_ids is not None:
+            pid = jnp.asarray(position_ids._data
+                              if hasattr(position_ids, "_data")
+                              else position_ids)
+            cc = jnp.squeeze(cc)[pid][:, :, None, :]
+            ss = jnp.squeeze(ss)[pid][:, :, None, :]
+        outs = tuple(_apply_rope(t, cc.astype(t.dtype), ss.astype(t.dtype),
+                                 use_neox_rotary_style) for t in qkv)
+        return outs if len(outs) > 1 else outs[0]
+
+    out = apply("fused_rope", f, cos, sin, *tensors)
+    outs = list(out) if isinstance(out, tuple) else [out]
+    result = []
+    for t in (q, k, v):
+        result.append(outs.pop(0) if t is not None else None)
+    return tuple(result)
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y; when y is None, x is split in half on the last axis."""
+    x = ensure_tensor(x)
+    if y is None:
+        return apply("swiglu",
+                     lambda a: jax.nn.silu(a[..., :a.shape[-1] // 2]) *
+                     a[..., a.shape[-1] // 2:], x)
+    return apply("swiglu", lambda a, b: jax.nn.silu(a) * b, x,
+                 ensure_tensor(y))
+
+
+def fused_dropout_add(x, y, p=0.5, training=True,
+                      mode="upscale_in_train", name=None):
+    """dropout(x) + y in one fused region."""
+    dropped = F.dropout(x, p=p, training=training, mode=mode)
+    return dropped + y
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, name=None):
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training)
+    h = h + residual
+    return F.layer_norm(h, h.shape[-1:], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def f(a, w, *b):
+        ww = w.T if transpose_weight else w
+        out = jnp.matmul(a, ww, precision=_precision())
+        return out + b[0] if b else out
+
+    if bias is not None:
+        return apply("fused_linear", f, x, weight, ensure_tensor(bias))
+    return apply("fused_linear", f, x, weight)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """matmul + bias + activation, fused by XLA into one kernel."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "none": lambda v: v, "": lambda v: v}[activation]
+
+    def f(a, w, *b):
+        if trans_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if trans_y:
+            w = jnp.swapaxes(w, -1, -2)
+        out = jnp.matmul(a, w, precision=_precision())
+        if b:
+            out = out + b[0]
+        return act(out)
+
+    if bias is not None:
+        return apply("fused_linear_activation", f, x, y, ensure_tensor(bias))
+    return apply("fused_linear_activation", f, x, y)
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True, name=None):
+    """Memory-efficient attention (reference: cutlass-backed kernel); here
+    the SDPA layer, which routes to the Pallas flash kernel when eligible.
+    SDPA applies 1/sqrt(d) internally; a custom ``scale`` is folded into the
+    query so the net scaling equals ``scale``."""
+    if scale is not None:
+        d = int(query.shape[-1])
+        query = query * (float(scale) * (d ** 0.5))
+    return F.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias,
+        dropout_p=p if training else 0.0, is_causal=False)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0, name=None):
+    """Varlen attention: per-sequence lengths become an additive mask over
+    the padded batch (static shapes — the TPU-friendly varlen form).
+
+    query/key/value: (B, H, S, D); seq_lens/kv_seq_lens: (B,) or (B, 1).
+    """
+    query, key, value = (ensure_tensor(query), ensure_tensor(key),
+                         ensure_tensor(value))
+    seq_lens, kv_seq_lens = ensure_tensor(seq_lens), ensure_tensor(kv_seq_lens)
+    extras = [ensure_tensor(mask)] if mask is not None else []
+
+    def f(q, k, v, sl, kvl, *mk):
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+        sc = scale if scale is not None else 1.0 / (d ** 0.5)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+        kvalid = jnp.arange(sk)[None, :] < kvl.reshape(-1, 1)
+        logits = jnp.where(kvalid[:, None, None, :], logits, -1e30)
+        if causal:
+            cm = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+            logits = jnp.where(cm[None, None], logits, -1e30)
+        if mk:
+            logits = logits + mk[0]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+        qvalid = jnp.arange(sq)[None, :] < sl.reshape(-1, 1)
+        return out * qvalid[:, None, :, None].astype(q.dtype)
+
+    return apply("varlen_mea", f, query, key, value, seq_lens, kv_seq_lens,
+                 *extras)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference: incubate.softmax_mask_fuse)."""
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    return apply("softmax_mask_fuse",
+                 lambda a, m: jax.nn.softmax(
+                     a.astype(jnp.float32) + m.astype(jnp.float32),
+                     axis=-1).astype(a.dtype), x, mask)
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,
+                     name=None):
+    a = ensure_tensor(seq_lens_encoder)
+    b = ensure_tensor(seq_lens_decoder)
+    return apply("blha_get_max_len",
+                 lambda x_, y_: (jnp.max(x_), jnp.max(y_)), a, b)

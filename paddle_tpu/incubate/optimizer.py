@@ -1,0 +1,142 @@
+"""``paddle.incubate.optimizer`` — LookAhead and ModelAverage wrappers.
+
+Parity: python/paddle/incubate/optimizer/{lookahead,modelaverage}.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, register_state_tensor
+from ..optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k steps forward, 1 step back (Zhang et al. 2019): every ``k`` inner
+    steps the slow weights move ``alpha`` toward the fast weights and the
+    fast weights reset onto them."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha=0.5, k=5, name=None):
+        # full base init so inherited plumbing (_refresh_derived_state, amp
+        # cast hooks, set_lr) finds its attributes; params are shared with
+        # the inner optimizer
+        super().__init__(inner_optimizer._learning_rate,
+                         inner_optimizer._param_groups)
+        self.inner_optimizer = inner_optimizer
+        self.alpha, self.k = float(alpha), int(k)
+        self._slow: dict[int, Tensor] = {}
+        self._la_step = 0
+        for p in inner_optimizer._param_groups:
+            t = Tensor(p._data.astype(jnp.float32), stop_gradient=True,
+                       name=f"{p.name}_slow")
+            t.persistable = True
+            register_state_tensor(t)
+            self._slow[id(p)] = t
+
+    # delegate the Optimizer surface to the inner optimizer
+    @property
+    def _param_groups(self):
+        return self.inner_optimizer._param_groups
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._la_step += 1
+        if self._la_step % self.k == 0:
+            for p in self.inner_optimizer._param_groups:
+                slow = self._slow[id(p)]
+                new_slow = slow._data + self.alpha * (
+                    p._data.astype(jnp.float32) - slow._data)
+                slow._set_data(new_slow)
+                p._set_data(new_slow.astype(p._data.dtype))
+            self.inner_optimizer._refresh_derived_state()
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        state = self.inner_optimizer.state_dict()
+        state["lookahead_step"] = self._la_step
+        for p in self.inner_optimizer._param_groups:
+            state[f"{p.name}_slow"] = self._slow[id(p)]
+        return state
+
+    def set_state_dict(self, state):
+        self._la_step = int(state.pop("lookahead_step", 0))
+        for p in self.inner_optimizer._param_groups:
+            key = f"{p.name}_slow"
+            if key in state:
+                src = state.pop(key)
+                self._slow[id(p)]._set_data(
+                    src._data if isinstance(src, Tensor) else jnp.asarray(src))
+        self.inner_optimizer.set_state_dict(state)
+
+
+class ModelAverage(Optimizer):
+    """Maintains a running average of parameters; ``apply()`` swaps it in
+    for evaluation, ``restore()`` swaps the live weights back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(0.0, parameters)
+        self.avg_rate = float(average_window_rate)
+        self.min_w, self.max_w = int(min_average_window), int(max_average_window)
+        self._sum: dict[int, Tensor] = {}
+        self._cnt = 0
+        self._backup: dict[int, Tensor] = {}
+        for p in self._param_groups:
+            t = Tensor(jnp.zeros_like(p._data, jnp.float32),
+                       stop_gradient=True, name=f"{p.name}_avg_sum")
+            t.persistable = True
+            register_state_tensor(t)
+            self._sum[id(p)] = t
+
+    def step(self):
+        self._cnt += 1
+        window = max(self.min_w,
+                     min(self.max_w, int(self._cnt * self.avg_rate) or 1))
+        decay = max(0.0, 1.0 - 1.0 / window)
+        for p in self._param_groups:
+            s = self._sum[id(p)]
+            s._set_data(decay * s._data +
+                        (1 - decay) * p._data.astype(jnp.float32))
+
+    def minimize(self, loss, *a, **k):
+        self.step()
+        return None, None
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context manager, as in the reference)."""
+        for p in self._param_groups:
+            self._backup[id(p)] = Tensor(p._data, stop_gradient=True)
+            p._set_data(self._sum[id(p)]._data.astype(p._data.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self._restore_now()
+
+    def restore(self, executor=None):
+        self._restore_now()
+
+    def _restore_now(self):
+        for p in self._param_groups:
+            bk = self._backup.pop(id(p), None)
+            if bk is not None:
+                p._set_data(bk._data)

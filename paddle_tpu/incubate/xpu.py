@@ -1,0 +1,48 @@
+"""``paddle.incubate.xpu`` — Kunlun-XPU-specific fused blocks.
+
+Parity: python/paddle/incubate/xpu/resnet_block.py. The XPU fused ResNet
+block is hardware-specific; on TPU the equivalent capability is the plain
+layer composition (XLA fuses it), exposed under the same name so reference
+scripts import cleanly.
+"""
+
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["resnet_basic_block", "ResNetBasicBlock"]
+
+
+class ResNetBasicBlock(nn.Layer):
+    """conv-bn-relu ×2 + residual, the block the XPU kernel fuses."""
+
+    def __init__(self, num_channels1, num_filter1, filter1_size, stride1=1,
+                 num_channels2=None, num_filter2=None, filter2_size=None,
+                 stride2=1, act="relu", has_shortcut=False, **kwargs):
+        super().__init__()
+        num_channels2 = num_channels2 or num_filter1
+        num_filter2 = num_filter2 or num_filter1
+        filter2_size = filter2_size or filter1_size
+        pad1, pad2 = filter1_size // 2, filter2_size // 2
+        self.conv1 = nn.Conv2D(num_channels1, num_filter1, filter1_size,
+                               stride=stride1, padding=pad1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(num_filter1)
+        self.conv2 = nn.Conv2D(num_channels2, num_filter2, filter2_size,
+                               stride=stride2, padding=pad2, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(num_filter2)
+        self.has_shortcut = has_shortcut
+        if has_shortcut:
+            self.conv3 = nn.Conv2D(num_channels1, num_filter2, 1,
+                                   stride=stride1 * stride2, bias_attr=False)
+            self.bn3 = nn.BatchNorm2D(num_filter2)
+        self.act = getattr(nn.functional, act)
+
+    def forward(self, x):
+        h = self.act(self.bn1(self.conv1(x)))
+        h = self.bn2(self.conv2(h))
+        short = self.bn3(self.conv3(x)) if self.has_shortcut else x
+        return self.act(h + short)
+
+
+def resnet_basic_block(*args, **kwargs):
+    return ResNetBasicBlock(*args, **kwargs)

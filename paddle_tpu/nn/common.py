@@ -336,13 +336,17 @@ class Bilinear(Layer):
         import jax.numpy as jnp
         from ..core.tensor import apply
 
+        from ..ops.linalg import _precision
+
         if self.bias is None:
             def f(a, b, w):
-                return jnp.einsum("bi,oij,bj->bo", a, w, b)
+                return jnp.einsum("bi,oij,bj->bo", a, w, b,
+                                  precision=_precision())
             return apply("bilinear", f, x1, x2, self.weight)
 
         def f(a, b, w, bias):
-            return jnp.einsum("bi,oij,bj->bo", a, w, b) + bias
+            return jnp.einsum("bi,oij,bj->bo", a, w, b,
+                              precision=_precision()) + bias
 
         return apply("bilinear", f, x1, x2, self.weight, self.bias)
 
@@ -367,6 +371,8 @@ class MaxUnPool1D(Layer):
         k, s, p, osz = self.args
         x4 = unsqueeze(x, 2)
         i4 = unsqueeze(indices, 2)
+        if osz is not None:
+            osz = (1, int(osz[-1]))  # length is the last entry of any form
         out = F.max_unpool2d(x4, i4, (1, k), (1, s or k), (0, p),
                              output_size=osz)
         return squeeze(out, 2)

@@ -235,5 +235,10 @@ def dynamic_decode(decoder: Decoder, inits=None, max_step_num: Optional[int] = N
             final_outputs = to_batch_major(final_outputs)
 
     if return_length:
+        if seq_len is None:
+            raise ValueError(
+                "return_length=True needs the decoder's final state to carry "
+                "a 'lengths' field (BeamSearchDecoder does); this decoder's "
+                "states do not track sequence lengths")
         return final_outputs, final_states, Tensor(seq_len)
     return final_outputs, final_states

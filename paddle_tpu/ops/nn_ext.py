@@ -37,7 +37,9 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
                                 dtype=th.dtype)
         ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
         base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # (H, W, 3)
-        return jnp.einsum("hwk,njk->nhwj", base, th)
+        # full precision: bf16 grid coordinates would shift every sampled
+        # pixel; this contraction is tiny so there is no MXU win to trade
+        return jnp.einsum("hwk,njk->nhwj", base, th, precision="highest")
 
     return apply("affine_grid", f, theta)
 

@@ -13,6 +13,7 @@ import math
 import weakref
 from typing import Dict, List, Optional, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -926,3 +927,171 @@ class L2Decay:
 
     def __init__(self, coeff=0.0):
         self.coeff = coeff
+
+
+class Rprop(Optimizer):
+    """Resilient backpropagation (reference: paddle.optimizer.Rprop):
+    sign-based per-element step sizes grown/shrunk by ``etas``."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_range = (float(learning_rate_range[0]),
+                          float(learning_rate_range[1]))
+        self._etas = (float(etas[0]), float(etas[1]))
+        if self._groups is not None:
+            self._materialize_state()
+
+    def _create_accumulators(self, p):
+        self._acc("prev_grad", p, dtype=jnp.float32)
+        self._acc("step_size", p,
+                  init=jnp.full_like(p._data, float(self._learning_rate)
+                                     if not isinstance(self._learning_rate,
+                                                       LRScheduler)
+                                     else self._learning_rate.last_lr,
+                                     dtype=jnp.float32))
+
+    def _update_param(self, p, g, lr_eff):
+        prev = self._acc("prev_grad", p, dtype=jnp.float32)
+        size = self._acc("step_size", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        sign = jnp.sign(g32 * prev._data)
+        eta_minus, eta_plus = self._etas
+        factor = jnp.where(sign > 0, eta_plus,
+                           jnp.where(sign < 0, eta_minus, 1.0))
+        new_size = jnp.clip(size._data * factor, self._lr_range[0],
+                            self._lr_range[1])
+        # on sign change the gradient is zeroed (no step, no state carry)
+        g_eff = jnp.where(sign < 0, 0.0, g32)
+        size._set_data(new_size)
+        prev._set_data(g_eff)
+        p._set_data((p._data.astype(jnp.float32) -
+                     jnp.sign(g_eff) * new_size).astype(p._data.dtype))
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: paddle.optimizer.ASGD): steps along the
+    moving sum of the last ``batch_num`` gradients."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._batch_num = max(1, int(batch_num))
+        if self._groups is not None:
+            self._materialize_state()
+
+    def _create_accumulators(self, p):
+        self._acc("d", p, dtype=jnp.float32)
+        if self._batch_num > 1:
+            self._acc("grad_hist", p,
+                      init=jnp.zeros((self._batch_num,) + tuple(p._data.shape),
+                                     jnp.float32))
+
+    def _update_param(self, p, g, lr_eff):
+        d = self._acc("d", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        if self._batch_num > 1:
+            # accumulator exists since _create_accumulators; no init arg (it
+            # would eagerly allocate a batch_num-sized dead buffer per step)
+            hist = self._accumulators["grad_hist"][id(p)]
+            slot = (self._step_t._data - 1) % self._batch_num
+            old = jax.lax.dynamic_index_in_dim(hist._data, slot, 0,
+                                               keepdims=False)
+            new_d = d._data - old + g32
+            hist._set_data(jax.lax.dynamic_update_index_in_dim(
+                hist._data, g32, slot, 0))
+        else:
+            new_d = g32
+        d._set_data(new_d)
+        p._set_data((p._data.astype(jnp.float32) -
+                     lr_eff * new_d / self._batch_num).astype(p._data.dtype))
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (reference: paddle.optimizer.NAdam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon, self._psi = epsilon, momentum_decay
+        if self._groups is not None:
+            self._materialize_state()
+
+    def _create_accumulators(self, p):
+        self._acc("moment1", p, dtype=jnp.float32)
+        self._acc("moment2", p, dtype=jnp.float32)
+        self._acc("mu_product", p, init=jnp.ones((), jnp.float32))
+
+    def _update_param(self, p, g, lr_eff):
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        mu_prod = self._acc("mu_product", p, init=jnp.ones((), jnp.float32))
+        g32 = g.astype(jnp.float32)
+        t = self._step_t._data.astype(jnp.float32)
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        new_mu_prod = mu_prod._data * mu_t
+        new_m = self._beta1 * m._data + (1 - self._beta1) * g32
+        new_v = self._beta2 * v._data + (1 - self._beta2) * g32 * g32
+        m._set_data(new_m)
+        v._set_data(new_v)
+        mu_prod._set_data(new_mu_prod)
+        m_hat = (mu_next * new_m / (1 - new_mu_prod * mu_next) +
+                 (1 - mu_t) * g32 / (1 - new_mu_prod))
+        v_hat = new_v / (1 - self._beta2 ** t)
+        p._set_data((p._data.astype(jnp.float32) -
+                     lr_eff * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+                     ).astype(p._data.dtype))
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: paddle.optimizer.RAdam): variance
+    rectification switches between adaptive and plain-momentum updates."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        if self._groups is not None:
+            self._materialize_state()
+
+    def _create_accumulators(self, p):
+        self._acc("moment1", p, dtype=jnp.float32)
+        self._acc("moment2", p, dtype=jnp.float32)
+
+    def _update_param(self, p, g, lr_eff):
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        g32 = g.astype(jnp.float32)
+        t = self._step_t._data.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        new_m = b1 * m._data + (1 - b1) * g32
+        new_v = b2 * v._data + (1 - b2) * g32 * g32
+        m._set_data(new_m)
+        v._set_data(new_v)
+        m_hat = new_m / (1 - b1 ** t)
+        bc2 = 1 - b2 ** t
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2 ** t / bc2
+        # rectified path (rho_t > 5): variance estimate is tractable
+        r_num = (rho_t - 4.0) * (rho_t - 2.0) * rho_inf
+        r_den = (rho_inf - 4.0) * (rho_inf - 2.0) * jnp.clip(rho_t, 1e-6, None)
+        r_t = jnp.sqrt(jnp.clip(r_num / r_den, 0.0, None))
+        adaptive = (lr_eff * r_t * m_hat * jnp.sqrt(bc2) /
+                    (jnp.sqrt(new_v) + self._epsilon))
+        plain = lr_eff * m_hat
+        upd = jnp.where(rho_t > 5.0, adaptive, plain)
+        p._set_data((p._data.astype(jnp.float32) - upd).astype(p._data.dtype))
+
+
+__all__ += ["Rprop", "ASGD", "NAdam", "RAdam"]

@@ -1,0 +1,295 @@
+"""Tests for the extended optimizer set (Rprop/ASGD/NAdam/RAdam), the
+incubate optimizer wrappers (LookAhead/ModelAverage), incubate fused
+functional ops, ASP pruning, and incubate namespace fills. Torch is the
+trajectory reference for the sign/momentum-family optimizers."""
+
+import pickle
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+import paddle_tpu.nn as nn
+from paddle_tpu.core.tensor import Parameter
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+
+
+def _trajectory_diff(name, torch_cls, paddle_cls, steps=25):
+    tp = torch.nn.Parameter(torch.tensor([5.0, -3.0]))
+    topt = torch_cls([tp], lr=0.1)
+    pp = Parameter(np.array([5.0, -3.0], "float32"), name=f"tp_{name}")
+    popt = paddle_cls(learning_rate=0.1, parameters=[pp])
+    for _ in range(steps):
+        tl = (tp * tp).sum()
+        topt.zero_grad()
+        tl.backward()
+        topt.step()
+        pl = (pp * pp).sum()
+        pl.backward()
+        popt.step()
+        popt.clear_grad()
+    return np.abs(tp.detach().numpy() - np.asarray(pp.numpy())).max()
+
+
+class TestNewOptimizers:
+    def test_nadam_matches_torch(self):
+        assert _trajectory_diff("nadam", torch.optim.NAdam,
+                                paddle.optimizer.NAdam) < 5e-4
+
+    def test_radam_matches_torch(self):
+        assert _trajectory_diff("radam", torch.optim.RAdam,
+                                paddle.optimizer.RAdam) < 5e-4
+
+    def test_rprop_matches_torch(self):
+        assert _trajectory_diff("rprop", torch.optim.Rprop,
+                                paddle.optimizer.Rprop) < 5e-4
+
+    @pytest.mark.parametrize("cls_name,steps,tol", [
+        ("Rprop", 200, 0.05), ("ASGD", 200, 0.05), ("NAdam", 200, 0.05),
+        # RAdam's rectification keeps early steps conservative (torch reaches
+        # the same 1.53 at 200 steps); just assert monotone convergence
+        ("RAdam", 600, 0.05),
+    ])
+    def test_converges_on_quadratic(self, cls_name, steps, tol):
+        cls = getattr(paddle.optimizer, cls_name)
+        p = Parameter(np.array([5.0, -3.0], "float32"), name=f"q_{cls_name}")
+        opt = cls(learning_rate=0.05, parameters=[p])
+        for _ in range(steps):
+            loss = (p * p).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float((p * p).sum()) < tol
+
+    def test_asgd_batch_num_window(self):
+        # with batch_num=m, the step direction is the mean of the last m grads
+        p = Parameter(np.array([0.0], "float32"), name="asgd_m")
+        opt = paddle.optimizer.ASGD(learning_rate=1.0, batch_num=2,
+                                    parameters=[p])
+        grads = [4.0, 2.0, 6.0]
+        for gval in grads:
+            p.clear_grad()
+            (p * gval).sum().backward()
+            opt.step()
+        # steps: -4/2... window holds [4], then [4,2], then [2,6]
+        expected = -(4.0 / 2) - (6.0 / 2) - (8.0 / 2)
+        np.testing.assert_allclose(np.asarray(p.numpy()), [expected],
+                                   rtol=1e-5)
+
+    def test_state_dict_roundtrip(self):
+        p = Parameter(np.array([1.0, 2.0], "float32"), name="sd_nadam")
+        opt = paddle.optimizer.NAdam(learning_rate=0.1, parameters=[p])
+        (p * p).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        st = opt.state_dict()
+        p2 = Parameter(np.array([1.0, 2.0], "float32"), name="sd_nadam")
+        opt2 = paddle.optimizer.NAdam(learning_rate=0.1, parameters=[p2])
+        opt2.set_state_dict(st)
+        assert int(opt2._step_t._data) == 1
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_converges(self):
+        p = Parameter(np.array([5.0, -3.0], "float32"), name="la_p")
+        la = LookAhead(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=[p]), alpha=0.5, k=5)
+        for _ in range(60):
+            loss = (p * p).sum()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        assert float((p * p).sum()) < 0.05
+
+    def test_lookahead_sync_pulls_back(self):
+        p = Parameter(np.array([8.0], "float32"), name="la_sync")
+        la = LookAhead(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=[p]), alpha=0.5, k=2)
+        vals = []
+        for _ in range(2):
+            (p * p).sum().backward()
+            la.step()
+            la.clear_grad()
+            vals.append(float(p.numpy()[0]))
+        # after k=2 steps the sync averages fast toward slow (initial) weights
+        fast_only = 8.0 * 0.8 * 0.8
+        assert vals[-1] > fast_only
+
+    def test_model_average_apply_restore(self):
+        p = Parameter(np.array([2.0], "float32"), name="ma_p")
+        ma = ModelAverage(0.5, parameters=[p])
+        ma.step()
+        p._set_data(p._data * 0 + 7.0)
+        with ma.apply():
+            inside = float(p.numpy()[0])
+        assert inside != 7.0
+        assert float(p.numpy()[0]) == 7.0
+
+
+class TestIncubateFunctional:
+    def test_fused_rms_norm_matches_plain(self):
+        x = paddle.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
+        w = paddle.to_tensor(np.random.rand(8).astype("float32"))
+        out = incubate.nn.functional.fused_rms_norm(x, norm_weight=w)
+        ref = nn.functional.rms_norm(x, w)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fused_layer_norm_residual(self):
+        x = paddle.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
+        w = paddle.to_tensor(np.random.rand(8).astype("float32"))
+        out, res = incubate.nn.functional.fused_layer_norm(x, norm_weight=w,
+                                                           residual=x)
+        np.testing.assert_allclose(res.numpy(), (x + x).numpy())
+        ref = nn.functional.layer_norm(res, [8], weight=w)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_swiglu(self):
+        x = np.random.randn(3, 8).astype("float32")
+        out = incubate.nn.functional.swiglu(paddle.to_tensor(x))
+        sil = x[:, :4] / (1 + np.exp(-x[:, :4]))
+        np.testing.assert_allclose(out.numpy(), sil * x[:, 4:], atol=1e-5)
+
+    def test_fused_rope_shapes_and_norm_preserved(self):
+        q = paddle.to_tensor(np.random.randn(2, 6, 4, 16).astype("float32"))
+        k = paddle.to_tensor(np.random.randn(2, 6, 4, 16).astype("float32"))
+        qq, kk, vv = incubate.nn.functional.fused_rotary_position_embedding(
+            q, k)
+        assert vv is None and qq.shape == q.shape
+        # rotation preserves pairwise norms
+        np.testing.assert_allclose(
+            np.linalg.norm(qq.numpy(), axis=-1),
+            np.linalg.norm(q.numpy(), axis=-1), rtol=1e-4)
+
+    def test_softmax_mask_fuse(self):
+        x = paddle.to_tensor(np.random.randn(2, 3, 4, 4).astype("float32"))
+        mask = paddle.to_tensor(np.zeros((2, 1, 4, 4), "float32"))
+        out = incubate.softmax_mask_fuse(x, mask)
+        np.testing.assert_allclose(np.asarray(out.numpy()).sum(-1), 1.0,
+                                   atol=1e-5)
+
+    def test_varlen_attention_masks_padding(self):
+        qv = paddle.to_tensor(np.random.randn(2, 2, 5, 8).astype("float32"))
+        sl = paddle.to_tensor(np.array([5, 3], "int32"))
+        out = incubate.nn.functional.variable_length_memory_efficient_attention(
+            qv, qv, qv, sl, sl)
+        arr = np.asarray(out.numpy())
+        np.testing.assert_allclose(arr[1, :, 3:], 0.0)
+        assert np.abs(arr[0]).sum() > 0
+
+    def test_fused_linear_activation(self):
+        x = np.random.randn(3, 4).astype("float32")
+        w = np.random.randn(4, 5).astype("float32")
+        b = np.random.randn(5).astype("float32")
+        out = incubate.nn.functional.fused_linear_activation(
+            paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b),
+            activation="relu")
+        np.testing.assert_allclose(out.numpy(),
+                                   np.maximum(x @ w + b, 0), atol=1e-5)
+
+    def test_fused_dropout_add_eval(self):
+        x = paddle.to_tensor(np.ones((2, 3), "float32"))
+        y = paddle.to_tensor(np.full((2, 3), 2.0, "float32"))
+        out = incubate.nn.functional.fused_dropout_add(x, y, p=0.5,
+                                                       training=False)
+        np.testing.assert_allclose(out.numpy(), 3.0)
+
+
+class TestASP:
+    def test_prune_and_decorate_keep_density(self):
+        model = nn.Linear(8, 8)
+        incubate.asp.prune_model(model)
+        assert abs(incubate.asp.calculate_density(model.weight) - 0.5) < 0.01
+        opt = incubate.asp.decorate(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=model.parameters()))
+        out = model(paddle.to_tensor(np.random.randn(4, 8).astype("float32")))
+        out.sum().backward()
+        opt.step()
+        opt.clear_grad()
+        assert abs(incubate.asp.calculate_density(model.weight) - 0.5) < 0.01
+
+    def test_excluded_layers(self):
+        incubate.asp.set_excluded_layers(["weight"])
+        try:
+            model = nn.Linear(8, 8)
+            masks = incubate.asp.prune_model(model)
+            assert "weight" not in masks
+        finally:
+            incubate.asp.reset_excluded_layers()
+
+
+class TestIncubateMisc:
+    def test_multiprocessing_tensor_pickle(self):
+        import paddle_tpu.incubate.multiprocessing  # installs reducer
+        t = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        t2 = pickle.loads(pickle.dumps(t))
+        np.testing.assert_allclose(t2.numpy(), t.numpy())
+
+    def test_xpu_resnet_block(self):
+        blk = incubate.xpu.ResNetBasicBlock(3, 8, 3, has_shortcut=True)
+        out = blk(paddle.to_tensor(np.random.randn(1, 3, 8, 8)
+                                   .astype("float32")))
+        assert out.shape == [1, 8, 8, 8]
+
+    def test_incubate_autograd(self):
+        assert incubate.autograd.prim_enabled()
+        incubate.autograd.disable_prim()
+        assert not incubate.autograd.prim_enabled()
+        incubate.autograd.enable_prim()
+        assert incubate.autograd.jacobian is not None
+
+
+class TestReviewFixes3:
+    def test_memory_efficient_attention_runs(self):
+        q = paddle.to_tensor(np.random.randn(2, 4, 3, 8).astype("float32"))
+        out = incubate.nn.memory_efficient_attention(q, q, q)
+        assert out.shape == q.shape
+        out2 = incubate.nn.memory_efficient_attention(q, q, q, scale=0.5)
+        assert not np.allclose(out.numpy(), out2.numpy())
+
+    def test_maxunpool1d_output_size(self):
+        x = paddle.to_tensor(np.random.randn(2, 3, 10).astype("float32"))
+        o, m = nn.functional.max_pool1d(x, 2, 2, return_mask=True)
+        up = nn.MaxUnPool1D(2, 2, output_size=[2, 3, 10])(o, m)
+        assert up.shape == [2, 3, 10]
+
+    def test_lookahead_asp_decorate_combo(self):
+        model = nn.Linear(8, 8)
+        incubate.asp.prune_model(model)
+        la = LookAhead(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=model.parameters()))
+        opt = incubate.asp.decorate(la)
+        out = model(paddle.to_tensor(np.random.randn(4, 8).astype("float32")))
+        out.sum().backward()
+        opt.step()
+        opt.clear_grad()
+        assert abs(incubate.asp.calculate_density(model.weight) - 0.5) < 0.01
+
+    def test_fused_rope_2d_cos_and_time_major(self):
+        q = paddle.to_tensor(np.random.randn(2, 6, 4, 16).astype("float32"))
+        cos = paddle.to_tensor(np.random.rand(6, 16).astype("float32"))
+        sin = paddle.to_tensor(np.random.rand(6, 16).astype("float32"))
+        qq, _, _ = incubate.nn.functional.fused_rotary_position_embedding(
+            q, sin=sin, cos=cos)
+        assert qq.shape == q.shape
+        # time-major round trip equals batch-major on the transposed input
+        q_tm = paddle.to_tensor(np.swapaxes(np.asarray(q.numpy()), 0, 1))
+        qq_tm, _, _ = incubate.nn.functional.fused_rotary_position_embedding(
+            q_tm, sin=sin, cos=cos, time_major=True)
+        np.testing.assert_allclose(np.swapaxes(np.asarray(qq_tm.numpy()), 0, 1),
+                                   qq.numpy(), atol=1e-5)
+
+    def test_dynamic_decode_return_length_guard(self):
+        class Dummy(nn.decode.Decoder):
+            def initialize(self, inits):
+                t = paddle.zeros([2])
+                return t, t, paddle.to_tensor(np.array([False, False]))
+
+            def step(self, time, inputs, states, **kw):
+                done = paddle.to_tensor(np.array([True, True]))
+                return states, states, inputs, done
+
+        with pytest.raises(ValueError, match="lengths"):
+            nn.dynamic_decode(Dummy(), max_step_num=2, return_length=True)
