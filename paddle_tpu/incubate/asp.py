@@ -44,10 +44,10 @@ def _nm_mask(arr: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
     return mask.reshape(arr.shape)
 
 
-def _prunable(name: str, shape) -> bool:
+def _prunable(name: str, shape, m: int = 4) -> bool:
     if name in _excluded:
         return False
-    return len(shape) == 2 and shape[-1] % 4 == 0
+    return len(shape) == 2 and shape[-1] % m == 0
 
 
 def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
@@ -57,7 +57,7 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
 
     masks = {}
     for name, p in model.named_parameters():
-        if not _prunable(name, tuple(p._data.shape)):
+        if not _prunable(name, tuple(p._data.shape), m):
             continue
         mask = _nm_mask(np.asarray(p._data), n, m)
         p._set_data(p._data * jnp.asarray(mask, p._data.dtype))

@@ -41,7 +41,9 @@ def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
         if "residual" in has:
             h = h + rest[has["residual"]]
         res_out = h
-        ms = jnp.mean(jnp.square(h.astype(jnp.float32)), axis=-1,
+        bna = begin_norm_axis % h.ndim
+        axes = tuple(range(bna, h.ndim))
+        ms = jnp.mean(jnp.square(h.astype(jnp.float32)), axis=axes,
                       keepdims=True)
         out = (h.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon))
         if "w" in has:
@@ -74,8 +76,10 @@ def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
             h = h + rest[has["residual"]]
         res_out = h
         h32 = h.astype(jnp.float32)
-        mean = jnp.mean(h32, axis=-1, keepdims=True)
-        var = jnp.var(h32, axis=-1, keepdims=True)
+        bna = begin_norm_axis % h.ndim
+        axes = tuple(range(bna, h.ndim))
+        mean = jnp.mean(h32, axis=axes, keepdims=True)
+        var = jnp.var(h32, axis=axes, keepdims=True)
         out = (h32 - mean) * jax.lax.rsqrt(var + epsilon)
         if "w" in has:
             out = out * rest[has["w"]].astype(jnp.float32)
@@ -145,8 +149,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             pid = jnp.asarray(position_ids._data
                               if hasattr(position_ids, "_data")
                               else position_ids)
-            cc = jnp.squeeze(cc)[pid][:, :, None, :]
-            ss = jnp.squeeze(ss)[pid][:, :, None, :]
+            # drop only the broadcast axes (0: batch, 2: heads) — squeezing
+            # everything would also collapse a length-1 sequence (decode step)
+            cc2 = cc.reshape(cc.shape[1], cc.shape[3])
+            ss2 = ss.reshape(ss.shape[1], ss.shape[3])
+            cc = cc2[pid][:, :, None, :]
+            ss = ss2[pid][:, :, None, :]
         outs = tuple(_apply_rope(t, cc.astype(t.dtype), ss.astype(t.dtype),
                                  use_neox_rotary_style) for t in qkv)
         return outs if len(outs) > 1 else outs[0]

@@ -97,7 +97,7 @@ class ModelAverage(Optimizer):
         self.avg_rate = float(average_window_rate)
         self.min_w, self.max_w = int(min_average_window), int(max_average_window)
         self._sum: dict[int, Tensor] = {}
-        self._cnt = 0
+        self._cnt = 0  # accumulations in the current window
         self._backup: dict[int, Tensor] = {}
         for p in self._param_groups:
             t = Tensor(jnp.zeros_like(p._data, jnp.float32),
@@ -107,14 +107,17 @@ class ModelAverage(Optimizer):
             self._sum[id(p)] = t
 
     def step(self):
+        # plain running sum; apply() divides by the count (the reference's
+        # sum_1/2/3 + num_accumulates bookkeeping collapsed to one window)
+        if self._cnt >= self.max_w:
+            self._cnt = 0
+            for p in self._param_groups:
+                self._sum[id(p)]._set_data(
+                    jnp.zeros_like(self._sum[id(p)]._data))
         self._cnt += 1
-        window = max(self.min_w,
-                     min(self.max_w, int(self._cnt * self.avg_rate) or 1))
-        decay = max(0.0, 1.0 - 1.0 / window)
         for p in self._param_groups:
             s = self._sum[id(p)]
-            s._set_data(decay * s._data +
-                        (1 - decay) * p._data.astype(jnp.float32))
+            s._set_data(s._data + p._data.astype(jnp.float32))
 
     def minimize(self, loss, *a, **k):
         self.step()
@@ -123,9 +126,10 @@ class ModelAverage(Optimizer):
     @contextlib.contextmanager
     def apply(self, executor=None, need_restore=True):
         """Swap averaged weights in (context manager, as in the reference)."""
+        cnt = max(self._cnt, 1)
         for p in self._param_groups:
             self._backup[id(p)] = Tensor(p._data, stop_gradient=True)
-            p._set_data(self._sum[id(p)]._data.astype(p._data.dtype))
+            p._set_data((self._sum[id(p)]._data / cnt).astype(p._data.dtype))
         try:
             yield
         finally:

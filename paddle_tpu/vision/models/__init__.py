@@ -1,5 +1,8 @@
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
-                     resnet152, wide_resnet50_2)
+                     resnet152, wide_resnet50_2, wide_resnet101_2,
+                     resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
+                     resnext101_64x4d, resnext152_32x4d, resnext152_64x4d)
+from .inception import InceptionV3, inception_v3  # noqa: F401
 from .lenet import LeNet  # noqa: F401
 from .mobilenet import MobileNetV3Small, MobileNetV3Large  # noqa: F401
 from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401

@@ -332,3 +332,461 @@ class PSRoIPool(Layer):
 
     def forward(self, x, boxes, boxes_num):
         return psroi_pool(x, boxes, boxes_num, self._size, self._scale)
+
+
+# ---------------------------------------------------------------------------
+# SSD / YOLO / RPN detection ops (reference: python/paddle/vision/ops.py
+# prior_box/yolo_box/yolo_loss/matrix_nms/generate_proposals/
+# distribute_fpn_proposals). All static-shape: candidate sets are padded to
+# fixed sizes with validity encoded in scores/labels, the TPU-friendly form.
+# ---------------------------------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes for one feature map. Returns (boxes, vars)
+    of shape (H, W, num_priors, 4)."""
+    import numpy as np
+
+    input, image = ensure_tensor(input), ensure_tensor(image)
+    h, w = int(input._data.shape[2]), int(input._data.shape[3])
+    img_h, img_w = int(image._data.shape[2]), int(image._data.shape[3])
+    step_h = steps[1] or img_h / h
+    step_w = steps[0] or img_w / w
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []  # (box_w, box_h) per prior, per min_size
+    for i, ms in enumerate(min_sizes):
+        ms = float(ms)
+        if min_max_aspect_ratios_order:
+            # Caffe-SSD order: min(ar=1), sqrt(min*max), then the other ars —
+            # the order pretrained SSD heads were trained against
+            whs.append((ms, ms))
+            if max_sizes:
+                mm = (ms * float(max_sizes[i])) ** 0.5
+                whs.append((mm, mm))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+        else:  # default order: all aspect ratios, then sqrt(min*max)
+            for ar in ars:
+                whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+            if max_sizes:
+                mm = (ms * float(max_sizes[i])) ** 0.5
+                whs.append((mm, mm))
+    whs_np = np.asarray(whs, np.float32)  # (P, 2)
+
+    cy = (np.arange(h, dtype=np.float32) + offset) * step_h
+    cx = (np.arange(w, dtype=np.float32) + offset) * step_w
+    cxg, cyg = np.meshgrid(cx, cy)  # (H, W)
+    centers = np.stack([cxg, cyg], axis=-1)[:, :, None, :]  # (H, W, 1, 2)
+    half = whs_np[None, None, :, :] / 2.0
+    mins = (centers - half) / np.asarray([img_w, img_h], np.float32)
+    maxs = (centers + half) / np.asarray([img_w, img_h], np.float32)
+    boxes = np.concatenate([mins, maxs], axis=-1).astype(np.float32)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    vars_ = np.broadcast_to(np.asarray(variance, np.float32),
+                            boxes.shape).copy()
+    from ..core.tensor import to_tensor
+    return to_tensor(jnp.asarray(boxes)), to_tensor(jnp.asarray(vars_))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 head (B, A*(5+C), H, W) into boxes and scores.
+
+    Returns (boxes (B, A*H*W, 4) xyxy in image pixels, scores
+    (B, A*H*W, C)); predictions under ``conf_thresh`` get zero scores.
+    """
+    import numpy as np
+
+    x, img_size = ensure_tensor(x), ensure_tensor(img_size)
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+    b, ch, h, w = (int(s) for s in x._data.shape)
+    attrs = 5 + class_num
+
+    def fn(feat, imsz):
+        if iou_aware:
+            # layout: [na IoU channels block][na*(5+C) yolo block]
+            ioup = jax.nn.sigmoid(feat[:, :na])[:, :, None]  # (B, A, 1, H, W)
+            f = feat[:, na:].reshape(b, na, attrs, h, w)
+        else:
+            f = feat.reshape(b, na, attrs, h, w)
+        gx = (jnp.arange(w, dtype=jnp.float32))[None, None, None, :]
+        gy = (jnp.arange(h, dtype=jnp.float32))[None, None, :, None]
+        sx = jax.nn.sigmoid(f[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+        sy = jax.nn.sigmoid(f[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        bx = (gx + sx) / w
+        by = (gy + sy) / h
+        input_w, input_h = w * downsample_ratio, h * downsample_ratio
+        bw = jnp.exp(f[:, :, 2]) * anc[None, :, 0, None, None] / input_w
+        bh = jnp.exp(f[:, :, 3]) * anc[None, :, 1, None, None] / input_h
+        obj = jax.nn.sigmoid(f[:, :, 4])
+        if iou_aware:
+            iou_s = ioup[:, :, 0]
+            obj = obj ** (1 - iou_aware_factor) * iou_s ** iou_aware_factor
+        cls = jax.nn.sigmoid(f[:, :, 5:])  # (B, A, C, H, W)
+        score = obj[:, :, None] * cls
+        score = jnp.where(score > conf_thresh, score, 0.0)
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, imw - 1)
+            y1 = jnp.clip(y1, 0.0, imh - 1)
+            x2 = jnp.clip(x2, 0.0, imw - 1)
+            y2 = jnp.clip(y2, 0.0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(b, -1, 4)
+        scores = jnp.moveaxis(score, 2, -1).reshape(b, -1, class_num)
+        return boxes, scores
+
+    out = apply("yolo_box", fn, x, img_size, differentiable=False)
+    return tuple(out)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss for one detection head.
+
+    x: (B, A*(5+C), H, W); gt_box: (B, G, 4) xywh in [0,1] image coords;
+    gt_label: (B, G). Returns per-image loss (B,). Anchor assignment (best
+    IoU over the FULL anchor set, masked to this head) and the
+    ignore-high-IoU objectness rule follow the reference kernel.
+    """
+    import numpy as np
+
+    x, gt_box, gt_label = (ensure_tensor(x), ensure_tensor(gt_box),
+                           ensure_tensor(gt_label))
+    extras = [ensure_tensor(gt_score)] if gt_score is not None else []
+    all_anc = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    na = len(mask)
+    b, ch, h, w = (int(s) for s in x._data.shape)
+    attrs = 5 + class_num
+    input_w = w * downsample_ratio
+    input_h = h * downsample_ratio
+    anc_this = all_anc[mask]  # (A, 2) pixels
+
+    def fn(feat, gtb, gtl, *gs):
+        f = feat.reshape(b, na, attrs, h, w)
+        tx, ty = f[:, :, 0], f[:, :, 1]
+        tw, th = f[:, :, 2], f[:, :, 3]
+        tobj, tcls = f[:, :, 4], f[:, :, 5:]
+
+        # --- decode predicted boxes (normalized) for the ignore mask
+        gxx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gyy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        px = (gxx + jax.nn.sigmoid(tx)) / w
+        py = (gyy + jax.nn.sigmoid(ty)) / h
+        pw = jnp.exp(tw) * anc_this[None, :, 0, None, None] / input_w
+        ph = jnp.exp(th) * anc_this[None, :, 1, None, None] / input_h
+
+        gx, gy = gtb[..., 0], gtb[..., 1]          # (B, G)
+        gw, gh = gtb[..., 2], gtb[..., 3]
+        valid = (gw > 1e-8) & (gh > 1e-8)
+
+        # IoU of every pred box vs every gt (xywh, normalized)
+        def iou(px1, py1, pw1, ph1, qx, qy, qw, qh):
+            l1, r1 = px1 - pw1 / 2, px1 + pw1 / 2
+            t1, b1 = py1 - ph1 / 2, py1 + ph1 / 2
+            l2, r2 = qx - qw / 2, qx + qw / 2
+            t2, b2 = qy - qh / 2, qy + qh / 2
+            iw = jnp.clip(jnp.minimum(r1, r2) - jnp.maximum(l1, l2), 0)
+            ih = jnp.clip(jnp.minimum(b1, b2) - jnp.maximum(t1, t2), 0)
+            inter = iw * ih
+            return inter / (pw1 * ph1 + qw * qh - inter + 1e-10)
+
+        pious = iou(px[..., None], py[..., None], pw[..., None],
+                    ph[..., None],
+                    gx[:, None, None, None, :], gy[:, None, None, None, :],
+                    gw[:, None, None, None, :], gh[:, None, None, None, :])
+        pious = jnp.where(valid[:, None, None, None, :], pious, 0.0)
+        best_iou = jnp.max(pious, axis=-1)         # (B, A, H, W)
+        ignore = best_iou > ignore_thresh
+
+        # --- anchor assignment per gt: best shape-IoU over ALL anchors
+        aw = all_anc[:, 0] / input_w
+        ah = all_anc[:, 1] / input_h
+        inter = (jnp.minimum(gw[..., None], aw[None, None]) *
+                 jnp.minimum(gh[..., None], ah[None, None]))
+        shape_iou = inter / (gw[..., None] * gh[..., None] +
+                             aw[None, None] * ah[None, None] - inter + 1e-10)
+        best_anchor = jnp.argmax(shape_iou, axis=-1)  # (B, G) in full set
+        # position in this head's mask (or -1)
+        mask_arr = jnp.asarray(mask)
+        in_head = (best_anchor[..., None] == mask_arr[None, None]).astype(
+            jnp.int32)
+        head_slot = jnp.argmax(in_head, axis=-1)    # (B, G)
+        assigned = (jnp.sum(in_head, axis=-1) > 0) & valid
+
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+
+        # gather predictions at assigned cells: flat index per gt
+        flat = (head_slot * h + gj) * w + gi        # (B, G)
+
+        def gather_bg(t):  # t: (B, A, H, W) -> (B, G)
+            tf = t.reshape(b, -1)
+            return jnp.take_along_axis(tf, flat, axis=1)
+
+        s_tx, s_ty = gather_bg(tx), gather_bg(ty)
+        s_tw, s_th = gather_bg(tw), gather_bg(th)
+
+        # targets
+        tgt_x = gx * w - gi
+        tgt_y = gy * h - gj
+        aw_s = jnp.take(aw, jnp.clip(best_anchor, 0, all_anc.shape[0] - 1))
+        ah_s = jnp.take(ah, jnp.clip(best_anchor, 0, all_anc.shape[0] - 1))
+        tgt_w = jnp.log(jnp.clip(gw / jnp.clip(aw_s, 1e-10), 1e-10, None))
+        tgt_h = jnp.log(jnp.clip(gh / jnp.clip(ah_s, 1e-10), 1e-10, None))
+        box_scale = 2.0 - gw * gh                   # small boxes weigh more
+        score_w = gs[0] if gs else jnp.ones_like(gx)
+        wgt = jnp.where(assigned, box_scale * score_w, 0.0)
+
+        def bce(logit, target):
+            return jax.nn.softplus(logit) - logit * target
+
+        loss_xy = (bce(s_tx, tgt_x) + bce(s_ty, tgt_y)) * wgt
+        loss_wh = (jnp.abs(s_tw - tgt_w) + jnp.abs(s_th - tgt_h)) * wgt
+
+        # objectness: positives at assigned cells, negatives elsewhere
+        # unless ignored
+        pos = jnp.zeros((b, na * h * w))
+        pos = jax.vmap(lambda pz, fl, asg: pz.at[fl].max(
+            asg.astype(jnp.float32)))(pos, flat, assigned)
+        pos = pos.reshape(b, na, h, w)
+        obj_w = jnp.where(pos > 0, 1.0, jnp.where(ignore, 0.0, 1.0))
+        loss_obj = bce(tobj, pos) * obj_w
+
+        # classification at assigned cells
+        smooth = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
+        onehot = jax.nn.one_hot(gtl.astype(jnp.int32), class_num)
+        onehot = onehot * (1 - smooth) + smooth / class_num
+
+        def gather_cls(t):  # (B, A, C, H, W) -> (B, G, C)
+            tf = jnp.moveaxis(t, 2, -1).reshape(b, -1, class_num)
+            return jnp.take_along_axis(
+                tf, flat[..., None].astype(jnp.int32), axis=1)
+
+        s_cls = gather_cls(tcls)
+        loss_cls = jnp.sum(bce(s_cls, onehot), axis=-1) * \
+            jnp.where(assigned, score_w, 0.0)
+
+        per_img = (jnp.sum(loss_xy, axis=1) + jnp.sum(loss_wh, axis=1) +
+                   jnp.sum(loss_obj, axis=(1, 2, 3)) +
+                   jnp.sum(loss_cls, axis=1))
+        return per_img
+
+    return apply("yolo_loss", fn, x, gt_box, gt_label, *extras)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): soft score decay from the pairwise IoU matrix —
+    one dense (k, k) computation, no sequential suppression loop (ideal for
+    the MXU). bboxes: (B, N, 4); scores: (B, C, N)."""
+    bboxes, scores = ensure_tensor(bboxes), ensure_tensor(scores)
+
+    def fn(bx, sc):
+        bsz, n, _ = bx.shape
+        c = sc.shape[1]
+
+        def one(boxes, scores_cn):
+            if 0 <= background_label < c:
+                scores_cn = scores_cn.at[background_label].set(0.0)
+            flat_s = scores_cn.reshape(-1)
+            labels = jnp.repeat(jnp.arange(c), n)
+            box_idx = jnp.tile(jnp.arange(n), c)
+            flat_s = jnp.where(flat_s > score_threshold, flat_s, 0.0)
+            k = min(nms_top_k, flat_s.shape[0])
+            order = jnp.argsort(-flat_s)[:k]
+            s_k = flat_s[order]
+            l_k = labels[order]
+            b_k = boxes[box_idx[order]]
+            # pairwise IoU over the candidate set
+            x1, y1, x2, y2 = b_k[:, 0], b_k[:, 1], b_k[:, 2], b_k[:, 3]
+            off = 0.0 if normalized else 1.0
+            area = jnp.clip(x2 - x1 + off, 0) * jnp.clip(y2 - y1 + off, 0)
+            iw = jnp.clip(jnp.minimum(x2[:, None], x2[None]) -
+                          jnp.maximum(x1[:, None], x1[None]) + off, 0)
+            ih = jnp.clip(jnp.minimum(y2[:, None], y2[None]) -
+                          jnp.maximum(y1[:, None], y1[None]) + off, 0)
+            inter = iw * ih
+            iou = inter / (area[:, None] + area[None] - inter + 1e-10)
+            same = (l_k[:, None] == l_k[None]).astype(iou.dtype)
+            # decay from every HIGHER-scored box of the same class
+            upper = jnp.triu(jnp.ones_like(iou), 1).T  # [i, j]: j before i
+            ious = iou * same * upper
+            max_iou = jnp.max(ious, axis=1)
+            if use_gaussian:
+                # decay_ij = exp(-(iou_ij^2 - compensate_j^2)/sigma), where
+                # compensate_j is box j's own max-IoU with its predecessors
+                decay = jnp.where(jnp.any(ious > 0, axis=1),
+                                  jnp.min(jnp.where(
+                                      ious > 0,
+                                      jnp.exp(-(ious ** 2 -
+                                                max_iou[None, :] ** 2) /
+                                              gaussian_sigma), 1.0), axis=1),
+                                  1.0)
+            else:
+                decay = jnp.where(
+                    jnp.any(ious > 0, axis=1),
+                    jnp.min(jnp.where(ious > 0,
+                                      (1 - ious) / (1 - max_iou[None, :]),
+                                      1.0), axis=1), 1.0)
+            new_s = s_k * decay
+            new_s = jnp.where(new_s > post_threshold, new_s, 0.0)
+            kk = min(keep_top_k, new_s.shape[0])
+            fin = jnp.argsort(-new_s)[:kk]
+            out_s = new_s[fin]
+            out = jnp.concatenate([
+                jnp.where(out_s > 0, l_k[fin], -1).astype(
+                    jnp.float32)[:, None],
+                out_s[:, None], b_k[fin]], axis=-1)
+            idx = jnp.where(out_s > 0, box_idx[order][fin], -1)
+            return out, idx, jnp.sum(out_s > 0).astype(jnp.int32)
+
+        return jax.vmap(one)(bx, sc)
+
+    out, idx, nums = apply("matrix_nms", fn, bboxes, scores,
+                           differentiable=False)
+    res = [out]
+    if return_index:
+        res.append(idx)
+    if return_rois_num:
+        res.append(nums)
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation: decode deltas on anchors, clip to image,
+    drop tiny boxes (zero-scored, shapes stay static), NMS, keep top-N.
+
+    scores: (B, A, H, W); bbox_deltas: (B, 4A, H, W); anchors/variances:
+    (H, W, A, 4) or (H*W*A, 4). Returns (rois (B, post_nms_top_n, 4),
+    roi_probs (B, post_nms_top_n, 1)[, rois_num (B,)]).
+    """
+    from ..ops.vision import _nms_suppress
+
+    scores, bbox_deltas = ensure_tensor(scores), ensure_tensor(bbox_deltas)
+    img_size, anchors = ensure_tensor(img_size), ensure_tensor(anchors)
+    variances = ensure_tensor(variances)
+    off = 1.0 if pixel_offset else 0.0
+
+    def fn(sc, bd, imsz, anc, var):
+        bsz, a, h, w = sc.shape
+        n = a * h * w
+        anc_f = anc.reshape(-1, 4)
+        var_f = var.reshape(-1, 4)
+
+        def one(s, d, sz):
+            s_f = s.reshape(-1)                           # A*H*W (A major)
+            # deltas (4A, H, W) -> (A, 4, H, W) -> (A, H, W, 4) -> flat
+            d_f = jnp.moveaxis(d.reshape(a, 4, h, w), 1, -1).reshape(-1, 4)
+            # anchors come (H, W, A, 4); reorder flat index to A-major
+            anc_hw = anc_f.reshape(h, w, a, 4) if anc_f.shape[0] == n else None
+            if anc_hw is not None:
+                anc_am = jnp.moveaxis(anc_hw, 2, 0).reshape(-1, 4)
+                var_am = jnp.moveaxis(var_f.reshape(h, w, a, 4), 2,
+                                      0).reshape(-1, 4)
+            else:
+                anc_am, var_am = anc_f, var_f
+            aw = anc_am[:, 2] - anc_am[:, 0] + off
+            ah = anc_am[:, 3] - anc_am[:, 1] + off
+            acx = anc_am[:, 0] + aw * 0.5
+            acy = anc_am[:, 1] + ah * 0.5
+            cx = var_am[:, 0] * d_f[:, 0] * aw + acx
+            cy = var_am[:, 1] * d_f[:, 1] * ah + acy
+            bw = jnp.exp(jnp.clip(var_am[:, 2] * d_f[:, 2], None,
+                                  10.0)) * aw
+            bh = jnp.exp(jnp.clip(var_am[:, 3] * d_f[:, 3], None,
+                                  10.0)) * ah
+            x1 = jnp.clip(cx - bw * 0.5, 0, sz[1] - off)
+            y1 = jnp.clip(cy - bh * 0.5, 0, sz[0] - off)
+            x2 = jnp.clip(cx + bw * 0.5 - off, 0, sz[1] - off)
+            y2 = jnp.clip(cy + bh * 0.5 - off, 0, sz[0] - off)
+            boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+            keep_size = ((x2 - x1 + off) >= min_size) & \
+                        ((y2 - y1 + off) >= min_size)
+            s_v = jnp.where(keep_size, s_f, 0.0)
+            k = min(pre_nms_top_n, n)
+            order = jnp.argsort(-s_v)[:k]
+            bs, ss = boxes[order], s_v[order]
+            keep = _nms_suppress(bs, nms_thresh) & (ss > 0)
+            ss = jnp.where(keep, ss, 0.0)
+            kk = min(post_nms_top_n, k)
+            fin = jnp.argsort(-ss)[:kk]
+            out_b, out_s = bs[fin], ss[fin]
+            if kk < post_nms_top_n:
+                pad = post_nms_top_n - kk
+                out_b = jnp.pad(out_b, ((0, pad), (0, 0)))
+                out_s = jnp.pad(out_s, (0, pad))
+            return out_b, out_s[:, None], jnp.sum(out_s > 0).astype(jnp.int32)
+
+        return jax.vmap(one)(sc, bd, imsz)
+
+    rois, probs, nums = apply("generate_proposals", fn, scores, bbox_deltas,
+                              img_size, anchors, variances,
+                              differentiable=False)
+    if return_rois_num:
+        return rois, probs, nums
+    return rois, probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Route RoIs to FPN levels by scale (eager, data-dependent sizes —
+    documented divergence: raises under tracing like other dynamic-shape
+    ops). Returns (multi_rois list, restore_index[, rois_num_per_level])."""
+    import numpy as np
+
+    from ..core.tensor import to_tensor
+
+    fpn_rois = ensure_tensor(fpn_rois)
+    rois = np.asarray(fpn_rois._data)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.clip((rois[:, 2] - rois[:, 0] + off), 0, None) *
+                    np.clip((rois[:, 3] - rois[:, 1] + off), 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore, nums = [], [], []
+    order = []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi_rois.append(to_tensor(jnp.asarray(rois[idx])))
+        nums.append(len(idx))
+        order.append(idx)
+    order_all = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore_index = np.empty_like(order_all)
+    restore_index[order_all] = np.arange(order_all.shape[0])
+    restore_t = to_tensor(jnp.asarray(restore_index.reshape(-1, 1)))
+    if rois_num is not None:
+        return multi_rois, restore_t, [
+            to_tensor(jnp.asarray(np.asarray([nv], np.int32)))
+            for nv in nums]
+    return multi_rois, restore_t
+
+
+__all__ += ["prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+            "generate_proposals", "distribute_fpn_proposals"]

@@ -1,0 +1,301 @@
+"""Tests for SSD/YOLO/RPN detection ops (prior_box, yolo_box, yolo_loss,
+matrix_nms, generate_proposals, distribute_fpn_proposals) and the new
+ResNeXt/Inception model variants."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision as vision
+from paddle_tpu.vision import ops as vops
+
+
+class TestPriorBox:
+    def test_shapes_and_ranges(self):
+        feat = paddle.zeros([1, 256, 4, 4])
+        img = paddle.zeros([1, 3, 32, 32])
+        boxes, vars_ = vops.prior_box(feat, img, min_sizes=[8.0],
+                                      max_sizes=[16.0], aspect_ratios=[2.0],
+                                      flip=True, clip=True)
+        # priors: ar 1 + ar 2 + ar 1/2 + sqrt(min*max) = 4
+        assert boxes.shape == [4, 4, 4, 4]
+        assert vars_.shape == boxes.shape
+        arr = np.asarray(boxes.numpy())
+        assert arr.min() >= 0.0 and arr.max() <= 1.0
+        np.testing.assert_allclose(np.asarray(vars_.numpy())[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+    def test_center_alignment(self):
+        feat = paddle.zeros([1, 1, 2, 2])
+        img = paddle.zeros([1, 3, 16, 16])
+        boxes, _ = vops.prior_box(feat, img, min_sizes=[4.0])
+        arr = np.asarray(boxes.numpy())
+        # first cell center should be at offset 0.5 * step = 4 px -> 0.25
+        cx = (arr[0, 0, 0, 0] + arr[0, 0, 0, 2]) / 2
+        assert abs(cx - 0.25) < 1e-6
+
+
+class TestYoloBox:
+    def test_decode_shapes_and_threshold(self):
+        cn, na = 3, 2
+        x = paddle.to_tensor(
+            np.random.randn(2, na * (5 + cn), 4, 4).astype("float32"))
+        imgsz = paddle.to_tensor(np.array([[32, 32], [32, 32]], "int32"))
+        b, s = vops.yolo_box(x, imgsz, anchors=[10, 14, 23, 27],
+                             class_num=cn, conf_thresh=0.5,
+                             downsample_ratio=8)
+        assert b.shape == [2, na * 16, 4]
+        assert s.shape == [2, na * 16, cn]
+        arr = np.asarray(s.numpy())
+        assert ((arr == 0) | (arr > 0.5 * 0.0)).all()  # zeros below thresh
+        barr = np.asarray(b.numpy())
+        assert barr.min() >= 0 and barr.max() <= 31  # clipped to image
+
+    def test_known_center_box(self):
+        # zero logits: sigmoid=0.5 -> center at cell centers, w=h=anchor
+        cn, na = 1, 1
+        x = paddle.zeros([1, na * (5 + cn), 2, 2])
+        imgsz = paddle.to_tensor(np.array([[16, 16]], "int32"))
+        b, s = vops.yolo_box(x, imgsz, anchors=[8, 8], class_num=cn,
+                             conf_thresh=0.0, downsample_ratio=8,
+                             clip_bbox=False)
+        arr = np.asarray(b.numpy())[0, 0]
+        # cell (0,0): center (0.5/2, 0.5/2)*16 = 4, anchor 8/16*16 = 8 wide
+        np.testing.assert_allclose(arr, [0.0, 0.0, 8.0, 8.0], atol=1e-4)
+
+
+class TestYoloLoss:
+    def test_finite_and_differentiable(self):
+        cn, na = 3, 2
+        gtb = paddle.to_tensor(
+            np.array([[[0.5, 0.5, 0.3, 0.4], [0, 0, 0, 0]]] * 2, "float32"))
+        gtl = paddle.to_tensor(np.zeros((2, 2), "int32"))
+        x = paddle.to_tensor(
+            np.random.randn(2, na * (5 + cn), 4, 4).astype("float32"),
+            stop_gradient=False)
+        loss = vops.yolo_loss(x, gtb, gtl, anchors=[10, 14, 23, 27],
+                              anchor_mask=[0, 1], class_num=cn,
+                              ignore_thresh=0.7, downsample_ratio=8)
+        assert loss.shape == [2]
+        loss.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_loss_decreases_with_training(self):
+        cn, na = 2, 1
+        gtb = paddle.to_tensor(np.array([[[0.5, 0.5, 0.4, 0.4]]], "float32"))
+        gtl = paddle.to_tensor(np.zeros((1, 1), "int32"))
+        from paddle_tpu.core.tensor import Parameter
+        x = Parameter(np.random.randn(1, na * (5 + cn), 4, 4)
+                      .astype("float32") * 0.1, name="yolo_feat")
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=[x])
+        first = last = None
+        for i in range(30):
+            loss = vops.yolo_loss(x, gtb, gtl, anchors=[13, 13],
+                                  anchor_mask=[0], class_num=cn,
+                                  ignore_thresh=0.7,
+                                  downsample_ratio=8).sum()
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert last < first * 0.5, (first, last)
+
+
+class TestMatrixNMS:
+    def test_decay_values(self):
+        bx = paddle.to_tensor(
+            np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]]],
+                     "float32"))
+        sc = paddle.to_tensor(np.array([[[0.9, 0.85, 0.7]]], "float32"))
+        out, idx, nums = vops.matrix_nms(bx, sc, score_threshold=0.1,
+                                         post_threshold=0.1, nms_top_k=3,
+                                         keep_top_k=3, return_index=True,
+                                         background_label=-1)
+        arr = np.asarray(out.numpy())[0]
+        assert int(nums.numpy()[0]) == 3
+        np.testing.assert_allclose(arr[0, 1], 0.9, atol=1e-6)
+        # the overlapping box (iou ~0.68) decays by (1 - iou)
+        assert 0.2 < arr[2, 1] < 0.4
+        # the far box keeps its score
+        np.testing.assert_allclose(arr[1, 1], 0.7, atol=1e-6)
+
+    def test_background_label_default_zeroes_class0(self):
+        bx = paddle.to_tensor(np.random.rand(1, 3, 4).astype("float32"))
+        sc = paddle.to_tensor(np.random.rand(1, 1, 3).astype("float32"))
+        out, nums = vops.matrix_nms(bx, sc, score_threshold=0.01,
+                                    nms_top_k=3, keep_top_k=3)
+        assert int(nums.numpy()[0]) == 0
+
+    def test_gaussian_mode(self):
+        bx = paddle.to_tensor(
+            np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]], "float32"))
+        sc = paddle.to_tensor(np.array([[[0.9, 0.8]]], "float32"))
+        out, nums = vops.matrix_nms(bx, sc, score_threshold=0.1,
+                                    post_threshold=0.0, nms_top_k=2,
+                                    keep_top_k=2, use_gaussian=True,
+                                    background_label=-1)
+        arr = np.asarray(out.numpy())[0]
+        assert arr[1, 1] < 0.8  # decayed
+
+
+class TestGenerateProposals:
+    def test_static_output_and_counts(self):
+        h = w = 4
+        a = 3
+        np.random.seed(0)
+        scores = paddle.to_tensor(np.random.rand(1, a, h, w)
+                                  .astype("float32"))
+        deltas = paddle.to_tensor(
+            (np.random.randn(1, 4 * a, h, w) * 0.1).astype("float32"))
+        anchors_np = np.random.rand(h, w, a, 4).astype("float32") * 16
+        anchors_np[..., 2:] += anchors_np[..., :2] + 4
+        rois, probs, n = vops.generate_proposals(
+            scores, deltas,
+            paddle.to_tensor(np.array([[32.0, 32.0]], "float32")),
+            paddle.to_tensor(anchors_np),
+            paddle.to_tensor(np.ones((h, w, a, 4), "float32")),
+            pre_nms_top_n=20, post_nms_top_n=10, nms_thresh=0.5,
+            min_size=1.0)
+        assert rois.shape == [1, 10, 4]
+        assert probs.shape == [1, 10, 1]
+        cnt = int(n.numpy()[0])
+        assert 1 <= cnt <= 10
+        arr = np.asarray(rois.numpy())[0]
+        assert arr.min() >= 0 and arr.max() <= 32
+
+    def test_min_size_filters(self):
+        h = w = 2
+        a = 1
+        scores = paddle.to_tensor(np.ones((1, a, h, w), "float32"))
+        deltas = paddle.to_tensor(np.zeros((1, 4, h, w), "float32"))
+        anchors_np = np.zeros((h, w, a, 4), "float32")
+        anchors_np[..., 2:] = 0.5  # all anchors tiny
+        rois, probs, n = vops.generate_proposals(
+            scores, deltas,
+            paddle.to_tensor(np.array([[32.0, 32.0]], "float32")),
+            paddle.to_tensor(anchors_np),
+            paddle.to_tensor(np.ones((h, w, a, 4), "float32")),
+            post_nms_top_n=4, min_size=5.0)
+        assert int(n.numpy()[0]) == 0
+
+
+class TestDistributeFPN:
+    def test_routing_and_restore(self):
+        rois_in = paddle.to_tensor(
+            np.array([[0, 0, 10, 10], [0, 0, 100, 100], [0, 0, 300, 300]],
+                     "float32"))
+        multi, restore = vops.distribute_fpn_proposals(rois_in, 2, 5, 4, 224)
+        sizes = [m.shape[0] for m in multi]
+        assert sum(sizes) == 3 and len(multi) == 4
+        # floor(log2(scale/224)) + 4, clamped: 10px -> lvl 2, 100px -> lvl 2,
+        # 300px -> lvl 4
+        assert sizes == [2, 0, 1, 0]
+        # restore index is a permutation
+        r = np.asarray(restore.numpy()).reshape(-1)
+        assert sorted(r.tolist()) == [0, 1, 2]
+
+    def test_rois_num_output(self):
+        rois_in = paddle.to_tensor(np.array([[0, 0, 50, 50]], "float32"))
+        multi, restore, nums = vops.distribute_fpn_proposals(
+            rois_in, 2, 5, 4, 224, rois_num=paddle.to_tensor(
+                np.array([1], "int32")))
+        assert len(nums) == 4
+
+
+class TestNewModels:
+    def test_resnext_forward(self):
+        m = vision.models.resnext50_32x4d(num_classes=10)
+        out = m(paddle.to_tensor(np.random.randn(1, 3, 64, 64)
+                                 .astype("float32")))
+        assert out.shape == [1, 10]
+
+    def test_wide_resnet101(self):
+        m = vision.models.wide_resnet101_2(num_classes=4)
+        out = m(paddle.to_tensor(np.random.randn(1, 3, 64, 64)
+                                 .astype("float32")))
+        assert out.shape == [1, 4]
+
+    def test_inception_v3(self):
+        m = vision.models.inception_v3(num_classes=7)
+        m.eval()
+        out = m(paddle.to_tensor(np.random.randn(1, 3, 128, 128)
+                                 .astype("float32")))
+        assert out.shape == [1, 7]
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestReviewFixes4:
+    def test_model_average_is_a_mean(self):
+        from paddle_tpu.core.tensor import Parameter
+        from paddle_tpu.incubate.optimizer import ModelAverage
+        p = Parameter(np.array([4.0], "float32"), name="ma_mean")
+        ma = ModelAverage(0.5, parameters=[p])
+        ma.step()                      # sum = 4
+        p._set_data(p._data * 0 + 8.0)
+        ma.step()                      # sum = 12, cnt = 2
+        with ma.apply():
+            np.testing.assert_allclose(np.asarray(p.numpy()), [6.0])
+        np.testing.assert_allclose(np.asarray(p.numpy()), [8.0])
+
+    def test_yolo_box_iou_aware_layout(self):
+        cn, na = 2, 2
+        h = w = 2
+        # zero yolo block, large IoU logits: scores must react to the IoU
+        # block placed AS A LEADING BLOCK of na channels
+        feat = np.zeros((1, na + na * (5 + cn), h, w), "float32")
+        feat[:, :na] = 5.0  # iou logits
+        b, s = vops.yolo_box(paddle.to_tensor(feat),
+                             paddle.to_tensor(np.array([[16, 16]], "int32")),
+                             anchors=[8, 8, 12, 12], class_num=cn,
+                             conf_thresh=0.0, downsample_ratio=8,
+                             clip_bbox=False, iou_aware=True,
+                             iou_aware_factor=0.5)
+        # with zero yolo logits, obj=0.5, cls=0.5, iou=sigmoid(5)≈0.993
+        # score = (0.5^0.5 * 0.993^0.5) * 0.5 ≈ 0.352
+        np.testing.assert_allclose(np.asarray(s.numpy()), 0.3523, atol=1e-3)
+        # boxes still decode from zero logits: w = anchor/input * img
+        arr = np.asarray(b.numpy())[0]
+        np.testing.assert_allclose(arr[0, 2] - arr[0, 0], 8.0, atol=1e-4)
+
+    def test_prior_box_min_max_order(self):
+        feat = paddle.zeros([1, 1, 1, 1])
+        img = paddle.zeros([1, 3, 16, 16])
+        boxes, _ = vops.prior_box(feat, img, min_sizes=[4.0], max_sizes=[8.0],
+                                  aspect_ratios=[2.0], flip=True,
+                                  min_max_aspect_ratios_order=True)
+        arr = np.asarray(boxes.numpy())[0, 0]  # (P, 4), P = 4
+        widths = (arr[:, 2] - arr[:, 0]) * 16
+        # order: min (4), sqrt(4*8)≈5.657, ar2 (4*sqrt2), ar0.5 (4/sqrt2)
+        np.testing.assert_allclose(
+            widths, [4.0, 32 ** 0.5, 4 * 2 ** 0.5, 4 / 2 ** 0.5], atol=1e-4)
+
+    def test_asp_m8_and_odd_shapes(self):
+        import paddle_tpu.incubate as incubate
+        import paddle_tpu.nn as nn
+        model = nn.Linear(8, 2)  # weight (8, 2): last dim 2 not divisible by 8
+        masks = incubate.asp.prune_model(model, n=2, m=8)
+        assert masks == {}  # skipped, not crashed/mis-masked
+        model2 = nn.Linear(2, 8)
+        incubate.asp.prune_model(model2, n=2, m=8)
+        assert abs(incubate.asp.calculate_density(model2.weight) - 0.25) < 0.01
+
+    def test_rope_decode_step_with_position_ids(self):
+        import paddle_tpu.incubate as incubate
+        q = paddle.to_tensor(np.random.randn(2, 1, 4, 16).astype("float32"))
+        cos = paddle.to_tensor(np.random.rand(1, 8, 1, 16).astype("float32"))
+        sin = paddle.to_tensor(np.random.rand(1, 8, 1, 16).astype("float32"))
+        pid = paddle.to_tensor(np.array([[5], [2]], "int32"))
+        qq, _, _ = incubate.nn.functional.fused_rotary_position_embedding(
+            q, sin=sin, cos=cos, position_ids=pid)
+        assert qq.shape == [2, 1, 4, 16]
+
+    def test_fused_norm_begin_norm_axis(self):
+        import paddle_tpu.incubate as incubate
+        x = paddle.to_tensor(np.random.randn(2, 3, 4).astype("float32"))
+        out = incubate.nn.functional.fused_layer_norm(x, begin_norm_axis=1)
+        arr = np.asarray(out.numpy())
+        # normalized jointly over axes 1..2 -> per-sample mean 0, var 1
+        np.testing.assert_allclose(arr.reshape(2, -1).mean(1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(arr.reshape(2, -1).var(1), 1.0, atol=1e-3)
