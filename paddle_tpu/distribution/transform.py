@@ -1,0 +1,230 @@
+"""``paddle.distribution.transform`` — bijective variable transforms.
+
+Parity: python/paddle/distribution/transform.py (Transform, Affine, Exp,
+Sigmoid, Tanh, Power, Chain, ...). Each transform implements forward,
+inverse, and forward_log_det_jacobian over Tensors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import ensure_tensor
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+           "TanhTransform", "PowerTransform", "ChainTransform",
+           "AbsTransform", "SoftmaxTransform", "ReshapeTransform",
+           "IndependentTransform", "StackTransform"]
+
+
+class Transform:
+    _type = "bijection"
+
+    def forward(self, x):
+        x = ensure_tensor(x)
+        return apply(type(self).__name__ + ".fwd", self._forward, x)
+
+    def inverse(self, y):
+        y = ensure_tensor(y)
+        return apply(type(self).__name__ + ".inv", self._inverse, y)
+
+    def forward_log_det_jacobian(self, x):
+        x = ensure_tensor(x)
+        return apply(type(self).__name__ + ".fldj",
+                     self._forward_log_det_jacobian, x)
+
+    def inverse_log_det_jacobian(self, y):
+        y = ensure_tensor(y)
+        return apply(type(self).__name__ + ".ildj",
+                     lambda a: -self._forward_log_det_jacobian(
+                         self._inverse(a)), y)
+
+    # subclass hooks over raw arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+
+    def _forward(self, x):
+        return self.loc._data + self.scale._data * x
+
+    def _inverse(self, y):
+        return (y - self.loc._data) / self.scale._data
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale._data)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = ensure_tensor(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power._data)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power._data)
+
+    def _forward_log_det_jacobian(self, x):
+        p = self.power._data
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh(x)^2) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    _type = "surjection"
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    _type = "other"
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("softmax is not a bijection")
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class IndependentTransform(Transform):
+    """Promote ``reinterpreted_batch_rank`` batch dims to event dims: the
+    log-det sums over them."""
+
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.rank, 0)))
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] along slice i of ``axis``."""
+
+    def __init__(self, transforms, axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t._forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t._forward(x)
+        return total

@@ -107,13 +107,19 @@ class ModelAverage(Optimizer):
             self._sum[id(p)] = t
 
     def step(self):
-        # plain running sum; apply() divides by the count (the reference's
-        # sum_1/2/3 + num_accumulates bookkeeping collapsed to one window)
+        # running sum; apply() divides by the count. At max_average_window
+        # the sum and count HALVE (geometric forgetting) instead of resetting
+        # — the sliding behavior the reference's sum_1/2/3 shift implements,
+        # without the post-reset cliff where apply() would see ~1 step.
+        # min_average_window floors the halved count so early windows keep
+        # enough history.
         if self._cnt >= self.max_w:
-            self._cnt = 0
+            keep = max(self._cnt // 2, min(self.min_w, self._cnt))
+            scale = keep / self._cnt
+            self._cnt = keep
             for p in self._param_groups:
-                self._sum[id(p)]._set_data(
-                    jnp.zeros_like(self._sum[id(p)]._data))
+                s = self._sum[id(p)]
+                s._set_data(s._data * scale)
         self._cnt += 1
         for p in self._param_groups:
             s = self._sum[id(p)]

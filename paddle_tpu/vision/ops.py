@@ -564,19 +564,21 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         loss_xy = (bce(s_tx, tgt_x) + bce(s_ty, tgt_y)) * wgt
         loss_wh = (jnp.abs(s_tw - tgt_w) + jnp.abs(s_th - tgt_h)) * wgt
 
-        # objectness: positives at assigned cells, negatives elsewhere
-        # unless ignored
+        # objectness: positive target is the gt score (mixup support) at
+        # assigned cells, negatives elsewhere unless ignored
         pos = jnp.zeros((b, na * h * w))
-        pos = jax.vmap(lambda pz, fl, asg: pz.at[fl].max(
-            asg.astype(jnp.float32)))(pos, flat, assigned)
+        pos = jax.vmap(lambda pz, fl, tgt: pz.at[fl].max(tgt))(
+            pos, flat, jnp.where(assigned, score_w, 0.0))
         pos = pos.reshape(b, na, h, w)
         obj_w = jnp.where(pos > 0, 1.0, jnp.where(ignore, 0.0, 1.0))
         loss_obj = bce(tobj, pos) * obj_w
 
-        # classification at assigned cells
-        smooth = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
+        # classification at assigned cells; reference smooth_weight is
+        # min(1/C, 1/40): positive target 1-sw, negative sw
+        sw = min(1.0 / class_num, 1.0 / 40.0) \
+            if use_label_smooth and class_num > 1 else 0.0
         onehot = jax.nn.one_hot(gtl.astype(jnp.int32), class_num)
-        onehot = onehot * (1 - smooth) + smooth / class_num
+        onehot = onehot * (1.0 - sw) + (1.0 - onehot) * sw
 
         def gather_cls(t):  # (B, A, C, H, W) -> (B, G, C)
             tf = jnp.moveaxis(t, 2, -1).reshape(b, -1, class_num)
