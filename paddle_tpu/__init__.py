@@ -115,3 +115,27 @@ def in_static_mode() -> bool:
     return not in_dynamic_mode()
 
 __version__ = "0.1.0"
+
+
+def disable_signal_handler() -> None:
+    """Parity no-op: the reference installs SIGSEGV/SIGBUS handlers in C++;
+    this runtime does not install signal handlers at all."""
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None) -> None:
+    """Configure numpy-backed tensor printing (parity:
+    paddle.set_printoptions)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)

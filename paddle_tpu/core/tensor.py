@@ -131,6 +131,28 @@ class Tensor:
     def element_size(self) -> int:
         return self.dtype.itemsize
 
+    @property
+    def itemsize(self) -> int:
+        return self.dtype.itemsize
+
+    @property
+    def nbytes(self) -> int:
+        return self.size * self.dtype.itemsize
+
+    def is_sparse(self) -> bool:
+        # method, not property: the reference API (and this repo's sparse
+        # classes) spell it t.is_sparse()
+        return False
+
+    def data_ptr(self) -> int:
+        """Opaque buffer identity (reference: device pointer). PJRT exposes
+        the device address only on some backends; fall back to the buffer
+        object's identity — stable for aliasing checks, not arithmetic."""
+        try:
+            return int(self._data.unsafe_buffer_pointer())
+        except Exception:
+            return id(self._data)
+
     def dim(self) -> int:
         return self.ndim
 

@@ -24,7 +24,11 @@ from .comm import (  # noqa: F401
     scatter_object_list, broadcast_object_list, reduce_scatter,
     alltoall, alltoall_single, broadcast, reduce, scatter, barrier, send, recv,
     shard_stack, unstack, ppermute_shift, wait, stream,
+    isend, irecv, P2POp, batch_isend_irecv, reduce_scatter_tensor,
+    all_gather_into_tensor, monitored_barrier, get_backend,
+    destroy_process_group,
 )
+from . import launch  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import fleet  # noqa: F401
 from .auto_parallel_api import (  # noqa: F401

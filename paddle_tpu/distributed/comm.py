@@ -360,3 +360,87 @@ class stream:
     reduce = staticmethod(reduce)
     send = staticmethod(send)
     recv = staticmethod(recv)
+
+
+# --- p2p / torch-style aliases ----------------------------------------------
+
+class _CompletedTask:
+    """Parity handle for async ops: collectives here execute via XLA when
+    the value is consumed, so the task is complete-on-creation."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        return self._result
+
+    def is_completed(self) -> bool:
+        return True
+
+
+def isend(tensor: Tensor, dst: int = 0, group=None):
+    send(tensor, dst=dst, group=group, sync_op=False)
+    return _CompletedTask(tensor)
+
+
+def irecv(tensor: Tensor, src: int = 0, group=None):
+    recv(tensor, src=src, group=group, sync_op=False)
+    return _CompletedTask(tensor)
+
+
+class P2POp:
+    """Parity: paddle.distributed.P2POp — one batched point-to-point op."""
+
+    def __init__(self, op, tensor: Tensor, peer: int, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Run a batch of P2POps; XLA already coalesces the underlying
+    collectives inside one program, so this is a sequential dispatch that
+    returns completed tasks."""
+    tasks = []
+    for p2p in p2p_op_list:
+        tasks.append(p2p.op(p2p.tensor, p2p.peer, group=p2p.group))
+    return [t if isinstance(t, _CompletedTask) else _CompletedTask(t)
+            for t in tasks]
+
+
+def reduce_scatter_tensor(output: Tensor, input: Tensor, op=None, group=None,
+                          sync_op=True):
+    """torch-style alias of reduce_scatter (paddle keeps both spellings)."""
+    return reduce_scatter(output, input,
+                          op=op if op is not None else ReduceOp.SUM,
+                          group=group)
+
+
+def all_gather_into_tensor(output: Tensor, input: Tensor, group=None,
+                           sync_op=True):
+    parts: List[Tensor] = []
+    all_gather(parts, input, group=group)
+    out = jnp.concatenate([p._data for p in parts], axis=0)
+    output._set_data(out)
+    return output
+
+
+def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
+    """Barrier with failure attribution in the reference; ICI barriers are
+    compiler-scheduled so this is the plain barrier."""
+    return barrier(group=group)
+
+
+def get_backend(group=None) -> str:
+    """The collective backend name: XLA over ICI/DCN (the reference returns
+    'NCCL'/'GLOO')."""
+    return "XLA"
+
+
+def destroy_process_group(group=None) -> None:
+    """Tear down eager collective state (parity: the reference frees the
+    NCCL comms; here the mesh/collective caches)."""
+    from . import env as _env
+    if group is None:
+        _env._initialized = False

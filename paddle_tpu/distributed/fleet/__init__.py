@@ -256,3 +256,5 @@ class UtilBase:
 
 
 util = UtilBase()
+
+from . import utils  # noqa: F401,E402  (fleet.utils: recompute, LocalFS)

@@ -144,3 +144,16 @@ class LocalFS:
         if os.path.exists(path) and not exist_ok:
             raise FileExistsError(path)
         open(path, "a").close()
+
+
+class HDFSClient:
+    """Gated parity stub: HDFS access needs a cluster + hadoop binary; this
+    zero-egress build raises with guidance (use LocalFS or mount the data)."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        raise RuntimeError(
+            "HDFSClient is unavailable in this build (no hadoop runtime); "
+            "use fleet.utils.LocalFS or mount the dataset locally.")
+
+
+__all__ += ["HDFSClient"]

@@ -583,7 +583,7 @@ class TransformedDistribution(Distribution):
             ldj_total = ldj if ldj_total is None else ldj_total + ldj
             y = x
         lp = self.base.log_prob(y)
-        return lp - ldj_total
+        return lp if ldj_total is None else lp - ldj_total
 
 
 __all__ += ["TransformedDistribution", "Transform", "AffineTransform",

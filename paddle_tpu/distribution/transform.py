@@ -56,12 +56,29 @@ class Transform:
 
 
 class AffineTransform(Transform):
-    """y = loc + scale * x."""
+    """y = loc + scale * x. loc/scale route through apply() as tensor
+    inputs so gradients flow to them and traces record their reads."""
 
     def __init__(self, loc, scale):
         self.loc = ensure_tensor(loc)
         self.scale = ensure_tensor(scale)
 
+    def forward(self, x):
+        return apply("AffineTransform.fwd", lambda a, l, s: l + s * a,
+                     ensure_tensor(x), self.loc, self.scale)
+
+    def inverse(self, y):
+        return apply("AffineTransform.inv", lambda a, l, s: (a - l) / s,
+                     ensure_tensor(y), self.loc, self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return apply("AffineTransform.fldj",
+                     lambda a, s: jnp.broadcast_to(jnp.log(jnp.abs(s)),
+                                                   a.shape),
+                     ensure_tensor(x), self.scale)
+
+    # raw-array hooks (used by ChainTransform/TransformedDistribution paths
+    # that compose inside one apply)
     def _forward(self, x):
         return self.loc._data + self.scale._data * x
 
@@ -86,6 +103,19 @@ class ExpTransform(Transform):
 class PowerTransform(Transform):
     def __init__(self, power):
         self.power = ensure_tensor(power)
+
+    def forward(self, x):
+        return apply("PowerTransform.fwd", jnp.power, ensure_tensor(x),
+                     self.power)
+
+    def inverse(self, y):
+        return apply("PowerTransform.inv", lambda a, p: jnp.power(a, 1.0 / p),
+                     ensure_tensor(y), self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return apply("PowerTransform.fldj",
+                     lambda a, p: jnp.log(jnp.abs(p * jnp.power(a, p - 1.0))),
+                     ensure_tensor(x), self.power)
 
     def _forward(self, x):
         return jnp.power(x, self.power._data)

@@ -393,3 +393,24 @@ class DataLoader:
             q.close()
         if err:
             raise err[0]
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in random order (reference:
+    paddle.io.SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as np
+
+        from ..core.random import default_generator
+        import jax
+
+        key = default_generator.split_key()
+        perm = np.asarray(jax.random.permutation(key, len(self.indices)))
+        return iter([self.indices[int(i)] for i in perm])
+
+    def __len__(self):
+        return len(self.indices)

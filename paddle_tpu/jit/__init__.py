@@ -18,3 +18,44 @@ from .save_load import save, load, TranslatedLayer  # noqa: F401
 def enable_to_static(flag: bool = True) -> None:
     from .to_static import _set_enabled
     _set_enabled(flag)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False) -> None:
+    """Parity shim: Dy2Static transformed-code dumping. This build traces
+    the original python directly (no generated code to print); the level is
+    recorded for API compatibility."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False) -> None:
+    global _verbosity
+    _verbosity = level
+
+
+_code_level = 0
+_verbosity = 0
+
+
+class TracedLayer:
+    """Parity: paddle.jit.TracedLayer — trace a layer once, replay the
+    compiled program. Wraps ``to_static`` (the trace IS the jaxpr program).
+    """
+
+    def __init__(self, layer, static_fn):
+        self._layer = layer
+        self._fn = static_fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        fn = to_static(lambda *xs: layer(*xs))
+        outs = fn(*inputs)
+        return outs, TracedLayer(layer, fn)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from . import save as _save
+        _save(self._layer, path)

@@ -48,3 +48,6 @@ from .transformer import (  # noqa: F401
 from ..optimizer import (  # noqa: F401  (parity: paddle.nn.ClipGradBy*)
     ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
 )
+from . import quant  # noqa: F401
+from . import decode  # noqa: F401
+from .initializer import set_global_initializer  # noqa: F401

@@ -35,7 +35,7 @@ from ..ops.nn_ext import (  # noqa: F401
     affine_grid, grid_sample, max_unpool2d, rrelu, temporal_shift,
     soft_margin_loss, multi_margin_loss, npair_loss, poisson_nll_loss,
     gaussian_nll_loss, margin_cross_entropy, ctc_loss, rnnt_loss,
-    adaptive_log_softmax_with_loss,
+    adaptive_log_softmax_with_loss, class_center_sample, sparse_attention,
 )
 
 

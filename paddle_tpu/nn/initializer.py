@@ -196,3 +196,19 @@ def _to_initializer(init):
     if callable(init):
         return init
     raise TypeError(f"cannot use {init!r} as an initializer")
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None) -> None:
+    """Default initializers for subsequently-created parameters (reference:
+    paddle.nn.initializer.set_global_initializer). Pass None to reset."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def _global_default(is_bias: bool):
+    return _global_bias_init if is_bias else _global_weight_init

@@ -150,7 +150,11 @@ class Layer:
         if attr is False:
             return None
         dtype = _dtype.convert_dtype(dtype) or self._dtype
-        init = attr.initializer or default_initializer
+        from .initializer import _global_default
+        # precedence (reference semantics): explicit ParamAttr initializer >
+        # set_global_initializer > the layer's own default
+        init = attr.initializer or _global_default(is_bias) \
+            or default_initializer
         if init is None:
             init = Constant(0.0) if is_bias else XavierUniform()
         init = _to_initializer(init)

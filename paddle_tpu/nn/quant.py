@@ -1,0 +1,85 @@
+"""``paddle.nn.quant`` — weight-only quantization helpers.
+
+Parity: python/paddle/nn/quant/ (weight_quantize / weight_dequantize /
+weight_only_linear, llm.int8 path). TPU-native notes: int8 weights live as
+int8 arrays + per-channel fp scales; matmuls upcast to bf16 at use (XLA
+fuses the dequant into the matmul epilogue — there is no separate int8 MXU
+path to schedule by hand).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import ensure_tensor, register_op
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+def weight_quantize(x, algo: str = "weight_only_int8", arch=None, name=None):
+    """Per-output-channel symmetric int8/int4 quantization. Returns
+    (quantized int8 weight, fp32 scales)."""
+    x = ensure_tensor(x)
+    if algo not in ("weight_only_int8", "weight_only_int4", "llm.int8"):
+        raise ValueError(f"unsupported quant algo {algo!r}")
+    qmax = 7.0 if algo == "weight_only_int4" else 127.0
+
+    def f(w):
+        scale = jnp.max(jnp.abs(w), axis=0) / qmax  # per out-channel (k, n)
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(w / scale[None, :]), -qmax, qmax)
+        return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+    out, scale = apply("weight_quantize", f, x, differentiable=False)
+    return out, scale
+
+
+def weight_dequantize(x, scale, algo: str = "weight_only_int8",
+                      out_dtype="float32", name=None):
+    x, scale = ensure_tensor(x), ensure_tensor(scale)
+    from ..core.dtype import convert_dtype
+    dt = convert_dtype(out_dtype)
+    return apply("weight_dequantize",
+                 lambda q, s: q.astype(dt) * s.astype(dt)[None, :],
+                 x, scale, differentiable=False)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
+    """x @ dequant(weight) + bias with the dequant fused into the matmul."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    extras = []
+    if weight_scale is not None:
+        extras.append(ensure_tensor(weight_scale))
+    if bias is not None:
+        extras.append(ensure_tensor(bias))
+
+    def f(a, w, *rest):
+        i = 0
+        if weight_scale is not None:
+            s = rest[i]
+            i += 1
+            w = w.astype(a.dtype) * s.astype(a.dtype)[None, :]
+        else:
+            w = w.astype(a.dtype)
+        out = a @ w
+        if bias is not None:
+            out = out + rest[i]
+        return out
+
+    return apply("weight_only_linear", f, x, weight, *extras)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold: float = 6.0, name=None):
+    """LLM.int8: outlier activation columns compute in fp, the rest in int8
+    (here: the numerics — dequantized matmul with the same API)."""
+    return weight_only_linear(x, weight, bias=bias, weight_scale=weight_scale)
+
+
+for _n in ("weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"):
+    register_op(_n, globals()[_n])

@@ -289,3 +289,133 @@ register_tensor_method("fill_diagonal_tensor_", fill_diagonal_tensor_)
 register_tensor_method("unfold", tensor_unfold)
 register_tensor_method("contiguous", lambda self: self)
 register_tensor_method("is_contiguous", lambda self: True)
+
+
+# --- in-place random fills / scatter family ---------------------------------
+
+def _inplace_random(x, sampler):
+    key = default_generator.split_key()
+    x._set_data(sampler(key).astype(x._data.dtype))
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill with Cauchy samples (reference: Tensor.cauchy_)."""
+    shape = tuple(x._data.shape)
+    return _inplace_random(
+        x, lambda k: loc + scale * jax.random.cauchy(k, shape))
+
+
+def geometric_(x, probs, name=None):
+    shape = tuple(x._data.shape)
+    return _inplace_random(
+        x, lambda k: jax.random.geometric(k, probs, shape).astype(jnp.float32))
+
+
+def exponential_(x, lam=1.0, name=None):
+    shape = tuple(x._data.shape)
+    return _inplace_random(
+        x, lambda k: jax.random.exponential(k, shape) / lam)
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    shape = tuple(x._data.shape)
+    return _inplace_random(
+        x, lambda k: jnp.exp(mean + std * jax.random.normal(k, shape)))
+
+
+def index_fill(x, index, axis, value, name=None):
+    """Fill the rows selected by ``index`` along ``axis`` with ``value``."""
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        filled = moved.at[idx].set(value)
+        return jnp.moveaxis(filled, 0, axis)
+
+    return apply("index_fill", f, x, index)
+
+
+def index_fill_(x, index, axis, value, name=None):
+    return x._rebind(index_fill(x, index, axis, value))
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Copy elements of ``value`` (in order) into positions where ``mask``.
+
+    Static-shape form: the k-th True position receives value.flat[k]."""
+    x, mask, value = ensure_tensor(x), ensure_tensor(mask), ensure_tensor(value)
+    from ..core.tensor import _is_tracer
+    if not (_is_tracer(mask._data) or _is_tracer(value._data)):
+        needed = int(np.asarray(
+            jnp.broadcast_to(mask._data, x._data.shape)).sum())
+        avail = int(np.prod(value._data.shape)) if value._data.shape else 1
+        if avail < needed:
+            raise ValueError(
+                f"masked_scatter: mask selects {needed} elements but value "
+                f"provides only {avail}")
+
+    def f(a, m, v):
+        mb = jnp.broadcast_to(m, a.shape).reshape(-1)
+        flat = a.reshape(-1)
+        # position of each element among the True entries
+        order = jnp.cumsum(mb.astype(jnp.int32)) - 1
+        src = v.reshape(-1)
+        take = jnp.clip(order, 0, src.shape[0] - 1)
+        return jnp.where(mb, src[take], flat).reshape(a.shape)
+
+    return apply("masked_scatter", f, x, mask, value)
+
+
+def masked_scatter_(x, mask, value, name=None):
+    return x._rebind(masked_scatter(x, mask, value))
+
+
+def _tensor_apply(x, func):
+    """Elementwise python callable over the tensor (host round-trip;
+    reference: Tensor.apply — documented as cpu-bound there too)."""
+    arr = np.asarray(x._data)
+    out = np.vectorize(func)(arr).astype(arr.dtype)
+    return Tensor(jnp.asarray(out), stop_gradient=x.stop_gradient)
+
+
+def _tensor_apply_(x, func):
+    x._set_data(_tensor_apply(x, func)._data)
+    return x
+
+
+def _to_sparse_coo(x, sparse_dim=None):
+    from ..sparse import sparse_coo_tensor
+    arr = x._data
+    nz = jnp.nonzero(jnp.asarray(arr))
+    indices = jnp.stack(nz)
+    values = arr[nz]
+    return sparse_coo_tensor(indices, values, tuple(arr.shape))
+
+
+register_op("index_fill", index_fill, methods=("index_fill",))
+register_op("masked_scatter", masked_scatter, methods=("masked_scatter",))
+register_tensor_method("index_fill_", index_fill_)
+register_tensor_method("masked_scatter_", masked_scatter_)
+register_tensor_method("cauchy_", cauchy_)
+register_tensor_method("geometric_", geometric_)
+register_tensor_method("exponential_", exponential_)
+register_tensor_method("log_normal_", log_normal_)
+register_tensor_method("apply", _tensor_apply)
+register_tensor_method("apply_", _tensor_apply_)
+register_tensor_method("to_sparse_coo", _to_sparse_coo)
+register_tensor_method("coalesce", lambda self: self)
+
+
+def _dense_values(self):
+    raise ValueError("Tensor.values() is only defined for sparse tensors; "
+                     "use paddle.sparse.sparse_coo_tensor / to_sparse_coo()")
+
+
+def _dense_indices(self):
+    raise ValueError("Tensor.indices() is only defined for sparse tensors; "
+                     "use paddle.sparse.sparse_coo_tensor / to_sparse_coo()")
+
+
+register_tensor_method("values", _dense_values)
+register_tensor_method("indices", _dense_indices)

@@ -519,3 +519,80 @@ for _name in ("affine_grid", "grid_sample", "max_unpool2d", "rrelu",
               "margin_cross_entropy", "ctc_loss", "rnnt_loss",
               "adaptive_log_softmax_with_loss", "max_pool2d_with_index"):
     register_op(_name, globals()[_name])
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample negative class centers (PartialFC): keep all positive classes
+    plus random negatives up to ``num_samples`` (reference:
+    paddle.nn.functional.class_center_sample). Static output: returns
+    (remapped_label, sampled_class_center) with the sampled set padded to
+    num_samples by the smallest unused class ids."""
+    label = ensure_tensor(label)
+    key = default_generator.split_key()
+    from ..core.tensor import _is_tracer
+    if not _is_tracer(label._data):
+        uniq = int(np.unique(np.asarray(label._data)).shape[0])
+        if uniq > num_samples:
+            raise ValueError(
+                f"class_center_sample: {uniq} distinct positive classes "
+                f"exceed num_samples={num_samples}; labels could not be "
+                "remapped consistently")
+
+    def f(y):
+        y = y.reshape(-1).astype(jnp.int32)
+        pos_mask = jnp.zeros((num_classes,), bool).at[y].set(True)
+        # random priority; positives forced to the front
+        prio = jax.random.uniform(key, (num_classes,))
+        prio = jnp.where(pos_mask, 2.0, prio)
+        _, sampled = jax.lax.top_k(prio, num_samples)
+        sampled = jnp.sort(sampled)
+        # remap: position of each label inside the sampled set
+        rank_in_sampled = jnp.searchsorted(sampled, y)
+        return rank_in_sampled.astype(y.dtype), sampled.astype(y.dtype)
+
+    out = apply("class_center_sample", f, label, differentiable=False)
+    return tuple(out)
+
+
+def sparse_attention(query, key_t, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention (reference: the cuSPARSE-backed
+    sparse_attention op). The CSR pattern selects which keys each query
+    attends to; TPU-native form: dense attention with the complement masked
+    to -inf (XLA fuses the mask; for long sequences route to flash/ring
+    attention instead — documented divergence on the compute pattern, not
+    the semantics)."""
+    query, key_t, value = (ensure_tensor(query), ensure_tensor(key_t),
+                           ensure_tensor(value))
+    offs, cols = ensure_tensor(sparse_csr_offset), ensure_tensor(sparse_csr_columns)
+
+    def f(q, k, v, off, col):
+        b, h, sq, d = q.shape
+        sk = k.shape[2]
+
+        def mask_one(off_bh, col_bh):
+            m = jnp.zeros((sq, sk), bool)
+            # CSR row of nnz entry e: the r with off[r] <= e < off[r+1]
+            row_idx = jnp.searchsorted(off_bh[1:],
+                                       jnp.arange(col_bh.shape[0]),
+                                       side="right")
+            return m.at[row_idx, col_bh].set(True)
+
+        mask = jax.vmap(jax.vmap(mask_one))(
+            off.reshape(b, h, sq + 1), col.reshape(b, h, -1))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (d ** 0.5)
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        # a row with NO csr entries must output zero, not uniform attention
+        # (softmax of an all -1e30 row is uniform)
+        row_has = jnp.any(mask, axis=-1, keepdims=True)
+        probs = probs * row_has.astype(probs.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+
+    return apply("sparse_attention", f, query, key_t, value, offs, cols)
+
+
+register_op("class_center_sample", class_center_sample)
+register_op("sparse_attention", sparse_attention)

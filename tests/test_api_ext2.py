@@ -1,0 +1,227 @@
+"""Tests for API batch 6: comm p2p aliases, nn.quant, class_center_sample,
+sparse_attention, tensor method tail, global initializer, jit fills."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+
+
+class TestCommAliases:
+    def test_backend_and_p2p_types(self):
+        assert dist.get_backend() == "XLA"
+        assert hasattr(dist, "P2POp") and hasattr(dist, "batch_isend_irecv")
+
+    def test_all_gather_into_tensor(self):
+        x = paddle.to_tensor(np.arange(8, dtype="float32").reshape(8, 1))
+        out = paddle.zeros([8, 1])
+        dist.all_gather_into_tensor(out, x)
+        # single-group gather over the 8-dev mesh concatenates the shards
+        assert out.shape[0] == 8
+
+    def test_monitored_barrier_and_destroy(self):
+        dist.monitored_barrier()
+        dist.destroy_process_group()
+        from paddle_tpu.distributed import env
+        assert not env.is_initialized()
+        env.init_parallel_env()
+
+
+class TestQuant:
+    def test_quantize_dequantize_roundtrip(self):
+        w = np.random.randn(8, 16).astype("float32")
+        q, s = nn.quant.weight_quantize(paddle.to_tensor(w))
+        assert str(q.dtype) == "int8"
+        wd = nn.quant.weight_dequantize(q, s)
+        assert np.abs(wd.numpy() - w).max() < np.abs(w).max() / 100
+
+    def test_weight_only_linear(self):
+        w = np.random.randn(8, 16).astype("float32")
+        x = np.random.randn(3, 8).astype("float32")
+        q, s = nn.quant.weight_quantize(paddle.to_tensor(w))
+        out = nn.quant.weight_only_linear(paddle.to_tensor(x), q,
+                                          weight_scale=s)
+        wd = nn.quant.weight_dequantize(q, s).numpy()
+        np.testing.assert_allclose(out.numpy(), x @ wd, atol=1e-4)
+
+    def test_int4(self):
+        w = np.random.randn(4, 4).astype("float32")
+        q, s = nn.quant.weight_quantize(paddle.to_tensor(w),
+                                        algo="weight_only_int4")
+        assert np.abs(np.asarray(q.numpy())).max() <= 7
+
+
+class TestClassCenterSample:
+    def test_positives_always_kept(self):
+        lab = paddle.to_tensor(np.array([1, 5, 9, 5], "int32"))
+        remapped, sampled = nn.functional.class_center_sample(lab, 20, 8)
+        sarr = np.asarray(sampled.numpy())
+        rarr = np.asarray(remapped.numpy())
+        assert sampled.shape == [8]
+        for orig, r in zip([1, 5, 9, 5], rarr):
+            assert sarr[r] == orig
+
+
+class TestSparseAttention:
+    def test_dense_pattern_matches_sdpa(self):
+        qv = paddle.to_tensor(np.random.randn(1, 2, 4, 8).astype("float32"))
+        off = paddle.to_tensor(
+            np.tile(np.arange(0, 17, 4, dtype=np.int32), (1, 2, 1)))
+        cols = paddle.to_tensor(
+            np.tile(np.tile(np.arange(4, dtype=np.int32), 4), (1, 2, 1)))
+        out = nn.functional.sparse_attention(qv, qv, qv, off, cols)
+        ref = nn.functional.scaled_dot_product_attention(
+            qv.transpose([0, 2, 1, 3]), qv.transpose([0, 2, 1, 3]),
+            qv.transpose([0, 2, 1, 3])).transpose([0, 2, 1, 3])
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_banded_pattern_masks(self):
+        # each query attends only to its own key
+        qv = paddle.to_tensor(np.random.randn(1, 1, 4, 8).astype("float32"))
+        off = paddle.to_tensor(np.arange(5, dtype=np.int32).reshape(1, 1, 5))
+        cols = paddle.to_tensor(np.arange(4, dtype=np.int32).reshape(1, 1, 4))
+        out = nn.functional.sparse_attention(qv, qv, qv, off, cols)
+        # diagonal pattern -> output equals value rows exactly
+        np.testing.assert_allclose(out.numpy(), qv.numpy(), atol=1e-5)
+
+
+class TestTensorTail:
+    def test_random_fills(self):
+        t = paddle.zeros([200])
+        t.exponential_(2.0)
+        assert 0.2 < float(t.numpy().mean()) < 1.0  # mean 1/lambda = 0.5
+        t2 = paddle.zeros([50])
+        t2.log_normal_(0.0, 0.25)
+        assert (t2.numpy() > 0).all()
+        t3 = paddle.zeros([50])
+        t3.cauchy_()
+        assert np.isfinite(t3.numpy()).all()
+        t4 = paddle.zeros([50])
+        t4.geometric_(0.5)
+        assert (t4.numpy() >= 1).all()
+
+    def test_index_fill_masked_scatter(self):
+        t = paddle.to_tensor(np.zeros((3, 3), "float32"))
+        out = t.index_fill(paddle.to_tensor(np.array([1])), 1, 9.0)
+        assert out.numpy()[0, 1] == 9.0 and out.numpy()[0, 0] == 0.0
+        m = paddle.to_tensor(np.array([True, False, True]))
+        src = paddle.to_tensor(np.array([7.0, 8.0, 9.0], "float32"))
+        ms = paddle.zeros([3]).masked_scatter(m, src)
+        assert ms.numpy().tolist() == [7.0, 0.0, 8.0]
+
+    def test_apply_and_meta(self):
+        t = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        out = t.apply(lambda v: v * 10)
+        assert out.numpy().tolist() == [10.0, 20.0]
+        t.apply_(lambda v: v + 1)
+        assert t.numpy().tolist() == [2.0, 3.0]
+        assert t.nbytes == 8 and t.itemsize == 4
+        assert isinstance(t.data_ptr(), int)
+        assert not t.is_sparse()
+
+    def test_sparse_bridge(self):
+        d = paddle.to_tensor(np.array([[1.0, 0.0], [0.0, 2.0]], "float32"))
+        sp = d.to_sparse_coo()
+        assert type(sp).__name__ == "SparseCooTensor"
+        with pytest.raises(ValueError):
+            d.values()
+        with pytest.raises(ValueError):
+            d.indices()
+        assert d.coalesce() is d
+
+
+class TestGlobalInitializer:
+    def test_set_and_reset(self):
+        nn.initializer.set_global_initializer(nn.initializer.Constant(0.5),
+                                              nn.initializer.Constant(0.1))
+        try:
+            lin = nn.Linear(3, 3)
+            np.testing.assert_allclose(lin.weight.numpy(), 0.5)
+            np.testing.assert_allclose(lin.bias.numpy(), 0.1)
+        finally:
+            nn.initializer.set_global_initializer(None, None)
+        lin2 = nn.Linear(3, 3)
+        assert not np.allclose(lin2.weight.numpy(), 0.5)
+
+    def test_param_attr_beats_global(self):
+        nn.initializer.set_global_initializer(nn.initializer.Constant(0.5))
+        try:
+            lin = nn.Linear(3, 3, weight_attr=nn.ParamAttr(
+                initializer=nn.initializer.Constant(2.0)))
+            np.testing.assert_allclose(lin.weight.numpy(), 2.0)
+        finally:
+            nn.initializer.set_global_initializer(None, None)
+
+
+class TestJitFills:
+    def test_traced_layer(self):
+        layer = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        outs, traced = paddle.jit.TracedLayer.trace(layer, x)
+        out2 = traced(paddle.to_tensor(np.random.randn(2, 4)
+                                       .astype("float32")))
+        assert out2.shape == [2, 4]
+
+    def test_levels(self):
+        paddle.jit.set_code_level(42)
+        paddle.jit.set_verbosity(3)
+
+
+class TestTopLevelFills:
+    def test_printoptions_and_signal(self):
+        paddle.set_printoptions(precision=3, sci_mode=False)
+        paddle.disable_signal_handler()
+
+    def test_subset_random_sampler(self):
+        s = paddle.io.SubsetRandomSampler([5, 3, 8])
+        assert sorted(list(s)) == [3, 5, 8]
+        assert len(s) == 3
+
+
+class TestReviewFixes6:
+    def test_is_sparse_callable(self):
+        t = paddle.zeros([2])
+        assert t.is_sparse() is False
+        sp = paddle.to_tensor(np.eye(2, dtype="float32")).to_sparse_coo()
+        assert sp.is_sparse() is True
+
+    def test_class_center_sample_overflow_raises(self):
+        lab = paddle.to_tensor(np.arange(10, dtype="int32"))
+        with pytest.raises(ValueError, match="distinct positive"):
+            nn.functional.class_center_sample(lab, 20, 4)
+
+    def test_masked_scatter_insufficient_raises(self):
+        m = paddle.to_tensor(np.array([True, True, True]))
+        with pytest.raises(ValueError, match="masked_scatter"):
+            paddle.zeros([3]).masked_scatter(
+                m, paddle.to_tensor(np.array([1.0], "float32")))
+
+    def test_affine_transform_grads_flow(self):
+        from paddle_tpu.distribution import AffineTransform
+        loc = paddle.to_tensor(np.array([3.0], "float32"),
+                               stop_gradient=False)
+        scale = paddle.to_tensor(np.array([2.0], "float32"),
+                                 stop_gradient=False)
+        t = AffineTransform(loc, scale)
+        x = paddle.to_tensor(np.array([1.5], "float32"))
+        t.forward(x).sum().backward()
+        np.testing.assert_allclose(np.asarray(loc.grad.numpy()), [1.0])
+        np.testing.assert_allclose(np.asarray(scale.grad.numpy()), [1.5])
+
+    def test_sparse_attention_empty_row_zero(self):
+        qv = paddle.to_tensor(np.random.randn(1, 1, 3, 4).astype("float32"))
+        # row 1 empty: offsets [0, 1, 1, 2], cols [0, 2]
+        off = paddle.to_tensor(np.array([[[0, 1, 1, 2]]], "int32"))
+        cols = paddle.to_tensor(np.array([[[0, 2]]], "int32"))
+        out = nn.functional.sparse_attention(qv, qv, qv, off, cols)
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0, 1], 0.0,
+                                   atol=1e-6)
+
+    def test_transformed_empty_transforms(self):
+        from paddle_tpu.distribution import Normal, TransformedDistribution
+        d = TransformedDistribution(Normal(0.0, 1.0), [])
+        v = paddle.to_tensor(np.array([0.3], "float32"))
+        base = Normal(0.0, 1.0).log_prob(v)
+        np.testing.assert_allclose(d.log_prob(v).numpy(), base.numpy())
