@@ -152,3 +152,34 @@ class TestFlashBackwardKernel:
         assert float(jnp.abs(out - out_r).max()) < 1e-5
         for got, ref in zip(vjp(g), vjp_r(g)):
             assert float(jnp.abs(got - ref).max()) < 1e-4
+
+
+class TestFlashTileFitting:
+    def test_fit_block_divisors(self):
+        from paddle_tpu.ops.flash_attention import _fit_block, _pallas_tileable
+        assert _fit_block(1024, 512) == 512
+        assert _fit_block(768, 512) == 256   # 256-multiple keeps flash
+        assert _fit_block(1280, 512) == 256
+        assert _fit_block(96, 512) == 96
+        assert _pallas_tileable(768, 768, 64, 512, 512)
+
+    def test_mid_range_length_matches_xla(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        np.random.seed(0)
+        q = paddle.to_tensor(np.random.randn(1, 768, 4, 16).astype("float32"),
+                             stop_gradient=False)
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        out.sum().backward()
+        g_flash = np.asarray(q.grad.numpy()).copy()
+        q2 = paddle.to_tensor(q.numpy(), stop_gradient=False)
+        paddle.set_flags({"FLAGS_flash_impl": "xla"})
+        try:
+            out2 = F.scaled_dot_product_attention(q2, q2, q2, is_causal=True)
+            out2.sum().backward()
+        finally:
+            paddle.set_flags({"FLAGS_flash_impl": "pallas"})
+        np.testing.assert_allclose(out.numpy(), out2.numpy(), atol=2e-3)
+        np.testing.assert_allclose(g_flash, np.asarray(q2.grad.numpy()),
+                                   atol=2e-3)
