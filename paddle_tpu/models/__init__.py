@@ -7,6 +7,7 @@ Each family lives here as a first-class citizen of the TPU framework.
 
 from . import llama  # noqa: F401
 from . import bert  # noqa: F401
+from . import gpt  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import ernie  # noqa: F401
 from . import ppyoloe  # noqa: F401

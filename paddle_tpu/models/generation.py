@@ -1,0 +1,71 @@
+"""Shared autoregressive decoding loop (reference surface: PaddleNLP
+GenerationMixin.generate — greedy by default, temperature/top-k/top-p
+sampling, finished rows frozen to eos).
+
+One implementation for every decoder LM in the zoo: the model supplies a
+``step(x, caches) -> (hidden, caches)`` and a ``logits(hidden_last)``.
+"""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from ..core.tracing import no_grad
+from ..ops.manipulation import concat
+
+
+def sample_token(arr, do_sample: bool, temperature: float, top_k: int,
+                 top_p: float):
+    """Pick next-token ids from fp32 logits (B, V)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.random import default_generator
+
+    if not do_sample or temperature == 0:
+        return jnp.argmax(arr, axis=-1)
+    if temperature != 1.0:
+        arr = arr / temperature
+    if top_k:
+        kth = jnp.sort(arr, axis=-1)[..., -top_k][..., None]
+        arr = jnp.where(arr < kth, -jnp.inf, arr)
+    if top_p < 1.0:
+        srt = jnp.sort(arr, axis=-1)[..., ::-1]
+        cdf = jnp.cumsum(jax.nn.softmax(srt, -1), axis=-1)
+        cut_idx = jnp.sum(cdf < top_p, axis=-1, keepdims=True)
+        cut = jnp.take_along_axis(srt, cut_idx, axis=-1)
+        arr = jnp.where(arr < cut, -jnp.inf, arr)
+    return jax.random.categorical(default_generator.split_key(), arr)
+
+
+def kv_cache_generate(step, logits_fn, input_ids, caches,
+                      max_new_tokens: int = 32, temperature: float = 1.0,
+                      top_k: int = 0, top_p: float = 1.0,
+                      do_sample: bool = False, eos_token_id=None):
+    """Prefill the prompt, then decode one cached token at a time.
+
+    ``step(x, caches) -> (hidden, caches)``; ``logits_fn(hidden_last)``
+    maps the final hidden state (B, H) to logits (B, V).
+    """
+    import jax.numpy as jnp
+
+    b = input_ids.shape[0]
+    with no_grad():
+        tokens = [input_ids]
+        x = input_ids
+        finished = jnp.zeros((b,), bool)
+        for _ in range(max_new_tokens):
+            h, caches = step(x, caches)
+            arr = logits_fn(h[:, -1])._data.astype(jnp.float32)
+            nxt = sample_token(arr, do_sample, temperature, top_k, top_p)
+            if eos_token_id is not None:
+                # rows already finished keep emitting eos (the reference
+                # generate freezes finished sequences to eos/pad)
+                nxt = jnp.where(finished,
+                                jnp.asarray(eos_token_id, nxt.dtype), nxt)
+                finished = finished | (nxt == eos_token_id)
+            t = Tensor(nxt[:, None])
+            tokens.append(t)
+            x = t
+            if eos_token_id is not None and bool(finished.all()):
+                break
+    return concat(tokens, axis=1)

@@ -1,0 +1,181 @@
+"""GPT-2/3-style decoder LM (reference surface: PaddleNLP
+paddlenlp/transformers/gpt/ — GPTModel / GPTForCausalLM, the other
+decoder-LM family the NLP zoo trains besides Llama).
+
+Architecture: learned position embeddings, pre-LN blocks, fused-QKV
+attention through the SDPA seam (flash routing included), GELU MLP.
+KV-cache generation reuses the Llama decode loop shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..ops.manipulation import concat, reshape, transpose
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    tie_word_embeddings: bool = True
+
+    @staticmethod
+    def tiny(vocab=128, hidden=64, layers=2, heads=4, inter=128, max_pos=128):
+        return GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                         num_hidden_layers=layers, num_attention_heads=heads,
+                         intermediate_size=inter,
+                         max_position_embeddings=max_pos)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv_proj = nn.Linear(h, 3 * h)
+        self.out_proj = nn.Linear(h, h)
+
+    def forward(self, x, cache=None):
+        b, l = x.shape[0], x.shape[1]
+        qkv = reshape(self.qkv_proj(x), [b, l, 3, self.num_heads,
+                                         self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cache is not None:
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=self.training)
+        out = self.out_proj(reshape(out, [b, l, -1]))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.ln_1 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.fc_in = nn.Linear(h, config.intermediate_size)
+        self.fc_out = nn.Linear(config.intermediate_size, h)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), cache=cache)
+        else:
+            a = self.attn(self.ln_1(x))
+        x = x + a
+        x = x + self.fc_out(F.gelu(self.fc_in(self.ln_2(x))))
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.embed_positions = nn.Embedding(config.max_position_embeddings,
+                                            config.hidden_size)
+        self.layers = nn.LayerList([GPTBlock(config)
+                                    for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, caches=None):
+        import jax.numpy as jnp
+
+        l = input_ids.shape[1]
+        offset = 0 if caches is None else int(caches[0][0].shape[1])
+        pos = Tensor(jnp.arange(offset, offset + l)[None, :])
+        x = self.embed_tokens(input_ids) + self.embed_positions(pos)
+        if caches is None:
+            for layer in self.layers:
+                x = layer(x)
+            return self.ln_f(x)
+        new_caches = []
+        for layer, c in zip(self.layers, caches):
+            x, nc = layer(x, cache=c)
+            new_caches.append(nc)
+        return self.ln_f(x), new_caches
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.model = GPTModel(config)
+        self.lm_head = (None if config.tie_word_embeddings
+                        else nn.Linear(config.hidden_size, config.vocab_size,
+                                       bias_attr=False))
+
+    def _logits(self, h):
+        if self.lm_head is not None:
+            return self.lm_head(h)
+        return F.linear(h, transpose(self.model.embed_tokens.weight, [1, 0]))
+
+    def forward(self, input_ids, labels=None):
+        h = self.model(input_ids)
+        logits = self._logits(h)
+        if labels is not None:
+            loss = F.cross_entropy(
+                reshape(logits[:, :-1, :], [-1, self.config.vocab_size]),
+                reshape(labels[:, 1:], [-1]))
+            return loss, logits
+        return logits
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 do_sample: bool = False, eos_token_id=None):
+        """KV-cached decode via the shared generation loop."""
+        import jax.numpy as jnp
+
+        from .generation import kv_cache_generate
+
+        cfg = self.config
+        b = input_ids.shape[0]
+        if input_ids.shape[1] + max_new_tokens > cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt ({input_ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_position_embeddings "
+                f"({cfg.max_position_embeddings}); learned positions cannot "
+                "extrapolate")
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        empty = jnp.zeros((b, 0, cfg.num_attention_heads, hd),
+                          self.model.embed_tokens.weight._data.dtype)
+        caches = [(Tensor(empty), Tensor(empty))
+                  for _ in range(cfg.num_hidden_layers)]
+        return kv_cache_generate(
+            lambda x, c: self.model(x, caches=c), self._logits, input_ids,
+            caches, max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, do_sample=do_sample,
+            eos_token_id=eos_token_id)
+
+
+def gpt2_small() -> GPTConfig:
+    return GPTConfig()
+
+
+def gpt2_medium() -> GPTConfig:
+    return GPTConfig(hidden_size=1024, num_hidden_layers=24,
+                     num_attention_heads=16, intermediate_size=4096)
+
+
+def gpt2_large() -> GPTConfig:
+    return GPTConfig(hidden_size=1280, num_hidden_layers=36,
+                     num_attention_heads=20, intermediate_size=5120)

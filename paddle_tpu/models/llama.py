@@ -207,15 +207,11 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                  do_sample: bool = False, eos_token_id=None):
-        """Autoregressive decode with a KV cache (reference surface:
-        PaddleNLP GenerationMixin.generate — greedy by default, optional
-        temperature/top-k/top-p sampling). Prefill processes the prompt in
-        one pass; each subsequent step feeds one token against the cache."""
-        import jax
+        """Autoregressive decode with a KV cache via the shared generation
+        loop (reference surface: PaddleNLP GenerationMixin.generate)."""
         import jax.numpy as jnp
 
-        from ..core.random import default_generator
-        from ..core.tracing import no_grad
+        from .generation import kv_cache_generate
 
         cfg = self.config
         b = input_ids.shape[0]
@@ -225,43 +221,11 @@ class LlamaForCausalLM(nn.Layer):
                           self.model.embed_tokens.weight._data.dtype)
         caches = [(Tensor(empty), Tensor(empty))
                   for _ in range(cfg.num_hidden_layers)]
-
-        def pick(logits):
-            arr = logits._data.astype(jnp.float32)
-            if not do_sample or temperature == 0:
-                return jnp.argmax(arr, axis=-1)
-            if temperature != 1.0:
-                arr = arr / temperature
-            if top_k:
-                kth = jnp.sort(arr, axis=-1)[..., -top_k][..., None]
-                arr = jnp.where(arr < kth, -jnp.inf, arr)
-            if top_p < 1.0:
-                srt = jnp.sort(arr, axis=-1)[..., ::-1]
-                cdf = jnp.cumsum(jax.nn.softmax(srt, -1), axis=-1)
-                cut_idx = jnp.sum(cdf < top_p, axis=-1, keepdims=True)
-                cut = jnp.take_along_axis(srt, cut_idx, axis=-1)
-                arr = jnp.where(arr < cut, -jnp.inf, arr)
-            return jax.random.categorical(default_generator.split_key(), arr)
-
-        with no_grad():
-            tokens = [input_ids]
-            x = input_ids
-            finished = jnp.zeros((b,), bool)
-            for _ in range(max_new_tokens):
-                h, caches = self.model(x, caches=caches)
-                nxt = pick(self._logits(h[:, -1]))
-                if eos_token_id is not None:
-                    # rows already finished keep emitting eos (reference
-                    # generate freezes finished sequences to eos/pad)
-                    nxt = jnp.where(finished,
-                                    jnp.asarray(eos_token_id, nxt.dtype), nxt)
-                    finished = finished | (nxt == eos_token_id)
-                t = Tensor(nxt[:, None])
-                tokens.append(t)
-                x = t
-                if eos_token_id is not None and bool(finished.all()):
-                    break
-        return concat(tokens, axis=1)
+        return kv_cache_generate(
+            lambda x, c: self.model(x, caches=c), self._logits, input_ids,
+            caches, max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, do_sample=do_sample,
+            eos_token_id=eos_token_id)
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())

@@ -228,16 +228,18 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _fit_block(length: int, want: int, floor: int = 128):
-    """Largest tile <= ``want`` that divides ``length`` (halving down to
-    ``floor``, then any divisor >= 8). Keeps mid-range lengths (768, 1280,
-    ...) on the flash path when the preferred tile doesn't divide them."""
-    length = int(length)
-    b = min(int(want), length)
-    while b >= floor:
-        if length % b == 0:
-            return b
-        b //= 2
-    for b in range(min(int(want), length), 7, -1):
+    """Largest lane-aligned tile <= ``want`` dividing ``length``.
+
+    Sequences shorter than the preferred tile use one full-length block
+    (the pre-tuning ``min(bq, lq)`` behavior); longer ones scan 128-multiple
+    divisors (768 -> 384, 1280 -> 256). Unaligned lengths (1000, 1001)
+    return None and stay on the XLA fallback — Mosaic needs lane/sublane
+    aligned trailing block dims."""
+    length, want = int(length), int(want)
+    if length <= want:
+        return length
+    b0 = min(want, length)
+    for b in range(b0 - b0 % floor, floor - 1, -floor):
         if length % b == 0:
             return b
     return None
@@ -393,20 +395,21 @@ def _flash_dispatch(q, k, v, causal, sm_scale):
 
 
 def _bwd_kernel_eligible(q, k):
+    """Eligibility AND the fitted tiles, so callers use the same blocks the
+    check was made with: (use_kernel, interpret, bq, bk)."""
     impl = _flags.flag("flash_impl")
     on_tpu = jax.default_backend() not in ("cpu",)
     lq, lk, d = q.shape[2], k.shape[2], q.shape[3]
-    bq = int(_flags.flag("flash_block_q"))
-    bk = int(_flags.flag("flash_block_k"))
-    return (impl == "pallas" and _pallas_tileable(lq, lk, d, bq, bk)
-            and d % 8 == 0), (not on_tpu)
+    bq = _fit_block(lq, int(_flags.flag("flash_block_q")))
+    bk = _fit_block(lk, int(_flags.flag("flash_block_k")))
+    use = (impl == "pallas" and bq is not None and bk is not None
+           and d % 8 == 0)
+    return use, (not on_tpu), bq, bk
 
 
 def _flash_fwd(q, k, v, causal, sm_scale):
-    use_kernel, interpret = _bwd_kernel_eligible(q, k)
+    use_kernel, interpret, bq, bk = _bwd_kernel_eligible(q, k)
     if use_kernel:
-        bq = _fit_block(q.shape[2], int(_flags.flag("flash_block_q")))
-        bk = _fit_block(k.shape[2], int(_flags.flag("flash_block_k")))
         out, lse = _pallas_flash(q, k, v, causal, sm_scale, bq, bk,
                                  interpret, with_lse=True)
         return out, (q, k, v, out, lse)
@@ -456,9 +459,7 @@ def _flash_bwd(causal, sm_scale, res, g):
     if lse is not None:
         # dedicated Pallas backward (dq streaming K/V; fused dk/dv streaming
         # Q/dO) — recompute-from-lse, never materializes (Lq, Lk)
-        _, interpret = _bwd_kernel_eligible(q, k)
-        bq = _fit_block(q.shape[2], int(_flags.flag("flash_block_q")))
-        bk = _fit_block(k.shape[2], int(_flags.flag("flash_block_k")))
+        _, interpret, bq, bk = _bwd_kernel_eligible(q, k)
         return _pallas_flash_bwd(q, k, v, out, lse, g, causal, sm_scale,
                                  bq, bk, interpret)
     # fallback: AD through the blockwise-remat form so the (Lq, Lk) matrix is

@@ -158,10 +158,14 @@ class TestFlashTileFitting:
     def test_fit_block_divisors(self):
         from paddle_tpu.ops.flash_attention import _fit_block, _pallas_tileable
         assert _fit_block(1024, 512) == 512
-        assert _fit_block(768, 512) == 256   # 256-multiple keeps flash
+        assert _fit_block(768, 512) == 384   # largest 128-multiple divisor
         assert _fit_block(1280, 512) == 256
-        assert _fit_block(96, 512) == 96
+        assert _fit_block(96, 512) == 96     # short seq: one full block
+        # unaligned lengths stay off the Pallas path (XLA fallback)
+        assert _fit_block(1000, 512) is None
+        assert _fit_block(1001, 512) is None
         assert _pallas_tileable(768, 768, 64, 512, 512)
+        assert not _pallas_tileable(1000, 1000, 64, 512, 512)
 
     def test_mid_range_length_matches_xla(self):
         import numpy as np

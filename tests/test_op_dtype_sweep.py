@@ -1,0 +1,179 @@
+"""Dtype-sweep + numeric-gradient op tests.
+
+The reference's OpTest backbone (test/legacy_test/op_test.py, SURVEY.md §4)
+runs every op across dtypes with per-dtype tolerance tables and checks
+registered grads against finite differences. This file carries both
+patterns: fp32/bf16/fp16 forward sweeps vs a NumPy reference computed in
+fp64, and central-difference gradient checks against the tape.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.default_rng(7)
+
+# per-dtype tolerances, mirroring the reference's tables
+TOLS = {
+    "float32": dict(rtol=2e-4, atol=1e-6),
+    "bfloat16": dict(rtol=2e-2, atol=2e-2),
+    "float16": dict(rtol=2e-3, atol=2e-3),
+}
+
+DTYPES = ["float32", "bfloat16", "float16"]
+
+
+def _cast(x, dtype):
+    return paddle.to_tensor(jnp.asarray(x).astype(jnp.dtype(dtype)))
+
+
+SWEEP_CASES = [
+    # (op, numpy reference on fp64, generator)
+    ("exp", np.exp, lambda s: RNG.uniform(-2, 2, s)),
+    ("log", np.log, lambda s: RNG.uniform(0.2, 3, s)),
+    ("sqrt", np.sqrt, lambda s: RNG.uniform(0.1, 4, s)),
+    ("tanh", np.tanh, lambda s: RNG.uniform(-3, 3, s)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), lambda s: RNG.uniform(-4, 4, s)),
+    ("square", np.square, lambda s: RNG.uniform(-2, 2, s)),
+    ("abs", np.abs, lambda s: RNG.uniform(-2, 2, s)),
+]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("name,ref,gen", SWEEP_CASES,
+                         ids=[c[0] for c in SWEEP_CASES])
+def test_unary_dtype_sweep(name, ref, gen, dtype):
+    x64 = gen((4, 5))
+    out = getattr(paddle, name)(_cast(x64, dtype))
+    assert str(out.dtype) == dtype  # dtype must be preserved
+    expected = ref(x64)
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float64), expected,
+                               **TOLS[dtype])
+
+
+BINARY_SWEEP = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum),
+]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("name,ref", BINARY_SWEEP,
+                         ids=[c[0] for c in BINARY_SWEEP])
+def test_binary_dtype_sweep(name, ref, dtype):
+    a = RNG.uniform(0.5, 2, (3, 4))
+    b = RNG.uniform(0.5, 2, (3, 4))
+    out = getattr(paddle, name)(_cast(a, dtype), _cast(b, dtype))
+    assert str(out.dtype) == dtype
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float64),
+                               ref(a, b), **TOLS[dtype])
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_matmul_dtype_sweep(dtype):
+    a = RNG.uniform(-1, 1, (8, 16))
+    b = RNG.uniform(-1, 1, (16, 8))
+    out = paddle.matmul(_cast(a, dtype), _cast(b, dtype))
+    assert str(out.dtype) == dtype
+    tol = dict(TOLS[dtype])
+    if dtype != "float32":  # accumulation over K widens the error
+        tol = dict(rtol=5e-2, atol=5e-2)
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float64), a @ b,
+                               **tol)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_softmax_layernorm_dtype_sweep(dtype):
+    x = RNG.uniform(-3, 3, (4, 10))
+    out = paddle.nn.functional.softmax(_cast(x, dtype))
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(out.numpy(), np.float64),
+                               e / e.sum(-1, keepdims=True), **TOLS[dtype])
+    ln = paddle.nn.functional.layer_norm(_cast(x, dtype), [10])
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(ln.numpy(), np.float64), ref,
+                               **TOLS[dtype])
+
+
+# --- numeric (finite difference) gradient checks -----------------------------
+
+def _numeric_grad(fn, x, eps=1e-3):
+    """Central differences of sum(fn(x)) w.r.t. x (fp32)."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.shape[0]):
+        orig = flat[i]
+        flat[i] = orig + eps
+        up = float(fn(paddle.to_tensor(x.copy())).sum())
+        flat[i] = orig - eps
+        dn = float(fn(paddle.to_tensor(x.copy())).sum())
+        flat[i] = orig
+        gf[i] = (up - dn) / (2 * eps)
+    return g
+
+
+GRADCHECK_CASES = [
+    ("exp", lambda v: paddle.exp(v), lambda s: RNG.uniform(-1, 1, s)),
+    ("log", lambda v: paddle.log(v), lambda s: RNG.uniform(0.5, 2, s)),
+    ("tanh", lambda v: paddle.tanh(v), lambda s: RNG.uniform(-1, 1, s)),
+    ("sqrt", lambda v: paddle.sqrt(v), lambda s: RNG.uniform(0.5, 2, s)),
+    ("softmax", lambda v: paddle.nn.functional.softmax(v),
+     lambda s: RNG.uniform(-1, 1, s)),
+    ("sigmoid", lambda v: paddle.nn.functional.sigmoid(v),
+     lambda s: RNG.uniform(-1, 1, s)),
+    ("square", lambda v: paddle.square(v), lambda s: RNG.uniform(-1, 1, s)),
+    ("mean", lambda v: paddle.mean(v), lambda s: RNG.uniform(-1, 1, s)),
+    ("logsumexp", lambda v: paddle.logsumexp(v),
+     lambda s: RNG.uniform(-1, 1, s)),
+    ("gelu", lambda v: paddle.nn.functional.gelu(v),
+     lambda s: RNG.uniform(-1, 1, s)),
+]
+
+
+@pytest.mark.parametrize("name,fn,gen", GRADCHECK_CASES,
+                         ids=[c[0] for c in GRADCHECK_CASES])
+def test_check_grad_numeric(name, fn, gen):
+    x = gen((3, 3)).astype(np.float32)
+    xt = paddle.to_tensor(x.copy(), stop_gradient=False)
+    fn(xt).sum().backward()
+    analytic = np.asarray(xt.grad.numpy())
+    numeric = _numeric_grad(fn, x.copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)
+
+
+def test_check_grad_matmul():
+    a = RNG.uniform(-1, 1, (3, 4)).astype(np.float32)
+    b = RNG.uniform(-1, 1, (4, 2)).astype(np.float32)
+    bt = paddle.to_tensor(b)
+    at = paddle.to_tensor(a.copy(), stop_gradient=False)
+    paddle.matmul(at, bt).sum().backward()
+    analytic = np.asarray(at.grad.numpy())
+    numeric = _numeric_grad(lambda v: paddle.matmul(v, bt), a.copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)
+
+
+def test_check_grad_conv2d():
+    x = RNG.uniform(-1, 1, (1, 2, 6, 6)).astype(np.float32)
+    w = paddle.to_tensor(RNG.uniform(-1, 1, (3, 2, 3, 3)).astype(np.float32))
+    xt = paddle.to_tensor(x.copy(), stop_gradient=False)
+    paddle.nn.functional.conv2d(xt, w, padding=1).sum().backward()
+    analytic = np.asarray(xt.grad.numpy())
+    numeric = _numeric_grad(
+        lambda v: paddle.nn.functional.conv2d(v, w, padding=1), x.copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)
+
+
+def test_check_grad_cross_entropy():
+    logits = RNG.uniform(-1, 1, (4, 5)).astype(np.float32)
+    labels = paddle.to_tensor(np.array([0, 2, 4, 1]))
+    lt = paddle.to_tensor(logits.copy(), stop_gradient=False)
+    paddle.nn.functional.cross_entropy(lt, labels).backward()
+    analytic = np.asarray(lt.grad.numpy())
+    numeric = _numeric_grad(
+        lambda v: paddle.nn.functional.cross_entropy(v, labels),
+        logits.copy())
+    np.testing.assert_allclose(analytic, numeric, rtol=2e-2, atol=2e-3)
