@@ -19,3 +19,76 @@ from . import features  # noqa: F401
 
 __all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]
+
+
+class _BackendsNS:
+    """``paddle.audio.backends`` parity: upstream wraps soundfile/wave IO.
+    This zero-egress build reads WAV via the stdlib."""
+
+    @staticmethod
+    def list_available_backends():
+        return ["wave"]
+
+    @staticmethod
+    def get_current_backend():
+        return "wave"
+
+    @staticmethod
+    def set_backend(backend: str):
+        if backend != "wave":
+            raise ValueError("only the stdlib 'wave' backend ships here")
+
+    @staticmethod
+    def load(filepath, frame_offset=0, num_frames=-1, normalize=True):
+        import numpy as np
+        import wave as _wave
+
+        with _wave.open(str(filepath), "rb") as w:
+            sr = w.getframerate()
+            n = w.getnframes() if num_frames < 0 else num_frames
+            w.setpos(frame_offset)
+            raw = w.readframes(n)
+            width = w.getsampwidth()
+            if width == 1:  # 8-bit WAV PCM is UNSIGNED, midpoint 128
+                data = (np.frombuffer(raw, dtype=np.uint8)
+                        .astype(np.float32) - 128.0)
+                if normalize:
+                    data = data / 128.0
+            elif width in (2, 4):
+                dt = {2: np.int16, 4: np.int32}[width]
+                data = np.frombuffer(raw, dtype=dt).astype(np.float32)
+                if normalize:
+                    data = data / float(np.iinfo(dt).max)
+            else:
+                raise ValueError(
+                    f"unsupported WAV sample width {width} bytes (24-bit "
+                    "PCM is not supported by the stdlib backend)")
+            ch = w.getnchannels()
+            if ch > 1:
+                data = data.reshape(-1, ch).T
+        from ..core.tensor import to_tensor
+        import jax.numpy as jnp
+        return to_tensor(jnp.asarray(data)), sr
+
+
+backends = _BackendsNS()
+
+
+class _AudioDatasetsNS:
+    """``paddle.audio.datasets`` parity: TESS/ESC50 are download-datasets
+    upstream; this build gates them (zero egress) behind a clear error."""
+
+    class TESS:
+        def __init__(self, *a, **k):
+            raise RuntimeError("audio.datasets.TESS needs the downloaded "
+                               "corpus; place it locally and load via "
+                               "paddle.audio.backends.load")
+
+    class ESC50:
+        def __init__(self, *a, **k):
+            raise RuntimeError("audio.datasets.ESC50 needs the downloaded "
+                               "corpus; place it locally and load via "
+                               "paddle.audio.backends.load")
+
+
+datasets = _AudioDatasetsNS()

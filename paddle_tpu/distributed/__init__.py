@@ -57,3 +57,15 @@ def spawn(func, args=(), nprocs=-1, **kwargs):
     on TPU; multi-host fan-out is the launcher's job)."""
     init_parallel_env()
     func(*args)
+
+from . import comm as communication  # noqa: F401,E402  (module path parity)
+from . import comm as collective  # noqa: F401,E402
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Parity: CPU-only bootstrap — same coordination path here."""
+    return init_parallel_env()
+
+
+def parallel_with_gloo():  # pragma: no cover - trivial parity shim
+    return init_parallel_env()

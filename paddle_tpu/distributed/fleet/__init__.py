@@ -258,3 +258,25 @@ class UtilBase:
 util = UtilBase()
 
 from . import utils  # noqa: F401,E402  (fleet.utils: recompute, LocalFS)
+
+from . import meta_parallel  # noqa: F401,E402
+from . import meta_optimizers  # noqa: F401,E402
+from . import mp_layers as layers  # noqa: F401,E402  (fleet.layers.mpu parity)
+
+
+def model(m):
+    """Parity alias: fleet.model == fleet.distributed_model."""
+    return distributed_model(m)
+
+
+def optimizer(opt, strategy=None):
+    """Parity alias: fleet.optimizer == fleet.distributed_optimizer."""
+    return distributed_optimizer(opt, strategy)
+
+
+def distributed_scaler(scaler):
+    """Wrap an amp GradScaler for hybrid parallel (parity:
+    fleet.distributed_scaler). Gradient collectives already ride the mesh
+    inside the compiled step, so the scaler's found_inf aggregation is the
+    only distributed concern — all_reduce folds it across ranks."""
+    return scaler

@@ -1,0 +1,8 @@
+"""``paddle.distributed.fleet.meta_optimizers`` namespace (reference:
+python/paddle/distributed/fleet/meta_optimizers/) — the dygraph sharding
+optimizer lives here upstream; the hybrid-parallel wrapping is
+``fleet.distributed_optimizer``'s job in this build."""
+
+from ..sharding import DygraphShardingOptimizer  # noqa: F401
+
+__all__ = ["DygraphShardingOptimizer"]

@@ -1,0 +1,12 @@
+"""``paddle.distributed.fleet.meta_parallel`` namespace (reference:
+python/paddle/distributed/fleet/meta_parallel/) — re-exports the parallel
+wrappers from their implementation modules."""
+
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, get_rng_state_tracker,
+)
+from .pipeline_parallel import (  # noqa: F401
+    LayerDesc, PipelineLayer, PipelineParallel, SharedLayerDesc,
+)
+from .tpu_pipeline import pipelined_forward, stack_stage_params  # noqa: F401

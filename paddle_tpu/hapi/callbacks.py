@@ -212,3 +212,95 @@ class LRScheduler(Callback):
         s = self._sched()
         if self.by_epoch and s is not None:
             s.step()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a monitored metric plateaus (reference:
+    paddle.callbacks.ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.min_delta, self.cooldown, self.min_lr = min_delta, cooldown, min_lr
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = float("inf") if self.mode == "min" else -float("inf")
+
+    def _better(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    # exactly ONE hook fires per epoch: eval metrics step in on_eval_end,
+    # train metrics in on_epoch_end — never both (double-stepping would halve
+    # patience and mix two metric series in one plateau tracker)
+    def on_eval_end(self, logs=None):
+        if self.monitor.startswith("eval_"):
+            self._step(logs or {})
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.monitor.startswith("eval_"):
+            self._step(logs or {})
+
+    def _step(self, logs):
+        cur = logs.get(self.monitor)
+        if cur is None and self.monitor.startswith("eval_"):
+            cur = logs.get(self.monitor[len("eval_"):])
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                try:
+                    lr = opt.get_lr()
+                    opt.set_lr(max(lr * self.factor, self.min_lr))
+                except RuntimeError:
+                    pass  # scheduler-driven LR: the scheduler owns decay
+            self.wait = 0
+            self.cooldown_counter = self.cooldown
+
+
+class VisualDL(Callback):
+    """Scalar logger with the VisualDL callback surface (reference:
+    paddle.callbacks.VisualDL). VisualDL itself isn't in this build; scalars
+    land in TensorBoard-compatible jsonl under ``log_dir`` that
+    ``jax.profiler``/XProf tooling and plain readers consume."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._fh = open(f"{self.log_dir}/scalars.jsonl", "a")
+
+    def on_train_batch_end(self, step, logs=None):
+        import json
+        self._step += 1
+        if self._fh and logs:
+            rec = {"step": self._step}
+            for k, v in logs.items():
+                try:
+                    rec[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
+                except (TypeError, ValueError):
+                    continue
+            self._fh.write(json.dumps(rec) + "\n")
+
+    def on_train_end(self, logs=None):
+        if self._fh:
+            self._fh.close()
+            self._fh = None

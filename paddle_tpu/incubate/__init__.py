@@ -7,6 +7,10 @@ from . import autograd  # noqa: F401
 from . import multiprocessing  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import xpu  # noqa: F401
+from . import jit  # noqa: F401
+from . import layers  # noqa: F401
+from . import operators  # noqa: F401
+from . import checkpoint  # noqa: F401
 from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
 from ..geometric import segment_sum, segment_mean, segment_max, segment_min  # noqa: F401
 from .moe import MoELayer  # noqa: F401

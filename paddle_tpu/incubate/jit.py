@@ -1,0 +1,26 @@
+"""``paddle.incubate.jit`` — inference-targeted jit decorators (reference:
+python/paddle/incubate/jit/). ``inference()`` wraps a function/layer with
+whole-program compilation; on this runtime that is exactly ``to_static``."""
+
+from __future__ import annotations
+
+from ..jit import to_static
+
+__all__ = ["inference"]
+
+
+def inference(function=None, cache_static_model=False, **kwargs):
+    """Compile a layer/function for inference (to_static + no_grad)."""
+    from ..core.tracing import no_grad
+
+    def wrap(fn):
+        call = fn.forward if hasattr(fn, "forward") else fn
+        static = to_static(call)
+
+        def runner(*args, **kw):
+            with no_grad():
+                return static(*args, **kw)
+
+        return runner
+
+    return wrap(function) if function is not None else wrap

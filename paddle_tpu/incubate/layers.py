@@ -1,0 +1,24 @@
+"""``paddle.incubate.layers`` — legacy fused layer fns (reference:
+python/paddle/incubate/layers/nn.py). The commonly-used entries map onto
+the modern ops; the rest of the upstream file is PS-era sparse kernels."""
+
+from __future__ import annotations
+
+from ..nn import functional as F
+
+__all__ = ["fused_embedding_seq_pool", "shuffle_batch"]
+
+
+def fused_embedding_seq_pool(input, weight, pool_type="sum"):
+    return F.embedding_bag(input, weight, mode=pool_type)
+
+
+def shuffle_batch(x, seed=None):
+    import jax
+    from ..core.random import default_generator
+    from ..core.tensor import Tensor
+
+    key = default_generator.split_key() if seed is None else \
+        jax.random.PRNGKey(seed)
+    perm = jax.random.permutation(key, x.shape[0])
+    return Tensor(x._data[perm])

@@ -119,3 +119,40 @@ class FusedFeedForward(nn.Layer):
 class FusedLinear(nn.Linear):
     """API parity: a Linear whose matmul+bias is one fused op (on TPU, XLA
     already emits the fused epilogue — this subclass exists for imports)."""
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """Parity: incubate.nn.FusedTransformerEncoderLayer — the fused encoder
+    block; lowers to the same composition XLA fuses (SDPA/flash + matmul
+    epilogues)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", act_dropout_rate=None,
+                 attn_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.inner = nn.TransformerEncoderLayer(
+            d_model, nhead, dim_feedforward, dropout=dropout_rate,
+            activation=activation,
+            act_dropout=act_dropout_rate, attn_dropout=attn_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.inner(src, src_mask)
+
+
+class FusedMoELayer(nn.Layer):
+    """Parity: incubate.nn.FusedMoELayer — routes to the MoE layer whose
+    dispatch is the dense padded all-to-all."""
+
+    def __init__(self, d_model, dim_feedforward, num_experts, top_k=2,
+                 **kwargs):
+        super().__init__()
+        from .moe import MoELayer
+        self.inner = MoELayer(d_model=d_model, hidden_size=dim_feedforward,
+                              num_experts=num_experts, top_k=top_k)
+
+    def forward(self, x):
+        return self.inner(x)
+
+
+__all__ += ["FusedTransformerEncoderLayer", "FusedMoELayer"]

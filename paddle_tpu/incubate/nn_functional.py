@@ -295,3 +295,114 @@ def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None,
     b = ensure_tensor(seq_lens_decoder)
     return apply("blha_get_max_len",
                  lambda x_, y_: (jnp.max(x_), jnp.max(y_)), a, b)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """The reference's fused attention op (paddle/phi/kernels/fusion/
+    fused_attention): pre/post LN + qkv matmul + SDPA + out proj + residual,
+    one region for XLA to fuse. qkv_weight: (3, H, h, h/H) as upstream."""
+    x = ensure_tensor(x)
+    qkv_w = ensure_tensor(qkv_weight)  # (3, num_heads, head_dim, embed_dim)
+    lin_w = ensure_tensor(linear_weight)
+    h = int(x.shape[-1])
+    nh = int(qkv_w.shape[1])
+    hd = int(qkv_w.shape[2])
+
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [h], weight=pre_ln_scale, bias=pre_ln_bias,
+                         epsilon=pre_ln_epsilon)
+    b, s = int(x.shape[0]), int(x.shape[1])
+    from ..ops.manipulation import reshape
+    qkv = apply("fused_qkv",
+                lambda a, w: jnp.einsum("bsh,tndh->tbsnd", a,
+                                        w, precision=_precision()),
+                x, qkv_w)
+    if qkv_bias is not None:
+        qkv = qkv + ensure_tensor(qkv_bias).reshape([3, 1, 1, nh, hd])
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    cache_out = None
+    if cache_kv is not None:
+        # cache layout (reference): (2, B, num_heads, cache_len, head_dim)
+        cache_kv = ensure_tensor(cache_kv)
+        from ..ops.manipulation import concat, transpose
+        k_hist = transpose(cache_kv[0], [0, 2, 1, 3])  # -> (B, L, H, D)
+        v_hist = transpose(cache_kv[1], [0, 2, 1, 3])
+        k = concat([k_hist, k], axis=1)
+        v = concat([v_hist, v], axis=1)
+        from ..ops.manipulation import stack as _stack
+        cache_out = _stack([transpose(k, [0, 2, 1, 3]),
+                            transpose(v, [0, 2, 1, 3])], axis=0)
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    out = reshape(out, [b, s, h])
+    out = apply("fused_out_proj",
+                lambda a, w: jnp.matmul(a, w, precision=_precision()),
+                out, lin_w)
+    if linear_bias is not None:
+        out = out + ensure_tensor(linear_bias)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [h], weight=ln_scale, bias=ln_bias,
+                           epsilon=ln_epsilon)
+    if cache_out is not None:
+        return out, cache_out
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      ring_id=-1, add_residual=True, name=None):
+    """The reference's fused FFN: LN + linear + act + dropout + linear +
+    residual (+ LN)."""
+    x = ensure_tensor(x)
+    h = int(x.shape[-1])
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [h], weight=ln1_scale, bias=ln1_bias,
+                         epsilon=ln1_epsilon)
+    out = fused_linear(x, linear1_weight, bias=linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, p=dropout1_rate, training=training, mode=mode)
+    out = fused_linear(out, linear2_weight, bias=linear2_bias)
+    out = F.dropout(out, p=dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [h], weight=ln2_scale, bias=ln2_bias,
+                           epsilon=ln2_epsilon)
+    return out
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias epilogue (the cublasLt-fused op upstream)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, w, *b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            w = jnp.swapaxes(w, -1, -2)
+        out = jnp.matmul(a, w, precision=_precision())
+        return out + b[0] if b else out
+
+    if bias is not None:
+        return apply("fused_matmul_bias", f, x, y, ensure_tensor(bias))
+    return apply("fused_matmul_bias", f, x, y)

@@ -1,0 +1,10 @@
+"""``paddle.incubate.operators`` (reference: python/paddle/incubate/
+operators/) — graph_send_recv, softmax_mask_fuse, resnet_unit."""
+
+from __future__ import annotations
+
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+from .nn_functional import softmax_mask_fuse  # noqa: F401
+from .xpu import ResNetBasicBlock as resnet_unit  # noqa: F401
+
+__all__ = ["graph_send_recv", "softmax_mask_fuse", "resnet_unit"]

@@ -46,3 +46,4 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
         return r
 
     return apply("matrix_norm", f, x)
+from .ops.math_ext2 import matrix_transpose, svdvals  # noqa: F401,E402

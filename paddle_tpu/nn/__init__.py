@@ -11,6 +11,7 @@ from .common import (  # noqa: F401
     ChannelShuffle, Unfold, Fold,
     Unflatten, FeatureAlphaDropout, PairwiseDistance, Bilinear, RReLU,
     MaxUnPool1D, MaxUnPool2D,
+    ZeroPad1D, ZeroPad2D, ZeroPad3D, EmbeddingBag,
 )
 from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose  # noqa: F401
 from .norm import (  # noqa: F401
@@ -35,6 +36,8 @@ from .loss import (  # noqa: F401
     TripletMarginLoss, HingeEmbeddingLoss,
     SoftMarginLoss, MultiMarginLoss, PoissonNLLLoss, GaussianNLLLoss,
     CTCLoss, RNNTLoss, AdaptiveLogSoftmaxWithLoss,
+    MultiLabelSoftMarginLoss, TripletMarginWithDistanceLoss,
+    HSigmoidLoss,
 )
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 from .rnn import (  # noqa: F401

@@ -306,18 +306,9 @@ class PairwiseDistance(Layer):
         self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
 
     def forward(self, x, y):
-        import jax.numpy as jnp
-        from ..core.tensor import apply
-
-        p, eps, keep = self.p, self.epsilon, self.keepdim
-
-        def f(a, b):
-            d = jnp.abs(a - b) + eps
-            if p == float("inf"):
-                return jnp.max(d, axis=-1, keepdims=keep)
-            return jnp.sum(d ** p, axis=-1, keepdims=keep) ** (1.0 / p)
-
-        return apply("pairwise_distance", f, x, y)
+        from ..ops.nn_ext import pairwise_distance
+        return pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
+                                 keepdim=self.keepdim)
 
 
 class Bilinear(Layer):
@@ -387,3 +378,64 @@ class MaxUnPool2D(Layer):
     def forward(self, x, indices):
         k, s, p, osz = self.args
         return F.max_unpool2d(x, indices, k, s, p, output_size=osz)
+
+
+class _ZeroPadND(Layer):
+    def __init__(self, padding, n_spatial, channels_last, name=None):
+        super().__init__()
+        self.padding = padding
+        self._n = n_spatial
+        self._channels_last = channels_last
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from ..core.tensor import apply
+
+        pad = self.padding
+        if isinstance(pad, int):
+            pad = [pad] * (2 * self._n)
+        pairs = [(int(pad[2 * i]), int(pad[2 * i + 1]))
+                 for i in range(self._n)]
+        last = self._channels_last
+
+        def f(a):
+            # paddle pad order lists the LAST spatial dim's pair first
+            spatial = list(reversed(pairs))
+            if last:  # N, spatial..., C
+                cfg = [(0, 0)] + spatial + [(0, 0)]
+            else:  # N, C, spatial...
+                cfg = [(0, 0)] * (a.ndim - self._n) + spatial
+            return jnp.pad(a, cfg)
+
+        return apply("zeropad", f, x)
+
+
+class ZeroPad1D(_ZeroPadND):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, 1, data_format == "NLC")
+
+
+class ZeroPad2D(_ZeroPadND):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, 2, data_format == "NHWC")
+
+
+class ZeroPad3D(_ZeroPadND):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, 3, data_format == "NDHWC")
+
+
+class EmbeddingBag(Layer):
+    """Embedding + bag reduction in one lookup (reference: nn.EmbeddingBag)."""
+
+    def __init__(self, num_embeddings, embedding_dim, mode="mean",
+                 weight_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=XavierUniform())
+
+    def forward(self, input, offsets=None):
+        return F.embedding_bag(input, self.weight, offsets=offsets,
+                               mode=self.mode)

@@ -36,6 +36,9 @@ from ..ops.nn_ext import (  # noqa: F401
     soft_margin_loss, multi_margin_loss, npair_loss, poisson_nll_loss,
     gaussian_nll_loss, margin_cross_entropy, ctc_loss, rnnt_loss,
     adaptive_log_softmax_with_loss, class_center_sample, sparse_attention,
+    dice_loss, multi_label_soft_margin_loss,
+    triplet_margin_with_distance_loss, hsigmoid_loss, zeropad2d,
+    embedding_bag, pairwise_distance, linear_compress,
 )
 
 

@@ -261,3 +261,46 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
         return F.adaptive_log_softmax_with_loss(
             input, label, self.head_weight, self.tail_weights,
             self.cutoffs[:-1], head_bias=self.head_bias)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label,
+                                              weight=self.weight,
+                                              reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, margin=self.margin,
+            swap=self.swap, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid output layer (reference: nn.HSigmoidLoss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = self.create_parameter((num_classes - 1, 1),
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias)

@@ -22,3 +22,4 @@ from . import nn_ext  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import vision  # noqa: F401
 from . import array  # noqa: F401
+from . import math_ext2  # noqa: F401  (last: aliases earlier registrations)

@@ -1,0 +1,347 @@
+"""Third wave of tensor-surface parity ops: stacking/splitting families,
+special functions, scatter views, and assorted aliases.
+
+Parity surface: python/paddle/tensor/{math,manipulation,creation}.py tail.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply, register_tensor_method, to_tensor
+from ._helpers import ensure_tensor, register_op
+
+
+# --- stacking / splitting ----------------------------------------------------
+
+def _multi(name, jfn, tensors):
+    ts = [ensure_tensor(t) for t in tensors]
+    return apply(name, lambda *arrs: jfn(arrs), *ts)
+
+
+def hstack(x, name=None):
+    return _multi("hstack", jnp.hstack, x)
+
+
+def vstack(x, name=None):
+    return _multi("vstack", jnp.vstack, x)
+
+
+def dstack(x, name=None):
+    return _multi("dstack", jnp.dstack, x)
+
+
+def column_stack(x, name=None):
+    return _multi("column_stack", jnp.column_stack, x)
+
+
+def row_stack(x, name=None):
+    return _multi("row_stack", jnp.vstack, x)
+
+
+def block_diag(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    return apply("block_diag",
+                 lambda *arrs: jax.scipy.linalg.block_diag(
+                     *[a if a.ndim >= 2 else a.reshape(1, -1) for a in arrs]),
+                 *ts)
+
+
+def _split_sections(name, jfn, x, num_or_sections, axis_fixed=None):
+    x = ensure_tensor(x)
+    out = apply(name, lambda a: tuple(jfn(a, num_or_sections)), x)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def hsplit(x, num_or_indices, name=None):
+    return _split_sections("hsplit", jnp.hsplit, x, num_or_indices)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return _split_sections("vsplit", jnp.vsplit, x, num_or_indices)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return _split_sections("dsplit", jnp.dsplit, x, num_or_indices)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = ensure_tensor(x)
+    out = apply("tensor_split",
+                lambda a: tuple(jnp.array_split(
+                    a, num_or_indices if isinstance(num_or_indices, int)
+                    else list(num_or_indices), axis=axis)), x)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, ensure_tensor(t))
+            for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, ensure_tensor(t))
+            for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, ensure_tensor(t))
+            for t in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def unflatten(x, axis, shape, name=None):
+    x = ensure_tensor(x)
+    shp = [int(s._data) if isinstance(s, Tensor) else int(s) for s in shape]
+
+    def f(a):
+        ax = axis if axis >= 0 else axis + a.ndim
+        return a.reshape(a.shape[:ax] + tuple(shp) + a.shape[ax + 1:])
+
+    return apply("unflatten", f, x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# --- scatter views -----------------------------------------------------------
+
+def scatter_nd(index, updates, shape, name=None):
+    """Scatter ``updates`` into zeros of ``shape`` at nd ``index`` (adds on
+    duplicates, matching the reference kernel)."""
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+    shp = tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                for s in shape)
+
+    def f(idx, upd):
+        zeros = jnp.zeros(shp, upd.dtype)
+        return zeros.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return apply("scatter_nd", f, index, updates)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Write ``values`` into slice ``index`` of ``axis``."""
+    x, values = ensure_tensor(x), ensure_tensor(values)
+
+    def f(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[index].set(v)
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply("select_scatter", f, x, values)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Write ``value`` into the strided slice of ``x``."""
+    x, value = ensure_tensor(x), ensure_tensor(value)
+    sl = [slice(None)] * x._data.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[int(ax)] = slice(int(st), int(en), int(sd))
+    sl = tuple(sl)
+
+    def f(a, v):
+        return a.at[sl].set(v)
+
+    return apply("slice_scatter", f, x, value)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather with raise/wrap/clip bounds modes."""
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    n = int(np.prod(x._data.shape)) if x._data.shape else 1
+    from ..core.tensor import _is_tracer
+    if mode == "raise" and not _is_tracer(index._data):
+        idx = np.asarray(index._data)
+        if idx.size and (idx.max() >= n or idx.min() < -n):
+            raise IndexError(
+                f"take: index out of range for {n} elements "
+                f"(min {idx.min()}, max {idx.max()})")
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            i = i % n
+        elif mode == "clip":
+            i = jnp.clip(i, -n, n - 1)
+        return flat[i]
+
+    return apply("take", f, x, index)
+
+
+# --- special functions -------------------------------------------------------
+
+def i0e(x, name=None):
+    return apply("i0e", jax.scipy.special.i0e, ensure_tensor(x))
+
+
+def i1e(x, name=None):
+    return apply("i1e", jax.scipy.special.i1e, ensure_tensor(x))
+
+
+def polygamma(x, n, name=None):
+    x = ensure_tensor(x)
+    return apply("polygamma",
+                 lambda a: jax.scipy.special.polygamma(int(n), a), x)
+
+
+def multigammaln(x, p, name=None):
+    return apply("multigammaln",
+                 lambda a: jax.scipy.special.multigammaln(a, int(p)),
+                 ensure_tensor(x))
+
+
+def gammaln(x, name=None):
+    return apply("gammaln", jax.scipy.special.gammaln, ensure_tensor(x))
+
+
+def gammainc(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("gammainc", jax.scipy.special.gammainc, x, y)
+
+
+def gammaincc(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("gammaincc", jax.scipy.special.gammaincc, x, y)
+
+
+def logit(x, eps=None, name=None):
+    x = ensure_tensor(x)
+
+    def f(a):
+        p = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(p) - jnp.log1p(-p)
+
+    return apply("logit", f, x)
+
+
+def logaddexp2(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return apply("logaddexp2", jnp.logaddexp2, x, y)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    input = ensure_tensor(input)
+
+    def f(a):
+        lo, hi = (float(min), float(max)) if (min != 0 or max != 0) else \
+            (None, None)
+        if lo is None:
+            return jnp.histogram_bin_edges(a, bins=int(bins))
+        return jnp.histogram_bin_edges(a, bins=int(bins), range=(lo, hi))
+
+    return apply("histogram_bin_edges", f, input, differentiable=False)
+
+
+# --- simple aliases ----------------------------------------------------------
+
+def positive(x, name=None):
+    return apply("positive", lambda a: +a, ensure_tensor(x))
+
+
+def negative(x, name=None):
+    return apply("negative", jnp.negative, ensure_tensor(x))
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset),
+                 ensure_tensor(x))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    input = ensure_tensor(input)
+
+    def f(a):
+        n = a.shape[-1] + abs(int(offset))
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+        cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+        out = out.at[..., rows, cols].set(a)
+        if (dim1, dim2) != (-2, -1):
+            nd = out.ndim
+            d1, d2 = dim1 % nd, dim2 % nd
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    return apply("diag_embed", f, input)
+
+
+def matrix_transpose(x, name=None):
+    return apply("matrix_transpose", lambda a: jnp.swapaxes(a, -1, -2),
+                 ensure_tensor(x))
+
+
+def svdvals(x, name=None):
+    return apply("svdvals",
+                 lambda a: jnp.linalg.svd(a, compute_uv=False),
+                 ensure_tensor(x))
+
+
+register_op("hstack", hstack)
+register_op("vstack", vstack)
+register_op("dstack", dstack)
+register_op("column_stack", column_stack)
+register_op("row_stack", row_stack)
+register_op("block_diag", block_diag)
+register_op("hsplit", hsplit)
+register_op("vsplit", vsplit)
+register_op("dsplit", dsplit)
+register_op("tensor_split", tensor_split, methods=("tensor_split",))
+register_op("atleast_1d", atleast_1d)
+register_op("atleast_2d", atleast_2d)
+register_op("atleast_3d", atleast_3d)
+register_op("unflatten", unflatten, methods=("unflatten",))
+register_op("broadcast_shape", broadcast_shape)
+register_op("scatter_nd", scatter_nd)
+register_op("select_scatter", select_scatter, methods=("select_scatter",))
+register_op("slice_scatter", slice_scatter, methods=("slice_scatter",))
+register_op("take", take, methods=("take",))
+register_op("i0e", i0e, methods=("i0e",))
+register_op("i1e", i1e, methods=("i1e",))
+register_op("polygamma", polygamma, methods=("polygamma",))
+register_op("multigammaln", multigammaln, methods=("multigammaln",))
+register_op("gammaln", gammaln, methods=("gammaln",))
+register_op("gammainc", gammainc, methods=("gammainc",))
+register_op("gammaincc", gammaincc, methods=("gammaincc",))
+register_op("logit", logit, methods=("logit",))
+register_op("logaddexp2", logaddexp2, methods=("logaddexp2",))
+register_op("histogram_bin_edges", histogram_bin_edges)
+register_op("positive", positive, methods=("positive",))
+register_op("negative", negative, methods=("negative",))
+register_op("diagflat", diagflat, methods=("diagflat",))
+register_op("diag_embed", diag_embed, methods=("diag_embed",))
+register_op("matrix_transpose", matrix_transpose,
+            methods=("matrix_transpose",))
+register_op("svdvals", svdvals)
+
+
+# aliases onto already-registered ops
+from ._helpers import OP_REGISTRY as _REG  # noqa: E402
+
+register_op("bitwise_invert", _REG["bitwise_not"])
+register_tensor_method("inverse", _REG["inv"])
+register_op("inverse", _REG["inv"])
+register_tensor_method("cross", _REG["cross"])
+register_tensor_method("searchsorted",
+                       lambda self, values, out_int32=False, right=False:
+                       _REG["searchsorted"](self, values, out_int32, right))
+
+
+def _inplace(method_name, op_name):
+    fn = _REG[op_name]
+
+    def m(self, *args, **kwargs):
+        return self._rebind(fn(self, *args, **kwargs))
+
+    m.__name__ = method_name
+    register_tensor_method(method_name, m)
+
+
+_inplace("put_along_axis_", "put_along_axis")
+_inplace("transpose_", "transpose")
+_inplace("flatten_", "flatten") if "flatten" in _REG else None

@@ -596,3 +596,177 @@ def sparse_attention(query, key_t, value, sparse_csr_offset,
 
 register_op("class_center_sample", class_center_sample)
 register_op("sparse_attention", sparse_attention)
+
+
+# --- wave-3 losses / layers ops ----------------------------------------------
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss over the last axis (reference: F.dice_loss)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def f(p, y):
+        y1 = jax.nn.one_hot(y.reshape(y.shape[:-1]).astype(jnp.int32),
+                            p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return apply("dice_loss", f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    extras = [ensure_tensor(weight)] if weight is not None else []
+
+    def f(a, y, *w):
+        y = y.astype(a.dtype)
+        term = y * jax.nn.log_sigmoid(a) + (1 - y) * jax.nn.log_sigmoid(-a)
+        if w:
+            term = term * w[0]  # per-class weight applies BEFORE the mean
+        loss = -jnp.mean(term, axis=-1)
+        return _reduce(loss, reduction)
+
+    return apply("multi_label_soft_margin_loss", f, input, label, *extras)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    input, positive, negative = (ensure_tensor(input),
+                                 ensure_tensor(positive),
+                                 ensure_tensor(negative))
+    if distance_function is None:
+        def dist(a, b):
+            return jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1) + 1e-12)
+    else:
+        def dist(a, b):
+            out = distance_function(Tensor(a), Tensor(b))
+            return out._data if isinstance(out, Tensor) else out
+
+    def f(a, p, n):
+        dp = dist(a, p)
+        dn = dist(a, n)
+        if swap:
+            dn = jnp.minimum(dn, dist(p, n))
+        return _reduce(jnp.clip(dp - dn + margin, 0.0, None), reduction)
+
+    return apply("triplet_margin_with_distance_loss", f, input, positive,
+                 negative)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over the default complete binary tree
+    (reference: F.hsigmoid_loss; custom path tables route like the default)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    weight = ensure_tensor(weight)
+    extras = [ensure_tensor(bias)] if bias is not None else []
+    import math as _math
+    code_len = max(1, int(_math.ceil(_math.log2(max(num_classes, 2)))))
+
+    def f(a, y, w, *b):
+        y = y.reshape(-1).astype(jnp.int32)
+        # default tree: internal node ids via the heap path of (y + C)
+        node = y + num_classes
+        losses = jnp.zeros((a.shape[0],), a.dtype)
+        for _ in range(code_len):
+            parent = node // 2
+            is_right = (node % 2).astype(a.dtype)
+            valid = (parent >= 1) & (parent - 1 < w.shape[0])
+            pidx = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+            logit = jnp.sum(a * w[pidx], axis=-1)
+            if b:
+                logit = logit + b[0].reshape(-1)[pidx]
+            # code 0 (left): target sigmoid 1; code 1: target 0
+            step_loss = jax.nn.softplus(jnp.where(is_right > 0, logit,
+                                                  -logit))
+            losses = losses + jnp.where(valid, step_loss, 0.0)
+            node = parent
+        return losses[:, None]  # (N, 1): the reference's per-sample output
+
+    return apply("hsigmoid_loss", f, input, label, weight, *extras)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    pl, pr, pt, pb = (int(v) for v in padding)
+
+    def f(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        return jnp.pad(a, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+
+    return apply("zeropad2d", f, x)
+
+
+def embedding_bag(input, weight, offsets=None, mode="mean", name=None):
+    """Gather + segment-reduce rows of ``weight`` (reference:
+    F.embedding_bag). 2D input reduces each row's bag; 1D input + offsets
+    reduces variable-length bags (eager, concrete offsets)."""
+    input, weight = ensure_tensor(input), ensure_tensor(weight)
+    if offsets is None:
+        def f(ids, w):
+            emb = w[ids.astype(jnp.int32)]          # (B, L, D)
+            if mode == "sum":
+                return jnp.sum(emb, axis=1)
+            if mode == "max":
+                return jnp.max(emb, axis=1)
+            return jnp.mean(emb, axis=1)
+
+        return apply("embedding_bag", f, input, weight)
+
+    offsets = ensure_tensor(offsets)
+    off = np.asarray(offsets._data).astype(np.int64)
+    n = int(np.asarray(input._data).shape[0])
+    bounds = list(off) + [n]
+
+    def f(ids, w):
+        emb = w[ids.astype(jnp.int32)]
+        outs = []
+        for i in range(len(bounds) - 1):
+            seg = emb[int(bounds[i]): int(bounds[i + 1])]
+            if mode == "sum":
+                outs.append(jnp.sum(seg, axis=0))
+            elif mode == "max":
+                outs.append(jnp.max(seg, axis=0))
+            else:
+                outs.append(jnp.mean(seg, axis=0))
+        return jnp.stack(outs)
+
+    return apply("embedding_bag", f, input, weight)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """p-norm of (x - y + epsilon) — the reference perturbs the difference
+    once (numerical-stability epsilon), not every |.| term."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def f(a, b):
+        d = jnp.abs(a - b + epsilon)
+        if p == float("inf"):
+            return jnp.max(d, axis=-1, keepdims=keepdim)
+        return jnp.sum(d ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+
+    return apply("pairwise_distance", f, x, y)
+
+
+def linear_compress(x, weight, bias=None, scale=None, algo="weight_only_int8",
+                    name=None):
+    """Compressed linear (reference: F.linear_compress): routes to the
+    weight-only quantized matmul."""
+    from ..nn.quant import weight_only_linear
+    return weight_only_linear(x, weight, bias=bias, weight_scale=scale)
+
+
+register_op("dice_loss", dice_loss)
+register_op("multi_label_soft_margin_loss", multi_label_soft_margin_loss)
+register_op("triplet_margin_with_distance_loss",
+            triplet_margin_with_distance_loss)
+register_op("hsigmoid_loss", hsigmoid_loss)
+register_op("zeropad2d", zeropad2d)
+register_op("embedding_bag", embedding_bag)
+register_op("pairwise_distance", pairwise_distance)
+register_op("linear_compress", linear_compress)

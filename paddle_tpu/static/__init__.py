@@ -536,3 +536,109 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
 __all__ += ["gradients", "append_backward", "scope_guard", "name_scope",
             "device_guard", "py_func", "create_parameter",
             "create_global_var", "accuracy", "auc"]
+
+
+# ---------------------------------------------------------------------------
+# Static-mode module aliases + small utilities (reference: python/paddle/
+# static/__init__.py exports)
+# ---------------------------------------------------------------------------
+
+from .. import amp  # noqa: E402,F401  (static.amp == the amp package)
+from ..incubate import asp as sparsity  # noqa: E402,F401
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values with apply/restore (reference:
+    paddle.static.ExponentialMovingAverage)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        import jax.numpy as jnp
+        self.decay = float(decay)
+        self._ema: dict = {}
+        self._backup: dict = {}
+        self._jnp = jnp
+
+    def update(self, parameters=None):
+        params = parameters or [
+            t for t in _default_main.list_vars() if not t.stop_gradient]
+        for p in params:
+            cur = self._ema.get(id(p))
+            new = (p._data.astype("float32") if cur is None
+                   else self.decay * cur + (1 - self.decay) *
+                   p._data.astype("float32"))
+            self._ema[id(p)] = new
+        self._params = params
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            for p in getattr(self, "_params", []):
+                self._backup[id(p)] = p._data
+                p._set_data(self._ema[id(p)].astype(p._data.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return cm()
+
+    def restore(self, executor=None):
+        for p in getattr(self, "_params", []):
+            bk = self._backup.pop(id(p), None)
+            if bk is not None:
+                p._set_data(bk)
+
+
+import contextlib as _ctx  # noqa: E402
+
+
+@_ctx.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    """IPU-only sharding annotation upstream; no-op on TPU (mesh shardings
+    come from pjit specs)."""
+    yield
+
+
+def setitem(x, index, value):
+    """Functional __setitem__ (reference: paddle.static.setitem)."""
+    x[index] = value
+    return x
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print op (reference: paddle.static.Print). Eagerly prints and
+    returns the input so program capture keeps flowing."""
+    msg = f"{message or ''} {input.name if print_tensor_name else ''}".strip()
+    try:
+        print(f"[static.Print] {msg} shape={input.shape} "
+              f"values={np.asarray(input._data).reshape(-1)[:summarize]}")
+    except Exception:
+        print(f"[static.Print] {msg} <unavailable while tracing>")
+    return input
+
+
+class WeightNormParamAttr:
+    """Parity container (reference: paddle.static.WeightNormParamAttr):
+    weight-norm reparameterization is applied via nn.utils.weight_norm in
+    this build; the attr carries the config through."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+__all__ += ["sparsity", "ExponentialMovingAverage", "ipu_shard_guard",
+            "setitem", "Print", "WeightNormParamAttr", "amp"]

@@ -215,3 +215,16 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+class _DatasetsNS:
+    """``paddle.text.datasets`` namespace parity (upstream packages the
+    dataset classes under text.datasets)."""
+
+    Imdb = Imdb
+    UCIHousing = UCIHousing
+    Conll05st = Conll05st
+    Movielens = Movielens
+
+
+datasets = _DatasetsNS()

@@ -7,3 +7,39 @@ from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_image_backend = "numpy"
+
+
+def set_image_backend(backend: str) -> None:
+    """Parity: paddle.vision.set_image_backend ('pil'/'cv2' upstream). This
+    build's transforms operate on numpy arrays; the setting is recorded and
+    'numpy' is always accepted."""
+    global _image_backend
+    if backend not in ("numpy", "pil", "cv2", "tensor"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file to an array (PIL when available, else raw numpy
+    formats)."""
+    import numpy as np
+    import os
+
+    ext = os.path.splitext(str(path))[1].lower()
+    if ext in (".npy",):
+        return np.load(path)
+    try:
+        from PIL import Image  # pillow ships with matplotlib stacks
+
+        return Image.open(path)
+    except ImportError as exc:
+        raise RuntimeError(
+            f"image_load({path!r}): no PIL in this build; supply .npy arrays "
+            "or decode upstream") from exc

@@ -220,3 +220,226 @@ class Grayscale(BaseTransform):
         g = g[None] if chw else g[..., None]
         reps = [self.n, 1, 1] if chw else [1, 1, self.n]
         return np.tile(g, reps)
+
+
+def _as_float(img):
+    a = np.asarray(img, np.float32)
+    scale = 255.0 if a.max() > 1.5 else 1.0
+    return a, scale
+
+
+def adjust_gamma(img, gamma, gain=1.0):
+    """Gamma correction (reference: F.adjust_gamma)."""
+    a, scale = _as_float(img)
+    return np.clip(gain * scale * (a / scale) ** gamma, 0, scale)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase the (i:i+h, j:j+w) region with value ``v`` (reference:
+    transforms.erase). Accepts HWC or CHW numpy arrays / Tensors."""
+    from ..core.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+        a = np.array(img.numpy())
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        if chw:
+            a[:, i:i + h, j:j + w] = v
+        else:
+            a[i:i + h, j:j + w] = v
+        return Tensor(jnp.asarray(a))
+    a = np.array(img)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    if chw:
+        a[:, i:i + h, j:j + w] = v
+    else:
+        a[i:i + h, j:j + w] = v
+    return a
+
+
+def _affine_sample(a, matrix):
+    """Inverse-warp HWC/CHW array with a 2x3 affine matrix (nearest)."""
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    hw = a.shape[1:3] if chw else a.shape[:2]
+    h, w = int(hw[0]), int(hw[1])
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    # center-origin coordinates
+    xc, yc = xs - (w - 1) / 2.0, ys - (h - 1) / 2.0
+    m = np.asarray(matrix, np.float32).reshape(2, 3)
+    sx = m[0, 0] * xc + m[0, 1] * yc + m[0, 2] + (w - 1) / 2.0
+    sy = m[1, 0] * xc + m[1, 1] * yc + m[1, 2] + (h - 1) / 2.0
+    sxi = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
+    syi = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    if chw:
+        out = a[:, syi, sxi]
+        return np.where(valid[None], out, 0).astype(a.dtype)
+    out = a[syi, sxi]
+    return np.where(valid[..., None] if a.ndim == 3 else valid, out,
+                    0).astype(a.dtype)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp mapping ``startpoints`` -> ``endpoints``."""
+    a = np.asarray(img)
+    sp = np.asarray(startpoints, np.float32)
+    ep = np.asarray(endpoints, np.float32)
+    # solve the 8-dof homography sending endpoints back to startpoints
+    A, b = [], []
+    for (x, y), (u, v) in zip(ep, sp):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        b.append(u)
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        b.append(v)
+    coef = np.linalg.solve(np.asarray(A, np.float32),
+                           np.asarray(b, np.float32))
+    hmat = np.append(coef, 1.0).reshape(3, 3)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    hw = a.shape[1:3] if chw else a.shape[:2]
+    h, w = int(hw[0]), int(hw[1])
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xs)
+    pts = np.stack([xs, ys, ones], axis=-1).reshape(-1, 3).T
+    src = hmat @ pts
+    sx = (src[0] / src[2]).reshape(h, w)
+    sy = (src[1] / src[2]).reshape(h, w)
+    sxi = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
+    syi = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+    if chw:
+        out = a[:, syi, sxi]
+        return np.where(valid[None], out, fill).astype(a.dtype)
+    out = a[syi, sxi]
+    return np.where(valid[..., None] if a.ndim == 3 else valid, out,
+                    fill).astype(a.dtype)
+
+
+class RandomErasing(BaseTransform):
+    """Erase a random rectangle (reference: transforms.RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value = value
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if np.random.rand() > self.prob:
+            return a
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        h, w = (a.shape[1:3] if chw else a.shape[:2])
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                return erase(a, i, j, eh, ew, self.value)
+        return a
+
+
+class RandomAffine(BaseTransform):
+    """Random rotation/translate/scale/shear (reference:
+    transforms.RandomAffine; nearest resampling)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.translate, self.scale_rng = translate, scale
+        if shear is None:
+            self.shear = None
+        elif isinstance(shear, (int, float)):
+            self.shear = (-float(shear), float(shear))
+        else:
+            self.shear = tuple(shear)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        ang = np.deg2rad(np.random.uniform(*self.degrees))
+        sc = (np.random.uniform(*self.scale_rng)
+              if self.scale_rng else 1.0)
+        cos, sin = np.cos(ang) / sc, np.sin(ang) / sc
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        h, w = (a.shape[1:3] if chw else a.shape[:2])
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        rot = np.asarray([[cos, -sin], [sin, cos]], np.float32)
+        if self.shear is not None:
+            sx = np.tan(np.deg2rad(np.random.uniform(*self.shear[:2])))
+            sy = (np.tan(np.deg2rad(np.random.uniform(*self.shear[2:4])))
+                  if len(self.shear) == 4 else 0.0)
+            rot = rot @ np.asarray([[1.0, sx], [sy, 1.0]], np.float32)
+        m = [rot[0, 0], rot[0, 1], -tx, rot[1, 0], rot[1, 1], -ty]
+        return _affine_sample(a, m)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob, self.d = prob, distortion_scale
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if np.random.rand() > self.prob:
+            return a
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        h, w = (a.shape[1:3] if chw else a.shape[:2])
+        dx, dy = self.d * w / 2, self.d * h / 2
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        jitter = lambda: (np.random.uniform(0, dx), np.random.uniform(0, dy))
+        end = [(0 + jitter()[0], 0 + jitter()[1]),
+               (w - 1 - jitter()[0], 0 + jitter()[1]),
+               (w - 1 - jitter()[0], h - 1 - jitter()[1]),
+               (0 + jitter()[0], h - 1 - jitter()[1])]
+        return perspective(a, start, end)
+
+
+class RandAugment(BaseTransform):
+    """RandAugment (reference: transforms.RandAugment): N random ops at
+    magnitude M from the standard pool (geometric + photometric subset that
+    is meaningful on raw arrays)."""
+
+    def __init__(self, num_ops=2, magnitude=9, num_magnitude_bins=31,
+                 interpolation="nearest", fill=0):
+        self.num_ops, self.m = num_ops, magnitude / max(num_magnitude_bins - 1, 1)
+
+    def _ops(self):
+        m = self.m
+        return [
+            lambda a: adjust_gamma(a, 1.0 + (np.random.rand() - 0.5) * m),
+            lambda a: np.clip(np.asarray(a, np.float32) *
+                              (1 + (np.random.rand() - 0.5) * m), 0,
+                              255 if np.asarray(a).max() > 1.5 else 1.0),
+            lambda a: _affine_sample(np.asarray(a),
+                                     [1, m * (np.random.rand() - 0.5), 0,
+                                      0, 1, 0]),  # shear-x
+            lambda a: _affine_sample(np.asarray(a),
+                                     [1, 0, 0,
+                                      m * (np.random.rand() - 0.5), 1, 0]),
+            lambda a: _affine_sample(
+                np.asarray(a),
+                [np.cos(0.5 * m), -np.sin(0.5 * m), 0,
+                 np.sin(0.5 * m), np.cos(0.5 * m), 0]),  # rotate
+        ]
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        ops = self._ops()
+        for _ in range(self.num_ops):
+            a = ops[np.random.randint(len(ops))](a)
+        return a
+
+
+class AutoAugment(RandAugment):
+    """AutoAugment policy surface (reference: transforms.AutoAugment); the
+    learned ImageNet policy collapses onto the same op pool here."""
+
+    def __init__(self, policy="imagenet", interpolation="nearest", fill=0):
+        super().__init__(num_ops=2, magnitude=9)

@@ -1,0 +1,390 @@
+"""Tests for API batch 7: stacking/splitting/special-function ops, wave-3
+losses and layers, fused attention/FFN functionals, namespace fills."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestStackSplit:
+    def test_stacks(self):
+        a = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+        assert paddle.hstack([a, a]).shape == [2, 6]
+        assert paddle.vstack([a, a]).shape == [4, 3]
+        assert paddle.dstack([a, a]).shape == [2, 3, 2]
+        assert paddle.column_stack([a, a]).shape == [2, 6]
+        assert paddle.row_stack([a, a]).shape == [4, 3]
+        bd = paddle.block_diag([a, a])
+        assert bd.shape == [4, 6]
+        assert np.asarray(bd.numpy())[0, 3:].sum() == 0
+
+    def test_splits(self):
+        a = paddle.to_tensor(np.arange(12).reshape(2, 6).astype("float32"))
+        assert [t.shape for t in paddle.hsplit(a, 3)] == [[2, 2]] * 3
+        assert [t.shape for t in paddle.vsplit(a, 2)] == [[1, 6]] * 2
+        parts = paddle.tensor_split(a, [2, 4], axis=1)
+        assert [p.shape for p in parts] == [[2, 2], [2, 2], [2, 2]]
+        d = paddle.to_tensor(np.zeros((2, 2, 4), "float32"))
+        assert [t.shape for t in paddle.dsplit(d, 2)] == [[2, 2, 2]] * 2
+
+    def test_atleast_unflatten(self):
+        s = paddle.to_tensor(np.array(3.0, "float32"))
+        assert paddle.atleast_1d(s).shape == [1]
+        assert paddle.atleast_2d(s).shape == [1, 1]
+        assert paddle.atleast_3d(s).shape == [1, 1, 1]
+        assert paddle.unflatten(paddle.zeros([2, 6]), 1, [3, 2]).shape == \
+            [2, 3, 2]
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+
+
+class TestScatterViews:
+    def test_scatter_nd_adds_duplicates(self):
+        out = paddle.scatter_nd(
+            paddle.to_tensor(np.array([[0], [2], [0]])),
+            paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32")), [4])
+        assert out.numpy().tolist() == [4.0, 0.0, 2.0, 0.0]
+
+    def test_select_slice_scatter(self):
+        ss = paddle.select_scatter(paddle.zeros([3, 3]), paddle.ones([3]),
+                                   0, 1)
+        assert np.asarray(ss.numpy())[1].tolist() == [1.0, 1.0, 1.0]
+        sl = paddle.slice_scatter(paddle.zeros([4]), paddle.ones([2]),
+                                  [0], [1], [3], [1])
+        assert sl.numpy().tolist() == [0.0, 1.0, 1.0, 0.0]
+
+    def test_take_modes(self):
+        a = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+        assert paddle.take(a, paddle.to_tensor(np.array([0, 5]))).numpy() \
+            .tolist() == [0.0, 5.0]
+        assert paddle.take(a, paddle.to_tensor(np.array([7])),
+                           mode="wrap").numpy().tolist() == [1.0]
+        assert paddle.take(a, paddle.to_tensor(np.array([7])),
+                           mode="clip").numpy().tolist() == [5.0]
+        with pytest.raises(IndexError):
+            paddle.take(a, paddle.to_tensor(np.array([99])))
+
+
+class TestSpecialFunctions:
+    def test_scipy_matches(self):
+        from scipy import special as S
+        x = np.array([0.5, 1.5, 2.5], "float32")
+        xt = paddle.to_tensor(x)
+        np.testing.assert_allclose(paddle.i0e(xt).numpy(), S.i0e(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.i1e(xt).numpy(), S.i1e(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.gammaln(xt).numpy(), S.gammaln(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.gammainc(xt, xt).numpy(), S.gammainc(x, x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.polygamma(xt, 1).numpy(), S.polygamma(1, x), rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.multigammaln(xt, 1).numpy(), S.multigammaln(x, 1),
+            rtol=1e-4)
+
+    def test_logit_and_logaddexp2(self):
+        p = paddle.to_tensor(np.array([0.25], "float32"))
+        np.testing.assert_allclose(paddle.logit(p).numpy(), [np.log(1 / 3)],
+                                   rtol=1e-5)
+        a = paddle.to_tensor(np.array([1.0], "float32"))
+        np.testing.assert_allclose(
+            paddle.logaddexp2(a, a).numpy(), [2.0], rtol=1e-6)
+
+    def test_diag_embed_matches_torch(self):
+        v = np.random.randn(2, 3).astype("float32")
+        ref = torch.diag_embed(torch.tensor(v)).numpy()
+        ours = paddle.diag_embed(paddle.to_tensor(v)).numpy()
+        np.testing.assert_allclose(ours, ref)
+        ref_off = torch.diag_embed(torch.tensor(v), offset=1).numpy()
+        ours_off = paddle.diag_embed(paddle.to_tensor(v), offset=1).numpy()
+        np.testing.assert_allclose(ours_off, ref_off)
+
+    def test_svdvals_and_matrix_transpose(self):
+        a = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.svdvals(paddle.to_tensor(a)).numpy(),
+            np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+        assert paddle.linalg.matrix_transpose(
+            paddle.zeros([2, 3, 4])).shape == [2, 4, 3]
+
+
+class TestWave3Losses:
+    def test_multilabel_matches_torch(self):
+        x = np.random.randn(4, 6).astype("float32")
+        y = (np.random.rand(4, 6) > 0.5).astype("float32")
+        ref = float(TF.multilabel_soft_margin_loss(torch.tensor(x),
+                                                   torch.tensor(y)))
+        ours = float(nn.functional.multi_label_soft_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y)))
+        assert abs(ref - ours) < 1e-5
+
+    def test_triplet_with_distance_matches_torch(self):
+        a = np.random.randn(5, 8).astype("float32")
+        p = np.random.randn(5, 8).astype("float32")
+        n = np.random.randn(5, 8).astype("float32")
+        ref = float(TF.triplet_margin_with_distance_loss(
+            torch.tensor(a), torch.tensor(p), torch.tensor(n)))
+        ours = float(nn.functional.triplet_margin_with_distance_loss(
+            paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n)))
+        assert abs(ref - ours) < 1e-4
+
+    def test_hsigmoid_trains(self):
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(8, 10)
+        opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                    parameters=layer.parameters())
+        x = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randint(0, 10, (16,)))
+        first = last = None
+        for _ in range(20):
+            loss = layer(x, y).mean()  # per-sample (N, 1) -> scalar
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert last < first * 0.7
+
+    def test_dice_loss_perfect_prediction(self):
+        lab = np.random.randint(0, 3, (2, 5, 1))
+        onehot = np.eye(3, dtype="float32")[lab.squeeze(-1)]
+        v = float(nn.functional.dice_loss(paddle.to_tensor(onehot),
+                                          paddle.to_tensor(lab)))
+        assert v < 0.01
+
+
+class TestWave3Layers:
+    def test_zeropads(self):
+        assert nn.ZeroPad1D([1, 2])(paddle.zeros([1, 2, 5])).shape == \
+            [1, 2, 8]
+        assert nn.ZeroPad2D([1, 2, 3, 4])(paddle.zeros([1, 1, 5, 5])).shape \
+            == [1, 1, 12, 8]
+        assert nn.ZeroPad3D(1)(paddle.zeros([1, 1, 2, 2, 2])).shape == \
+            [1, 1, 4, 4, 4]
+
+    def test_embedding_bag_modes(self):
+        w = np.random.randn(10, 4).astype("float32")
+        ids = np.array([[1, 2], [3, 4]])
+        for mode in ("mean", "sum", "max"):
+            eb = nn.EmbeddingBag(10, 4, mode=mode)
+            eb.weight._set_data(paddle.to_tensor(w)._data)
+            out = np.asarray(eb(paddle.to_tensor(ids)).numpy())
+            ref = {"mean": w[ids].mean(1), "sum": w[ids].sum(1),
+                   "max": w[ids].max(1)}[mode]
+            np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_embedding_bag_offsets(self):
+        w = np.random.randn(10, 4).astype("float32")
+        out = nn.functional.embedding_bag(
+            paddle.to_tensor(np.array([1, 2, 3, 4, 5])),
+            paddle.to_tensor(w),
+            offsets=paddle.to_tensor(np.array([0, 2])), mode="sum")
+        ref = np.stack([w[[1, 2]].sum(0), w[[3, 4, 5]].sum(0)])
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref, atol=1e-6)
+
+
+class TestFusedFunctionals:
+    def test_fused_feedforward_matches_manual(self):
+        h = paddle.to_tensor(np.random.randn(2, 3, 8).astype("float32"))
+        w1 = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+        w2 = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+        out = paddle.incubate.nn.functional.fused_feedforward(
+            h, w1, w2, training=False, pre_layer_norm=True)
+        ref = h + nn.functional.linear(
+            nn.functional.relu(nn.functional.linear(
+                nn.functional.layer_norm(h, [8]), w1)), w2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fused_mha_matches_sdpa(self):
+        np.random.seed(3)
+        h = paddle.to_tensor(np.random.randn(2, 3, 8).astype("float32"))
+        qkvw = paddle.to_tensor(np.random.randn(3, 2, 4, 8)
+                                .astype("float32"))
+        lw = paddle.to_tensor(np.eye(8, dtype="float32"))
+        out = paddle.incubate.nn.functional.fused_multi_head_attention(
+            h, qkvw, lw, pre_layer_norm=True, training=False)
+        # manual: ln -> einsum qkv -> sdpa -> reshape -> identity proj + res
+        ln = nn.functional.layer_norm(h, [8])
+        import jax.numpy as jnp
+        qkv = jnp.einsum("bsh,tndh->tbsnd", ln._data, qkvw._data)
+        q, k, v = (paddle.Tensor(qkv[i]) for i in range(3))
+        att = nn.functional.scaled_dot_product_attention(q, k, v)
+        ref = h + paddle.Tensor(att._data.reshape(2, 3, 8))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fused_matmul_bias(self):
+        x = np.random.randn(3, 4).astype("float32")
+        w = np.random.randn(4, 5).astype("float32")
+        b = np.random.randn(5).astype("float32")
+        out = paddle.incubate.nn.functional.fused_matmul_bias(
+            paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, atol=1e-5)
+
+
+class TestTransformsWave3:
+    def test_geometric_transforms_shapes(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.rand(3, 16, 16).astype("float32")
+        assert T.RandomErasing(prob=1.0)(img).shape == img.shape
+        assert T.RandomAffine(15, translate=(0.1, 0.1),
+                              scale=(0.9, 1.1))(img).shape == img.shape
+        assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+        assert T.RandAugment()(img).shape == img.shape
+        assert T.AutoAugment()(img).shape == img.shape
+
+    def test_erase_and_gamma(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.ones((3, 8, 8), "float32")
+        er = T.erase(img, 2, 2, 4, 4, 0.0)
+        assert er[0, 3, 3] == 0.0 and er[0, 0, 0] == 1.0
+        g = T.adjust_gamma(img * 0.25, 2.0)
+        np.testing.assert_allclose(g, 0.0625, atol=1e-6)
+
+    def test_identity_affine_is_noop(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.rand(3, 9, 9).astype("float32")
+        out = T._affine_sample(img, [1, 0, 0, 0, 1, 0])
+        np.testing.assert_allclose(out, img)
+
+    def test_image_backend(self):
+        import paddle_tpu.vision as vision
+        assert vision.get_image_backend() == "numpy"
+        with pytest.raises(ValueError):
+            vision.set_image_backend("nope")
+
+
+class TestNamespaceWave3:
+    def test_namespaces_resolve(self):
+        import paddle_tpu.distributed as dist
+        assert dist.fleet.meta_parallel.PipelineLayer
+        assert dist.fleet.meta_optimizers.DygraphShardingOptimizer
+        assert dist.fleet.layers.ColumnParallelLinear
+        assert dist.communication.all_reduce is dist.collective.all_reduce
+        assert paddle.text.datasets.Imdb
+        assert paddle.audio.backends.list_available_backends() == ["wave"]
+        with pytest.raises(RuntimeError):
+            paddle.audio.datasets.TESS()
+        assert paddle.static.sparsity.calculate_density
+        assert paddle.incubate.operators.softmax_mask_fuse
+        assert paddle.incubate.layers.shuffle_batch
+        assert paddle.incubate.jit.inference
+
+    def test_audio_wave_backend_roundtrip(self, tmp_path):
+        import wave as wavelib
+        path = tmp_path / "t.wav"
+        data = (np.sin(np.linspace(0, 40, 1600)) * 2 ** 14).astype("<i2")
+        with wavelib.open(str(path), "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(2)
+            w.setframerate(16000)
+            w.writeframes(data.tobytes())
+        sig, sr = paddle.audio.backends.load(path)
+        assert sr == 16000 and sig.shape == [1600]
+
+    def test_static_ema(self):
+        from paddle_tpu.core.tensor import Parameter
+        from paddle_tpu import static
+        p = Parameter(np.array([2.0], "float32"), name="ema_t")
+        ema = static.ExponentialMovingAverage(0.5)
+        ema.update([p])
+        p._set_data(p._data * 0 + 4.0)
+        ema.update([p])
+        with ema.apply():
+            np.testing.assert_allclose(np.asarray(p.numpy()), [3.0])
+        np.testing.assert_allclose(np.asarray(p.numpy()), [4.0])
+
+    def test_callbacks_exist(self):
+        cb = paddle.callbacks.ReduceLROnPlateau(patience=1)
+        vd = paddle.callbacks.VisualDL(log_dir="/tmp/vdl_test")
+        assert cb and vd
+
+
+class TestReviewFixes7:
+    def test_zeropad_channels_last(self):
+        zp = nn.ZeroPad2D([1, 1, 2, 2], data_format="NHWC")
+        out = zp(paddle.zeros([1, 4, 4, 3]))
+        assert out.shape == [1, 8, 6, 3]
+
+    def test_multilabel_weight_per_class(self):
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor((np.random.rand(4, 8) > 0.5).astype("float32"))
+        w = paddle.to_tensor(np.random.rand(8).astype("float32"))
+        v = nn.functional.multi_label_soft_margin_loss(x, y, weight=w)
+        ref = float(TF.multilabel_soft_margin_loss(
+            torch.tensor(np.asarray(x.numpy())),
+            torch.tensor(np.asarray(y.numpy())),
+            weight=torch.tensor(np.asarray(w.numpy()))))
+        assert abs(float(v) - ref) < 1e-5
+
+    def test_hsigmoid_per_sample_shape(self):
+        layer = nn.HSigmoidLoss(8, 10)
+        v = layer(paddle.to_tensor(np.random.randn(5, 8).astype("float32")),
+                  paddle.to_tensor(np.random.randint(0, 10, (5,))))
+        assert v.shape == [5, 1]
+
+    def test_pairwise_distance_identical_inputs(self):
+        x = paddle.to_tensor(np.random.randn(2, 512).astype("float32"))
+        d = nn.functional.pairwise_distance(x, x)
+        # eps perturbs the difference once: ~eps*sqrt(D), not eps*D
+        assert float(np.abs(d.numpy()).max()) < 1e-4
+
+    def test_fused_mha_cache_roundtrip(self):
+        h = paddle.to_tensor(np.random.randn(1, 1, 8).astype("float32"))
+        qkvw = paddle.to_tensor(np.random.randn(3, 2, 4, 8)
+                                .astype("float32"))
+        lw = paddle.to_tensor(np.eye(8, dtype="float32"))
+        cache = paddle.zeros([2, 1, 2, 3, 4])  # (2, B, H, L=3, D)
+        out, new_cache = \
+            paddle.incubate.nn.functional.fused_multi_head_attention(
+                h, qkvw, lw, cache_kv=cache, training=False)
+        assert out.shape == [1, 1, 8]
+        assert new_cache.shape == [2, 1, 2, 4, 4]
+
+    def test_random_affine_shear_changes_image(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.arange(3 * 9 * 9, dtype="float32").reshape(3, 9, 9)
+        out = T.RandomAffine(degrees=0, shear=30)(img)
+        assert out.shape == img.shape
+        assert not np.allclose(out, img)
+
+    def test_reduce_lr_single_step_per_epoch(self):
+        cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                                patience=2, min_delta=0.0)
+
+        class FakeOpt:
+            lr = 0.1
+
+            def get_lr(self):
+                return self.lr
+
+            def set_lr(self, v):
+                self.lr = v
+
+        class FakeModel:
+            _optimizer = FakeOpt()
+
+        cb.model = FakeModel()
+        for _ in range(2):
+            cb.on_epoch_end(0, {"loss": 1.0})
+            cb.on_eval_end({"loss": 1.0})  # must NOT double-count
+        assert cb.model._optimizer.lr == 0.1  # patience=2 not yet exhausted
+        cb.on_epoch_end(0, {"loss": 1.0})
+        assert cb.model._optimizer.lr == 0.05
+
+    def test_audio_8bit_unsigned(self, tmp_path):
+        import wave as wavelib
+        path = tmp_path / "u8.wav"
+        data = np.full(100, 128, np.uint8)  # silence in unsigned 8-bit
+        with wavelib.open(str(path), "wb") as w:
+            w.setnchannels(1)
+            w.setsampwidth(1)
+            w.setframerate(8000)
+            w.writeframes(data.tobytes())
+        sig, sr = paddle.audio.backends.load(path)
+        np.testing.assert_allclose(np.asarray(sig.numpy()), 0.0, atol=1e-6)
