@@ -1,0 +1,81 @@
+"""Eager-dispatch overhead microbenchmark (SURVEY.md §7 hard-part #2).
+
+Measures fwd+bwd through the eager tape (apply() -> vjp record, one device
+dispatch per op) vs the SAME fwd+bwd chain compiled under ``to_static`` —
+quantifying the Python dispatch cost the reference buries in codegen'd C++
+ad_funcs, and the factor whole-step compilation buys back. Both paths run
+forward AND backward; timing blocks on the produced gradient.
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N_ITERS = 200          # loop iterations; each runs 2 elementwise ops
+OPS = 2 * N_ITERS      # elementwise ops per forward chain (+ final sum)
+
+
+def main() -> None:
+    import jax
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.random.randn(64, 64).astype("float32"),
+                         stop_gradient=False)
+
+    def chain(v):
+        for _ in range(N_ITERS):
+            v = v * 1.0001 + 0.001
+        return v.sum()
+
+    def eager_step():
+        loss = chain(x)
+        loss.backward()
+        jax.block_until_ready(x.grad._data)  # wait on the actual output
+        x.clear_grad()
+
+    eager_step()  # warm-up covers backward-path setup too
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        eager_step()
+    eager_dt = (time.perf_counter() - t0) / reps
+
+    # compiled fwd+bwd (symmetric with the eager measurement)
+    @paddle.jit.to_static
+    def static_step(v):
+        loss = chain(v)
+        loss.backward()
+        return loss
+
+    static_step(x)  # compile
+    x.clear_grad()
+    t0 = time.perf_counter()
+    for _ in range(reps * 10):
+        static_step(x)
+    jax.block_until_ready(x.grad._data)
+    static_dt = (time.perf_counter() - t0) / (reps * 10)
+    x.clear_grad()
+
+    print(json.dumps({
+        "benchmark": "eager_dispatch",
+        "chain_elementwise_ops": OPS,
+        "eager_fwd_bwd_ms": round(eager_dt * 1e3, 2),
+        "eager_us_per_op": round(1e6 * eager_dt / OPS, 1),
+        "compiled_fwd_bwd_ms": round(static_dt * 1e3, 3),
+        "eager_vs_compiled_x": round(eager_dt / static_dt, 1),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()
