@@ -84,3 +84,20 @@ def check_numerics(tensor, op_type: str = "", var_name: str = "",
         print(msg)
     return Tensor(jnp.asarray([num_nan], jnp.int64)), \
         Tensor(jnp.asarray([num_inf], jnp.int64))
+
+
+class TensorCheckerConfig:
+    """Parity: paddle.amp.debugging.TensorCheckerConfig — configures the
+    NaN/Inf sweep driven by the pre-existing enable_tensor_checker
+    (FLAGS_check_nan_inf)."""
+
+    def __init__(self, enable=False, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = list(checked_op_list or [])
+        self.skipped_op_list = list(skipped_op_list or [])
+        self.debug_step = debug_step
+        self.stack_height_limit = stack_height_limit

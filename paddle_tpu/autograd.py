@@ -231,3 +231,43 @@ class PyLayer:
                 o._grad_node = node
                 o._grad_index = i
         return out if multi else outs[0]
+
+
+import contextlib as _contextlib
+
+_saved_hooks_stack = []
+
+
+@_contextlib.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """Parity: paddle.autograd.saved_tensors_hooks. The eager tape stores
+    residuals inside jax vjp closures (not as framework tensors), so
+    pack/unpack cannot intercept them tensor-by-tensor; the supported
+    memory-control path is fleet.utils.recompute. The context records the
+    hooks so code probing for the API runs; a warning states the
+    divergence."""
+    import warnings
+
+    warnings.warn(
+        "saved_tensors_hooks: residuals live inside jax vjp closures on this "
+        "runtime; hooks are recorded but not applied per-tensor. Use "
+        "fleet.utils.recompute (activation checkpointing) for memory "
+        "control.", stacklevel=2)
+    _saved_hooks_stack.append((pack_hook, unpack_hook))
+    try:
+        yield
+    finally:
+        _saved_hooks_stack.pop()
+
+
+def set_detect_anomaly(mode: bool) -> None:
+    """Parity: anomaly detection — when on, backward() checks every produced
+    gradient for NaN/Inf and raises naming the op. Single source of truth:
+    the flag backward() reads in core.autograd."""
+    from .core import autograd as _core_ad
+    _core_ad._detect_anomaly = bool(mode)
+
+
+def is_anomaly_enabled() -> bool:
+    from .core import autograd as _core_ad
+    return _core_ad._detect_anomaly

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 __all__ = ["GradNode", "backward", "grad"]
 
 _node_counter = itertools.count()
+_detect_anomaly = False  # toggled by paddle.autograd.set_detect_anomaly
 
 # When non-None, _accumulate_leaf only writes .grad for these tensor ids
 # (used by paddle.grad to avoid polluting unrelated leaves).
@@ -126,6 +127,14 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
             for s, av in zip(slots, node.out_avals)
         ]
         in_grads = node.vjp_fn(tuple(filled) if node.n_outputs > 1 else filled[0])
+        if _detect_anomaly:
+            for g in in_grads:
+                if g is not None and hasattr(g, "dtype") and \
+                        jnp.issubdtype(g.dtype, jnp.floating) and \
+                        not bool(jnp.isfinite(g).all()):
+                    raise RuntimeError(
+                        f"anomaly detected: non-finite gradient produced by "
+                        f"{node} (enable via set_detect_anomaly)")
         for (t, sub, slot), g in zip(node.input_links, in_grads):
             if t.stop_gradient or g is None:
                 continue

@@ -591,3 +591,12 @@ __all__ += ["TransformedDistribution", "Transform", "AffineTransform",
             "PowerTransform", "ChainTransform", "AbsTransform",
             "SoftmaxTransform", "ReshapeTransform", "IndependentTransform",
             "StackTransform", "transform"]
+
+
+from .extra import (  # noqa: E402,F401
+    Binomial, Cauchy, ExponentialFamily, Gamma, Independent,
+    MultivariateNormal, Poisson, StudentT,
+)
+
+__all__ += ["ExponentialFamily", "Gamma", "Poisson", "Binomial", "Cauchy",
+            "StudentT", "MultivariateNormal", "Independent"]

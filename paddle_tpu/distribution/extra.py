@@ -1,0 +1,310 @@
+"""Second wave of distributions: Gamma, Poisson, Binomial, Cauchy, StudentT,
+MultivariateNormal, Independent, ExponentialFamily.
+
+Parity: python/paddle/distribution/. Samplers draw from the global
+splittable PRNG; log_probs route parameters through ``apply`` so gradients
+reach them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..ops._helpers import ensure_tensor
+from . import Distribution, register_kl
+
+__all__ = ["ExponentialFamily", "Gamma", "Poisson", "Binomial", "Cauchy",
+           "StudentT", "MultivariateNormal", "Independent"]
+
+
+class ExponentialFamily(Distribution):
+    """Base marker (reference: paddle.distribution.ExponentialFamily);
+    entropy via Bregman divergence collapses to subclass closed forms here."""
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = ensure_tensor(concentration)
+        self.rate = ensure_tensor(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration._data.shape, self.rate._data.shape))
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("gamma_rsample",
+                     lambda a, r: jax.random.gamma(key, jnp.broadcast_to(
+                         a, shp)) / r,
+                     self.concentration, self.rate)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            "gamma_log_prob",
+            lambda v, a, r: (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v -
+                             jax.scipy.special.gammaln(a)),
+            value, self.concentration, self.rate)
+
+    @property
+    def mean(self):
+        return apply("gamma_mean", lambda a, r: a / r,
+                     self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return apply("gamma_var", lambda a, r: a / (r * r),
+                     self.concentration, self.rate)
+
+    def entropy(self):
+        return apply(
+            "gamma_entropy",
+            lambda a, r: (a - jnp.log(r) + jax.scipy.special.gammaln(a) +
+                          (1 - a) * jax.scipy.special.digamma(a)),
+            self.concentration, self.rate)
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = ensure_tensor(rate)
+        super().__init__(self.rate._data.shape)
+
+    def sample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("poisson_sample",
+                     lambda r: jax.random.poisson(key, jnp.broadcast_to(
+                         r, shp)).astype(jnp.float32),
+                     self.rate, differentiable=False)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            "poisson_log_prob",
+            lambda v, r: v * jnp.log(r) - r -
+            jax.scipy.special.gammaln(v + 1.0),
+            value, self.rate)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+
+class Binomial(ExponentialFamily):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = ensure_tensor(total_count)
+        self.probs_t = ensure_tensor(probs)
+        super().__init__(jnp.broadcast_shapes(
+            self.total_count._data.shape, self.probs_t._data.shape))
+
+    def sample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+
+        def f(n, p):
+            return jax.random.binomial(
+                key, jnp.broadcast_to(n, shp).astype(jnp.float32),
+                jnp.broadcast_to(p, shp)).astype(jnp.float32)
+
+        return apply("binom_sample", f, self.total_count, self.probs_t,
+                     differentiable=False)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, n, p):
+            logc = (jax.scipy.special.gammaln(n + 1.0) -
+                    jax.scipy.special.gammaln(v + 1.0) -
+                    jax.scipy.special.gammaln(n - v + 1.0))
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        return apply("binom_log_prob", f, value, self.total_count,
+                     self.probs_t)
+
+    @property
+    def mean(self):
+        return apply("binom_mean", lambda n, p: n * p, self.total_count,
+                     self.probs_t)
+
+    @property
+    def variance(self):
+        return apply("binom_var", lambda n, p: n * p * (1 - p),
+                     self.total_count, self.probs_t)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc._data.shape,
+                                              self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("cauchy_rsample",
+                     lambda l, s: l + s * jax.random.cauchy(key, shp),
+                     self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        return apply(
+            "cauchy_log_prob",
+            lambda v, l, s: -jnp.log(jnp.pi) - jnp.log(s) -
+            jnp.log1p(((v - l) / s) ** 2),
+            value, self.loc, self.scale)
+
+    def entropy(self):
+        return apply("cauchy_entropy",
+                     lambda s: jnp.log(4 * jnp.pi * s), self.scale)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = ensure_tensor(df)
+        self.loc = ensure_tensor(loc)
+        self.scale = ensure_tensor(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df._data.shape, self.loc._data.shape,
+            self.scale._data.shape))
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = tuple(shape) + self.batch_shape
+        return apply("studentt_rsample",
+                     lambda d, l, s: l + s * jax.random.t(
+                         key, jnp.broadcast_to(d, shp)),
+                     self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, d, l, s):
+            z = (v - l) / s
+            return (jax.scipy.special.gammaln((d + 1) / 2) -
+                    jax.scipy.special.gammaln(d / 2) -
+                    0.5 * jnp.log(d * jnp.pi) - jnp.log(s) -
+                    (d + 1) / 2 * jnp.log1p(z * z / d))
+
+        return apply("studentt_log_prob", f, value, self.df, self.loc,
+                     self.scale)
+
+
+class MultivariateNormal(Distribution):
+    """N(loc, covariance_matrix) (reference:
+    paddle.distribution.MultivariateNormal)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 precision_matrix=None, name=None):
+        self.loc = ensure_tensor(loc)
+        if scale_tril is not None:
+            self.scale_tril = ensure_tensor(scale_tril)
+        elif covariance_matrix is not None:
+            cov = ensure_tensor(covariance_matrix)
+            self.scale_tril = apply("mvn_chol", jnp.linalg.cholesky, cov)
+        elif precision_matrix is not None:
+            prec = ensure_tensor(precision_matrix)
+            self.scale_tril = apply(
+                "mvn_prec_chol",
+                lambda p: jnp.linalg.cholesky(jnp.linalg.inv(p)), prec)
+        else:
+            raise ValueError("one of covariance_matrix / scale_tril / "
+                             "precision_matrix is required")
+        d = int(self.loc._data.shape[-1])
+        # batch shape broadcasts loc's and the matrix's batch dims
+        batch = jnp.broadcast_shapes(self.loc._data.shape[:-1],
+                                     self.scale_tril._data.shape[:-2])
+        super().__init__(batch, (d,))
+
+    def rsample(self, shape=()):
+        key = self._key()
+        shp = (tuple(shape) + self.batch_shape + self.event_shape)
+
+        def f(l, st):
+            eps = jax.random.normal(key, shp)
+            return l + jnp.einsum("...ij,...j->...i", st, eps)
+
+        return apply("mvn_rsample", f, self.loc, self.scale_tril)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+
+        def f(v, l, st):
+            d = l.shape[-1]
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(st, diff[..., None],
+                                                    lower=True)[..., 0]
+            maha = jnp.sum(sol * sol, axis=-1)
+            logdet = jnp.sum(jnp.log(jnp.abs(
+                jnp.diagonal(st, axis1=-2, axis2=-1))), axis=-1)
+            return -0.5 * (d * jnp.log(2 * jnp.pi) + maha) - logdet
+
+        return apply("mvn_log_prob", f, value, self.loc, self.scale_tril)
+
+    def entropy(self):
+        def f(st):
+            d = st.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.abs(
+                jnp.diagonal(st, axis1=-2, axis2=-1))), axis=-1)
+            return 0.5 * d * (1 + jnp.log(2 * jnp.pi)) + logdet
+
+        return apply("mvn_entropy", f, self.scale_tril)
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims of a
+    base distribution as event dims (log_prob sums over them)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        super().__init__(bshape[: len(bshape) - self.rank],
+                         bshape[len(bshape) - self.rank:] +
+                         tuple(base.event_shape))
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if self.rank == 0:
+            return lp
+        return apply("independent_log_prob",
+                     lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))),
+                     lp)
+
+    def entropy(self):
+        ent = self.base.entropy()
+        if self.rank == 0:
+            return ent
+        return apply("independent_entropy",
+                     lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))),
+                     ent)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    def f(a1, r1, a2, r2):
+        return ((a1 - a2) * jax.scipy.special.digamma(a1) -
+                jax.scipy.special.gammaln(a1) + jax.scipy.special.gammaln(a2) +
+                a2 * (jnp.log(r1) - jnp.log(r2)) + a1 * (r2 - r1) / r1)
+
+    return apply("kl_gamma", f, p.concentration, p.rate, q.concentration,
+                 q.rate)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return apply("kl_poisson",
+                 lambda r1, r2: r1 * (jnp.log(r1) - jnp.log(r2)) + r2 - r1,
+                 p.rate, q.rate)

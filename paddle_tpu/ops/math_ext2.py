@@ -345,3 +345,15 @@ def _inplace(method_name, op_name):
 _inplace("put_along_axis_", "put_along_axis")
 _inplace("transpose_", "transpose")
 _inplace("flatten_", "flatten") if "flatten" in _REG else None
+
+
+# Tensor protocol / inplace tail
+def _tensor_dlpack(self, stream=None, **kwargs):
+    from ..utils.dlpack import to_dlpack
+    return to_dlpack(self)
+
+
+register_tensor_method("__dlpack__", _tensor_dlpack)
+register_tensor_method("__dlpack_device__",
+                       lambda self: self._data.__dlpack_device__())
+_inplace("sigmoid_", "sigmoid")

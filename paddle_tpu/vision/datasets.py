@@ -132,3 +132,79 @@ class DatasetFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(_SyntheticImages):
+    """Flowers102 surface (synthetic-local: zero-egress build)."""
+
+    def __init__(self, mode="train", transform=None, download=False,
+                 backend=None):
+        n = 1020 if mode == "train" else 1020 if mode == "valid" else 6149
+        super().__init__(n, (3, 224, 224), 102, transform, seed=7)
+        self.mode = mode
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation surface: (image, label-mask) pairs
+    (synthetic-local: class-conditional blobs with a consistent mask)."""
+
+    def __init__(self, mode="train", transform=None, download=False,
+                 backend=None):
+        self.n = 1464 if mode == "train" else 1449
+        self.transform = transform
+        self._seed = 21
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self._seed + idx)
+        img = rng.normal(0, 1, (3, 224, 224)).astype(np.float32)
+        mask = np.zeros((224, 224), np.int64)
+        cls = idx % 20 + 1
+        cx, cy = rng.integers(64, 160, 2)
+        mask[cy - 40:cy + 40, cx - 40:cx + 40] = cls
+        img[:, mask > 0] += 1.5  # the object region is visibly brighter
+        if self.transform:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return self.n
+
+
+class ImageFolder(Dataset):
+    """Unlabeled folder of images (reference: vision.datasets.ImageFolder —
+    flat list, returns [img] per sample). Reads .npy arrays; .png/.jpg when
+    PIL is importable."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader
+        exts = tuple(extensions or (".npy", ".png", ".jpg", ".jpeg"))
+        self.samples = []
+        for base, _dirs, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(base, fname)
+                if is_valid_file is not None:
+                    # reference passes the FULL path to the predicate
+                    if is_valid_file(path):
+                        self.samples.append(path)
+                elif fname.lower().endswith(exts):
+                    self.samples.append(path)
+
+    def _load(self, path):
+        if self.loader is not None:
+            return self.loader(path)
+        if path.endswith(".npy"):
+            return np.load(path)
+        from . import image_load
+        return np.asarray(image_load(path), np.float32)
+
+    def __getitem__(self, idx):
+        img = self._load(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

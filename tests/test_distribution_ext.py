@@ -1,0 +1,212 @@
+"""Tests for the second distribution wave (scipy as the numeric reference),
+wave-4 datasets, anomaly detection, and the tensor-protocol tail."""
+
+import warnings
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as paddle
+import paddle_tpu.vision as vision
+from paddle_tpu import distribution as D
+
+
+class TestDistributionsWave2:
+    def test_gamma_matches_scipy(self):
+        v = np.array([0.5, 1.5, 3.0], "float32")
+        g = D.Gamma(2.0, 0.5)
+        np.testing.assert_allclose(
+            np.asarray(g.log_prob(paddle.to_tensor(v)).numpy()),
+            stats.gamma.logpdf(v, 2.0, scale=2.0), rtol=1e-5)
+        np.testing.assert_allclose(float(g.entropy().numpy()),
+                                   stats.gamma.entropy(2.0, scale=2.0),
+                                   rtol=1e-5)
+        assert abs(float(g.mean.numpy()) - 4.0) < 1e-6
+        s = g.rsample((2000,))
+        assert abs(float(s.numpy().mean()) - 4.0) < 0.5
+
+    def test_poisson_matches_scipy(self):
+        p = D.Poisson(3.0)
+        np.testing.assert_allclose(
+            np.asarray(p.log_prob(paddle.to_tensor(
+                np.array([0.0, 2.0, 5.0], "float32"))).numpy()),
+            stats.poisson.logpmf([0, 2, 5], 3.0), rtol=1e-5)
+        s = p.sample((2000,))
+        assert abs(float(s.numpy().mean()) - 3.0) < 0.3
+
+    def test_binomial_matches_scipy(self):
+        b = D.Binomial(paddle.to_tensor(10.0), paddle.to_tensor(0.3))
+        np.testing.assert_allclose(
+            float(b.log_prob(paddle.to_tensor(4.0)).numpy()),
+            stats.binom.logpmf(4, 10, 0.3), rtol=1e-5)
+        assert abs(float(b.mean.numpy()) - 3.0) < 1e-6
+
+    def test_cauchy_student_match_scipy(self):
+        v = np.array([0.5, 1.5, 3.0], "float32")
+        c = D.Cauchy(1.0, 2.0)
+        np.testing.assert_allclose(
+            np.asarray(c.log_prob(paddle.to_tensor(v)).numpy()),
+            stats.cauchy.logpdf(v, 1.0, 2.0), rtol=1e-5)
+        t = D.StudentT(5.0, 1.0, 2.0)
+        np.testing.assert_allclose(
+            np.asarray(t.log_prob(paddle.to_tensor(v)).numpy()),
+            stats.t.logpdf(v, 5.0, 1.0, 2.0), rtol=1e-5)
+
+    def test_mvn_matches_scipy(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        mvn = D.MultivariateNormal(
+            paddle.to_tensor(np.array([1.0, -1.0], "float32")),
+            covariance_matrix=paddle.to_tensor(cov))
+        x = np.array([0.3, 0.7], "float32")
+        np.testing.assert_allclose(
+            float(mvn.log_prob(paddle.to_tensor(x)).numpy()),
+            stats.multivariate_normal.logpdf(x, [1.0, -1.0], cov), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(mvn.entropy().numpy()),
+            stats.multivariate_normal([1.0, -1.0], cov).entropy(), rtol=1e-4)
+        s = np.asarray(mvn.rsample((4000,)).numpy())
+        np.testing.assert_allclose(s.mean(0), [1.0, -1.0], atol=0.15)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.2)
+
+    def test_independent_sums_event_dims(self):
+        base = D.Normal(np.zeros(3, "float32"), np.ones(3, "float32"))
+        ind = D.Independent(base, 1)
+        v = np.array([0.5, 1.5, 3.0], "float32")
+        np.testing.assert_allclose(
+            float(ind.log_prob(paddle.to_tensor(v)).numpy()),
+            stats.norm.logpdf(v).sum(), rtol=1e-5)
+        assert ind.event_shape == (3,)
+
+    def test_gamma_kl(self):
+        kl = D.kl_divergence(D.Gamma(2.0, 1.0), D.Gamma(3.0, 2.0))
+        assert float(kl.numpy()) > 0
+        self_kl = D.kl_divergence(D.Gamma(2.0, 1.0), D.Gamma(2.0, 1.0))
+        assert abs(float(self_kl.numpy())) < 1e-6
+
+    def test_rsample_grads_flow(self):
+        conc = paddle.to_tensor(np.array([2.0], "float32"),
+                                stop_gradient=False)
+        g = D.Gamma(conc, paddle.to_tensor(np.array([1.0], "float32")))
+        g.rsample((8,)).sum().backward()
+        assert conc.grad is not None
+
+
+class TestWave4Datasets:
+    def test_flowers_voc(self):
+        f = vision.datasets.Flowers(mode="train")
+        img, lab = f[0]
+        assert img.shape == (3, 224, 224) and 0 <= int(lab) < 102
+        voc = vision.datasets.VOC2012()
+        img, mask = voc[0]
+        assert mask.shape == (224, 224) and mask.max() >= 1
+
+    def test_image_folder(self, tmp_path):
+        for i in range(3):
+            np.save(tmp_path / f"img{i}.npy",
+                    np.random.rand(3, 4, 4).astype("float32"))
+        ds = vision.datasets.ImageFolder(str(tmp_path))
+        assert len(ds) == 3
+        (img,) = ds[0]
+        assert img.shape == (3, 4, 4)
+
+    def test_concat_dataset(self):
+        d1 = vision.datasets.MNIST(mode="test")
+        cd = paddle.io.ConcatDataset([d1, d1])
+        assert len(cd) == 2 * len(d1)
+        a, _ = cd[len(d1) + 5]
+        b, _ = d1[5]
+        np.testing.assert_allclose(a, b)
+        with pytest.raises(ValueError):
+            paddle.io.ConcatDataset([])
+
+
+class TestAnomalyAndHooks:
+    def test_detect_anomaly_flags_nonfinite(self):
+        paddle.autograd.set_detect_anomaly(True)
+        try:
+            x = paddle.to_tensor(np.array([0.0], "float32"),
+                                 stop_gradient=False)
+            with pytest.raises(RuntimeError, match="anomaly"):
+                paddle.log(x).backward()
+        finally:
+            paddle.autograd.set_detect_anomaly(False)
+        x2 = paddle.to_tensor(np.array([2.0], "float32"),
+                              stop_gradient=False)
+        paddle.log(x2).backward()
+        np.testing.assert_allclose(np.asarray(x2.grad.numpy()), [0.5])
+
+    def test_saved_tensors_hooks_warns(self):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with paddle.autograd.saved_tensors_hooks(lambda t: t,
+                                                     lambda t: t):
+                pass
+        assert any("recompute" in str(x.message) for x in w)
+
+    def test_tensor_checker_config(self):
+        cfg = paddle.amp.debugging.TensorCheckerConfig(enable=True)
+        paddle.amp.debugging.enable_tensor_checker(cfg)
+        paddle.amp.debugging.disable_tensor_checker()
+
+
+class TestTensorProtocolTail:
+    def test_dlpack_protocol(self):
+        t = paddle.to_tensor(np.random.rand(2, 2).astype("float32"))
+        assert t.__dlpack_device__() is not None
+        np.testing.assert_allclose(np.from_dlpack(t), t.numpy())
+
+    def test_sigmoid_(self):
+        t = paddle.to_tensor(np.array([0.0], "float32"))
+        t.sigmoid_()
+        np.testing.assert_allclose(np.asarray(t.numpy()), [0.5])
+
+
+class TestReviewFixes8:
+    def test_mvn_batched_covariance(self):
+        cov = np.stack([np.eye(2, dtype="float32") * (i + 1)
+                        for i in range(5)])
+        mvn = D.MultivariateNormal(
+            paddle.to_tensor(np.zeros(2, "float32")),
+            covariance_matrix=paddle.to_tensor(cov))
+        assert mvn.batch_shape == (5,)
+        s = mvn.rsample((3,))
+        assert s.shape == [3, 5, 2]
+        lp = mvn.log_prob(paddle.to_tensor(np.zeros((5, 2), "float32")))
+        assert lp.shape == [5]
+
+    def test_concat_out_of_range_raises(self):
+        d1 = vision.datasets.MNIST(mode="test")
+        cd = paddle.io.ConcatDataset([d1])
+        with pytest.raises(IndexError):
+            cd[len(d1)]
+        with pytest.raises(IndexError):
+            cd[-len(d1) - 1]
+
+    def test_image_folder_full_path_predicate(self, tmp_path):
+        sub = tmp_path / "keep"
+        sub.mkdir()
+        np.save(sub / "a.npy", np.zeros((1,), "float32"))
+        np.save(tmp_path / "b.npy", np.zeros((1,), "float32"))
+        import os
+        ds = vision.datasets.ImageFolder(
+            str(tmp_path), is_valid_file=lambda p: "keep" in p and
+            os.path.exists(p))
+        assert len(ds) == 1
+
+    def test_tensor_checker_old_signature_still_works(self):
+        paddle.amp.debugging.enable_tensor_checker()  # no-arg form
+        paddle.amp.debugging.disable_tensor_checker()
+        cfg = paddle.amp.debugging.TensorCheckerConfig(enable=True)
+        paddle.amp.debugging.enable_tensor_checker(cfg)
+        paddle.amp.debugging.disable_tensor_checker()
+
+    def test_anomaly_flag_single_source(self):
+        paddle.autograd.set_detect_anomaly(True)
+        try:
+            assert paddle.autograd.is_anomaly_enabled()
+            from paddle_tpu.core import autograd as core_ad
+            assert core_ad._detect_anomaly
+        finally:
+            paddle.autograd.set_detect_anomaly(False)
+        assert not paddle.autograd.is_anomaly_enabled()
