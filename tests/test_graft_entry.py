@@ -1,0 +1,64 @@
+"""The driver's multichip dry-run must survive a TPU-latched environment.
+
+Round-1 regression: ``dryrun_multichip`` relied on XLA_FLAGS alone, so when
+the driver called it in a process whose default jax platform was the real
+TPU plugin, model init allocated on the chip and died (libtpu mismatch —
+MULTICHIP_r01.json). The fix pins the platform programmatically inside
+``dryrun_multichip`` itself. These tests run the entry module in a fresh
+subprocess WITHOUT scrubbing the TPU env, exactly like the driver does.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code: str, env_overrides=None, timeout=600):
+    env = dict(os.environ)
+    # deliberately do NOT strip TPU-related vars; only drop the CPU pins the
+    # test conftest added, restoring the hostile driver-like environment
+    if env.get("JAX_PLATFORMS") == "cpu":
+        del env["JAX_PLATFORMS"]
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = " ".join(
+        f for f in flags.split() if "host_platform_device_count" not in f)
+    if env_overrides:
+        env.update(env_overrides)
+    return subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_survives_unscrubbed_env():
+    r = _run("import __graft_entry__ as g; g.dryrun_multichip(8)")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "dryrun_multichip OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_after_jax_import():
+    # driver may import jax (and even list devices) before calling us
+    r = _run(
+        "import jax\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "dryrun_multichip OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_after_backend_init():
+    # worst case: the default (possibly TPU) backend is already initialized
+    # when dryrun_multichip is called — it must re-pin to an 8-device CPU mesh
+    r = _run(
+        "import jax\n"
+        "jax.devices()\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "dryrun_multichip OK" in r.stdout
