@@ -10,6 +10,8 @@ import numpy as np
 
 import paddle_tpu as paddle
 
+paddle.device.force_platform_from_env()
+
 
 def main():
     ap = argparse.ArgumentParser()

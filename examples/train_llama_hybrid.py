@@ -22,16 +22,20 @@ def main():
     args = ap.parse_args()
 
     need = args.dp * args.mp
-    if f"host_platform_device_count={need}" not in os.environ.get(
-            "XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                                   f" --xla_force_host_platform_device_count"
-                                   f"={need}").strip()
 
     import jax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     import paddle_tpu as paddle
+
+    paddle.device.force_platform_from_env()
+    # this config demos the hybrid mesh; unless a machine really has `need`
+    # accelerator chips, build the virtual CPU mesh (programmatically — env
+    # vars are latched by TPU-plugin sitecustomize hooks)
+    if len(jax.devices()) < need:
+        paddle.device.force_platform("cpu", need)
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
     from paddle_tpu.core.tensor import _state_registry
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 

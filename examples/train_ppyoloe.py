@@ -9,6 +9,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import paddle_tpu as paddle
+
+paddle.device.force_platform_from_env()
 from paddle_tpu.models.ppyoloe import PPYOLOE, PPYOLOEConfig
 
 

@@ -19,6 +19,7 @@ __all__ = [
     "XPUPlace", "MLUPlace", "IPUPlace", "CUDAPinnedPlace",
     "set_device", "get_device", "get_all_devices", "device_count",
     "is_compiled_with_cuda", "is_compiled_with_tpu", "current_place",
+    "force_platform", "force_platform_from_env",
 ]
 
 
@@ -299,3 +300,58 @@ xpu = _DeviceStatsNS()
 
 def synchronize(device=None) -> None:
     _DeviceStatsNS.synchronize(device)
+
+
+def force_platform(platform: str, device_count: Optional[int] = None) -> None:
+    """Pin the jax platform programmatically, even in environments where a
+    TPU plugin's sitecustomize overrides ``JAX_PLATFORMS`` env vars.
+
+    If backends were already initialized, drops the stale clients and
+    re-initializes — which invalidates any live jax arrays/executables, so
+    call this FIRST in a process (examples/tests do, via
+    ``force_platform_from_env``). ``device_count`` forces a virtual device
+    count on the cpu platform (the SURVEY §4 fake-mesh pattern).
+    """
+    import os
+
+    os.environ["JAX_PLATFORMS"] = platform
+    if device_count is not None and platform == "cpu":
+        flag = f"--xla_force_host_platform_device_count={device_count}"
+        if flag not in os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    import warnings
+
+    try:
+        from jax._src import xla_bridge
+        if getattr(xla_bridge, "_backends", None):
+            xla_bridge._clear_backends()
+            xla_bridge.get_backend.cache_clear()
+    except Exception as e:  # private jax API may move in an upgrade
+        warnings.warn(f"force_platform: could not clear latched jax "
+                      f"backends ({e!r}); the platform pin may not apply")
+    try:
+        jax.config.update("jax_platforms", platform)
+    except Exception as e:
+        warnings.warn(f"force_platform: jax_platforms update failed ({e!r})")
+    if device_count is not None and platform == "cpu":
+        try:
+            jax.config.update("jax_num_cpu_devices", device_count)
+        except Exception as e:
+            warnings.warn(f"force_platform: jax_num_cpu_devices update "
+                          f"failed ({e!r}); relying on XLA_FLAGS")
+
+
+def force_platform_from_env() -> None:
+    """Apply ``PADDLE_PLATFORM`` / ``PADDLE_PLATFORM_DEVICE_COUNT`` if set.
+
+    Entry-point scripts call this before any jax work so test harnesses can
+    pin them to the virtual CPU mesh (plain env vars are latched by TPU
+    plugin sitecustomize hooks, so subprocess env alone is NOT enough)."""
+    import os
+
+    plat = os.environ.get("PADDLE_PLATFORM")
+    if not plat:
+        return
+    cnt = os.environ.get("PADDLE_PLATFORM_DEVICE_COUNT")
+    force_platform(plat, int(cnt) if cnt else None)

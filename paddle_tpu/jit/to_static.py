@@ -154,6 +154,17 @@ class StaticFunction:
             return self.__call__(*args, **kwargs)
 
         state_arrays = [t._data for t in state_tensors]
+        if self._donate:
+            # donated buffers must be unique: two state tensors aliasing one
+            # jax.Array (or a state array that is also a plain argument) make
+            # XLA reject the executable call on TPU. Copy the duplicates so
+            # every donated slot owns its buffer.
+            seen = {id(a) for a in arg_arrays}
+            for i, a in enumerate(state_arrays):
+                if id(a) in seen:
+                    state_arrays[i] = jnp.copy(a)
+                else:
+                    seen.add(id(a))
         out_arrays, new_state, mut_vals = jitted(state_arrays, arg_arrays)
         for t, arr in zip(state_tensors, new_state):
             t._data = arr

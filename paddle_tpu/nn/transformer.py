@@ -254,19 +254,25 @@ def _clone_layer(layer: Layer) -> Layer:
         if p is None:
             new._parameters[name] = None
         else:
-            q = Parameter(p._data, trainable=p.trainable)
-            from ..nn.initializer import Normal
-            # re-draw so the clone is an independent init
+            # re-draw so the clone is an independent init; when the redraw
+            # is skipped (zero-variance or non-float params) the clone must
+            # still OWN its array — sharing p._data between two state
+            # tensors makes to_static donate the same buffer twice, which
+            # the TPU runtime rejects (INVALID_ARGUMENT)
             from ..core.random import default_generator
             import jax
             k = default_generator.split_key()
+            std = 0.0
             if jnp.issubdtype(p._data.dtype, jnp.floating):
                 std = float(jnp.std(p._data)) if p._data.size > 1 else 0.0
-                if std > 0:
-                    q._set_data(jax.random.normal(k, p._data.shape, p._data.dtype) * std)
-            new._parameters[name] = q
+            if std > 0:
+                data = jax.random.normal(k, p._data.shape, p._data.dtype) * std
+            else:
+                data = jnp.array(p._data, copy=True)
+            new._parameters[name] = Parameter(data, trainable=p.trainable)
     for name, b in layer._buffers.items():
-        new._buffers[name] = None if b is None else Tensor(b._data)
+        new._buffers[name] = (None if b is None
+                              else Tensor(jnp.array(b._data, copy=True)))
     for name, sub in layer._sub_layers.items():
         new._sub_layers[name] = _clone_layer(sub)
     return new

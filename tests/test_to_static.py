@@ -209,3 +209,40 @@ def test_iters_per_call_eager_fallback_matches():
     for a, b in zip(model.parameters(), model2.parameters()):
         np.testing.assert_allclose(np.asarray(a._data), np.asarray(b._data),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_cloned_encoder_layers_own_their_buffers():
+    """Round-1 TPU regression: TransformerEncoder clones shared the
+    prototype's jax.Array for zero-variance params (biases, LN weights) and
+    all buffers, so to_static donated the same buffer twice — the TPU
+    runtime rejects that (INVALID_ARGUMENT). Clones must own their arrays."""
+    import paddle_tpu.nn as nn
+
+    layer = nn.TransformerEncoderLayer(
+        d_model=16, nhead=2, dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 3)
+    seen = {}
+    for name, p in enc.state_dict().items():
+        key = id(p._data)
+        assert key not in seen, (
+            f"{name} aliases {seen[key]}: donated twice under jit")
+        seen[key] = name
+
+
+def test_to_static_dedupes_aliased_state_donation():
+    """Even if two live state tensors alias one array (e.g. hand-tied
+    weights), the donated buffer list must stay unique."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    a = paddle.nn.Linear(4, 4)
+    b = paddle.nn.Linear(4, 4)
+    b.weight._set_data(a.weight._data)  # deliberate alias
+
+    @paddle.jit.to_static
+    def f(x):
+        return (a(x) + b(x)).sum()
+
+    x = paddle.to_tensor(np.ones((2, 4), dtype="float32"))
+    out = float(f(x))
+    assert np.isfinite(out)
