@@ -22,10 +22,6 @@ __all__ = ["GradNode", "backward", "grad"]
 _node_counter = itertools.count()
 _detect_anomaly = False  # toggled by paddle.autograd.set_detect_anomaly
 
-# When non-None, _accumulate_leaf only writes .grad for these tensor ids
-# (used by paddle.grad to avoid polluting unrelated leaves).
-_leaf_filter: Optional[set] = None
-
 
 class GradNode:
     """One recorded op on the tape (analogue of ``GradNodeBase``).
@@ -35,23 +31,32 @@ class GradNode:
     *current* ``_grad_node`` of an input during backward would find a cycle.
     """
 
-    __slots__ = ("id", "op_name", "vjp_fn", "inputs", "input_links",
-                 "n_outputs", "out_avals", "released")
+    __slots__ = ("id", "op_name", "vjp_fn", "pure_fn", "inputs",
+                 "input_links", "n_outputs", "out_avals", "released",
+                 "multi_out")
 
-    def __init__(self, op_name: str, vjp_fn, inputs: Sequence[Any], n_outputs: int, out_avals):
+    def __init__(self, op_name: str, vjp_fn, inputs: Sequence[Any],
+                 n_outputs: int, out_avals, pure_fn=None, multi_out=None):
         self.id = next(_node_counter)
         self.op_name = op_name
         self.vjp_fn = vjp_fn
+        # the op's pure array->array fn; kept so create_graph=True can
+        # re-linearize the op as a function of its primals (the captured
+        # vjp_fn closes over them as constants, which double-grad can't use)
+        self.pure_fn = pure_fn
         self.inputs = tuple(inputs)  # input Tensors (strong refs keep graph alive)
         # (tensor, producing node or None, output slot) captured NOW:
         self.input_links = tuple(
             (t, t._grad_node, t._grad_index) for t in inputs)
         self.n_outputs = n_outputs
         self.out_avals = out_avals  # (shape, dtype) per output for zero-fill
+        # whether pure_fn returns a tuple (vjp cotangent structure must match)
+        self.multi_out = n_outputs > 1 if multi_out is None else multi_out
         self.released = False
 
     def release(self) -> None:
         self.vjp_fn = None
+        self.pure_fn = None
         self.inputs = ()
         self.input_links = ()
         self.released = True
@@ -75,13 +80,21 @@ def _topo_nodes(roots: Sequence[GradNode]) -> List[GradNode]:
     return [seen[i] for i in sorted(seen, reverse=True)]
 
 
-def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             create_graph: bool = False, _leaf_set: Optional[set] = None) -> None:
     """``paddle.autograd.backward`` / ``Tensor.backward``.
 
     Seeds the output cotangents (ones for scalar losses), walks the tape in
     reverse creation order, and accumulates leaf gradients into ``.grad``.
+    ``create_graph=True`` records the backward pass itself on the tape (each
+    node's vjp re-dispatches through the op layer), enabling grad-of-grad.
+    ``_leaf_set`` restricts which leaves receive ``.grad`` (paddle.grad).
     """
     from .tensor import Tensor  # local import to avoid cycle
+
+    if create_graph:
+        _backward_create_graph(tensors, grad_tensors, retain_graph, _leaf_set)
+        return
 
     if isinstance(tensors, Tensor):
         tensors = [tensors]
@@ -108,7 +121,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
             seed = jnp.ones_like(t._data)
         node, idx = t._grad_node, t._grad_index
         if node is None:
-            _accumulate_leaf(t, seed)
+            _accumulate_leaf(t, seed, _leaf_set)
             continue
         slots = cotangents.setdefault(node.id, [None] * node.n_outputs)
         slots[idx] = seed if slots[idx] is None else slots[idx] + seed
@@ -126,7 +139,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
             s if s is not None else jnp.zeros(av[0], av[1])
             for s, av in zip(slots, node.out_avals)
         ]
-        in_grads = node.vjp_fn(tuple(filled) if node.n_outputs > 1 else filled[0])
+        in_grads = node.vjp_fn(tuple(filled) if node.multi_out else filled[0])
         if _detect_anomaly:
             for g in in_grads:
                 if g is not None and hasattr(g, "dtype") and \
@@ -142,7 +155,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
                 continue  # non-differentiable (integer) input
             g = _apply_hooks(t, g)
             if sub is None:
-                _accumulate_leaf(t, g)
+                _accumulate_leaf(t, g, _leaf_set)
             else:
                 sl = cotangents.setdefault(sub.id, [None] * sub.n_outputs)
                 sl[slot] = g if sl[slot] is None else sl[slot] + g
@@ -150,11 +163,11 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
             node.release()
 
 
-def _accumulate_leaf(t, g) -> None:
+def _accumulate_leaf(t, g, leaf_set: Optional[set] = None) -> None:
     """GradientAccumulator parity: sum into ``.grad`` in place."""
     from .tensor import Tensor
 
-    if _leaf_filter is not None and id(t) not in _leaf_filter:
+    if leaf_set is not None and id(t) not in leaf_set:
         return
 
     if g.dtype != t._data.dtype and jnp.issubdtype(t._data.dtype, jnp.floating):
@@ -165,6 +178,120 @@ def _accumulate_leaf(t, g) -> None:
         t.grad = gt
     else:
         t.grad._set_data(t.grad._data + g)
+
+
+def _backward_create_graph(tensors, grad_tensors, retain_graph: bool,
+                           leaf_set: Optional[set]) -> None:
+    """Tensor-space backward: cotangents are tape-connected Tensors and each
+    node's vjp is re-dispatched through ``apply`` as
+    ``grads = vjp(pure_fn at primals)(cotangents)`` — a differentiable op of
+    (primals, cotangents), so a further backward() through the produced
+    grads works (upstream: double-grad nodes in paddle/fluid/eager/)."""
+    from .tensor import Tensor, apply
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    cotangents: Dict[int, List[Optional[Tensor]]] = {}
+    roots: List[GradNode] = []
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True; "
+                "it is not connected to the autograd graph")
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "pass grad_tensors for non-scalar backward()")
+            seed = Tensor(jnp.ones_like(t._data), stop_gradient=True)
+        else:
+            seed = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g),
+                                                          stop_gradient=True)
+        node, idx = t._grad_node, t._grad_index
+        if node is None:
+            _accumulate_leaf_tensor(t, seed, leaf_set)
+            continue
+        slots = cotangents.setdefault(node.id, [None] * node.n_outputs)
+        slots[idx] = seed if slots[idx] is None else slots[idx] + seed
+        roots.append(node)
+
+    for node in _topo_nodes(roots):
+        slots = cotangents.pop(node.id, None)
+        if slots is None:
+            continue
+        if node.released:
+            raise RuntimeError(
+                f"trying to backward through {node} a second time; "
+                "set retain_graph=True to allow this")
+        if node.pure_fn is None:
+            raise RuntimeError(
+                f"{node} was recorded without its primal function; "
+                "create_graph=True needs ops dispatched through apply()")
+        filled = [
+            s if s is not None else Tensor(jnp.zeros(av[0], av[1]),
+                                           stop_gradient=True)
+            for s, av in zip(slots, node.out_avals)
+        ]
+        n_in = len(node.inputs)
+        pure_fn = node.pure_fn
+        multi_out = node.multi_out
+
+        def grad_fn(*xs_and_cts, _pure_fn=pure_fn, _n_in=n_in,
+                    _multi=multi_out):
+            xs, cts = xs_and_cts[:_n_in], xs_and_cts[_n_in:]
+            _, vjp = jax.vjp(_pure_fn, *xs)
+            gs = vjp(tuple(cts) if _multi else cts[0])
+            return tuple(gs)
+
+        in_grads = apply(f"{node.op_name}_grad", grad_fn,
+                         *node.inputs, *filled)
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        if _detect_anomaly:
+            for g in in_grads:
+                gd = getattr(g, "_data", g)
+                if gd is not None and hasattr(gd, "dtype") and \
+                        jnp.issubdtype(gd.dtype, jnp.floating) and \
+                        not bool(jnp.isfinite(gd).all()):
+                    raise RuntimeError(
+                        f"anomaly detected: non-finite gradient produced by "
+                        f"{node} (enable via set_detect_anomaly)")
+        for (t, sub, slot), g in zip(node.input_links, in_grads):
+            if t.stop_gradient or g is None:
+                continue
+            gd = g._data if isinstance(g, Tensor) else g
+            if getattr(gd, "dtype", None) is not None and                     gd.dtype == jax.dtypes.float0:
+                continue  # non-differentiable (integer) input
+            for hook in t._hooks.values():
+                out = hook(g)
+                if out is not None:
+                    g = out
+            if sub is None:
+                _accumulate_leaf_tensor(t, g, leaf_set)
+            else:
+                sl = cotangents.setdefault(sub.id, [None] * sub.n_outputs)
+                sl[slot] = g if sl[slot] is None else sl[slot] + g
+        if not retain_graph:
+            node.release()
+
+
+def _accumulate_leaf_tensor(t, g, leaf_set: Optional[set]) -> None:
+    """create_graph accumulation: ``.grad`` stays tape-connected."""
+    if leaf_set is not None and id(t) not in leaf_set:
+        return
+    if g.dtype != t.dtype and jnp.issubdtype(t._data.dtype, jnp.floating):
+        g = g.astype(t.dtype)
+    if t.grad is None:
+        g.name = (t.name or "tensor") + "@GRAD"
+        t.grad = g
+    else:
+        t.grad = t.grad + g
 
 
 def _apply_hooks(t, g):
@@ -187,31 +314,27 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     touching ``.grad`` slots. Implemented by running backward on a shadow
     accumulation map.
 
-    Note: ``create_graph=True`` (higher-order grads through the tape) is
-    supported by re-dispatching the vjp through the op layer is not yet
-    implemented — use ``to_static``/jax.grad composition for higher order.
+    ``create_graph=True`` records the backward itself on the tape, so the
+    returned grads are differentiable (grad-of-grad, WGAN-GP penalties).
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is not supported by the eager tape yet; "
-            "wrap the computation in paddle.jit.to_static and use jax.grad")
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = bool(create_graph)
 
-    # stash existing .grad, run backward, read, restore
-    global _leaf_filter
+    # stash existing .grad, run backward, read, restore (leaf filtering is
+    # threaded through as an argument — reentrant, unlike a module global)
     stash = [t.grad for t in inputs]
     for t in inputs:
         t.grad = None
-    _leaf_filter = {id(t) for t in inputs}
     try:
-        backward(outputs, grad_outputs, retain_graph=retain_graph)
+        backward(outputs, grad_outputs, retain_graph=retain_graph,
+                 create_graph=create_graph,
+                 _leaf_set={id(t) for t in inputs})
         results = []
         for t in inputs:
             if t.grad is None:
@@ -223,7 +346,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
             else:
                 results.append(t.grad)
     finally:
-        _leaf_filter = None
         for t, old in zip(inputs, stash):
             t.grad = old
     return results

@@ -473,7 +473,8 @@ def _apply_impl(op_name: str, fn: Callable, *tensor_inputs: Tensor,
     out_tensors = []
     if needs_grad:
         node = GradNode(op_name, vjp_fn, tensor_inputs, len(out_arrays),
-                        tuple((oa.shape, oa.dtype) for oa in out_arrays))
+                        tuple((oa.shape, oa.dtype) for oa in out_arrays),
+                        pure_fn=f, multi_out=multi)
         for i, oa in enumerate(out_arrays):
             t = Tensor(oa, stop_gradient=False)
             t._grad_node = node

@@ -6,11 +6,25 @@ pickle-over-TCP listener thread and workers discover each other through the
 rendezvous store (the same seam the collective stack bootstraps with).
 Device tensors serialize through host numpy (PJRT buffers cannot cross
 process boundaries).
+
+Trust model: RPC payloads are pickles, i.e. arbitrary code at the receiver —
+acceptable only between the job's own trainers (upstream brpc makes the same
+assumption inside the trainer transport). Mitigations, not guarantees: the
+listener binds to the job's interface (loopback for single-host runs), and
+every message carries an HMAC keyed by a per-job secret, so stray/broken
+peers and port-scanners can't trigger deserialization. LIMIT: by default
+the secret is distributed through the rendezvous TCPStore, so anyone who
+can reach the store port can fetch it — on untrusted networks set
+``PADDLE_RPC_SECRET`` (same value on every worker) to move the secret
+out-of-band, and keep the store/RPC ports firewalled to the job.
 """
 
 from __future__ import annotations
 
+import hmac as _hmac
+import hashlib
 import pickle
+import secrets as _secrets
 import socket
 import socketserver
 import struct
@@ -60,7 +74,18 @@ class FutureWrapper:
         return self._fut.done()
 
 
+_MAC_LEN = 32  # sha256 digest
+
+
+def _mac(payload: bytes) -> bytes:
+    secret = _state.get("secret")
+    if not secret:
+        raise RuntimeError("rpc not initialized (no job secret)")
+    return _hmac.new(secret, payload, hashlib.sha256).digest()
+
+
 def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    payload = _mac(payload) + payload
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
@@ -72,13 +97,18 @@ def _recv_msg(sock: socket.socket) -> bytes:
             raise ConnectionError("rpc peer closed")
         header += chunk
     (n,) = struct.unpack("<Q", header)
+    if n < _MAC_LEN:
+        raise ConnectionError("rpc message too short to be authenticated")
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise ConnectionError("rpc peer closed mid-message")
         buf.extend(chunk)
-    return bytes(buf)
+    mac, payload = bytes(buf[:_MAC_LEN]), bytes(buf[_MAC_LEN:])
+    if not _hmac.compare_digest(mac, _mac(payload)):
+        raise ConnectionError("rpc message failed authentication")
+    return payload
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -109,16 +139,36 @@ def init_rpc(name: str, rank: Optional[int] = None,
     rank = get_rank() if rank is None else int(rank)
     world_size = get_world_size() if world_size is None else int(world_size)
     host = _routable_host()
-    server = _Server(("0.0.0.0", 0), _Handler)
-    port = server.server_address[1]
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
 
     if master_endpoint is None:
         master_endpoint = "127.0.0.1:29530"
     mhost, _, mport = master_endpoint.partition(":")
     store = TCPStore(mhost, int(mport), is_master=(rank == 0),
                      world_size=world_size)
+    # per-job shared secret: PADDLE_RPC_SECRET (out-of-band) wins; else
+    # rank 0 mints one and distributes via the store. Fetched BEFORE the
+    # listener publishes its endpoint so every request it serves is
+    # authenticated.
+    import os
+    env_secret = os.environ.get("PADDLE_RPC_SECRET")
+    if env_secret:
+        _state["secret"] = env_secret.encode()
+    else:
+        if rank == 0:
+            store.set("rpc/secret", _secrets.token_bytes(32))
+        _state["secret"] = bytes(store.get("rpc/secret"))
+
+    # bind to the interface peers will actually dial; PADDLE_RPC_HOST may
+    # be an external NAT address that is not locally bindable — fall back
+    # to all interfaces in that case (the HMAC still gates every message)
+    try:
+        server = _Server((host, 0), _Handler)
+    except OSError:
+        server = _Server(("0.0.0.0", 0), _Handler)
+    port = server.server_address[1]
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+
     store.set(f"rpc/{rank}", f"{name},{host},{port}".encode())
     infos = {}
     for r in range(world_size):

@@ -1,0 +1,108 @@
+"""Safe on-disk container for inference artifacts (.pdmodel).
+
+Upstream's ``.pdmodel`` is a protobuf ProgramDesc; ours carries serialized
+StableHLO. A pickle container would execute arbitrary code at load time and
+silently masquerade as reference-compatible, so the format is explicit and
+inert: magic line, little-endian u64 header length, JSON header, then raw
+blob bytes back-to-back. Numpy arrays ride as ``.npy`` blobs and are loaded
+with ``allow_pickle=False``.
+
+Layout::
+
+    PDTPU-ART\\n | u64 header_len | header JSON | blob 0 | blob 1 | ...
+
+The header's ``blobs`` entry is ``[[name, nbytes], ...]`` in file order;
+``arrays`` lists which blob names are npy-encoded.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+MAGIC = b"PDTPU-ART\n"
+
+__all__ = ["MAGIC", "write_artifact", "read_artifact",
+           "read_model_payload"]
+
+
+def write_artifact(path: str, header: Dict[str, Any],
+                   blobs: Dict[str, bytes] | None = None,
+                   arrays: Dict[str, np.ndarray] | None = None) -> None:
+    """Write ``header`` (JSON-serializable) plus named binary/array blobs."""
+    blobs = dict(blobs or {})
+    array_dtypes: Dict[str, str] = {}
+    for name, arr in (arrays or {}).items():
+        arr = np.ascontiguousarray(arr)
+        # np.lib.format writes extension dtypes (ml_dtypes bfloat16/fp8) as
+        # raw void ('|V2'); record the true dtype so read can view it back
+        array_dtypes[name] = str(arr.dtype)
+        buf = io.BytesIO()
+        np.lib.format.write_array(buf, arr, allow_pickle=False)
+        blobs[name] = buf.getvalue()
+    hdr = dict(header)
+    hdr["blobs"] = [[name, len(b)] for name, b in blobs.items()]
+    hdr["arrays"] = array_dtypes
+    hdr_bytes = json.dumps(hdr).encode()
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(len(hdr_bytes).to_bytes(8, "little"))
+        f.write(hdr_bytes)
+        for b in blobs.values():
+            f.write(b)
+
+
+def read_artifact(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Returns (header, blobs); npy-encoded blobs come back as ndarrays."""
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(
+                f"{path} is not a paddle_tpu artifact (bad magic). Reference "
+                "protobuf .pdmodel files and pre-v2 pickle artifacts cannot "
+                "be loaded; re-export with this framework's save APIs.")
+        hdr_len = int.from_bytes(f.read(8), "little")
+        header = json.loads(f.read(hdr_len).decode())
+        blobs: Dict[str, Any] = {}
+        arrays_meta = header.get("arrays", [])
+        if isinstance(arrays_meta, list):  # legacy: names only, no dtypes
+            arrays_meta = {n: None for n in arrays_meta}
+        for name, nbytes in header.get("blobs", []):
+            raw = f.read(nbytes)
+            if len(raw) != nbytes:
+                raise ValueError(f"{path}: truncated blob {name!r}")
+            if name in arrays_meta:
+                arr = np.lib.format.read_array(io.BytesIO(raw),
+                                               allow_pickle=False)
+                want = arrays_meta[name]
+                if want and str(arr.dtype) != want:
+                    arr = arr.view(_lookup_dtype(want))
+                blobs[name] = arr
+            else:
+                blobs[name] = raw
+    return header, blobs
+
+
+def _lookup_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 / float8 extension dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def read_model_payload(path: str) -> Dict[str, Any]:
+    """Load a .pdmodel artifact into the flat payload dict the model loaders
+    (jit.load, inference.Predictor, static.load_inference_model) consume:
+    header fields plus ``stablehlo`` bytes and, for jit artifacts, ``state``
+    (the ordered param arrays)."""
+    header, blobs = read_artifact(path)
+    payload = dict(header)
+    payload["stablehlo"] = blobs.get("stablehlo")
+    if "state_names" in header:
+        payload["state"] = [blobs[f"state/{i}"]
+                            for i in range(len(header["state_names"]))]
+    return payload

@@ -113,17 +113,17 @@ class Predictor:
         path = prefix + ".pdmodel"
         if not os.path.exists(path):
             raise FileNotFoundError(path)
-        with open(path, "rb") as f:
-            payload = pickle.load(f)
+        from ..framework.artifact import read_model_payload
+        payload = read_model_payload(path)
         fmt = payload.get("format", "")
         from jax import export as jax_export
 
-        if fmt == "paddle_tpu.static_inference.v1":
+        if fmt == "paddle_tpu.static_inference.v2":
             self._exported = jax_export.deserialize(payload["stablehlo"])
             self._input_names = list(payload["feed_names"])
             self._output_names = list(payload["fetch_names"])
             self._params = None
-        elif fmt == "paddle_tpu.jit.v1":
+        elif fmt == "paddle_tpu.jit.v2":
             if not payload.get("stablehlo"):
                 raise RuntimeError(
                     "artifact was saved without input_spec; re-save with "

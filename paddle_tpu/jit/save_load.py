@@ -90,18 +90,18 @@ def save(layer, path: str, input_spec: Optional[List[Any]] = None, **configs) ->
         except Exception:
             pass
 
-    payload = {
-        "format": "paddle_tpu.jit.v1",
+    from ..framework.artifact import write_artifact
+    write_artifact(path + ".pdmodel", {
+        "format": "paddle_tpu.jit.v2",
         "state_names": names,
-        "state": [np.asarray(a) for a in param_arrays],
-        "stablehlo": exported_bytes,
         "class_name": type(layer).__name__,
         "input_names": input_names,
         "input_specs": input_specs,
         "output_names": output_names,
-    }
-    with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(payload, f, protocol=4)
+    }, blobs=({"stablehlo": exported_bytes}
+              if exported_bytes is not None else {}),
+        arrays={f"state/{i}": np.asarray(a)
+                for i, a in enumerate(param_arrays)})
     # params also in paddle.save format for cross-loading
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(_pack(dict(state)), f, protocol=4)
@@ -141,6 +141,5 @@ class TranslatedLayer:
 
 
 def load(path: str, **configs) -> TranslatedLayer:
-    with open(path + ".pdmodel", "rb") as f:
-        payload = pickle.load(f)
-    return TranslatedLayer(payload)
+    from ..framework.artifact import read_model_payload
+    return TranslatedLayer(read_model_payload(path + ".pdmodel"))

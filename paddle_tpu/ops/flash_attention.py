@@ -237,7 +237,11 @@ def _fit_block(length: int, want: int, floor: int = 128):
     aligned trailing block dims."""
     length, want = int(length), int(want)
     if length <= want:
-        return length
+        # full-length single tile: still require sublane alignment (Mosaic
+        # pads the lane dim but an unaligned second-minor dim, e.g. 300,
+        # cannot be validated by the CPU interpret-mode tests) — unaligned
+        # short lengths take the XLA fallback instead
+        return length if length % 8 == 0 else None
     b0 = min(want, length)
     for b in range(b0 - b0 % floor, floor - 1, -floor):
         if length % b == 0:

@@ -1007,8 +1007,12 @@ class ASGD(Optimizer):
         else:
             new_d = g32
         d._set_data(new_d)
+        # reference formula divides by n = min(t, batch_num): until the
+        # window fills, average over the gradients actually seen
+        n = jnp.minimum(self._step_t._data.astype(jnp.float32),
+                        jnp.float32(self._batch_num))
         p._set_data((p._data.astype(jnp.float32) -
-                     lr_eff * new_d / self._batch_num).astype(p._data.dtype))
+                     lr_eff * new_d / n).astype(p._data.dtype))
 
 
 class NAdam(Optimizer):

@@ -315,18 +315,19 @@ def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
         exp = jax_export.export(jax.jit(fwd))(*_specs(False))
 
     os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
-    payload = {
-        "format": "paddle_tpu.static_inference.v1",
-        "stablehlo": exp.serialize(),
+    # safe container (magic + JSON + raw StableHLO) — NOT pickle: a pickle
+    # would execute arbitrary code at load and silently masquerade as the
+    # reference's protobuf ProgramDesc format (framework/artifact.py).
+    from ..framework.artifact import write_artifact
+    write_artifact(path_prefix + ".pdmodel", {
+        "format": "paddle_tpu.static_inference.v2",
         "feed_names": [getattr(v, "name", f"feed_{i}")
                        for i, v in enumerate(feed_vars)],
         "fetch_names": [getattr(v, "name", f"fetch_{i}")
                         for i, v in enumerate(fetch_vars)],
-        "feed_specs": [(tuple(v._data.shape), str(v._data.dtype))
+        "feed_specs": [(list(v._data.shape), str(v._data.dtype))
                        for v in feed_vars],
-    }
-    with open(path_prefix + ".pdmodel", "wb") as f:
-        pickle.dump(payload, f, protocol=4)
+    }, blobs={"stablehlo": exp.serialize()})
 
 
 class _LoadedProgram:
@@ -351,9 +352,8 @@ class _LoadedProgram:
 def load_inference_model(path_prefix: str, executor=None):
     """Returns [program, feed_target_names, fetch_targets] (reference
     contract); ``program`` is runnable via Executor.run(program, feed=...)."""
-    import pickle
-    with open(path_prefix + ".pdmodel", "rb") as f:
-        payload = pickle.load(f)
+    from ..framework.artifact import read_model_payload
+    payload = read_model_payload(path_prefix + ".pdmodel")
     prog = _LoadedProgram(payload)
     return [prog, prog.feed_names, prog.fetch_names]
 

@@ -64,11 +64,36 @@ class Normalize(BaseTransform):
         return (a - self.mean.reshape(shape)) / self.std.reshape(shape)
 
 
-def _resize_np(a: np.ndarray, size) -> np.ndarray:
+_RESIZE_METHODS = {
+    "nearest": "nearest",
+    "bilinear": "linear",
+    "linear": "linear",
+    "bicubic": "cubic",
+    "cubic": "cubic",
+    "lanczos": "lanczos3",
+}
+
+
+def _resize_np(a: np.ndarray, size, interpolation="bilinear") -> np.ndarray:
     import jax
     import jax.numpy as jnp
-    h, w = size if isinstance(size, (tuple, list)) else (size, size)
+    try:
+        method = _RESIZE_METHODS[interpolation]
+    except KeyError:
+        raise ValueError(
+            f"unsupported interpolation {interpolation!r}; "
+            f"one of {sorted(_RESIZE_METHODS)}")
     chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    H, W = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+    if isinstance(size, (tuple, list)):
+        h, w = size
+    else:
+        # int size: scale the SHORTER edge to `size`, keep aspect ratio
+        # (reference semantics — torchvision/paddle.vision Resize)
+        if H <= W:
+            h, w = int(size), max(int(round(size * W / H)), 1)
+        else:
+            h, w = max(int(round(size * H / W)), 1), int(size)
     if chw:
         out_shape = (a.shape[0], h, w)
     elif a.ndim == 3:
@@ -76,15 +101,20 @@ def _resize_np(a: np.ndarray, size) -> np.ndarray:
     else:
         out_shape = (h, w)
     return np.asarray(jax.image.resize(jnp.asarray(a, jnp.float32), out_shape,
-                                       method="linear")).astype(a.dtype)
+                                       method=method)).astype(a.dtype)
 
 
 class Resize(BaseTransform):
+    """Resize to ``size``. An int resizes the shorter edge preserving aspect
+    ratio (upstream paddle.vision.transforms.Resize semantics); a (h, w)
+    pair resizes to exactly that shape."""
+
     def __init__(self, size, interpolation="bilinear"):
         self.size = size
+        self.interpolation = interpolation
 
     def __call__(self, img):
-        return _resize_np(np.asarray(img), self.size)
+        return _resize_np(np.asarray(img), self.size, self.interpolation)
 
 
 class CenterCrop(BaseTransform):

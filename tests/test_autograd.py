@@ -126,3 +126,99 @@ def test_getitem_grad_flow():
     expected = np.zeros((3, 3), np.float32)
     expected[0, 1] = expected[1, 1] = 1
     np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+class TestHigherOrderGrad:
+    """create_graph=True: backward recorded on the tape (upstream double-grad
+    nodes in paddle/fluid/eager/)."""
+
+    def test_double_grad_cubic(self):
+        x = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+        (g,) = paddle.grad((x * x * x).sum(), x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-6)
+        (g2,) = paddle.grad(g.sum(), x)
+        np.testing.assert_allclose(g2.numpy(), [12.0], rtol=1e-6)
+
+    def test_triple_grad_tanh(self):
+        x = paddle.to_tensor(np.array([0.5], "float32"), stop_gradient=False)
+        (g,) = paddle.grad(paddle.tanh(x).sum(), x, create_graph=True)
+        (g2,) = paddle.grad(g.sum(), x, create_graph=True)
+        (g3,) = paddle.grad(g2.sum(), x)
+        t = np.tanh(0.5)
+        np.testing.assert_allclose(g.numpy(), [1 - t * t], rtol=1e-5)
+        np.testing.assert_allclose(g2.numpy(), [-2 * t * (1 - t * t)],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(g3.numpy(), [(6 * t * t - 2) * (1 - t * t)],
+                                   rtol=1e-4)
+
+    def test_double_grad_matmul_chain(self):
+        a = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.ones((3, 2), "float32"), stop_gradient=False)
+        y = paddle.matmul(a, b)
+        loss = (y * y).sum()
+        (ga,) = paddle.grad(loss, a, create_graph=True)
+        # ga = 2 (a b) b^T with b = ones(3,2):
+        # ga.sum() = 12 * sum(a)  =>  d(ga.sum())/da = 12 everywhere
+        (gga,) = paddle.grad(ga.sum(), a)
+        np.testing.assert_allclose(gga.numpy(), np.full((2, 3), 12.0),
+                                   rtol=1e-5)
+
+    def test_wgan_gp_penalty_backward(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.random((4, 3), dtype=np.float32),
+                             stop_gradient=False)
+        w = paddle.to_tensor(rng.random((3, 3), dtype=np.float32),
+                             stop_gradient=False)
+        y = paddle.matmul(x, w).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        penalty = ((gx * gx).sum() - 1.0) ** 2
+        penalty.backward()
+        assert w.grad is not None
+        # d(penalty)/dw: gx = 1 @ w^T rows -> analytic via numpy
+        gx_np = np.tile(w.numpy().sum(axis=1), (4, 1))
+        coef = 2.0 * ((gx_np ** 2).sum() - 1.0)
+        grad_w = np.zeros((3, 3), np.float32)
+        for i in range(3):  # d gx[:,i] / d w[i,j] = 1 for all j
+            grad_w[i, :] = coef * 2.0 * gx_np[:, i].sum() / 4 * 1.0
+        # direction check only (scale folded): compare against autodiff of
+        # numpy-equivalent computation via finite differences
+        eps = 1e-3
+        w_np = w.numpy().copy()
+        def pen(wv):
+            gxv = np.tile(wv.sum(axis=1), (4, 1))
+            return ((gxv * gxv).sum() - 1.0) ** 2
+        fd = np.zeros_like(w_np)
+        for i in range(3):
+            for j in range(3):
+                wp = w_np.copy(); wp[i, j] += eps
+                wm = w_np.copy(); wm[i, j] -= eps
+                fd[i, j] = (pen(wp) - pen(wm)) / (2 * eps)
+        np.testing.assert_allclose(w.grad.numpy(), fd, rtol=2e-2, atol=1e-2)
+
+    def test_grad_reentrant_from_hook(self):
+        """paddle.grad called from inside a backward hook must not corrupt
+        the outer leaf filtering (round-1: module-global _leaf_filter)."""
+        x = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+        side = {}
+
+        def hook(g):
+            a = paddle.to_tensor(np.array([2.0], "float32"),
+                                 stop_gradient=False)
+            (ga,) = paddle.grad((a * a).sum(), a)
+            side["inner"] = ga.numpy()
+            return g
+
+        y = x * x
+        y.register_hook(hook)
+        loss = y.sum()
+        loss.backward()
+        np.testing.assert_allclose(side["inner"], [4.0], rtol=1e-6)
+        assert x.grad is not None  # outer accumulation unaffected
+        np.testing.assert_allclose(x.grad.numpy(), [6.0], rtol=1e-6)
+
+    def test_create_graph_leaf_grad_is_connected(self):
+        x = paddle.to_tensor(np.array([1.5], "float32"), stop_gradient=False)
+        (g,) = paddle.grad((x ** 4).sum(), x, create_graph=True)
+        assert not g.stop_gradient
+        assert g._grad_node is not None

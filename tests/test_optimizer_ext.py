@@ -73,8 +73,9 @@ class TestNewOptimizers:
             p.clear_grad()
             (p * gval).sum().backward()
             opt.step()
-        # steps: -4/2... window holds [4], then [4,2], then [2,6]
-        expected = -(4.0 / 2) - (6.0 / 2) - (8.0 / 2)
+        # reference divides by n = min(t, batch_num): first step averages
+        # over the 1 gradient seen, later steps over the full window
+        expected = -(4.0 / 1) - (6.0 / 2) - (8.0 / 2)
         np.testing.assert_allclose(np.asarray(p.numpy()), [expected],
                                    rtol=1e-5)
 

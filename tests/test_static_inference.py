@@ -215,3 +215,24 @@ def test_accuracy_duplicate_topk_slots():
     m.update(m.compute(pred, label))
     res = m.accumulate()
     assert res[1] == res[2]  # duplicate k slots must agree
+
+
+def test_jit_save_load_bfloat16_params():
+    """Artifact container must preserve ml_dtypes (bfloat16) param dtypes —
+    np.lib.format alone writes them as raw void ('|V2')."""
+    import tempfile, os.path as osp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.static import InputSpec
+
+    m = paddle.nn.Linear(4, 2)
+    m.bfloat16()
+    d = tempfile.mkdtemp()
+    paddle.jit.save(m, osp.join(d, "m"),
+                    input_spec=[InputSpec([1, 4], "bfloat16")])
+    m2 = paddle.jit.load(osp.join(d, "m"))
+    for n, p in m2.state_dict().items():
+        assert str(p.dtype) == "bfloat16", (n, p.dtype)
+    out = m2(paddle.to_tensor(
+        np.ones((1, 4), np.float32)).astype("bfloat16"))
+    assert str(out.dtype) == "bfloat16"

@@ -196,3 +196,34 @@ class TestMisc:
 
     def test_version(self):
         assert paddle.version.full_version == paddle.__version__
+
+
+class TestResizeSemantics:
+    def test_int_size_resizes_shorter_edge(self):
+        # reference semantics: Resize(256) on a 480x640 image -> 256x341
+        from paddle_tpu.vision import transforms as T
+        img = np.random.rand(480, 640, 3).astype("float32")
+        out = T.Resize(256)(img)
+        assert out.shape == (256, 341, 3), out.shape
+        tall = np.random.rand(640, 480, 3).astype("float32")
+        out = T.Resize(256)(tall)
+        assert out.shape == (341, 256, 3), out.shape
+
+    def test_pair_size_exact(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.rand(100, 50).astype("float32")
+        assert T.Resize((30, 40))(img).shape == (30, 40)
+
+    def test_resize_crop_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.rand(480, 640, 3).astype("float32")
+        out = T.Compose([T.Resize(256), T.CenterCrop(224)])(img)
+        assert out.shape == (224, 224, 3), out.shape
+
+    def test_interpolation_modes(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.rand(64, 64).astype("float32")
+        for mode in ("nearest", "bilinear", "bicubic"):
+            assert T.Resize((32, 32), interpolation=mode)(img).shape == (32, 32)
+        with pytest.raises(ValueError):
+            T.Resize((32, 32), interpolation="area")(img)
