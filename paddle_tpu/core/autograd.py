@@ -271,7 +271,10 @@ def _backward_create_graph(tensors, grad_tensors, retain_graph: bool,
             for hook in t._hooks.values():
                 out = hook(g)
                 if out is not None:
-                    g = out
+                    # hooks may return raw arrays (normal backward accepts
+                    # them); normalize back to a tape Tensor
+                    g = out if isinstance(out, Tensor) else \
+                        Tensor(jnp.asarray(out), stop_gradient=True)
             if sub is None:
                 _accumulate_leaf_tensor(t, g, leaf_set)
             else:

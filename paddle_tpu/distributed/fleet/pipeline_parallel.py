@@ -114,6 +114,11 @@ class PipelineLayer(Layer):
         return self._num_stages - 1
 
     def forward(self, x):
+        if getattr(self, "_engine", None) is not None:
+            raise RuntimeError(
+                "this PipelineLayer was consumed by the pipelined engine "
+                "(its per-stage copies were stacked and released); call "
+                "through the fleet.distributed_model wrapper instead")
         for layer, ffunc in self._entries:
             if ffunc is not None:
                 x = ffunc(layer, x)
@@ -138,10 +143,17 @@ class PipelineLayer(Layer):
 class PipelineParallel(Layer):
     """Microbatch training driver (parity: meta_parallel PipelineParallel).
 
-    ``train_batch`` splits the batch into ``accumulate_steps`` microbatches
-    and accumulates gradients — the numerics of 1F1B. The compiled schedule
-    (overlap across stages) is delegated to XLA via to_static around the
-    whole train_batch, or to fleet.tpu_pipeline for uniform stacks.
+    Two execution paths:
+
+    * **pipelined** (default when the PipelineLayer has a uniform block run
+      and the hybrid mesh has a ``pp`` axis): per-stage block weights are
+      stacked and sharded over ``pp`` and the whole microbatch schedule runs
+      as one shard_map/ppermute program — real stage placement, activations
+      hop stages on ICI (``fleet.tpu_pipeline.PipelinedStack``).
+    * **grad-accumulation fallback** (non-uniform stacks): microbatch loop
+      accumulating gradients. NOTE: this fallback does NOT place stages on
+      devices — it reproduces only the accumulated-gradient numerics that a
+      1F1B schedule would also produce, with no pipelining.
     """
 
     def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
@@ -152,8 +164,21 @@ class PipelineParallel(Layer):
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = cfg.get("micro_batch_size", None)
         self._loss_fn = layers._loss_fn
+        self._engine = None
+        pp = self._hcg.get_pipe_parallel_world_size() if self._hcg else 1
+        if pp > 1 and "pp" in getattr(self._hcg.mesh, "axis_names", ()):
+            from .tpu_pipeline import NonUniformStackError, PipelinedStack
+            try:
+                self._engine = PipelinedStack(
+                    layers, self._hcg.mesh, axis="pp",
+                    micro_batches=self.accumulate_steps,
+                    remat=bool(cfg.get("remat", True)))
+            except NonUniformStackError:
+                self._engine = None  # non-uniform stack: fallback path
 
     def forward(self, *args, **kwargs):
+        if self._engine is not None:
+            return self._engine(*args, **kwargs)
         return self._layers(*args, **kwargs)
 
     def _split_micro(self, data):
@@ -168,6 +193,9 @@ class PipelineParallel(Layer):
 
     def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
         self._layers.train()
+        if self._engine is not None:
+            return self._train_batch_pipelined(data, optimizer, lr_scheduler,
+                                               scaler)
         micros = self._split_micro(data)
         n = len(micros)
         total = None
@@ -195,8 +223,49 @@ class PipelineParallel(Layer):
             lr_scheduler.step()
         return total * (1.0 / n)
 
+    def _train_batch_pipelined(self, data, optimizer=None, lr_scheduler=None,
+                               scaler=None):
+        if isinstance(data, (tuple, list)):
+            x, label = data[0], data[1]
+        else:
+            x, label = data, None
+        self._engine._M = self.accumulate_steps
+        if self.micro_batch_size is not None:
+            self._engine._M = max(
+                int(x.shape[0]) // int(self.micro_batch_size), 1)
+            self.accumulate_steps = self._engine._M
+        out = self._engine(x)
+        loss = self._loss_fn(out, label) if self._loss_fn is not None else out
+        if scaler is not None:
+            scaler.scale(loss).backward()
+        else:
+            loss.backward()
+        if optimizer is not None:
+            if scaler is not None:
+                scaler.step(optimizer)
+                scaler.update()
+            else:
+                optimizer.step()
+            optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
     def eval_batch(self, data, compute_loss: bool = True):
         self._layers.eval()
+        if self._engine is not None:
+            from ...core.tracing import no_grad
+            with no_grad():
+                if isinstance(data, (tuple, list)):
+                    x, label = data[0], data[1]
+                else:
+                    x, label = data, None
+                # eval has no microbatching requirement; a single microbatch
+                # always divides the batch
+                out = self._engine(x, micro_batches=1)
+                if compute_loss and self._loss_fn is not None:
+                    return self._loss_fn(out, label)
+                return out
         micros = self._split_micro(data)
         outs = []
         from ...core.tracing import no_grad
@@ -218,10 +287,16 @@ class PipelineParallel(Layer):
         return outs
 
     def parameters(self, include_sublayers=True):
+        if self._engine is not None:
+            return self._engine.parameters()
         return self._layers.parameters(include_sublayers)
 
     def state_dict(self, *a, **k):
+        if self._engine is not None:
+            return self._engine.state_dict()
         return self._layers.state_dict(*a, **k)
 
     def set_state_dict(self, sd, *a, **k):
+        if self._engine is not None:
+            return self._engine.set_state_dict(sd)
         return self._layers.set_state_dict(sd, *a, **k)

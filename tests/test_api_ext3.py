@@ -133,6 +133,7 @@ class TestWave3Losses:
             paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n)))
         assert abs(ref - ours) < 1e-4
 
+    @pytest.mark.slow
     def test_hsigmoid_trains(self):
         paddle.seed(0)
         layer = nn.HSigmoidLoss(8, 10)

@@ -138,6 +138,7 @@ class TestFlops:
         # (8+1)*16*2 + (16+1)*4*2
         assert total == 2 * (9 * 16) + 2 * (17 * 4)
 
+    @pytest.mark.slow
     def test_conv_model_flops_positive(self, capsys):
         net = paddle.vision.models.LeNet()
         total = paddle.flops(net, [1, 1, 28, 28])

@@ -7,6 +7,8 @@ loss-decreases training smoke, and jit-ability of the train step.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.ops import vision as V

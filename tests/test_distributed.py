@@ -195,6 +195,7 @@ def test_dp_training_loss_parity():
                                m2[0].weight.numpy(), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_sharding_stage_parity():
     """ZeRO stages keep the same numerics as the plain optimizer."""
     from paddle_tpu.distributed import fleet
@@ -255,6 +256,7 @@ def test_distributed_checkpoint_roundtrip(tmp_path):
                                np.asarray(sd["weight"]._data))
 
 
+@pytest.mark.slow
 def test_sharded_embedding_deepfm_step():
     from paddle_tpu.distributed import fleet
     from paddle_tpu.models.deepfm import DeepFM, DeepFMConfig
@@ -280,6 +282,7 @@ def test_sharded_embedding_deepfm_step():
     assert float(loss) < first
 
 
+@pytest.mark.slow
 def test_pipeline_layer_microbatch_parity():
     from paddle_tpu.distributed import fleet
     from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer

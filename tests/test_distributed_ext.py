@@ -186,6 +186,7 @@ class TestLocalFS:
 
 
 class TestRPC:
+    @pytest.mark.slow
     def test_two_process_rpc(self, tmp_path):
         script = textwrap.dedent("""
             import os, sys, time
@@ -278,6 +279,7 @@ class TestReviewFixes5:
         assert w.wait() == 11 and w.done()
         assert not hasattr(Future, "wait")
 
+    @pytest.mark.slow
     def test_yolo_loss_gt_score_scales_objectness(self):
         from paddle_tpu.vision import ops as vops
         cn, na = 2, 1

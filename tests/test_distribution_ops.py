@@ -133,7 +133,9 @@ class TestNewOps:
         assert float(paddle.nanmedian(x)) == 3.0
 
 
+@pytest.mark.slow
 class TestGenerate:
+    @pytest.mark.slow
     def test_cached_decode_matches_full_context(self):
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
         import jax.numpy as jnp

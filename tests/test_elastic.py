@@ -14,6 +14,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
                                                   ElasticStatus,
                                                   start_worker_heartbeat)

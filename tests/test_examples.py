@@ -21,6 +21,7 @@ CASES = [
 
 
 @pytest.mark.parametrize("script,args", CASES, ids=[c[0] for c in CASES])
+@pytest.mark.slow
 def test_example_runs(script, args):
     env = dict(os.environ)
     # plain JAX_PLATFORMS env is latched away by TPU-plugin sitecustomize

@@ -47,6 +47,7 @@ class TestRoleMaker:
         assert rm.is_first_worker()
 
 
+@pytest.mark.slow
 def test_ps_server_worker_lifecycle(tmp_path):
     """Worker in-process, server in a subprocess: init → train DeepFM with
     the sharded embedding → stop_worker shuts the server down cleanly."""
@@ -112,6 +113,7 @@ def test_ps_server_worker_lifecycle(tmp_path):
         fleet._server_store = None
 
 
+@pytest.mark.slow
 def test_launch_ps_mode(tmp_path):
     """launch --run_mode ps spawns servers + trainers; both sides exit 0."""
     script = tmp_path / "ps_train.py"

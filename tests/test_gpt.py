@@ -40,6 +40,7 @@ class TestGPT:
         losses = [float(step(data)) for _ in range(12)]
         assert losses[-1] < losses[0] * 0.8, losses
 
+    @pytest.mark.slow
     def test_cached_generate_matches_uncached(self):
         model = _tiny()
         model.eval()

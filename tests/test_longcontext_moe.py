@@ -68,6 +68,7 @@ def test_ring_attention_matches_serial(causal):
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad_flows():
     from paddle_tpu.distributed.fleet.context_parallel import ring_flash_attention
     _sep_mesh(8)
@@ -95,6 +96,7 @@ def test_ulysses_matches_serial():
     np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_forward_and_grad():
     paddle.seed(4)
     from paddle_tpu.incubate.moe import MoELayer
@@ -115,6 +117,7 @@ def test_moe_forward_and_grad():
     assert float(paddle.abs(out).sum()) > 0
 
 
+@pytest.mark.slow
 def test_moe_switch_gate():
     paddle.seed(5)
     from paddle_tpu.incubate.moe import MoELayer
@@ -125,6 +128,7 @@ def test_moe_switch_gate():
     assert out.shape == [4, 4, d]
 
 
+@pytest.mark.slow
 class TestFlashBackwardKernel:
     """The dedicated Pallas dq/dkv backward (recompute-from-lse) must match
     the XLA attention vjp exactly (reference invariant: flash_attn_grad
@@ -167,6 +171,7 @@ class TestFlashTileFitting:
         assert _pallas_tileable(768, 768, 64, 512, 512)
         assert not _pallas_tileable(1000, 1000, 64, 512, 512)
 
+    @pytest.mark.slow
     def test_mid_range_length_matches_xla(self):
         import numpy as np
         import paddle_tpu as paddle

@@ -89,6 +89,7 @@ def test_tcp_store_wait_blocks_until_set():
         master.close()
 
 
+@pytest.mark.slow
 def test_tcp_store_cross_process():
     """A subprocess client rendezvouses through the in-process server."""
     master = TCPStore(is_master=True, world_size=2)

@@ -115,6 +115,7 @@ def test_mha_forward():
     assert y.shape == [2, 5, 16]
 
 
+@pytest.mark.slow
 def test_transformer_encoder():
     layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
     enc = nn.TransformerEncoder(layer, 2)

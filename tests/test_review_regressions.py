@@ -102,6 +102,7 @@ def test_spectral_norm_buffers_advance():
 
 # -- round-1 session-2 review findings ---------------------------------------
 
+@pytest.mark.slow
 def test_flash_causal_alignment_lq_ne_lk():
     """Pallas, XLA, and chunked-backward paths must agree on bottom-right
     causal alignment for lq != lk (KV-cache decode / cross-window)."""

@@ -139,6 +139,7 @@ def test_custom_cell_runs_through_forward():
     assert y.shape == [B, T, H]
 
 
+@pytest.mark.slow
 def test_lstm_trains():
     paddle.seed(0)
     B, T, I, H = 4, 8, 6, 10

@@ -5,6 +5,8 @@ ResNeXt/Inception model variants."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 import paddle_tpu.vision as vision
 from paddle_tpu.vision import ops as vops
