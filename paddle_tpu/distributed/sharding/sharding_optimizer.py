@@ -90,6 +90,20 @@ class DygraphShardingOptimizer:
                         p.grad._set_data(jax.lax.with_sharding_constraint(
                             p.grad._data, NamedSharding(self._mesh, spec)))
         self._inner.step()
+        # re-assert accumulator layout INSIDE the traced step: without this
+        # the compiled program is free to write fresh accumulator values
+        # back fully replicated, silently undoing ZeRO (the §7 hard-part-3
+        # failure mode — pinned by tests/test_zero_sharding_proof.py)
+        from ...core.tensor import _is_tracer
+        for slots in self._inner._accumulators.values():
+            for acc in slots.values():
+                arr = acc._data
+                if not _is_tracer(arr):
+                    continue  # eager: birth-sharding already holds
+                spec = _shard_spec_for(arr, self._mesh, self._axis)
+                if spec is not None:
+                    acc._set_data(jax.lax.with_sharding_constraint(
+                        arr, NamedSharding(self._mesh, spec)))
         # re-assert the parameter layout after the update
         for p in self._params():
             if self.stage >= 3:

@@ -113,6 +113,9 @@ def set_flags(flags: Dict[str, Any]) -> None:
 
 # --- core flags used across the framework -----------------------------------
 define_flag("eager_op_jit", True, "jit-compile each eager op (per-op kernel cache)")
+define_flag("to_static_capture_lowered", False,
+            "capture arg specs on each compiled call so "
+            "StaticFunction.compiled_text() can report the XLA HLO (debug)")
 define_flag("check_nan_inf", False, "check every op output for nan/inf (debug)")
 define_flag("amp_dtype", "bfloat16", "default autocast dtype on TPU")
 define_flag("allocator_strategy", "auto_growth", "accepted for parity; XLA/PJRT manages memory")

@@ -11,6 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor, _state_registry, _is_tracer
+from .. import flags as _flags
 from ..core.tracing import (TraceState, pop_trace_state, push_trace_state,
                             trace_state)
 
@@ -79,10 +80,24 @@ class StaticFunction:
         self._iters = int(iters_per_call)
         self._cache: Dict[Any, Tuple] = {}
         self.concrete_program = None  # parity attribute
+        self._last_lowered = None  # (jitted, arg shape/sharding specs)
 
     @property
     def program_cache(self):
         return self._cache
+
+    def compiled_text(self) -> str:
+        """XLA-compiled HLO of the most recent call (requires the
+        FLAGS_to_static_capture_lowered debug flag). Test/debug surface for
+        asserting on the compiled program, e.g. that ZeRO sharding lowered
+        to reduce-scatter rather than a full all-reduce."""
+        if self._last_lowered is None:
+            raise RuntimeError(
+                "no lowered call captured; set "
+                "paddle.set_flags({'FLAGS_to_static_capture_lowered': True}) "
+                "and invoke the function first")
+        jitted, state_specs, arg_specs = self._last_lowered
+        return jitted.lower(state_specs, arg_specs).compile().as_text()
 
     def __get__(self, instance, owner):
         if instance is None:
@@ -154,6 +169,22 @@ class StaticFunction:
             return self.__call__(*args, **kwargs)
 
         state_arrays = [t._data for t in state_tensors]
+        if _flags.flag("to_static_capture_lowered"):
+            def _spec(a):
+                # single-device shardings mean "uncommitted" here — passing
+                # them into lower() would conflict with in-step mesh
+                # constraints, which the real call (uncommitted arrays)
+                # never does
+                sh = getattr(a, "sharding", None)
+                if not isinstance(sh, jax.sharding.NamedSharding):
+                    sh = None
+                try:
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+                except TypeError:
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+            self._last_lowered = (jitted,
+                                  [_spec(a) for a in state_arrays],
+                                  [_spec(a) for a in arg_arrays])
         if self._donate:
             # donated buffers must be unique: two state tensors aliasing one
             # jax.Array (or a state array that is also a plain argument) make

@@ -24,7 +24,14 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _seed_everything():
+    import gc
+
     import paddle_tpu as paddle
+    # reference cycles (optimizer accumulator closures, layer graphs) keep
+    # dead models in the weakref state registry until a gc pass; collect so
+    # one test's mesh-committed state can't leak into the next test's
+    # to_static signature
+    gc.collect()
     paddle.seed(2024)
     np.random.seed(2024)
     yield

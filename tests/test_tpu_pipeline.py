@@ -134,7 +134,7 @@ def _train(model_like, params, data, labels, steps=4, lr=0.1):
 
 
 @pytest.mark.parametrize("dp,pp", [
-    (1, 2),
+    pytest.param(1, 2, marks=pytest.mark.slow),
     pytest.param(1, 4, marks=pytest.mark.slow),
     pytest.param(2, 4, marks=pytest.mark.slow),
 ])
@@ -284,3 +284,62 @@ def test_engine_state_dict_roundtrip_and_eval():
             model(x)
     finally:
         set_hybrid_communicate_group(None)
+
+
+def test_fleet_pipeline_parity_compiled_fast():
+    """Fast-subset guard for the pipelined engine: pp=2 under to_static,
+    2 steps, loss parity vs serial (full matrix in the slow-marked tests)."""
+    rng = np.random.default_rng(9)
+    data_np = rng.normal(0, 1, (8, D)).astype(np.float32)
+    label_np = rng.normal(0, 1, (8, 4)).astype(np.float32)
+
+    def compiled_losses(model_like, params, is_pp):
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=params)
+
+        @paddle.jit.to_static
+        def step(x, y):
+            if is_pp:
+                return model_like.train_batch((x, y), optimizer=opt)
+            loss = _mse(model_like(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        x, y = paddle.to_tensor(data_np), paddle.to_tensor(label_np)
+        return [float(step(x, y)) for _ in range(2)]
+
+    paddle.seed(321)
+    set_hybrid_communicate_group(None)
+    serial = _build_pipeline_layer()
+    ref = compiled_losses(serial, serial.parameters(), False)
+
+    paddle.seed(321)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = _build_pipeline_layer()
+        wrapped = fleet.distributed_model(model)
+        assert wrapped._engine is not None
+        got = compiled_losses(wrapped, wrapped.parameters(), True)
+        # eager train_batch path too (one step): first-loss must equal the
+        # serial first loss (same init, same data)
+        paddle.seed(321)
+        set_hybrid_communicate_group(None)
+        strategy2 = fleet.DistributedStrategy()
+        strategy2.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+        strategy2.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy2)
+        model2 = _build_pipeline_layer()
+        wrapped2 = fleet.distributed_model(model2)
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=wrapped2.parameters())
+        eager_loss = float(wrapped2.train_batch(
+            (paddle.to_tensor(data_np), paddle.to_tensor(label_np)),
+            optimizer=opt2))
+        np.testing.assert_allclose(eager_loss, ref[0], rtol=2e-4)
+    finally:
+        set_hybrid_communicate_group(None)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
