@@ -322,11 +322,18 @@ def force_platform(platform: str, device_count: Optional[int] = None) -> None:
                 os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
     import warnings
 
+    # our own device-list memos may hold pre-pin results (even a cached
+    # backend FAILURE) — always drop them, backends latched or not
+    _devices_of_type.cache_clear()
+    _accelerator_type.cache_clear()
     try:
         from jax._src import xla_bridge
         if getattr(xla_bridge, "_backends", None):
             xla_bridge._clear_backends()
             xla_bridge.get_backend.cache_clear()
+            # device lists are memoized separately (jax.local_devices etc.)
+            # and would otherwise keep serving the pre-switch platform
+            jax.clear_caches()
     except Exception as e:  # private jax API may move in an upgrade
         warnings.warn(f"force_platform: could not clear latched jax "
                       f"backends ({e!r}); the platform pin may not apply")

@@ -43,11 +43,23 @@ def init_parallel_env(strategy=None):
         return ParallelEnv()
     nprocs = _env_int("PADDLE_TRAINERS_NUM", 1)
     pid = _env_int("PADDLE_TRAINER_ID", 0)
-    if nprocs > 1 and jax.process_count() == 1:
+    # probe the coordination-service state WITHOUT jax.process_count(): that
+    # would initialize the XLA backend, after which initialize() refuses
+    try:
+        from jax._src import distributed as _jdist
+        already = _jdist.global_state.client is not None
+    except Exception:
+        # private-API drift: fall back to the (backend-initializing) probe
+        already = jax.process_count() > 1
+    if nprocs > 1 and not already:
         endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
         coordinator = endpoints[0] if endpoints and endpoints[0] else None
-        jax.distributed.initialize(coordinator_address=coordinator,
-                                   num_processes=nprocs, process_id=pid)
+        try:
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=nprocs, process_id=pid)
+        except RuntimeError as e:
+            if "already" not in str(e):
+                raise  # a real bootstrap failure, not double-init
     from .topology import _ensure_default_topology
     _ensure_default_topology()
     # elastic launcher present? lease a heartbeat so the manager can tell a

@@ -1,0 +1,54 @@
+"""Multi-PROCESS distributed execution (not just the in-process CPU mesh):
+2 workers over loopback, bootstrapped by the launcher env contract through
+jax.distributed — validates env.py + launch/ as more than scaffolding
+(SURVEY §4 TestDistBase pattern; VERDICT round-1 missing item 6)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_two_process_dp_parity(tmp_path):
+    env = dict(os.environ)
+    env.pop("PADDLE_PLATFORM", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path),
+         os.path.join(ROOT, "tests", "workers", "dp_multiproc_worker.py")],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    log0 = ""
+    for name in sorted(os.listdir(tmp_path)):
+        with open(os.path.join(tmp_path, name)) as f:
+            content = f.read()
+        if "losses" in content or "allreduce_ok" in content:
+            log0 = content
+    assert out.returncode == 0, (
+        f"launcher rc={out.returncode}\nstdout={out.stdout}\n"
+        f"stderr={out.stderr}\nlogs={log0}")
+    assert "allreduce_ok 3.0" in log0, log0
+
+    got = None
+    for line in log0.splitlines():
+        if line.startswith("losses "):
+            got = [float(v) for v in line.split()[1:]]
+    assert got is not None, log0
+
+    # serial reference: same data, full batch, plain numpy
+    D = 8
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (4, D)).astype(np.float32)
+    y = rng.normal(0, 1, (4, 1)).astype(np.float32)
+    w = (np.arange(D, dtype=np.float32).reshape(D, 1) / D) - 0.5
+    ref = []
+    for _ in range(5):
+        pred = x @ w
+        ref.append(float(np.mean((pred - y) ** 2)))
+        g = 2.0 / 4 * x.T @ (pred - y)
+        w = w - 0.1 * g
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)

@@ -17,8 +17,7 @@ import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
-from paddle_tpu.distributed.sharding import (DygraphShardingOptimizer,
-                                             shard_model_params)
+from paddle_tpu.distributed.sharding import DygraphShardingOptimizer
 from paddle_tpu.distributed.topology import (HybridCommunicateGroup,
                                              set_hybrid_communicate_group)
 
