@@ -14,6 +14,7 @@ import itertools
 import math
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -282,6 +283,227 @@ def default_collate_fn(batch):
     return batch
 
 
+_TENSOR_TAG = "__pdtpu_tensor__"
+
+
+def _encode_for_ipc(obj):
+    """Tensors can't cross process boundaries as PJRT buffers; ship numpy."""
+    if isinstance(obj, Tensor):
+        return (_TENSOR_TAG, np.asarray(obj._data))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_encode_for_ipc(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _encode_for_ipc(v) for k, v in obj.items()}
+    return obj
+
+
+def _decode_from_ipc(obj):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == _TENSOR_TAG:
+        return to_tensor(obj[1])
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode_from_ipc(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _decode_from_ipc(v) for k, v in obj.items()}
+    return obj
+
+
+def _np_collate(batch):
+    """Worker-side default collate: stacks to numpy so the worker process
+    never touches a jax backend (the parent does the single device_put)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return (_TENSOR_TAG, np.stack([np.asarray(b._data) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return (_TENSOR_TAG, np.stack(batch))
+    if isinstance(sample, (int, float, np.floating, np.integer)):
+        return (_TENSOR_TAG, np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(_np_collate(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
+                 worker_id, num_workers, iterable_mode, batch_size,
+                 drop_last):
+    """Body of one spawned worker process (upstream parity:
+    python/paddle/io/dataloader/worker.py _worker_loop)."""
+    global _worker_info
+    try:
+        # keep jax (and especially any TPU plugin) OUT of worker processes:
+        # pin cpu before anything can query a backend
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            from .. import device as _device
+            _device.force_platform("cpu")
+        except Exception:
+            pass
+        _worker_info = _WorkerInfo(worker_id, num_workers, dataset)
+        if init_fn is not None:
+            init_fn(worker_id)
+        if iterable_mode:
+            try:
+                it = iter(dataset)
+                seq = worker_id
+                while True:
+                    batch = list(itertools.islice(it, batch_size))
+                    if not batch or (len(batch) < batch_size and drop_last):
+                        break
+                    result_queue.put(
+                        (seq, _encode_for_ipc(collate_fn(batch))))
+                    seq += num_workers
+            except Exception as e:
+                result_queue.put(("error", (worker_id, repr(e))))
+            result_queue.put(("done", worker_id))
+            # wait for the shutdown token so the queue is drained cleanly
+            while True:
+                cmd = index_queue.get()
+                if cmd is None:
+                    break
+        else:
+            while True:
+                cmd = index_queue.get()
+                if cmd is None:
+                    break
+                epoch, seq, idx_batch = cmd
+                try:
+                    out = _encode_for_ipc(
+                        collate_fn([dataset[i] for i in idx_batch]))
+                    result_queue.put((epoch, seq, out))
+                except Exception as e:  # ship the error, keep serving
+                    result_queue.put((epoch, "error", (seq, repr(e))))
+    except KeyboardInterrupt:
+        pass
+
+
+class _WorkerPool:
+    """N spawned workers fed by an index queue, drained in submit order."""
+
+    def __init__(self, loader):
+        import multiprocessing as mp
+
+        self._loader = loader
+        ctx = mp.get_context("spawn")
+        self._index_queues = []
+        self._result_queue = ctx.Queue()
+        n = loader.num_workers
+        user_collate = loader.collate_fn is not default_collate_fn
+        collate = loader.collate_fn if user_collate else _np_collate
+        self._procs = []
+        self._epoch = 0  # stale-epoch filter: an early-broken epoch leaves
+        #                  in-flight results that must not leak into the next
+        for w in range(n):
+            iq = ctx.Queue()
+            self._index_queues.append(iq)
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, iq, self._result_queue, collate,
+                      loader.worker_init_fn, w, n, loader._iterable_mode,
+                      loader.batch_size, loader.drop_last),
+                daemon=True)
+            p.start()
+            self._procs.append(p)
+
+    def _get_result(self, timeout):
+        """Blocking get with worker-liveness polling: a hard worker death
+        (segfault/OOM-kill) must raise, not hang the trainer forever."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            poll = 5.0 if deadline is None else max(
+                0.01, min(5.0, deadline - time.monotonic()))
+            try:
+                return self._result_queue.get(timeout=poll)
+            except queue.Empty:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                dead = [w for w, p in enumerate(self._procs)
+                        if not p.is_alive()]
+                if dead:
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} died unexpectedly "
+                        "(killed or crashed outside Python)")
+
+    def run_epoch(self):
+        loader = self._loader
+        timeout = (loader.timeout
+                   if loader.timeout and loader.timeout > 0 else None)
+        if loader._iterable_mode:
+            yield from self._run_iterable(timeout)
+            return
+        self._epoch += 1
+        epoch = self._epoch
+        indices = list(loader.batch_sampler)
+        n_batches = len(indices)
+        inflight_target = max(2, loader.prefetch_factor) * len(self._procs)
+        next_submit = 0
+        received = {}
+        next_yield = 0
+        while next_yield < n_batches:
+            while (next_submit < n_batches
+                   and next_submit - next_yield < inflight_target):
+                self._index_queues[next_submit % len(self._procs)].put(
+                    (epoch, next_submit, indices[next_submit]))
+                next_submit += 1
+            while next_yield in received:
+                yield _decode_from_ipc(received.pop(next_yield))
+                next_yield += 1
+            if next_yield >= n_batches:
+                break
+            ep, tag, payload = self._get_result(timeout)
+            if ep != epoch:
+                continue  # stale result from an early-broken prior epoch
+            if tag == "error":
+                seq, msg = payload
+                raise RuntimeError(
+                    f"DataLoader worker failed on batch {seq}: {msg}")
+            received[tag] = payload
+
+    def _run_iterable(self, timeout):
+        done = 0
+        received = {}
+        # workers stream (seq = worker_id + k*num_workers); yield in global
+        # seq order so two epochs of the same dataset agree
+        next_seq = 0
+        while done < len(self._procs):
+            if next_seq in received:
+                yield _decode_from_ipc(received.pop(next_seq))
+                next_seq += 1
+                continue
+            tag, payload = self._get_result(timeout)
+            if tag == "done":
+                done += 1
+                continue
+            if tag == "error":
+                seq, msg = payload
+                raise RuntimeError(f"DataLoader worker failed: {msg}")
+            received[tag] = payload
+        # stragglers: some seq numbers never arrive (a worker exhausted
+        # early); yield the rest in ascending order
+        for seq in sorted(received):
+            yield _decode_from_ipc(received.pop(seq))
+
+    def shutdown(self):
+        for iq in self._index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        self._procs = []
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -304,6 +526,14 @@ class DataLoader:
             self.batch_sampler = None
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        self.timeout = timeout
+        self._pool = None
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.shutdown()
 
     def __len__(self):
         if self.batch_sampler is not None:
@@ -325,13 +555,24 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
-        if not self.use_buffer_reader or self.num_workers == 0:
-            if self.use_buffer_reader:
-                yield from self._thread_prefetch(self._iter_batches())
-            else:
-                yield from self._iter_batches()
+        if self.num_workers and self.num_workers > 0:
+            pool = self._pool
+            if pool is None:
+                pool = _WorkerPool(self)
+                # iterable workers exhaust their stream once; a persistent
+                # pool would hang the next epoch — always rebuild for them
+                if self.persistent_workers and not self._iterable_mode:
+                    self._pool = pool
+            try:
+                yield from pool.run_epoch()
+            finally:
+                if pool is not self._pool:
+                    pool.shutdown()
             return
-        yield from self._thread_prefetch(self._iter_batches())
+        if self.use_buffer_reader:
+            yield from self._thread_prefetch(self._iter_batches())
+        else:
+            yield from self._iter_batches()
 
     def _thread_prefetch(self, gen):
         """Background-thread double buffering: the native C++ BlockingQueue
