@@ -3,10 +3,16 @@
 Parity surface: python/paddle/distributed/checkpoint/
 (``save_state_dict``/``load_state_dict`` — per-rank shard files + metadata
 with global shape/placements, resharding when the load topology differs).
-TPU-native: arrays are saved via orbax (async-capable, multi-host-aware);
-shardings are recorded as (axis spec) metadata, and on load the arrays are
-``device_put`` onto the CURRENT mesh — reshard-on-load is free because XLA
-relayouts to whatever the new topology needs.
+
+TPU-native: arrays are handed to orbax AS SHARDED ``jax.Array``s — each
+host serializes only its addressable shards (no full host gather, so a 7B
+state never funnels through one host), ``async_save`` rides orbax's
+AsyncCheckpointer (device-to-host copy happens synchronously, file IO in
+the background), and load passes each destination tensor's CURRENT
+sharding as a restore arg, so orbax reads exactly the shards the new
+topology needs — reshard-on-load across different meshes (e.g. save on
+(dp=2, mp=4), load on (dp=4, mp=2)) is exercised by
+tests/test_distributed_checkpoint.py.
 """
 
 from __future__ import annotations
@@ -14,14 +20,15 @@ from __future__ import annotations
 import json
 import os
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor, to_tensor
 
-__all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict",
+           "wait_async_saves"]
 
 
 def _spec_of(t: Tensor):
@@ -35,51 +42,83 @@ def _spec_of(t: Tensor):
     return None
 
 
+_ASYNC: List[Any] = []  # pending (ckptr | thread) handles
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, async_save: bool = False) -> None:
     os.makedirs(path, exist_ok=True)
     flat = _flatten("", state_dict)
     meta = {}
-    arrays = {}
+    arrays: Dict[str, Any] = {}
     for k, v in flat.items():
         if isinstance(v, Tensor):
-            arrays[k] = np.asarray(v._data)
+            # raw (possibly sharded) jax.Array — orbax writes per-shard;
+            # no np.asarray host gather here
+            arrays[k] = v._data
             meta[k] = {"shape": list(v._data.shape),
                        "dtype": str(v._data.dtype),
                        "spec": _spec_of(v)}
         else:
             meta[k] = {"value": v}
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f)
 
-    def _write():
-        try:
-            import orbax.checkpoint as ocp
-            ckptr = ocp.PyTreeCheckpointer()
+    try:
+        import orbax.checkpoint as ocp
+    except Exception:
+        ocp = None
+
+    if ocp is not None:
+        if async_save:
+            ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
             ckptr.save(os.path.join(path, "arrays"), arrays, force=True)
-        except Exception:
-            np.savez(os.path.join(path, "arrays.npz"), **arrays)
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta, f)
+            _ASYNC.append(ckptr)
+        else:
+            ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
+                os.path.join(path, "arrays"), arrays, force=True)
+        return
+
+    # fallback without orbax: single-file npz (full host gather — small
+    # states only; orbax is the supported path)
+    def _write():
+        np.savez(os.path.join(path, "arrays.npz"),
+                 **{k: np.asarray(a) for k, a in arrays.items()})
 
     if async_save:
         t = threading.Thread(target=_write, daemon=True)
         t.start()
-        _ASYNC_THREADS.append(t)
+        _ASYNC.append(t)
     else:
         _write()
 
 
-_ASYNC_THREADS = []
-
-
 def wait_async_saves() -> None:
-    for t in _ASYNC_THREADS:
-        t.join()
-    _ASYNC_THREADS.clear()
+    for h in _ASYNC:
+        if hasattr(h, "wait_until_finished"):
+            h.wait_until_finished()
+            try:
+                h.close()
+            except Exception:
+                pass
+        else:
+            h.join()
+    _ASYNC.clear()
 
 
 def async_save_state_dict(state_dict, path, **kw):
     return save_state_dict(state_dict, path, async_save=True, **kw)
+
+
+def _target_sharding(t: Tensor):
+    try:
+        sh = t._data.sharding
+        if isinstance(sh, jax.sharding.NamedSharding):
+            return sh
+    except Exception:
+        pass
+    return None
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
@@ -89,30 +128,54 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     each destination tensor's current placement."""
     with open(os.path.join(path, "metadata.json")) as f:
         meta = json.load(f)
+    flat = {k: t for k, t in _flatten("", state_dict).items()
+            if isinstance(t, Tensor)}
+    for k in flat:
+        if k not in meta or "value" in meta.get(k, {}):
+            raise KeyError(f"checkpoint at {path} has no entry {k!r}")
+        src_shape = meta[k]["shape"]
+        if list(src_shape) != list(flat[k]._data.shape):
+            raise ValueError(
+                f"shape mismatch for {k}: checkpoint {src_shape} vs target "
+                f"{tuple(flat[k]._data.shape)}")
+
     arrays = None
-    try:
+    arrays_dir = os.path.join(path, "arrays")
+    if os.path.isdir(arrays_dir):
         import orbax.checkpoint as ocp
-        ckptr = ocp.PyTreeCheckpointer()
-        arrays = ckptr.restore(os.path.join(path, "arrays"))
-    except Exception:
+        # restore_args must mirror the SAVED tree (orbax tree-maps it), so
+        # cover every saved array key — target keys get their destination
+        # sharding (orbax then reads only the shards this topology needs),
+        # non-target keys restore default and are dropped below
+        restore_args = {}
+        for k, m in meta.items():
+            if "value" in m:
+                continue
+            t = flat.get(k)
+            sh = _target_sharding(t) if t is not None else None
+            if sh is not None:
+                restore_args[k] = ocp.ArrayRestoreArgs(sharding=sh)
+            else:
+                restore_args[k] = ocp.RestoreArgs()
+        arrays = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).restore(
+            arrays_dir, restore_args=restore_args)
+    else:
         npz = np.load(os.path.join(path, "arrays.npz"))
         arrays = {k: npz[k] for k in npz.files}
-    flat = _flatten("", state_dict)
+
     for k, tgt in flat.items():
-        if not isinstance(tgt, Tensor):
-            continue
-        if k not in arrays:
-            raise KeyError(f"checkpoint at {path} has no entry {k!r}")
-        src = np.asarray(arrays[k])
-        if list(src.shape) != list(tgt._data.shape):
-            raise ValueError(f"shape mismatch for {k}: checkpoint "
-                             f"{src.shape} vs target {tuple(tgt._data.shape)}")
-        # reshard-on-load: place with the destination's current sharding
-        try:
-            sharding = tgt._data.sharding
-            arr = jax.device_put(src.astype(tgt._data.dtype), sharding)
-        except Exception:
-            arr = jax.numpy.asarray(src.astype(tgt._data.dtype))
+        src = arrays[k]
+        if isinstance(src, jax.Array) and _target_sharding(tgt) is not None \
+                and src.sharding == tgt._data.sharding:
+            arr = src.astype(tgt._data.dtype) \
+                if src.dtype != tgt._data.dtype else src
+        else:
+            host = np.asarray(src)
+            try:
+                arr = jax.device_put(host.astype(tgt._data.dtype),
+                                     tgt._data.sharding)
+            except Exception:
+                arr = jax.numpy.asarray(host.astype(tgt._data.dtype))
         tgt._set_data(arr)
 
 

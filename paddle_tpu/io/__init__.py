@@ -388,24 +388,40 @@ class _WorkerPool:
         self._loader = loader
         ctx = mp.get_context("spawn")
         self._index_queues = []
-        self._result_queue = ctx.Queue()
         n = loader.num_workers
+        # bounded: gives iterable-mode workers backpressure (map mode is
+        # already throttled by the in-flight window) + room for control
+        # tokens
+        self._result_queue = ctx.Queue(
+            maxsize=max(2, loader.prefetch_factor) * n + n)
         user_collate = loader.collate_fn is not default_collate_fn
         collate = loader.collate_fn if user_collate else _np_collate
         self._procs = []
         self._epoch = 0  # stale-epoch filter: an early-broken epoch leaves
         #                  in-flight results that must not leak into the next
-        for w in range(n):
-            iq = ctx.Queue()
-            self._index_queues.append(iq)
-            p = ctx.Process(
-                target=_worker_loop,
-                args=(loader.dataset, iq, self._result_queue, collate,
-                      loader.worker_init_fn, w, n, loader._iterable_mode,
-                      loader.batch_size, loader.drop_last),
-                daemon=True)
-            p.start()
-            self._procs.append(p)
+        # children must pin to cpu BEFORE they unpickle the dataset (a
+        # dataset holding Tensors would otherwise initialize the parent's
+        # real backend while deserializing Process args)
+        import os
+        prev_plat = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for w in range(n):
+                iq = ctx.Queue()
+                self._index_queues.append(iq)
+                p = ctx.Process(
+                    target=_worker_loop,
+                    args=(loader.dataset, iq, self._result_queue, collate,
+                          loader.worker_init_fn, w, n, loader._iterable_mode,
+                          loader.batch_size, loader.drop_last),
+                    daemon=True)
+                p.start()
+                self._procs.append(p)
+        finally:
+            if prev_plat is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev_plat
 
     def _get_result(self, timeout):
         """Blocking get with worker-liveness polling: a hard worker death
@@ -418,7 +434,9 @@ class _WorkerPool:
                 return self._result_queue.get(timeout=poll)
             except queue.Empty:
                 if deadline is not None and time.monotonic() >= deadline:
-                    raise
+                    raise RuntimeError(
+                        f"DataLoader timed out after {timeout}s waiting for "
+                        "a worker batch") from None
                 dead = [w for w, p in enumerate(self._procs)
                         if not p.is_alive()]
                 if dead:
