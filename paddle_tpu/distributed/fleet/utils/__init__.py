@@ -36,6 +36,10 @@ def recompute(function, *args, **kwargs):
         return function(*args, **kwargs)
 
     rng_before = default_generator.get_state() if preserve_rng else None
+    # capture the ambient autocast state: backward runs OUTSIDE the user's
+    # auto_cast block, but the re-forward must produce outputs of the same
+    # dtypes as the original or the stored vjp rejects the cotangents
+    amp_at_forward = _tracing.amp_state()
 
     class _Recompute(_autograd.PyLayer):
         @staticmethod
@@ -55,10 +59,18 @@ def recompute(function, *args, **kwargs):
             if rng_before is not None:
                 rng_after = default_generator.get_state()
                 default_generator.set_state(rng_before)
+            # replay the forward's exact autocast state — including the
+            # DISABLED state, so a backward() issued inside someone else's
+            # auto_cast block can't re-cast the recomputation
+            replay_amp = amp_at_forward if amp_at_forward is not None \
+                else _tracing.AmpState(False, None, "O1", frozenset(),
+                                       frozenset())
+            _tracing.push_amp_state(replay_amp)
             try:
                 with _tracing.enable_grad():
                     out = function(*re_args, **kwargs)
             finally:
+                _tracing.pop_amp_state()
                 if rng_before is not None:
                     default_generator.set_state(rng_after)
             outs = out if isinstance(out, (tuple, list)) else (out,)

@@ -42,6 +42,18 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     dtype: str = "float32"
+    # activation checkpointing per decoder layer (fleet.utils.recompute);
+    # trades ~1/3 more FLOPs for O(layers) less activation memory — the
+    # standard big-model training setting on TPU
+    recompute: bool = False
+    # scan-over-layers: stack identical decoder-layer params and lax.scan a
+    # single layer body over them. The compiled program stops growing with
+    # depth (a 32-layer model compiles as fast as a 2-layer one) and
+    # composes with ``recompute`` as jax.checkpoint on the scan body — the
+    # standard TPU big-model trainer structure. NOTE: state_dict keys use
+    # the stacked layout (model.scan_*) — not interchangeable with the
+    # per-layer layout; cached generation requires scan_layers=False
+    scan_layers: bool = False
 
     @staticmethod
     def llama2_7b() -> "LlamaConfig":
@@ -164,13 +176,89 @@ class LlamaModel(nn.Layer):
                                config.rope_theta)
         self.register_buffer("rope_cos", to_tensor(cos), persistable=False)
         self.register_buffer("rope_sin", to_tensor(sin), persistable=False)
+        if config.scan_layers:
+            self._build_scan_stack()
+
+    def _build_scan_stack(self):
+        """Stack per-layer params into (L, ...) Parameters; layer 0 stays as
+        the trace template, the other layer objects are released."""
+        from ..core.tensor import Parameter as _Parameter
+
+        layers = list(self.layers)
+        self._scan_names = sorted(layers[0].state_dict().keys())
+        self._scan_params = {}
+        for name in self._scan_names:
+            stacked = jnp.stack(
+                [l.state_dict()[name]._data for l in layers], axis=0)
+            p = _Parameter(stacked, name=f"llama_scan_{name.replace('.', '_')}")
+            self._scan_params[name] = p
+            setattr(self, f"scan_{name.replace('.', '_')}", p)
+        # keep only the template, OUTSIDE the registered sublayer tree: its
+        # params are trace placeholders and must not surface in
+        # parameters()/state_dict (an optimizer would build dead state for
+        # them). Plain-attribute storage keeps the object alive without
+        # registration.
+        from ..nn.container import LayerList as _LayerList
+        object.__setattr__(self, "_scan_template", layers[0])
+        self.layers = _LayerList([])
+        for q in layers[0].parameters():
+            q.trainable = False
+            q.stop_gradient = True
+
+    def _scan_forward(self, x):
+        import jax
+
+        from ..core.tensor import Tensor as _T, apply as _apply
+        from ..core.tracing import no_grad  # noqa: F401
+
+        template = self._scan_template
+        names = self._scan_names
+        flat = [self._scan_params[n] for n in names]
+        recompute = self.config.recompute
+
+        def fn(cos, sin, h, *stacked):
+            def body(carry, sl):
+                with no_grad():
+                    sd = template.state_dict()
+                    saved = {n: sd[n]._data for n in names}
+                    for n, v in zip(names, sl):
+                        sd[n]._data = v
+                    try:
+                        out = template(_T(carry), _T(cos), _T(sin))._data
+                    finally:
+                        for n in names:
+                            sd[n]._data = saved[n]
+                return out, None
+
+            if recompute:
+                body = jax.checkpoint(body)
+            out, _ = jax.lax.scan(body, h, list(stacked))
+            return out
+
+        return _apply("llama_scan_layers", fn, self.rope_cos, self.rope_sin,
+                      x, *flat, amp=False)
 
     def forward(self, input_ids, attn_mask=None, caches=None):
         x = self.embed_tokens(input_ids)
         if caches is None:
-            for layer in self.layers:
-                x = layer(x, self.rope_cos, self.rope_sin, attn_mask)
+            if self.config.scan_layers:
+                if attn_mask is not None:
+                    raise NotImplementedError(
+                        "scan_layers supports the causal training path only")
+                return self.norm(self._scan_forward(x))
+            if self.config.recompute:
+                from ..distributed.fleet.utils import recompute as _rc
+                for layer in self.layers:
+                    x = _rc(layer, x, self.rope_cos, self.rope_sin, attn_mask)
+            else:
+                for layer in self.layers:
+                    x = layer(x, self.rope_cos, self.rope_sin, attn_mask)
             return self.norm(x)
+        if self.config.scan_layers:
+            raise NotImplementedError(
+                "scan_layers is a training-path structure; rebuild the "
+                "model with scan_layers=False (loading the same weights "
+                "via the stacked state_dict) for cached generation")
         new_caches = []
         for layer, c in zip(self.layers, caches):
             x, nc = layer(x, self.rope_cos, self.rope_sin, attn_mask, cache=c)

@@ -507,16 +507,12 @@ class Adam(Optimizer):
         decay_fn = getattr(self, "_apply_decay_param_fun", None)
         lr_ratio = getattr(self, "_lr_ratio", None)
         if decay_fn is not None:
-            wd_np = np.ones((fs["total"],), np.float32)
-            for p, (off, n) in zip(fs["params"], fs["offsets"]):
-                if not decay_fn(p.name):
-                    wd_np[off:off + n] = 0.0
-            fs["wd_mask"] = self._reg_flat("wd_mask", jnp.asarray(wd_np))
+            fs["wd_mask"] = self._reg_flat("wd_mask", self._segment_vector(
+                [0.0 if not decay_fn(p.name) else 1.0
+                 for p in fs["params"]]))
         if lr_ratio is not None:
-            lr_np = np.ones((fs["total"],), np.float32)
-            for p, (off, n) in zip(fs["params"], fs["offsets"]):
-                lr_np[off:off + n] = lr_ratio(p)
-            fs["lr_scale"] = self._reg_flat("lr_scale", jnp.asarray(lr_np))
+            fs["lr_scale"] = self._reg_flat("lr_scale", self._segment_vector(
+                [float(lr_ratio(p)) for p in fs["params"]]))
 
     def _fused_sync_versions(self) -> None:
         fs = self._fused
@@ -555,17 +551,30 @@ class Adam(Optimizer):
         else:
             super()._on_params_cast()
 
+    def _segment_vector(self, per_segment_values):
+        """Flat (total,) f32 vector that is constant within each param's
+        segment. Built as tiny-literal boundaries + one gather — NOT a dense
+        literal (materialized mid-trace that embeds a model-sized constant
+        into the program: the remote-compile 413 failure mode) and NOT an
+        O(n_params) where-chain. int64 iota so >2^31-element flat buffers
+        (7B scale) index correctly regardless of jax_enable_x64 width caps:
+        searchsorted boundaries stay well under float precision anyway."""
+        fs = self._fused
+        bounds = np.asarray([off for off, _ in fs["offsets"]][1:], np.int64)
+        vals = jnp.asarray(np.asarray(per_segment_values, np.float32))
+        idx = jax.lax.iota(jnp.int64, fs["total"])             if fs["total"] > np.iinfo(np.int32).max             else jax.lax.iota(jnp.int32, fs["total"])
+        seg = jnp.searchsorted(jnp.asarray(bounds, idx.dtype), idx,
+                               side="right")
+        return vals[seg]
+
     def _fused_live_mask(self, live):
         """0/1 f32 segment mask for the given per-param liveness tuple,
         registered as carried state (cached per distinct pattern)."""
         fs = self._fused
         m = fs["live_cache"].get(live)
         if m is None:
-            mask_np = np.zeros((fs["total"],), np.float32)
-            for ok, (off, n) in zip(live, fs["offsets"]):
-                if ok:
-                    mask_np[off:off + n] = 1.0
-            m = self._reg_flat("live_mask", jnp.asarray(mask_np))
+            m = self._reg_flat("live_mask", self._segment_vector(
+                [1.0 if ok else 0.0 for ok in live]))
             fs["live_cache"][live] = m
         return m._data
 

@@ -110,3 +110,54 @@ class TestGenerationSemantics:
         out = m.generate(paddle.to_tensor(np.random.randint(0, 64, (2, 4))),
                          max_new_tokens=4, eos_token_id=0)
         assert out.shape[0] == 2 and out.shape[1] <= 8
+
+
+class TestScanLayers:
+    """LlamaConfig.scan_layers: stacked-layer lax.scan trainer structure."""
+
+    def test_scan_layers_matches_loop(self):
+        ids_np = np.random.default_rng(0).integers(0, 128, (2, 32),
+                                                   dtype=np.int32)
+
+        def losses(scan):
+            paddle.seed(0)
+            from paddle_tpu.models.llama import (LlamaConfig,
+                                                 LlamaForCausalLM)
+            cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=3, heads=4,
+                                   kv_heads=4, inter=128, max_pos=64)
+            cfg.scan_layers = scan
+            cfg.recompute = scan  # checkpointed scan body
+            m = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(
+                learning_rate=1e-3,
+                parameters=[p for p in m.parameters() if p.trainable])
+
+            @paddle.jit.to_static
+            def step(ids):
+                loss, _ = m(ids, labels=ids)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            ids = paddle.to_tensor(ids_np)
+            return [float(step(ids)) for _ in range(4)]
+
+        ref = losses(False)
+        got = losses(True)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-5)
+
+    def test_template_params_not_trainable(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=2,
+                               kv_heads=2, inter=64, max_pos=32)
+        cfg.scan_layers = True
+        m = LlamaForCausalLM(cfg)
+        # template placeholders excluded; stacked params present
+        trainable = [p for p in m.parameters() if p.trainable]
+        assert any((p.name or "").startswith("llama_scan_")
+                   for p in trainable)
+        for layer in m.model.layers:
+            for p in layer.parameters():
+                assert not p.trainable
