@@ -15,7 +15,8 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from . import callbacks as callbacks_mod
-from .callbacks import Callback, CallbackList, ProgBarLogger, ModelCheckpoint
+from .callbacks import (Callback, CallbackList, ProgBarLogger,
+                        ModelCheckpoint, VisualDL)
 
 __all__ = ["Model", "summary"]
 

@@ -272,35 +272,47 @@ class ReduceLROnPlateau(Callback):
 
 
 class VisualDL(Callback):
-    """Scalar logger with the VisualDL callback surface (reference:
-    paddle.callbacks.VisualDL). VisualDL itself isn't in this build; scalars
-    land in TensorBoard-compatible jsonl under ``log_dir`` that
-    ``jax.profiler``/XProf tooling and plain readers consume."""
+    """Scalar-sink callback (parity: paddle.callbacks.VisualDL): writes
+    per-step train metrics and per-epoch eval metrics through
+    ``paddle_tpu.utils.logwriter.LogWriter`` (JSONL event stream)."""
 
-    def __init__(self, log_dir="vdl_log"):
-        super().__init__()
+    def __init__(self, log_dir: str = "vdl_log"):
         self.log_dir = log_dir
-        self._fh = None
-        self._step = 0
+        self._writer = None
+        self._global_step = 0
 
-    def on_train_begin(self, logs=None):
-        import os
-        os.makedirs(self.log_dir, exist_ok=True)
-        self._fh = open(f"{self.log_dir}/scalars.jsonl", "a")
+    def _w(self):
+        if self._writer is None:
+            from ..utils.logwriter import LogWriter
+            self._writer = LogWriter(logdir=self.log_dir)
+        return self._writer
+
+    @staticmethod
+    def _scalars(logs):
+        out = {}
+        for k, v in (logs or {}).items():
+            try:
+                out[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+        return out
 
     def on_train_batch_end(self, step, logs=None):
-        import json
-        self._step += 1
-        if self._fh and logs:
-            rec = {"step": self._step}
-            for k, v in logs.items():
-                try:
-                    rec[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
-                except (TypeError, ValueError):
-                    continue
-            self._fh.write(json.dumps(rec) + "\n")
+        self._global_step += 1
+        for k, v in self._scalars(logs).items():
+            self._w().add_scalar(f"train/{k}", v, self._global_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in self._scalars(logs).items():
+            self._w().add_scalar(f"train_epoch/{k}", v, epoch)
+
+    def on_eval_end(self, logs=None):
+        for k, v in self._scalars(logs).items():
+            self._w().add_scalar(f"eval/{k}", v, self._global_step)
+        if self._writer is not None:
+            self._writer.flush()
 
     def on_train_end(self, logs=None):
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None  # a second fit() reopens cleanly

@@ -71,6 +71,19 @@ class StaticFunction:
         functools.update_wrapper(self, fn)
         self._fn = fn
         self._donate = donate_states
+        # full_graph=False is the reference SOT contract: a trace failure
+        # (tensor-dependent Python control flow, unsupported op) falls back
+        # to eager for that call instead of raising — the graph-break
+        # analogue. Our default stays strict (full_graph=True) because the
+        # silent perf cliff is usually a bug the user wants to see.
+        self._full_graph = bool(full_graph)
+        self._warned_fallback = False
+        if not self._full_graph:
+            # fallback may re-run the fn eagerly after a compiled attempt
+            # failed mid-flight; donation would have deleted the state
+            # buffers that eager rerun reads — the compatibility mode
+            # trades donation for a safe graph-break
+            self._donate = False
         # iters_per_call > 1: lax.scan ``fn`` over the leading axis of every
         # tensor argument inside ONE compiled call (state is the scan carry).
         # This is the standard TPU scan-over-steps trainer pattern — it
@@ -168,6 +181,31 @@ class StaticFunction:
             del self._cache[key]
             return self.__call__(*args, **kwargs)
 
+        try:
+            return self._invoke(jitted, holder, state_tensors, arg_arrays,
+                                leaves, key)
+        except Exception as e:
+            if self._full_graph:
+                raise
+            # SOT-style graph break (upstream python/paddle/jit/sot/):
+            # tracing failed (tensor-dependent Python control flow,
+            # unsupported op) — run eagerly instead. The poisoned cache
+            # entry is dropped so a later fixed call can recompile.
+            self._cache.pop(key, None)
+            if not self._warned_fallback:
+                import warnings
+                warnings.warn(
+                    f"to_static(full_graph=False): tracing "
+                    f"{getattr(self._fn, '__name__', '?')} failed "
+                    f"({type(e).__name__}: {e}); falling back to eager "
+                    "execution")
+                self._warned_fallback = True
+            if self._iters > 1:
+                return self._run_iters_eager(args, kwargs)
+            return self._fn(*args, **kwargs)
+
+    def _invoke(self, jitted, holder, state_tensors, arg_arrays, leaves,
+                key):
         state_arrays = [t._data for t in state_tensors]
         if _flags.flag("to_static_capture_lowered"):
             def _spec(a):
@@ -423,7 +461,8 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
               **kwargs):
     """``paddle.jit.to_static`` parity decorator."""
 
-    sf_kwargs = {k: kwargs[k] for k in ("iters_per_call", "donate_states")
+    sf_kwargs = {k: kwargs[k]
+                 for k in ("iters_per_call", "donate_states", "full_graph")
                  if k in kwargs}
 
     def decorate(fn):

@@ -75,9 +75,55 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
 
 def llm_int8_linear(x, weight, bias=None, weight_scale=None,
                     threshold: float = 6.0, name=None):
-    """LLM.int8: outlier activation columns compute in fp, the rest in int8
-    (here: the numerics — dequantized matmul with the same API)."""
-    return weight_only_linear(x, weight, bias=bias, weight_scale=weight_scale)
+    """LLM.int8 (Dettmers et al.): activations quantize dynamically
+    per-row to int8 and the matmul EXECUTES in int8 with int32
+    accumulation (``lax.dot_general(..., preferred_element_type=int32)``
+    — the TPU MXU's int8 path); in-feature columns whose activation
+    magnitude exceeds ``threshold`` stay in floating point and ride a
+    second matmul (static-shape masking instead of gather, so the program
+    compiles once)."""
+    import jax.lax as lax
+
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    extras = []
+    if weight_scale is not None:
+        extras.append(ensure_tensor(weight_scale))
+    if bias is not None:
+        extras.append(ensure_tensor(bias))
+
+    def f(a, w, *rest):
+        i = 0
+        if weight_scale is not None:
+            w_scale = rest[i].astype(jnp.float32)
+            i += 1
+        else:
+            w_scale = jnp.ones((w.shape[1],), jnp.float32)
+        lead = a.shape[:-1]
+        a2 = a.reshape((-1, a.shape[-1])).astype(jnp.float32)
+        # outlier in-features: any row exceeding threshold
+        col_amax = jnp.max(jnp.abs(a2), axis=0)
+        outlier = col_amax > jnp.float32(threshold)
+        a_int_src = jnp.where(outlier[None, :], 0.0, a2)
+        a_fp = jnp.where(outlier[None, :], a2, 0.0)
+        # per-row symmetric int8 quantization of the non-outlier part
+        row_scale = jnp.maximum(jnp.max(jnp.abs(a_int_src), axis=1,
+                                        keepdims=True), 1e-8) / 127.0
+        a8 = jnp.clip(jnp.round(a_int_src / row_scale), -127, 127
+                      ).astype(jnp.int8)
+        w8 = w.astype(jnp.int8)
+        y32 = lax.dot_general(a8, w8, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        y_int = y32.astype(jnp.float32) * row_scale * w_scale[None, :]
+        # outlier columns in fp against the dequantized weight rows
+        w_fp = jnp.where(outlier[:, None], w.astype(jnp.float32)
+                         * w_scale[None, :], 0.0)
+        y = y_int + a_fp @ w_fp
+        y = y.reshape(lead + (w.shape[1],)).astype(a.dtype)
+        if bias is not None:
+            y = y + rest[i]
+        return y
+
+    return apply("llm_int8_linear", f, x, weight, *extras)
 
 
 for _n in ("weight_quantize", "weight_dequantize", "weight_only_linear",

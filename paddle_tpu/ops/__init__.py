@@ -23,3 +23,4 @@ from . import loss_ops  # noqa: F401
 from . import vision  # noqa: F401
 from . import array  # noqa: F401
 from . import math_ext2  # noqa: F401  (last: aliases earlier registrations)
+from . import math_ext4  # noqa: F401  (wave 4: trace/view/polar/pdist/...)

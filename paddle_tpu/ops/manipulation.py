@@ -37,7 +37,7 @@ def reshape(x, shape, name=None):
     return apply("reshape", lambda a: jnp.reshape(a, shape), x)
 
 
-register_op("reshape", reshape, methods=("reshape", "view"), inplace_method="reshape_")
+register_op("reshape", reshape, methods=("reshape",), inplace_method="reshape_")
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):

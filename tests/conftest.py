@@ -35,3 +35,12 @@ def _seed_everything():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+    # the global default Program records ops with strong tensor refs; a
+    # test that ran static ops outside a program_guard would otherwise pin
+    # its (possibly mesh-committed) tensors into every later test's
+    # to_static state signature
+    import paddle_tpu.static as _static
+    if _static._static_mode:
+        paddle.disable_static()
+    _static._default_main = _static.Program()
+    _static._default_startup = _static.Program()

@@ -389,3 +389,80 @@ class TestReviewFixes7:
             w.writeframes(data.tobytes())
         sig, sr = paddle.audio.backends.load(path)
         np.testing.assert_allclose(np.asarray(sig.numpy()), 0.0, atol=1e-6)
+
+
+class TestWave4Ops:
+    def test_trace(self):
+        a = np.arange(12, dtype="float32").reshape(3, 4)
+        np.testing.assert_allclose(paddle.trace(paddle.to_tensor(a)).numpy(),
+                                   np.trace(a))
+        np.testing.assert_allclose(
+            paddle.trace(paddle.to_tensor(a), offset=1).numpy(),
+            np.trace(a, offset=1))
+        t = paddle.to_tensor(a, stop_gradient=False)
+        paddle.trace(t).backward()
+        np.testing.assert_allclose(t.grad.numpy(), np.eye(3, 4))
+
+    def test_view_reshape_and_dtype(self):
+        a = np.arange(8, dtype="float32")
+        v = paddle.view(paddle.to_tensor(a), [2, 4])
+        assert v.shape == [2, 4]
+        b = paddle.view(paddle.to_tensor(a), "int32")
+        assert str(b.dtype) == "int32"
+        np.testing.assert_array_equal(b.numpy(), a.view(np.int32))
+        # different-width reinterpret rescales the LAST dim (paddle.view)
+        h = paddle.view(paddle.to_tensor(a), "float16")
+        assert h.shape == [16], h.shape
+        np.testing.assert_array_equal(h.numpy(), a.view(np.float16))
+        back = paddle.view(h, "float32")
+        assert back.shape == [8]
+        np.testing.assert_allclose(back.numpy(), a)
+
+    def test_polar(self):
+        r = np.array([1.0, 2.0], "float32")
+        t = np.array([0.0, np.pi / 2], "float32")
+        z = paddle.polar(paddle.to_tensor(r), paddle.to_tensor(t)).numpy()
+        np.testing.assert_allclose(z, r * np.exp(1j * t), atol=1e-6)
+
+    def test_pdist(self):
+        x = np.random.default_rng(0).normal(0, 1, (5, 3)).astype("float32")
+        got = paddle.pdist(paddle.to_tensor(x)).numpy()
+        from scipy.spatial.distance import pdist as sp_pdist
+        np.testing.assert_allclose(got, sp_pdist(x), rtol=1e-5)
+
+    def test_igamma_igammac(self):
+        from scipy.special import gammainc, gammaincc
+        x = np.array([1.0, 2.0, 3.0], "float32")
+        a = np.array([0.5, 1.5, 2.5], "float32")
+        # reference naming is inverted vs scipy: igamma == upper Q
+        np.testing.assert_allclose(
+            paddle.igamma(paddle.to_tensor(x), paddle.to_tensor(a)).numpy(),
+            gammaincc(x, a), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.igammac(paddle.to_tensor(x), paddle.to_tensor(a)).numpy(),
+            gammainc(x, a), rtol=1e-5)
+
+    def test_sinc(self):
+        x = np.array([-1.5, 0.0, 0.5], "float32")
+        np.testing.assert_allclose(paddle.sinc(paddle.to_tensor(x)).numpy(),
+                                   np.sinc(x), rtol=1e-6)
+
+    def test_reduce_as(self):
+        x = np.random.rand(4, 3, 2).astype("float32")
+        tgt = np.zeros((3, 1), "float32")
+        got = paddle.reduce_as(paddle.to_tensor(x),
+                               paddle.to_tensor(tgt)).numpy()
+        np.testing.assert_allclose(got, x.sum(axis=0).sum(axis=1,
+                                                          keepdims=True),
+                                   rtol=1e-6)
+
+    def test_log_normal_and_geometric(self):
+        paddle.seed(7)
+        s = paddle.log_normal(mean=0.0, std=0.5, shape=[2000])
+        logs = np.log(s.numpy())
+        assert abs(logs.mean()) < 0.1 and abs(logs.std() - 0.5) < 0.1
+        t = paddle.to_tensor(np.zeros(2000, "float32"))
+        t.geometric_(0.3)
+        vals = t.numpy()
+        assert vals.min() >= 1
+        assert abs(vals.mean() - 1 / 0.3) < 0.4

@@ -162,3 +162,48 @@ def test_layer_and_type_config():
     q = Q.QAT(cfg).quantize(model)
     assert isinstance(q[0], Q.QuantedLinear)
     assert isinstance(q[1], nn.Linear)  # untouched
+
+
+class TestLlmInt8Execution:
+    """llm.int8 must EXECUTE in int8 (int32-accumulated dot), not just
+    store int8 weights (VERDICT round-1 missing item 10)."""
+
+    def _setup(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(0, 0.1, (64, 32)).astype(np.float32)
+        x = rng.normal(0, 1.0, (8, 64)).astype(np.float32)
+        x[:, 5] *= 20  # outlier column exercises the fp side-path
+        return x, w
+
+    def test_matches_fp32_within_quant_error(self):
+        from paddle_tpu.nn.quant import llm_int8_linear, weight_quantize
+        x, w = self._setup()
+        q, s = weight_quantize(paddle.to_tensor(w))
+        y = llm_int8_linear(paddle.to_tensor(x), q, weight_scale=s)
+        ref = x @ w
+        err = np.abs(y.numpy() - ref).max() / np.abs(ref).max()
+        assert err < 0.02, err
+
+    def test_compiled_program_contains_int8_dot(self):
+        from paddle_tpu.nn.quant import llm_int8_linear, weight_quantize
+        x, w = self._setup()
+        q, s = weight_quantize(paddle.to_tensor(w))
+        paddle.set_flags({"FLAGS_to_static_capture_lowered": True})
+        try:
+            f = paddle.jit.to_static(
+                lambda a: llm_int8_linear(a, q, weight_scale=s))
+            f(paddle.to_tensor(x))
+            txt = f.compiled_text()
+        finally:
+            paddle.set_flags({"FLAGS_to_static_capture_lowered": False})
+        assert "s8" in txt and "s32" in txt, (
+            "no int8 operands / int32 accumulation in the compiled program")
+
+    def test_grad_flows_through_weight_only_linear(self):
+        from paddle_tpu.nn.quant import weight_only_linear, weight_quantize
+        x, w = self._setup()
+        q, s = weight_quantize(paddle.to_tensor(w))
+        xt = paddle.to_tensor(x, stop_gradient=False)
+        weight_only_linear(xt, q, weight_scale=s).sum().backward()
+        assert xt.grad is not None
+        assert np.isfinite(xt.grad.numpy()).all()

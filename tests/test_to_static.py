@@ -246,3 +246,31 @@ def test_to_static_dedupes_aliased_state_donation():
     x = paddle.to_tensor(np.ones((2, 4), dtype="float32"))
     out = float(f(x))
     assert np.isfinite(out)
+
+
+def test_full_graph_false_falls_back_to_eager():
+    """SOT parity (upstream python/paddle/jit/sot/): tensor-data-dependent
+    Python control flow breaks the graph; full_graph=False falls back to
+    eager instead of raising."""
+    import warnings
+
+    import paddle_tpu as paddle
+
+    def fn(x):
+        if float(x.sum()) > 0:  # concrete read -> untraceable
+            return x * 2
+        return x - 1
+
+    strict = paddle.jit.to_static(fn, full_graph=True)
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with pytest.raises(Exception):
+        strict(x)
+
+    soft = paddle.jit.to_static(fn, full_graph=False)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = soft(x)
+        out2 = soft(x)  # second call keeps working (no re-warn needed)
+    np.testing.assert_allclose(out.numpy(), np.full((2, 2), 2.0))
+    np.testing.assert_allclose(out2.numpy(), np.full((2, 2), 2.0))
+    assert any("falling back to eager" in str(x.message) for x in w)
