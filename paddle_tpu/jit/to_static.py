@@ -20,6 +20,8 @@ __all__ = ["StaticFunction", "to_static", "not_to_static", "ignore_module",
 
 _ENABLED = True
 
+_FALLBACK = object()  # cache sentinel: this signature graph-breaks to eager
+
 # Objects with lazily-derived state (e.g. optimizer AMP masters) register here;
 # before any (re)trace we give them a chance to reconcile derived state with
 # concrete values — inside the trace the data is symbolic and it's too late.
@@ -169,6 +171,11 @@ class StaticFunction:
             state_items = _state_registry.alive_items()
             key = (treedef, static_key, tuple(rid for rid, _ in state_items))
             entry = self._cache.get(key)
+        if entry is _FALLBACK:
+            # memoized graph break (full_graph=False): skip re-tracing
+            if self._iters > 1:
+                return self._run_iters_eager(args, kwargs)
+            return self._fn(*args, **kwargs)
         if entry is None:
             entry = self._build(treedef, proto, statics,
                                 [t for _, t in state_items])
@@ -189,9 +196,10 @@ class StaticFunction:
                 raise
             # SOT-style graph break (upstream python/paddle/jit/sot/):
             # tracing failed (tensor-dependent Python control flow,
-            # unsupported op) — run eagerly instead. The poisoned cache
-            # entry is dropped so a later fixed call can recompile.
-            self._cache.pop(key, None)
+            # unsupported op) — run eagerly instead, and memoize the break
+            # so later calls skip the (expensive, side-effect-repeating)
+            # re-trace
+            self._cache[key] = _FALLBACK
             if not self._warned_fallback:
                 import warnings
                 warnings.warn(

@@ -85,6 +85,11 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
     import jax.lax as lax
 
     x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if jnp.issubdtype(weight._data.dtype, jnp.floating):
+        # unquantized weights: keep the historical exact-fp behavior
+        # rather than silently truncating fractional values to int8
+        return weight_only_linear(x, weight, bias=bias,
+                                  weight_scale=weight_scale)
     extras = []
     if weight_scale is not None:
         extras.append(ensure_tensor(weight_scale))

@@ -41,6 +41,10 @@ def view(x, shape_or_dtype, name=None):
     dt = _dtype.convert_dtype(shape_or_dtype)
     src_size = x._data.dtype.itemsize
     dst_size = jnp.dtype(dt).itemsize
+    if x._data.ndim == 0 and dst_size != src_size:
+        raise ValueError(
+            "view: dtype reinterpret of a 0-d tensor with a different "
+            "byte width is undefined; reshape to (1,) first")
 
     def f(a):
         # paddle.view(dtype) rescales the LAST dim by the byte-width ratio;
@@ -131,6 +135,13 @@ def reduce_as(x, target, name=None):
     the broadcast-adjoint used by custom grads)."""
     x, target = ensure_tensor(x), ensure_tensor(target)
     tgt_shape = tuple(target._data.shape)
+    x_shape = tuple(x._data.shape)
+    trail = x_shape[len(x_shape) - len(tgt_shape):] if tgt_shape else ()
+    if len(tgt_shape) > len(x_shape) or any(
+            t != s and t != 1 for s, t in zip(trail, tgt_shape)):
+        raise ValueError(
+            f"reduce_as: target shape {tgt_shape} is not broadcast-"
+            f"reducible from input shape {x_shape}")
 
     def f(a, _t):
         extra = a.ndim - len(tgt_shape)

@@ -36,7 +36,8 @@ class LogWriter:
         self.logdir = logdir
         name = file_name or f"vdlrecords.{int(time.time())}.jsonl"
         self._path = os.path.join(logdir, name)
-        self._f = open(self._path, "a", buffering=1)
+        self._f = open(self._path, "a")  # block-buffered; the
+        # flush_secs timer below bounds staleness
         self._lock = threading.Lock()
         self._flush_secs = flush_secs
         self._last_flush = time.monotonic()
