@@ -1,0 +1,194 @@
+"""Async/Geo communicator for sparse-embedding training.
+
+Parity surface: the reference PS ``Communicator``
+(upstream paddle/fluid/distributed/ps/service/communicator/ — a background
+thread that batches gradient "sends" so trainers never block on the table
+update, with ASYNC (apply every batch window) and GEO (apply parameter
+DELTAS every k steps) modes). TPU-native re-scope per the north star
+("PS → ICI allreduce path"): there is no brpc table service — the tables
+are mesh-sharded dense tensors (``ShardedEmbedding``) living on device, and
+the communicator's value is the ASYNCHRONY contract: ``push_sparse`` hands
+a gradient off to a bounded queue and returns immediately; a daemon thread
+applies batched updates to the table; ``pull_sparse``/``barrier`` give the
+read-your-writes points. GEO mode accumulates k pushes and applies their
+SUM once — the same staleness/traffic trade the reference's
+GeoCommunicator makes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Communicator", "register_sparse_table", "registered_tables"]
+
+# name -> weakly-held table tensor; ShardedEmbedding self-registers here so
+# fleet.init_worker can hand the worker's sparse tables to the Communicator
+# without a manual init_with_ctx call
+import weakref
+
+_TABLE_REGISTRY: Dict[str, "weakref.ref"] = {}
+
+
+def register_sparse_table(name: str, table: Tensor) -> None:
+    _TABLE_REGISTRY[name] = weakref.ref(table)
+
+
+def registered_tables() -> Dict[str, Tensor]:
+    out = {}
+    for name, ref in list(_TABLE_REGISTRY.items()):
+        t = ref()
+        if t is None:
+            del _TABLE_REGISTRY[name]
+        else:
+            out[name] = t
+    return out
+
+
+class Communicator:
+    """``Communicator(mode="async"|"geo"|"sync")`` over sharded tables.
+
+    mode="sync"  — push applies inline (exact SGD; the default data path).
+    mode="async" — pushes enqueue; a daemon thread applies them in arrival
+                   order. Bounded queue gives backpressure instead of
+                   unbounded staleness.
+    mode="geo"   — pushes accumulate; every ``geo_k`` pushes the summed
+                   update applies once.
+    """
+
+    def __init__(self, mode: str = "async", send_queue_size: int = 32,
+                 geo_k: int = 8, lr: float = 0.01):
+        mode = mode.lower()
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"unknown communicator mode {mode!r}")
+        self.mode = mode
+        self.lr = float(lr)
+        self.geo_k = int(geo_k)
+        self._tables: Dict[str, Tensor] = {}
+        self._queue: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
+        self._accum: Dict[str, List] = {}
+        self._accum_count = 0
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._drained = threading.Condition(self._lock)
+        self._error: Optional[BaseException] = None
+
+    # -- lifecycle (reference: Communicator::Start/Stop) ---------------------
+    def init_with_ctx(self, tables: Dict[str, Tensor]) -> None:
+        """Register the named tables (sharded embedding weights)."""
+        self._tables.update(tables)
+
+    def start(self) -> None:
+        if self.mode != "async" or self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._running = False
+            self._queue.put(None)
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def is_running(self) -> bool:
+        return self._running
+
+    # -- data path -----------------------------------------------------------
+    def push_sparse(self, table_name: str, ids, grad) -> None:
+        """Hand a (ids, grad_rows) update to the table. async: returns
+        immediately; geo: accumulates; sync: applies inline."""
+        if table_name not in self._tables:
+            raise KeyError(f"unknown table {table_name!r}")
+        ids_a = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        g_a = grad._data if isinstance(grad, Tensor) else jnp.asarray(grad)
+        if self.mode == "sync":
+            self._apply(table_name, ids_a, g_a)
+            return
+        if self.mode == "geo":
+            self._accum.setdefault(table_name, []).append((ids_a, g_a))
+            self._accum_count += 1
+            if self._accum_count >= self.geo_k:
+                self._flush_geo()
+            return
+        if self._error is not None:
+            raise RuntimeError(
+                "communicator applier died") from self._error
+        if self._thread is None:
+            raise RuntimeError(
+                "async Communicator not started; call start() first")
+        with self._lock:
+            self._pending += 1
+        self._queue.put((table_name, ids_a, g_a))
+
+    def pull_sparse(self, table_name: str, ids) -> Tensor:
+        """Read rows. async: drains pending pushes first so a worker reads
+        its own writes (reference: pull blocks on the send queue). geo:
+        reads STALE params without flushing the accumulation window — the
+        k-step batching is the mode's point (reference GeoCommunicator)."""
+        if self.mode == "async":
+            self.barrier()
+        table = self._tables[table_name]
+        ids_a = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        return Tensor(table._data[ids_a], stop_gradient=True)
+
+    def barrier(self) -> None:
+        """Wait until every queued push has been applied."""
+        if self.mode == "geo":
+            self._flush_geo()
+            return
+        if self.mode != "async":
+            return
+        with self._drained:
+            while self._pending > 0:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "communicator applier died") from self._error
+                if self._thread is None or not self._thread.is_alive():
+                    raise RuntimeError(
+                        "communicator applier is not running with "
+                        f"{self._pending} updates pending")
+                self._drained.wait(timeout=0.1)
+        if self._error is not None:
+            raise RuntimeError(
+                "communicator applier died") from self._error
+
+    # -- internals -----------------------------------------------------------
+    def _apply(self, name: str, ids, grad) -> None:
+        t = self._tables[name]
+        # scatter-subtract; duplicate ids accumulate (segment-sum semantics,
+        # the reference accessor's SGD rule)
+        t._set_data(t._data.at[ids].add(-self.lr * grad))
+
+    def _flush_geo(self) -> None:
+        accum, self._accum = self._accum, {}
+        self._accum_count = 0
+        for name, items in accum.items():
+            for ids, g in items:
+                self._apply(name, ids, g)
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                break
+            name, ids, g = item
+            try:
+                self._apply(name, ids, g)
+            except BaseException as e:  # record; surface at barrier/push
+                self._error = e
+                with self._drained:
+                    self._pending -= 1
+                    self._drained.notify_all()
+                return
+            with self._drained:
+                self._pending -= 1
+                self._drained.notify_all()

@@ -35,6 +35,7 @@ __all__ = [
     "is_server", "is_worker", "is_first_worker", "worker_index", "worker_num",
     "server_num", "worker_endpoints", "server_endpoints", "init_server",
     "run_server", "init_worker", "stop_worker", "barrier_worker",
+    "get_communicator",
 ]
 
 _fleet_initialized = False
@@ -186,13 +187,43 @@ def run_server() -> None:
 
 
 def init_worker(scopes=None) -> None:
-    """Reference: creates the brpc client + pulls dense params. ICI path:
-    tables are already mesh-resident; nothing to pull."""
+    """Reference: creates the brpc client + pulls dense params and starts
+    the async Communicator. ICI path: tables are mesh-resident; when the
+    strategy asks for a_sync, a ``distributed.communicator.Communicator``
+    starts so ``push_sparse`` hands updates to a background applier
+    (upstream Communicator::Start)."""
+    global _communicator
     _rm()  # assert PS mode
+    st = get_strategy()
+    if st is not None and getattr(st, "a_sync", False):
+        if _communicator is not None:  # re-init (elastic restart): replace
+            _communicator.stop()
+        from ..communicator import Communicator, registered_tables
+        cfg = getattr(st, "a_sync_configs", {}) or {}
+        mode = "geo" if int(cfg.get("k_steps", 0) or 0) > 0 else "async"
+        _communicator = Communicator(
+            mode=mode, geo_k=int(cfg.get("k_steps", 0) or 8),
+            send_queue_size=int(cfg.get("send_queue_size", 32) or 32))
+        # every live ShardedEmbedding table is a push/pull target
+        _communicator.init_with_ctx(registered_tables())
+        _communicator.start()
+
+
+_communicator = None
+
+
+def get_communicator():
+    """The worker's active async Communicator (None in sync mode)."""
+    return _communicator
 
 
 def stop_worker() -> None:
     """Signal every server's KV plane that this worker is done."""
+    global _communicator
+    if _communicator is not None:
+        _communicator.barrier()
+        _communicator.stop()
+        _communicator = None
     from ..store import TCPStore
     rm = _rm()
     for ep in rm.get_pserver_endpoints():

@@ -39,6 +39,9 @@ class ShardedEmbedding(Layer):
             self.weight._set_data(jax.device_put(
                 self.weight._data, NamedSharding(mesh, P(ax, None))))
             self.weight.is_distributed = True
+        # make the table reachable by the PS-mode async Communicator
+        from .communicator import register_sparse_table
+        register_sparse_table(name or self.weight.name, self.weight)
 
     @staticmethod
     def _resolve_axis(axis):
