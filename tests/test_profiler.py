@@ -121,3 +121,60 @@ def test_record_event_explicit_begin_end():
     ev.end()  # double-end is a no-op
     p.stop()
     assert any(e["name"] == "manual" for e in p.events())
+
+
+@pytest.mark.tpu
+@pytest.mark.slow
+def test_memory_stats_on_real_chip():
+    """Round-1 gap: the PJRT memory-stats parity surface was never verified
+    against real HBM. Allocate a known-size buffer on the chip and check
+    the counters move accordingly."""
+    import subprocess
+    import sys
+
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        capture_output=True, text=True, timeout=120,
+        env={k: v for k, v in os.environ.items()
+             if k != "JAX_PLATFORMS" or v != "cpu"})
+    if "tpu" not in probe.stdout.lower():
+        pytest.skip("no TPU attached")
+
+    code = r"""
+import numpy as np
+import paddle_tpu as paddle
+import jax, jax.numpy as jnp
+
+if jax.devices()[0].memory_stats() is None:
+    # relay-attached PJRT clients may not forward allocator stats
+    print("MEMSTATS_UNAVAILABLE")
+    raise SystemExit(0)
+base = paddle.device.memory_allocated()
+big = jax.device_put(jnp.zeros((64, 1024, 1024), jnp.float32))  # 256MB
+jax.block_until_ready(big)
+after = paddle.device.memory_allocated()
+peak = paddle.device.max_memory_allocated()
+grew = after - base
+assert grew >= 200 * 1024 * 1024, (base, after)
+assert peak >= after, (peak, after)
+del big
+print("MEMSTATS_OK", grew)
+"""
+    env = dict(os.environ)
+    env.pop("PADDLE_PLATFORM", None)
+    if env.get("JAX_PLATFORMS") == "cpu":
+        del env["JAX_PLATFORMS"]
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=300, env=env,
+                         cwd=os.path.dirname(os.path.dirname(
+                             os.path.abspath(__file__))))
+    assert out.returncode == 0, f"{out.stdout}\n{out.stderr}"
+    if "MEMSTATS_UNAVAILABLE" in out.stdout:
+        pytest.skip("attached PJRT client does not forward memory stats "
+                    "(relay tunnel limitation); parity surface covered on "
+                    "directly-attached chips")
+    assert "MEMSTATS_OK" in out.stdout
