@@ -47,3 +47,39 @@ def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
 
     return apply("matrix_norm", f, x)
 from .ops.math_ext2 import matrix_transpose, svdvals  # noqa: F401,E402
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: paddle.linalg.svd_lowrank,
+    Halko et al. 2011): returns (U, S, V) with q columns via subspace
+    iteration — q matmuls instead of a full decomposition."""
+    from .core.random import default_generator
+    from .core.tensor import apply, Tensor
+    import jax
+    import jax.numpy as jnp
+
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    key = default_generator.split_key()
+    m, n = int(x._data.shape[-2]), int(x._data.shape[-1])
+    q_eff = min(int(q), m, n)
+    if M is not None and not isinstance(M, Tensor):
+        M = Tensor(jnp.asarray(M))
+    extras = [M] if M is not None else []
+
+    def f(a, *rest):
+        a32 = a.astype(jnp.float32)
+        if rest:
+            a32 = a32 - rest[0].astype(jnp.float32)
+        omega = jax.random.normal(key, a32.shape[:-2] + (n, q_eff),
+                                  jnp.float32)
+        y = a32 @ omega
+        for _ in range(int(niter)):
+            y = a32 @ (a32.swapaxes(-1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.swapaxes(-1, -2) @ a32
+        ub, s_, vt = jnp.linalg.svd(b, full_matrices=False)
+        u = qmat @ ub
+        return u.astype(a.dtype), s_.astype(a.dtype), \
+            vt.swapaxes(-1, -2).astype(a.dtype)
+
+    return apply("svd_lowrank", f, x, *extras)

@@ -32,7 +32,8 @@ from ..ops.manipulation import pad  # noqa: F401
 from ..ops.indexing import one_hot  # noqa: F401
 from ..ops.flash_attention import flash_attention  # noqa: F401
 from ..ops.nn_ext import (  # noqa: F401
-    affine_grid, grid_sample, max_unpool2d, rrelu, temporal_shift,
+    affine_grid, grid_sample, max_unpool1d, max_unpool2d, max_unpool3d,
+    fractional_max_pool2d, fractional_max_pool3d, rrelu, temporal_shift,
     soft_margin_loss, multi_margin_loss, npair_loss, poisson_nll_loss,
     gaussian_nll_loss, margin_cross_entropy, ctc_loss, rnnt_loss,
     adaptive_log_softmax_with_loss, class_center_sample, sparse_attention,

@@ -165,3 +165,35 @@ register_op("igammac", igammac, methods=("igammac",))
 register_op("log_normal", log_normal)
 register_op("sinc", sinc, methods=("sinc",))
 register_op("reduce_as", reduce_as)
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors (paddle.cartesian_prod)."""
+    xs = [ensure_tensor(t) for t in x]
+
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply("cartesian_prod", f, *xs)
+
+
+def numel(x, name=None):
+    """Element count as a 0-d int64 tensor (paddle.numel)."""
+    x = ensure_tensor(x)
+    n = 1
+    for s_ in x._data.shape:
+        n *= int(s_)
+
+    from ..core import dtype as _dtype
+
+    def f(_a):
+        # int64 when x64 is enabled, canonical int otherwise (no per-call
+        # truncation warning)
+        return jnp.asarray(n, _dtype.canonicalize(jnp.int64))
+
+    return apply("numel", f, x, differentiable=False)
+
+
+register_op("cartesian_prod", cartesian_prod)
+register_op("numel", numel)

@@ -770,3 +770,178 @@ register_op("zeropad2d", zeropad2d)
 register_op("embedding_bag", embedding_bag)
 register_op("pairwise_distance", pairwise_distance)
 register_op("linear_compress", linear_compress)
+
+
+def _unpool_scatter(op_name, x, indices, out_spatial):
+    """Shared N-D unpool kernel: scatter (N, C, *spatial) values to flat
+    positions ``indices`` of an (N, C, prod(out_spatial)) zero canvas."""
+    import math as _math
+
+    total = int(_math.prod(out_spatial))
+
+    def f(a, idx):
+        flat_val = a.reshape(a.shape[0], a.shape[1], -1)
+        flat_idx = idx.reshape(idx.shape[0], idx.shape[1], -1)
+        zeros = jnp.zeros((a.shape[0], a.shape[1], total), a.dtype)
+        out = jax.vmap(jax.vmap(lambda z, i, v: z.at[i].set(v)))(
+            zeros, flat_idx, flat_val)
+        return out.reshape(a.shape[:2] + tuple(out_spatial))
+
+    return apply(op_name, f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    """1-D unpool: scatter pooled values to their argmax positions
+    (reference: paddle.nn.functional.max_unpool1d)."""
+    x, indices = ensure_tensor(x), ensure_tensor(indices)
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = (stride if stride is not None else k)
+    s = s if isinstance(s, int) else s[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    n_, c_, lo = (int(d) for d in x._data.shape)
+    length = (lo - 1) * s - 2 * p + k if output_size is None \
+        else int(output_size[-1])
+    return _unpool_scatter("max_unpool1d", x, indices, (length,))
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    """3-D unpool (reference: paddle.nn.functional.max_unpool3d)."""
+    x, indices = ensure_tensor(x), ensure_tensor(indices)
+    kd, kh, kw = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+                  else tuple(kernel_size))
+    st = stride if stride is not None else (kd, kh, kw)
+    sd, sh, sw = (st,) * 3 if isinstance(st, int) else tuple(st)
+    pd, ph, pw = ((padding,) * 3 if isinstance(padding, int)
+                  else tuple(padding))
+    n_, c_, do, ho, wo = (int(d) for d in x._data.shape)
+    if output_size is None:
+        d = (do - 1) * sd - 2 * pd + kd
+        h = (ho - 1) * sh - 2 * ph + kh
+        w = (wo - 1) * sw - 2 * pw + kw
+    else:
+        d, h, w = (int(v) for v in output_size[-3:])
+    return _unpool_scatter("max_unpool3d", x, indices, (d, h, w))
+
+
+def _fractional_bounds(inp, out, u):
+    """Pseudo-random increasing region boundaries (Graham 2014 alpha
+    sequence: ceil(alpha*(i+u)) - ceil(alpha*u))."""
+    import numpy as _np
+
+    alpha = inp / out
+    base = _np.ceil(alpha * (_np.arange(out + 1) + u)) - _np.ceil(alpha * u)
+    base = _np.clip(base, 0, inp).astype(_np.int32)
+    base[-1] = inp
+    return base
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """Fractional max pooling (Graham 2014; reference:
+    paddle.nn.functional.fractional_max_pool2d): pseudo-random pooling
+    regions whose sizes average H/out_h. The region boundaries follow the
+    reference's alpha-sequence construction from a single random u."""
+    x = ensure_tensor(x)
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool2d: only the disjoint (kernel_size=None) "
+            "mode is implemented; fixed-size overlapping windows are not")
+    n_, c_, h, w = (int(d) for d in x._data.shape)
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    if random_u is None:
+        from ..core.random import default_generator
+        key = default_generator.split_key()
+        u = float(jax.random.uniform(key, (), jnp.float32, 0.05, 0.95))
+    else:
+        u = float(random_u)
+
+    hb, wb = _fractional_bounds(h, oh, u), _fractional_bounds(w, ow, u)
+
+    def f(a):
+        rows = []
+        for i in range(oh):
+            cols = []
+            for j in range(ow):
+                region = a[:, :, hb[i]:max(hb[i + 1], hb[i] + 1),
+                           wb[j]:max(wb[j + 1], wb[j] + 1)]
+                cols.append(jnp.max(region, axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    out = apply("fractional_max_pool2d", f, x)
+    if return_mask:
+        # reference returns flat argmax indices into the input plane
+        def fm(a):
+            rows = []
+            for i in range(oh):
+                cols = []
+                for j in range(ow):
+                    h0, h1 = hb[i], max(hb[i + 1], hb[i] + 1)
+                    w0, w1 = wb[j], max(wb[j + 1], wb[j] + 1)
+                    region = a[:, :, h0:h1, w0:w1]
+                    flat = region.reshape(region.shape[0], region.shape[1], -1)
+                    am = jnp.argmax(flat, axis=-1)
+                    rw = w1 - w0
+                    cols.append((h0 + am // rw) * w + (w0 + am % rw))
+                rows.append(jnp.stack(cols, axis=-1))
+            return jnp.stack(rows, axis=-2).astype(jnp.int32)
+
+        mask = apply("fractional_max_pool2d_mask", fm, x,
+                     differentiable=False)
+        return out, mask
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """3-D fractional max pooling (reference:
+    paddle.nn.functional.fractional_max_pool3d)."""
+    x = ensure_tensor(x)
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool3d: only the disjoint (kernel_size=None) "
+            "mode is implemented; fixed-size overlapping windows are not")
+    n_, c_, d, h, w = (int(v) for v in x._data.shape)
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else tuple(output_size))
+    if random_u is None:
+        from ..core.random import default_generator
+        key = default_generator.split_key()
+        u = float(jax.random.uniform(key, (), jnp.float32, 0.05, 0.95))
+    else:
+        u = float(random_u)
+
+    db = _fractional_bounds(d, od, u)
+    hb = _fractional_bounds(h, oh, u)
+    wb = _fractional_bounds(w, ow, u)
+
+    def f(a):
+        planes = []
+        for q in range(od):
+            rows = []
+            for i in range(oh):
+                cols = []
+                for j in range(ow):
+                    region = a[:, :,
+                               db[q]:max(db[q + 1], db[q] + 1),
+                               hb[i]:max(hb[i + 1], hb[i] + 1),
+                               wb[j]:max(wb[j + 1], wb[j] + 1)]
+                    cols.append(jnp.max(region, axis=(2, 3, 4)))
+                rows.append(jnp.stack(cols, axis=-1))
+            planes.append(jnp.stack(rows, axis=-2))
+        return jnp.stack(planes, axis=-3)
+
+    out = apply("fractional_max_pool3d", f, x)
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True) is not implemented; "
+            "use fractional_max_pool2d or max_pool3d masks")
+    return out
+
+
+for _n in ("max_unpool1d", "max_unpool3d", "fractional_max_pool2d",
+           "fractional_max_pool3d"):
+    register_op(_n, globals()[_n])

@@ -181,7 +181,7 @@ def cummin(x, axis=None, dtype="int64", name=None):
     return out, idx
 
 
-register_op("cumsum", cumsum, methods=("cumsum",))
+register_op("cumsum", cumsum, methods=("cumsum",), inplace_method="cumsum_")
 register_op("cumprod", cumprod, methods=("cumprod",))
 register_op("cummax", cummax, methods=("cummax",))
 register_op("cummin", cummin, methods=("cummin",))

@@ -466,3 +466,63 @@ class TestWave4Ops:
         vals = t.numpy()
         assert vals.min() >= 1
         assert abs(vals.mean() - 1 / 0.3) < 0.4
+
+
+class TestWave5Ops:
+    def test_max_unpool_1d_3d_roundtrip(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(
+            np.arange(16, dtype="float32").reshape(1, 1, 16))
+        pooled, idx = F.max_pool1d(x, kernel_size=2, stride=2,
+                                   return_mask=True)
+        restored = F.max_unpool1d(pooled, idx, kernel_size=2)
+        dense = np.zeros(16, "float32")
+        dense[1::2] = np.arange(16, dtype="float32")[1::2]
+        np.testing.assert_allclose(restored.numpy().ravel(), dense)
+
+        # 3-D: hand-built indices (max_pool3d has no mask mode): place the
+        # pooled values at known flat positions of the 4x4x4 output
+        vals = np.array([[[ [[10., 20.], [30., 40.]],
+                            [[50., 60.], [70., 80.]] ]]], "float32")
+        idx = np.array([[[ [[21, 23], [29, 31]],
+                           [[53, 55], [61, 63]] ]]], "int32")
+        r3 = F.max_unpool3d(paddle.to_tensor(vals), paddle.to_tensor(idx),
+                            kernel_size=2)
+        assert r3.shape == [1, 1, 4, 4, 4]
+        flat = r3.numpy().ravel()
+        np.testing.assert_allclose(flat[idx.ravel()], vals.ravel())
+        assert flat.sum() == vals.sum()
+
+    def test_fractional_max_pool2d(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(
+            np.arange(49, dtype="float32").reshape(1, 1, 7, 7))
+        out, mask = F.fractional_max_pool2d(x, output_size=3,
+                                            random_u=0.3, return_mask=True)
+        assert out.shape == [1, 1, 3, 3]
+        # regions tile the input: global max must survive
+        assert float(out.numpy().max()) == 48.0
+        flat = x.numpy().ravel()
+        np.testing.assert_allclose(
+            np.take(flat, mask.numpy().ravel()), out.numpy().ravel())
+
+    def test_cartesian_prod_numel_cumsum_(self):
+        a = paddle.to_tensor(np.array([1, 2], "int32"))
+        b = paddle.to_tensor(np.array([3, 4, 5], "int32"))
+        cp = paddle.cartesian_prod([a, b]).numpy()
+        assert cp.shape == (6, 2)
+        assert (cp[0] == [1, 3]).all() and (cp[-1] == [2, 5]).all()
+        assert int(paddle.numel(paddle.to_tensor(np.zeros((3, 4))))) == 12
+        t = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+        t.cumsum_()
+        np.testing.assert_allclose(t.numpy(), [1.0, 3.0, 6.0])
+
+    def test_svd_lowrank(self):
+        from paddle_tpu import linalg
+        rng = np.random.default_rng(0)
+        # a genuinely low-rank matrix is recovered to tolerance
+        A = (rng.normal(0, 1, (20, 4)) @ rng.normal(0, 1, (4, 15))
+             ).astype("float32")
+        u, s_, v = linalg.svd_lowrank(paddle.to_tensor(A), q=6)
+        rec = u.numpy() @ np.diag(s_.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(rec, A, atol=1e-3)
