@@ -35,7 +35,7 @@ def main() -> None:
                           intermediate_size=2816, num_hidden_layers=8,
                           num_attention_heads=16, num_key_value_heads=16,
                           max_position_embeddings=2048)
-        batch, seq, steps, scan_k = 16, 1024, 20, 4
+        batch, seq, steps, scan_k = 24, 1024, 20, 4
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:  # CPU smoke config so the bench always runs
         cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=4,
