@@ -10,10 +10,12 @@ from .common import (  # noqa: F401
     Pad3D, CosineSimilarity, PixelShuffle, PixelUnshuffle,
     ChannelShuffle, Unfold, Fold,
     Unflatten, FeatureAlphaDropout, PairwiseDistance, Bilinear, RReLU,
-    MaxUnPool1D, MaxUnPool2D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    FractionalMaxPool2D, FractionalMaxPool3D,
     ZeroPad1D, ZeroPad2D, ZeroPad3D, EmbeddingBag,
 )
-from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose  # noqa: F401
+from .conv import (Conv1D, Conv2D, Conv3D, Conv2DTranspose,  # noqa: F401
+                   Conv1DTranspose, Conv3DTranspose)
 from .norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
     GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, RMSNorm,
@@ -21,12 +23,14 @@ from .norm import (  # noqa: F401
 )
 from .pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
-    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
 )
 from .activation import (  # noqa: F401
     ReLU, ReLU6, GELU, Sigmoid, Tanh, Softmax, LogSoftmax, LeakyReLU, ELU, SELU,
     CELU, SiLU, Swish, Mish, Hardswish, Hardsigmoid, Hardtanh, Hardshrink,
-    Softshrink, Softplus, Softsign, Tanhshrink, ThresholdedReLU, LogSigmoid,
+    Softshrink, Softplus, Softsign, Silu, Softmax2D, Tanhshrink,
+    ThresholdedReLU, LogSigmoid,
     Maxout, PReLU, GLU,
 )
 from .container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401

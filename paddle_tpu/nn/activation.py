@@ -12,6 +12,7 @@ __all__ = [
     "Hardsigmoid", "Hardtanh", "Hardshrink", "Softshrink", "Softplus",
     "Softsign", "Tanhshrink", "ThresholdedReLU", "LogSigmoid", "Maxout", "PReLU",
     "GLU",
+    "Silu", "Softmax2D",
 ]
 
 
@@ -180,3 +181,24 @@ class PReLU(Layer):
 
     def forward(self, x):
         return F.prelu(x, self.weight, self.data_format)
+
+
+class Silu(Layer):
+    """paddle.nn.Silu (alias of the silu/swish activation)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    """Softmax over CHW per spatial location (paddle.nn.Softmax2D):
+    normalizes across channels for NCHW inputs."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

@@ -18,6 +18,7 @@ __all__ = [
     "Flatten", "Identity", "Upsample", "UpsamplingBilinear2D", "UpsamplingNearest2D",
     "Pad1D", "Pad2D", "Pad3D", "CosineSimilarity", "PixelShuffle",
     "PixelUnshuffle", "ChannelShuffle", "Unfold", "Fold",
+    "MaxUnPool3D", "FractionalMaxPool2D", "FractionalMaxPool3D",
 ]
 
 
@@ -439,3 +440,45 @@ class EmbeddingBag(Layer):
     def forward(self, input, offsets=None):
         return F.embedding_bag(input, self.weight, offsets=offsets,
                                mode=self.mode)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)

@@ -8,7 +8,9 @@ from . import functional as F
 from .initializer import KaimingUniform
 from .layer import Layer
 
-__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "Conv1DTranspose"]
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "Conv1DTranspose",
+    "Conv3DTranspose",
+]
 
 
 def _ntuple(v, n):
@@ -121,3 +123,31 @@ class Conv1DTranspose(Layer):
     def forward(self, x):
         from ..ops.manipulation import squeeze, unsqueeze
         return squeeze(self.conv2dt(unsqueeze(x, 2)), 2)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self.kernel_size = _ntuple(kernel_size, 3)
+        self.stride = stride
+        self.padding = padding
+        self.output_padding = output_padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
+        fan_in = in_channels * int(np.prod(self.kernel_size))
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + self.kernel_size,
+            attr=weight_attr, default_initializer=KaimingUniform(fan_in=fan_in))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.groups, self.dilation,
+                                  self.data_format, output_size)

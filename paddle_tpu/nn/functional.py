@@ -19,7 +19,9 @@ from ..ops.nn_ops import (  # noqa: F401
 from ..ops.conv_pool import (  # noqa: F401
     conv1d, conv2d, conv3d, conv2d_transpose, max_pool1d, max_pool2d,
     max_pool3d, avg_pool1d, avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
-    adaptive_avg_pool2d, adaptive_max_pool2d, interpolate, upsample,
+    adaptive_avg_pool2d, adaptive_avg_pool3d, adaptive_max_pool1d,
+    adaptive_max_pool2d, adaptive_max_pool3d, conv3d_transpose, interpolate,
+    upsample,
     pixel_shuffle, pixel_unshuffle, channel_shuffle, fold, unfold,
 )
 from ..ops.loss_ops import (  # noqa: F401

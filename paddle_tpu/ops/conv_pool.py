@@ -420,3 +420,115 @@ for _n in ("conv1d", "conv2d", "conv3d", "conv2d_transpose", "max_pool1d",
            "interpolate", "upsample", "pixel_shuffle", "unfold",
            "pixel_unshuffle", "channel_shuffle", "fold"):
     register_op(_n, globals()[_n])
+
+
+def _adaptive_pool_exact(op_name, x, out_sizes, mode):
+    """Exact adaptive pooling over the trailing spatial dims of an NC...
+    tensor: bin i spans [floor(i*L/out), ceil((i+1)*L/out)) — the reference
+    semantics for ANY input size (divisible inputs reduce to equal
+    windows). Output sizes are small constants, so the per-bin Python loop
+    unrolls into a static program."""
+    import math as _math
+
+    x = ensure_tensor(x)
+    spatial = len(out_sizes)
+    in_sizes = tuple(int(d) for d in x._data.shape[2:2 + spatial])
+
+    def bins(L, out):
+        return [(int(_math.floor(i * L / out)),
+                 max(int(_math.ceil((i + 1) * L / out)),
+                     int(_math.floor(i * L / out)) + 1))
+                for i in range(out)]
+
+    all_bins = [bins(L, o) for L, o in zip(in_sizes, out_sizes)]
+    red = jnp.max if mode == "max" else jnp.mean
+    axes = tuple(range(2, 2 + spatial))
+
+    def f(a):
+        def build(dim, index):
+            if dim == spatial:
+                sl = (slice(None), slice(None)) + tuple(
+                    slice(lo, hi) for lo, hi in index)
+                return red(a[sl], axis=axes)
+            # each child is (N, C, out_{dim+1}, ...): stacking at axis=2
+            # prepends this dim's bins in the right position
+            return jnp.stack([build(dim + 1, index + [b])
+                              for b in all_bins[dim]], axis=2)
+        return build(0, [])
+
+    return apply(op_name, f, x)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """(reference: paddle.nn.functional.adaptive_avg_pool3d)"""
+    if data_format != "NCDHW":
+        raise NotImplementedError(
+            "adaptive_avg_pool3d supports NCDHW only")
+    return _adaptive_pool_exact("adaptive_avg_pool3d", x,
+                                _pair(output_size, 3), "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    """(reference: paddle.nn.functional.adaptive_max_pool1d)"""
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d(return_mask=True) is not implemented")
+    o = int(output_size) if not isinstance(output_size, (list, tuple)) \
+        else int(output_size[0])
+    return _adaptive_pool_exact("adaptive_max_pool1d", x, (o,), "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    """(reference: paddle.nn.functional.adaptive_max_pool3d)"""
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True) is not implemented")
+    return _adaptive_pool_exact("adaptive_max_pool3d", x,
+                                _pair(output_size, 3), "max")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    """3-D transposed convolution (reference:
+    paddle.nn.functional.conv3d_transpose): gradient-of-conv as an
+    lhs-dilated conv with the flipped kernel (same formulation as the 2-D
+    op; paddle output size (i-1)*s - 2p + dil*(k-1) + 1 + opad)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    spatial = 3
+    strides = _pair(stride, spatial)
+    dil = _pair(dilation, spatial)
+    pads = _conv_padding(padding, spatial, strides, None, dil)
+    opad = _pair(output_padding, spatial)
+    extras = [ensure_tensor(bias)] if bias is not None else []
+
+    def f(a, w, *rest):
+        wt = jnp.swapaxes(w, 0, 1)  # (in, out/g, kD,kH,kW) -> OIDHW
+        if groups > 1:
+            ic = w.shape[0]
+            oc_g = w.shape[1]
+            wg = w.reshape(groups, ic // groups, oc_g, *w.shape[2:])
+            wt = jnp.concatenate([jnp.swapaxes(g, 0, 1) for g in wg], axis=0)
+        wt = jnp.flip(wt, axis=(-1, -2, -3))
+        if isinstance(pads, str):
+            pad_cfg = pads
+        else:
+            pad_cfg = [
+                (dil[i] * (w.shape[2 + i] - 1) - pads[i][0],
+                 dil[i] * (w.shape[2 + i] - 1) - pads[i][1] + opad[i])
+                for i in range(spatial)
+            ]
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1, 1, 1), padding=pad_cfg,
+            lhs_dilation=strides, rhs_dilation=dil,
+            feature_group_count=groups)
+        if rest:
+            out = out + rest[0].reshape(1, -1, 1, 1, 1)
+        return out
+
+    return apply("conv3d_transpose", f, x, weight, *extras)
+
+
+for _n in ("adaptive_avg_pool3d", "adaptive_max_pool1d",
+           "adaptive_max_pool3d", "conv3d_transpose"):
+    register_op(_n, globals()[_n])

@@ -336,3 +336,65 @@ class TestReviewFixes2:
         with pytest.raises(NotImplementedError):
             F.max_pool2d(paddle.zeros([1, 1, 4, 4]), 2, padding="SAME",
                          return_mask=True)
+
+
+class TestWave6Layers:
+    def test_adaptive_pools_3d_1d(self):
+        x = paddle.to_tensor(np.random.rand(1, 2, 8, 8, 8).astype("float32"))
+        assert paddle.nn.AdaptiveAvgPool3D(2)(x).shape == [1, 2, 2, 2, 2]
+        assert paddle.nn.AdaptiveMaxPool3D(4)(x).shape == [1, 2, 4, 4, 4]
+        x1 = paddle.to_tensor(np.random.rand(1, 2, 12).astype("float32"))
+        out = paddle.nn.AdaptiveMaxPool1D(3)(x1)
+        np.testing.assert_allclose(
+            out.numpy(),
+            np.asarray(x1.numpy()).reshape(1, 2, 3, 4).max(-1))
+
+    def test_conv3d_transpose_matches_torch(self):
+        import torch
+        paddle.seed(0)
+        ct = paddle.nn.Conv3DTranspose(2, 3, 3, stride=2, padding=1)
+        x_np = np.random.rand(1, 2, 5, 5, 5).astype("float32")
+        y = ct(paddle.to_tensor(x_np))
+        ref = torch.nn.functional.conv_transpose3d(
+            torch.tensor(x_np), torch.tensor(np.asarray(ct.weight._data)),
+            torch.tensor(np.asarray(ct.bias._data)), stride=2, padding=1)
+        np.testing.assert_allclose(y.numpy(), ref.numpy(), atol=1e-5)
+        y.sum().backward()
+        assert ct.weight.grad is not None
+
+    def test_silu_softmax2d(self):
+        x = paddle.to_tensor(np.random.rand(1, 3, 4, 4).astype("float32"))
+        s2 = paddle.nn.Softmax2D()(x)
+        np.testing.assert_allclose(s2.numpy().sum(axis=1),
+                                   np.ones((1, 4, 4)), rtol=1e-5)
+        x1 = paddle.to_tensor(np.array([-1.0, 0.0, 2.0], "float32"))
+        np.testing.assert_allclose(
+            paddle.nn.Silu()(x1).numpy(),
+            x1.numpy() / (1 + np.exp(-x1.numpy())), rtol=1e-5)
+
+    def test_max_unpool3d_layer(self):
+        vals = paddle.to_tensor(np.array(
+            [[[[[5.0]]]]], "float32"))
+        idx = paddle.to_tensor(np.array([[[[[7]]]]], "int32"))
+        out = paddle.nn.MaxUnPool3D(kernel_size=2)(vals, idx)
+        flat = out.numpy().ravel()
+        assert flat[7] == 5.0 and flat.sum() == 5.0
+
+    def test_adaptive_pools_non_divisible_match_torch(self):
+        import torch
+        x = np.random.rand(1, 2, 11).astype("float32")
+        np.testing.assert_allclose(
+            paddle.nn.functional.adaptive_max_pool1d(
+                paddle.to_tensor(x), 4).numpy(),
+            torch.nn.functional.adaptive_max_pool1d(
+                torch.tensor(x), 4).numpy())
+        x3 = np.random.rand(1, 2, 7, 9, 5).astype("float32")
+        np.testing.assert_allclose(
+            paddle.nn.functional.adaptive_avg_pool3d(
+                paddle.to_tensor(x3), (3, 4, 2)).numpy(),
+            torch.nn.functional.adaptive_avg_pool3d(
+                torch.tensor(x3), (3, 4, 2)).numpy(), rtol=1e-5)
+        import pytest as _pytest
+        with _pytest.raises(NotImplementedError):
+            paddle.nn.functional.adaptive_max_pool1d(
+                paddle.to_tensor(x), 4, return_mask=True)
