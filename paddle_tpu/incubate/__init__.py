@@ -14,7 +14,8 @@ from . import checkpoint  # noqa: F401
 from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
 from ..geometric import segment_sum, segment_mean, segment_max, segment_min  # noqa: F401
 from .moe import MoELayer  # noqa: F401
-from .nn_functional import softmax_mask_fuse  # noqa: F401
+from .nn_functional import (softmax_mask_fuse,  # noqa: F401
+                            softmax_mask_fuse_upper_triangle, identity_loss)
 
 
 class distributed:  # namespace parity: paddle.incubate.distributed.models.moe

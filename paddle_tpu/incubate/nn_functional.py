@@ -406,3 +406,47 @@ def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
     if bias is not None:
         return apply("fused_matmul_bias", f, x, y, ensure_tensor(bias))
     return apply("fused_matmul_bias", f, x, y)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax fused (reference:
+    incubate.softmax_mask_fuse_upper_triangle): softmax over the last dim
+    with strictly-upper-triangle positions masked to -inf. XLA fuses the
+    mask + softmax into one kernel."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import apply
+    from ..ops._helpers import ensure_tensor
+
+    x = ensure_tensor(x)
+
+    def f(a):
+        q, k = a.shape[-2], a.shape[-1]
+        mask = jnp.tril(jnp.ones((q, k), bool), k=k - q)
+        logits = jnp.where(mask, a.astype(jnp.float32), -1e30)
+        import jax
+        return jax.nn.softmax(logits, axis=-1).astype(a.dtype)
+
+    return apply("softmax_mask_fuse_upper_triangle", f, x)
+
+
+def identity_loss(x, reduction="none", name=None):
+    """Pass-through loss head (reference: paddle.incubate.identity_loss —
+    marks a tensor as the loss for IPU-style pipelines; here it reduces per
+    ``reduction`` and is differentiable)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import apply
+    from ..ops._helpers import ensure_tensor
+
+    x = ensure_tensor(x)
+    red = {0: "sum", 1: "mean", 2: "none"}.get(reduction, reduction)
+
+    def f(a):
+        if red == "sum":
+            return jnp.sum(a)
+        if red == "mean":
+            return jnp.mean(a)
+        return a
+
+    return apply("identity_loss", f, x)

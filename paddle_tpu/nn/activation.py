@@ -183,14 +183,7 @@ class PReLU(Layer):
         return F.prelu(x, self.weight, self.data_format)
 
 
-class Silu(Layer):
-    """paddle.nn.Silu (alias of the silu/swish activation)."""
-
-    def __init__(self, name=None):
-        super().__init__()
-
-    def forward(self, x):
-        return F.silu(x)
+Silu = SiLU  # reference spells both
 
 
 class Softmax2D(Layer):

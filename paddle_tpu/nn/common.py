@@ -446,6 +446,8 @@ class MaxUnPool3D(Layer):
     def __init__(self, kernel_size, stride=None, padding=0, data_format="NCDHW",
                  output_size=None, name=None):
         super().__init__()
+        if data_format != "NCDHW":
+            raise NotImplementedError("MaxUnPool3D supports NCDHW only")
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding

@@ -237,50 +237,31 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     x = ensure_tensor(x)
     out_hw = _pair(output_size, 2)
+    if data_format != "NCHW":
+        # channel-last: transpose around the channel-first exact helper
+        def to_cf(t):
+            from ..ops.manipulation import transpose as _tp
+            return _tp(t, [0, 3, 1, 2])
 
-    def f(a):
-        h, w = (a.shape[2], a.shape[3]) if data_format == "NCHW" else (a.shape[1], a.shape[2])
-        oh, ow = out_hw
-        if h % oh == 0 and w % ow == 0:
-            kh, kw = h // oh, w // ow
-            window = (1, 1, kh, kw) if data_format == "NCHW" else (1, kh, kw, 1)
-            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, window, "VALID")
-            return summed / (kh * kw)
-        # general: mean over interpolated bins via resize-style gather
-        return jax.image.resize(a, a.shape[:2] + (oh, ow) if data_format == "NCHW"
-                                else (a.shape[0], oh, ow, a.shape[3]), method="linear")
-
-    return apply("adaptive_avg_pool2d", f, x)
+        out = _adaptive_pool_exact("adaptive_avg_pool2d", to_cf(x), out_hw,
+                                   "avg")
+        from ..ops.manipulation import transpose as _tp
+        return _tp(out, [0, 2, 3, 1])
+    return _adaptive_pool_exact("adaptive_avg_pool2d", x, out_hw, "avg")
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
-    x = ensure_tensor(x)
-    o = int(output_size) if not isinstance(output_size, (list, tuple)) else int(output_size[0])
-
-    def f(a):
-        l = a.shape[2]
-        if l % o == 0:
-            k = l // o
-            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k), (1, 1, k),
-                                           "VALID")
-            return summed / k
-        return jax.image.resize(a, a.shape[:2] + (o,), method="linear")
-
-    return apply("adaptive_avg_pool1d", f, x)
+    o = int(output_size) if not isinstance(output_size, (list, tuple)) \
+        else int(output_size[0])
+    return _adaptive_pool_exact("adaptive_avg_pool1d", x, (o,), "avg")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    x = ensure_tensor(x)
-    out_hw = _pair(output_size, 2)
-
-    def f(a):
-        h, w = a.shape[2], a.shape[3]
-        oh, ow = out_hw
-        kh, kw = h // oh, w // ow
-        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, kh, kw),
-                                     (1, 1, kh, kw), "VALID")
-
-    return apply("adaptive_max_pool2d", f, x)
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool2d(return_mask=True) is not implemented")
+    return _adaptive_pool_exact("adaptive_max_pool2d", x,
+                                _pair(output_size, 2), "max")
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
@@ -444,6 +425,22 @@ def _adaptive_pool_exact(op_name, x, out_sizes, mode):
     red = jnp.max if mode == "max" else jnp.mean
     axes = tuple(range(2, 2 + spatial))
 
+    if all(L % o == 0 for L, o in zip(in_sizes, out_sizes)):
+        # equal windows: one reduce_window beats the per-bin unrolling
+        ks = tuple(L // o for L, o in zip(in_sizes, out_sizes))
+        window = (1, 1) + ks
+
+        def f(a):
+            if mode == "max":
+                return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max,
+                                             window, window, "VALID")
+            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window,
+                                           window, "VALID")
+            import math as _m
+            return summed / _m.prod(ks)
+
+        return apply(op_name, f, x)
+
     def f(a):
         def build(dim, index):
             if dim == spatial:
@@ -494,12 +491,28 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
     paddle.nn.functional.conv3d_transpose): gradient-of-conv as an
     lhs-dilated conv with the flipped kernel (same formulation as the 2-D
     op; paddle output size (i-1)*s - 2p + dil*(k-1) + 1 + opad)."""
+    if data_format != "NCDHW":
+        raise NotImplementedError("conv3d_transpose supports NCDHW only")
     x, weight = ensure_tensor(x), ensure_tensor(weight)
     spatial = 3
     strides = _pair(stride, spatial)
     dil = _pair(dilation, spatial)
     pads = _conv_padding(padding, spatial, strides, None, dil)
     opad = _pair(output_padding, spatial)
+    if output_size is not None and not isinstance(pads, str):
+        # reference semantics: output_size resolves the stride ambiguity —
+        # derive the implied output_padding per dim
+        outs = [int(v) for v in (output_size if isinstance(
+            output_size, (list, tuple)) else [output_size] * spatial)][-3:]
+        opad = tuple(
+            outs[i] - ((int(x._data.shape[2 + i]) - 1) * strides[i]
+                       - pads[i][0] - pads[i][1]
+                       + dil[i] * (int(weight._data.shape[2 + i]) - 1) + 1)
+            for i in range(spatial))
+        if any(o < 0 or o >= strides[i] for i, o in enumerate(opad)):
+            raise ValueError(
+                f"conv3d_transpose: output_size {outs} unreachable with "
+                f"stride {strides} / padding {padding}")
     extras = [ensure_tensor(bias)] if bias is not None else []
 
     def f(a, w, *rest):

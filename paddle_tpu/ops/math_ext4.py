@@ -197,3 +197,77 @@ def numel(x, name=None):
 
 register_op("cartesian_prod", cartesian_prod)
 register_op("numel", numel)
+
+
+# ---------------------------------------------------------------------------
+# method-binding wave: reference Tensor methods whose functions existed at
+# module level only, plus small missing free functions
+# ---------------------------------------------------------------------------
+
+def floor_mod(x, y, name=None):
+    """Alias of elementwise mod (paddle.floor_mod == paddle.mod)."""
+    from ._helpers import OP_REGISTRY
+    return OP_REGISTRY["mod"](x, y)
+
+
+def increment(x, value=1.0, name=None):
+    """In-place scalar increment (paddle.increment): returns x after
+    x += value (0-d/1-element tensors in the reference)."""
+    x = ensure_tensor(x)
+
+    def f(a):
+        return a + jnp.asarray(value, a.dtype)
+
+    out = apply("increment", f, x)
+    x._rebind(out)
+    return x
+
+
+def is_empty(x, name=None):
+    """Whether the tensor has zero elements (paddle.is_empty)."""
+    x = ensure_tensor(x)
+    n = 1
+    for d in x._data.shape:
+        n *= int(d)
+
+    def f(_a):
+        return jnp.asarray(n == 0)
+
+    return apply("is_empty", f, x, differentiable=False)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split along ``axis`` into that dimension's count of tensors, each
+    with the axis removed (paddle.unstack)."""
+    x = ensure_tensor(x)
+    ax = int(axis)
+    n = int(x._data.shape[ax]) if num is None else int(num)
+
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=ax)
+                     for s in jnp.split(a, n, axis=ax))
+
+    out = apply("unstack", f, x)
+    return list(out)
+
+
+register_op("floor_mod", floor_mod, methods=("floor_mod",))
+register_op("increment", increment)
+register_op("is_empty", is_empty, methods=("is_empty",))
+register_op("unstack", unstack, methods=("unstack",))
+
+# bind existing free functions as Tensor methods (reference method surface)
+from ..core.tensor import register_tensor_method as _rtm
+from ._helpers import OP_REGISTRY as _REG
+
+
+def _bind_existing_methods():
+    from .. import linalg as _linalg
+    for name in ("cholesky", "eig", "eigvals", "lu", "solve"):
+        fn = _REG.get(name) or getattr(_linalg, name, None)
+        if fn is not None:
+            _rtm(name, fn)
+    _rtm("increment", increment)
+
+
+_bind_existing_methods()

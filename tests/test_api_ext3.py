@@ -526,3 +526,39 @@ class TestWave5Ops:
         u, s_, v = linalg.svd_lowrank(paddle.to_tensor(A), q=6)
         rec = u.numpy() @ np.diag(s_.numpy()) @ v.numpy().T
         np.testing.assert_allclose(rec, A, atol=1e-3)
+
+
+class TestMethodWave:
+    def test_bound_linalg_methods(self):
+        t = paddle.to_tensor(np.eye(3, dtype="float32") * 4)
+        np.testing.assert_allclose(t.cholesky().numpy(), np.eye(3) * 2,
+                                   rtol=1e-5)
+        x = paddle.to_tensor(np.array([[2.0, 0.0], [0.0, 2.0]], "float32"))
+        sol = x.solve(paddle.to_tensor(np.array([[2.0], [4.0]], "float32")))
+        np.testing.assert_allclose(sol.numpy(), [[1.0], [2.0]], rtol=1e-5)
+
+    def test_unstack_increment_is_empty_floor_mod(self):
+        parts = paddle.to_tensor(
+            np.arange(6, dtype="float32").reshape(2, 3)).unstack(axis=0)
+        assert len(parts) == 2 and parts[0].shape == [3]
+        np.testing.assert_allclose(parts[1].numpy(), [3.0, 4.0, 5.0])
+        c = paddle.to_tensor(np.asarray(1.0, "float32"))
+        paddle.increment(c, 2.5)
+        assert float(c) == 3.5
+        assert bool(paddle.is_empty(
+            paddle.to_tensor(np.zeros((0, 3), "float32"))))
+        np.testing.assert_allclose(
+            paddle.floor_mod(paddle.to_tensor(np.array([7.0], "float32")),
+                             paddle.to_tensor(np.array([3.0], "float32"))
+                             ).numpy(), [1.0])
+
+    def test_incubate_fused_softmax_and_identity_loss(self):
+        import paddle_tpu.incubate as inc
+        x = paddle.to_tensor(np.random.rand(2, 2, 4, 4).astype("float32"),
+                             stop_gradient=False)
+        out = inc.softmax_mask_fuse_upper_triangle(x)
+        o = out.numpy()
+        np.testing.assert_allclose(o.sum(-1), np.ones((2, 2, 4)), rtol=1e-5)
+        assert (o[..., 0, 1:] < 1e-6).all()
+        inc.identity_loss(out, reduction="mean").backward()
+        assert x.grad is not None

@@ -398,3 +398,33 @@ class TestWave6Layers:
         with _pytest.raises(NotImplementedError):
             paddle.nn.functional.adaptive_max_pool1d(
                 paddle.to_tensor(x), 4, return_mask=True)
+
+    def test_adaptive_pool_2d_1d_non_divisible_exact(self):
+        """Previously-broken siblings rerouted through the exact helper."""
+        import torch
+        x2 = np.random.rand(1, 2, 11, 11).astype("float32")
+        np.testing.assert_allclose(
+            paddle.nn.functional.adaptive_max_pool2d(
+                paddle.to_tensor(x2), 4).numpy(),
+            torch.nn.functional.adaptive_max_pool2d(
+                torch.tensor(x2), 4).numpy())
+        x1 = np.random.rand(1, 2, 7).astype("float32")
+        np.testing.assert_allclose(
+            paddle.nn.functional.adaptive_avg_pool1d(
+                paddle.to_tensor(x1), 3).numpy(),
+            torch.nn.functional.adaptive_avg_pool1d(
+                torch.tensor(x1), 3).numpy(), rtol=1e-5)
+
+    def test_conv3d_transpose_output_size(self):
+        paddle.seed(0)
+        ct = paddle.nn.Conv3DTranspose(4, 6, 3, stride=2, padding=1)
+        x = paddle.to_tensor(np.random.rand(1, 4, 5, 5, 5).astype("float32"))
+        y = paddle.nn.functional.conv3d_transpose(
+            x, ct.weight, ct.bias, stride=2, padding=1,
+            output_size=[10, 10, 10])
+        assert y.shape == [1, 6, 10, 10, 10]
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            paddle.nn.functional.conv3d_transpose(
+                x, ct.weight, ct.bias, stride=2, padding=1,
+                output_size=[20, 20, 20])
