@@ -29,7 +29,14 @@ try:
 except Exception:  # pragma: no cover
     from jax._src.core import Tracer as _Tracer
 
-__all__ = ["Tensor", "Parameter", "to_tensor", "apply", "register_tensor_method"]
+__all__ = ["Tensor", "Parameter", "to_tensor", "apply",
+           "register_tensor_method", "TraceBreakError"]
+
+
+class TraceBreakError(RuntimeError):
+    """A concrete host-side read (``.numpy()``, ``float()``, ``bool()``) hit a
+    traced value. Under ``to_static(full_graph=False)`` this is a graph break
+    (eager fallback / segment boundary); under full_graph=True it surfaces."""
 
 
 def _is_tracer(x) -> bool:
@@ -159,8 +166,9 @@ class Tensor:
     # --- host interop -------------------------------------------------------
     def numpy(self) -> np.ndarray:
         if _is_tracer(self._data):
-            raise RuntimeError("Tensor.numpy() is not available while tracing "
-                               "inside paddle.jit.to_static")
+            raise TraceBreakError(
+                "Tensor.numpy() is not available while tracing "
+                "inside paddle.jit.to_static")
         return np.asarray(self._data)
 
     def __array__(self, dtype=None):

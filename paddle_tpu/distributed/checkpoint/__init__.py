@@ -143,22 +143,28 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     arrays_dir = os.path.join(path, "arrays")
     if os.path.isdir(arrays_dir):
         import orbax.checkpoint as ocp
-        # restore_args must mirror the SAVED tree (orbax tree-maps it), so
-        # cover every saved array key — target keys get their destination
-        # sharding (orbax then reads only the shards this topology needs),
-        # non-target keys restore default and are dropped below
+        # PARTIAL restore: only the target tree's keys are read (item template
+        # + transforms={} makes orbax skip the rest) — a model-only load from
+        # a checkpoint that also holds optimizer m/v never materializes the
+        # optimizer state, and each restored key reads exactly the shards its
+        # destination sharding needs (reshard-on-load)
         restore_args = {}
-        for k, m in meta.items():
-            if "value" in m:
-                continue
-            t = flat.get(k)
-            sh = _target_sharding(t) if t is not None else None
+        item = {}
+        for k, t in flat.items():
+            sh = _target_sharding(t)
             if sh is not None:
                 restore_args[k] = ocp.ArrayRestoreArgs(sharding=sh)
             else:
                 restore_args[k] = ocp.RestoreArgs()
+            try:
+                item[k] = jax.ShapeDtypeStruct(
+                    tuple(meta[k]["shape"]), np.dtype(meta[k]["dtype"]),
+                    sharding=sh)
+            except TypeError:
+                item[k] = jax.ShapeDtypeStruct(
+                    tuple(meta[k]["shape"]), np.dtype(meta[k]["dtype"]))
         arrays = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).restore(
-            arrays_dir, restore_args=restore_args)
+            arrays_dir, item=item, restore_args=restore_args, transforms={})
     else:
         npz = np.load(os.path.join(path, "arrays.npz"))
         arrays = {k: npz[k] for k in npz.files}

@@ -57,8 +57,10 @@ class Communicator:
     mode="async" — pushes enqueue; a daemon thread applies them in arrival
                    order. Bounded queue gives backpressure instead of
                    unbounded staleness.
-    mode="geo"   — pushes accumulate; every ``geo_k`` pushes the summed
-                   update applies once.
+    mode="geo"   — pushes accumulate PER TABLE; a table flushes when its own
+                   count reaches ``geo_k`` (reference GeoCommunicator tracks
+                   per-table send deltas — a global count would stagger the
+                   staleness window unpredictably as table count grows).
     """
 
     def __init__(self, mode: str = "async", send_queue_size: int = 32,
@@ -72,7 +74,6 @@ class Communicator:
         self._tables: Dict[str, Tensor] = {}
         self._queue: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
         self._accum: Dict[str, List] = {}
-        self._accum_count = 0
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._lock = threading.Lock()
@@ -115,9 +116,8 @@ class Communicator:
             return
         if self.mode == "geo":
             self._accum.setdefault(table_name, []).append((ids_a, g_a))
-            self._accum_count += 1
-            if self._accum_count >= self.geo_k:
-                self._flush_geo()
+            if len(self._accum[table_name]) >= self.geo_k:
+                self._flush_geo(table_name)
             return
         if self._error is not None:
             raise RuntimeError(
@@ -168,11 +168,12 @@ class Communicator:
         # the reference accessor's SGD rule)
         t._set_data(t._data.at[ids].add(-self.lr * grad))
 
-    def _flush_geo(self) -> None:
-        accum, self._accum = self._accum, {}
-        self._accum_count = 0
-        for name, items in accum.items():
-            for ids, g in items:
+    def _flush_geo(self, table_name: Optional[str] = None) -> None:
+        """Apply accumulated deltas for one table (its k-window filled) or
+        all tables (barrier)."""
+        names = [table_name] if table_name is not None else list(self._accum)
+        for name in names:
+            for ids, g in self._accum.pop(name, []):
                 self._apply(name, ids, g)
 
     def _loop(self) -> None:

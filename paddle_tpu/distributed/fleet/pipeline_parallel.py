@@ -173,8 +173,14 @@ class PipelineParallel(Layer):
                     layers, self._hcg.mesh, axis="pp",
                     micro_batches=self.accumulate_steps,
                     remat=bool(cfg.get("remat", True)))
-            except NonUniformStackError:
+            except NonUniformStackError as e:
                 self._engine = None  # non-uniform stack: fallback path
+                import warnings
+                warnings.warn(
+                    f"pipeline parallel (pp={pp}): {e}. Falling back to the "
+                    "grad-accumulation path — numerics match 1F1B but stages "
+                    "are NOT placed on devices (no pipelining).",
+                    stacklevel=2)
 
     def forward(self, *args, **kwargs):
         if self._engine is not None:

@@ -133,6 +133,17 @@ def _entry_key(layer):
                   for k, v in sorted(sd.items())))
 
 
+def _has_persistable_buffers(layer) -> bool:
+    """True if the block carries persistable buffers (state_dict entries that
+    are not parameters — e.g. BatchNorm running stats). Such blocks cannot be
+    stacked: the engine would hand the buffers to the optimizer as weights,
+    and in-forward buffer updates (running-stat EMAs) would be silently
+    dropped by the pure stage function. They take the grad-accumulation
+    fallback instead."""
+    param_ids = {id(p) for p in layer.parameters()}
+    return any(id(v) not in param_ids for v in layer.state_dict().values())
+
+
 def find_uniform_run(entries, num_stages):
     """Longest contiguous run of structurally identical Layer entries whose
     length admits >=1 block per stage. Returns (start, n_used) or None."""
@@ -141,7 +152,7 @@ def find_uniform_run(entries, num_stages):
     keys = []
     for layer, ffunc in entries:
         if ffunc is not None or not isinstance(layer, _Layer) \
-                or not layer.state_dict():
+                or not layer.state_dict() or _has_persistable_buffers(layer):
             keys.append(None)  # boundary: can't be stacked
         else:
             keys.append(_entry_key(layer))
@@ -185,6 +196,13 @@ class PipelinedStack:
     embeddings (SharedLayerDesc) need no explicit grad allreduce: the tied
     module runs replicated in pre AND post, so both uses hit the same
     parameter and the tape sums their gradients.
+
+    Only parameters are stacked: blocks carrying persistable buffers
+    (BatchNorm-style running stats) are never selected for stacking — they
+    fall to the grad-accumulation path, where buffer updates apply normally.
+    Non-persistable buffers (derived caches such as rotary tables) are read
+    from the template block and therefore must be stage-invariant, which
+    holds for identically-constructed blocks.
     """
 
     def __init__(self, pipeline_layer, mesh: Mesh, axis: str = "pp",

@@ -11,7 +11,8 @@ train step with buffer donation on the state (in-place optimizer semantics),
 which is where TPU performance lives.
 """
 
-from .to_static import StaticFunction, to_static, not_to_static, ignore_module  # noqa: F401
+from .to_static import (StaticFunction, TraceBreakError, to_static,  # noqa: F401
+                        not_to_static, ignore_module)
 from .save_load import save, load, TranslatedLayer  # noqa: F401
 
 

@@ -10,17 +10,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.tensor import Tensor, _state_registry, _is_tracer
+from ..core.tensor import (Tensor, TraceBreakError, _state_registry,
+                           _is_tracer)
 from .. import flags as _flags
 from ..core.tracing import (TraceState, pop_trace_state, push_trace_state,
                             trace_state)
 
 __all__ = ["StaticFunction", "to_static", "not_to_static", "ignore_module",
-           "register_pretrace_hook"]
+           "register_pretrace_hook", "TraceBreakError"]
 
 _ENABLED = True
 
 _FALLBACK = object()  # cache sentinel: this signature graph-breaks to eager
+
+
+def _is_trace_failure(e: BaseException) -> bool:
+    """Graph breaks are TRACE/LOWERING failures only (tensor-dependent Python
+    control flow, tracer leaks, ops without abstract eval) — the reference
+    SOT's fallback contract. Runtime failures (XLA execution errors, device
+    OOM, asserts that only fire under jit) must NOT memoize a permanent
+    eager fallback: they re-raise so the user sees them."""
+    return isinstance(e, (jax.errors.JAXTypeError,
+                          jax.errors.NonConcreteBooleanIndexError,
+                          NotImplementedError, TraceBreakError))
 
 # Objects with lazily-derived state (e.g. optimizer AMP masters) register here;
 # before any (re)trace we give them a chance to reconcile derived state with
@@ -192,7 +204,10 @@ class StaticFunction:
             return self._invoke(jitted, holder, state_tensors, arg_arrays,
                                 leaves, key)
         except Exception as e:
-            if self._full_graph:
+            if self._full_graph or not _is_trace_failure(e):
+                # full-graph mode, or a genuine runtime failure (XLA execution
+                # error, assert under jit): surface it — only trace failures
+                # are graph breaks
                 raise
             # SOT-style graph break (upstream python/paddle/jit/sot/):
             # tracing failed (tensor-dependent Python control flow,

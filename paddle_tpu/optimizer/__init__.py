@@ -551,21 +551,43 @@ class Adam(Optimizer):
         else:
             super()._on_params_cast()
 
+    # chunk width for >int32-range flat buffers; a class attribute so tests
+    # can shrink it and exercise the chunked path on small totals
+    _SEGVEC_CHUNK = np.iinfo(np.int32).max
+
     def _segment_vector(self, per_segment_values):
         """Flat (total,) f32 vector that is constant within each param's
         segment. Built as tiny-literal boundaries + one gather — NOT a dense
         literal (materialized mid-trace that embeds a model-sized constant
         into the program: the remote-compile 413 failure mode) and NOT an
-        O(n_params) where-chain. int64 iota so >2^31-element flat buffers
-        (7B scale) index correctly regardless of jax_enable_x64 width caps:
-        searchsorted boundaries stay well under float precision anyway."""
+        O(n_params) where-chain. Totals past int32 range are built in
+        chunks with the segment boundaries shifted host-side into each
+        chunk's window — lax.iota(int64) silently canonicalizes to int32
+        when x64 is off, so a single big iota would wrap and corrupt the
+        segment masks at 7B scale."""
         fs = self._fused
         bounds = np.asarray([off for off, _ in fs["offsets"]][1:], np.int64)
         vals = jnp.asarray(np.asarray(per_segment_values, np.float32))
-        idx = jax.lax.iota(jnp.int64, fs["total"])             if fs["total"] > np.iinfo(np.int32).max             else jax.lax.iota(jnp.int32, fs["total"])
-        seg = jnp.searchsorted(jnp.asarray(bounds, idx.dtype), idx,
-                               side="right")
-        return vals[seg]
+        total = fs["total"]
+        chunk = int(self._SEGVEC_CHUNK)
+        if total <= chunk:
+            idx = jax.lax.iota(jnp.int32, total)
+            seg = jnp.searchsorted(jnp.asarray(bounds, jnp.int32), idx,
+                                   side="right")
+            return vals[seg]
+        parts = []
+        start = 0
+        while start < total:
+            n = min(chunk, total - start)
+            # bounds before the window clip to 0 (counted for every local
+            # idx), bounds past it clip to n (never counted) — searchsorted
+            # over the shifted bounds yields the GLOBAL segment id
+            local = np.clip(bounds - start, 0, n).astype(np.int32)
+            idx = jax.lax.iota(jnp.int32, n)
+            seg = jnp.searchsorted(jnp.asarray(local), idx, side="right")
+            parts.append(vals[seg])
+            start += n
+        return jnp.concatenate(parts)
 
     def _fused_live_mask(self, live):
         """0/1 f32 segment mask for the given per-param liveness tuple,

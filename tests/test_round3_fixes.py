@@ -1,0 +1,222 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md round 2).
+
+One test per finding:
+* PipelinedStack must not stack blocks with persistable buffers (BatchNorm
+  running stats would become trainable weights and their in-forward updates
+  would be dropped).
+* full_graph=False memoizes a graph break ONLY for trace failures — runtime
+  errors surface.
+* distributed-checkpoint subset loads restore only the target keys.
+* fused-optimizer segment vectors survive int32-width chunking (the 7B
+  flat-buffer case).
+* GEO communicator flushes per table, not on a global push count.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ---------------------------------------------------------------------------
+# ADVICE medium: blocks with persistable buffers are not stackable
+# ---------------------------------------------------------------------------
+
+D = 8
+
+
+class _BNBlock(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(D, D)
+        self.bn = nn.BatchNorm1D(D)
+
+    def forward(self, x):
+        return paddle.tanh(self.bn(self.fc(x)))
+
+
+class _PlainBlock(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(D, D)
+
+    def forward(self, x):
+        return paddle.tanh(self.fc(x))
+
+
+def test_bn_blocks_are_not_stackable():
+    from paddle_tpu.distributed.fleet.tpu_pipeline import find_uniform_run
+
+    bn_entries = [(_BNBlock(), None) for _ in range(4)]
+    assert find_uniform_run(bn_entries, 2) is None
+
+    plain_entries = [(_PlainBlock(), None) for _ in range(4)]
+    assert find_uniform_run(plain_entries, 2) == (0, 4)
+
+    # a BN head bounding a plain run must not poison the run itself
+    mixed = plain_entries + [(_BNBlock(), None)]
+    assert find_uniform_run(mixed, 2) == (0, 4)
+
+
+def test_bn_pipeline_falls_back_with_warning_and_updates_stats():
+    """End-to-end: a pp>1 model whose blocks carry BatchNorm takes the
+    grad-accumulation fallback (with a one-time warning) and its running
+    stats still update — the exact divergence the stacked engine would have
+    silently introduced."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.pipeline_parallel import (LayerDesc,
+                                                                PipelineLayer)
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+    paddle.seed(3)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = PipelineLayer(
+            layers=[LayerDesc(_BNBlock) for _ in range(4)],
+            loss_fn=lambda out, label: ((out - label) ** 2).mean())
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            wrapped = fleet.distributed_model(model)
+        assert wrapped._engine is None, "BN blocks must not be stacked"
+        assert any("grad-accumulation" in str(x.message) for x in w)
+
+        bn = model._entries[0][0].bn
+        mean_before = np.asarray(bn._mean._data).copy()
+        rng = np.random.default_rng(0)
+        data = paddle.to_tensor(rng.normal(2, 1, (8, D)).astype(np.float32))
+        label = paddle.to_tensor(rng.normal(0, 1, (8, D)).astype(np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=wrapped.parameters())
+        wrapped.train_batch((data, label), optimizer=opt)
+        mean_after = np.asarray(bn._mean._data)
+        assert not np.allclose(mean_before, mean_after), \
+            "running stats must update on the fallback path"
+    finally:
+        set_hybrid_communicate_group(None)
+
+
+# ---------------------------------------------------------------------------
+# ADVICE low: full_graph=False must not memoize runtime failures
+# ---------------------------------------------------------------------------
+
+def test_full_graph_false_reraises_non_trace_errors():
+    calls = {"n": 0}
+
+    def fn(x):
+        calls["n"] += 1
+        raise ValueError("genuine bug, not a graph break")
+
+    soft = paddle.jit.to_static(fn, full_graph=False)
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with pytest.raises(ValueError, match="genuine bug"):
+        soft(x)
+    # NOT memoized as a fallback: the next call must raise again, not run
+    # eagerly and silently pin this signature to eager
+    with pytest.raises(ValueError, match="genuine bug"):
+        soft(x)
+
+
+def test_full_graph_false_still_breaks_on_trace_failure():
+    def fn(x):
+        if float(x.sum()) > 0:  # concrete read of a tracer
+            return x * 2
+        return x - 1
+
+    soft = paddle.jit.to_static(fn, full_graph=False)
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        out = soft(x)
+    np.testing.assert_allclose(out.numpy(), np.full((2, 2), 2.0))
+
+
+# ---------------------------------------------------------------------------
+# ADVICE low: subset checkpoint loads restore only the target keys
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_subset_load_restores_only_targets(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+
+    state = {"model": {"w": Tensor(jnp.arange(16.0).reshape(4, 4))},
+             "opt": {"m": Tensor(jnp.ones((4, 4))),
+                     "v": Tensor(jnp.ones((4, 4)))}}
+    save_state_dict(state, str(tmp_path / "ck"))
+
+    import orbax.checkpoint as ocp
+    restored_trees = []
+    orig = ocp.Checkpointer.restore
+
+    def spy(self, *a, **kw):
+        out = orig(self, *a, **kw)
+        restored_trees.append(out)
+        return out
+
+    monkeypatch.setattr(ocp.Checkpointer, "restore", spy)
+    target = {"model": {"w": Tensor(jnp.zeros((4, 4)))}}
+    load_state_dict(target, str(tmp_path / "ck"))
+    np.testing.assert_array_equal(np.asarray(target["model"]["w"]._data),
+                                  np.arange(16.0).reshape(4, 4))
+    assert len(restored_trees) == 1
+    # the optimizer keys were never materialized by the restore
+    assert set(restored_trees[0].keys()) == {"model.w"}
+
+
+# ---------------------------------------------------------------------------
+# ADVICE low: segment vectors built in int32-safe chunks
+# ---------------------------------------------------------------------------
+
+def test_segment_vector_chunked_matches_unchunked(monkeypatch):
+    paddle.seed(11)
+    m = nn.Linear(3, 4)  # segments: weight 12 elements, bias 4 elements
+    opt = paddle.optimizer.AdamW(parameters=m.parameters(),
+                                 use_multi_tensor=True)
+    assert opt._fused is not None
+    vals = [2.5, -1.5]
+    ref = np.asarray(opt._segment_vector(vals))
+    assert ref.shape == (16,)
+    # chunk width smaller than every segment boundary layout we care about
+    for chunk in (1, 3, 5, 7, 12, 15):
+        monkeypatch.setattr(type(opt), "_SEGVEC_CHUNK", chunk)
+        np.testing.assert_array_equal(np.asarray(opt._segment_vector(vals)),
+                                      ref)
+
+
+# ---------------------------------------------------------------------------
+# ADVICE low: GEO communicator flushes per table
+# ---------------------------------------------------------------------------
+
+def test_geo_flushes_per_table():
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.communicator import Communicator
+
+    t1 = Tensor(jnp.zeros((4, 2)), stop_gradient=True)
+    t2 = Tensor(jnp.zeros((4, 2)), stop_gradient=True)
+    c = Communicator(mode="geo", lr=1.0, geo_k=3)
+    c.init_with_ctx({"a": t1, "b": t2})
+    g = np.ones((1, 2), np.float32)
+    # interleave pushes: 2 to a, 2 to b — under a GLOBAL count the 4th push
+    # would flush everything; per-table neither window is full yet
+    c.push_sparse("a", np.array([0]), g)
+    c.push_sparse("b", np.array([0]), g)
+    c.push_sparse("a", np.array([0]), g)
+    c.push_sparse("b", np.array([0]), g)
+    np.testing.assert_allclose(np.asarray(t1._data)[0], 0.0)
+    np.testing.assert_allclose(np.asarray(t2._data)[0], 0.0)
+    # a's third push fills a's window only
+    c.push_sparse("a", np.array([0]), g)
+    np.testing.assert_allclose(np.asarray(t1._data)[0], -3.0)
+    np.testing.assert_allclose(np.asarray(t2._data)[0], 0.0)
+    # barrier flushes b's partial window
+    c.barrier()
+    np.testing.assert_allclose(np.asarray(t2._data)[0], -2.0)
