@@ -93,7 +93,11 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
         # create fp32 masters for the freshly cast params NOW — creating them
         # lazily inside the first to_static trace would force a second
         # whole-program compile (fused optimizers keep their pre-cast fp32
-        # flat master instead)
+        # flat master instead). master_weight=False selects the
+        # master-weight-free path (bf16 params update with stochastic
+        # rounding; see Optimizer._use_master_weights)
+        if master_weight is not None and hasattr(o, "_use_master_weights"):
+            o._use_master_weights = bool(master_weight)
         if hasattr(o, "_on_params_cast"):
             o._on_params_cast()
     return (models if is_list else model_list[0]), optimizers

@@ -92,7 +92,11 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
     """
     from .tensor import Tensor  # local import to avoid cycle
 
-    if create_graph:
+    from . import lazy as _lazy
+    if create_graph or _lazy.active():
+        # lazy segment mode records nodes without a materialized vjp_fn;
+        # the tensor-space path re-dispatches each node's vjp through
+        # apply(), so backward ops join the recorded segment
         _backward_create_graph(tensors, grad_tensors, retain_graph, _leaf_set)
         return
 
@@ -164,10 +168,26 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False,
 
 
 def _accumulate_leaf(t, g, leaf_set: Optional[set] = None) -> None:
-    """GradientAccumulator parity: sum into ``.grad`` in place."""
+    """GradientAccumulator parity: sum into ``.grad`` in place. Sparse
+    (SelectedRows) gradients stay sparse: accumulation concatenates rows
+    lazily; mixing with a dense gradient densifies (upstream
+    GradientAccumulator does the same merge)."""
+    from .selected_rows import SelectedRows, SelectedRowsTensor
     from .tensor import Tensor
 
     if leaf_set is not None and id(t) not in leaf_set:
+        return
+
+    if isinstance(g, SelectedRows):
+        if g.dtype != t._data.dtype and \
+                jnp.issubdtype(t._data.dtype, jnp.floating):
+            g = g.astype(t._data.dtype)
+        if t.grad is None:
+            t.grad = SelectedRowsTensor(g, name=(t.name or "tensor") + "@GRAD")
+        elif isinstance(t.grad, SelectedRowsTensor):
+            t.grad.accumulate_sparse(g)
+        else:
+            t.grad._set_data(t.grad._data + g.to_dense())
         return
 
     if g.dtype != t._data.dtype and jnp.issubdtype(t._data.dtype, jnp.floating):
@@ -176,6 +196,8 @@ def _accumulate_leaf(t, g, leaf_set: Optional[set] = None) -> None:
         gt = Tensor(g, stop_gradient=True)
         gt.name = (t.name or "tensor") + "@GRAD"
         t.grad = gt
+    elif isinstance(t.grad, SelectedRowsTensor):
+        t.grad.accumulate_dense(g)
     else:
         t.grad._set_data(t.grad._data + g)
 
@@ -298,6 +320,11 @@ def _accumulate_leaf_tensor(t, g, leaf_set: Optional[set]) -> None:
 
 
 def _apply_hooks(t, g):
+    if not t._hooks:
+        return g
+    from .selected_rows import SelectedRows
+    if isinstance(g, SelectedRows):
+        g = g.to_dense()  # hooks (DP reducers etc.) see the dense gradient
     for hook in t._hooks.values():
         out = hook(_wrap_hook_arg(t, g))
         if out is not None:

@@ -1,0 +1,353 @@
+"""Lazy segment executor: partial-graph capture for ``full_graph=False``.
+
+Parity surface: upstream SOT (``python/paddle/jit/sot/`` — bytecode-level
+graph capture with guards; on a data-dependent branch it compiles the
+subgraphs AROUND the break instead of abandoning compilation). The
+TPU-native equivalent is the lazy-tensor design (the torch_xla/LTC model):
+
+* Python runs the user function EVERY call (it is the control-flow
+  interpreter, so tensor-dependent ``if``/``while`` just work);
+* each op dispatched through ``apply()`` is RECORDED, not executed — its
+  outputs are ``LazyValue`` placeholders carrying only shape/dtype
+  (abstract eval, cached per op signature);
+* a concrete read (``float(x)``, ``.numpy()``, a raw-jnp touch via
+  ``__jax_array__``) FLUSHES the pending graph: the recorded segment is
+  compiled as ONE XLA program (cached by a structural signature: op code
+  objects + hashable closure state + topology + input avals) and executed,
+  rebinding every escaping placeholder to a real array;
+* the read value feeds the Python branch, and recording resumes — the ops
+  after the break land in the next segment.
+
+So a function with one data-dependent branch executes as [compiled
+segment] -> host read -> [compiled segment]: the guard set of the
+reference's SOT collapses into "Python re-executes", and the compiled
+cache keys replace its per-break graph cache. Per-call Python overhead is
+the op-recording walk (microseconds per op); device work runs in fused
+segments, which is where the throughput is.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = ["LazyValue", "active", "segment_mode", "flush", "flush_if_active",
+           "record", "last_segment_hlos"]
+
+
+class LazyValue:
+    """Placeholder for a not-yet-executed op output."""
+
+    __slots__ = ("seq", "aval", "array", "owners", "__weakref__")
+
+    def __init__(self, seq: int, aval):
+        self.seq = seq
+        self.aval = aval
+        self.array = None  # filled by flush
+        self.owners: "weakref.WeakSet" = weakref.WeakSet()
+
+    # --- duck-typed array surface (shape/dtype consumers) -------------------
+    @property
+    def shape(self):
+        return self.aval.shape
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        out = 1
+        for s in self.aval.shape:
+            out *= int(s)
+        return out
+
+    def __jax_array__(self):
+        # a raw jnp consumer touched a pending value: that is an implicit
+        # segment boundary — materialize and hand over the array
+        if self.array is None:
+            flush()
+        return self.array
+
+    def __repr__(self):
+        state = "pending" if self.array is None else "ready"
+        return f"LazyValue<{self.seq}:{state} {self.aval.shape}:{self.aval.dtype}>"
+
+
+class _Record:
+    __slots__ = ("fn", "inputs", "out_lazies", "fn_sig", "lifted")
+
+    def __init__(self, fn, inputs, out_lazies, fn_sig, lifted):
+        self.fn = fn                # the op's pure array fn (may close over arrays)
+        self.inputs = inputs        # per input: LazyValue | jax.Array
+        self.out_lazies = out_lazies
+        self.fn_sig = fn_sig        # hashable structural signature of fn
+        self.lifted = lifted        # [(setter, array)] closure-held arrays
+
+
+class _State:
+    def __init__(self):
+        self.active = False
+        self.records: List[_Record] = []
+        self.seq = 0
+        self.aval_cache: Dict[Any, Any] = {}     # (fn_sig, in_avals) -> out avals
+        self.compiled: Dict[Any, Any] = {}       # segment signature -> jitted
+        self.last_hlos: List[str] = []           # debug: per-flush compiled HLO
+        self.capture_hlo = False
+
+
+_state = _State()
+
+
+def active() -> bool:
+    return _state.active
+
+
+class segment_mode:
+    """Context manager enabling lazy segment recording."""
+
+    def __enter__(self):
+        if _state.active:
+            raise RuntimeError("lazy segment mode is not reentrant")
+        _state.active = True
+        _state.records = []
+        _state.last_hlos = []
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            # flush on BOTH paths: the recorded ops were "executed" from the
+            # Python program's point of view, so an exception must still
+            # materialize their side effects (state mutations) — otherwise
+            # tensors are left holding dead placeholders and a caller-level
+            # eager retry would double-apply whatever had already flushed
+            try:
+                flush()
+            except Exception:
+                if exc_type is None:
+                    raise  # don't swallow a flush failure on the clean path
+                # already unwinding: keep the original exception
+        finally:
+            _state.active = False
+            _state.records = []
+        return False
+
+
+# ---------------------------------------------------------------------------
+# fn structural signatures (stable across per-call closure objects)
+# ---------------------------------------------------------------------------
+
+def _walk_fn(fn, depth=0):
+    """Return (hashable signature, [(rebind, array), ...]) for a function,
+    recursing into closure cells and defaults. Arrays found there are
+    LIFTED: the signature marks their position and the rebind callback lets
+    the replay trace substitute a traced value (cells are writable)."""
+    if depth > 4:
+        return ("deep", repr(fn)), []
+    sig: List[Any] = [getattr(fn, "__code__", None) and fn.__code__.co_code,
+                      getattr(fn, "__code__", None) and fn.__code__.co_consts]
+    lifted: List[Tuple[Any, Any]] = []
+
+    def classify(value, rebind):
+        if isinstance(value, jax.Array) or isinstance(value, np.ndarray):
+            sig.append(("ARR", tuple(np.shape(value)), str(np.asarray(value).dtype)
+                        if isinstance(value, np.ndarray) else str(value.dtype)))
+            lifted.append((rebind, value))
+        elif callable(value) and hasattr(value, "__code__"):
+            sub_sig, sub_lifted = _walk_fn(value, depth + 1)
+            sig.append(("FN", sub_sig))
+            lifted.extend(sub_lifted)
+        else:
+            try:
+                hash(value)
+                sig.append(("C", value))
+            except TypeError:
+                sig.append(("R", repr(value)))
+
+    cells = getattr(fn, "__closure__", None) or ()
+    for cell in cells:
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            sig.append(("EMPTY",))
+            continue
+
+        def rebind(x, _cell=cell):
+            _cell.cell_contents = x
+
+        classify(v, rebind)
+    defaults = getattr(fn, "__defaults__", None) or ()
+    for i, v in enumerate(defaults):
+        def rebind(x, _fn=fn, _i=i):
+            d = list(_fn.__defaults__)
+            d[_i] = x
+            _fn.__defaults__ = tuple(d)
+
+        classify(v, rebind)
+    return tuple(sig), lifted
+
+
+def _aval_of(x):
+    if isinstance(x, LazyValue):
+        return x.aval
+    return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# record + flush
+# ---------------------------------------------------------------------------
+
+def record(op_name: str, fn, arrays) -> List[LazyValue]:
+    """Record one op over ``arrays`` (jax arrays or LazyValues); return the
+    output LazyValues (abstract-evaled, cached per signature)."""
+    st = _state
+    fn_sig, lifted = _walk_fn(fn)
+    in_avals = tuple(
+        (a.aval.shape, str(a.aval.dtype)) if isinstance(a, LazyValue)
+        else (np.shape(a), str(a.dtype)) for a in arrays)
+    key = (op_name, fn_sig, in_avals)
+    out_avals = st.aval_cache.get(key)
+    if out_avals is None:
+        out_avals = jax.eval_shape(fn, *[_aval_of(a) for a in arrays])
+        st.aval_cache[key] = out_avals
+    multi = isinstance(out_avals, tuple)
+    avals = out_avals if multi else (out_avals,)
+    outs = []
+    for av in avals:
+        lv = LazyValue(st.seq, av)
+        st.seq += 1
+        outs.append(lv)
+    st.records.append(_Record(fn, list(arrays), outs, (op_name, fn_sig),
+                              lifted))
+    return outs, multi
+
+
+def flush_if_active() -> None:
+    if _state.active and _state.records:
+        flush()
+
+
+def flush() -> None:
+    """Compile + execute the pending segment; rebind escaping values."""
+    st = _state
+    records, st.records = st.records, []
+    if not records:
+        return
+
+    # classify inputs: external arrays (dedup by id) vs internal lazy refs
+    ext_arrays: List[Any] = []
+    ext_index: Dict[int, int] = {}
+    topo = []  # per record: (("x", ext_idx) | ("l", producer_pos, out_slot))
+    produced: Dict[int, Tuple[int, int]] = {}  # id(LazyValue) -> (rec, slot)
+    for ri, rec in enumerate(records):
+        for si, lv in enumerate(rec.out_lazies):
+            produced[id(lv)] = (ri, si)
+    lifted_arrays: List[Any] = []
+    lifted_rebinds: List[Any] = []
+    sig_parts: List[Any] = []
+    for rec in records:
+        refs = []
+        for a in rec.inputs:
+            if isinstance(a, LazyValue):
+                if a.array is not None:  # materialized by an earlier flush
+                    idx = ext_index.setdefault(id(a.array), len(ext_arrays))
+                    if idx == len(ext_arrays):
+                        ext_arrays.append(a.array)
+                    refs.append(("x", idx))
+                else:
+                    pos = produced.get(id(a))
+                    if pos is None:
+                        raise RuntimeError(
+                            "lazy value consumed before being recorded")
+                    refs.append(("l",) + pos)
+            else:
+                idx = ext_index.setdefault(id(a), len(ext_arrays))
+                if idx == len(ext_arrays):
+                    ext_arrays.append(a)
+                refs.append(("x", idx))
+        for (_rb, arr) in rec.lifted:
+            lifted_rebinds.append(_rb)
+            lifted_arrays.append(arr)
+        sig_parts.append((rec.fn_sig, tuple(refs), len(rec.out_lazies)))
+
+    # which outputs escape (have a live owner tensor)?
+    escaping: List[Tuple[int, int]] = []
+    for ri, rec in enumerate(records):
+        for si, lv in enumerate(rec.out_lazies):
+            if len(lv.owners) > 0:
+                escaping.append((ri, si))
+    sig = (tuple(sig_parts), tuple(escaping),
+           tuple((tuple(np.shape(a)), str(a.dtype)) for a in ext_arrays),
+           tuple((tuple(np.shape(a)), str(a.dtype)) for a in lifted_arrays))
+
+    jitted = st.compiled.get(sig)
+    cache_fill = jitted is None
+    if cache_fill:
+        n_lifted_per: List[int] = [len(r.lifted) for r in records]
+
+        def replay(ext, lifted_vals):
+            vals: List[List[Any]] = []
+            li = 0
+            for rec2, refs2, nl in zip(records, [s[1] for s in sig_parts],
+                                       n_lifted_per):
+                # substitute traced values into array-carrying closures
+                for k in range(nl):
+                    lifted_rebinds_local = lifted_rebinds[li + k]
+                    lifted_rebinds_local(lifted_vals[li + k])
+                li += nl
+                args = []
+                for ref in refs2:
+                    if ref[0] == "x":
+                        args.append(ext[ref[1]])
+                    else:
+                        args.append(vals[ref[1]][ref[2]])
+                out = rec2.fn(*args)
+                vals.append(list(out) if isinstance(out, tuple) else [out])
+            return [vals[ri][si] for ri, si in escaping]
+
+        jitted = jax.jit(replay)
+        st.compiled[sig] = jitted
+        if st.capture_hlo:
+            st.last_hlos.append(
+                jitted.lower(ext_arrays, lifted_arrays).compile().as_text())
+    elif st.capture_hlo:
+        st.last_hlos.append("<cached segment>")
+
+    outs = jitted(ext_arrays, lifted_arrays)
+    for (ri, si), arr in zip(escaping, outs):
+        lv = records[ri].out_lazies[si]
+        lv.array = arr
+        for t in list(lv.owners):
+            if t._data is lv:
+                t._data = arr
+            g = getattr(t, "_grad", None)
+            if g is not None and getattr(g, "_data", None) is lv:
+                g._data = arr
+
+    if cache_fill:
+        # the cached replay closure only ever reads rec.fn (for retraces on
+        # aval change); drop the array references so the cache does not pin
+        # this flush's inputs/outputs in device memory for the process
+        # lifetime
+        for rec in records:
+            rec.inputs = None
+            rec.out_lazies = None
+            rec.lifted = None
+
+
+def last_segment_hlos() -> List[str]:
+    """Debug surface: compiled HLO text of each segment flushed in the most
+    recent segment_mode (requires capture enabled via
+    ``set_capture_hlo(True)``)."""
+    return list(_state.last_hlos)
+
+
+def set_capture_hlo(flag: bool) -> None:
+    _state.capture_hlo = bool(flag)

@@ -1,0 +1,155 @@
+"""SelectedRows-analogue sparse gradients.
+
+Parity surface: ``paddle/phi/core/selected_rows.h`` + the sparse-grad path of
+``lookup_table``/embedding (upstream: embedding with ``sparse=True`` emits a
+SelectedRows gradient — (rows, values) — which GradientAccumulator keeps
+sparse and the optimizers apply row-wise; the PS Communicator ships it as
+push_sparse traffic).
+
+TPU-native design: a gradient for an (vocab, dim) embedding touched by N
+ids is carried as ``rows: (N,) int32`` + ``values: (N, dim)`` — never the
+dense (vocab, dim) scatter. Accumulation across microbatches/uses is LAZY
+concatenation (O(sum N), no vocab-sized buffer, and fully static-shaped so
+it works inside ``to_static`` traces). Consumers:
+
+* sparse-aware optimizers (SGD row update; Adam ``lazy_mode``) merge
+  duplicate rows with a size-padded ``jnp.unique`` + segment-sum (static
+  shapes, jit-safe) and scatter-add only the touched rows;
+* the PS ``Communicator.push_sparse`` ships (rows, values) directly;
+* everything else reads ``grad._data``, which densifies once on demand —
+  dense consumers keep working unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "SelectedRowsTensor"]
+
+
+class SelectedRows:
+    """(rows, values) sparse rows of a dense ``dense_shape`` tensor."""
+
+    __slots__ = ("rows", "values", "dense_shape")
+
+    def __init__(self, rows, values, dense_shape: Tuple[int, ...]):
+        self.rows = rows
+        self.values = values
+        self.dense_shape = tuple(dense_shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    def astype(self, dtype) -> "SelectedRows":
+        return SelectedRows(self.rows, self.values.astype(dtype),
+                            self.dense_shape)
+
+    def concat(self, other: "SelectedRows") -> "SelectedRows":
+        """Lazy accumulation: duplicate rows are allowed (scatter-add and
+        the merged consumers sum them)."""
+        if other.dense_shape != self.dense_shape:
+            raise ValueError(
+                f"SelectedRows shape mismatch: {self.dense_shape} vs "
+                f"{other.dense_shape}")
+        return SelectedRows(jnp.concatenate([self.rows, other.rows]),
+                            jnp.concatenate([self.values, other.values]),
+                            self.dense_shape)
+
+    def scale(self, s) -> "SelectedRows":
+        return SelectedRows(self.rows, self.values * s, self.dense_shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def merged(self) -> "SelectedRows":
+        """Deduplicate rows (values summed). Static-shaped (padded unique):
+        output keeps N slots; tail slots point at a guaranteed-unused
+        sentinel row index with zero values, so row-wise consumers can
+        scatter them harmlessly out of range (jit-safe: jnp clips/drops
+        out-of-bounds scatter indices)."""
+        n = self.rows.shape[0]
+        sentinel = self.dense_shape[0]  # one past the last valid row
+        uniq, inv = jnp.unique(self.rows, size=n, fill_value=sentinel,
+                               return_inverse=True)
+        summed = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                     num_segments=n)
+        return SelectedRows(uniq, summed, self.dense_shape)
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape}, "
+                f"values={self.values.shape}, dense={self.dense_shape})")
+
+
+class SelectedRowsTensor:
+    """``param.grad`` holder for sparse gradients.
+
+    Duck-types the slice of the Tensor surface gradient consumers touch;
+    ``._data`` densifies ON DEMAND (cached), so dense-only consumers work
+    transparently while sparse-aware ones (optimizer lazy paths, the PS
+    communicator) read ``.selected_rows`` and never pay the dense cost.
+    """
+
+    def __init__(self, sr: SelectedRows, name: Optional[str] = None):
+        self._sr: Optional[SelectedRows] = sr
+        self._dense: Optional[jax.Array] = None
+        self.name = name
+        self.stop_gradient = True
+        self.persistable = False
+
+    # -- sparse surface ------------------------------------------------------
+    def is_selected_rows(self) -> bool:
+        return self._sr is not None
+
+    @property
+    def selected_rows(self) -> Optional[SelectedRows]:
+        return self._sr
+
+    def accumulate_sparse(self, sr: SelectedRows) -> None:
+        if self._dense is not None:
+            self._dense = self._dense + sr.to_dense()
+        else:
+            self._sr = self._sr.concat(sr)
+
+    def accumulate_dense(self, g) -> None:
+        self._dense = self._data + g
+        self._sr = None
+
+    # -- dense (Tensor-compatible) surface -----------------------------------
+    @property
+    def _data(self):
+        if self._dense is None:
+            self._dense = self._sr.to_dense()
+        return self._dense
+
+    @_data.setter
+    def _data(self, value):
+        self._dense = value
+        self._sr = None
+
+    def _set_data(self, value) -> None:
+        self._data = value
+
+    @property
+    def dtype(self):
+        return self._sr.dtype if self._sr is not None else self._dense.dtype
+
+    @property
+    def shape(self):
+        return (self._sr.dense_shape if self._sr is not None
+                else tuple(self._dense.shape))
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._data)
+
+    def __repr__(self):
+        return f"SelectedRowsTensor({self._sr if self._sr is not None else self._dense.shape})"

@@ -70,6 +70,8 @@ class Tensor:
         if isinstance(data, Tensor):
             data = data._data
         self._data = data
+        if type(data).__name__ == "LazyValue":  # cheap check, hot path
+            data.owners.add(self)
         self.stop_gradient = stop_gradient
         self._grad: Optional["Tensor"] = None
         self._grad_node: Optional[GradNode] = None
@@ -86,6 +88,8 @@ class Tensor:
         ts = _tracing.trace_state()
         if ts is not None:
             ts.record_mutation("data", self)
+        if type(value).__name__ == "LazyValue":
+            value.owners.add(self)
         self._data = value
         self._version += 1
 
@@ -169,6 +173,14 @@ class Tensor:
             raise TraceBreakError(
                 "Tensor.numpy() is not available while tracing "
                 "inside paddle.jit.to_static")
+        if type(self._data).__name__ == "LazyValue":
+            # concrete read of a pending value: segment boundary — flush the
+            # recorded graph (the SOT graph-break point)
+            from . import lazy as _lazy
+            if self._data.array is None:
+                _lazy.flush()
+            if type(self._data).__name__ == "LazyValue":
+                self._data = self._data.array
         return np.asarray(self._data)
 
     def __array__(self, dtype=None):
@@ -419,6 +431,34 @@ _op_profile_hook: Optional[Callable[[str, float, float], None]] = None
 _op_graph_hook: Optional[Callable] = None
 
 
+def _lazy_apply(op_name, f, tensor_inputs, arrays, needs_grad):
+    """Segment-mode dispatch (full_graph=False partial-graph capture): the
+    op is RECORDED, outputs are LazyValue placeholders, and the tape node
+    carries only pure_fn — backward re-dispatches through apply() so the
+    gradient ops land in the (compiled) segment too."""
+    from . import lazy as _lazy
+    from .autograd import GradNode
+
+    out_lazies, multi = _lazy.record(op_name, f, arrays)
+    out_tensors = []
+    if needs_grad:
+        node = GradNode(op_name, None, tensor_inputs, len(out_lazies),
+                        tuple((lv.aval.shape, lv.aval.dtype)
+                              for lv in out_lazies),
+                        pure_fn=f, multi_out=multi)
+        for i, lv in enumerate(out_lazies):
+            t = Tensor(lv, stop_gradient=False)
+            t._grad_node = node
+            t._grad_index = i
+            out_tensors.append(t)
+    else:
+        for lv in out_lazies:
+            out_tensors.append(Tensor(lv, stop_gradient=True))
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]
+
+
 def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
           differentiable: bool = True, amp: bool = True, **static_kwargs) -> Any:
     """Dispatch one op: the TPU analogue of ad_func → Phi API → kernel.
@@ -462,6 +502,10 @@ def _apply_impl(op_name: str, fn: Callable, *tensor_inputs: Tensor,
                   for x, d in zip(xs, cast_targets)]
         r = fn(*xs, **static_kwargs) if static_kwargs else fn(*xs)
         return tuple(r) if isinstance(r, list) else r
+
+    from . import lazy as _lazy
+    if _lazy.active():
+        return _lazy_apply(op_name, f, tensor_inputs, arrays, needs_grad)
 
     if needs_grad:
         outs, vjp_fn = jax.vjp(f, *arrays)

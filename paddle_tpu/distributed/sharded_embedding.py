@@ -31,6 +31,10 @@ class ShardedEmbedding(Layer):
         self.num_embeddings = num_embeddings
         self.embedding_dim = embedding_dim
         self.padding_idx = padding_idx
+        # sparse=True: gradients come back as SelectedRows (rows, values) —
+        # the SelectedRows path PaddleRec tables rely on; push_sparse ships
+        # exactly those rows (see push_sparse_grad)
+        self.sparse = sparse
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=XavierUniform())
@@ -57,4 +61,26 @@ class ShardedEmbedding(Layer):
         return None, None
 
     def forward(self, ids):
-        return F.embedding(ids, self.weight, padding_idx=self.padding_idx)
+        return F.embedding(ids, self.weight, padding_idx=self.padding_idx,
+                           sparse=self.sparse)
+
+    def push_sparse_grad(self, communicator, table_name=None) -> bool:
+        """Ship this table's accumulated gradient to the PS communicator as
+        sparse (rows, values) traffic — the upstream push_sparse payload —
+        and clear it. A dense gradient (sparse=False) ships every row;
+        returns False when there is nothing to push."""
+        from ..core.selected_rows import SelectedRowsTensor
+
+        g = self.weight.grad
+        if g is None:
+            return False
+        name = table_name or self.weight.name
+        if isinstance(g, SelectedRowsTensor) and g.is_selected_rows():
+            sr = g.selected_rows.merged()
+            communicator.push_sparse(name, sr.rows, sr.values)
+        else:
+            communicator.push_sparse(
+                name, jnp.arange(self.num_embeddings, dtype=jnp.int32),
+                g._data)
+        self.weight.clear_grad()
+        return True

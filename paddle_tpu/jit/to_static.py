@@ -22,6 +22,7 @@ __all__ = ["StaticFunction", "to_static", "not_to_static", "ignore_module",
 _ENABLED = True
 
 _FALLBACK = object()  # cache sentinel: this signature graph-breaks to eager
+_SEGMENTED = object()  # cache sentinel: run via lazy compiled segments
 
 
 def _is_trace_failure(e: BaseException) -> bool:
@@ -86,10 +87,13 @@ class StaticFunction:
         self._fn = fn
         self._donate = donate_states
         # full_graph=False is the reference SOT contract: a trace failure
-        # (tensor-dependent Python control flow, unsupported op) falls back
-        # to eager for that call instead of raising — the graph-break
-        # analogue. Our default stays strict (full_graph=True) because the
-        # silent perf cliff is usually a bug the user wants to see.
+        # (tensor-dependent Python control flow) switches the signature to
+        # PARTIAL-GRAPH capture — the lazy segment executor (core/lazy.py)
+        # compiles the op runs around each break and re-runs Python as the
+        # control-flow interpreter, like upstream SOT's
+        # subgraph-with-guards. Our default stays strict (full_graph=True)
+        # because the silent perf change is usually a bug the user wants
+        # to see.
         self._full_graph = bool(full_graph)
         self._warned_fallback = False
         if not self._full_graph:
@@ -183,6 +187,8 @@ class StaticFunction:
             state_items = _state_registry.alive_items()
             key = (treedef, static_key, tuple(rid for rid, _ in state_items))
             entry = self._cache.get(key)
+        if entry is _SEGMENTED:
+            return self._run_segmented(args, kwargs, key)
         if entry is _FALLBACK:
             # memoized graph break (full_graph=False): skip re-tracing
             if self._iters > 1:
@@ -211,21 +217,51 @@ class StaticFunction:
                 raise
             # SOT-style graph break (upstream python/paddle/jit/sot/):
             # tracing failed (tensor-dependent Python control flow,
-            # unsupported op) — run eagerly instead, and memoize the break
-            # so later calls skip the (expensive, side-effect-repeating)
-            # re-trace
-            self._cache[key] = _FALLBACK
-            if not self._warned_fallback:
+            # unsupported op). Partial-graph capture: re-run through the
+            # lazy segment executor — compiled segments around the break,
+            # Python as the control-flow interpreter (core/lazy.py). Falls
+            # back to plain eager only if segmenting itself fails.
+            if self._iters > 1:
+                self._cache[key] = _FALLBACK
+                self._warn_break(e, "eager execution (iters_per_call)")
+                return self._run_iters_eager(args, kwargs)
+            self._cache[key] = _SEGMENTED
+            self._warn_break(e, "compiled-segment execution")
+            return self._run_segmented(args, kwargs, key)
+
+    def _warn_break(self, e, how: str) -> None:
+        if not self._warned_fallback:
+            import warnings
+            warnings.warn(
+                f"to_static(full_graph=False): tracing "
+                f"{getattr(self._fn, '__name__', '?')} failed "
+                f"({type(e).__name__}: {e}); falling back to {how}")
+            self._warned_fallback = True
+
+    def _run_segmented(self, args, kwargs, key):
+        """Graph-break mode: execute through the lazy segment recorder —
+        device work runs as cached compiled segments split at concrete
+        reads; Python runs every call and owns the control flow."""
+        from ..core import lazy as _lazy
+        try:
+            with _lazy.segment_mode():
+                return self._fn(*args, **kwargs)
+        except Exception as e:
+            # segment_mode.__exit__ flushed whatever had been recorded, so
+            # state mutations up to the failure are applied exactly once —
+            # re-running the fn here would double-apply them, so we never
+            # do. A LAZY-MACHINERY failure (an op touching the placeholder
+            # in a way the recorder can't stage) downgrades FUTURE calls to
+            # plain eager; genuine user errors keep the segmented path.
+            if "LazyValue" in str(e) or isinstance(e, NotImplementedError):
+                self._cache[key] = _FALLBACK
                 import warnings
                 warnings.warn(
-                    f"to_static(full_graph=False): tracing "
-                    f"{getattr(self._fn, '__name__', '?')} failed "
-                    f"({type(e).__name__}: {e}); falling back to eager "
-                    "execution")
-                self._warned_fallback = True
-            if self._iters > 1:
-                return self._run_iters_eager(args, kwargs)
-            return self._fn(*args, **kwargs)
+                    f"to_static(full_graph=False): segmented execution of "
+                    f"{getattr(self._fn, '__name__', '?')} cannot stage this "
+                    f"function ({type(e).__name__}: {e}); later calls run "
+                    "plain eager")
+            raise
 
     def _invoke(self, jitted, holder, state_tensors, arg_arrays, leaves,
                 key):

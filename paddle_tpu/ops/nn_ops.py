@@ -44,7 +44,56 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, jnp.zeros_like(out), out)
         return out
 
+    if sparse:
+        return _sparse_embedding(x, weight, f, padding_idx)
     return apply("embedding", f, x, weight)
+
+
+def _sparse_embedding(x, weight, f, padding_idx):
+    """``sparse=True``: the weight gradient is emitted as SelectedRows
+    (rows=the looked-up ids, values=the output cotangent rows) instead of a
+    dense (vocab, dim) scatter — upstream lookup_table's sparse-grad path
+    (paddle/phi/core/selected_rows.h). Only leaf weights qualify (a derived
+    weight needs the dense vjp to keep flowing); non-leaf or no-grad cases
+    fall back to the dense path."""
+    from ..core import lazy as _lazy
+    from ..core import tracing as _tracing
+    from ..core.autograd import GradNode
+    from ..core.selected_rows import SelectedRows
+    from ..core.tensor import Tensor
+
+    needs_grad = (_tracing.grad_enabled() and not weight.stop_gradient
+                  and weight._grad_node is None)
+    if not needs_grad or _lazy.active():
+        # segment mode stages ops through apply(); the manual sparse node
+        # reads ids eagerly, so it densifies there (correct, just dense)
+        return apply("embedding", f, x, weight)
+
+    ts = _tracing.trace_state()
+    for t in (x, weight):
+        from ..core.tensor import _is_tracer
+        if ts is not None and not _is_tracer(t._data):
+            ts.record_read(t)
+    ids = x._data.astype(jnp.int32)
+    out_arr = f(ids, weight._data)
+    dim_nd = weight._data.ndim - 1  # trailing embedding dims
+    vocab_shape = tuple(weight._data.shape)
+
+    def sparse_vjp(cot):
+        rows = ids.reshape(-1)
+        vals = cot.reshape((-1,) + cot.shape[cot.ndim - dim_nd:])
+        if padding_idx is not None:
+            vals = jnp.where((rows == padding_idx)[:, None],
+                             jnp.zeros_like(vals), vals)
+        return (None, SelectedRows(rows, vals, vocab_shape))
+
+    node = GradNode("embedding_sparse", sparse_vjp, (x, weight), 1,
+                    ((out_arr.shape, out_arr.dtype),), pure_fn=None,
+                    multi_out=False)
+    out = Tensor(out_arr, stop_gradient=False)
+    out._grad_node = node
+    out._grad_index = 0
+    return out
 
 
 register_op("embedding", embedding)

@@ -30,6 +30,22 @@ __all__ = [
 lr = lr_mod
 
 
+def _stochastic_round_bf16(x32, key):
+    """Stochastically round f32 -> bf16 (add uniform low bits, truncate).
+    Unbiased: E[round(x)] = x. Master-weight-free bf16 training depends on
+    it — round-to-nearest silently drops updates below ~2^-8 relative, so a
+    bf16 weight would stop learning once lr*update falls under its ulp.
+    (Reference keeps fp32 masters instead: python/paddle/amp/ O2 +
+    optimizer multi_precision; this is the TPU-native low-memory option.)"""
+    bits = jax.lax.bitcast_convert_type(x32.astype(jnp.float32), jnp.uint32)
+    rnd = jax.random.bits(key, bits.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = (bits + rnd) & jnp.uint32(0xFFFF0000)
+    out = jax.lax.bitcast_convert_type(rounded, jnp.float32)
+    # adding mantissa bits to inf/nan patterns would corrupt them
+    out = jnp.where(jnp.isfinite(x32), out, x32)
+    return out.astype(jnp.bfloat16)
+
+
 class _ClipBase:
     def __call__(self, params_grads):
         raise NotImplementedError
@@ -112,6 +128,12 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._group_wd = None  # active group's weight-decay override
         self._multi_precision = multi_precision
+        # None = default (fp32 masters for low-precision params, the
+        # reference multi_precision behavior); False = master-weight-free:
+        # low-precision params update in their own dtype (with stochastic
+        # rounding for bf16) — halves optimizer memory for bf16 training
+        self._use_master_weights: Optional[bool] = None
+        self._stochastic_rounding = True
         self._accumulators: Dict[str, Dict[int, Tensor]] = {}
         self._master_weights: Dict[int, Tensor] = {}
         # the global step is carried STATE (an int32 scalar tensor), not a
@@ -194,14 +216,48 @@ class Optimizer:
             g = g + coeff * p._data
         return g
 
+    # sparse (SelectedRows) gradient support: optimizers that can apply a
+    # row-wise update override this; None means "densify and take the dense
+    # path" (reading grad._data densifies transparently)
+    def _update_param_sparse(self, p, sr, lr_eff) -> bool:
+        return False
+
+    def _sparse_eligible(self, p, group) -> bool:
+        from ..core.selected_rows import SelectedRowsTensor
+        g = p.grad
+        if not (isinstance(g, SelectedRowsTensor) and g.is_selected_rows()):
+            return False
+        if type(self)._update_param_sparse is Optimizer._update_param_sparse:
+            return False
+        # clipping and coupled decay/regularizers read the full gradient —
+        # those configurations densify (upstream sparse grads have the same
+        # restriction: ClipGradByGlobalNorm densifies SelectedRows)
+        if ((group or {}).get("grad_clip") or self._grad_clip) is not None:
+            return False
+        if (group or {}).get("weight_decay") is not None or \
+                self._weight_decay is not None or \
+                getattr(p, "regularizer", None) is not None:
+            return False
+        return True
+
     def _collect_params_grads(self, group=None):
         params = group["params"] if group is not None else self._param_groups
         pg = [(p, p.grad._data) for p in params
-              if p.grad is not None and p.trainable]
+              if p.grad is not None and p.trainable
+              and not self._sparse_eligible(p, group)]
         clip = (group or {}).get("grad_clip") or self._grad_clip
         if clip is not None:
             pg = clip(pg)
         return pg
+
+    def _step_sparse_params(self, group, group_lr) -> None:
+        for p in group["params"]:
+            if p.grad is None or not p.trainable or \
+                    not self._sparse_eligible(p, group):
+                continue
+            lr_eff = group_lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else group_lr
+            self._update_param_sparse(p, p.grad.selected_rows, lr_eff)
 
     # --- the step -------------------------------------------------------------
     @property
@@ -253,7 +309,12 @@ class Optimizer:
 
     @no_grad()
     def step(self) -> None:
+        from ..core import lazy as _lazy
         from ..core.tracing import trace_state
+        # segment mode (full_graph=False partial capture): the update math
+        # below is raw jnp over state payloads — materialize the recorded
+        # forward/backward segment first
+        _lazy.flush_if_active()
         if trace_state() is None:
             # eager step after an external weight load: reconcile masters
             self._refresh_derived_state()
@@ -262,6 +323,7 @@ class Optimizer:
         for group in self._groups:
             self._group_wd = group.get("weight_decay")
             group_lr = base_lr * float(group.get("learning_rate", 1.0))
+            self._step_sparse_params(group, group_lr)
             for p, g in self._collect_params_grads(group):
                 g = self._decayed_grad(p, g)
                 lr_eff = group_lr * p.optimize_attr.get("learning_rate", 1.0) \
@@ -345,8 +407,24 @@ class Optimizer:
 
     set_dict = set_state_dict
 
+    def _narrow_write(self, new32, dtype):
+        """fp32 update -> storage dtype: THE write-narrowing policy, shared
+        by the per-param, fused-flat and sparse-row paths. bf16 rounds
+        stochastically when enabled (sub-ulp updates apply in expectation);
+        everything else is a plain cast (fp32: no-op)."""
+        if dtype == jnp.bfloat16 and self._stochastic_rounding:
+            from ..core.random import default_generator
+            return _stochastic_round_bf16(new32, default_generator.split_key())
+        return new32.astype(dtype)
+
+    def _param_write_back(self, p: Tensor, new_p32) -> None:
+        """Write an fp32 update into a master-weight-free param."""
+        p._set_data(self._narrow_write(new_p32, p._data.dtype))
+
     def _ensure_master(self, p: Tensor):
         """fp32 master weight for low-precision params (AMP O2)."""
+        if self._use_master_weights is False:
+            return None
         if p._data.dtype in (jnp.bfloat16, jnp.float16):
             m = self._master_weights.get(id(p))
             if m is None:
@@ -376,7 +454,27 @@ class SGD(Optimizer):
             p._set_data(new_m.astype(p._data.dtype))
             self._note_param_written(p)
         else:
-            p._set_data(p._data - lr_eff * g.astype(p._data.dtype))
+            self._param_write_back(
+                p, p._data.astype(jnp.float32) - lr_eff * g.astype(jnp.float32))
+
+    def _update_param_sparse(self, p, sr, lr_eff) -> bool:
+        """Row-wise SGD (upstream sgd kernel's SelectedRows overload):
+        touch only the looked-up rows — exact (SGD has no cross-row
+        state), so sparse SGD == dense SGD numerically."""
+        sr = sr.merged()
+        rows = sr.rows
+        delta = (-lr_eff * sr.values.astype(jnp.float32))
+        master = self._ensure_master(p)
+        if master is not None:
+            new_m = master._data.at[rows].add(delta, mode="drop")
+            master._set_data(new_m)
+            p._set_data(p._data.at[rows].set(
+                new_m[rows].astype(p._data.dtype), mode="drop"))
+            self._note_param_written(p)
+        else:
+            p._set_data(p._data.at[rows].add(delta.astype(p._data.dtype),
+                                             mode="drop"))
+        return True
 
 
 class Momentum(Optimizer):
@@ -409,26 +507,49 @@ class Momentum(Optimizer):
             p._set_data(new_m.astype(p._data.dtype))
             self._note_param_written(p)
         else:
-            p._set_data(p._data - (lr_eff * upd).astype(p._data.dtype))
+            self._param_write_back(
+                p, p._data.astype(jnp.float32) - lr_eff * upd)
 
 
 class Adam(Optimizer):
+    """``paddle.optimizer.Adam`` with two TPU-native memory knobs beyond the
+    reference surface (upstream python/paddle/optimizer/adam.py keeps fp32
+    m/v + fp32 masters unconditionally):
+
+    * ``moment_dtype``: dtype of the m/v accumulators ("float32" default,
+      "bfloat16" halves optimizer state; update math always runs in fp32).
+    * ``use_master_weights``: None keeps the reference behavior (fp32
+      masters for bf16/fp16 params); False trains master-weight-free — bf16
+      params update in-place with stochastic rounding
+      (``stochastic_rounding=False`` to disable).
+
+    bf16 m/v + master-free bf16 params cut per-param optimizer bytes from
+    16 (bf16 p + f32 master/m/v) to 6 (bf16 p/m/v) — the difference between
+    816M and ~1.9B params fitting a 16GB chip.
+    """
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, use_multi_tensor=False, name=None):
+                 multi_precision=False, use_multi_tensor=False,
+                 moment_dtype="float32", use_master_weights=None,
+                 stochastic_rounding=True, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._use_multi_tensor = use_multi_tensor
+        self._lazy_mode = bool(lazy_mode)
+        self._moment_dtype = jnp.dtype(moment_dtype)
+        self._use_master_weights = use_master_weights
+        self._stochastic_rounding = bool(stochastic_rounding)
         self._fused = None  # flat-buffer state, built by _materialize_state
         if self._groups is not None:
             self._materialize_state()
 
     def _create_accumulators(self, p):
-        self._acc("moment1", p, dtype=jnp.float32)
-        self._acc("moment2", p, dtype=jnp.float32)
+        self._acc("moment1", p, dtype=self._moment_dtype)
+        self._acc("moment2", p, dtype=self._moment_dtype)
 
     # --- fused (multi-tensor) path -------------------------------------------
     # One flat f32 buffer each for moment1/moment2/master instead of 3 arrays
@@ -466,20 +587,30 @@ class Adam(Optimizer):
             n = int(np.prod(p._data.shape)) if p._data.shape else 1
             offsets.append((total, n))
             total += n
+        # master-weight-free + all-bf16 params: the flat buffer (the
+        # authoritative storage) itself lives in bf16 and updates with
+        # stochastic rounding; mixed/fp32 params keep the fp32 flat buffer
+        flat_dtype = jnp.bfloat16 if (
+            self._use_master_weights is False and params
+            and all(p._data.dtype == jnp.bfloat16 for p in params)) \
+            else jnp.float32
         master = jnp.concatenate(
-            [p._data.reshape(-1).astype(jnp.float32) for p in params]) \
-            if params else jnp.zeros((0,), jnp.float32)
+            [p._data.reshape(-1).astype(flat_dtype) for p in params]) \
+            if params else jnp.zeros((0,), flat_dtype)
         fused = self._fused
         if fused is not None and fused["total"] == total:
             # re-materialize (e.g. after amp.decorate cast): refresh master
-            fused["master"]._set_data(master)
+            fused["master"]._set_data(master.astype(fused["master"]._data.dtype))
             fused["params"] = params
             self._fused_sync_versions()
             return
         self._fused = {
             "params": params, "offsets": offsets, "total": total,
-            "m": self._reg_flat("moment1", jnp.zeros((total,), jnp.float32)),
-            "v": self._reg_flat("moment2", jnp.zeros((total,), jnp.float32)),
+            "flat_dtype": flat_dtype,
+            "m": self._reg_flat("moment1",
+                                jnp.zeros((total,), self._moment_dtype)),
+            "v": self._reg_flat("moment2",
+                                jnp.zeros((total,), self._moment_dtype)),
             "master": self._reg_flat("master", master),
             "wd_mask": None,  # scalar 1.0 unless apply_decay_param_fun set
             "lr_scale": None,
@@ -533,7 +664,7 @@ class Adam(Optimizer):
             p = fs["params"][i]
             off, n = fs["offsets"][i]
             master = master.at[off:off + n].set(
-                p._data.reshape(-1).astype(jnp.float32))
+                p._data.reshape(-1).astype(master.dtype))
         fs["master"]._set_data(master)
         self._fused_sync_versions()
 
@@ -545,7 +676,14 @@ class Adam(Optimizer):
 
     def _on_params_cast(self) -> None:
         if self._fused is not None:
-            # the flat master already holds the PRE-cast fp32 values (built at
+            fs = self._fused
+            if self._use_master_weights is False and fs["params"] and all(
+                    p._data.dtype == jnp.bfloat16 for p in fs["params"]):
+                # master-weight-free: after the O2 cast the flat buffer IS
+                # the bf16 storage (no fp32 shadow kept)
+                fs["flat_dtype"] = jnp.bfloat16
+                fs["master"]._set_data(fs["master"]._data.astype(jnp.bfloat16))
+            # the flat master already holds the PRE-cast values (built at
             # construction); treat the cast as an internal write, don't clobber
             self._fused_sync_versions()
         else:
@@ -624,19 +762,23 @@ class Adam(Optimizer):
             g_flat = jnp.clip(g_flat, clip.min, clip.max)
         b1, b2 = self._beta1, self._beta2
         t = self._step_t._data.astype(jnp.float32)
-        new_m = b1 * fs["m"]._data + (1 - b1) * g_flat
-        new_v = b2 * fs["v"]._data + (1 - b2) * g_flat * g_flat
+        # fp32 update math over possibly-narrow storage (casts fuse into the
+        # elementwise chain; a bf16 state buffer never widens in HBM)
+        m32 = fs["m"]._data.astype(jnp.float32)
+        v32 = fs["v"]._data.astype(jnp.float32)
+        new_m = b1 * m32 + (1 - b1) * g_flat
+        new_v = b2 * v32 + (1 - b2) * g_flat * g_flat
         if mask is not None:
-            new_m = mask * new_m + (1.0 - mask) * fs["m"]._data
-            new_v = mask * new_v + (1.0 - mask) * fs["v"]._data
-        fs["m"]._set_data(new_m)
-        fs["v"]._set_data(new_v)
+            new_m = mask * new_m + (1.0 - mask) * m32
+            new_v = mask * new_v + (1.0 - mask) * v32
+        fs["m"]._set_data(new_m.astype(self._moment_dtype))
+        fs["v"]._set_data(new_v.astype(self._moment_dtype))
         mhat = new_m / (1 - b1 ** t)
         vhat = new_v / (1 - b2 ** t)
         lr_vec = base_lr if fs["lr_scale"] is None \
             else base_lr * fs["lr_scale"]._data
         wd = getattr(self, "_wd_coeff", 0.0)
-        base = fs["master"]._data
+        base = fs["master"]._data.astype(jnp.float32)
         upd = base
         if wd:
             decay = lr_vec * wd if fs["wd_mask"] is None \
@@ -644,16 +786,19 @@ class Adam(Optimizer):
             upd = upd * (1.0 - decay)
         upd = upd - lr_vec * mhat / (jnp.sqrt(vhat) + self._epsilon)
         new_p = upd if mask is None else mask * upd + (1.0 - mask) * base
-        fs["master"]._set_data(new_p)
+        new_flat = self._narrow_write(new_p, fs["flat_dtype"])
+        fs["master"]._set_data(new_flat)
         for ok, (p, (off, n)) in zip(live, zip(fs["params"], fs["offsets"])):
             if ok:
-                p._set_data(new_p[off:off + n].reshape(p._data.shape)
+                p._set_data(new_flat[off:off + n].reshape(p._data.shape)
                             .astype(p._data.dtype))
         self._fused_sync_versions()
 
     @no_grad()
     def step(self) -> None:
+        from ..core import lazy as _lazy
         from ..core.tracing import trace_state
+        _lazy.flush_if_active()
         if trace_state() is None:
             self._refresh_derived_state()
         if not self._use_multi_tensor or self._fused is None:
@@ -717,15 +862,18 @@ class Adam(Optimizer):
     set_dict = set_state_dict
 
     def _adam_core(self, p, g, lr_eff, decoupled_wd=0.0):
-        m = self._acc("moment1", p, dtype=jnp.float32)
-        v = self._acc("moment2", p, dtype=jnp.float32)
+        m = self._acc("moment1", p, dtype=self._moment_dtype)
+        v = self._acc("moment2", p, dtype=self._moment_dtype)
         g32 = g.astype(jnp.float32)
         b1, b2 = self._beta1, self._beta2
         t = self._step_t._data.astype(jnp.float32)
-        new_m = b1 * m._data + (1 - b1) * g32
-        new_v = b2 * v._data + (1 - b2) * g32 * g32
-        m._set_data(new_m)
-        v._set_data(new_v)
+        # update math in fp32 regardless of storage dtype (XLA fuses the
+        # widen/narrow casts into the elementwise chain — no fp32 copy of
+        # the state ever materializes in HBM)
+        new_m = b1 * m._data.astype(jnp.float32) + (1 - b1) * g32
+        new_v = b2 * v._data.astype(jnp.float32) + (1 - b2) * g32 * g32
+        m._set_data(new_m.astype(self._moment_dtype))
+        v._set_data(new_v.astype(self._moment_dtype))
         mhat = new_m / (1 - b1 ** t)
         vhat = new_v / (1 - b2 ** t)
         master = self._ensure_master(p)
@@ -738,10 +886,59 @@ class Adam(Optimizer):
             p._set_data(new_p.astype(p._data.dtype))
             self._note_param_written(p)
         else:
-            p._set_data(new_p.astype(p._data.dtype))
+            self._param_write_back(p, new_p)
 
     def _update_param(self, p, g, lr_eff):
         self._adam_core(p, g, lr_eff)
+
+    def _sparse_eligible(self, p, group) -> bool:
+        # Adam's moments decay every step for every row; skipping untouched
+        # rows is the explicit ``lazy_mode`` approximation (upstream adam
+        # kernel's lazy_mode flag) — without it, densify
+        return (self._lazy_mode
+                and getattr(self, "_lr_ratio", None) is None
+                and super()._sparse_eligible(p, group))
+
+    def _update_param_sparse(self, p, sr, lr_eff) -> bool:
+        """lazy_mode row update: moments and weights advance only for the
+        touched rows (upstream adam_dense_param_sparse_grad kernel)."""
+        m = self._acc("moment1", p, dtype=self._moment_dtype)
+        v = self._acc("moment2", p, dtype=self._moment_dtype)
+        sr = sr.merged()
+        rows = sr.rows
+        g32 = sr.values.astype(jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        t = self._step_t._data.astype(jnp.float32)
+        m_rows = m._data[rows].astype(jnp.float32)
+        v_rows = v._data[rows].astype(jnp.float32)
+        new_m = b1 * m_rows + (1 - b1) * g32
+        new_v = b2 * v_rows + (1 - b2) * g32 * g32
+        m._set_data(m._data.at[rows].set(new_m.astype(self._moment_dtype),
+                                         mode="drop"))
+        v._set_data(v._data.at[rows].set(new_v.astype(self._moment_dtype),
+                                         mode="drop"))
+        mhat = new_m / (1 - b1 ** t)
+        vhat = new_v / (1 - b2 ** t)
+        master = self._ensure_master(p)
+        base = master._data if master is not None \
+            else p._data.astype(jnp.float32)
+        base_rows = base[rows]
+        wd = getattr(self, "_wd_coeff", 0.0)
+        decay_fn = getattr(self, "_apply_decay_param_fun", None)
+        if wd and (decay_fn is None or decay_fn(p.name)):
+            # decoupled decay on the touched rows only (lazy semantics)
+            base_rows = base_rows * (1.0 - lr_eff * wd)
+        new_rows = base_rows - lr_eff * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if master is not None:
+            new_master = master._data.at[rows].set(new_rows, mode="drop")
+            master._set_data(new_master)
+            p._set_data(p._data.at[rows].set(
+                new_rows.astype(p._data.dtype), mode="drop"))
+            self._note_param_written(p)
+        else:
+            p._set_data(p._data.at[rows].set(
+                self._narrow_write(new_rows, p._data.dtype), mode="drop"))
+        return True
 
 
 class AdamW(Adam):
@@ -750,10 +947,15 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, use_multi_tensor=False, name=None):
+                 multi_precision=False, use_multi_tensor=False,
+                 moment_dtype="float32", use_master_weights=None,
+                 stochastic_rounding=True, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
-                         use_multi_tensor=use_multi_tensor, name=name)
+                         use_multi_tensor=use_multi_tensor,
+                         moment_dtype=moment_dtype,
+                         use_master_weights=use_master_weights,
+                         stochastic_rounding=stochastic_rounding, name=name)
         self._wd_coeff = weight_decay.coeff if hasattr(weight_decay, "coeff") \
             else float(weight_decay or 0.0)
         self._apply_decay_param_fun = apply_decay_param_fun

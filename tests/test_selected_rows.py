@@ -1,0 +1,185 @@
+"""SelectedRows-analogue sparse embedding gradients (SURVEY §2.1 —
+upstream paddle/phi/core/selected_rows.h + lookup_table sparse grads).
+
+Contract: ``embedding(..., sparse=True)`` grads carry (rows, values), never
+the dense (vocab, dim) scatter; accumulation is lazy concatenation; sparse
+SGD is EXACT vs dense; Adam lazy_mode matches dense when every row is
+touched; dense-only consumers transparently densify.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.selected_rows import SelectedRows, SelectedRowsTensor
+
+VOCAB, DIM = 50, 8
+
+
+def _loss(emb, ids):
+    return (emb(paddle.to_tensor(ids)) ** 2).sum()
+
+
+def test_sparse_grad_matches_dense():
+    paddle.seed(1)
+    ids = np.array([[3, 7, 3], [1, 0, 7]], np.int64)
+
+    paddle.seed(5)
+    dense = nn.Embedding(VOCAB, DIM, sparse=False)
+    _loss(dense, ids).backward()
+    gd = dense.weight.grad._data
+
+    paddle.seed(5)
+    sp = nn.Embedding(VOCAB, DIM, sparse=True)
+    _loss(sp, ids).backward()
+    g = sp.weight.grad
+    assert isinstance(g, SelectedRowsTensor) and g.is_selected_rows()
+    sr = g.selected_rows
+    assert sr.rows.shape == (6,)          # one row per looked-up id
+    assert sr.values.shape == (6, DIM)    # never (VOCAB, DIM)
+    np.testing.assert_allclose(np.asarray(sr.to_dense()), np.asarray(gd),
+                               rtol=1e-6)
+    # transparent densify for dense consumers
+    np.testing.assert_allclose(np.asarray(g._data), np.asarray(gd),
+                               rtol=1e-6)
+
+
+def test_sparse_accumulation_is_lazy_concat():
+    paddle.seed(2)
+    emb = nn.Embedding(VOCAB, DIM, sparse=True)
+    _loss(emb, np.array([[1, 2]], np.int64)).backward()
+    _loss(emb, np.array([[2, 3]], np.int64)).backward()
+    sr = emb.weight.grad.selected_rows
+    assert sr.rows.shape == (4,)  # concatenated, duplicates kept lazily
+    merged = sr.merged()
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(np.asarray(merged.to_dense()), dense,
+                               rtol=1e-6)
+    # row 2 got contributions from both microbatches
+    assert np.abs(dense[2]).sum() > 0 and np.abs(dense[1]).sum() > 0
+
+
+def test_padding_idx_rows_zeroed():
+    paddle.seed(3)
+    emb = nn.Embedding(VOCAB, DIM, padding_idx=0, sparse=True)
+    _loss(emb, np.array([[0, 4]], np.int64)).backward()
+    sr = emb.weight.grad.selected_rows
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense[0], 0.0)
+
+
+def test_sparse_sgd_exact_vs_dense():
+    ids_seq = [np.array([[3, 7]], np.int64), np.array([[1, 3]], np.int64),
+               np.array([[7, 7]], np.int64)]
+
+    def run(sparse):
+        paddle.seed(8)
+        emb = nn.Embedding(VOCAB, DIM, sparse=sparse)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=emb.parameters())
+        for ids in ids_seq:
+            _loss(emb, ids).backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight._data)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6, atol=1e-7)
+
+
+def test_sparse_adam_lazy_matches_dense_when_all_rows_touched():
+    all_ids = np.arange(VOCAB, dtype=np.int64)[None, :]
+
+    def run(sparse):
+        paddle.seed(9)
+        emb = nn.Embedding(VOCAB, DIM, sparse=sparse)
+        opt = paddle.optimizer.Adam(learning_rate=0.05, lazy_mode=sparse,
+                                    parameters=emb.parameters())
+        for _ in range(3):
+            _loss(emb, all_ids).backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight._data)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_adam_lazy_touches_only_seen_rows():
+    paddle.seed(10)
+    emb = nn.Embedding(VOCAB, DIM, sparse=True)
+    before = np.asarray(emb.weight._data).copy()
+    opt = paddle.optimizer.AdamW(learning_rate=0.05, lazy_mode=True,
+                                 weight_decay=0.1,
+                                 parameters=emb.parameters())
+    _loss(emb, np.array([[4, 9]], np.int64)).backward()
+    opt.step()
+    after = np.asarray(emb.weight._data)
+    changed = np.where(np.abs(after - before).sum(axis=1) > 0)[0]
+    np.testing.assert_array_equal(changed, [4, 9])
+    # moments exist only as full buffers but untouched rows stayed zero
+    m = next(iter(opt._accumulators["moment1"].values()))
+    mrows = np.where(np.abs(np.asarray(m._data)).sum(axis=1) > 0)[0]
+    np.testing.assert_array_equal(mrows, [4, 9])
+
+
+def test_sparse_grad_under_to_static():
+    """Compiled train step: sparse grads are traced values; the lazy-concat
+    accumulation and row updates are static-shaped, so the whole step
+    compiles — and the grad is consumed in-step (cleared), so no dense
+    materialization escapes."""
+    paddle.seed(11)
+    emb = nn.Embedding(VOCAB, DIM, sparse=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=emb.parameters())
+
+    @paddle.jit.to_static
+    def step(ids):
+        loss = (emb(ids) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    ids = paddle.to_tensor(np.array([[3, 7, 1]], np.int64))
+    l0 = float(step(ids))
+    l1 = float(step(ids))
+    assert l1 < l0
+
+    # parity vs eager dense
+    paddle.seed(11)
+    ref = nn.Embedding(VOCAB, DIM, sparse=False)
+    ropt = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=ref.parameters())
+    for _ in range(2):
+        (ref(paddle.to_tensor(np.array([[3, 7, 1]], np.int64))) ** 2) \
+            .sum().backward()
+        ropt.step()
+        ropt.clear_grad()
+    np.testing.assert_allclose(np.asarray(emb.weight._data),
+                               np.asarray(ref.weight._data),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_grad_clip_densifies():
+    """Clipping reads the full gradient: sparse-eligibility is withdrawn
+    and the dense path runs (correctness over memory)."""
+    paddle.seed(12)
+    emb = nn.Embedding(VOCAB, DIM, sparse=True)
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=emb.parameters(),
+        grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    _loss(emb, np.array([[3, 7]], np.int64)).backward()
+    opt.step()  # must not raise; falls back to dense
+    opt.clear_grad()
+
+
+def test_merged_dedupes_rows():
+    sr = SelectedRows(jnp.asarray([2, 5, 2, 2], jnp.int32),
+                      jnp.ones((4, 3), jnp.float32), (10, 3))
+    m = sr.merged()
+    d = np.asarray(m.to_dense())
+    np.testing.assert_allclose(d[2], 3.0)
+    np.testing.assert_allclose(d[5], 1.0)
+    assert np.abs(d).sum() == pytest.approx(12.0)

@@ -273,4 +273,5 @@ def test_full_graph_false_falls_back_to_eager():
         out2 = soft(x)  # second call keeps working (no re-warn needed)
     np.testing.assert_allclose(out.numpy(), np.full((2, 2), 2.0))
     np.testing.assert_allclose(out2.numpy(), np.full((2, 2), 2.0))
-    assert any("falling back to eager" in str(x.message) for x in w)
+    assert any("falling back to compiled-segment" in str(x.message)
+               for x in w)
