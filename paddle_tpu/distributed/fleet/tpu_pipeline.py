@@ -144,9 +144,7 @@ def _has_persistable_buffers(layer) -> bool:
     return any(id(v) not in param_ids for v in layer.state_dict().values())
 
 
-def find_uniform_run(entries, num_stages):
-    """Longest contiguous run of structurally identical Layer entries whose
-    length admits >=1 block per stage. Returns (start, n_used) or None."""
+def _stackable_keys(entries):
     from ...nn.layer import Layer as _Layer
 
     keys = []
@@ -156,25 +154,59 @@ def find_uniform_run(entries, num_stages):
             keys.append(None)  # boundary: can't be stacked
         else:
             keys.append(_entry_key(layer))
-    best = None  # (length, start)
-    i = 0
-    while i < len(keys):
-        if keys[i] is None:
-            i += 1
+    return keys
+
+
+def find_uniform_run(entries, num_stages):
+    """Find the best contiguous run stackable over ``num_stages`` stages.
+
+    A run of length S*q is stackable when its structural keys are PERIODIC
+    with period q: entry (s*q + t) matches entry t for every stage s and
+    slot t. q == 1 is the classic uniform-transformer case; q > 1 covers
+    heterogeneous repeating stacks (BERT-shaped alternating attention/MLP
+    entries, conv/attention interleaves) — the stage body simply runs its
+    q slots in order, each slot with its own (S, ...) stacked parameters.
+
+    Returns (start, n_used) with n_used = S*q*ceil-free (largest multiple
+    of num_stages*q that fits), or None when nothing is stackable.
+    """
+    S = int(num_stages)
+    keys = _stackable_keys(entries)
+    n = len(keys)
+    best = None  # (n_used, -q, start)
+    # maximal boundary-free segments
+    seg_start = 0
+    while seg_start < n:
+        if keys[seg_start] is None:
+            seg_start += 1
             continue
-        j = i
-        while j < len(keys) and keys[j] == keys[i]:
-            j += 1
-        if best is None or (j - i) > best[0]:
-            best = (j - i, i)
-        i = j
+        seg_end = seg_start
+        while seg_end < n and keys[seg_end] is not None:
+            seg_end += 1
+        seg_len = seg_end - seg_start
+        max_q = min(seg_len // S, 32)  # periods past 32 slots are implausible
+        for q in range(1, max_q + 1):
+            period = q
+            # slide a window of length S*q*r — take the longest periodic
+            # prefix at each offset; a simple O(len^2) scan is fine at
+            # model-definition sizes
+            for off in range(seg_start, seg_end - S * period + 1):
+                length = 0
+                while off + length < seg_end and \
+                        keys[off + length] == keys[off + length % period]:
+                    length += 1
+                repeats = length // period
+                usable_rep = (repeats // S) * S
+                if usable_rep >= S:
+                    n_used = usable_rep * period
+                    cand = (n_used, -period, -off)
+                    if best is None or cand > best:
+                        best = (n_used, -period, -off)
+        seg_start = seg_end
     if best is None:
         return None
-    n, start = best
-    usable = (n // num_stages) * num_stages
-    if usable < num_stages:  # fewer blocks than stages
-        return None
-    return start, usable
+    n_used, neg_q, neg_off = best
+    return -neg_off, n_used
 
 
 class PipelinedStack:
@@ -222,8 +254,10 @@ class PipelinedStack:
         run = find_uniform_run(entries, self._S)
         if run is None:
             raise NonUniformStackError(
-                "PipelineLayer has no uniform block run stackable over "
-                f"{self._S} stages; the grad-accumulation fallback applies")
+                "PipelineLayer has no stage-periodic block run stackable "
+                f"over {self._S} stages (and none of its repeating segments "
+                "is free of persistable buffers); the grad-accumulation "
+                "fallback applies")
         start, n_used = run
         self._k = n_used // self._S  # blocks per stage
 

@@ -286,6 +286,7 @@ def test_engine_state_dict_roundtrip_and_eval():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_fleet_pipeline_parity_compiled_fast():
     """Fast-subset guard for the pipelined engine: pp=2 under to_static,
     2 steps, loss parity vs serial (full matrix in the slow-marked tests)."""
@@ -343,3 +344,89 @@ def test_fleet_pipeline_parity_compiled_fast():
     finally:
         set_hybrid_communicate_group(None)
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous (periodic) stacks: BERT-shaped alternating entries pipeline
+# ---------------------------------------------------------------------------
+
+class Attnish(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(D, D)
+
+    def forward(self, x):
+        return x + paddle.tanh(self.fc(x))
+
+
+class MLPish(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.up = nn.Linear(D, 2 * D)
+        self.down = nn.Linear(2 * D, D)
+
+    def forward(self, x):
+        return x + self.down(paddle.nn.functional.gelu(self.up(x)))
+
+
+def test_find_uniform_run_periodic():
+    from paddle_tpu.distributed.fleet.tpu_pipeline import find_uniform_run
+
+    # (Attn, MLP) x 8 over 4 stages: period 2, 16 entries usable
+    entries = []
+    for _ in range(8):
+        entries.append((Attnish(), None))
+        entries.append((MLPish(), None))
+    assert find_uniform_run(entries, 4) == (0, 16)
+    # with edges around it
+    bounded = [(Emb(), None)] + entries + [(Head(), None)]
+    start, used = find_uniform_run(bounded, 4)
+    assert (start, used) == (1, 16)
+    # 6 repeats over 4 stages: only 4 repeats (8 entries) usable
+    short = entries[:12]
+    start, used = find_uniform_run(short, 4)
+    assert used == 8
+
+
+@pytest.mark.parametrize("dp,pp", [
+    pytest.param(1, 2),
+    pytest.param(2, 4, marks=pytest.mark.slow),
+])
+def test_fleet_pipeline_periodic_stack_parity(dp, pp):
+    """BERT-shaped PipelineLayer (alternating attention/MLP entries) takes
+    the truly pipelined path and matches the serial trajectory."""
+    def build():
+        layers = [LayerDesc(Emb)]
+        for _ in range(4):
+            layers.append(LayerDesc(Attnish))
+            layers.append(LayerDesc(MLPish))
+        layers.append(LayerDesc(Head))
+        return PipelineLayer(layers=layers, loss_fn=_mse)
+
+    rng = np.random.default_rng(11)
+    data_np = rng.normal(0, 1, (8, D)).astype(np.float32)
+    label_np = rng.normal(0, 1, (8, 4)).astype(np.float32)
+
+    paddle.seed(321)
+    set_hybrid_communicate_group(None)
+    serial = build()
+    s_losses = _train(serial, serial.parameters(),
+                      paddle.to_tensor(data_np), paddle.to_tensor(label_np))
+
+    paddle.seed(321)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": dp, "pp_degree": pp}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = build()
+        wrapped = fleet.distributed_model(model)
+        assert wrapped._engine is not None, "periodic stack must pipeline"
+        assert wrapped._engine._k == (4 // pp) * 2  # repeats/stage x period
+        p_losses = _train(wrapped, wrapped.parameters(),
+                          paddle.to_tensor(data_np),
+                          paddle.to_tensor(label_np))
+    finally:
+        set_hybrid_communicate_group(None)
+
+    np.testing.assert_allclose(p_losses, s_losses, rtol=2e-4, atol=2e-5)
