@@ -30,7 +30,7 @@ __all__ = [
     "QuantConfig", "PTQ", "QAT", "quant_dequant",
     "AbsMaxObserver", "MovingAverageAbsMaxObserver", "PerChannelAbsMaxObserver",
     "HistObserver", "FakeQuanterWithAbsMax",
-    "QuantedLinear", "QuantedConv2D", "LinearQuanterDequanter",
+    "QuantedLinear", "QuantedConv2D", "LinearQuanterDequanter", "Int8Linear",
 ]
 
 
@@ -301,6 +301,44 @@ class QuantedConv2D(Layer):
                         c.groups, c.data_format)
 
 
+class Int8Linear(Layer):
+    """Deployed int8 linear: the PTQ→deployment kernel. Holds the int8
+    weight + per-out-channel scales and the CALIBRATED static activation
+    scale; forward quantizes the activation and EXECUTES the matmul in
+    int8 with int32 MXU accumulation (same dot the llm.int8 path uses —
+    nn/quant.py), then rescales. This is what lands in the saved
+    inference graph, so the Predictor replays a true int8 program
+    (upstream: Paddle Inference's quantized passes turning qdq graphs
+    into int8 kernels)."""
+
+    def __init__(self, inner, act_scale: Tensor, w_int8: Tensor,
+                 w_scale: Tensor):
+        super().__init__()
+        self.register_buffer("act_scale", act_scale)     # scalar absmax
+        self.register_buffer("w_int8", w_int8)           # (k, n) int8
+        self.register_buffer("w_scale", w_scale)         # (n,) absmax
+        self.bias = getattr(inner, "bias", None)
+
+    def forward(self, x):
+        from ..core.tensor import apply as _apply
+
+        def f(xv, sa, qw, sw):
+            import jax
+            sa = jnp.maximum(sa, 1e-9)
+            qx = jnp.clip(jnp.round(xv / sa * 127.0), -127, 127) \
+                .astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                qx, qw, (((xv.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return acc.astype(jnp.float32) * (sa * sw / (127.0 * 127.0))
+
+        out = _apply("int8_linear", f, x, self.act_scale, self.w_int8,
+                     self.w_scale, differentiable=False)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
 class LinearQuanterDequanter(Layer):
     """Frozen quant-dequant with baked scales — what ``convert`` leaves in
     the inference graph."""
@@ -359,8 +397,12 @@ class QAT:
     def quantize(self, model: Layer, inplace: bool = True) -> Layer:
         return _quantize(model, self.config)
 
-    def convert(self, model: Layer, inplace: bool = True) -> Layer:
-        return _convert(model)
+    def convert(self, model: Layer, inplace: bool = True,
+                int8_kernels: bool = False) -> Layer:
+        """Bake calibrated scales. ``int8_kernels=True`` replaces quanted
+        Linears by :class:`Int8Linear` (true int8 dots in the saved graph)
+        instead of simulated quant-dequant; Conv stays qdq."""
+        return _convert(model, int8_kernels=int8_kernels)
 
 
 class PTQ(QAT):
@@ -369,20 +411,41 @@ class PTQ(QAT):
     ``convert`` bakes the calibrated scales."""
 
 
-def _convert(model: Layer) -> Layer:
+def _convert(model: Layer, int8_kernels: bool = False) -> Layer:
     """Replace quanted wrappers by inner layers with frozen quant-dequant on
-    their inputs/weights (scales from the observers/quanters)."""
+    their inputs/weights (scales from the observers/quanters), or — with
+    ``int8_kernels`` — by true int8-executing layers."""
+    import jax.numpy as jnp
 
     def bake(layer):
         if not isinstance(layer, (QuantedLinear, QuantedConv2D)):
             return None
         inner = layer.inner
         wq = layer.weight_quanter
+        aq = layer.activation_quanter
+        w_axis_ok = wq is not None and (
+            wq.quant_axis() is None or
+            wq.quant_axis() in (-1, inner.weight._data.ndim - 1))
+        if int8_kernels and isinstance(layer, QuantedLinear) \
+                and wq is not None and aq is not None \
+                and getattr(wq, "quant_bits", 8) == 8 \
+                and getattr(aq, "quant_bits", 8) == 8 \
+                and aq.quant_axis() is None and w_axis_ok:
+            # per-OUT-channel weight scales only (axis -1 of the (in, out)
+            # weight); other axes keep the simulated qdq path below
+            w = inner.weight._data
+            sw = jnp.asarray(wq.scales()._data, jnp.float32)
+            if wq.quant_axis() is None:
+                sw = jnp.broadcast_to(sw, (w.shape[-1],))
+            sw = jnp.maximum(sw, 1e-9)
+            q = jnp.clip(jnp.round(w / sw[None, :] * 127.0), -127, 127) \
+                .astype(jnp.int8)
+            return Int8Linear(inner, aq.scales(), Tensor(q),
+                              Tensor(sw))
         if wq is not None:
             qdq = quant_dequant(inner.weight, wq.scales(),
                                 getattr(wq, "quant_bits", 8), wq.quant_axis())
             inner.weight.set_value(np.asarray(qdq._data))
-        aq = layer.activation_quanter
         if aq is None:
             return inner
         pre = LinearQuanterDequanter(aq.scales(),
