@@ -2,8 +2,9 @@
 
 Vocab 1M x dim 64, batch of 512 lookups per step, SGD. The dense path
 materializes a (1M, 64) fp32 gradient (256MB) every step; the sparse path
-carries 512 rows (128KB). Measures per-step wall time and the compiled
-train step's temp-buffer footprint (XLA memory_analysis) for both.
+carries 512 rows (~132KB: fp32 values + int32 row ids). Measures per-step
+wall time for both; the gradient byte counts in the JSON are the payload
+sizes implied by those layouts.
 
 Run: python benchmarks/bench_sparse_embedding.py   (CPU or chip)
 """
@@ -62,7 +63,8 @@ def main():
         "sparse_ms_per_step": round(rows[True], 2),
         "speedup": round(rows[False] / rows[True], 2),
         "dense_grad_bytes": VOCAB * DIM * 4,
-        "sparse_grad_bytes": BATCH * (DIM * 4 + 4),
+        "sparse_grad_bytes": BATCH * (DIM * 4 + 4),  # fp32 rows + int32 ids
+        # (ids enter as int64 but the sparse path stores int32 rows)
         "device": str(jax.devices()[0]),
     }))
 

@@ -45,6 +45,32 @@ def _spec_of(t: Tensor):
 _ASYNC: List[Any] = []  # pending (ckptr | thread) handles
 
 
+def _globalize(arr):
+    """Multi-process saves can only serialize GLOBAL arrays. A host-local
+    array (single-device scalar like a step counter, or any value created
+    outside the mesh) is converted to a globally-replicated array — every
+    process must hold the same value, which is the only sane meaning of
+    checkpointing such a key from N processes."""
+    if jax.process_count() == 1 or not arr.is_fully_addressable:
+        return arr
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    host = np.asarray(arr)
+    # guard the replication assumption: divergent per-rank values would be
+    # silently dropped (orbax writes the primary replica only) — make that
+    # a hard error instead
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(host)
+    if not np.allclose(np.asarray(gathered),
+                       np.asarray(gathered)[0:1], equal_nan=True):
+        raise ValueError(
+            "checkpointing a host-local array whose value differs across "
+            "processes; make it a global (mesh-placed) array or reconcile "
+            "it before save_state_dict")
+    mesh = Mesh(np.array(jax.devices()), ("_ckpt",))
+    return jax.make_array_from_callback(
+        host.shape, NamedSharding(mesh, P()), lambda idx: host[idx])
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, async_save: bool = False) -> None:
@@ -56,7 +82,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         if isinstance(v, Tensor):
             # raw (possibly sharded) jax.Array — orbax writes per-shard;
             # no np.asarray host gather here
-            arrays[k] = v._data
+            arrays[k] = _globalize(v._data)
             meta[k] = {"shape": list(v._data.shape),
                        "dtype": str(v._data.dtype),
                        "spec": _spec_of(v)}
@@ -112,9 +138,13 @@ def async_save_state_dict(state_dict, path, **kw):
 
 
 def _target_sharding(t: Tensor):
+    """The destination's concrete sharding (NamedSharding for mesh-placed
+    tensors, SingleDeviceSharding for plain ones) — orbax restores exactly
+    the shards it needs for it; a checkpoint written by OTHER processes'
+    devices can only be read by passing a concrete local sharding."""
     try:
         sh = t._data.sharding
-        if isinstance(sh, jax.sharding.NamedSharding):
+        if isinstance(sh, jax.sharding.Sharding):
             return sh
     except Exception:
         pass

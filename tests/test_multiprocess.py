@@ -52,3 +52,78 @@ def test_two_process_dp_parity(tmp_path):
         g = 2.0 / 4 * x.T @ (pred - y)
         w = w - 0.1 * g
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
+
+
+def test_four_process_hybrid_dp2mp4_and_checkpoint(tmp_path):
+    """4 processes x 2 devices = 8-device global mesh running a hybrid
+    dp2 x mp4 train step with loss parity vs a serial reference, then a
+    distributed checkpoint saved ACROSS the four processes and loaded back
+    in THIS single process on a different topology (reshard-on-load across
+    process counts). (VERDICT r2 missing item 5 / SURVEY §4 TestDistBase.)"""
+    ckpt = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env.pop("PADDLE_PLATFORM", None)
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--log_dir", str(tmp_path / "logs"),
+         os.path.join(ROOT, "tests", "workers", "hybrid_multiproc_worker.py"),
+         ckpt],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    logs = ""
+    logdir = tmp_path / "logs"
+    if logdir.is_dir():
+        for name in sorted(os.listdir(logdir)):
+            with open(logdir / name) as f:
+                logs += f"--- {name} ---\n" + f.read()
+    assert out.returncode == 0, (
+        f"launcher rc={out.returncode}\nstdout={out.stdout}\n"
+        f"stderr={out.stderr}\nlogs={logs}")
+    assert "ckpt_saved" in logs, logs
+    got = None
+    for line in logs.splitlines():
+        if line.startswith("losses "):
+            got = [float(v) for v in line.split()[1:]]
+    assert got is not None, logs
+
+    # serial numpy reference: identical seeds/model as the worker
+    B, D, H = 8, 16, 32
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (B, D)).astype(np.float32)
+    y = rng.normal(0, 1, (B, 1)).astype(np.float32)
+    w1 = rng.normal(0, 0.3, (D, H)).astype(np.float32)
+    w2 = rng.normal(0, 0.3, (H, 1)).astype(np.float32)
+    ref = []
+    for _ in range(4):
+        h = np.tanh(x @ w1)
+        pred = h @ w2
+        err = pred - y
+        ref.append(float(np.mean(err ** 2)))
+        dpred = 2.0 / (B * 1) * err
+        g2 = h.T @ dpred
+        dh = dpred @ w2.T * (1 - h ** 2)
+        g1 = x.T @ dh
+        w1 = w1 - 0.1 * g1
+        w2 = w2 - 0.1 * g2
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-6)
+
+    # load the 4-process checkpoint HERE (1 process, 8 virtual devices) on
+    # a different mesh layout; values must match the serial final weights
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.checkpoint import load_state_dict
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("a", "b"))
+    target = {"model": {
+        "w1": Tensor(jax.device_put(jnp.zeros((D, H)),
+                                    NamedSharding(mesh, P(None, "a")))),
+        "w2": Tensor(jax.device_put(jnp.zeros((H, 1)),
+                                    NamedSharding(mesh, P("a", None))))},
+        "meta": {"steps": Tensor(jnp.zeros(()))}}
+    load_state_dict(target, ckpt)
+    np.testing.assert_allclose(np.asarray(target["model"]["w1"]._data), w1,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(target["model"]["w2"]._data), w2,
+                               rtol=1e-5, atol=1e-6)
+    assert float(np.asarray(target["meta"]["steps"]._data)) == 4.0
