@@ -45,30 +45,39 @@ def _spec_of(t: Tensor):
 _ASYNC: List[Any] = []  # pending (ckptr | thread) handles
 
 
-def _globalize(arr):
-    """Multi-process saves can only serialize GLOBAL arrays. A host-local
-    array (single-device scalar like a step counter, or any value created
-    outside the mesh) is converted to a globally-replicated array — every
-    process must hold the same value, which is the only sane meaning of
-    checkpointing such a key from N processes."""
-    if jax.process_count() == 1 or not arr.is_fully_addressable:
-        return arr
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    host = np.asarray(arr)
-    # guard the replication assumption: divergent per-rank values would be
-    # silently dropped (orbax writes the primary replica only) — make that
-    # a hard error instead
+def _globalize_host_local(arrays: Dict[str, Any]) -> None:
+    """Multi-process saves can only serialize GLOBAL arrays. Host-local
+    entries (single-device scalars like step counters, or values created
+    outside the mesh) are converted IN PLACE to globally-replicated arrays.
+    Every process must hold the same value — that is checked with ONE
+    pytree allgather over all such keys (not one collective per key), and
+    the written value is rank 0's (deterministic: never whichever replica
+    orbax picks as primary)."""
+    if jax.process_count() == 1:
+        return
+    local = {k: np.asarray(a) for k, a in arrays.items()
+             if a.is_fully_addressable}
+    if not local:
+        return
     from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(host)
-    if not np.allclose(np.asarray(gathered),
-                       np.asarray(gathered)[0:1], equal_nan=True):
-        raise ValueError(
-            "checkpointing a host-local array whose value differs across "
-            "processes; make it a global (mesh-placed) array or reconcile "
-            "it before save_state_dict")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    gathered = multihost_utils.process_allgather(local)
     mesh = Mesh(np.array(jax.devices()), ("_ckpt",))
-    return jax.make_array_from_callback(
-        host.shape, NamedSharding(mesh, P()), lambda idx: host[idx])
+    repl = NamedSharding(mesh, P())
+    for k, host in local.items():
+        g = np.asarray(gathered[k])
+        exact = not np.issubdtype(host.dtype, np.inexact)
+        same = np.array_equal(g, np.broadcast_to(g[0:1], g.shape)) if exact \
+            else np.allclose(g, g[0:1], equal_nan=True)
+        if not same:
+            raise ValueError(
+                f"checkpoint key {k!r} is a host-local array whose value "
+                "differs across processes; make it a global (mesh-placed) "
+                "array or reconcile it before save_state_dict")
+        canonical = g[0]  # rank 0's value: deterministic content
+        arrays[k] = jax.make_array_from_callback(
+            canonical.shape, repl,
+            lambda idx, _c=canonical: _c[idx])
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
@@ -82,12 +91,13 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         if isinstance(v, Tensor):
             # raw (possibly sharded) jax.Array — orbax writes per-shard;
             # no np.asarray host gather here
-            arrays[k] = _globalize(v._data)
+            arrays[k] = v._data
             meta[k] = {"shape": list(v._data.shape),
                        "dtype": str(v._data.dtype),
                        "spec": _spec_of(v)}
         else:
             meta[k] = {"value": v}
+    _globalize_host_local(arrays)
     with open(os.path.join(path, "metadata.json"), "w") as f:
         json.dump(meta, f)
 
