@@ -23,6 +23,50 @@ import time
 import numpy as np
 
 
+def smoke() -> None:
+    """On-chip regression surface beyond the headline number: run every
+    example entry point (the five BASELINE configs) on the real device and
+    report one JSON line. ``python bench.py --smoke``."""
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    cases = [
+        ("train_resnet.py", ["--steps", "2", "--batch", "8",
+                             "--image-size", "32", "--arch", "resnet18"]),
+        ("finetune_bert.py", ["--steps", "2"]),
+        ("train_ppyoloe.py", ["--steps", "1", "--image-size", "64"]),
+        ("train_llama_hybrid.py", ["--dp", "1", "--mp", "1", "--steps", "2"]),
+        ("train_deepfm.py", ["--steps", "2", "--batch", "32"]),
+    ]
+    env = dict(os.environ)
+    env.pop("PADDLE_PLATFORM", None)  # run on whatever the real device is
+    results = {}
+    ok = True
+    for script, args in cases:
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.join(root, "examples", script),
+                 *args],
+                capture_output=True, text=True, timeout=900, env=env,
+                cwd=root)
+            passed = out.returncode == 0 and "loss" in out.stdout
+        except subprocess.TimeoutExpired:
+            out = None
+            passed = False
+        ok = ok and passed
+        results[script] = {"ok": passed,
+                           "secs": round(time.perf_counter() - t0, 1)}
+        if not passed:
+            results[script]["tail"] = "timeout" if out is None else \
+                (out.stdout + out.stderr)[-400:]
+    print(json.dumps({"metric": "examples_on_chip_smoke",
+                      "value": sum(r["ok"] for r in results.values()),
+                      "unit": "examples_passing", "vs_baseline": 1.0 if ok
+                      else 0.0, "detail": results}))
+    sys.exit(0 if ok else 1)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -113,4 +157,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--smoke" in sys.argv:
+        smoke()
+    else:
+        main()

@@ -237,11 +237,14 @@ def _fit_block(length: int, want: int, floor: int = 128):
     aligned trailing block dims."""
     length, want = int(length), int(want)
     if length <= want:
-        # full-length single tile: still require sublane alignment (Mosaic
-        # pads the lane dim but an unaligned second-minor dim, e.g. 300,
-        # cannot be validated by the CPU interpret-mode tests) — unaligned
-        # short lengths take the XLA fallback instead
-        return length if length % 8 == 0 else None
+        # full-length single tile: must be LANE-aligned (128) — the
+        # backward kernels slice the (B, H, L) lse/delta refs along their
+        # minor dimension in block_q steps, and Mosaic on real TPUs
+        # rejects sub-128 strides there ("cannot statically prove that
+        # index in dimension 2 is a multiple of 128"; found by the
+        # bench --smoke run of train_llama_hybrid at seq 64). Short
+        # sequences lose nothing on the XLA fallback.
+        return length if length % 128 == 0 else None
     b0 = min(want, length)
     for b in range(b0 - b0 % floor, floor - 1, -floor):
         if length % b == 0:

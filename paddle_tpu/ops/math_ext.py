@@ -385,12 +385,20 @@ def _tensor_apply_(x, func):
 
 
 def _to_sparse_coo(x, sparse_dim=None):
+    """reference: Tensor.to_sparse_coo(sparse_dim) — the FIRST sparse_dim
+    dims become COO indices; trailing dims stay dense (the hybrid layout
+    sparse Conv/BatchNorm consume: indices [N,H,W] or [N,D,H,W] with dense
+    channel values)."""
     from ..sparse import sparse_coo_tensor
-    arr = x._data
-    nz = jnp.nonzero(jnp.asarray(arr))
-    indices = jnp.stack(nz)
-    values = arr[nz]
-    return sparse_coo_tensor(indices, values, tuple(arr.shape))
+    arr = jnp.asarray(x._data)
+    if sparse_dim is None or sparse_dim >= arr.ndim:
+        nz = jnp.nonzero(arr)
+        return sparse_coo_tensor(jnp.stack(nz), arr[nz], tuple(arr.shape))
+    sd = int(sparse_dim)
+    # a site is active when ANY trailing-dense element is nonzero
+    mask = jnp.any(arr != 0, axis=tuple(range(sd, arr.ndim)))
+    nz = jnp.nonzero(mask)
+    return sparse_coo_tensor(jnp.stack(nz), arr[nz], tuple(arr.shape))
 
 
 register_op("index_fill", index_fill, methods=("index_fill",))

@@ -271,3 +271,78 @@ def _bind_existing_methods():
 
 
 _bind_existing_methods()
+
+
+# ---------------------------------------------------------------------------
+# top-level tail (round-3 probe): add_n / remainder / rank / shape /
+# shard_index (upstream python/paddle/tensor/ surface)
+# ---------------------------------------------------------------------------
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a list of tensors (reference: paddle.add_n)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    ts = [ensure_tensor(t) for t in inputs]
+    return apply("add_n", lambda *xs: functools.reduce(jnp.add, xs), *ts)
+
+
+def remainder(x, y, name=None):
+    """Python-style modulo (alias of paddle.mod)."""
+    return _REG["mod"](x, y)
+
+
+def rank(x, name=None):
+    """Tensor of the input's rank (reference: paddle.rank returns a 0-D
+    int32 tensor, usable in static graphs)."""
+    from ..core.tensor import Tensor
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x._data.ndim, jnp.int32), stop_gradient=True)
+
+
+def shape(x, name=None):
+    """1-D int32 tensor holding the input's shape (reference: paddle.shape).
+    Static shapes on XLA: the values are compile-time constants."""
+    from ..core.tensor import Tensor
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x._data.shape, jnp.int32), stop_gradient=True)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,
+                name=None):
+    """Recompute global ids into shard-local ids (reference:
+    paddle.shard_index; the vocab-parallel embedding helper): ids whose
+    shard (id // shard_size) equals ``shard_id`` map to id - shard_id *
+    shard_size; everything else becomes ``ignore_value``."""
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for nshards {nshards}")
+    x = ensure_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def f(ids):
+        local = ids - shard_id * shard_size
+        mine = (ids // shard_size) == shard_id
+        return jnp.where(mine, local, ignore_value)
+
+    return apply("shard_index", f, x, differentiable=False)
+
+
+import functools  # noqa: E402  (used by add_n)
+
+register_op("add_n", add_n)
+register_op("remainder", remainder, inplace_method="remainder_")
+register_op("rank", rank)
+register_op("shape", shape)
+register_op("shard_index", shard_index)
+
+_rtm("rank", rank)
+_rtm("shape_tensor", shape)
+
+
+def is_tensor(x):
+    """reference: paddle.is_tensor."""
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+register_op("is_tensor", is_tensor)

@@ -495,3 +495,63 @@ def cast(x, index_dtype=None, value_dtype=None):
 
 
 from . import nn  # noqa: E402,F401
+
+
+# ---------------------------------------------------------------------------
+# round-3 surface wave: mv / addmm / slice + unary tail
+# (upstream python/paddle/sparse/ + paddle/phi/kernels/sparse/)
+# ---------------------------------------------------------------------------
+
+def mv(a, x) -> Tensor:
+    """sparse (M, N) @ dense vector (N,) -> dense (M,)."""
+    if not isinstance(x, Tensor) or x._data.ndim != 1:
+        raise TypeError("sparse.mv expects a dense 1-D vector")
+    from ..ops.manipulation import reshape as _reshape
+    out = matmul(a, _reshape(x, [-1, 1]))
+    return _reshape(out, [-1])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0) -> Tensor:
+    """beta * input + alpha * (x @ y) with sparse ``x`` (reference:
+    paddle.sparse.addmm's sparse-dense-dense form)."""
+    prod = matmul(x, y)
+    return input * beta + prod * alpha
+
+
+def slice(x, axes, starts, ends):
+    """Slice a COO tensor along ``axes`` (reference: paddle.sparse.slice).
+    Pattern-level filter: rows whose coordinates fall inside the window
+    keep their values with shifted indices."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.slice expects a sparse tensor")
+    x = coalesce(x)
+    idx = np.asarray(x._indices)
+    vals = x._values
+    shape = list(x._shape)
+    keep = np.ones(idx.shape[1], bool)
+    for ax, s, e in zip(axes, starts, ends):
+        ax = int(ax) % len(shape)
+        s = int(s) + shape[ax] if int(s) < 0 else int(s)
+        e = int(e) + shape[ax] if int(e) < 0 else int(e)
+        e = min(e, shape[ax])
+        keep &= (idx[ax] >= s) & (idx[ax] < e)
+        shape[ax] = e - s
+    sel = np.where(keep)[0]
+    new_idx = idx[:, sel]
+    for ax, s, _e in zip(axes, starts, ends):
+        ax = int(ax) % len(shape)
+        s = int(s) + x._shape[ax] if int(s) < 0 else int(s)
+        new_idx[ax] = new_idx[ax] - s
+    from ..core.tensor import apply as _apply
+    new_vals = _apply("sparse_slice_gather",
+                      lambda v: v[jnp.asarray(sel)], vals)
+    return SparseCooTensor(jnp.asarray(new_idx), new_vals, shape, True)
+
+
+isnan = _unary("isnan", jnp.isnan)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+__all__ += ["mv", "addmm", "slice", "isnan", "rad2deg", "deg2rad"]

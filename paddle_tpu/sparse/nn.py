@@ -61,38 +61,45 @@ class Softmax(Layer):
         return SparseCooTensor(coo._indices, vals, coo._shape, True)
 
 
-class _SparseConv3D(Layer):
-    """Shared impl for Conv3D / SubmConv3D on NDHWC COO inputs."""
+class _SparseConvND(Layer):
+    """Shared impl for the 2-D/3-D sparse convs on channels-last COO
+    inputs (indices over [N, *spatial], dense channel values). Lowered as
+    scatter-to-dense -> XLA conv -> re-sparsify (or gather at the input
+    sites for submanifold convs)."""
 
     SUBM = False
+    NDIM = 3  # spatial rank
+    DATA_FORMAT = "NDHWC"
+    DIMNUMS = ("NDHWC", "DHWIO", "NDHWC")
 
     def __init__(self, in_channels: int, out_channels: int,
                  kernel_size=3, stride=1, padding=0, dilation=1, groups=1,
                  padding_mode: str = "zeros", weight_attr=None,
-                 bias_attr=None, data_format: str = "NDHWC"):
+                 bias_attr=None, data_format: str = None):
         super().__init__()
-        if data_format != "NDHWC":
-            raise ValueError("sparse conv expects NDHWC")
-        k = ((kernel_size,) * 3 if isinstance(kernel_size, int)
+        nd = self.NDIM
+        if data_format not in (None, self.DATA_FORMAT):
+            raise ValueError(f"sparse conv expects {self.DATA_FORMAT}")
+        k = ((kernel_size,) * nd if isinstance(kernel_size, int)
              else tuple(kernel_size))
         self.kernel_size = k
-        self.stride = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
-        self.padding = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
-        self.dilation = (dilation,) * 3 if isinstance(dilation, int) else tuple(dilation)
+        self.stride = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+        self.padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+        self.dilation = (dilation,) * nd if isinstance(dilation, int) else tuple(dilation)
         self.groups = groups
         self.in_channels = in_channels
         self.out_channels = out_channels
         if self.SUBM:
-            if self.stride != (1, 1, 1):
-                raise ValueError("SubmConv3D requires stride 1")
+            if self.stride != (1,) * nd:
+                raise ValueError("submanifold sparse conv requires stride 1")
             # submanifold gathers output at *input* coordinates, so the conv
             # must preserve spatial dims: 2p == dilation*(k-1) per axis
             for p, d, kk in zip(self.padding, self.dilation, k):
                 if 2 * p != d * (kk - 1):
                     raise ValueError(
-                        "SubmConv3D requires size-preserving padding "
-                        "(2*padding == dilation*(kernel-1)); got padding="
-                        f"{self.padding}, dilation={self.dilation}, "
+                        "submanifold sparse conv requires size-preserving "
+                        "padding (2*padding == dilation*(kernel-1)); got "
+                        f"padding={self.padding}, dilation={self.dilation}, "
                         f"kernel={k}")
         # reference kernel layout: [kd, kh, kw, in/groups, out]
         self.weight = self.create_parameter(
@@ -102,15 +109,17 @@ class _SparseConv3D(Layer):
             (out_channels,), attr=bias_attr, is_bias=True)
 
     def forward(self, x: SparseCooTensor) -> SparseCooTensor:
-        if x.sparse_dim != 4 or x.dense_dim != 1:
+        nd = self.NDIM
+        if x.sparse_dim != nd + 1 or x.dense_dim != 1:
             raise ValueError(
-                "sparse Conv3D expects COO with indices [N,D,H,W] and dense "
-                "channel values")
+                f"sparse Conv{nd}D expects COO with indices over "
+                f"[N, *{nd} spatial dims] and dense channel values")
         idx = x._indices
         shape = x._shape
         subm = self.SUBM
         stride, padding, dilation = self.stride, self.padding, self.dilation
         groups = self.groups
+        dimnums = self.DIMNUMS
 
         def fn(v, w, b):
             # bias deliberately NOT added here: it belongs only at retained
@@ -121,19 +130,24 @@ class _SparseConv3D(Layer):
                 window_strides=stride,
                 padding=[(p, p) for p in padding],
                 rhs_dilation=dilation,
-                dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+                dimension_numbers=dimnums,
                 feature_group_count=groups)
             if subm:
                 return out[tuple(idx)] + b
             return out
 
         if self.SUBM:
-            vals = apply("subm_conv3d", fn, x._values, self.weight, self.bias)
-            return SparseCooTensor(idx, vals, shape[:4] + (self.out_channels,),
+            vals = apply(f"subm_conv{nd}d", fn, x._values, self.weight,
+                         self.bias)
+            return SparseCooTensor(idx, vals,
+                                   shape[:nd + 1] + (self.out_channels,),
                                    x._coalesced)
-        out_dense = apply("sparse_conv3d", fn, x._values, self.weight,
+        out_dense = apply(f"sparse_conv{nd}d", fn, x._values, self.weight,
                           self.bias)
         return _dense_to_coo(out_dense, self.bias)
+
+
+_SparseConv3D = _SparseConvND  # back-compat alias
 
 
 def _dense_to_coo(x: Tensor, bias: Optional[Tensor] = None) -> SparseCooTensor:
@@ -217,3 +231,43 @@ class BatchNorm(Layer):
             vals = apply("sparse_batch_norm_infer", fn, x._values,
                          self.weight, self.bias, rm, rv)
         return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+
+
+# ---------------------------------------------------------------------------
+# round-3 surface wave: activations + 2-D sparse convs
+# (upstream python/paddle/sparse/nn/)
+# ---------------------------------------------------------------------------
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope: float = 0.01):
+        super().__init__()
+        self.negative_slope = float(negative_slope)
+
+    def forward(self, x):
+        from . import _unary
+        return _unary("leaky_relu",
+                      lambda v: jnp.where(v >= 0, v,
+                                          self.negative_slope * v))(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import _unary
+        return _unary("relu6", lambda v: jnp.clip(v, 0.0, 6.0))(x)
+
+
+class _SparseConv2D(_SparseConvND):
+    NDIM = 2
+    DATA_FORMAT = "NHWC"
+    DIMNUMS = ("NHWC", "HWIO", "NHWC")
+
+
+class Conv2D(_SparseConv2D):
+    SUBM = False
+
+
+class SubmConv2D(_SparseConv2D):
+    SUBM = True
+
+
+__all__ += ["LeakyReLU", "ReLU6", "Conv2D", "SubmConv2D"]

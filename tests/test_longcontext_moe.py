@@ -164,7 +164,11 @@ class TestFlashTileFitting:
         assert _fit_block(1024, 512) == 512
         assert _fit_block(768, 512) == 384   # largest 128-multiple divisor
         assert _fit_block(1280, 512) == 256
-        assert _fit_block(96, 512) == 96     # short seq: one full block
+        assert _fit_block(256, 512) == 256   # short seq: one full block
+        # sub-128 sequences are NOT pallas-tileable: the backward kernels
+        # slice lse/delta along the lane dim, which real-TPU Mosaic
+        # requires 128-aligned (found on-chip by bench --smoke)
+        assert _fit_block(96, 512) is None
         # unaligned lengths stay off the Pallas path (XLA fallback)
         assert _fit_block(1000, 512) is None
         assert _fit_block(1001, 512) is None

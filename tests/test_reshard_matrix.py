@@ -136,7 +136,7 @@ def test_hlo_partial_consumption_reduce_scatter():
                                out_specs=P("x")))
     txt = fn.lower(
         jax.ShapeDtypeStruct((8, 16), jnp.float32)).compile().as_text()
-    assert "reduce-scatter" in txt or "all-reduce" not in txt
+    assert "reduce-scatter" in txt and "all-reduce" not in txt
 
 
 def test_hlo_partial_to_replicate_all_reduce():

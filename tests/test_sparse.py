@@ -199,3 +199,84 @@ def test_sparse_batchnorm():
     bn.eval()
     y2 = bn(x)
     assert y2.values().numpy().shape == (10, 4)
+
+
+# ---------------------------------------------------------------------------
+# round-3 surface wave: mv/addmm/slice/unary tail, hybrid to_sparse_coo,
+# 2-D sparse convs, LeakyReLU/ReLU6
+# ---------------------------------------------------------------------------
+
+def test_mv_and_addmm():
+    dense = np.array([[0, 2, 0], [3, 0, 4.0]], np.float32)
+    idx = np.stack(np.nonzero(dense))
+    coo = sparse.sparse_coo_tensor(idx, dense[tuple(idx)], dense.shape)
+    v = paddle.to_tensor(np.array([1.0, 2, 3], np.float32))
+    np.testing.assert_allclose(sparse.mv(coo, v).numpy(), dense @ [1, 2, 3])
+    y = paddle.to_tensor(np.ones((3, 2), np.float32))
+    inp = paddle.to_tensor(np.ones((2, 2), np.float32))
+    np.testing.assert_allclose(
+        sparse.addmm(inp, coo, y, beta=0.5, alpha=2.0).numpy(),
+        0.5 + 2 * (dense @ np.ones((3, 2), np.float32)), rtol=1e-6)
+
+
+def test_slice_and_unary_tail():
+    dense = np.array([[0, 2, 0, 1], [3, 0, 4.0, 0]], np.float32)
+    idx = np.stack(np.nonzero(dense))
+    coo = sparse.sparse_coo_tensor(idx, dense[tuple(idx)], dense.shape)
+    sl = sparse.slice(coo, [1], [1], [3])
+    np.testing.assert_allclose(sl.to_dense().numpy(), dense[:, 1:3])
+    sl2 = sparse.slice(coo, [0, 1], [1, 0], [2, 4])
+    np.testing.assert_allclose(sl2.to_dense().numpy(), dense[1:2, :])
+    assert not bool(np.any(sparse.isnan(coo).values().numpy()))
+    np.testing.assert_allclose(sparse.rad2deg(coo).values().numpy(),
+                               np.rad2deg(dense[tuple(idx)]), rtol=1e-6)
+
+
+def test_to_sparse_coo_hybrid_dims():
+    dense = np.zeros((1, 4, 4, 3), np.float32)
+    dense[0, 1, 2] = [1, 2, 3]
+    x = paddle.to_tensor(dense).to_sparse_coo(3)
+    assert x.sparse_dim == 3 and x.dense_dim == 1
+    np.testing.assert_allclose(x.to_dense().numpy(), dense)
+    full = paddle.to_tensor(dense).to_sparse_coo(4)
+    assert full.sparse_dim == 4 and full.dense_dim == 0
+    np.testing.assert_allclose(full.to_dense().numpy(), dense)
+
+
+def test_sparse_conv2d_and_subm():
+    paddle.seed(0)
+    dense = np.zeros((1, 8, 8, 3), np.float32)
+    dense[0, 2, 3] = [1, 2, 3]
+    dense[0, 5, 5] = [4, 5, 6]
+    x = paddle.to_tensor(dense).to_sparse_coo(3)
+
+    subm = sparse.nn.SubmConv2D(3, 4, kernel_size=3, padding=1)
+    out = subm(x)
+    assert out.shape == [1, 8, 8, 4]
+    np.testing.assert_array_equal(np.asarray(out.indices().numpy()),
+                                  np.asarray(x.indices().numpy()))
+
+    conv = sparse.nn.Conv2D(3, 4, kernel_size=3, stride=2, padding=1)
+    out2 = conv(x)
+    assert out2.shape == [1, 4, 4, 4]
+    # dense reference at the retained sites
+    import jax
+    import jax.numpy as jnp
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), conv.weight._data, (2, 2), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    got = np.asarray(out2.to_dense().numpy())
+    want = np.asarray(ref)
+    sites = np.any(got != 0, axis=-1)
+    np.testing.assert_allclose(
+        got[sites], (want + np.asarray(conv.bias._data))[sites], rtol=1e-4)
+
+
+def test_sparse_activations():
+    dense = np.array([[-2.0, 0, 8.0]], np.float32)
+    idx = np.stack(np.nonzero(dense))
+    coo = sparse.sparse_coo_tensor(idx, dense[tuple(idx)], dense.shape)
+    lr = sparse.nn.LeakyReLU(0.1)(coo)
+    np.testing.assert_allclose(lr.values().numpy(), [-0.2, 8.0], rtol=1e-6)
+    r6 = sparse.nn.ReLU6()(coo)
+    np.testing.assert_allclose(r6.values().numpy(), [0.0, 6.0])
