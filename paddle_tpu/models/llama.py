@@ -257,8 +257,9 @@ class LlamaModel(nn.Layer):
         if self.config.scan_layers:
             raise NotImplementedError(
                 "scan_layers is a training-path structure; rebuild the "
-                "model with scan_layers=False (loading the same weights "
-                "via the stacked state_dict) for cached generation")
+                "model with scan_layers=False and load the converted "
+                "weights (models.llama.scan_to_layered_state_dict) for "
+                "cached generation")
         new_caches = []
         for layer, c in zip(self.layers, caches):
             x, nc = layer(x, self.rope_cos, self.rope_sin, attn_mask, cache=c)
@@ -324,6 +325,75 @@ class LlamaForCausalLM(nn.Layer):
         c = self.config
         attn = 12 * c.num_hidden_layers * c.hidden_size * seq_len
         return 6.0 * n + attn
+
+
+def scan_to_layered_state_dict(sd):
+    """Convert a ``scan_layers=True`` state_dict (stacked ``model.scan_*``
+    keys, leaves (L, ...)) to the per-layer layout
+    (``model.layers.{i}.{name}``) — the bridge that lets a scan-trained
+    checkpoint load into a ``scan_layers=False`` model for cached
+    generation (the one layout restriction LlamaModel documents)."""
+    out = {}
+    for k, v in sd.items():
+        if ".scan_" not in k:
+            out[k] = v
+        else:
+            prefix, flat = k.split(".scan_", 1)
+            name = flat.replace("_", ".")
+            # param names contain underscores themselves (q_proj.weight →
+            # q_proj_weight); reverse by trying progressively: the real
+            # layer attribute path uses dots between modules only
+            arr = v._data if hasattr(v, "_data") else v
+            for i in range(arr.shape[0]):
+                out[f"{prefix}.layers.{i}.{_unflatten_scan_name(flat)}"] = \
+                    Tensor(arr[i], stop_gradient=True)
+    return out
+
+
+def _unflatten_scan_name(flat: str) -> str:
+    """scan key names flatten '.' to '_' (q_proj_weight); rebuild the
+    dotted path against the known decoder-layer attribute names."""
+    known = ("input_layernorm", "post_attention_layernorm", "self_attn",
+             "mlp", "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
+             "up_proj", "down_proj", "weight", "bias")
+    parts = []
+    rest = flat
+    while rest:
+        for cand in sorted(known, key=len, reverse=True):
+            if rest == cand or rest.startswith(cand + "_"):
+                parts.append(cand)
+                rest = rest[len(cand) + 1:]
+                break
+        else:
+            parts.append(rest)
+            rest = ""
+    return ".".join(parts)
+
+
+def layered_to_scan_state_dict(sd, num_layers: int):
+    """Inverse of :func:`scan_to_layered_state_dict`: stack
+    ``model.layers.{i}.{name}`` keys into ``model.scan_{name}``."""
+    import re
+
+    out = {}
+    groups = {}
+    for k, v in sd.items():
+        m = re.match(r"(.*)\.layers\.(\d+)\.(.+)$", k)
+        if m is None:
+            out[k] = v
+            continue
+        prefix, i, name = m.group(1), int(m.group(2)), m.group(3)
+        groups.setdefault((prefix, name), {})[i] = \
+            v._data if hasattr(v, "_data") else v
+    for (prefix, name), per_layer in groups.items():
+        if len(per_layer) != num_layers:
+            raise ValueError(
+                f"layer group {name!r} has {len(per_layer)} of "
+                f"{num_layers} layers")
+        stacked = jnp.stack([per_layer[i] for i in range(num_layers)], 0)
+        out[f"{prefix}.scan_{name.replace('.', '_')}"] = \
+            Tensor(stacked, stop_gradient=True)
+    return out
 
 
 def _maybe_parallel_linear(row: bool = False):

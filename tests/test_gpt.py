@@ -161,3 +161,39 @@ class TestScanLayers:
         for layer in m.model.layers:
             for p in layer.parameters():
                 assert not p.trainable
+
+
+class TestScanLayoutConversion:
+    """scan_layers checkpoints convert to the per-layer layout (and back)
+    so cached generation is reachable from a scan-trained model."""
+
+    def test_scan_to_layered_roundtrip_and_generate(self):
+        from paddle_tpu.models.llama import (
+            LlamaConfig, LlamaForCausalLM, layered_to_scan_state_dict,
+            scan_to_layered_state_dict)
+
+        paddle.seed(5)
+        cfg_s = LlamaConfig.tiny(vocab=64, hidden=32, layers=3, heads=4,
+                                 kv_heads=2, inter=64, max_pos=32)
+        cfg_s.scan_layers = True
+        m_scan = LlamaForCausalLM(cfg_s)
+        ids = paddle.to_tensor(np.random.default_rng(0).integers(
+            0, 64, (2, 8), dtype=np.int32))
+        logits_scan = m_scan(ids).numpy()
+
+        m_layer = LlamaForCausalLM(LlamaConfig.tiny(
+            vocab=64, hidden=32, layers=3, heads=4, kv_heads=2, inter=64,
+            max_pos=32))
+        converted = scan_to_layered_state_dict(m_scan.state_dict())
+        missing, unexpected = m_layer.set_state_dict(converted)
+        assert not missing and not unexpected
+        np.testing.assert_allclose(m_layer(ids).numpy(), logits_scan,
+                                   rtol=2e-4, atol=2e-5)
+        out = m_layer.generate(ids, max_new_tokens=3)
+        assert out.shape == [2, 11]
+
+        back = layered_to_scan_state_dict(m_layer.state_dict(), 3)
+        for k, v in m_scan.state_dict().items():
+            got = back[k]._data if hasattr(back[k], "_data") else back[k]
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(v._data), rtol=1e-6)
