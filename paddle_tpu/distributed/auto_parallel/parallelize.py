@@ -19,7 +19,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...nn.layer import Layer
 from ..auto_parallel_api import ProcessMesh, get_mesh
 
-__all__ = ["parallelize", "ColWiseParallel", "RowWiseParallel",
+__all__ = ["parallelize", "plan_parallelize", "ColWiseParallel",
+           "RowWiseParallel",
            "PrepareLayerInput", "PrepareLayerOutput",
            "SequenceParallelBegin", "SequenceParallelEnd"]
 
@@ -105,15 +106,97 @@ def _match_layers(model: Layer, pattern: str):
             yield name, sub
 
 
+# name fragments that identify the two halves of a megatron pair; checked
+# before the structural fallback (registration order) in the planner
+_COL_HINTS = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj", "qkv",
+              "in_proj", "fc1", "linear1", "w1", "wi")
+_ROW_HINTS = ("o_proj", "down_proj", "out_proj", "fc2", "linear2", "w2",
+              "wo")
+
+
+def plan_parallelize(model: Layer, mesh: ProcessMesh,
+                     axis: Optional[str] = None) -> Dict[str, _Plan]:
+    """Derive a tensor-parallel plan from the model structure (the
+    sharding-planner seam the reference grows a cost model behind —
+    upstream python/paddle/distributed/auto_parallel/ planners; ours is a
+    structural heuristic, documented and testable):
+
+    * Linear layers pair up megatron-style WITHIN each parent module:
+      name hints first (q/k/v/gate/up → column, o/down/fc2 → row), then
+      registration order (all but the last linear column, the last row) —
+      so one block contributes ONE all-reduce, after the row projection;
+    * only divisible layers shard (column: out %% size, row: in %% size);
+      indivisible layers stay replicated (never a wrong layout);
+    * a lone linear in a module stays replicated (no pair, sharding it
+      would buy an all-gather for nothing).
+
+    Returns {qualified-name: Plan}, directly usable as
+    ``mp_config.parallelize_plan`` (or pass ``"auto"`` there).
+    """
+    from ...nn import Linear
+
+    ax = axis or ("mp" if "mp" in mesh.dim_names else mesh.dim_names[-1])
+    size = mesh.get_dim_size(ax)
+    plan: Dict[str, _Plan] = {}
+
+    def divisible_col(l):  # noqa: E743
+        return l.weight._data.shape[1] % size == 0
+
+    def divisible_row(l):  # noqa: E743
+        return l.weight._data.shape[0] % size == 0
+
+    for parent_name, parent in model.named_sublayers(include_self=True):
+        linears = [(n, c) for n, c in parent.named_children()
+                   if isinstance(c, Linear)]
+        if len(linears) < 2:
+            continue
+        cols, rows, unknown = [], [], []
+        for n, c in linears:
+            ln = n.lower()
+            if any(h in ln for h in _COL_HINTS):
+                cols.append((n, c))
+            elif any(h in ln for h in _ROW_HINTS):
+                rows.append((n, c))
+            else:
+                unknown.append((n, c))
+        if not rows:
+            # structural fallback: registration order — pair ADJACENT
+            # linears (col, row), leaving an odd leftover replicated.
+            # Col-sharding every non-last linear in a 3+ chain would hand
+            # a feature-sharded activation to another col layer, forcing
+            # an extra collective mid-block.
+            if not unknown:
+                continue
+            for j in range(len(unknown) // 2):
+                cols.append(unknown[2 * j])
+                rows.append(unknown[2 * j + 1])
+        else:
+            cols += unknown
+        if not cols or not rows:
+            continue
+        usable_cols = [(n, c) for n, c in cols if divisible_col(c)]
+        usable_rows = [(n, c) for n, c in rows if divisible_row(c)]
+        if not usable_cols or not usable_rows:
+            continue  # half a pair would add comms without saving memory
+        prefix = parent_name + "." if parent_name else ""
+        for n, _c in usable_cols:
+            plan[prefix + n] = ColWiseParallel()
+        for n, _c in usable_rows:
+            plan[prefix + n] = RowWiseParallel()
+    return plan
+
+
 def parallelize(model: Layer, optimizer=None,
                 mesh: Optional[ProcessMesh] = None,
                 config: Optional[Dict] = None):
     """Apply a hybrid-parallel ``config`` to ``model`` (reference:
     paddle.distributed.parallelize).
 
-    config = {"mp_config": {"parallelize_plan": {"pattern": Plan}},
+    config = {"mp_config": {"parallelize_plan": {"pattern": Plan} | "auto"},
               "dp_config": {"sharding_level": 0|1|2|3},
               "pp_config": {...}}
+
+    ``parallelize_plan="auto"`` runs :func:`plan_parallelize`.
     """
     config = config or {}
     mesh = mesh or get_mesh()
@@ -124,6 +207,8 @@ def parallelize(model: Layer, optimizer=None,
 
     mp_cfg = config.get("mp_config") or {}
     plan = mp_cfg.get("parallelize_plan") or {}
+    if plan == "auto":
+        plan = plan_parallelize(model, mesh, mp_axis)
     for pattern, plan_obj in plan.items():
         plans = plan_obj if isinstance(plan_obj, (list, tuple)) else [plan_obj]
         for _, sub in _match_layers(model, pattern):

@@ -335,39 +335,45 @@ def scan_to_layered_state_dict(sd):
     generation (the one layout restriction LlamaModel documents)."""
     out = {}
     for k, v in sd.items():
-        if ".scan_" not in k:
+        if ".scan_" not in k and not k.startswith("scan_"):
             out[k] = v
-        else:
-            prefix, flat = k.split(".scan_", 1)
-            name = flat.replace("_", ".")
-            # param names contain underscores themselves (q_proj.weight →
-            # q_proj_weight); reverse by trying progressively: the real
-            # layer attribute path uses dots between modules only
-            arr = v._data if hasattr(v, "_data") else v
-            for i in range(arr.shape[0]):
-                out[f"{prefix}.layers.{i}.{_unflatten_scan_name(flat)}"] = \
-                    Tensor(arr[i], stop_gradient=True)
+            continue
+        prefix, flat = (k.split(".scan_", 1) if ".scan_" in k
+                        else ("", k[len("scan_"):]))
+        dotted = _unflatten_scan_name(flat)
+        arr = v._data if hasattr(v, "_data") else v
+        layer_prefix = f"{prefix}.layers" if prefix else "layers"
+        for i in range(arr.shape[0]):
+            out[f"{layer_prefix}.{i}.{dotted}"] = \
+                Tensor(arr[i], stop_gradient=True)
     return out
 
 
+def _scan_name_map():
+    """{flattened: dotted} for every decoder-layer state key, derived from
+    the layer structure itself (no hardcoded attribute list — a layer
+    variant or added param is covered automatically)."""
+    global _SCAN_NAME_MAP
+    try:
+        return _SCAN_NAME_MAP
+    except NameError:
+        pass
+    template = LlamaDecoderLayer(LlamaConfig.tiny())
+    _SCAN_NAME_MAP = {k.replace(".", "_"): k
+                      for k in template.state_dict().keys()}
+    return _SCAN_NAME_MAP
+
+
 def _unflatten_scan_name(flat: str) -> str:
-    """scan key names flatten '.' to '_' (q_proj_weight); rebuild the
-    dotted path against the known decoder-layer attribute names."""
-    known = ("input_layernorm", "post_attention_layernorm", "self_attn",
-             "mlp", "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
-             "up_proj", "down_proj", "weight", "bias")
-    parts = []
-    rest = flat
-    while rest:
-        for cand in sorted(known, key=len, reverse=True):
-            if rest == cand or rest.startswith(cand + "_"):
-                parts.append(cand)
-                rest = rest[len(cand) + 1:]
-                break
-        else:
-            parts.append(rest)
-            rest = ""
-    return ".".join(parts)
+    """scan key names flatten '.' to '_' (q_proj.weight → q_proj_weight);
+    rebuild the dotted path from the decoder layer's own key set."""
+    dotted = _scan_name_map().get(flat)
+    if dotted is None:
+        raise ValueError(
+            f"unrecognized scan-stacked key {flat!r}: not a "
+            "LlamaDecoderLayer state entry (custom layers need their own "
+            "layout converter)")
+    return dotted
 
 
 def layered_to_scan_state_dict(sd, num_layers: int):
@@ -378,11 +384,11 @@ def layered_to_scan_state_dict(sd, num_layers: int):
     out = {}
     groups = {}
     for k, v in sd.items():
-        m = re.match(r"(.*)\.layers\.(\d+)\.(.+)$", k)
+        m = re.match(r"(?:(.*)\.)?layers\.(\d+)\.(.+)$", k)
         if m is None:
             out[k] = v
             continue
-        prefix, i, name = m.group(1), int(m.group(2)), m.group(3)
+        prefix, i, name = m.group(1) or "", int(m.group(2)), m.group(3)
         groups.setdefault((prefix, name), {})[i] = \
             v._data if hasattr(v, "_data") else v
     for (prefix, name), per_layer in groups.items():
@@ -391,7 +397,8 @@ def layered_to_scan_state_dict(sd, num_layers: int):
                 f"layer group {name!r} has {len(per_layer)} of "
                 f"{num_layers} layers")
         stacked = jnp.stack([per_layer[i] for i in range(num_layers)], 0)
-        out[f"{prefix}.scan_{name.replace('.', '_')}"] = \
+        scan_key = f"scan_{name.replace('.', '_')}"
+        out[f"{prefix}.{scan_key}" if prefix else scan_key] = \
             Tensor(stacked, stop_gradient=True)
     return out
 
