@@ -171,7 +171,12 @@ def plan_parallelize(model: Layer, mesh: ProcessMesh,
                 cols.append(unknown[2 * j])
                 rows.append(unknown[2 * j + 1])
         else:
-            cols += unknown
+            # hinted pairs exist; leftover hint-less linears pair among
+            # themselves (odd one stays replicated — a col with no row
+            # partner would force an all-gather)
+            for j in range(len(unknown) // 2):
+                cols.append(unknown[2 * j])
+                rows.append(unknown[2 * j + 1])
         if not cols or not rows:
             continue
         usable_cols = [(n, c) for n, c in cols if divisible_col(c)]

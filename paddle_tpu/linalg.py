@@ -83,3 +83,19 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
             vt.swapaxes(-1, -2).astype(a.dtype)
 
     return apply("svd_lowrank", f, x, *extras)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference: paddle.linalg.pca_lowrank): returns
+    (U, S, V) of the (optionally centered) data via svd_lowrank."""
+    from .core.tensor import Tensor
+    import jax.numpy as jnp
+
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    m, n = int(x._data.shape[-2]), int(x._data.shape[-1])
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        mean = x.mean(axis=-2, keepdim=True)
+        x = x - mean
+    return svd_lowrank(x, q=q, niter=niter)

@@ -358,7 +358,15 @@ def _scan_name_map():
         return _SCAN_NAME_MAP
     except NameError:
         pass
-    template = LlamaDecoderLayer(LlamaConfig.tiny())
+    # building the template draws initializer samples — snapshot/restore
+    # the generator so a seeded program gets identical randomness whether
+    # or not it converted a checkpoint first
+    from ..core.random import default_generator
+    state = default_generator.get_state()
+    try:
+        template = LlamaDecoderLayer(LlamaConfig.tiny())
+    finally:
+        default_generator.set_state(state)
     _SCAN_NAME_MAP = {k.replace(".", "_"): k
                       for k in template.state_dict().keys()}
     return _SCAN_NAME_MAP

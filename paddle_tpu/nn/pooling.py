@@ -134,3 +134,57 @@ class AdaptiveMaxPool3D(Layer):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size,
                                      return_mask=self.return_mask)
+
+
+class _LPPool(Layer):
+    """Power-average pooling: (sum_window |x|^p)^(1/p) (reference:
+    paddle.nn.LPPool1D/2D — upstream python/paddle/nn/layer/pooling.py).
+    Lowered as avg_pool over |x|^p times the window size, then the p-th
+    root (one fused XLA reduce-window, no custom kernel needed)."""
+
+    _ND = 2
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format=None, name=None):
+        super().__init__()
+        self.norm_type = float(norm_type)
+        if self.norm_type == 0:
+            raise ValueError("norm_type must be non-zero")
+        self.kernel_size = kernel_size
+        self.stride = stride if stride is not None else kernel_size
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format or ("NCL" if self._ND == 1 else "NCHW")
+
+    def _window_count(self):
+        k = self.kernel_size
+        if isinstance(k, int):
+            return k ** self._ND
+        out = 1
+        for v in k:
+            out *= v
+        return out
+
+    def forward(self, x):
+        p = self.norm_type
+        n = float(self._window_count())
+        # reference semantics: SIGNED x**p (sum can go negative; its p-th
+        # root is then nan for odd/fractional p — torch/paddle agree)
+        powed = x ** p
+        if self._ND == 1:
+            avg = F.avg_pool1d(powed, self.kernel_size, self.stride,
+                               self.padding, ceil_mode=self.ceil_mode,
+                               data_format=self.data_format)
+        else:
+            avg = F.avg_pool2d(powed, self.kernel_size, self.stride,
+                               self.padding, ceil_mode=self.ceil_mode,
+                               data_format=self.data_format)
+        return (avg * n) ** (1.0 / p)
+
+
+class LPPool1D(_LPPool):
+    _ND = 1
+
+
+class LPPool2D(_LPPool):
+    _ND = 2

@@ -135,8 +135,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 
 
 def _pool(x, op_name, kernel_size, stride, padding, spatial, reducer, init,
-          ceil_mode=False, data_format="NCHW", count_include_pad=True,
-          exclusive=True):
+          ceil_mode=False, data_format="NCHW", exclusive=True):
     x = ensure_tensor(x)
     k = _pair(kernel_size, spatial)
     s = _pair(stride if stride is not None else kernel_size, spatial)
@@ -154,6 +153,28 @@ def _pool(x, op_name, kernel_size, stride, padding, spatial, reducer, init,
         window = (1,) + k + (1,)
         strides = (1,) + s + (1,)
         pad_full = [(0, 0)] + (pad if not isinstance(pad, str) else []) + [(0, 0)]
+    ceil_extra = False
+    user_pad_full = [tuple(pp) for pp in pad_full] \
+        if not isinstance(pad, str) else None
+    if ceil_mode and not isinstance(pad, str):
+        # ceil output shapes: extend the HIGH-side padding so reduce_window
+        # emits the last partial window (reference rule: that window must
+        # still START inside input+pad_lo, else it is dropped). Padding
+        # elements never pollute results: max uses -inf, avg either counts
+        # real elements (exclusive) or divides by the fixed kernel size.
+        sp0 = 2 if channel_first else 1
+        for j in range(spatial):
+            dim = sp0 + j
+            length = int(x._data.shape[dim])
+            eff = length + 2 * p[j] - k[j]
+            if eff % s[j] != 0:
+                out_ceil = -(-eff // s[j]) + 1
+                if (out_ceil - 1) * s[j] >= length + p[j]:
+                    continue  # would start entirely in padding: dropped
+                hi_extra = (out_ceil - 1) * s[j] + k[j] - (length + 2 * p[j])
+                lo, hi = pad_full[dim]
+                pad_full[dim] = (lo, hi + hi_extra)
+                ceil_extra = True
     pad_cfg = pad if isinstance(pad, str) else pad_full
 
     def f(a):
@@ -161,18 +182,33 @@ def _pool(x, op_name, kernel_size, stride, padding, spatial, reducer, init,
             return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window, strides,
                                          pad_cfg)
         summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pad_cfg)
-        if isinstance(pad_cfg, str) or not exclusive or all(p == (0, 0) for p in pad_full):
-            denom = float(np.prod(k))
-            if exclusive and not isinstance(pad_cfg, str):
-                return summed / denom
-            counts = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
-                                           window, strides, pad_cfg)
-            return summed / counts
-        if count_include_pad:
+
+        def real_counts():
+            return jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
+                                         window, strides, pad_cfg)
+
+        if isinstance(pad_cfg, str):
+            return summed / real_counts()
+        if not exclusive:
+            # paddle exclusive=False: user padding COUNTS in the divisor
+            # (torch count_include_pad=True) but the ceil extension never
+            # does — count over ones pre-padded with the user padding
+            if not ceil_extra:
+                return summed / float(np.prod(k))
+            ones_up = jnp.pad(jnp.ones_like(a), user_pad_full,
+                              constant_values=1.0)
+            extras = [(f_[0] - u[0], f_[1] - u[1])
+                      for f_, u in zip(pad_full, user_pad_full)]
+            counts_up = jax.lax.reduce_window(ones_up, 0.0, jax.lax.add,
+                                              window, strides, extras)
+            return summed / counts_up
+        # exclusive=True (the paddle default): padding and ceil-extension
+        # elements are EXCLUDED from the divisor — divide by the true
+        # element count per window. No-padding floor-mode keeps the cheap
+        # constant divisor.
+        if not ceil_extra and all(pp == (0, 0) for pp in pad_full):
             return summed / float(np.prod(k))
-        counts = jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
-                                       window, strides, pad_cfg)
-        return summed / counts
+        return summed / real_counts()
 
     return apply(op_name, f, x)
 

@@ -675,3 +675,37 @@ class TestTopLevelTailOps:
     def test_is_tensor(self):
         assert paddle.is_tensor(paddle.to_tensor([1.0]))
         assert not paddle.is_tensor(np.zeros(3))
+
+
+class TestRound3TailLayers:
+    def test_lp_pool_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as TF
+        import paddle_tpu.nn as pnn
+        x = np.abs(np.random.default_rng(0).normal(
+            0, 1, (2, 3, 16))).astype(np.float32)  # fractional p needs >=0
+        for p_, k in ((2, 4), (3, 2), (1.5, 2)):
+            got = pnn.LPPool1D(norm_type=p_, kernel_size=k)(
+                paddle.to_tensor(x)).numpy()
+            want = TF.lp_pool1d(torch.tensor(x), norm_type=p_,
+                                kernel_size=k).numpy()
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        x2 = np.random.default_rng(1).normal(0, 1, (2, 3, 8, 8)) \
+            .astype(np.float32)
+        got2 = pnn.LPPool2D(norm_type=2, kernel_size=2, stride=2)(
+            paddle.to_tensor(x2)).numpy()
+        want2 = TF.lp_pool2d(torch.tensor(x2), norm_type=2, kernel_size=2,
+                             stride=2).numpy()
+        np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-5)
+
+    def test_pca_lowrank_reconstructs(self):
+        rng = np.random.default_rng(2)
+        # rank-3 data + noise
+        base = rng.normal(0, 1, (40, 3)) @ rng.normal(0, 1, (3, 10))
+        x = (base + 0.01 * rng.normal(0, 1, (40, 10))).astype(np.float32)
+        u, s, v = paddle.linalg.pca_lowrank(paddle.to_tensor(x), q=3)
+        centered = x - x.mean(0)
+        recon = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        err = np.linalg.norm(recon - centered) / np.linalg.norm(centered)
+        assert err < 0.05, err
+        assert s.shape == [3]

@@ -41,6 +41,7 @@ def _init_fn(worker_id):
     os.environ["_PDTPU_TEST_WORKER"] = str(worker_id)
 
 
+@pytest.mark.slow
 def test_map_style_ordered_across_workers():
     dl = DataLoader(MapDS(), batch_size=4, num_workers=2,
                     worker_init_fn=_init_fn)

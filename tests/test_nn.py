@@ -147,3 +147,36 @@ def test_pooling():
     np.testing.assert_allclose(
         nn.AdaptiveAvgPool2D(1)(x).numpy()[0, 0, 0, 0],
         x.numpy()[0, 0].mean(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("ceil", [False, True])
+@pytest.mark.parametrize("excl", [True, False])
+def test_pool_ceil_mode_and_divisors_match_torch(ceil, excl):
+    """ceil_mode produces the reference output shapes AND divisors:
+    partial last windows average over real elements (exclusive) or
+    input+user-pad elements (include-pad, torch count_include_pad) — the
+    ceil extension never counts. Round-3 fix: ceil_mode was silently a
+    no-op for every pool."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as TF
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    for L, k, s, p in [(9, 3, 2, 1), (10, 4, 3, 1), (13, 5, 4, 2)]:
+        x = rng.normal(0, 1, (2, 3, L)).astype(np.float32)
+        got = F.avg_pool1d(paddle.to_tensor(x), k, s, p, ceil_mode=ceil,
+                           exclusive=excl).numpy()
+        want = TF.avg_pool1d(torch.tensor(x), k, s, p, ceil_mode=ceil,
+                             count_include_pad=not excl).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        gm = F.max_pool1d(paddle.to_tensor(x), k, s, p,
+                          ceil_mode=ceil).numpy()
+        wm = TF.max_pool1d(torch.tensor(x), k, s, p, ceil_mode=ceil).numpy()
+        np.testing.assert_allclose(gm, wm, rtol=1e-5, atol=1e-6)
+    for H, k, s, p in [(9, 3, 2, 1), (11, 4, 3, 1)]:
+        x = rng.normal(0, 1, (2, 3, H, H)).astype(np.float32)
+        got = F.avg_pool2d(paddle.to_tensor(x), k, s, p, ceil_mode=ceil,
+                           exclusive=excl).numpy()
+        want = TF.avg_pool2d(torch.tensor(x), k, s, p, ceil_mode=ceil,
+                             count_include_pad=not excl).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

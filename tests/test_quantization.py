@@ -76,6 +76,7 @@ def test_qat_quantize_and_train():
     assert y.shape == [16, 4]
 
 
+@pytest.mark.slow
 def test_ptq_calibrate_and_convert():
     paddle.seed(0)
     model = nn.Sequential(nn.Linear(6, 6), nn.ReLU(), nn.Linear(6, 2))
