@@ -20,7 +20,8 @@ from .topology import (  # noqa: F401
     get_hybrid_communicate_group, global_mesh,
 )
 from .comm import (  # noqa: F401
-    ReduceOp, all_reduce, all_gather, all_gather_object,
+    ReduceOp, all_reduce, all_gather, all_gather_object, gather,
+    get_group, split,
     scatter_object_list, broadcast_object_list, reduce_scatter,
     alltoall, alltoall_single, broadcast, reduce, scatter, barrier, send, recv,
     shard_stack, unstack, ppermute_shift, wait, stream,

@@ -159,24 +159,13 @@ def plan_parallelize(model: Layer, mesh: ProcessMesh,
                 rows.append((n, c))
             else:
                 unknown.append((n, c))
-        if not rows:
-            # structural fallback: registration order — pair ADJACENT
-            # linears (col, row), leaving an odd leftover replicated.
-            # Col-sharding every non-last linear in a 3+ chain would hand
-            # a feature-sharded activation to another col layer, forcing
-            # an extra collective mid-block.
-            if not unknown:
-                continue
-            for j in range(len(unknown) // 2):
-                cols.append(unknown[2 * j])
-                rows.append(unknown[2 * j + 1])
-        else:
-            # hinted pairs exist; leftover hint-less linears pair among
-            # themselves (odd one stays replicated — a col with no row
-            # partner would force an all-gather)
-            for j in range(len(unknown) // 2):
-                cols.append(unknown[2 * j])
-                rows.append(unknown[2 * j + 1])
+        # hint-less linears pair ADJACENTLY (registration order) into
+        # (col, row); an odd leftover stays replicated — a col without a
+        # row partner (or two cols in a row) would force an extra
+        # mid-block collective
+        for j in range(len(unknown) // 2):
+            cols.append(unknown[2 * j])
+            rows.append(unknown[2 * j + 1])
         if not cols or not rows:
             continue
         usable_cols = [(n, c) for n, c in cols if divisible_col(c)]

@@ -444,3 +444,90 @@ def destroy_process_group(group=None) -> None:
     from . import env as _env
     if group is None:
         _env._initialized = False
+
+
+def gather(tensor: Tensor, gather_list=None, dst: int = 0,
+           group: Optional["ProcessGroup"] = None, sync_op: bool = True):
+    """Gather to ``dst`` (reference: paddle.distributed.gather). SPMD
+    note: on a mesh every device executes the program, so the gather is an
+    all_gather with non-dst ranks discarding — the list fills only for the
+    dst 'rank view', matching the reference contract that gather_list is
+    meaningful on dst."""
+    tmp: List[Tensor] = []
+    all_gather(tmp, tensor, group=group, sync_op=sync_op)
+    if gather_list is not None:
+        gather_list.extend(tmp)
+    return gather_list
+
+
+def get_group(id: int = 0):
+    """Parity: paddle.distributed.get_group — look up a group handle by its
+    id (groups register at construction). id 0 — or an id never issued —
+    resolves to the world group over the active mesh's first axis."""
+    from .topology import ProcessGroup, global_mesh
+    g = ProcessGroup._registry.get(id)
+    if g is not None:
+        return g
+    mesh = global_mesh()
+    world = ProcessGroup(mesh, mesh.axis_names[0])
+    return world
+
+
+_SPLIT_LAYERS: dict = {}
+
+
+def split(x, size, operation: str = "linear", axis: int = 0,
+          num_partitions: int = 1, gather_out: bool = True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Functional model-parallel op (reference: paddle.distributed.split —
+    the fleet static-graph API for splitting a linear/embedding across the
+    mp group). The parallel layer is created on first call and cached by
+    ``name`` — REQUIRED, like the reference's unique-parameter-name
+    contract (an anonymous cache key would silently share weights between
+    unrelated call sites). The cache is scoped to the active hybrid
+    topology: re-initializing fleet invalidates it (a layer sharded for a
+    4-way mp mesh must not serve a 2-way one).
+
+    operation='linear': axis=1 splits the weight's columns
+    (ColumnParallelLinear, ``gather_out`` controls output gathering),
+    axis=0 splits its rows (RowParallelLinear). operation='embedding'
+    splits the vocabulary (VocabParallelEmbedding)."""
+    from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+    from .topology import get_hybrid_communicate_group
+
+    if name is None:
+        raise ValueError(
+            "paddle.distributed.split requires a unique name= per weight "
+            "(the reference's parameter-naming requirement)")
+    hcg = get_hybrid_communicate_group()
+    mp = hcg.get_model_parallel_world_size() if hcg is not None else 1
+    if num_partitions not in (1, mp):
+        raise ValueError(
+            f"num_partitions={num_partitions} disagrees with the active "
+            f"mp degree {mp}")
+    key = (id(hcg), name)
+    layer = _SPLIT_LAYERS.get(key)
+    if layer is None:
+        if operation == "linear":
+            if axis == 1:
+                layer = ColumnParallelLinear(size[0], size[1],
+                                             weight_attr=weight_attr,
+                                             bias_attr=bias_attr,
+                                             gather_output=gather_out,
+                                             name=key)
+            elif axis == 0:
+                # the functional API feeds a replicated activation
+                layer = RowParallelLinear(size[0], size[1],
+                                          weight_attr=weight_attr,
+                                          bias_attr=bias_attr,
+                                          input_is_parallel=False, name=key)
+            else:
+                raise ValueError("linear split axis must be 0 or 1")
+        elif operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1],
+                                           weight_attr=weight_attr, name=key)
+        else:
+            raise ValueError(f"unknown split operation {operation!r}")
+        _SPLIT_LAYERS[key] = layer
+    return layer(x)

@@ -36,9 +36,12 @@ class ProcessGroup:
 
     _next_gid = itertools.count()
 
+    _registry: dict = {}  # gid -> group (get_group lookup surface)
+
     def __init__(self, mesh: Mesh, axis_name: Optional[str], ranks=None,
                  rank: int = 0):
         self.id = next(ProcessGroup._next_gid)
+        ProcessGroup._registry[self.id] = self
         self.mesh = mesh
         self.axis_name = axis_name
         self.nranks = int(mesh.shape[axis_name]) if axis_name else 1

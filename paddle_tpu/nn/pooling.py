@@ -171,13 +171,18 @@ class _LPPool(Layer):
         # reference semantics: SIGNED x**p (sum can go negative; its p-th
         # root is then nan for odd/fractional p — torch/paddle agree)
         powed = x ** p
+        # exclusive=False: avg divides by the FULL kernel size, so avg*n is
+        # the true window sum (padding zeros contribute nothing to x**p) —
+        # exclusive counting would over-scale partial/padded windows
         if self._ND == 1:
             avg = F.avg_pool1d(powed, self.kernel_size, self.stride,
-                               self.padding, ceil_mode=self.ceil_mode,
+                               self.padding, exclusive=False,
+                               ceil_mode=self.ceil_mode,
                                data_format=self.data_format)
         else:
             avg = F.avg_pool2d(powed, self.kernel_size, self.stride,
-                               self.padding, ceil_mode=self.ceil_mode,
+                               self.padding, exclusive=False,
+                               ceil_mode=self.ceil_mode,
                                data_format=self.data_format)
         return (avg * n) ** (1.0 / p)
 

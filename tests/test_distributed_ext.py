@@ -310,3 +310,51 @@ class TestReviewFixes5:
             # average of a constant parameter must stay that constant
             np.testing.assert_allclose(np.asarray(p.numpy()), [1.0],
                                        rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# round-3 tail: gather / get_group / split (upstream paddle.distributed)
+# ---------------------------------------------------------------------------
+
+def test_gather_and_get_group():
+    import paddle_tpu.distributed as dist
+
+    paddle.distributed.init_parallel_env()
+    gl = []
+    t = dist.shard_stack([paddle.to_tensor(np.full(2, float(i), np.float32))
+                          for i in range(8)])
+    dist.gather(t, gl, dst=0)
+    assert len(gl) == 8
+    np.testing.assert_allclose(gl[3].numpy(), 3.0)
+    assert dist.get_group(0) is not None
+
+
+def test_split_functional_mp():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        x = paddle.to_tensor(np.random.default_rng(0).normal(
+            0, 1, (4, 8)).astype(np.float32))
+        y = dist.split(x, (8, 16), operation="linear", axis=1,
+                       gather_out=True, name="ut_s1")
+        assert y.shape == [4, 16]
+        # cached layer: same weights on reuse
+        y2 = dist.split(x, (8, 16), operation="linear", axis=1,
+                        gather_out=True, name="ut_s1")
+        np.testing.assert_allclose(y.numpy(), y2.numpy())
+        yr = dist.split(x, (8, 16), operation="linear", axis=0,
+                        name="ut_s2")
+        assert yr.shape == [4, 16]
+        ids = paddle.to_tensor(np.array([[1, 5, 9]], np.int64))
+        e = dist.split(ids, (100, 8), operation="embedding", name="ut_e1")
+        assert e.shape == [1, 3, 8]
+        with pytest.raises(ValueError):
+            dist.split(x, (8, 16), operation="conv", name="ut_bad")
+    finally:
+        set_hybrid_communicate_group(None)
