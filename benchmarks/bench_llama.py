@@ -31,10 +31,12 @@ def main() -> None:
     ap.add_argument("--scan-k", type=int, default=4)
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="GQA kv heads (0 = same as --heads)")
-    ap.add_argument("--state", choices=["fp32", "bf16"], default="fp32",
+    ap.add_argument("--state", choices=["fp32", "bf16", "int8"],
+                    default="fp32",
                     help="optimizer state: fp32 masters+moments (reference "
-                         "behavior) or bf16 moments + master-weight-free "
-                         "bf16 params with stochastic rounding")
+                         "behavior), bf16 moments + master-weight-free "
+                         "bf16 params with stochastic rounding, or int8 "
+                         "block-quantized moments (2 B/param of m+v)")
     ap.add_argument("--scan-layers", action="store_true",
                     help="stack identical decoder layers under lax.scan")
     ap.add_argument("--recompute", action="store_true",
@@ -58,15 +60,17 @@ def main() -> None:
                       scan_layers=args.scan_layers,
                       recompute=args.recompute)
     model = LlamaForCausalLM(cfg)
-    bf16_state = args.state == "bf16"
-    # bf16 state: narrow moments + no fp32 masters (params update in bf16
-    # with stochastic rounding) — 6 bytes/param of state instead of 16,
-    # the knob that fits >=1.5B on one 16GB chip. The big scan-stacked
-    # params make the per-param (unfused) path the fast one here.
+    bf16_state = args.state in ("bf16", "int8")
+    # narrow state: bf16 (6 B/param) or int8 block-quantized (4 B/param)
+    # moments + no fp32 masters (params update in bf16 with stochastic
+    # rounding) vs the reference's 16 B/param. The big scan-stacked params
+    # make the per-param (unfused) path the fast one here.
+    moment = {"fp32": "float32", "bf16": "bfloat16",
+              "int8": "int8"}[args.state]
     opt = paddle.optimizer.AdamW(
         learning_rate=1e-4, parameters=model.parameters(),
-        use_multi_tensor=not args.scan_layers,
-        moment_dtype="bfloat16" if bf16_state else "float32",
+        use_multi_tensor=not args.scan_layers and args.state != "int8",
+        moment_dtype=moment,
         use_master_weights=False if bf16_state else None)
     if on_tpu:
         model, opt = paddle.amp.decorate(

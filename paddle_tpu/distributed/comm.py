@@ -450,27 +450,37 @@ def gather(tensor: Tensor, gather_list=None, dst: int = 0,
            group: Optional["ProcessGroup"] = None, sync_op: bool = True):
     """Gather to ``dst`` (reference: paddle.distributed.gather). SPMD
     note: on a mesh every device executes the program, so the gather is an
-    all_gather with non-dst ranks discarding — the list fills only for the
-    dst 'rank view', matching the reference contract that gather_list is
-    meaningful on dst."""
+    all_gather with non-dst ranks discarding — the list FILLS (replacing
+    prior contents, so loops can reuse it) only for the dst 'rank view',
+    matching the reference contract that gather_list is meaningful on
+    dst."""
     tmp: List[Tensor] = []
     all_gather(tmp, tensor, group=group, sync_op=sync_op)
     if gather_list is not None:
-        gather_list.extend(tmp)
+        gather_list[:] = tmp
     return gather_list
 
 
+_WORLD_GROUP = None
+
+
 def get_group(id: int = 0):
-    """Parity: paddle.distributed.get_group — look up a group handle by its
-    id (groups register at construction). id 0 — or an id never issued —
-    resolves to the world group over the active mesh's first axis."""
+    """Parity: paddle.distributed.get_group — look up a group handle by
+    its id (groups register at construction; gid 0 is reserved). id 0 — or
+    an id never issued — resolves to the world group over the GLOBAL
+    1-axis device mesh (never a hybrid sub-axis)."""
     from .topology import ProcessGroup, global_mesh
-    g = ProcessGroup._registry.get(id)
-    if g is not None:
-        return g
+    if id != 0:
+        g = ProcessGroup._registry.get(id)
+        if g is not None:
+            return g
+    global _WORLD_GROUP
     mesh = global_mesh()
-    world = ProcessGroup(mesh, mesh.axis_names[0])
-    return world
+    if _WORLD_GROUP is None or _WORLD_GROUP.mesh is not mesh:
+        _WORLD_GROUP = ProcessGroup(mesh, mesh.axis_names[0])
+        _WORLD_GROUP.id = 0
+        ProcessGroup._registry[0] = _WORLD_GROUP
+    return _WORLD_GROUP
 
 
 _SPLIT_LAYERS: dict = {}
@@ -500,13 +510,18 @@ def split(x, size, operation: str = "linear", axis: int = 0,
         raise ValueError(
             "paddle.distributed.split requires a unique name= per weight "
             "(the reference's parameter-naming requirement)")
+    from .topology import topology_epoch
     hcg = get_hybrid_communicate_group()
     mp = hcg.get_model_parallel_world_size() if hcg is not None else 1
     if num_partitions not in (1, mp):
         raise ValueError(
             f"num_partitions={num_partitions} disagrees with the active "
             f"mp degree {mp}")
-    key = (id(hcg), name)
+    epoch = topology_epoch()
+    if _SPLIT_LAYERS.get("_epoch") != epoch:
+        _SPLIT_LAYERS.clear()  # topology changed: old shardings are stale
+        _SPLIT_LAYERS["_epoch"] = epoch
+    key = name
     layer = _SPLIT_LAYERS.get(key)
     if layer is None:
         if operation == "linear":

@@ -34,9 +34,14 @@ class ProcessGroup:
     addresses collectives; ``ranks`` lists member positions along that axis.
     """
 
-    _next_gid = itertools.count()
+    _next_gid = itertools.count(1)  # 0 is the world group
 
-    _registry: dict = {}  # gid -> group (get_group lookup surface)
+    # gid -> group, weakly held (groups are created per call by the hcg
+    # accessors — strong registry references would grow without bound and
+    # outlive their meshes). gid 0 is RESERVED for the world group.
+    import weakref as _weakref
+    _registry: "ProcessGroup._weakref.WeakValueDictionary" = \
+        _weakref.WeakValueDictionary()
 
     def __init__(self, mesh: Mesh, axis_name: Optional[str], ranks=None,
                  rank: int = 0):
@@ -203,9 +208,20 @@ _hcg: Optional[HybridCommunicateGroup] = None
 _default_mesh: Optional[Mesh] = None
 
 
+_topology_epoch = 0
+
+
+def topology_epoch() -> int:
+    """Monotonic counter bumped on every hybrid-topology (re)set — cache
+    keys derived from the live topology use this instead of object ids
+    (CPython id reuse would alias a dead mesh's cache entries)."""
+    return _topology_epoch
+
+
 def set_hybrid_communicate_group(hcg: HybridCommunicateGroup) -> None:
-    global _hcg
+    global _hcg, _topology_epoch
     _hcg = hcg
+    _topology_epoch += 1
 
 
 def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:

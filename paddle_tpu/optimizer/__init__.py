@@ -30,6 +30,33 @@ __all__ = [
 lr = lr_mod
 
 
+_Q8_BLOCK = 2048  # block size for int8 moment quantization
+
+
+def _q8_quantize(x32, block: int = _Q8_BLOCK):
+    """Per-block absmax int8 quantization of an fp32 array: returns
+    (q int8 (nb, block), scale fp32 (nb,)). The bitsandbytes-style 8-bit
+    optimizer-state layout (1 byte/element + 4/block bytes of scale)."""
+    flat = x32.reshape(-1)
+    n = flat.shape[0]
+    nb = -(-n // block)
+    flat = jnp.pad(flat, (0, nb * block - n))
+    blocks = flat.reshape(nb, block)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(blocks / scale[:, None]), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _q8_dequantize(q, scale, shape):
+    n = 1
+    for s in shape:
+        n *= int(s)
+    flat = (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
+    return flat[:n].reshape(shape)
+
+
 def _stochastic_round_bf16(x32, key):
     """Stochastically round f32 -> bf16 (add uniform low bits, truncate).
     Unbiased: E[round(x)] = x. Master-weight-free bf16 training depends on
@@ -516,8 +543,15 @@ class Adam(Optimizer):
     reference surface (upstream python/paddle/optimizer/adam.py keeps fp32
     m/v + fp32 masters unconditionally):
 
-    * ``moment_dtype``: dtype of the m/v accumulators ("float32" default,
-      "bfloat16" halves optimizer state; update math always runs in fp32).
+    * ``moment_dtype``: dtype of the m/v accumulators — "float32" default;
+      "bfloat16" halves optimizer state; "int8" stores per-block
+      absmax-quantized moments (1 byte/param + 4/2048 scale overhead, the
+      bitsandbytes 8-bit layout; unfused path only). Update math always
+      runs in fp32. int8 caveat: the per-block absmax REDUCTION pins the
+      fp32 update transient in HBM (a cast can fuse away, a reduction
+      cannot), so for one giant scan-stacked tensor its peak memory
+      exceeds bf16's — int8 wins on models made of many medium tensors;
+      at the single-chip scan-stacked memory limit prefer "bfloat16".
     * ``use_master_weights``: None keeps the reference behavior (fp32
       masters for bf16/fp16 params); False trains master-weight-free — bf16
       params update in-place with stochastic rounding
@@ -540,7 +574,14 @@ class Adam(Optimizer):
         self._epsilon = epsilon
         self._use_multi_tensor = use_multi_tensor
         self._lazy_mode = bool(lazy_mode)
-        self._moment_dtype = jnp.dtype(moment_dtype)
+        self._moment_q8 = str(moment_dtype) == "int8"
+        if self._moment_q8 and use_multi_tensor:
+            raise ValueError(
+                "moment_dtype='int8' is supported on the per-param path "
+                "only; drop use_multi_tensor (XLA fuses the per-param "
+                "updates under to_static anyway)")
+        self._moment_dtype = jnp.dtype("float32") if self._moment_q8 \
+            else jnp.dtype(moment_dtype)
         self._use_master_weights = use_master_weights
         self._stochastic_rounding = bool(stochastic_rounding)
         self._fused = None  # flat-buffer state, built by _materialize_state
@@ -548,6 +589,14 @@ class Adam(Optimizer):
             self._materialize_state()
 
     def _create_accumulators(self, p):
+        if self._moment_q8:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            nb = -(-n // _Q8_BLOCK)
+            for name in ("moment1", "moment2"):
+                self._acc(name, p, init=jnp.zeros((nb, _Q8_BLOCK), jnp.int8))
+                self._acc(name + "_scale", p,
+                          init=jnp.ones((nb,), jnp.float32))
+            return
         self._acc("moment1", p, dtype=self._moment_dtype)
         self._acc("moment2", p, dtype=self._moment_dtype)
 
@@ -870,10 +919,24 @@ class Adam(Optimizer):
         # update math in fp32 regardless of storage dtype (XLA fuses the
         # widen/narrow casts into the elementwise chain — no fp32 copy of
         # the state ever materializes in HBM)
-        new_m = b1 * m._data.astype(jnp.float32) + (1 - b1) * g32
-        new_v = b2 * v._data.astype(jnp.float32) + (1 - b2) * g32 * g32
-        m._set_data(new_m.astype(self._moment_dtype))
-        v._set_data(new_v.astype(self._moment_dtype))
+        if self._moment_q8:
+            ms = self._acc("moment1_scale", p)
+            vs = self._acc("moment2_scale", p)
+            m32 = _q8_dequantize(m._data, ms._data, p._data.shape)
+            v32 = _q8_dequantize(v._data, vs._data, p._data.shape)
+            new_m = b1 * m32 + (1 - b1) * g32
+            new_v = b2 * v32 + (1 - b2) * g32 * g32
+            qm, qms = _q8_quantize(new_m)
+            qv, qvs = _q8_quantize(new_v)
+            m._set_data(qm)
+            ms._set_data(qms)
+            v._set_data(qv)
+            vs._set_data(qvs)
+        else:
+            new_m = b1 * m._data.astype(jnp.float32) + (1 - b1) * g32
+            new_v = b2 * v._data.astype(jnp.float32) + (1 - b2) * g32 * g32
+            m._set_data(new_m.astype(self._moment_dtype))
+            v._set_data(new_v.astype(self._moment_dtype))
         mhat = new_m / (1 - b1 ** t)
         vhat = new_v / (1 - b2 ** t)
         master = self._ensure_master(p)
@@ -896,6 +959,7 @@ class Adam(Optimizer):
         # rows is the explicit ``lazy_mode`` approximation (upstream adam
         # kernel's lazy_mode flag) — without it, densify
         return (self._lazy_mode
+                and not self._moment_q8  # block quant is whole-tensor
                 and getattr(self, "_lr_ratio", None) is None
                 and super()._sparse_eligible(p, group))
 

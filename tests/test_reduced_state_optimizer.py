@@ -183,3 +183,42 @@ def test_reduced_state_survives_to_static():
     losses = [float(step(paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i])))
               for i in range(20)]
     assert losses[-1] < 0.2 * losses[0], losses
+
+
+def test_int8_moments_track_fp32_trajectory():
+    """8-bit block-quantized m/v (the bitsandbytes layout): trajectory in
+    the fp32 neighborhood, state physically int8."""
+    ref, _ = _train(moment_dtype="float32")
+    lo, opt = _train(moment_dtype="int8")
+    assert lo[-1] < 0.15 * lo[0], "int8-moment training must converge"
+    np.testing.assert_allclose(lo, ref, rtol=0.35, atol=0.05)
+    m = next(iter(opt._accumulators["moment1"].values()))
+    assert m._data.dtype == jnp.int8
+    s = next(iter(opt._accumulators["moment1_scale"].values()))
+    assert s._data.dtype == jnp.float32
+
+
+def test_int8_moments_master_free_end_to_end():
+    lo, opt = _train(cast_bf16=True, master=False, moment_dtype="int8")
+    assert len(opt._master_weights) == 0
+    assert lo[-1] < 0.2 * lo[0], lo
+
+
+def test_int8_rejects_fused_path():
+    paddle.seed(3)
+    m = nn.Linear(4, 4)
+    with pytest.raises(ValueError, match="int8"):
+        paddle.optimizer.AdamW(parameters=m.parameters(),
+                               use_multi_tensor=True, moment_dtype="int8")
+
+
+def test_q8_quantize_roundtrip():
+    from paddle_tpu.optimizer import _q8_dequantize, _q8_quantize
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 3, (1000,)).astype(np.float32) *
+                    rng.uniform(0.001, 10, (1000,)).astype(np.float32))
+    q, s = _q8_quantize(x)
+    back = _q8_dequantize(q, s, (1000,))
+    # per-block absmax: error bounded by absmax/254 per block
+    err = np.abs(np.asarray(back - x))
+    assert err.max() <= float(jnp.max(jnp.abs(x))) / 127.0 + 1e-6
