@@ -5,7 +5,7 @@ Prints ONE JSON line:
 
 Metric of record (BASELINE.json): tokens/sec/chip on a Llama-2-style decoder.
 A single TPU v5 lite chip cannot hold 7B for training, so the bench runs the
-LARGEST Llama that fits — 1.59B params at seq 2048 (the north-star regime's
+LARGEST Llama that fits — 1.59B params at seq 4096 (the north-star regime's
 per-chip story) — using the reduced-footprint optimizer (bf16 moments,
 master-weight-free bf16 params with stochastic rounding; 6 bytes/param of
 state), scan-over-layers and activation recompute. ``vs_baseline`` is
@@ -86,7 +86,7 @@ def main() -> None:
                           max_position_embeddings=4096,
                           scan_layers=True, recompute=True)
         # seq 4096 / bs 3 is the measured MFU sweet spot for this model
-        # (RESULTS.md north-star table: 0.616 vs 0.595 at seq 2048/bs 6)
+        # (RESULTS.md north-star table: 0.614 vs 0.595 at seq 2048/bs 6)
         batch, seq, steps, scan_k = 3, 4096, 16, 4
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:  # CPU smoke config so the bench always runs

@@ -222,6 +222,16 @@ def set_hybrid_communicate_group(hcg: HybridCommunicateGroup) -> None:
     global _hcg, _topology_epoch
     _hcg = hcg
     _topology_epoch += 1
+    # purge topology-scoped caches EAGERLY: dist.split's cached layers hold
+    # registered state tensors committed to the OLD mesh — left alive, they
+    # ride into every later to_static state signature and collide with the
+    # new mesh's device set (the lazy next-call purge is not enough when
+    # split is never called again)
+    try:
+        from .comm import _SPLIT_LAYERS
+        _SPLIT_LAYERS.clear()
+    except ImportError:  # pragma: no cover - circular-import guard
+        pass
 
 
 def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:

@@ -220,3 +220,26 @@ def test_geo_flushes_per_table():
     # barrier flushes b's partial window
     c.barrier()
     np.testing.assert_allclose(np.asarray(t2._data)[0], -2.0)
+
+
+def test_split_cache_purged_on_topology_change():
+    """dist.split's cached layers are committed to the active mesh; a
+    topology change must release them EAGERLY — stale mesh-committed state
+    tensors would ride into every later to_static signature and collide
+    with the new mesh's device set (found as order-dependent ZeRO test
+    failures in the full tier)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.comm import _SPLIT_LAYERS
+    from paddle_tpu.distributed.topology import set_hybrid_communicate_group
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        dist.split(x, (8, 16), operation="linear", axis=1, name="purge_t")
+        assert "purge_t" in _SPLIT_LAYERS
+    finally:
+        set_hybrid_communicate_group(None)
+    assert not _SPLIT_LAYERS
