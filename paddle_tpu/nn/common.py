@@ -325,22 +325,8 @@ class Bilinear(Layer):
                                           is_bias=True)
 
     def forward(self, x1, x2):
-        import jax.numpy as jnp
-        from ..core.tensor import apply
-
-        from ..ops.linalg import _precision
-
-        if self.bias is None:
-            def f(a, b, w):
-                return jnp.einsum("bi,oij,bj->bo", a, w, b,
-                                  precision=_precision())
-            return apply("bilinear", f, x1, x2, self.weight)
-
-        def f(a, b, w, bias):
-            return jnp.einsum("bi,oij,bj->bo", a, w, b,
-                              precision=_precision()) + bias
-
-        return apply("bilinear", f, x1, x2, self.weight, self.bias)
+        from ..ops.nn_ext import bilinear as _bilinear
+        return _bilinear(x1, x2, self.weight, self.bias)
 
 
 class RReLU(Layer):

@@ -41,7 +41,8 @@ from ..ops.nn_ext import (  # noqa: F401
     adaptive_log_softmax_with_loss, class_center_sample, sparse_attention,
     dice_loss, multi_label_soft_margin_loss,
     triplet_margin_with_distance_loss, hsigmoid_loss, zeropad2d,
-    embedding_bag, pairwise_distance, linear_compress,
+    embedding_bag, pairwise_distance, linear_compress, bilinear,
+    gather_tree,
 )
 
 

@@ -945,3 +945,58 @@ def fractional_max_pool3d(x, output_size, kernel_size=None,
 for _n in ("max_unpool1d", "max_unpool3d", "fractional_max_pool2d",
            "fractional_max_pool3d"):
     register_op(_n, globals()[_n])
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Functional bilinear transform (reference: paddle.nn.functional.
+    bilinear): out[b, o] = x1[b] @ W[o] @ x2[b]^T (+ bias)."""
+    x1, x2, weight = (ensure_tensor(x1), ensure_tensor(x2),
+                      ensure_tensor(weight))
+    from .linalg import _precision
+
+    if bias is None:
+        return apply("bilinear",
+                     lambda a, b, w: jnp.einsum("bi,oij,bj->bo", a, w, b,
+                                                precision=_precision()),
+                     x1, x2, weight)
+    return apply("bilinear",
+                 lambda a, b, w, bb: jnp.einsum(
+                     "bi,oij,bj->bo", a, w, b, precision=_precision())
+                 + bb.reshape(1, -1),
+                 x1, x2, weight, ensure_tensor(bias))
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace (reference: paddle.nn.functional.gather_tree;
+    upstream gather_tree op): ids/parents are (max_time, batch, beam); walk
+    backwards from the last step following parent pointers so every beam
+    holds its FULL token path. Static-shaped lax.scan over reversed time —
+    jit-safe."""
+    import jax
+
+    ids, parents = ensure_tensor(ids), ensure_tensor(parents)
+
+    def f(idv, parv):
+        # canonical recurrence (upstream gather_tree / TF seq2seq):
+        #   out[T-1] = ids[T-1, beam]; parent = parents[T-1, beam]
+        #   for t in T-2..0: out[t] = ids[t, parent];
+        #                    parent = parents[t, parent]
+        T = idv.shape[0]
+        beams = jnp.arange(idv.shape[2], dtype=parv.dtype)
+        b_idx = jnp.arange(idv.shape[1])[:, None]
+
+        def step(carry, t):
+            ptr = carry
+            tok = idv[t][b_idx, ptr]
+            return parv[t][b_idx, ptr], tok
+
+        init = jnp.broadcast_to(beams[None, :],
+                                (idv.shape[1], idv.shape[2]))
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply("gather_tree", f, ids, parents, differentiable=False)
+
+
+register_op("bilinear", bilinear)
+register_op("gather_tree", gather_tree)
