@@ -1,0 +1,99 @@
+"""Partial-graph (segment executor) throughput vs full-graph vs eager.
+
+The full_graph=False contract claims a graph break costs "compiled
+segments around the break", not a fall to per-op eager. This measures it:
+one train step with a tensor-dependent Python branch mid-step, run three
+ways on the same model/data:
+
+  full    — full_graph=True with the branch removed (the ceiling)
+  segment — full_graph=False with the branch (2 compiled segments/call)
+  eager   — plain eager with the branch (the old fallback behavior)
+
+Run: python benchmarks/bench_sot_segments.py   (chip or CPU)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import json
+import warnings
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    D, H, LAYERS, BATCH, STEPS = 512, 2048, 4, 256, 30
+    rng = np.random.default_rng(0)
+    xs = rng.normal(0, 1, (BATCH, D)).astype(np.float32)
+    ys = rng.normal(0, 1, (BATCH, D)).astype(np.float32)
+
+    def build():
+        paddle.seed(7)
+        layers = []
+        for _ in range(LAYERS):
+            layers += [nn.Linear(D, H), nn.GELU(), nn.Linear(H, D)]
+        model = nn.Sequential(*layers)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        return model, opt
+
+    def step_fn(model, opt, with_break):
+        def step(x, y):
+            loss = ((model(x) - y) ** 2).mean()
+            if with_break and float(loss) > 1e9:  # tensor-dependent branch
+                loss = loss * 0.5
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+        return step
+
+    def time_mode(runner):
+        for _ in range(3):  # warm (compile / segment-cache fill)
+            runner()
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            loss = runner()
+        float(loss)  # sync
+        return STEPS / (time.perf_counter() - t0)
+
+    x, y = paddle.to_tensor(xs), paddle.to_tensor(ys)
+    results = {}
+
+    m, o = build()
+    full = paddle.jit.to_static(step_fn(m, o, with_break=False))
+    results["full_graph_steps_per_sec"] = time_mode(lambda: full(x, y))
+
+    m, o = build()
+    seg = paddle.jit.to_static(step_fn(m, o, with_break=True),
+                               full_graph=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        results["segmented_steps_per_sec"] = time_mode(lambda: seg(x, y))
+
+    m, o = build()
+    eager = step_fn(m, o, with_break=True)
+    results["eager_steps_per_sec"] = time_mode(lambda: eager(x, y))
+
+    results = {k: round(v, 2) for k, v in results.items()}
+    results["segment_vs_full"] = round(
+        results["segmented_steps_per_sec"]
+        / results["full_graph_steps_per_sec"], 3)
+    results["segment_vs_eager"] = round(
+        results["segmented_steps_per_sec"]
+        / results["eager_steps_per_sec"], 2)
+    print(json.dumps({"benchmark": "sot_segments",
+                      "params": sum(p.size for p in m.parameters()),
+                      **results, "device": str(jax.devices()[0])}))
+
+
+if __name__ == "__main__":
+    main()
