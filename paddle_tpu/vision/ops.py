@@ -792,3 +792,73 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
 
 __all__ += ["prior_box", "yolo_box", "yolo_loss", "matrix_nms",
             "generate_proposals", "distribute_fpn_proposals"]
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference: paddle.vision.ops /
+    fluid box_clip op): input (..., 4) [xmin, ymin, xmax, ymax], im_info
+    per-image (H, W, scale) — boxes clamp to [0, W-1] x [0, H-1] after
+    scale."""
+    from ..core.tensor import apply
+
+    input, im_info = ensure_tensor(input), ensure_tensor(im_info)
+
+    def f(boxes, info):
+        info = info.reshape(-1)
+        h, w = info[0], info[1]
+        scale = info[2] if info.shape[0] > 2 else jnp.asarray(1.0, info.dtype)
+        wmax = w / scale - 1.0
+        hmax = h / scale - 1.0
+        x1 = jnp.clip(boxes[..., 0], 0.0, wmax)
+        y1 = jnp.clip(boxes[..., 1], 0.0, hmax)
+        x2 = jnp.clip(boxes[..., 2], 0.0, wmax)
+        y2 = jnp.clip(boxes[..., 3], 0.0, hmax)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    return apply("box_clip", f, input, im_info)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """Greedy bipartite matching (reference: the SSD target-assign
+    bipartite_match op): dist (N, M) similarity; each column matches at
+    most one row. ``match_type='per_prediction'`` additionally matches
+    unmatched columns to their best row when the distance exceeds
+    ``dist_threshold``. Returns (match_indices (1, M) int32 with -1 for
+    unmatched, match_dist (1, M)). Host-side numpy loop (data-prep op,
+    like the reference's CPU-only kernel)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    d = np.array(dist_matrix.numpy() if hasattr(dist_matrix, "numpy")
+                 else dist_matrix, np.float32)
+    if d.ndim != 2:
+        raise ValueError("bipartite_match expects a 2-D distance matrix")
+    n, m = d.shape
+    idx = np.full((m,), -1, np.int32)
+    dist = np.zeros((m,), np.float32)
+    # mask with NaN (not -inf): real -inf entries stay distinguishable
+    # from consumed rows/columns, and NaN distances are never matched
+    work = d.copy()
+    work[~np.isfinite(work)] = np.nan
+    for _ in range(min(n, m)):
+        if not np.any(~np.isnan(work)):
+            break
+        r, c = np.unravel_index(np.nanargmax(work), work.shape)
+        idx[c] = r
+        dist[c] = d[r, c]
+        work[r, :] = np.nan
+        work[:, c] = np.nan
+    if match_type == "per_prediction":
+        for c in range(m):
+            if idx[c] == -1:
+                r = int(np.argmax(d[:, c]))
+                if d[r, c] >= dist_threshold:
+                    idx[c] = r
+                    dist[c] = d[r, c]
+    return (Tensor(jnp.asarray(idx[None])),
+            Tensor(jnp.asarray(dist[None])))
+
+
+__all__ += ["box_clip", "bipartite_match"]

@@ -224,18 +224,38 @@ class Pad(BaseTransform):
 
 
 class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue (reference semantics: one
+    random factor per property, applied in order)."""
+
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        if not 0 <= hue <= 0.5:
+            raise ValueError("hue must be in [0, 0.5]")
         self.brightness = brightness
         self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
 
     def __call__(self, img):
-        a = np.asarray(img, np.float32)
+        # the value range is inferred ONCE: per-op re-inference would flip
+        # from the 255 to the 1.0 range after a strong darkening and clip
+        # the image to garbage
+        a, scale = _as_float(img)
         if self.brightness:
-            a = a * np.random.uniform(1 - self.brightness, 1 + self.brightness)
+            a = _adjust_brightness(
+                a, np.random.uniform(max(0.0, 1 - self.brightness),
+                                     1 + self.brightness), scale)
         if self.contrast:
-            m = a.mean()
-            a = (a - m) * np.random.uniform(1 - self.contrast, 1 + self.contrast) + m
-        return np.clip(a, 0, 255 if a.max() > 1.5 else 1.0)
+            a = _adjust_contrast(
+                a, np.random.uniform(max(0.0, 1 - self.contrast),
+                                     1 + self.contrast), scale)
+        if self.saturation and a.ndim == 3:
+            a = _adjust_saturation(
+                a, np.random.uniform(max(0.0, 1 - self.saturation),
+                                     1 + self.saturation), scale)
+        if self.hue and a.ndim == 3:
+            a = adjust_hue(a, np.random.uniform(-self.hue, self.hue),
+                           _scale=scale)
+        return a
 
 
 class Grayscale(BaseTransform):
@@ -287,7 +307,7 @@ def erase(img, i, j, h, w, v, inplace=False):
     return a
 
 
-def _affine_sample(a, matrix):
+def _affine_sample(a, matrix, fill=0):
     """Inverse-warp HWC/CHW array with a 2x3 affine matrix (nearest)."""
     chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
     hw = a.shape[1:3] if chw else a.shape[:2]
@@ -303,10 +323,10 @@ def _affine_sample(a, matrix):
     valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
     if chw:
         out = a[:, syi, sxi]
-        return np.where(valid[None], out, 0).astype(a.dtype)
+        return np.where(valid[None], out, fill).astype(a.dtype)
     out = a[syi, sxi]
     return np.where(valid[..., None] if a.ndim == 3 else valid, out,
-                    0).astype(a.dtype)
+                    fill).astype(a.dtype)
 
 
 def perspective(img, startpoints, endpoints, interpolation="nearest",
@@ -473,3 +493,252 @@ class AutoAugment(RandAugment):
 
     def __init__(self, policy="imagenet", interpolation="nearest", fill=0):
         super().__init__(num_ops=2, magnitude=9)
+
+
+# ---------------------------------------------------------------------------
+# functional surface (reference: paddle.vision.transforms.functional / the
+# F.* names re-exported at transforms level) + the photometric transform
+# classes built on it. All numpy/HWC-or-CHW, matching this module's model.
+# ---------------------------------------------------------------------------
+
+def hflip(img):
+    a = np.asarray(img)
+    if a.ndim == 2:
+        return a[:, ::-1]
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    return a[:, :, ::-1] if chw else a[:, ::-1]
+
+
+def vflip(img):
+    a = np.asarray(img)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    return a[:, ::-1] if chw else a[::-1]
+
+
+def crop(img, top, left, height, width):
+    a = np.asarray(img)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    if chw:
+        return a[:, top:top + height, left:left + width]
+    return a[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    a = np.asarray(img)
+    oh, ow = ((output_size, output_size)
+              if isinstance(output_size, int) else output_size)
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    h, w = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+    top, left = max((h - oh) // 2, 0), max((w - ow) // 2, 0)
+    return crop(a, top, left, oh, ow)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize_np(np.asarray(img), size, interpolation)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if to_rgb:  # reference: flip BGR -> RGB before normalizing
+        a = a[::-1] if data_format == "CHW" else a[..., ::-1]
+    if data_format == "CHW":
+        return (a - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (a - mean) / std
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def _adjust_brightness(a, factor, scale):
+    return np.clip(a * float(factor), 0, scale)
+
+
+def _adjust_contrast(a, factor, scale):
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    w = np.array([0.299, 0.587, 0.114], np.float32)
+    if a.ndim == 2:
+        mean = a.mean()
+    else:
+        gray = (np.tensordot(w, a, axes=([0], [0])) if chw else a @ w)
+        mean = gray.mean()
+    return np.clip((a - mean) * float(factor) + mean, 0, scale)
+
+
+def _adjust_saturation(a, factor, scale):
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    w = np.array([0.299, 0.587, 0.114], np.float32)
+    gray = (np.tensordot(w, a, axes=([0], [0]))[None] if chw
+            else (a @ w)[..., None])
+    return np.clip(gray + float(factor) * (a - gray), 0, scale)
+
+
+def adjust_brightness(img, brightness_factor):
+    a, scale = _as_float(img)
+    return _adjust_brightness(a, brightness_factor, scale)
+
+
+def adjust_contrast(img, contrast_factor):
+    a, scale = _as_float(img)
+    return _adjust_contrast(a, contrast_factor, scale)
+
+
+def adjust_saturation(img, saturation_factor):
+    a, scale = _as_float(img)
+    return _adjust_saturation(a, saturation_factor, scale)
+
+
+def adjust_hue(img, hue_factor, _scale=None):
+    """Shift hue by ``hue_factor`` (in [-0.5, 0.5] turns) via RGB->HSV."""
+    if not -0.5 <= float(hue_factor) <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    if _scale is None:
+        a, scale = _as_float(img)
+    else:
+        a, scale = np.asarray(img, np.float32), _scale
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    rgb = (np.moveaxis(a, 0, -1) if chw else a) / scale
+    mx, mn = rgb.max(-1), rgb.min(-1)
+    diff = mx - mn
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    safe = np.where(diff == 0, 1.0, diff)
+    h = np.where(mx == r, ((g - b) / safe) % 6,
+                 np.where(mx == g, (b - r) / safe + 2, (r - g) / safe + 4))
+    h = np.where(diff == 0, 0.0, h) / 6.0
+    h = (h + float(hue_factor)) % 1.0
+    s = np.where(mx == 0, 0.0, diff / np.where(mx == 0, 1.0, mx))
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = i.astype(np.int64) % 6
+    out = np.select(
+        [(i == k)[..., None] for k in range(6)],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    out = np.clip(out * scale, 0, scale).astype(np.float32)
+    return np.moveaxis(out, -1, 0) if chw else out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate by ``angle`` degrees counter-clockwise (reference
+    convention — verified against np.rot90 for the 90-degree case) about
+    ``center`` (image center by default). expand=True output-resizing is
+    not implemented — pre-pad instead."""
+    if expand:
+        raise NotImplementedError(
+            "rotate(expand=True) is not implemented; pad the image to the "
+            "rotated bounding box first")
+    a = np.asarray(img)
+    rad = np.deg2rad(angle)  # backward warp: sample with the inverse (CW)
+    m = [np.cos(rad), -np.sin(rad), 0.0, np.sin(rad), np.cos(rad), 0.0]
+    if center is not None:
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        h, w = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+        cx, cy = center[0] - (w - 1) / 2.0, center[1] - (h - 1) / 2.0
+        # shift so rotation pivots on `center` instead of the image center
+        m[2] = cx - (m[0] * cx + m[1] * cy)
+        m[5] = cy - (m[3] * cx + m[4] * cy)
+    return _affine_sample(a, m, fill=fill)
+
+
+def affine(img, angle=0, translate=(0, 0), scale=1.0, shear=(0, 0),
+           interpolation="nearest", fill=0, center=None):
+    return _affine_from_params(np.asarray(img), angle, translate, scale,
+                               shear)
+
+
+def _affine_from_params(a, angle, translate, scale, shear):
+    rad = -np.deg2rad(angle)
+    s = 1.0 / float(scale)
+    shx, shy = (np.deg2rad(shear[0]), np.deg2rad(shear[1])) \
+        if isinstance(shear, (tuple, list)) else (np.deg2rad(shear), 0.0)
+    rot = np.array([[np.cos(rad), -np.sin(rad)],
+                    [np.sin(rad), np.cos(rad)]], np.float32)
+    sh = np.array([[1.0, np.tan(shx)], [np.tan(shy), 1.0]], np.float32)
+    lin = s * (rot @ sh)
+    m = [lin[0, 0], lin[0, 1], -float(translate[0]),
+         lin[1, 0], lin[1, 1], -float(translate[1])]
+    return _affine_sample(a, m)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def __call__(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, expand=self.expand,
+                      center=self.center, fill=self.fill)
+
+
+__all__ += ["hflip", "vflip", "crop", "center_crop", "resize", "pad",
+            "normalize", "to_tensor", "to_grayscale", "adjust_brightness",
+            "adjust_contrast", "adjust_saturation", "adjust_hue", "rotate",
+            "affine", "BrightnessTransform", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "RandomRotation"]

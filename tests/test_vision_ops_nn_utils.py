@@ -227,3 +227,87 @@ class TestResizeSemantics:
             assert T.Resize((32, 32), interpolation=mode)(img).shape == (32, 32)
         with pytest.raises(ValueError):
             T.Resize((32, 32), interpolation="area")(img)
+
+
+class TestRound3VisionTail:
+    def test_box_clip(self):
+        import paddle_tpu.vision.ops as vo
+        b = paddle.to_tensor(np.array([[-5, -5, 30, 40], [2, 3, 100, 90]],
+                                      np.float32))
+        info = paddle.to_tensor(np.array([20.0, 25.0, 1.0], np.float32))
+        out = vo.box_clip(b, info).numpy()
+        np.testing.assert_allclose(out,
+                                   [[0, 0, 24, 19], [2, 3, 24, 19]])
+
+    def test_bipartite_match(self):
+        import paddle_tpu.vision.ops as vo
+        d = np.array([[0.9, 0.1, 0.3], [0.2, 0.8, 0.6]], np.float32)
+        idx, dist = vo.bipartite_match(paddle.to_tensor(d))
+        assert idx.numpy().tolist() == [[0, 1, -1]]
+        np.testing.assert_allclose(dist.numpy(), [[0.9, 0.8, 0.0]])
+        idx2, dist2 = vo.bipartite_match(paddle.to_tensor(d),
+                                         match_type="per_prediction",
+                                         dist_threshold=0.5)
+        assert idx2.numpy().tolist() == [[0, 1, 1]]
+
+    def test_bipartite_match_nan_robust(self):
+        import paddle_tpu.vision.ops as vo
+        d = np.array([[np.nan, 0.9], [0.8, np.nan]], np.float32)
+        idx, dist = vo.bipartite_match(paddle.to_tensor(d))
+        assert idx.numpy().tolist() == [[1, 0]]
+        assert np.all(np.isfinite(dist.numpy()))
+
+    def test_hflip_layouts_and_rotate_direction(self):
+        import paddle_tpu.vision.transforms as T
+        chw = np.arange(3 * 5 * 4, dtype=np.float32).reshape(3, 5, 4)
+        np.testing.assert_allclose(T.hflip(chw), chw[:, :, ::-1])
+        # H outside {1,3,4}: the module's CHW-vs-HWC heuristic reads this
+        # unambiguously as HWC
+        hwc = np.arange(5 * 4 * 3, dtype=np.float32).reshape(5, 4, 3)
+        np.testing.assert_allclose(T.hflip(hwc), hwc[:, ::-1])  # width, not C
+        # rotate(90) is counter-clockwise == np.rot90 on the spatial dims
+        img = np.zeros((1, 5, 5), np.float32)
+        img[0, 0, 4] = 1.0  # lit pixel top-right
+        out = T.rotate(img, 90)
+        np.testing.assert_allclose(out[0], np.rot90(img[0]))
+
+    def test_colorjitter_dark_range_stays_consistent(self):
+        import paddle_tpu.vision.transforms as T
+        img = np.full((3, 8, 8), 200.0, np.float32)
+        np.random.seed(0)
+        out = T.ColorJitter(brightness=0.999, contrast=0.5)(img)
+        # a strong darkening must not flip the inferred range and clip to 1
+        assert out.max() <= 255.0 and not np.allclose(out, np.clip(out, 0, 1))
+        with pytest.raises(ValueError):
+            T.ColorJitter(hue=0.6)
+
+    def test_normalize_to_rgb(self):
+        import paddle_tpu.vision.transforms as T
+        bgr = np.stack([np.full((2, 2), 10.0), np.full((2, 2), 20.0),
+                        np.full((2, 2), 30.0)]).astype(np.float32)
+        out = T.normalize(bgr, [0, 0, 0], [1, 1, 1], to_rgb=True)
+        np.testing.assert_allclose(out[0], 30.0)  # red channel came from B
+
+    def test_transforms_functional_surface(self):
+        import paddle_tpu.vision.transforms as T
+        rng = np.random.default_rng(0)
+        img = rng.uniform(0, 255, (3, 16, 16)).astype(np.float32)
+        assert T.hflip(img).shape == img.shape
+        np.testing.assert_allclose(T.hflip(T.hflip(img)), img)
+        assert T.crop(img, 2, 3, 8, 9).shape == (3, 8, 9)
+        assert T.center_crop(img, 8).shape == (3, 8, 8)
+        assert T.resize(img, (8, 10)).shape == (3, 8, 10)
+        assert T.to_grayscale(img).shape == (1, 16, 16)
+        assert T.rotate(img, 30).shape == img.shape
+        # hue shift round-trips
+        x = rng.uniform(0, 1, (3, 8, 8)).astype(np.float32)
+        rt = T.adjust_hue(T.adjust_hue(x, 0.3), -0.3)
+        assert np.abs(rt - x).max() < 1e-2
+        # saturation=0 is grayscale everywhere
+        g = T.adjust_saturation(x, 0.0)
+        assert np.abs(g[0] - g[1]).max() < 1e-6
+        for cls in (T.BrightnessTransform, T.ContrastTransform,
+                    T.SaturationTransform):
+            assert cls(0.2)(img).shape == img.shape
+        assert T.HueTransform(0.1)(img).shape == img.shape
+        assert T.RandomRotation(15)(img).shape == img.shape
