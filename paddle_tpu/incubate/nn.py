@@ -15,7 +15,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
+
 from .. import nn
+from ..core.tensor import apply
 from ..nn import functional as F
 from . import nn_functional as functional  # noqa: F401  (incubate.nn.functional)
 from .nn_functional import memory_efficient_attention  # noqa: F401
@@ -156,3 +160,79 @@ class FusedMoELayer(nn.Layer):
 
 
 __all__ += ["FusedTransformerEncoderLayer", "FusedMoELayer"]
+
+
+class FusedDropoutAdd(nn.Layer):
+    """y = dropout(x) + residual as one layer (reference:
+    paddle.incubate.nn.FusedDropoutAdd — upstream fuses the two kernels;
+    XLA fuses the same chain automatically, so this is the API surface
+    over the ordinary ops)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.dropout(x, p=self.p, training=self.training,
+                         mode=self.mode) + y
+
+
+class FusedEcMoe(nn.Layer):
+    """Expert-choice MoE layer (reference: paddle.incubate.nn.FusedEcMoe;
+    upstream signature — ``forward(x, gate)`` takes the caller's gate
+    LOGITS (B, S, E), the layer owns only the expert weights): experts
+    pick their top tokens (capacity-bounded) instead of tokens picking
+    experts — balanced by construction. Lowered as dense einsums over the
+    expert axis with a top-k token mask (MXU-friendly; no ragged
+    dispatch)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError("act_type must be gelu or relu")
+        if weight_attr is False or bias_attr is False:
+            raise ValueError(
+                "FusedEcMoe requires its expert weights and biases "
+                "(attr=False is not supported)")
+        self.num_experts = num_experts
+        self.act_type = act_type
+        self.w0 = self.create_parameter((num_experts, hidden_size, inter_size),
+                                        attr=weight_attr)
+        self.b0 = self.create_parameter((num_experts, 1, inter_size),
+                                        attr=bias_attr, is_bias=True)
+        self.w1 = self.create_parameter((num_experts, inter_size, hidden_size),
+                                        attr=weight_attr)
+        self.b1 = self.create_parameter((num_experts, 1, hidden_size),
+                                        attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate):
+        act = self.act_type
+
+        def f(xv, gv, w0, b0, w1, b1):
+            B, S, H = xv.shape
+            tokens = xv.reshape(B * S, H)
+            probs = jax.nn.softmax(gv.reshape(B * S, -1), axis=-1)
+            T = tokens.shape[0]
+            E = w0.shape[0]
+            capacity = max(T // E, 1)
+            # expert choice: each expert takes its top-`capacity` tokens
+            gate_t = probs.T                            # (E, T)
+            weight, sel = jax.lax.top_k(gate_t, capacity)  # (E, C)
+            picked = tokens[sel]                        # (E, C, H)
+            h = jnp.einsum("ech,ehi->eci", picked, w0) + b0
+            h = jax.nn.gelu(h) if act == "gelu" else jnp.maximum(h, 0)
+            out_e = jnp.einsum("eci,eih->ech", h, w1) + b1  # (E, C, H)
+            out_e = out_e * weight[..., None]
+            # scatter-add expert outputs back to token positions
+            flat_out = jnp.zeros((T, H), xv.dtype)
+            flat_out = flat_out.at[sel.reshape(-1)].add(
+                out_e.reshape(-1, H))
+            return flat_out.reshape(B, S, H)
+
+        return apply("fused_ec_moe", f, x, gate,
+                     self.w0, self.b0, self.w1, self.b1)
+
+
+__all__ += ["FusedDropoutAdd", "FusedEcMoe"]
