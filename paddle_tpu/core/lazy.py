@@ -314,13 +314,23 @@ def flush() -> None:
 
         jitted = jax.jit(replay)
         st.compiled[sig] = jitted
-        if st.capture_hlo:
-            st.last_hlos.append(
-                jitted.lower(ext_arrays, lifted_arrays).compile().as_text())
-    elif st.capture_hlo:
-        st.last_hlos.append("<cached segment>")
 
-    outs = jitted(ext_arrays, lifted_arrays)
+    # Tracing (cache fill, capture_hlo lower, or an aval-change retrace on
+    # the cached path) runs ``replay``, which rebinds lifted closure
+    # cells/defaults with jit TRACERS. Restore the original arrays no
+    # matter what — a leaked tracer would be lifted into the NEXT segment
+    # and crash it with UnexpectedTracerError.
+    try:
+        if st.capture_hlo:
+            if cache_fill:
+                st.last_hlos.append(
+                    jitted.lower(ext_arrays, lifted_arrays).compile().as_text())
+            else:
+                st.last_hlos.append("<cached segment>")
+        outs = jitted(ext_arrays, lifted_arrays)
+    finally:
+        for rb, arr in zip(lifted_rebinds, lifted_arrays):
+            rb(arr)
     for (ri, si), arr in zip(escaping, outs):
         lv = records[ri].out_lazies[si]
         lv.array = arr

@@ -115,7 +115,11 @@ class SelectedRowsTensor:
 
     def accumulate_sparse(self, sr: SelectedRows) -> None:
         if self._dense is not None:
+            # the dense copy is authoritative from here on: keeping _sr
+            # would leave a stale sparse view missing these rows while
+            # is_selected_rows() still answered True
             self._dense = self._dense + sr.to_dense()
+            self._sr = None
         else:
             self._sr = self._sr.concat(sr)
 

@@ -180,6 +180,14 @@ class Tensor:
             if self._data.array is None:
                 _lazy.flush()
             if type(self._data).__name__ == "LazyValue":
+                if self._data.array is None:
+                    # the flush failed (or this value's segment flushed while
+                    # it had no live owner): surface a clear error instead of
+                    # silently degrading to a 0-d object array of None
+                    raise RuntimeError(
+                        "lazy tensor was never materialized: its recorded "
+                        "segment failed to flush or flushed without a live "
+                        "owner; re-run the producing op eagerly")
                 self._data = self._data.array
         return np.asarray(self._data)
 

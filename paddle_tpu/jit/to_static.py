@@ -253,7 +253,8 @@ class StaticFunction:
             # do. A LAZY-MACHINERY failure (an op touching the placeholder
             # in a way the recorder can't stage) downgrades FUTURE calls to
             # plain eager; genuine user errors keep the segmented path.
-            if "LazyValue" in str(e) or isinstance(e, NotImplementedError):
+            if ("LazyValue" in str(e) or isinstance(e, NotImplementedError)
+                    or isinstance(e, jax.errors.UnexpectedTracerError)):
                 self._cache[key] = _FALLBACK
                 import warnings
                 warnings.warn(

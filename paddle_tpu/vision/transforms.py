@@ -307,8 +307,44 @@ def erase(img, i, j, h, w, v, inplace=False):
     return a
 
 
-def _affine_sample(a, matrix, fill=0):
-    """Inverse-warp HWC/CHW array with a 2x3 affine matrix (nearest)."""
+def _sample_grid(a, sx, sy, fill=0, interpolation="nearest"):
+    """Gather image values at fractional source coords (sy, sx); positions
+    outside the image get ``fill``."""
+    chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+    hw = a.shape[1:3] if chw else a.shape[:2]
+    h, w = int(hw[0]), int(hw[1])
+    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+
+    def gather(syi, sxi):
+        return a[:, syi, sxi] if chw else a[syi, sxi]
+
+    if interpolation in ("bilinear", "linear"):
+        x0 = np.clip(np.floor(sx).astype(np.int64), 0, w - 1)
+        y0 = np.clip(np.floor(sy).astype(np.int64), 0, h - 1)
+        x1, y1 = np.minimum(x0 + 1, w - 1), np.minimum(y0 + 1, h - 1)
+        fx = (np.clip(sx, 0, w - 1) - x0).astype(np.float32)
+        fy = (np.clip(sy, 0, h - 1) - y0).astype(np.float32)
+        if chw:
+            fx, fy = fx[None], fy[None]
+        elif a.ndim == 3:
+            fx, fy = fx[..., None], fy[..., None]
+        out = ((1 - fy) * ((1 - fx) * gather(y0, x0) + fx * gather(y0, x1))
+               + fy * ((1 - fx) * gather(y1, x0) + fx * gather(y1, x1)))
+        if np.issubdtype(a.dtype, np.integer):
+            out = np.round(out)  # truncation would bias every sample low
+    else:
+        sxi = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
+        syi = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
+        out = gather(syi, sxi)
+    if chw:
+        mask = valid[None]
+    else:
+        mask = valid[..., None] if a.ndim == 3 else valid
+    return np.where(mask, out, fill).astype(a.dtype)
+
+
+def _affine_sample(a, matrix, fill=0, interpolation="nearest"):
+    """Inverse-warp HWC/CHW array with a 2x3 affine matrix."""
     chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
     hw = a.shape[1:3] if chw else a.shape[:2]
     h, w = int(hw[0]), int(hw[1])
@@ -318,15 +354,7 @@ def _affine_sample(a, matrix, fill=0):
     m = np.asarray(matrix, np.float32).reshape(2, 3)
     sx = m[0, 0] * xc + m[0, 1] * yc + m[0, 2] + (w - 1) / 2.0
     sy = m[1, 0] * xc + m[1, 1] * yc + m[1, 2] + (h - 1) / 2.0
-    sxi = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
-    syi = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
-    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
-    if chw:
-        out = a[:, syi, sxi]
-        return np.where(valid[None], out, fill).astype(a.dtype)
-    out = a[syi, sxi]
-    return np.where(valid[..., None] if a.ndim == 3 else valid, out,
-                    fill).astype(a.dtype)
+    return _sample_grid(a, sx, sy, fill=fill, interpolation=interpolation)
 
 
 def perspective(img, startpoints, endpoints, interpolation="nearest",
@@ -354,15 +382,7 @@ def perspective(img, startpoints, endpoints, interpolation="nearest",
     src = hmat @ pts
     sx = (src[0] / src[2]).reshape(h, w)
     sy = (src[1] / src[2]).reshape(h, w)
-    sxi = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
-    syi = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
-    valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
-    if chw:
-        out = a[:, syi, sxi]
-        return np.where(valid[None], out, fill).astype(a.dtype)
-    out = a[syi, sxi]
-    return np.where(valid[..., None] if a.ndim == 3 else valid, out,
-                    fill).astype(a.dtype)
+    return _sample_grid(a, sx, sy, fill=fill, interpolation=interpolation)
 
 
 class RandomErasing(BaseTransform):
@@ -394,7 +414,7 @@ class RandomErasing(BaseTransform):
 
 class RandomAffine(BaseTransform):
     """Random rotation/translate/scale/shear (reference:
-    transforms.RandomAffine; nearest resampling)."""
+    transforms.RandomAffine)."""
 
     def __init__(self, degrees, translate=None, scale=None, shear=None,
                  interpolation="nearest", fill=0, center=None):
@@ -407,33 +427,35 @@ class RandomAffine(BaseTransform):
             self.shear = (-float(shear), float(shear))
         else:
             self.shear = tuple(shear)
+        self.interpolation, self.fill, self.center = interpolation, fill, center
 
     def __call__(self, img):
         a = np.asarray(img)
-        ang = np.deg2rad(np.random.uniform(*self.degrees))
+        ang = np.random.uniform(*self.degrees)
         sc = (np.random.uniform(*self.scale_rng)
               if self.scale_rng else 1.0)
-        cos, sin = np.cos(ang) / sc, np.sin(ang) / sc
         chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
         h, w = (a.shape[1:3] if chw else a.shape[:2])
         tx = ty = 0.0
         if self.translate:
             tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
             ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
-        rot = np.asarray([[cos, -sin], [sin, cos]], np.float32)
         if self.shear is not None:
-            sx = np.tan(np.deg2rad(np.random.uniform(*self.shear[:2])))
-            sy = (np.tan(np.deg2rad(np.random.uniform(*self.shear[2:4])))
-                  if len(self.shear) == 4 else 0.0)
-            rot = rot @ np.asarray([[1.0, sx], [sy, 1.0]], np.float32)
-        m = [rot[0, 0], rot[0, 1], -tx, rot[1, 0], rot[1, 1], -ty]
-        return _affine_sample(a, m)
+            shx = np.random.uniform(*self.shear[:2])
+            shy = (np.random.uniform(*self.shear[2:4])
+                   if len(self.shear) == 4 else 0.0)
+        else:
+            shx = shy = 0.0
+        return _affine_from_params(a, ang, (tx, ty), sc, (shx, shy),
+                                   interpolation=self.interpolation,
+                                   fill=self.fill, center=self.center)
 
 
 class RandomPerspective(BaseTransform):
     def __init__(self, prob=0.5, distortion_scale=0.5,
                  interpolation="nearest", fill=0):
         self.prob, self.d = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
 
     def __call__(self, img):
         a = np.asarray(img)
@@ -448,7 +470,8 @@ class RandomPerspective(BaseTransform):
                (w - 1 - jitter()[0], 0 + jitter()[1]),
                (w - 1 - jitter()[0], h - 1 - jitter()[1]),
                (0 + jitter()[0], h - 1 - jitter()[1])]
-        return perspective(a, start, end)
+        return perspective(a, start, end, interpolation=self.interpolation,
+                           fill=self.fill)
 
 
 class RandAugment(BaseTransform):
@@ -651,27 +674,43 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
         # shift so rotation pivots on `center` instead of the image center
         m[2] = cx - (m[0] * cx + m[1] * cy)
         m[5] = cy - (m[3] * cx + m[4] * cy)
-    return _affine_sample(a, m, fill=fill)
+    return _affine_sample(a, m, fill=fill, interpolation=interpolation)
 
 
 def affine(img, angle=0, translate=(0, 0), scale=1.0, shear=(0, 0),
            interpolation="nearest", fill=0, center=None):
     return _affine_from_params(np.asarray(img), angle, translate, scale,
-                               shear)
+                               shear, interpolation=interpolation, fill=fill,
+                               center=center)
 
 
-def _affine_from_params(a, angle, translate, scale, shear):
-    rad = -np.deg2rad(angle)
-    s = 1.0 / float(scale)
+def _affine_from_params(a, angle, translate, scale, shear,
+                        interpolation="nearest", fill=0, center=None):
+    """Backward-warp matrix for the forward transform
+    ``y = s * R(angle) @ Sh(shear) @ (x - c) + c + translate`` (rotation
+    convention matching :func:`rotate`, CCW-positive): the sampling matrix
+    is the exact inverse ``x = (1/s) Sh^-1 R^-1 (y - c - t) + c``."""
+    rad = np.deg2rad(angle)
     shx, shy = (np.deg2rad(shear[0]), np.deg2rad(shear[1])) \
         if isinstance(shear, (tuple, list)) else (np.deg2rad(shear), 0.0)
-    rot = np.array([[np.cos(rad), -np.sin(rad)],
-                    [np.sin(rad), np.cos(rad)]], np.float32)
-    sh = np.array([[1.0, np.tan(shx)], [np.tan(shy), 1.0]], np.float32)
-    lin = s * (rot @ sh)
-    m = [lin[0, 0], lin[0, 1], -float(translate[0]),
-         lin[1, 0], lin[1, 1], -float(translate[1])]
-    return _affine_sample(a, m)
+    # inverse rotation: rotate() verified backward R(+rad) == forward CCW
+    rot_inv = np.array([[np.cos(rad), -np.sin(rad)],
+                        [np.sin(rad), np.cos(rad)]], np.float32)
+    tx_, ty_ = np.tan(shx), np.tan(shy)
+    sh_inv = np.array([[1.0, -tx_], [-ty_, 1.0]], np.float32) \
+        / (1.0 - tx_ * ty_)
+    lin = (sh_inv @ rot_inv) / float(scale)
+    if center is not None:
+        chw = a.ndim == 3 and a.shape[0] in (1, 3, 4)
+        h, w = (a.shape[1], a.shape[2]) if chw else (a.shape[0], a.shape[1])
+        cx = float(center[0]) - (w - 1) / 2.0
+        cy = float(center[1]) - (h - 1) / 2.0
+    else:
+        cx = cy = 0.0
+    tcx, tcy = float(translate[0]) + cx, float(translate[1]) + cy
+    m = [lin[0, 0], lin[0, 1], cx - (lin[0, 0] * tcx + lin[0, 1] * tcy),
+         lin[1, 0], lin[1, 1], cy - (lin[1, 0] * tcx + lin[1, 1] * tcy)]
+    return _affine_sample(a, m, fill=fill, interpolation=interpolation)
 
 
 class BrightnessTransform(BaseTransform):
