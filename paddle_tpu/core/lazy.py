@@ -34,8 +34,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-__all__ = ["LazyValue", "active", "segment_mode", "flush", "flush_if_active",
-           "record", "last_segment_hlos"]
+__all__ = ["LazyValue", "active", "segment_mode", "suspended", "flush",
+           "flush_if_active", "record", "last_segment_hlos"]
 
 
 class LazyValue:
@@ -75,6 +75,24 @@ class LazyValue:
         if self.array is None:
             flush()
         return self.array
+
+    def _materialize(self):
+        if self.array is None:
+            flush()
+            if self.array is None:
+                raise RuntimeError(
+                    "lazy value was never materialized: its recorded segment "
+                    "failed to flush or flushed without a live owner")
+        return self.array
+
+    def __int__(self):
+        return int(self._materialize())
+
+    def __float__(self):
+        return float(self._materialize())
+
+    def __bool__(self):
+        return bool(self._materialize())
 
     def __repr__(self):
         state = "pending" if self.array is None else "ready"
@@ -137,6 +155,25 @@ class segment_mode:
         finally:
             _state.active = False
             _state.records = []
+        return False
+
+
+class suspended:
+    """Temporarily disable recording inside an already-active segment.
+
+    Used by staged meta-ops (the optimizer-update record) whose ``fn``
+    re-runs eager-style jnp math when the replay trace calls it: with
+    recording suspended, any nested ``apply()`` executes inline on the
+    tracers — i.e. it becomes part of the SAME traced segment instead of
+    appending spurious records to the in-flight segment list."""
+
+    def __enter__(self):
+        self._was = _state.active
+        _state.active = False
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _state.active = self._was
         return False
 
 
@@ -204,11 +241,21 @@ def _aval_of(x):
 # record + flush
 # ---------------------------------------------------------------------------
 
-def record(op_name: str, fn, arrays) -> List[LazyValue]:
+def record(op_name: str, fn, arrays, fn_sig=None) -> List[LazyValue]:
     """Record one op over ``arrays`` (jax arrays or LazyValues); return the
-    output LazyValues (abstract-evaled, cached per signature)."""
+    output LazyValues (abstract-evaled, cached per signature).
+
+    ``fn_sig``: optional explicit hashable structural signature. When given,
+    the closure walk is skipped entirely — the CALLER guarantees that two
+    fns carrying the same signature trace identically over same-aval inputs,
+    and that every step-varying array the fn reads is passed via ``arrays``
+    (nothing is lifted from closures). This is the seam for staged meta-ops
+    like the optimizer-update segment."""
     st = _state
-    fn_sig, lifted = _walk_fn(fn)
+    if fn_sig is None:
+        fn_sig, lifted = _walk_fn(fn)
+    else:
+        lifted = []
     in_avals = tuple(
         (a.aval.shape, str(a.aval.dtype)) if isinstance(a, LazyValue)
         else (np.shape(a), str(a.dtype)) for a in arrays)

@@ -184,8 +184,14 @@ class Optimizer:
                 learning_rate._bound_opts = []
             learning_rate._bound_opts.append(weakref.ref(self))
         self._master_versions: Dict[int, int] = {}
+        # never-reused instance id: anchors the recorded-segment signature of
+        # the staged (full_graph=False) optimizer update
+        Optimizer._uid_counter += 1
+        self._opt_uid = Optimizer._uid_counter
         from ..jit.to_static import register_pretrace_hook
         register_pretrace_hook(self)
+
+    _uid_counter = 0
 
     # --- lr -----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -338,13 +344,26 @@ class Optimizer:
     def step(self) -> None:
         from ..core import lazy as _lazy
         from ..core.tracing import trace_state
-        # segment mode (full_graph=False partial capture): the update math
-        # below is raw jnp over state payloads — materialize the recorded
-        # forward/backward segment first
-        _lazy.flush_if_active()
+        if _lazy.active():
+            # segment mode (full_graph=False partial capture): stage the
+            # whole update as ONE recorded meta-op so it compiles into the
+            # current segment — a full_graph=False train step then runs as
+            # [fwd(+bwd) segment] -> host read -> [bwd+update segment] with
+            # no eager tail (upstream SOT compiles the update into its
+            # subgraphs: python/paddle/jit/sot/)
+            if self._try_record_step():
+                return
+            # ineligible configuration (sparse grads, custom step): the raw
+            # jnp update math below cannot record — materialize first
+            _lazy.flush_if_active()
         if trace_state() is None:
             # eager step after an external weight load: reconcile masters
             self._refresh_derived_state()
+        self._step_impl()
+
+    def _step_impl(self) -> None:
+        """The update math proper (pure jnp over the state payloads; also
+        traced by the recorded optimizer-step segment)."""
         self._step_t._set_data(self._step_t._data + 1)
         base_lr = self._lr_value()
         for group in self._groups:
@@ -357,6 +376,150 @@ class Optimizer:
                     if hasattr(p, "optimize_attr") else group_lr
                 self._update_param(p, g, lr_eff)
         self._group_wd = None
+
+    # --- staged update for the lazy segment executor -------------------------
+    def _lazy_step_tensors(self) -> List[Tensor]:
+        """Every state tensor the update math READS or WRITES, in a fixed
+        order. All of them ride the recorded segment as explicit inputs (and
+        outputs) — a state tensor missing from this list would be baked into
+        the compiled segment as a trace-time constant and silently go stale
+        on replay."""
+        from ..core.random import default_generator
+        out = [self._step_t, default_generator._key]
+        if self._lr_t is not None:
+            out.append(self._lr_t)
+        params = self._param_groups
+        out.extend(params)
+        fs = getattr(self, "_fused", None)
+        if fs is not None and getattr(self, "_use_multi_tensor", False):
+            out += [fs["m"], fs["v"], fs["master"]]
+            for k in ("wd_mask", "lr_scale"):
+                if fs[k] is not None:
+                    out.append(fs[k])
+            for key in sorted(fs["live_cache"]):
+                out.append(fs["live_cache"][key])
+        else:
+            for name in sorted(self._accumulators):
+                store = self._accumulators[name]
+                for p in params:
+                    t = store.get(id(p))
+                    if t is not None:
+                        out.append(t)
+            for p in params:
+                m = self._master_weights.get(id(p))
+                if m is not None:
+                    out.append(m)
+        return out
+
+    def _lazy_step_sig(self):
+        """Hashable signature covering every Python-level constant the traced
+        update bakes in: two steps with equal signatures (and equal input
+        avals) may legally share one compiled segment."""
+        def _reg_sig(p):
+            r = getattr(p, "regularizer", None)
+            return None if r is None else (float(r.coeff),
+                                           bool(getattr(r, "_l2", True)))
+        groups_sig = tuple(
+            (float(g.get("learning_rate", 1.0)), repr(g.get("weight_decay")),
+             repr(g.get("grad_clip")), len(g["params"]))
+            for g in self._groups)
+        params_sig = tuple(
+            (p.grad is not None, bool(getattr(p, "trainable", True)),
+             bool(getattr(p, "need_clip", True)),
+             float(p.optimize_attr.get("learning_rate", 1.0))
+             if hasattr(p, "optimize_attr") else 1.0,
+             _reg_sig(p))
+            for p in self._param_groups)
+        return ("optimizer_step", self._opt_uid,
+                None if self._lr_t is not None else float(self._learning_rate),
+                repr(self._weight_decay), repr(self._grad_clip),
+                bool(self._stochastic_rounding), groups_sig, params_sig)
+
+    def _try_record_step(self) -> bool:
+        """Segment mode: record the whole optimizer update as one meta-op.
+
+        The recorded fn temporarily binds the traced values into the live
+        state tensors, re-runs ``_step_impl`` (plain jnp math traces fine),
+        and returns each state tensor's new payload; the segment executor
+        compiles it into the current segment and rebinds the real arrays on
+        flush. Returns False for configurations the staged path cannot
+        express (sparse SelectedRows grads, subclass custom ``step``)."""
+        from ..core import lazy as _lazy
+        from ..core.selected_rows import SelectedRowsTensor
+        if self._groups is None or type(self).step is not Optimizer.step:
+            return False
+        params = self._param_groups
+        if not params:
+            return False
+        for p in params:
+            if isinstance(p.grad, SelectedRowsTensor):
+                return False  # row-sparse update path stays eager
+        self._refresh_derived_state()
+        fs = getattr(self, "_fused", None)
+        if fs is not None and getattr(self, "_use_multi_tensor", False):
+            # pre-build the liveness mask OUTSIDE the trace (built inside it
+            # would register a model-sized constant as fresh state mid-trace)
+            live = tuple(p.grad is not None and p.trainable
+                         for p in fs["params"])
+            if not all(live):
+                self._fused_live_mask(live)
+        else:
+            # per-param accumulators/masters must pre-exist: created inside
+            # the trace they would capture tracers as persistent state
+            Optimizer._materialize_state(self)
+        state = self._lazy_step_tensors()
+        # snapshot the grad TENSORS, not just their payloads: the replay
+        # trace runs at flush time, which can be after clear_grad() — the fn
+        # must see the record-time grad structure, not a later-cleared one
+        grad_pairs = [(p, p.grad) for p in params
+                      if p.grad is not None and p.trainable]
+        grads = [g for _, g in grad_pairs]
+        tensors = state + grads
+        arrays = [t._data for t in tensors]
+
+        def optimizer_step_fn(*flat):
+            # called by the segment trace (eval_shape at record, replay at
+            # flush): binds the traced values into the live tensors, re-runs
+            # the update math, and restores the real payloads no matter what
+            saved = [t._data for t in tensors]
+            saved_grads = [p._grad for p, _ in grad_pairs]
+            try:
+                for t, v in zip(tensors, flat):
+                    t._data = v
+                for p, g in grad_pairs:
+                    p._grad = g
+                with no_grad(), _lazy.suspended():
+                    self._step_impl()
+                return tuple(t._data for t in state)
+            finally:
+                for t, s in zip(tensors, saved):
+                    t._data = s
+                for (p, _), g0 in zip(grad_pairs, saved_grads):
+                    p._grad = g0
+
+        try:
+            outs, _ = _lazy.record("optimizer_step", optimizer_step_fn,
+                                   arrays, fn_sig=self._lazy_step_sig())
+        except Exception as e:
+            # unstageable update math: take the eager path — but say so
+            # once, because the silent cost is ~8x step throughput
+            if not getattr(self, "_warned_unstaged", False):
+                self._warned_unstaged = True
+                import warnings
+                warnings.warn(
+                    f"optimizer update could not be staged as a compiled "
+                    f"segment ({type(e).__name__}: {e}); falling back to "
+                    f"the eager per-op update for this optimizer")
+            return False
+        for t, lv in zip(state, outs):
+            t._set_data(lv)
+        # the writes above bump versions; re-sync so the derived-state
+        # refresh doesn't mistake them for external loads
+        for p in params:
+            self._note_param_written(p)
+        if fs is not None and getattr(self, "_use_multi_tensor", False):
+            self._fused_sync_versions()
+        return True
 
     def _update_param(self, p: Tensor, g, lr_eff: float) -> None:
         raise NotImplementedError
@@ -843,15 +1006,9 @@ class Adam(Optimizer):
                             .astype(p._data.dtype))
         self._fused_sync_versions()
 
-    @no_grad()
-    def step(self) -> None:
-        from ..core import lazy as _lazy
-        from ..core.tracing import trace_state
-        _lazy.flush_if_active()
-        if trace_state() is None:
-            self._refresh_derived_state()
+    def _step_impl(self) -> None:
         if not self._use_multi_tensor or self._fused is None:
-            super().step()
+            super()._step_impl()
             return
         self._step_t._set_data(self._step_t._data + 1)
         self._fused_step()
