@@ -57,9 +57,9 @@ class Generator:
 default_generator = Generator(0)
 
 
-def seed(seed_val: int) -> Generator:
-    """``paddle.seed`` parity."""
-    default_generator.manual_seed(int(seed_val))
+def seed(seed: int) -> Generator:
+    """``paddle.seed`` parity (upstream names the arg ``seed``)."""
+    default_generator.manual_seed(int(seed))
     return default_generator
 
 

@@ -594,9 +594,9 @@ __all__ += ["TransformedDistribution", "Transform", "AffineTransform",
 
 
 from .extra import (  # noqa: E402,F401
-    Binomial, Cauchy, ExponentialFamily, Gamma, Independent,
+    Binomial, Cauchy, ExponentialFamily, Gamma, Independent, LKJCholesky,
     MultivariateNormal, Poisson, StudentT,
 )
 
 __all__ += ["ExponentialFamily", "Gamma", "Poisson", "Binomial", "Cauchy",
-            "StudentT", "MultivariateNormal", "Independent"]
+            "StudentT", "MultivariateNormal", "Independent", "LKJCholesky"]

@@ -17,7 +17,7 @@ from ..ops._helpers import ensure_tensor
 from . import Distribution, register_kl
 
 __all__ = ["ExponentialFamily", "Gamma", "Poisson", "Binomial", "Cauchy",
-           "StudentT", "MultivariateNormal", "Independent"]
+           "StudentT", "MultivariateNormal", "Independent", "LKJCholesky"]
 
 
 class ExponentialFamily(Distribution):
@@ -290,6 +290,106 @@ class Independent(Distribution):
         return apply("independent_entropy",
                      lambda a: jnp.sum(a, axis=tuple(range(-self.rank, 0))),
                      ent)
+
+
+class LKJCholesky(Distribution):
+    """LKJ distribution over Cholesky factors of correlation matrices
+    (parity: paddle.distribution.LKJCholesky — upstream
+    python/paddle/distribution/lkj_cholesky.py; the torch/numpyro LKJ).
+
+    ``concentration`` > 0 is the shape: 1.0 is uniform over correlation
+    matrices; > 1 concentrates near identity. Sampling supports both
+    upstream methods — 'onion' (Lewandowski et al. alg. 3.2: per-row Beta
+    radii × uniform hypersphere directions) and 'cvine' (partial
+    correlations through signed stick-breaking)."""
+
+    def __init__(self, dim=2, concentration=1.0, sample_method="onion"):
+        if dim < 2:
+            raise ValueError("LKJCholesky requires dim >= 2")
+        if sample_method not in ("onion", "cvine"):
+            raise ValueError(f"unknown sample_method {sample_method!r}")
+        self.dim = int(dim)
+        self.concentration = ensure_tensor(concentration)
+        self.sample_method = sample_method
+        super().__init__(tuple(self.concentration._data.shape),
+                         (self.dim, self.dim))
+
+    def _vec_to_tril(self, vec, strict_dim):
+        """Pack (..., k*(k+1)/2) into the lower triangle (incl. diagonal) of
+        a (..., k, k) matrix, k = strict_dim."""
+        k = strict_dim
+        out = jnp.zeros(vec.shape[:-1] + (k, k), vec.dtype)
+        r, c = jnp.tril_indices(k)
+        return out.at[..., r, c].set(vec)
+
+    def sample(self, shape=()):
+        key = self._key()
+        shape = tuple(shape)
+        d = self.dim
+        dm1 = d - 1
+        conc = self.concentration._data.astype(jnp.float32)
+        batch = conc.shape
+        marginal = conc[..., None] + 0.5 * (d - 2)  # (*batch, 1)
+
+        def onion(k):
+            k_b, k_n = jax.random.split(k)
+            offset = 0.5 * jnp.arange(dm1)
+            a = offset + 0.5                      # (dm1,)
+            b = marginal - offset                 # (*batch, dm1)
+            y = jax.random.beta(k_b, jnp.broadcast_to(a, shape + batch + (dm1,)),
+                                jnp.broadcast_to(b, shape + batch + (dm1,)))
+            nrm = jax.random.normal(k_n, shape + batch + (d * dm1 // 2,))
+            tril = self._vec_to_tril(nrm, dm1)    # rows i: i+1 live entries
+            u = tril / jnp.linalg.norm(tril, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u        # (..., dm1, dm1)
+            chol = jnp.zeros(shape + batch + (d, d))
+            chol = chol.at[..., 1:, :-1].set(w)
+            diag = jnp.ones(shape + batch + (d,)).at[..., 1:].set(
+                jnp.sqrt(1.0 - y))
+            return chol + diag[..., None] * jnp.eye(d)
+
+        def cvine(k):
+            offs_tril = jnp.concatenate(
+                [jnp.full((i + 1,), 0.5 * i) for i in range(dm1)])
+            bconc = marginal[..., :1] - offs_tril  # (*batch, d*(d-1)/2)
+            bconc = jnp.broadcast_to(bconc, shape + batch + (d * dm1 // 2,))
+            beta = jax.random.beta(k, bconc, bconc)
+            pc = self._vec_to_tril(2.0 * beta - 1.0, dm1)  # partial corr
+            eps = jnp.finfo(pc.dtype).eps
+            r = jnp.clip(pc, -1 + eps, 1 - eps)
+            z = r * r
+            cumprod = jnp.sqrt(jnp.cumprod(1.0 - z, axis=-1))
+            shifted = jnp.concatenate(
+                [jnp.ones(cumprod.shape[:-1] + (1,)), cumprod[..., :-1]],
+                axis=-1)
+            w = r * shifted                        # strict-lower rows
+            chol = jnp.zeros(shape + batch + (d, d))
+            chol = chol.at[..., 1:, :-1].set(w)
+            # each row's diagonal completes the unit norm
+            diag = jnp.sqrt(jnp.clip(
+                1.0 - jnp.sum(chol * chol, axis=-1), eps, None))
+            return chol + diag[..., None] * jnp.eye(d)
+
+        fn = onion if self.sample_method == "onion" else cvine
+        return Tensor(jax.lax.stop_gradient(fn(key)), stop_gradient=True)
+
+    def log_prob(self, value):
+        value = ensure_tensor(value)
+        d = self.dim
+        dm1 = d - 1
+
+        def f(L, conc):
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            order = 2.0 * (conc[..., None] - 1.0) + d - jnp.arange(2, d + 1)
+            unnorm = jnp.sum(order * jnp.log(diag), axis=-1)
+            alpha = conc + 0.5 * dm1
+            # multivariate-gamma normalizer (torch/upstream constant layout)
+            numer = jax.scipy.special.multigammaln(alpha - 0.5, dm1)
+            denom = jax.scipy.special.gammaln(alpha) * dm1
+            pi_const = 0.5 * dm1 * jnp.log(jnp.pi)
+            return unnorm - (pi_const + numer - denom)
+
+        return apply("lkj_log_prob", f, value, self.concentration)
 
 
 @register_kl(Gamma, Gamma)

@@ -13,31 +13,47 @@ __all__ = [
 
 
 class _Pool(Layer):
+    """Shared storage; subclass __init__s carry the upstream-exact positional
+    signatures (python/paddle/nn/layer/pooling.py — note upstream's own
+    inconsistency: MaxPool* puts return_mask before ceil_mode, AvgPool1D puts
+    exclusive before ceil_mode, AvgPool2D/3D put ceil_mode first)."""
+
     _DEFAULT_FORMAT = "NCHW"
 
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
-                 data_format=None, **kw):
-        super().__init__()
+    def _store(self, kernel_size, stride, padding, ceil_mode, data_format):
         self.kernel_size = kernel_size
         self.stride = stride
         self.padding = padding
         self.ceil_mode = ceil_mode
         self.data_format = data_format or self._DEFAULT_FORMAT
-        self.kw = kw
 
 
 class MaxPool1D(_Pool):
     _DEFAULT_FORMAT = "NCL"
 
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format=None, name=None):
+        super().__init__()
+        self._store(kernel_size, stride, padding, ceil_mode, data_format)
+        self.return_mask = return_mask
+
     def forward(self, x):
         return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
                             ceil_mode=self.ceil_mode,
                             data_format=self.data_format)
 
 
 class MaxPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format=None, name=None):
+        super().__init__()
+        self._store(kernel_size, stride, padding, ceil_mode, data_format)
+        self.return_mask = return_mask
+
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
                             ceil_mode=self.ceil_mode,
                             data_format=self.data_format)
 
@@ -45,8 +61,15 @@ class MaxPool2D(_Pool):
 class MaxPool3D(_Pool):
     _DEFAULT_FORMAT = "NCDHW"
 
+    def __init__(self, kernel_size, stride=None, padding=0, return_mask=False,
+                 ceil_mode=False, data_format=None, name=None):
+        super().__init__()
+        self._store(kernel_size, stride, padding, ceil_mode, data_format)
+        self.return_mask = return_mask
+
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            return_mask=self.return_mask,
                             ceil_mode=self.ceil_mode,
                             data_format=self.data_format)
 
@@ -54,25 +77,52 @@ class MaxPool3D(_Pool):
 class AvgPool1D(_Pool):
     _DEFAULT_FORMAT = "NCL"
 
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, data_format=None, name=None):
+        super().__init__()
+        self._store(kernel_size, stride, padding, ceil_mode, data_format)
+        self.exclusive = exclusive
+
     def forward(self, x):
         return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive,
                             ceil_mode=self.ceil_mode,
                             data_format=self.data_format)
 
 
 class AvgPool2D(_Pool):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format=None,
+                 name=None):
+        super().__init__()
+        self._store(kernel_size, stride, padding, ceil_mode, data_format)
+        self.exclusive = exclusive
+        self.divisor_override = divisor_override
+
     def forward(self, x):
         return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
                             ceil_mode=self.ceil_mode,
+                            exclusive=self.exclusive,
+                            divisor_override=self.divisor_override,
                             data_format=self.data_format)
 
 
 class AvgPool3D(_Pool):
     _DEFAULT_FORMAT = "NCDHW"
 
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format=None,
+                 name=None):
+        super().__init__()
+        self._store(kernel_size, stride, padding, ceil_mode, data_format)
+        self.exclusive = exclusive
+        self.divisor_override = divisor_override
+
     def forward(self, x):
         return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
                             ceil_mode=self.ceil_mode,
+                            exclusive=self.exclusive,
+                            divisor_override=self.divisor_override,
                             data_format=self.data_format)
 
 

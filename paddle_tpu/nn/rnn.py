@@ -394,9 +394,17 @@ def _stack0(ts) -> Tensor:
 
 
 class SimpleRNN(_RNNBase):
-    """Parity: paddle.nn.SimpleRNN."""
+    """Parity: paddle.nn.SimpleRNN (upstream puts ``activation`` FOURTH,
+    before direction — unlike LSTM/GRU which have no activation arg)."""
     MODE = "RNN_TANH"
     N_GATES = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 activation="tanh", direction="forward", time_major=False,
+                 dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation)
 
 
 class LSTM(_RNNBase):

@@ -59,8 +59,15 @@ def make_binary(name: str, jfn: Callable, methods=(), differentiable: bool = Tru
     return register_op(name, op, methods=methods or (name,), inplace_method=inplace)
 
 
-def make_reduction(name: str, jfn: Callable, methods=(), bool_out: bool = False):
-    def op(x, axis=None, keepdim=False, dtype=None, name=None):
+def make_reduction(name: str, jfn: Callable, methods=(), bool_out: bool = False,
+                   dtype_pos: Optional[str] = None):
+    """Reduction op factory. ``dtype_pos`` pins the upstream positional slot
+    of the optional ``dtype`` parameter — upstream is inconsistent about it
+    (sum/nansum: dtype BEFORE keepdim; prod: dtype AFTER keepdim; mean and
+    the extremum/bool reductions: no dtype at all), and positional callers
+    migrating from upstream depend on the exact order."""
+
+    def _run(x, axis, keepdim, dtype):
         x = ensure_tensor(x)
         if isinstance(axis, (list, tuple)):
             axis = tuple(int(a) for a in axis)
@@ -73,6 +80,17 @@ def make_reduction(name: str, jfn: Callable, methods=(), bool_out: bool = False)
                 r = r.astype(jnp.dtype(dtype))
             return r
 
-        return apply(op.__name__, f, x, differentiable=not bool_out)
+        f.__name__ = name
+        return apply(name, f, x, differentiable=not bool_out)
+
+    if dtype_pos == "after_axis":
+        def op(x, axis=None, dtype=None, keepdim=False, name=None):
+            return _run(x, axis, keepdim, dtype)
+    elif dtype_pos == "last":
+        def op(x, axis=None, keepdim=False, dtype=None, name=None):
+            return _run(x, axis, keepdim, dtype)
+    else:
+        def op(x, axis=None, keepdim=False, name=None):
+            return _run(x, axis, keepdim, None)
     op.__name__ = name
     return register_op(name, op, methods=methods or (name,))

@@ -21,7 +21,15 @@ softsign = make_unary("softsign", jax.nn.soft_sign)
 tanhshrink = make_unary("tanhshrink", lambda x: x - jnp.tanh(x))
 mish = make_unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
 hardswish = make_unary("hardswish", jax.nn.hard_swish)
-hardsigmoid = make_unary("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    """Upstream contract: max(0, min(1, slope * x + offset)) — the default
+    slope/offset (1/6, 0.5) matches the fixed formula this op used before."""
+    return apply("hardsigmoid",
+                 lambda a: jnp.clip(slope * a + offset, 0.0, 1.0),
+                 ensure_tensor(x))
+
+
+register_op("hardsigmoid", hardsigmoid)
 log_sigmoid = make_unary("log_sigmoid", jax.nn.log_sigmoid)
 
 

@@ -135,7 +135,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 
 
 def _pool(x, op_name, kernel_size, stride, padding, spatial, reducer, init,
-          ceil_mode=False, data_format="NCHW", exclusive=True):
+          ceil_mode=False, data_format="NCHW", exclusive=True,
+          divisor_override=None):
     x = ensure_tensor(x)
     k = _pair(kernel_size, spatial)
     s = _pair(stride if stride is not None else kernel_size, spatial)
@@ -182,6 +183,10 @@ def _pool(x, op_name, kernel_size, stride, padding, spatial, reducer, init,
             return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window, strides,
                                          pad_cfg)
         summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pad_cfg)
+        if divisor_override is not None:
+            # fixed user divisor replaces every counting rule (upstream
+            # avg_pool2d/3d divisor_override)
+            return summed / float(divisor_override)
 
         def real_counts():
             return jax.lax.reduce_window(jnp.ones_like(a), 0.0, jax.lax.add,
@@ -261,13 +266,15 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW", name=None):
     return _pool(x, "avg_pool2d", kernel_size, stride, padding, 2, "avg", 0.0,
-                 ceil_mode, data_format, exclusive=exclusive)
+                 ceil_mode, data_format, exclusive=exclusive,
+                 divisor_override=divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
     return _pool(x, "avg_pool3d", kernel_size, stride, padding, 3, "avg", 0.0,
-                 ceil_mode, data_format, exclusive=exclusive)
+                 ceil_mode, data_format, exclusive=exclusive,
+                 divisor_override=divisor_override)
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
@@ -434,9 +441,12 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
 for _n in ("conv1d", "conv2d", "conv3d", "conv2d_transpose", "max_pool1d",
            "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
            "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
-           "interpolate", "upsample", "pixel_shuffle", "unfold",
+           "interpolate", "upsample", "pixel_shuffle",
            "pixel_unshuffle", "channel_shuffle", "fold"):
     register_op(_n, globals()[_n])
+# NOTE: this module's ``unfold`` (im2col) is nn.functional.unfold only;
+# top-level paddle.unfold is the sliding-window Tensor op (math_ext.py) —
+# they are DIFFERENT upstream APIs sharing a name.
 
 
 def _adaptive_pool_exact(op_name, x, out_sizes, mode):

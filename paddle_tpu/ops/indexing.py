@@ -28,10 +28,12 @@ def _reject_dynamic(op_name, *tensors):
             "masked/padded formulation")
 
 
-def gather(x, index, axis=0, name=None):
+def gather(x, index, axis=None, name=None):
     x, index = ensure_tensor(x), ensure_tensor(index)
     if isinstance(axis, Tensor):
         axis = int(axis._data)
+    if axis is None:  # upstream default: gather along axis 0
+        axis = 0
     return apply("gather", lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis), x, index)
 
 
@@ -302,10 +304,12 @@ register_op("sort", sort, methods=("sort",))
 register_op("argsort", argsort, methods=("argsort",))
 
 
-def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
     x = ensure_tensor(x)
     if isinstance(k, Tensor):
         k = int(k._data)
+    if axis is None:  # upstream default: last axis
+        axis = -1
 
     def f(a):
         moved = jnp.moveaxis(a, axis, -1)

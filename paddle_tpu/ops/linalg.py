@@ -35,7 +35,15 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 
 
 register_op("matmul", matmul, methods=("matmul", "mm", "__matmul__"))
-register_op("mm", matmul)
+
+
+def mm(input, mat2, name=None):
+    """Upstream ``paddle.mm(input, mat2)`` — plain matmul, upstream arg
+    names (a migrating ``mm(input=a, mat2=b)`` call must bind)."""
+    return matmul(input, mat2)
+
+
+register_op("mm", mm)
 
 
 def _rmatmul(self, other):
@@ -278,15 +286,35 @@ def cross(x, y, axis=9, name=None):
 
 
 def householder_product(x, tau, name=None):
+    """``paddle.linalg.householder_product`` parity: x (*, m, n) holds the
+    reflector vectors below the diagonal, tau (*, k) the scaling factors
+    (k <= n); returns the FIRST n COLUMNS of Q = H_1 H_2 ... H_k, shape
+    (*, m, n) — upstream python/paddle/tensor/linalg.py householder_product
+    (the LAPACK orgqr contract), including batched inputs and complex
+    v v^H reflectors. The k reflections unroll as a static Python loop
+    (k is a compile-time shape; XLA fuses the chain)."""
     x, tau = ensure_tensor(x), ensure_tensor(tau)
 
-    def f(a, t):
-        m, n = a.shape[-2], a.shape[-1]
+    def core(a, t):
+        m, n = a.shape
+        k = t.shape[0]
+        rows = jnp.arange(m)
         q = jnp.eye(m, dtype=a.dtype)
-        for i in range(n):
-            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1:, i]])
-            q = q - t[i] * (q @ v[:, None]) @ v[None, :]
-        return q
+        for i in range(k):
+            # v_i = [0]*i + [1] + a[i+1:, i]
+            v = jnp.where(rows > i, a[:, i], jnp.zeros((), a.dtype))
+            v = v.at[i].set(1)
+            q = q - t[i] * (q @ v[:, None]) @ jnp.conj(v)[None, :]
+        return q[:, :n]
+
+    def f(a, t):
+        batch = a.shape[:-2]
+        if not batch:
+            return core(a, t)
+        fa = a.reshape((-1,) + a.shape[-2:])
+        ft = t.reshape((-1, t.shape[-1]))
+        out = jax.vmap(core)(fa, ft)
+        return out.reshape(batch + out.shape[-2:])
 
     return apply("householder_product", f, x, tau)
 

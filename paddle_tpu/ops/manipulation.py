@@ -152,8 +152,8 @@ def chunk(x, chunks, axis=0, name=None):
 register_op("chunk", chunk, methods=("chunk",))
 
 
-def unbind(x, axis=0, name=None):
-    x = ensure_tensor(x)
+def unbind(input, axis=0, name=None):
+    x = ensure_tensor(input)
     n = x._data.shape[axis]
 
     def f(a):

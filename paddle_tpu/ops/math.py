@@ -54,7 +54,11 @@ sqrt = make_unary("sqrt", jnp.sqrt, inplace="sqrt_")
 square = make_unary("square", jnp.square)
 tan = make_unary("tan", jnp.tan)
 tanh = make_unary("tanh", jnp.tanh, inplace="tanh_")
-trunc = make_unary("trunc", jnp.trunc)
+def trunc(input, name=None):  # upstream names the arg ``input``
+    return apply("trunc", jnp.trunc, ensure_tensor(input))
+
+
+register_op("trunc", trunc, methods=("trunc",))
 angle = make_unary("angle", jnp.angle)
 conj = make_unary("conj", jnp.conj)
 real = make_unary("real", jnp.real)

@@ -287,6 +287,10 @@ register_op("top_p_sampling", top_p_sampling)
 register_tensor_method("fill_diagonal_", fill_diagonal_)
 register_tensor_method("fill_diagonal_tensor_", fill_diagonal_tensor_)
 register_tensor_method("unfold", tensor_unfold)
+# top-level paddle.unfold IS the sliding-window op (upstream
+# python/paddle/tensor/manipulation.py unfold), NOT nn.functional.unfold's
+# im2col — two different upstream APIs share the bare name
+register_op("unfold", tensor_unfold)
 register_tensor_method("contiguous", lambda self: self)
 register_tensor_method("is_contiguous", lambda self: True)
 

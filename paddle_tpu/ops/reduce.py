@@ -13,12 +13,12 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply
 from ._helpers import ensure_tensor, make_reduction, register_op
 
-sum = make_reduction("sum", jnp.sum)
+sum = make_reduction("sum", jnp.sum, dtype_pos="after_axis")
 mean = make_reduction("mean", jnp.mean)
-prod = make_reduction("prod", jnp.prod)
+prod = make_reduction("prod", jnp.prod, dtype_pos="last")
 amax = make_reduction("amax", jnp.max)
 amin = make_reduction("amin", jnp.min)
-nansum = make_reduction("nansum", jnp.nansum)
+nansum = make_reduction("nansum", jnp.nansum, dtype_pos="after_axis")
 nanmean = make_reduction("nanmean", jnp.nanmean)
 all = make_reduction("all", jnp.all, bool_out=True)
 any = make_reduction("any", jnp.any, bool_out=True)
@@ -187,13 +187,14 @@ register_op("cummax", cummax, methods=("cummax",))
 register_op("cummin", cummin, methods=("cummin",))
 
 
-def logcumsumexp(x, axis=None, name=None):
+def logcumsumexp(x, axis=None, dtype=None, name=None):
     x = ensure_tensor(x)
 
     def f(a):
         arr = a.reshape(-1) if axis is None else a
         ax = 0 if axis is None else axis
-        return jax.lax.cumlogsumexp(arr, axis=ax)
+        r = jax.lax.cumlogsumexp(arr, axis=ax)
+        return r.astype(jnp.dtype(dtype)) if dtype is not None else r
 
     return apply("logcumsumexp", f, x)
 

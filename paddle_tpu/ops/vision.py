@@ -115,22 +115,48 @@ def bbox2distance(points, bbox, reg_max: Optional[float] = None) -> Tensor:
     return apply("bbox2distance", fn, points, bbox)
 
 
-def nms(boxes, scores, iou_threshold: float = 0.5,
-        top_k: Optional[int] = None) -> Tensor:
-    """Class-agnostic NMS. Returns kept indices padded with -1 to ``top_k``
-    (static shape); order is by descending score."""
-    k = int(top_k or boxes.shape[0])
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None) -> Tensor:
+    """``paddle.vision.ops.nms`` parity (upstream python/paddle/vision/ops.py
+    nms: positional order boxes, iou_threshold, scores, category_idxs,
+    categories, top_k).
 
-    def fn(b, s):
-        order = jnp.argsort(-s)[:k]
-        bs = b[order]
-        keep = _nms_suppress(bs, iou_threshold)
-        out = jnp.where(keep, order, -1)
-        if out.shape[0] < k:
-            out = jnp.pad(out, (0, k - out.shape[0]), constant_values=-1)
-        return out
+    * ``scores=None``: suppression in the given box order (upstream
+      "sorted by score or in the given order").
+    * ``category_idxs``/``categories``: categorical NMS — boxes of different
+      categories never suppress each other (implemented by offsetting each
+      category into a disjoint coordinate range, one fused pass; upstream
+      loops per category).
 
-    return apply("nms", fn, boxes, scores, differentiable=False)
+    Static-shape divergence (see MIGRATING.md): returns kept indices in
+    descending-score order, compacted to the front and padded with -1 to a
+    fixed length (``top_k`` if given, else the box count) instead of a
+    dynamic-length array.
+    """
+    n = boxes.shape[0]
+    k = min(int(top_k), n) if top_k is not None else n
+
+    def fn(b, *rest):
+        rest = list(rest)
+        s = rest.pop(0) if scores is not None else None
+        cidx = rest.pop(0) if category_idxs is not None else None
+        if cidx is not None:
+            # disjoint per-category windows: cross-category IoU becomes 0
+            span = 2.0 * (jnp.max(jnp.abs(b)) + 1.0)
+            b = b + cidx.astype(b.dtype)[:, None] * span
+        order = jnp.argsort(-s) if s is not None else jnp.arange(b.shape[0])
+        keep = _nms_suppress(b[order], iou_threshold)
+        kept = jnp.where(keep, order, -1)
+        # stable-compact the kept indices to the front, then cut to k
+        pos = jnp.where(keep, jnp.arange(keep.shape[0]), keep.shape[0])
+        return kept[jnp.argsort(pos)][:k]
+
+    args = [boxes]
+    if scores is not None:
+        args.append(scores)
+    if category_idxs is not None:
+        args.append(category_idxs)
+    return apply("nms", fn, *args, differentiable=False)
 
 
 def multiclass_nms(bboxes, scores, score_threshold: float = 0.05,

@@ -1,0 +1,117 @@
+"""Numeric fidelity for the round-3 probe's divergences (VERDICT r3 Weak #2):
+householder_product's (m, n) contract + batching, LKJCholesky, and the
+silent-ignore pool args. References computed with torch (cpu) where the
+upstream kernel contract is LAPACK-defined.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+
+class TestHouseholderProduct:
+    def _case(self, shape):
+        rng = np.random.default_rng(0)
+        a = rng.normal(0, 1, shape).astype(np.float32)
+        tau_shape = shape[:-2] + (shape[-1],)
+        tau = rng.uniform(0.1, 1.0, tau_shape).astype(np.float32)
+        ref = torch.linalg.householder_product(
+            torch.from_numpy(a), torch.from_numpy(tau)).numpy()
+        got = paddle.linalg.householder_product(
+            paddle.to_tensor(a), paddle.to_tensor(tau)).numpy()
+        assert got.shape == ref.shape, \
+            f"shape {got.shape} != upstream {ref.shape}"
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_tall_returns_m_by_n(self):
+        # upstream returns the FIRST n COLUMNS (m, n), not the full (m, m) Q
+        self._case((6, 3))
+
+    def test_square(self):
+        self._case((4, 4))
+
+    def test_batched(self):
+        # round-3 bug: a[i+1:, i] indexed the batch axis for 3-D input
+        self._case((5, 6, 3))
+
+    def test_qr_roundtrip(self):
+        # orgqr contract: householder_product(geqrf(A)) reconstructs Q of A
+        rng = np.random.default_rng(1)
+        a = rng.normal(0, 1, (8, 4)).astype(np.float32)
+        h, tau = torch.geqrf(torch.from_numpy(a))
+        q = paddle.linalg.householder_product(
+            paddle.to_tensor(h.numpy()), paddle.to_tensor(tau.numpy())).numpy()
+        # Q columns orthonormal and span == qr(a).Q
+        np.testing.assert_allclose(q.T @ q, np.eye(4), atol=1e-5)
+        qr_q = np.linalg.qr(a)[0]
+        np.testing.assert_allclose(np.abs(q.T @ qr_q), np.eye(4), atol=1e-4)
+
+
+class TestLKJCholesky:
+    @pytest.mark.parametrize("dim,conc", [(2, 1.0), (3, 0.7), (5, 2.0)])
+    def test_log_prob_matches_torch(self, dim, conc):
+        ref = torch.distributions.LKJCholesky(dim, conc)
+        L = ref.sample((20,))
+        ours = paddle.distribution.LKJCholesky(dim, conc)
+        np.testing.assert_allclose(
+            ours.log_prob(paddle.to_tensor(L.numpy())).numpy(),
+            ref.log_prob(L).numpy(), rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("method", ["onion", "cvine"])
+    def test_samples_are_valid_cholesky_factors(self, method):
+        paddle.seed(7)
+        d = 4
+        s = paddle.distribution.LKJCholesky(d, 1.5, method).sample((100,))
+        s = s.numpy()
+        assert s.shape == (100, d, d)
+        assert np.allclose(np.triu(s, 1), 0)
+        assert (np.diagonal(s, axis1=-2, axis2=-1) > 0).all()
+        corr = s @ np.swapaxes(s, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+
+    def test_concentration_shapes_density(self):
+        # higher concentration concentrates correlations near zero
+        paddle.seed(8)
+        lo = paddle.distribution.LKJCholesky(3, 1.0).sample((800,)).numpy()
+        hi = paddle.distribution.LKJCholesky(3, 8.0).sample((800,)).numpy()
+        r_lo = (lo @ np.swapaxes(lo, -1, -2))[:, 0, 1]
+        r_hi = (hi @ np.swapaxes(hi, -1, -2))[:, 0, 1]
+        assert np.abs(r_hi).mean() < np.abs(r_lo).mean()
+
+
+class TestPoolArgFidelity:
+    def test_avgpool_exclusive_actually_forwards(self):
+        # round-4 fix: AvgPool2D(**kw) used to swallow `exclusive` silently
+        x = np.ones((1, 1, 4, 4), np.float32)
+        inc = paddle.nn.AvgPool2D(3, stride=1, padding=1, exclusive=False)
+        exc = paddle.nn.AvgPool2D(3, stride=1, padding=1, exclusive=True)
+        out_inc = inc(paddle.to_tensor(x)).numpy()
+        out_exc = exc(paddle.to_tensor(x)).numpy()
+        # corner: 4 real elements / 9 (inclusive) vs / 4 (exclusive)
+        assert abs(out_inc[0, 0, 0, 0] - 4 / 9) < 1e-6
+        assert abs(out_exc[0, 0, 0, 0] - 1.0) < 1e-6
+
+    def test_avgpool_divisor_override(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        pool = paddle.nn.AvgPool2D(2, stride=2, divisor_override=2)
+        out = pool(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, 2.0)  # sum 4 / divisor 2
+
+    def test_maxpool_return_mask_forwards(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        pool = paddle.nn.MaxPool2D(2, stride=2, return_mask=True)
+        out, mask = pool(paddle.to_tensor(x))
+        assert out.shape == [1, 1, 2, 2] and mask.shape == [1, 1, 2, 2]
+        np.testing.assert_allclose(out.numpy().ravel(), [5, 7, 13, 15])
+
+
+class TestTensorUnfoldTopLevel:
+    def test_sliding_window_semantics(self):
+        # paddle.unfold is the Tensor sliding-window op, NOT im2col
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        out = paddle.unfold(x, 0, 3, 2).numpy()
+        ref = torch.arange(8, dtype=torch.float32).unfold(0, 3, 2).numpy()
+        np.testing.assert_allclose(out, ref)

@@ -55,10 +55,12 @@ def test_nms_suppresses_overlaps():
         [0, 0, 10, 10],                    # duplicate of #0
     ], np.float32)
     scores = np.array([0.9, 0.8, 0.7, 0.6], np.float32)
-    keep = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
-                 iou_threshold=0.5).numpy()
+    keep = V.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                 scores=paddle.to_tensor(scores)).numpy()
     kept = [i for i in keep if i >= 0]
     assert kept == [0, 2]
+    # kept indices are compacted to the front (upstream ordering contract)
+    assert list(keep[:2]) == [0, 2] and all(i == -1 for i in keep[2:])
 
 
 def test_multiclass_nms_static_output():
@@ -86,9 +88,36 @@ def test_multiclass_nms_static_output():
 def test_nms_accepts_nonpositive_scores():
     boxes = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], np.float32)
     scores = np.array([-0.2, -1.3], np.float32)  # raw logits
-    keep = V.nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
-                 iou_threshold=0.5).numpy()
+    keep = V.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                 scores=paddle.to_tensor(scores)).numpy()
     assert sorted(i for i in keep if i >= 0) == [0, 1]
+
+
+def test_nms_upstream_signature_and_variants():
+    """Upstream positional contract: nms(boxes, iou_threshold, scores,
+    category_idxs, categories, top_k) — a migrating call like
+    ``nms(boxes, 0.5)`` must bind 0.5 as the IoU threshold."""
+    boxes = np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11],    # overlap pair
+        [50, 50, 60, 60], [51, 51, 61, 61],  # overlap pair
+    ], np.float32)
+    # no scores: suppression in the GIVEN order
+    keep = V.nms(paddle.to_tensor(boxes), 0.5).numpy()
+    assert [i for i in keep if i >= 0] == [0, 2]
+    # categorical: same-box different-category must NOT suppress
+    cats = np.array([0, 1, 0, 1], np.int32)
+    keep = V.nms(paddle.to_tensor(boxes), 0.5,
+                 scores=paddle.to_tensor(
+                     np.array([0.9, 0.8, 0.7, 0.6], np.float32)),
+                 category_idxs=paddle.to_tensor(cats),
+                 categories=[0, 1]).numpy()
+    assert sorted(i for i in keep if i >= 0) == [0, 1, 2, 3]
+    # top_k truncates the kept list (static shape k)
+    keep = V.nms(paddle.to_tensor(boxes), 0.5,
+                 scores=paddle.to_tensor(
+                     np.array([0.9, 0.8, 0.7, 0.6], np.float32)),
+                 top_k=1)
+    assert keep.shape == [1] and int(keep.numpy()[0]) == 0
 
 
 def test_multiclass_nms_pads_to_keep_top_k():
