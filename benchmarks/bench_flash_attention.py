@@ -59,6 +59,35 @@ def main() -> None:
                 entry[name + "_tflops"] = round(flops / dt / 1e12, 1)
             except Exception as e:  # XLA OOM at long seq is expected
                 entry[name + "_ms"] = f"OOM/{type(e).__name__}"
+
+        # masked (padding via segment ids): stays on the flash kernel —
+        # round-4 item; previously masked attention fell back to XLA and
+        # OOMed at seq 8192
+        paddle.set_flags({"FLAGS_flash_impl": "pallas"})
+        segs = np.ones((args.batch, seq), np.int32)
+        segs[:, -seq // 8:] = 0  # 1/8 padding tail
+        qseg = paddle.to_tensor(np.ones((args.batch, seq), np.int32))
+        kseg = paddle.to_tensor(segs)
+
+        @paddle.jit.to_static
+        def fwd_masked(q, qs, ks):
+            return F.flash_attention(q, q, q, causal=True,
+                                     q_segment_ids=qs, kv_segment_ids=ks)
+
+        try:
+            out = fwd_masked(q, qseg, kseg)
+            np.asarray(out._data[0, 0, 0, 0])
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = fwd_masked(q, qseg, kseg)
+            np.asarray(out._data[0, 0, 0, 0])
+            dt = (time.perf_counter() - t0) / 10
+            flops = 4 * args.batch * args.heads * seq * seq * \
+                args.head_dim / 2
+            entry["masked_pallas_ms"] = round(dt * 1e3, 2)
+            entry["masked_pallas_tflops"] = round(flops / dt / 1e12, 1)
+        except Exception as e:
+            entry["masked_pallas_ms"] = f"OOM/{type(e).__name__}"
         results.append(entry)
         print(json.dumps(entry))
 

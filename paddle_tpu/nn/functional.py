@@ -32,7 +32,7 @@ from ..ops.loss_ops import (  # noqa: F401
 )
 from ..ops.manipulation import pad  # noqa: F401
 from ..ops.indexing import one_hot  # noqa: F401
-from ..ops.flash_attention import flash_attention  # noqa: F401
+from ..ops.flash_attention import flash_attention, flash_attn_unpadded  # noqa: F401
 from ..ops.nn_ext import (  # noqa: F401
     affine_grid, grid_sample, max_unpool1d, max_unpool2d, max_unpool3d,
     fractional_max_pool2d, fractional_max_pool3d, rrelu, temporal_shift,

@@ -20,6 +20,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # pltpu only resolves on TPU-enabled jaxlib (always true here)
@@ -43,16 +44,27 @@ _flags.define_flag("flash_block_k", 512, "flash attention K/V tile")
 _NEG_INF = -1e30
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                      sm_scale: float, kv_len: int, q_len: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, block_k: int, causal: bool,
+                      sm_scale: float, kv_len: int, q_len: int,
+                      with_segs: bool = False):
     """One (batch*head, q-block) program: stream K/V blocks, online softmax.
 
-    Refs: q (1, Bq, D), k/v (1, Lk, D) in VMEM; o (1, Bq, D).
+    Refs: q (1, Bq, D), k/v (1, Lk, D) in VMEM; o (1, Bq, D). With
+    ``with_segs``, two extra int32 refs qseg (1, 1, Bq) / kseg (1, 1, Lk)
+    carry segment ids: row i may attend key j only when their ids match —
+    the TPU-native form of padding masks (pad id never matches) and packed
+    sequences (per-sequence ids). Fully-masked rows emit 0 (flash
+    convention; the XLA softmax would emit uniform rows there).
 
     Causal masking is bottom-right aligned (row i attends keys
     ``k <= i + kv_len - q_len``), matching ``_xla_attention`` and the
     KV-cache decode convention — lq != lk must agree with the backward path.
     """
+    if with_segs:
+        qseg_ref, kseg_ref, o_ref = rest
+        qs = qseg_ref[0, 0].astype(jnp.int32)  # (Bq,)
+    else:
+        (o_ref,) = rest
     q = q_ref[0].astype(jnp.float32) * sm_scale  # (Bq, D)
     bq = q.shape[0]
     qi = pl.program_id(1)  # q-block index
@@ -76,6 +88,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
             k_ids = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        if with_segs:
+            ks = kseg_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(
+                jnp.int32)
+            s = jnp.where(qs[:, None] == ks[None, :], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -94,11 +110,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
-def _flash_fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+def _flash_fwd_kernel_lse(q_ref, k_ref, v_ref, *rest,
                           block_k: int, causal: bool, sm_scale: float,
-                          kv_len: int, q_len: int):
+                          kv_len: int, q_len: int, with_segs: bool = False):
     """Forward that also emits the per-row logsumexp (the flash residual the
     dedicated backward kernels consume). Same math as _flash_fwd_kernel."""
+    if with_segs:
+        qseg_ref, kseg_ref, o_ref, lse_ref = rest
+        qs = qseg_ref[0, 0].astype(jnp.int32)
+    else:
+        o_ref, lse_ref = rest
     q = q_ref[0].astype(jnp.float32) * sm_scale
     bq = q.shape[0]
     qi = pl.program_id(1)
@@ -121,6 +142,10 @@ def _flash_fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             k_ids = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        if with_segs:
+            ks = kseg_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(
+                jnp.int32)
+            s = jnp.where(qs[:, None] == ks[None, :], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -142,9 +167,15 @@ def _flash_fwd_kernel_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, causal: bool,
-                         sm_scale: float, kv_len: int, q_len: int):
+                         *rest, block_k: int, causal: bool,
+                         sm_scale: float, kv_len: int, q_len: int,
+                         with_segs: bool = False):
     """dq for one (batch*head, q-block): stream K/V, recompute p from lse."""
+    if with_segs:
+        qseg_ref, kseg_ref, dq_ref = rest
+        qs = qseg_ref[0, 0].astype(jnp.int32)
+    else:
+        (dq_ref,) = rest
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0].astype(jnp.float32)[:, None]
@@ -165,6 +196,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_ids = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        if with_segs:
+            ks = kseg_ref[0, 0, pl.dslice(kb * block_k, block_k)].astype(
+                jnp.int32)
+            s = jnp.where(qs[:, None] == ks[None, :], s, _NEG_INF)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
@@ -181,9 +216,15 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, causal: bool,
-                          sm_scale: float, kv_len: int, q_len: int):
+                          *rest, block_q: int, causal: bool,
+                          sm_scale: float, kv_len: int, q_len: int,
+                          with_segs: bool = False):
     """dk/dv for one (batch*head, k-block): stream Q/dO blocks."""
+    if with_segs:
+        qseg_ref, kseg_ref, dk_ref, dv_ref = rest
+        ks = kseg_ref[0, 0].astype(jnp.int32)  # (Bk,)
+    else:
+        dk_ref, dv_ref = rest
     k_blk = k_ref[0].astype(jnp.float32)  # (Bk, D)
     v_blk = v_ref[0].astype(jnp.float32)
     bk = k_blk.shape[0]
@@ -207,6 +248,10 @@ def _flash_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             k_ids = k_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 1)
             s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        if with_segs:
+            qs = qseg_ref[0, 0, pl.dslice(qb * block_q, block_q)].astype(
+                jnp.int32)
+            s = jnp.where(qs[:, None] == ks[None, :], s, _NEG_INF)
         p = jnp.exp(s - lse)  # (Bq, Bk)
         dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
@@ -257,9 +302,20 @@ def _pallas_tileable(lq, lk, d, bq, bk):
             and _fit_block(lk, bk) is not None and d % 8 == 0)
 
 
+def _flatten_segs(segs, b, h, length):
+    """(B, L) int32 segment ids -> (B*H, 1, L) rank-3 refs for the kernels."""
+    s = jnp.broadcast_to(segs.astype(jnp.int32)[:, None, None, :],
+                         (b, h, 1, length))
+    return s.reshape(b * h, 1, length)
+
+
 def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int,
-                  block_k: int, interpret: bool, with_lse: bool = False):
-    """q/k/v: (B, H, L, D) -> (B, H, L, D) [, lse (B, H, L) fp32]."""
+                  block_k: int, interpret: bool, with_lse: bool = False,
+                  q_segs=None, kv_segs=None):
+    """q/k/v: (B, H, L, D) -> (B, H, L, D) [, lse (B, H, L) fp32].
+
+    ``q_segs``/``kv_segs``: optional (B, L) int32 segment ids (see the
+    kernel docstring) — both or neither."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
     block_q = min(block_q, lq)
@@ -268,6 +324,7 @@ def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int,
     qf = q.reshape(b * h, lq, d)
     kf = k.reshape(b * h, lk, d)
     vf = v.reshape(b * h, lk, d)
+    with_segs = q_segs is not None
 
     grid = (b * h, lq // block_q)
     in_specs = [
@@ -275,20 +332,28 @@ def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int,
         pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
         pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
     ]
+    inputs = [qf, kf, vf]
+    if with_segs:
+        in_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, lk), lambda bh, qi: (bh, 0, 0)),
+        ]
+        inputs += [_flatten_segs(q_segs, b, h, lq),
+                   _flatten_segs(kv_segs, b, h, lk)]
     if not with_lse:
         kernel = functools.partial(_flash_fwd_kernel, block_k=block_k,
                                    causal=causal, sm_scale=sm_scale,
-                                   kv_len=lk, q_len=lq)
+                                   kv_len=lk, q_len=lq, with_segs=with_segs)
         out = pl.pallas_call(
             kernel, grid=grid, in_specs=in_specs,
             out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
             out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
             interpret=interpret,
-        )(qf, kf, vf)
+        )(*inputs)
         return out.reshape(b, h, lq, d)
     kernel = functools.partial(_flash_fwd_kernel_lse, block_k=block_k,
                                causal=causal, sm_scale=sm_scale, kv_len=lk,
-                               q_len=lq)
+                               q_len=lq, with_segs=with_segs)
     out, lse = pl.pallas_call(
         kernel, grid=grid, in_specs=in_specs,
         out_specs=[
@@ -301,12 +366,13 @@ def _pallas_flash(q, k, v, causal: bool, sm_scale: float, block_q: int,
             jax.ShapeDtypeStruct((b * h, 1, lq), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*inputs)
     return out.reshape(b, h, lq, d), lse.reshape(b, h, lq)
 
 
 def _pallas_flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
-                      block_q: int, block_k: int, interpret: bool):
+                      block_q: int, block_k: int, interpret: bool,
+                      q_segs=None, kv_segs=None):
     """Dedicated flash backward: dq then fused dk/dv, both streaming."""
     b, h, lq, d = q.shape
     lk = k.shape[2]
@@ -320,38 +386,57 @@ def _pallas_flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
     # delta = rowsum(dO * O): tiny elementwise+reduce, XLA fuses it
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1).reshape(b * h, 1, lq)
+    with_segs = q_segs is not None
+    qsf = _flatten_segs(q_segs, b, h, lq) if with_segs else None
+    ksf = _flatten_segs(kv_segs, b, h, lk) if with_segs else None
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
                                   causal=causal, sm_scale=sm_scale,
-                                  kv_len=lk, q_len=lq)
+                                  kv_len=lk, q_len=lq, with_segs=with_segs)
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+    ]
+    dq_inputs = [qf, kf, vf, dof, lsef, delta]
+    if with_segs:
+        dq_specs += [
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, lk), lambda bh, qi: (bh, 0, 0)),
+        ]
+        dq_inputs += [qsf, ksf]
     dq = pl.pallas_call(
         dq_kernel, grid=(b * h, lq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, lk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, lq, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, delta)
+    )(*dq_inputs)
 
     dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
                                    causal=causal, sm_scale=sm_scale,
-                                   kv_len=lk, q_len=lq)
+                                   kv_len=lk, q_len=lq, with_segs=with_segs)
+    dkv_specs = [
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, lq, d), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, lq, d), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, lq), lambda bh, ki: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, lq), lambda bh, ki: (bh, 0, 0)),
+    ]
+    dkv_inputs = [kf, vf, qf, dof, lsef, delta]
+    if with_segs:
+        dkv_specs += [
+            pl.BlockSpec((1, 1, lq), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, ki: (bh, 0, ki)),
+        ]
+        dkv_inputs += [qsf, ksf]
     dk, dv = pl.pallas_call(
         dkv_kernel, grid=(b * h, lk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, lq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, lq, d), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, lq), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, lq), lambda bh, ki: (bh, 0, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
@@ -361,19 +446,25 @@ def _pallas_flash_bwd(q, k, v, out, lse, g, causal: bool, sm_scale: float,
             jax.ShapeDtypeStruct((b * h, lk, d), v.dtype),
         ],
         interpret=interpret,
-    )(kf, vf, qf, dof, lsef, delta)
+    )(*dkv_inputs)
     return (dq.reshape(b, h, lq, d), dk.reshape(b, h, lk, d),
             dv.reshape(b, h, lk, d))
 
 
-def _xla_attention(q, k, v, causal: bool, sm_scale: float):
+def _xla_attention(q, k, v, causal: bool, sm_scale: float,
+                   q_segs=None, kv_segs=None):
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    ql, kl = logits.shape[-2], logits.shape[-1]
+    mask = None
     if causal:
-        ql, kl = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+    if q_segs is not None:
+        seg = (q_segs[:, None, :, None] == kv_segs[:, None, None, :])
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
         logits = jnp.where(mask, logits, _NEG_INF)
         p_raw = jax.nn.softmax(logits, axis=-1)
-        # fully-masked rows (lq > lk bottom-right) emit 0, flash convention
+        # fully-masked rows emit 0, flash convention
         p_raw = jnp.where(mask.any(-1)[..., None], p_raw, 0.0)
     else:
         p_raw = jax.nn.softmax(logits, axis=-1)
@@ -424,7 +515,8 @@ def _flash_fwd(q, k, v, causal, sm_scale):
     return out, (q, k, v, None, None)
 
 
-def _chunked_attention(q, k, v, causal: bool, sm_scale: float, block: int):
+def _chunked_attention(q, k, v, causal: bool, sm_scale: float, block: int,
+                       q_segs=None, kv_segs=None):
     """Blockwise attention over Q chunks with per-chunk remat.
 
     Same math (and bottom-right causal alignment) as ``_xla_attention`` but
@@ -440,15 +532,25 @@ def _chunked_attention(q, k, v, causal: bool, sm_scale: float, block: int):
     offsets = jnp.arange(nb, dtype=jnp.int32) * block
     shift = lk - lq
 
+    seg_blocks = None
+    if q_segs is not None:
+        seg_blocks = jnp.moveaxis(
+            q_segs.reshape(b, nb, block), 1, 0)  # (nb, B, blk)
+
     def one(args):
-        qi, off = args  # (B,H,blk,D), scalar
+        qi, off, qs = args  # (B,H,blk,D), scalar, (B,blk) | scalar 0
         logits = jnp.einsum("bhqd,bhkd->bhqk", qi, k).astype(
             jnp.float32) * sm_scale
+        keep = None
         if causal:
             rows = off + shift + jax.lax.broadcasted_iota(
                 jnp.int32, (block, lk), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block, lk), 1)
-            keep = rows >= cols
+            keep = jnp.broadcast_to(rows >= cols, (b, 1, block, lk))
+        if seg_blocks is not None:
+            seg = qs[:, None, :, None] == kv_segs[:, None, None, :]
+            keep = seg if keep is None else jnp.logical_and(keep, seg)
+        if keep is not None:
             logits = jnp.where(keep, logits, _NEG_INF)
             p_raw = jax.nn.softmax(logits, axis=-1)
             p_raw = jnp.where(keep.any(-1)[..., None], p_raw, 0.0)
@@ -457,7 +559,10 @@ def _chunked_attention(q, k, v, causal: bool, sm_scale: float, block: int):
         p = p_raw.astype(q.dtype)
         return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
-    out = jax.lax.map(jax.checkpoint(one), (qb, offsets))  # (nb,B,H,blk,D)
+    dummy = jnp.zeros((nb,), jnp.int32)
+    out = jax.lax.map(jax.checkpoint(one),
+                      (qb, offsets,
+                       seg_blocks if seg_blocks is not None else dummy))
     return jnp.moveaxis(out, 0, 2).reshape(b, h, lq, d)
 
 
@@ -484,23 +589,93 @@ def _flash_bwd(causal, sm_scale, res, g):
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
+# --- segment-masked core (padding / packed sequences) -----------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_core_seg(q, k, v, q_segs, kv_segs, causal: bool, sm_scale: float):
+    """Segment-id flash attention: like _flash_core but row i attends key j
+    only when q_segs[b, i] == kv_segs[b, j] (padding masks and packed
+    sequences stay on the streaming kernel — the fallback the reference's
+    varlen flash kernels serve on GPU, upstream
+    paddle/phi/kernels/gpu/flash_attn_ kernels, SURVEY §5 long-context)."""
+    use_kernel, interpret, bq, bk = _bwd_kernel_eligible(q, k)
+    if use_kernel:
+        return _pallas_flash(q, k, v, causal, sm_scale, bq, bk, interpret,
+                             q_segs=q_segs, kv_segs=kv_segs)
+    return _xla_attention(q, k, v, causal, sm_scale, q_segs, kv_segs)
+
+
+def _flash_fwd_seg(q, k, v, q_segs, kv_segs, causal, sm_scale):
+    use_kernel, interpret, bq, bk = _bwd_kernel_eligible(q, k)
+    if use_kernel:
+        out, lse = _pallas_flash(q, k, v, causal, sm_scale, bq, bk,
+                                 interpret, with_lse=True,
+                                 q_segs=q_segs, kv_segs=kv_segs)
+        return out, (q, k, v, out, lse, q_segs, kv_segs)
+    out = _xla_attention(q, k, v, causal, sm_scale, q_segs, kv_segs)
+    return out, (q, k, v, None, None, q_segs, kv_segs)
+
+
+def _flash_bwd_seg(causal, sm_scale, res, g):
+    q, k, v, out, lse, q_segs, kv_segs = res
+    zero_seg = (np.zeros(q_segs.shape, jax.dtypes.float0),
+                np.zeros(kv_segs.shape, jax.dtypes.float0))
+    if lse is not None:
+        _, interpret, bq, bk = _bwd_kernel_eligible(q, k)
+        dq, dk, dv = _pallas_flash_bwd(q, k, v, out, lse, g, causal,
+                                       sm_scale, bq, bk, interpret,
+                                       q_segs=q_segs, kv_segs=kv_segs)
+        return (dq, dk, dv) + zero_seg
+    block = _fit_block(q.shape[2], int(_flags.flag("flash_block_q")))
+    if block is not None:
+        fn = lambda a, b, c: _chunked_attention(a, b, c, causal, sm_scale,
+                                                block, q_segs, kv_segs)
+    else:
+        fn = lambda a, b, c: _xla_attention(a, b, c, causal, sm_scale,
+                                            q_segs, kv_segs)
+    _, vjp = jax.vjp(fn, q, k, v)
+    return tuple(vjp(g)) + zero_seg
+
+
+_flash_core_seg.defvjp(_flash_fwd_seg, _flash_bwd_seg)
+
+
 def flash_attention(query, key, value, dropout: float = 0.0, causal: bool = False,
                     return_softmax: bool = False, fixed_seed_offset=None,
-                    rng_name: str = "", training: bool = True, name=None):
-    """paddle.nn.functional.flash_attention parity. Inputs (B, L, H, D)."""
+                    rng_name: str = "", training: bool = True,
+                    q_segment_ids=None, kv_segment_ids=None, name=None):
+    """paddle.nn.functional.flash_attention parity. Inputs (B, L, H, D).
+
+    TPU-native extension beyond the upstream signature (trailing kwargs, so
+    upstream positional calls are unaffected): ``q_segment_ids`` /
+    ``kv_segment_ids`` (B, L) int tensors keep PADDING-MASKED and
+    PACKED-sequence attention on the streaming Pallas kernel — attention is
+    allowed only where ids match (combined with ``causal`` if set). This is
+    the role the reference's varlen flash kernels play on GPU
+    (paddle/phi/kernels/gpu/flash_attn_*). Masked long-sequence attention
+    previously fell back to materializing (Lq, Lk) logits in XLA, which
+    OOMs one chip at seq 8192."""
     query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("pass both q_segment_ids and kv_segment_ids, or "
+                         "neither")
     if dropout > 0.0 and training:
         # attention-prob dropout breaks the flash formulation; use the fused
         # XLA path (parity with reference behavior under dropout)
         from .nn_ops import scaled_dot_product_attention
-        out = scaled_dot_product_attention(query, key, value, None, dropout,
+        mask = None
+        if q_segment_ids is not None:
+            qs = ensure_tensor(q_segment_ids)._data
+            ks = ensure_tensor(kv_segment_ids)._data
+            mask = Tensor((qs[:, None, :, None] == ks[:, None, None, :]))
+        out = scaled_dot_product_attention(query, key, value, mask, dropout,
                                            causal, training)
         return (out, None) if return_softmax else out
 
     d = query._data.shape[-1]
     sm_scale = 1.0 / math.sqrt(d)
 
-    def f(q, k, v):
+    def f(q, k, v, *segs):
         qh = jnp.swapaxes(q, 1, 2)
         kh = jnp.swapaxes(k, 1, 2)
         vh = jnp.swapaxes(v, 1, 2)
@@ -508,21 +683,89 @@ def flash_attention(query, key, value, dropout: float = 0.0, causal: bool = Fals
             rep = qh.shape[1] // kh.shape[1]
             kh = jnp.repeat(kh, rep, axis=1)
             vh = jnp.repeat(vh, rep, axis=1)
-        out = _flash_core(qh, kh, vh, causal, sm_scale)
+        if segs:
+            out = _flash_core_seg(qh, kh, vh, segs[0].astype(jnp.int32),
+                                  segs[1].astype(jnp.int32), causal, sm_scale)
+        else:
+            out = _flash_core(qh, kh, vh, causal, sm_scale)
         return jnp.swapaxes(out, 1, 2)
 
-    out = apply("flash_attention", f, query, key, value)
+    if q_segment_ids is not None:
+        out = apply("flash_attention", f, query, key, value,
+                    ensure_tensor(q_segment_ids),
+                    ensure_tensor(kv_segment_ids))
+    else:
+        out = apply("flash_attention", f, query, key, value)
     return (out, None) if return_softmax else out
 
 
 def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
                         max_seqlen_k, scale=None, dropout=0.0, causal=False,
-                        return_softmax=False, **kw):
-    """Varlen parity shim: reshapes the packed layout to padded batches is the
-    caller's job on TPU (static shapes); provided for API compatibility."""
-    raise NotImplementedError(
-        "varlen flash attention: pad to fixed lengths on TPU (static shapes) "
-        "and call flash_attention with a mask")
+                        return_softmax=False, fixed_seed_offset=None,
+                        rng_name="", training=True, name=None):
+    """Varlen (packed) flash attention — upstream
+    paddle.nn.functional.flash_attn_unpadded over the GPU varlen kernels.
+
+    TPU-native design: the packed (total, H, D) layout IS the natural static
+    shape — run it as one batch row with per-sequence SEGMENT IDS derived
+    from ``cu_seqlens`` on-device (no host read, trace-safe). ``causal``
+    composes with the segment mask, which restricts global causality to
+    within each packed sequence — exactly the varlen-causal contract.
+    ``max_seqlen_*`` only size upstream's workspace; unused here (static
+    shapes already known)."""
+    q, k, v = ensure_tensor(q), ensure_tensor(k), ensure_tensor(v)
+    cu_q = ensure_tensor(cu_seqlens_q)
+    cu_k = ensure_tensor(cu_seqlens_k)
+    total_q, nheads, d = q._data.shape
+    total_k = k._data.shape[0]
+    sm_scale = float(scale) if scale else 1.0 / math.sqrt(d)
+    # hoisted OUTSIDE the traced fn so the key rides the carried RNG state
+    # instead of baking as a trace-time constant (same pattern as SDPA)
+    dkey = None
+    if dropout > 0.0 and training:
+        from ..core.random import default_generator
+        dkey = default_generator.split_key()
+
+    def seg_ids(cu, total):
+        # token i belongs to sequence searchsorted(cu[1:], i, 'right');
+        # tokens past cu[-1] get an id beyond any q/k pair -> masked out
+        ids = jnp.arange(total, dtype=jnp.int32)
+        return jnp.searchsorted(cu[1:].astype(jnp.int32), ids,
+                                side="right").astype(jnp.int32)[None, :]
+
+    def f(qa, ka, va, cq, ck):
+        qh = qa[None].swapaxes(1, 2)  # (1, H, Tq, D)
+        kh = ka[None].swapaxes(1, 2)
+        vh = va[None].swapaxes(1, 2)
+        qsegs = seg_ids(cq, total_q)
+        # offset k ids by a non-colliding base only for padding tail:
+        ksegs = seg_ids(ck, total_k)
+        # tail tokens (>= cu[-1]) must never match: push them out of range
+        qs = jnp.where(jnp.arange(total_q)[None, :] < cq[-1], qsegs,
+                       jnp.int32(2147483646))
+        ks = jnp.where(jnp.arange(total_k)[None, :] < ck[-1], ksegs,
+                       jnp.int32(2147483647))
+        if dkey is not None:
+            # parity path: masked XLA attention with prob-dropout
+            keep_mask = qs[:, None, :, None] == ks[:, None, None, :]
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(
+                jnp.float32) * sm_scale
+            if causal:
+                rows = jax.lax.broadcasted_iota(jnp.int32, (total_q, total_k), 0)
+                cols = jax.lax.broadcasted_iota(jnp.int32, (total_q, total_k), 1)
+                keep_mask = jnp.logical_and(keep_mask, rows >= cols)
+            logits = jnp.where(keep_mask, logits, _NEG_INF)
+            p = jax.nn.softmax(logits, axis=-1)
+            p = jnp.where(keep_mask.any(-1)[..., None], p, 0.0)
+            keep = jax.random.bernoulli(dkey, 1.0 - dropout, p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout), 0.0).astype(qh.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        else:
+            out = _flash_core_seg(qh, kh, vh, qs, ks, causal, sm_scale)
+        return out.swapaxes(1, 2)[0]  # (Tq, H, D)
+
+    out = apply("flash_attn_unpadded", f, q, k, v, cu_q, cu_k)
+    return (out, None) if return_softmax else out
 
 
 register_op("flash_attention", flash_attention)

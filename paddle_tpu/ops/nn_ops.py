@@ -387,9 +387,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     available; falls back to the fused XLA softmax-attention otherwise.
     """
     query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
-    if (attn_mask is None and not (dropout_p > 0.0 and training)
-            and jax.default_backend() not in ("cpu",)
-            and query._data.shape[1] >= int(_flags.flag("sdpa_flash_min_seqlen"))):
+    flash_ok = (not (dropout_p > 0.0 and training)
+                and jax.default_backend() not in ("cpu",)
+                and query._data.shape[1] >= int(
+                    _flags.flag("sdpa_flash_min_seqlen")))
+    if attn_mask is None and flash_ok:
         # (CPU keeps the fused XLA path — the Pallas kernel would run in
         # interpret mode there; call F.flash_attention directly to force it)
         # mask-free attention takes the flash path: Pallas online-softmax
@@ -399,6 +401,32 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         from .flash_attention import flash_attention
         return flash_attention(query, key, value, causal=is_causal,
                                training=training)
+    if attn_mask is not None and flash_ok:
+        # KEY-PADDING masks stay on the flash path as segment ids: a boolean
+        # mask that is constant across query rows and heads — (B, Lk),
+        # (B, 1, Lk) or (B, 1|H->1, 1, Lk) — means "key j is visible to every
+        # row or to none", i.e. kv_segment_ids. Anything row-varying falls
+        # through to the fused XLA path below. (Divergence note: a row with
+        # ALL keys padded emits 0 on the flash path; XLA softmax would emit
+        # the uniform average — such rows are padding and discarded anyway.)
+        m = ensure_tensor(attn_mask)._data
+        kv_valid = None
+        if m.dtype == jnp.bool_:
+            # NOTE: a 2-D bool mask is (Lq, Lk) under upstream broadcast
+            # semantics (row-varying) — it must NOT take this route
+            if m.ndim == 3 and m.shape[1] == 1:
+                kv_valid = m[:, 0, :]
+            elif m.ndim == 4 and m.shape[1] == 1 and m.shape[2] == 1:
+                kv_valid = m[:, 0, 0, :]
+        if kv_valid is not None:
+            from .flash_attention import flash_attention
+            b = query._data.shape[0]
+            lq = query._data.shape[1]
+            q_segs = Tensor(jnp.ones((b, lq), jnp.int32))
+            kv_segs = Tensor(kv_valid.astype(jnp.int32))
+            return flash_attention(query, key, value, causal=is_causal,
+                                   training=training, q_segment_ids=q_segs,
+                                   kv_segment_ids=kv_segs)
     dkey = default_generator.split_key() if (dropout_p > 0.0 and training) else None
 
     def f(q, k, v, *maybe_mask):

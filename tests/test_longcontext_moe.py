@@ -54,6 +54,102 @@ def test_flash_attention_matches_reference():
         np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
 
 
+def _ref_attention_seg(q, k, v, causal, q_segs, kv_segs):
+    """Masked reference: rows attend only same-segment keys (flash
+    convention: fully-masked rows emit 0)."""
+    qh = np.swapaxes(q, 1, 2).astype(np.float64)
+    kh = np.swapaxes(k, 1, 2).astype(np.float64)
+    vh = np.swapaxes(v, 1, 2).astype(np.float64)
+    s = np.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(q.shape[-1])
+    keep = (q_segs[:, None, :, None] == kv_segs[:, None, None, :])
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        keep = keep & np.tril(np.ones((lq, lk), bool), k=lk - lq)
+    s = np.where(keep, s, -1e30)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    denom = e.sum(-1, keepdims=True)
+    p = np.where(keep.any(-1, keepdims=True), e / np.maximum(denom, 1e-300), 0.0)
+    out = np.einsum("bhqk,bhkd->bhqd", p, vh)
+    return np.swapaxes(out, 1, 2).astype(np.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_segment_mask_matches_reference(causal):
+    """Padding/packed masks via segment ids stay on the flash kernel
+    (interpret mode on CPU) and match the masked softmax reference —
+    forward AND gradients (VERDICT r3 item 3)."""
+    paddle.seed(1)
+    B, L, H, D = 2, 256, 2, 16
+    rng = np.random.default_rng(3)
+    qn = rng.normal(0, 1, (B, L, H, D)).astype(np.float32)
+    kn = rng.normal(0, 1, (B, L, H, D)).astype(np.float32)
+    vn = rng.normal(0, 1, (B, L, H, D)).astype(np.float32)
+    # batch 0: two packed sequences; batch 1: one sequence + padding tail
+    segs = np.zeros((B, L), np.int32)
+    segs[0, : L // 2] = 1
+    segs[0, L // 2:] = 2
+    segs[1, : 3 * L // 4] = 1
+    segs[1, 3 * L // 4:] = 0  # padding id (q rows there are don't-care)
+
+    q = paddle.to_tensor(qn); q.stop_gradient = False
+    k = paddle.to_tensor(kn); k.stop_gradient = False
+    v = paddle.to_tensor(vn); v.stop_gradient = False
+    st = paddle.to_tensor(segs)
+    out = nn.functional.flash_attention(q, k, v, causal=causal,
+                                        q_segment_ids=st, kv_segment_ids=st)
+    ref = _ref_attention_seg(qn, kn, vn, causal, segs, segs)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    # gradients: finite + match AD through the masked XLA reference
+    out.sum().backward()
+    import jax.numpy as jnp
+    import jax
+
+    def ref_jax(qa, ka, va):
+        from paddle_tpu.ops.flash_attention import _xla_attention
+        o = _xla_attention(jnp.swapaxes(qa, 1, 2), jnp.swapaxes(ka, 1, 2),
+                           jnp.swapaxes(va, 1, 2), causal,
+                           1.0 / np.sqrt(D), jnp.asarray(segs),
+                           jnp.asarray(segs))
+        return jnp.swapaxes(o, 1, 2).sum()
+
+    gq, gk, gv = jax.grad(ref_jax, argnums=(0, 1, 2))(qn, kn, vn)
+    np.testing.assert_allclose(q.grad.numpy(), np.asarray(gq), rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(k.grad.numpy(), np.asarray(gk), rtol=2e-4,
+                               atol=2e-5)
+    np.testing.assert_allclose(v.grad.numpy(), np.asarray(gv), rtol=2e-4,
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attn_unpadded_packed_sequences(causal):
+    """flash_attn_unpadded: packed (total, H, D) + cu_seqlens == looping the
+    per-sequence attention (the upstream varlen contract)."""
+    paddle.seed(2)
+    H, D = 2, 16
+    lens = [128, 256, 128]  # 128-aligned total keeps the kernel path
+    total = sum(lens)
+    rng = np.random.default_rng(4)
+    qn = rng.normal(0, 1, (total, H, D)).astype(np.float32)
+    kn = rng.normal(0, 1, (total, H, D)).astype(np.float32)
+    vn = rng.normal(0, 1, (total, H, D)).astype(np.float32)
+    cu = np.cumsum([0] + lens).astype(np.int32)
+
+    out = nn.functional.flash_attn_unpadded(
+        paddle.to_tensor(qn), paddle.to_tensor(kn), paddle.to_tensor(vn),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), max(lens), max(lens),
+        causal=causal)
+    got = out.numpy()
+
+    for i in range(len(lens)):
+        s, e = cu[i], cu[i + 1]
+        ref = _ref_attention(qn[None, s:e], kn[None, s:e], vn[None, s:e],
+                             causal)[0]
+        np.testing.assert_allclose(got[s:e], ref, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"sequence {i}")
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_matches_serial(causal):
     from paddle_tpu.distributed.fleet.context_parallel import ring_flash_attention
