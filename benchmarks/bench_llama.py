@@ -37,6 +37,10 @@ def main() -> None:
                          "behavior), bf16 moments + master-weight-free "
                          "bf16 params with stochastic rounding, or int8 "
                          "block-quantized moments (2 B/param of m+v)")
+    ap.add_argument("--q8-chunk", type=int, default=0,
+                    help="int8-state chunk size in elements (0 = default); "
+                         "bigger = fewer serial optimizer chunks, more "
+                         "transient HBM")
     ap.add_argument("--scan-layers", action="store_true",
                     help="stack identical decoder layers under lax.scan")
     ap.add_argument("--recompute", action="store_true",
@@ -67,6 +71,8 @@ def main() -> None:
     # make the per-param (unfused) path the fast one here.
     moment = {"fp32": "float32", "bf16": "bfloat16",
               "int8": "int8"}[args.state]
+    if args.q8_chunk:
+        paddle.optimizer.Adam._Q8_CHUNK_ELEMS = args.q8_chunk
     opt = paddle.optimizer.AdamW(
         learning_rate=1e-4, parameters=model.parameters(),
         use_multi_tensor=not args.scan_layers and args.state != "int8",

@@ -222,3 +222,39 @@ def test_q8_quantize_roundtrip():
     # per-block absmax: error bounded by absmax/254 per block
     err = np.abs(np.asarray(back - x))
     assert err.max() <= float(jnp.max(jnp.abs(x))) / 127.0 + 1e-6
+
+
+def test_q8_chunked_update_matches_single_chunk():
+    """Round-4: the int8 update runs per-chunk under lax.map (so fp32
+    transients stay O(chunk) at the 2B single-chip ceiling). Multi-chunk
+    (tiny _Q8_CHUNK_ELEMS) must match the single-chunk trajectory exactly —
+    the blockwise quantization math is chunk-shape invariant."""
+    import paddle_tpu.optimizer as optim
+
+    def run(chunk_elems):
+        paddle.seed(11)
+        model = nn.Linear(64, 96)  # 6144 weights -> 3 blocks of 2048
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters(),
+                                     moment_dtype="int8",
+                                     stochastic_rounding=False)
+        old = optim.Adam._Q8_CHUNK_ELEMS
+        optim.Adam._Q8_CHUNK_ELEMS = chunk_elems
+        try:
+            x = paddle.to_tensor(
+                np.random.default_rng(5).normal(0, 1, (8, 64))
+                .astype(np.float32))
+            for _ in range(4):
+                loss = (model(x) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+        finally:
+            optim.Adam._Q8_CHUNK_ELEMS = old
+        return (np.asarray(model.weight._data.astype(jnp.float32)),
+                np.asarray(next(iter(
+                    opt._accumulators["moment1"].values()))._data))
+
+    w_multi, m_multi = run(2048)          # 1 block/chunk -> 3 chunks
+    w_single, m_single = run(8 * 1024 * 1024)  # everything in one chunk
+    np.testing.assert_allclose(w_multi, w_single, rtol=0, atol=0)
+    np.testing.assert_array_equal(m_multi, m_single)
