@@ -47,13 +47,27 @@ def _shard_param(p: Tensor, spec) -> Tensor:
 
 
 def _constrain(t: Tensor, spec) -> Tensor:
-    """Apply a sharding constraint (works eagerly and under tracing)."""
+    """Apply a sharding constraint (works eagerly and under tracing).
+
+    Inside a PARTIAL-manual shard_map (the pipeline engine maps pp/dp
+    manually and leaves mp auto), the constraint must be expressed on the
+    ambient ABSTRACT mesh — whose axis types mark pp/dp Manual — not the
+    concrete all-auto mesh, or jax rejects the manual vma axes."""
     mesh, _ = _mp_mesh()
     if mesh is None:
         return t
-    return apply("sharding_constraint",
-                 lambda a: jax.lax.with_sharding_constraint(
-                     a, NamedSharding(mesh, spec)), t)
+
+    def f(a):
+        use = mesh
+        try:
+            cur = jax.sharding.get_abstract_mesh()
+            if cur is not None and cur.axis_names:
+                use = cur
+        except Exception:
+            pass
+        return jax.lax.with_sharding_constraint(a, NamedSharding(use, spec))
+
+    return apply("sharding_constraint", f, t)
 
 
 class ColumnParallelLinear(Layer):

@@ -64,7 +64,21 @@ def pipelined_forward(stage_fn: Callable, stacked_params, micro_inputs,
     T = M + S - 1
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    vary_axes = (axis,) + ((batch_axis,) if batch_axis else ())
+    # Manual-axis policy: with only pp (+ dp batch) on the mesh, both are
+    # manual (the classic layout). When the mesh ALSO carries tensor
+    # parallelism (dp x mp x pp), only pp goes manual — dp and mp both ride
+    # AUTO sharding propagation inside the body, because the XLA
+    # partitioners reject the mixed manual set (shardy: "Manual sub-axis
+    # isn't supported"; GSPMD: manual/auto dynamic-slice mismatch).
+    extra_axes = {a for a in mesh.axis_names
+                  if a != axis and a != batch_axis and int(mesh.shape[a]) > 1}
+    if extra_axes:
+        manual_axes = {axis}
+        micro_spec = P(None)  # pp-replicated; batch/mp shardings flow auto
+    else:
+        manual_axes = {axis} | ({batch_axis} if batch_axis else set())
+        micro_spec = P(None, batch_axis) if batch_axis else P()
+    vary_axes = tuple(manual_axes)
 
     def local_fn(params_local, micro):
         # params_local leaves: (1, ...) — this stage's slice
@@ -112,11 +126,11 @@ def pipelined_forward(stage_fn: Callable, stacked_params, micro_inputs,
 
     n_param_dims = jax.tree_util.tree_map(lambda a: P(axis, *([None] * (a.ndim - 1))),
                                           stacked_params)
-    micro_spec = P(None, batch_axis) if batch_axis else P()
     mapped = jax.shard_map(
         local_fn, mesh=mesh,
         in_specs=(n_param_dims, micro_spec),
-        out_specs=micro_spec)
+        out_specs=micro_spec,
+        axis_names=manual_axes)
     return mapped(stacked_params, micro_inputs)
 
 
