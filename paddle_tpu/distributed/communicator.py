@@ -64,14 +64,21 @@ class Communicator:
     """
 
     def __init__(self, mode: str = "async", send_queue_size: int = 32,
-                 geo_k: int = 8, lr: float = 0.01):
+                 geo_k: int = 8, lr: float = 0.01, remote=None):
         mode = mode.lower()
         if mode not in ("sync", "async", "geo"):
             raise ValueError(f"unknown communicator mode {mode!r}")
         self.mode = mode
         self.lr = float(lr)
         self.geo_k = int(geo_k)
+        # remote: a ps_service.PsClient — pushes/pulls cross the process
+        # boundary to tables held by a PS SERVER process (the reference
+        # BrpcPsClient seam) instead of mutating worker-local tables
+        self._remote = remote
+        if remote is not None:
+            remote.lr = self.lr
         self._tables: Dict[str, Tensor] = {}
+        self._table_dims: Dict[str, int] = {}
         self._queue: "queue.Queue" = queue.Queue(maxsize=send_queue_size)
         self._accum: Dict[str, List] = {}
         self._thread: Optional[threading.Thread] = None
@@ -83,8 +90,17 @@ class Communicator:
 
     # -- lifecycle (reference: Communicator::Start/Stop) ---------------------
     def init_with_ctx(self, tables: Dict[str, Tensor]) -> None:
-        """Register the named tables (sharded embedding weights)."""
+        """Register the named tables (sharded embedding weights). With a
+        remote client, the worker's initial table values seed the SERVER's
+        state (idempotent create: the first worker wins, reference
+        load-once shards) and the worker keeps only name -> row width."""
         self._tables.update(tables)
+        if self._remote is not None:
+            import numpy as np
+            for name, t in tables.items():
+                arr = np.asarray(t._data)
+                self._remote.create_table(name, arr)
+                self._table_dims[name] = int(arr.shape[-1])
 
     def start(self) -> None:
         if self.mode != "async" or self._running:
@@ -136,8 +152,13 @@ class Communicator:
         k-step batching is the mode's point (reference GeoCommunicator)."""
         if self.mode == "async":
             self.barrier()
-        table = self._tables[table_name]
         ids_a = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        if self._remote is not None:
+            import numpy as np
+            rows = self._remote.pull(table_name, np.asarray(ids_a),
+                                     self._table_dims[table_name])
+            return Tensor(jnp.asarray(rows), stop_gradient=True)
+        table = self._tables[table_name]
         return Tensor(table._data[ids_a], stop_gradient=True)
 
     def barrier(self) -> None:
@@ -163,6 +184,12 @@ class Communicator:
 
     # -- internals -----------------------------------------------------------
     def _apply(self, name: str, ids, grad) -> None:
+        if self._remote is not None:
+            # ship (rows, values) across the process boundary; the server
+            # applies the SGD scatter rule to ITS table state
+            import numpy as np
+            self._remote.push(name, np.asarray(ids), np.asarray(grad))
+            return
         t = self._tables[name]
         # scatter-subtract; duplicate ids accumulate (segment-sum semantics,
         # the reference accessor's SGD rule)
@@ -170,10 +197,28 @@ class Communicator:
 
     def _flush_geo(self, table_name: Optional[str] = None) -> None:
         """Apply accumulated deltas for one table (its k-window filled) or
-        all tables (barrier)."""
+        all tables (barrier). With a remote PS the window merges into ONE
+        wire push (segment-summing duplicate ids) — the reference
+        GeoCommunicator sends one merged delta per window, not k RPCs."""
         names = [table_name] if table_name is not None else list(self._accum)
         for name in names:
-            for ids, g in self._accum.pop(name, []):
+            pending = self._accum.pop(name, [])
+            if not pending:
+                continue
+            if self._remote is not None and len(pending) > 1:
+                import numpy as np
+                ids_all = np.concatenate(
+                    [np.asarray(i).reshape(-1) for i, _ in pending])
+                g_all = np.concatenate(
+                    [np.asarray(g).reshape(len(np.asarray(i).reshape(-1)), -1)
+                     for i, g in pending])
+                uniq, inv = np.unique(ids_all, return_inverse=True)
+                merged = np.zeros((uniq.shape[0], g_all.shape[1]),
+                                  g_all.dtype)
+                np.add.at(merged, inv, g_all)
+                self._apply(name, uniq, merged)
+                continue
+            for ids, g in pending:
                 self._apply(name, ids, g)
 
     def _loop(self) -> None:

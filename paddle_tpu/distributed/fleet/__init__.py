@@ -153,9 +153,19 @@ def server_endpoints(to_string: bool = False):
     return ",".join(eps) if to_string else eps
 
 
-def init_server(*args, **kwargs) -> None:
+def _ps_rpc_endpoint(rm) -> str:
+    """The PS RPC plane rides the first server's endpoint shifted by one
+    port (the server endpoint itself is the shutdown-coordination store)."""
+    host, _, port = rm.get_pserver_endpoints()[0].rpartition(":")
+    return f"{host or '127.0.0.1'}:{int(port) + 1}"
+
+
+def init_server(*args, use_ps_service: bool = False, **kwargs) -> None:
     """Start this server's KV plane (reference: BrpcPsServer startup loading
-    table shards; here the coordination store only — tables are on-mesh)."""
+    table shards). ``use_ps_service=True`` additionally joins the job RPC
+    plane and HOSTS TABLE STATE in this process (``distributed.ps_service``)
+    — workers then push (rows, values) sparse grads across the process
+    boundary instead of mutating mesh-local tables."""
     global _server_store
     from ..store import TCPStore
     rm = _rm()
@@ -163,6 +173,14 @@ def init_server(*args, **kwargs) -> None:
     port = int(ep.rsplit(":", 1)[1])
     _server_store = TCPStore(is_master=True, port=port,
                              world_size=rm.worker_num())
+    if use_ps_service:
+        from .. import rpc as _rpc
+        from .. import ps_service
+        ps_service.reset_server_state()
+        idx = rm.server_index()
+        _rpc.init_rpc(f"ps/{idx}", rank=idx,
+                      world_size=rm.server_num() + rm.worker_num(),
+                      master_endpoint=_ps_rpc_endpoint(rm))
 
 
 def run_server() -> None:
@@ -193,7 +211,7 @@ def init_worker(scopes=None) -> None:
     starts so ``push_sparse`` hands updates to a background applier
     (upstream Communicator::Start)."""
     global _communicator
-    _rm()  # assert PS mode
+    rm = _rm()  # assert PS mode
     st = get_strategy()
     if st is not None and getattr(st, "a_sync", False):
         if _communicator is not None:  # re-init (elastic restart): replace
@@ -201,9 +219,21 @@ def init_worker(scopes=None) -> None:
         from ..communicator import Communicator, registered_tables
         cfg = getattr(st, "a_sync_configs", {}) or {}
         mode = "geo" if int(cfg.get("k_steps", 0) or 0) > 0 else "async"
+        remote = None
+        if cfg.get("use_ps_service"):
+            # cross-process PS: join the RPC plane and aim pushes at the
+            # table-hosting server process (reference BrpcPsClient)
+            from .. import rpc as _rpc
+            from ..ps_service import PsClient
+            widx = rm.worker_index()
+            _rpc.init_rpc(f"worker/{widx}", rank=rm.server_num() + widx,
+                          world_size=rm.server_num() + rm.worker_num(),
+                          master_endpoint=_ps_rpc_endpoint(rm))
+            remote = PsClient("ps/0")
         _communicator = Communicator(
             mode=mode, geo_k=int(cfg.get("k_steps", 0) or 8),
-            send_queue_size=int(cfg.get("send_queue_size", 32) or 32))
+            send_queue_size=int(cfg.get("send_queue_size", 32) or 32),
+            remote=remote)
         # every live ShardedEmbedding table is a push/pull target
         _communicator.init_with_ctx(registered_tables())
         _communicator.start()

@@ -1,0 +1,142 @@
+"""Cross-process parameter-server service.
+
+Parity surface: the reference PS services
+(upstream paddle/fluid/distributed/ps/service/ — BrpcPsServer holding table
+shards, BrpcPsClient issuing push_sparse/pull_sparse RPCs, the Communicator
+batching sends). TPU-native transport: instead of brpc, the job's own RPC
+plane (``distributed.rpc`` — pickle-over-TCP with per-job HMAC, bootstrapped
+through the rendezvous TCPStore) carries the requests; the SERVER PROCESS
+holds table state as host numpy arrays (sparse tables are host-memory
+objects in the reference too — device meshes are the collective path, the
+PS path is explicitly the host-side one).
+
+Role separation is real: ``fleet.init(role)`` on a SERVER process serves
+these tables; WORKER processes never hold them — ``push_sparse`` ships
+(rows, values) across the process boundary and ``pull_sparse`` reads the
+server's current state (including its staleness under geo batching, which
+is the semantics the Communicator contract promises).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PsClient", "serve_stats", "reset_server_state"]
+
+# ---------------------------------------------------------------------------
+# server-side state (lives in the PS SERVER process; reached via rpc)
+# ---------------------------------------------------------------------------
+
+_TABLES: Dict[str, np.ndarray] = {}
+_LOCK = threading.Lock()
+_STATS = {"pushes": 0, "pulls": 0, "creates": 0}
+
+
+def reset_server_state() -> None:
+    with _LOCK:
+        _TABLES.clear()
+        _STATS.update(pushes=0, pulls=0, creates=0)
+
+
+def _srv_create(name: str, value_bytes: bytes, shape: Tuple[int, ...],
+                dtype: str) -> bool:
+    """Install a table (idempotent: the first creator wins, matching the
+    reference's load-once table shards)."""
+    with _LOCK:
+        if name not in _TABLES:
+            _TABLES[name] = np.frombuffer(value_bytes, dtype=dtype) \
+                .reshape(shape).copy()
+            _STATS["creates"] += 1
+    return True
+
+
+def _srv_push(name: str, ids_bytes: bytes, grad_bytes: bytes,
+              n: int, dim: int, lr: float) -> bool:
+    """Apply an SGD scatter-update: table[ids] -= lr * grad. Duplicate ids
+    accumulate (segment-sum semantics, the reference accessor's rule)."""
+    with _LOCK:
+        t = _TABLES[name]
+        ids = np.frombuffer(ids_bytes, dtype=np.int64)
+        g = np.frombuffer(grad_bytes, dtype=np.float32).reshape(n, dim)
+        np.subtract.at(t, ids, lr * g.astype(t.dtype))
+        _STATS["pushes"] += 1
+    return True
+
+
+def _srv_pull(name: str, ids_bytes: bytes) -> bytes:
+    with _LOCK:
+        t = _TABLES[name]
+        ids = np.frombuffer(ids_bytes, dtype=np.int64)
+        _STATS["pulls"] += 1
+        return t[ids].tobytes()
+
+
+def _srv_stats() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_STATS)
+
+
+def _srv_table_snapshot(name: str) -> Tuple[bytes, Tuple[int, ...], str]:
+    """Test/introspection surface: the server's CURRENT table state —
+    exactly what geo-staleness assertions need to observe."""
+    with _LOCK:
+        t = _TABLES[name]
+        return t.tobytes(), t.shape, str(t.dtype)
+
+
+def serve_stats() -> Dict[str, int]:
+    """Server-local stats read (same process)."""
+    return _srv_stats()
+
+
+# ---------------------------------------------------------------------------
+# worker-side client
+# ---------------------------------------------------------------------------
+
+class PsClient:
+    """push/pull against tables living in a PS SERVER process.
+
+    The analogue of the reference BrpcPsClient: every method is a remote
+    call; nothing is cached worker-side (pulls observe the server's real,
+    possibly-stale-under-geo state)."""
+
+    def __init__(self, server: str, lr: float = 0.01):
+        self.server = server
+        self.lr = float(lr)
+
+    def _rpc(self):
+        from . import rpc
+        return rpc
+
+    def create_table(self, name: str, value) -> None:
+        arr = np.asarray(value)
+        self._rpc().rpc_sync(self.server, _srv_create,
+                             args=(name, arr.tobytes(), arr.shape,
+                                   str(arr.dtype)))
+
+    def push(self, name: str, ids, grad, wait: bool = True):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        g = np.asarray(grad, np.float32).reshape(ids.shape[0], -1)
+        rpc = self._rpc()
+        args = (name, ids.tobytes(), g.tobytes(), g.shape[0], g.shape[1],
+                self.lr)
+        if wait:
+            return rpc.rpc_sync(self.server, _srv_push, args=args)
+        return rpc.rpc_async(self.server, _srv_push, args=args)
+
+    def pull(self, name: str, ids, dim: int, dtype=np.float32) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        raw = self._rpc().rpc_sync(self.server, _srv_pull,
+                                   args=(name, ids.tobytes()))
+        return np.frombuffer(raw, dtype=dtype).reshape(ids.shape[0], dim)
+
+    def table_snapshot(self, name: str) -> np.ndarray:
+        raw, shape, dtype = self._rpc().rpc_sync(
+            self.server, _srv_table_snapshot, args=(name,))
+        return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+    def stats(self) -> Dict[str, int]:
+        return self._rpc().rpc_sync(self.server, _srv_stats)
