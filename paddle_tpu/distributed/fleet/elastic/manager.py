@@ -86,8 +86,14 @@ class ElasticManager:
     def __init__(self, world_size: int,
                  elastic_level: int = ElasticLevel.FAULT_TOLERANCE,
                  beat_timeout: float = 30.0, max_restarts: int = 3,
-                 store=None, rank_offset: int = 0):
+                 store=None, rank_offset: int = 0,
+                 single_node: bool = True):
         self.world_size = world_size
+        # level-2 RESIZE only acts when this manager supervises the whole
+        # job (single node): node-local managers resizing independently
+        # would desync PADDLE_TRAINERS_NUM across nodes — multi-node jobs
+        # keep level-1 same-size restart semantics
+        self.single_node = bool(single_node)
         # first GLOBAL rank of the locally-supervised procs (multi-node:
         # node_rank * nproc_per_node); beat keys are global-rank keyed
         self.rank_offset = rank_offset
@@ -127,8 +133,11 @@ class ElasticManager:
             return None
 
     def classify(self, procs: List) -> str:
-        """One watch tick over child processes + leases."""
+        """One watch tick over child processes + leases. Also records the
+        MEMBERSHIP LOSS of the tick (``_last_dead``): fault-exited plus
+        hung workers — the resize input for ``ElasticLevel.ELASTIC``."""
         codes = [p.poll() for p in procs]
+        self._last_dead = sum(1 for c in codes if c is not None and c != 0)
         if all(c == 0 for c in codes):
             return ElasticStatus.COMPLETED
         if any(c is not None and c != 0 for c in codes):
@@ -139,14 +148,18 @@ class ElasticManager:
         # for hangs via lease freshness (a worker that exited 0 naturally
         # stops beating — that is not a hang; and a script that never
         # registered a beat simply isn't hang-monitored)
+        hung = 0
         for i, code in enumerate(codes):
             if code == 0:
                 continue
             age = self._beat_age(self.rank_offset + i)
             if age is not None and age > self.beat_timeout:
-                return (ElasticStatus.RESTART
-                        if self.restarts < self.max_restarts
-                        else ElasticStatus.ERROR)
+                hung += 1
+        if hung:
+            self._last_dead += hung
+            return (ElasticStatus.RESTART
+                    if self.restarts < self.max_restarts
+                    else ElasticStatus.ERROR)
         return ElasticStatus.HOLD
 
     # --- the loop -------------------------------------------------------------
@@ -167,6 +180,18 @@ class ElasticManager:
                 return 1
             if status == ElasticStatus.RESTART:
                 self.restarts += 1
+                if (self.elastic_level >= ElasticLevel.ELASTIC
+                        and self.single_node):
+                    # level 2 (resize): the lost members LEAVE the job —
+                    # recompute the world to the surviving count and restart
+                    # on the smaller topology (ranks remapped 0..new-1 by
+                    # the launcher's respawn; workers resume from
+                    # checkpoint). Upstream: the etcd membership watch in
+                    # fleet/elastic/manager.py shrinking np on node loss.
+                    dead = max(1, getattr(self, "_last_dead", 1))
+                    new_world = max(1, self.world_size - dead)
+                    if new_world != self.world_size:
+                        self.world_size = new_world
                 for p in procs:
                     if p.poll() is None:
                         p.terminate()

@@ -112,19 +112,28 @@ def launch_main() -> int:
                                  elastic_level=args.elastic_level,
                                  beat_timeout=args.elastic_timeout,
                                  max_restarts=args.max_restarts,
-                                 rank_offset=args.rank * nproc)
+                                 rank_offset=args.rank * nproc,
+                                 single_node=(nnodes == 1))
 
     def spawn(restart_count: int = 0) -> List[subprocess.Popen]:
+        # elastic level 2 may have RESIZED the world on membership loss:
+        # respawn on the manager's current topology with ranks remapped
+        # 0..new_world-1 and endpoints re-derived for the new size
+        cur_world = manager.world_size if manager is not None else world
+        cur_nproc = min(nproc, cur_world) if nnodes == 1 else nproc
+        cur_endpoints = ",".join(
+            f"127.0.0.1:{base_port + i}" for i in range(cur_world)) \
+            if nnodes == 1 else endpoints
         out: List[subprocess.Popen] = []
-        for local_rank in range(nproc):
-            rank = args.rank * nproc + local_rank
+        for local_rank in range(cur_nproc):
+            rank = args.rank * cur_nproc + local_rank
             env = dict(os.environ)
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_TRAINERS_NUM": str(world),
-                "PADDLE_TRAINER_ENDPOINTS": endpoints,
-                "PADDLE_CURRENT_ENDPOINT": endpoints.split(",")[rank]
-                if rank < len(endpoints.split(",")) else master,
+                "PADDLE_TRAINERS_NUM": str(cur_world),
+                "PADDLE_TRAINER_ENDPOINTS": cur_endpoints,
+                "PADDLE_CURRENT_ENDPOINT": cur_endpoints.split(",")[rank]
+                if rank < len(cur_endpoints.split(",")) else master,
                 "PADDLE_MASTER": master,
                 "FLAGS_selected_devices": args.devices or "",
             })

@@ -251,3 +251,103 @@ def test_kill_worker_midtrain_rejoin_resumes_step_counter(tmp_path):
         assert done["resumed_from"] >= 2, (
             f"rank {rank} restarted from scratch: {done}")
         assert done["final_step"] == 8
+
+
+def test_elastic_level2_resize_on_member_loss(tmp_path):
+    """--elastic_level 2 (VERDICT r3 item 6): killing one of THREE workers
+    must not respawn the same world — the job RESIZES to world 2, ranks
+    remap 0..1, and training resumes from the shared checkpoint with a
+    continuous step counter."""
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import json, os, sys, time\n"
+        "sys.path.insert(0, os.environ['REPO'])\n"
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "paddle.device.force_platform('cpu', 1)\n"
+        "import paddle_tpu.nn as nn\n"
+        "from paddle_tpu.distributed.fleet.elastic import "
+        "start_worker_heartbeat\n"
+        "start_worker_heartbeat(interval=0.2)\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "d = os.environ['CKPT_DIR']\n"
+        "open(os.path.join(d, f'pid_{world}_{rank}'), 'w')"
+        ".write(str(os.getpid()))\n"
+        "ck = os.path.join(d, 'shared.pdparams')\n"
+        "paddle.seed(3)\n"
+        "model = nn.Linear(4, 1)\n"
+        "opt = paddle.optimizer.SGD(learning_rate=0.05,\n"
+        "                           parameters=model.parameters())\n"
+        "start = 0\n"
+        "if os.path.exists(ck):\n"
+        "    st = paddle.load(ck)\n"
+        "    model.set_state_dict(st['model'])\n"
+        "    start = int(st['step'])\n"
+        "rng = np.random.default_rng(0)\n"
+        "xs = rng.normal(0, 1, (8, 16, 4)).astype('float32')\n"
+        "ys = rng.normal(0, 1, (8, 16, 1)).astype('float32')\n"
+        "for step in range(start, 8):\n"
+        "    loss = ((model(paddle.to_tensor(xs[step])) -\n"
+        "             paddle.to_tensor(ys[step])) ** 2).mean()\n"
+        "    loss.backward(); opt.step(); opt.clear_grad()\n"
+        "    if rank == 0:\n"
+        "        paddle.save({'model': model.state_dict(),\n"
+        "                     'step': step + 1}, ck)\n"
+        "    open(os.path.join(d, f'step_{world}_{rank}'), 'w')"
+        ".write(str(step + 1))\n"
+        "    time.sleep(0.4)\n"
+        "open(os.path.join(d, f'done_{world}_{rank}'), 'w').write(\n"
+        "    json.dumps({'resumed_from': start, 'world': world,\n"
+        "                'restarts': int(os.environ.get("
+        "'PADDLE_RESTART_COUNT', 0))}))\n"
+    )
+    env = dict(os.environ)
+    env["CKPT_DIR"] = str(tmp_path)
+    env["REPO"] = REPO
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3", "--elastic_level", "2",
+         "--max_restarts", "2", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        env=env, cwd=str(tmp_path), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE)
+    try:
+        import signal
+
+        def _step(world, rank):
+            sf = tmp_path / f"step_{world}_{rank}"
+            try:
+                return int(sf.read_text()) if sf.exists() else 0
+            except ValueError:
+                return 0
+
+        deadline = time.time() + 120
+        killed_at = None
+        while time.time() < deadline:
+            cur = min(_step(3, r) for r in range(3))
+            if cur >= 3:  # all three made progress: lose member 2
+                pid = int((tmp_path / "pid_3_2").read_text())
+                os.kill(pid, signal.SIGKILL)
+                killed_at = cur
+                break
+            time.sleep(0.2)
+        assert killed_at is not None, "workers never reached step 3"
+
+        out, err = proc.communicate(timeout=180)
+        assert proc.returncode == 0, err.decode()[-800:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    import json
+    # the job finished at WORLD SIZE 2 with both survivors resuming from
+    # the checkpointed step (continuity), after exactly one restart
+    for rank in (0, 1):
+        done = json.loads((tmp_path / f"done_2_{rank}").read_text())
+        assert done["world"] == 2, done
+        assert done["restarts"] == 1, done
+        assert done["resumed_from"] >= 2, \
+            f"rank {rank} restarted from scratch: {done}"
+    assert not (tmp_path / "done_2_2").exists()  # no rank 2 in the new world
