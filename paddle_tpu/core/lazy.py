@@ -24,6 +24,20 @@ reference's SOT collapses into "Python re-executes", and the compiled
 cache keys replace its per-break graph cache. Per-call Python overhead is
 the op-recording walk (microseconds per op); device work runs in fused
 segments, which is where the throughput is.
+
+Memory semantics of a flush (what materializes):
+
+* only ESCAPING values — pending values still owned by a live tensor —
+  become compiled-program outputs; intermediates whose tensors died
+  (e.g. inference under ``no_grad``, or a model whose params are frozen,
+  where no tape exists) are fused away by XLA like any full-graph run.
+  ``last_escape_counts()`` exposes the per-flush output count for tests.
+* with a tape (grad-enabled forward over trainable params), the tape's
+  strong refs keep every intermediate's tensor alive, so every
+  intermediate materializes — IDENTICAL to upstream eager semantics
+  (the autograd graph pins activations until released there too), not a
+  segment-mode regression. The fused optimum for inference remains
+  ``no_grad`` (or ``full_graph=True``), same as the reference.
 """
 
 from __future__ import annotations
@@ -119,6 +133,7 @@ class _State:
         self.compiled: Dict[Any, Any] = {}       # segment signature -> jitted
         self.last_hlos: List[str] = []           # debug: per-flush compiled HLO
         self.capture_hlo = False
+        self.last_escapes: List[int] = []        # per-flush escaping-output count
 
 
 _state = _State()
@@ -137,6 +152,7 @@ class segment_mode:
         _state.active = True
         _state.records = []
         _state.last_hlos = []
+        _state.last_escapes = []
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -333,6 +349,7 @@ def flush() -> None:
     sig = (tuple(sig_parts), tuple(escaping),
            tuple((tuple(np.shape(a)), str(a.dtype)) for a in ext_arrays),
            tuple((tuple(np.shape(a)), str(a.dtype)) for a in lifted_arrays))
+    st.last_escapes.append(len(escaping))
 
     jitted = st.compiled.get(sig)
     cache_fill = jitted is None
@@ -408,3 +425,10 @@ def last_segment_hlos() -> List[str]:
 
 def set_capture_hlo(flag: bool) -> None:
     _state.capture_hlo = bool(flag)
+
+
+def last_escape_counts() -> List[int]:
+    """Per-flush count of escaping (materialized) outputs in the most
+    recent segment_mode — the memory-assertion surface: inference under
+    ``no_grad`` must materialize only what the caller actually reads."""
+    return list(_state.last_escapes)

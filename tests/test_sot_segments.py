@@ -219,6 +219,44 @@ def test_staged_update_variants_match_eager():
                                        err_msg=which)
 
 
+def test_inference_under_no_grad_materializes_only_outputs():
+    """Memory assertion for segment-mode inference (round-4): with no tape
+    (no_grad, or frozen params), a flush's compiled program outputs ONLY
+    the values the caller still holds — intermediates are fused away by
+    XLA exactly like full-graph mode. With a tape, every intermediate
+    escapes (upstream-eager parity: the autograd graph pins activations
+    there too)."""
+    paddle.seed(51)
+    model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 32),
+                          nn.Tanh(), nn.Linear(32, 4))
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+
+    import contextlib
+
+    def run(no_grad):
+        ctx = paddle.no_grad() if no_grad else contextlib.nullcontext()
+        with lazy.segment_mode():
+            with ctx:
+                out = model(x).sum()
+            val = float(out)  # the single concrete read triggers the flush
+        return val, lazy.last_escape_counts()
+
+    v_ng, esc_ng = run(no_grad=True)
+    v_tr, esc_tr = run(no_grad=False)
+    np.testing.assert_allclose(v_ng, v_tr, rtol=1e-6)
+    # no tape: exactly ONE output (the read scalar) materializes
+    assert esc_ng == [1], esc_ng
+    # with a tape every intermediate is pinned (eager parity)
+    assert esc_tr[0] > 1, esc_tr
+
+    # frozen params (the loaded-model inference shape): also no tape
+    for p in model.parameters():
+        p.stop_gradient = True
+    v_fr, esc_fr = run(no_grad=False)
+    np.testing.assert_allclose(v_fr, v_tr, rtol=1e-6)
+    assert esc_fr == [1], esc_fr
+
+
 def test_full_graph_unbroken_fns_unaffected():
     """A fn that traces cleanly keeps the whole-graph path even with
     full_graph=False (segments are only the break fallback)."""
