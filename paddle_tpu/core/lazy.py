@@ -350,6 +350,8 @@ def flush() -> None:
            tuple((tuple(np.shape(a)), str(a.dtype)) for a in ext_arrays),
            tuple((tuple(np.shape(a)), str(a.dtype)) for a in lifted_arrays))
     st.last_escapes.append(len(escaping))
+    if len(st.last_escapes) > 64:  # debug surface, not a log: keep a window
+        del st.last_escapes[:-64]
 
     jitted = st.compiled.get(sig)
     cache_fill = jitted is None

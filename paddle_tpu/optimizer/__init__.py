@@ -364,6 +364,7 @@ class Optimizer:
     def _step_impl(self) -> None:
         """The update math proper (pure jnp over the state payloads; also
         traced by the recorded optimizer-step segment)."""
+        self._q8_serial_token = None  # per-trace ordering chain (q8 path)
         self._step_t._set_data(self._step_t._data + 1)
         base_lr = self._lr_value()
         for group in self._groups:
@@ -755,7 +756,10 @@ class Adam(Optimizer):
         if self._moment_q8:
             n = int(np.prod(p._data.shape)) if p._data.shape else 1
             nb = -(-n // _Q8_BLOCK)
-            for name in ("moment1", "moment2"):
+            # "moment2_sqrt": the second moment is stored in SQRT space
+            # (see _adam_q8_update) — the key name versions the format so
+            # a legacy linear-v checkpoint cannot silently bind to it
+            for name in ("moment1", "moment2_sqrt"):
                 self._acc(name, p, init=jnp.zeros((nb, _Q8_BLOCK), jnp.int8))
                 self._acc(name + "_scale", p,
                           init=jnp.ones((nb,), jnp.float32))
@@ -1033,9 +1037,38 @@ class Adam(Optimizer):
                     stop_gradient=True)
         return state
 
+    def _convert_legacy_q8_v(self) -> None:
+        """A round-3 int8 checkpoint stores moment2 as LINEAR-v int8; the
+        current format is sqrt-space under the versioned key moment2_sqrt.
+        Binding the old arrays directly would square-shrink v (~1000x too
+        large updates); convert linear -> sqrt per block on load instead."""
+        if not self._moment_q8:
+            return
+        store = self._accumulators.pop("moment2", None)
+        sstore = self._accumulators.pop("moment2_scale", None)
+        if not store:
+            return
+        import warnings
+        warnings.warn("converting legacy int8 moment2 (linear v) checkpoint "
+                      "state to the sqrt-space layout (moment2_sqrt)")
+        for pid, t in store.items():
+            if t._data.dtype != jnp.int8 or sstore is None:
+                continue
+            sc = sstore.get(pid)
+            if sc is None:
+                continue
+            v = jnp.maximum(t._data.astype(jnp.float32) * sc._data[:, None],
+                            0.0)
+            q, nsc = _q8_quantize(jnp.sqrt(v).reshape(-1))
+            self._accumulators.setdefault("moment2_sqrt", {})[pid] = t
+            t._set_data(q)
+            self._accumulators.setdefault("moment2_sqrt_scale", {})[pid] = sc
+            sc._set_data(nsc)
+
     def set_state_dict(self, state):
         if self._fused is None:
             super().set_state_dict(state)
+            self._convert_legacy_q8_v()
             return
         step = state.get("step", 0)
         if isinstance(step, Tensor):
@@ -1067,10 +1100,12 @@ class Adam(Optimizer):
 
     set_dict = set_state_dict
 
-    # fp32 transient budget per lax.map chunk of the int8 update (elements);
-    # a class attribute so tests can shrink it and exercise multi-chunk
-    # paths on small params
-    _Q8_CHUNK_ELEMS = 8 * 1024 * 1024
+    # fp32 transient budget per chunk of the int8 update (elements); a class
+    # attribute so tests can shrink it and exercise multi-chunk paths on
+    # small params. 2M measured best at the 2.07B single-chip ceiling: the
+    # XLA memory scheduler needs the headroom (4M chunks miss fitting by
+    # ~45MB there), and per-chunk traffic is already bandwidth-amortized.
+    _Q8_CHUNK_ELEMS = 2 * 1024 * 1024
 
     def _adam_q8_update(self, p, g, lr_eff, decoupled_wd=0.0):
         """Fully-chunked int8-moment Adam step.
@@ -1080,14 +1115,20 @@ class Adam(Optimizer):
         chains, but the per-block absmax REDUCTION forces the fp32 update
         to materialize) — measured to OOM a 2.07B single-chip run by
         ~0.5-0.9GB. Here the dequantize -> moment update -> requantize ->
-        param write pipeline runs per chunk under ``lax.map``: peak fp32
-        live set is O(_Q8_CHUNK_ELEMS), independent of parameter size, so
-        int8 moments actually deliver their 1 byte/element promise at the
-        single-chip memory ceiling."""
+        param write pipeline runs chunk-by-chunk IN PLACE: a fori_loop
+        carries the full m/v/scale/param buffers (XLA aliases the carry, so
+        dynamic-slice reads + dynamic-update-slice writes touch the
+        original storage) and each iteration's fp32 live set is
+        O(_Q8_CHUNK_ELEMS), independent of parameter size. No whole-array
+        pad/stack copies: an earlier lax.map-over-padded-groups draft added
+        ~3 full-tensor copies, which pushed a 2.07B step to the HBM ceiling
+        and collapsed throughput ~10x (measured: fwd+bwd 0.165s/step, the
+        copying optimizer tail +1.5s). A ragged tail (params not a multiple
+        of chunk x block) is processed as one separate static-shape chunk."""
         m = self._acc("moment1", p)
         ms = self._acc("moment1_scale", p)
-        v = self._acc("moment2", p)
-        vs = self._acc("moment2_scale", p)
+        v = self._acc("moment2_sqrt", p)
+        vs = self._acc("moment2_sqrt_scale", p)
         shape = p._data.shape
         n = int(np.prod(shape)) if shape else 1
         nb = int(m._data.shape[0])
@@ -1095,65 +1136,107 @@ class Adam(Optimizer):
         t = self._step_t._data.astype(jnp.float32)
         c1 = 1.0 - b1 ** t
         c2 = 1.0 - b2 ** t
-        blocks_per_chunk = max(1, int(self._Q8_CHUNK_ELEMS) // _Q8_BLOCK)
-        groups = -(-nb // blocks_per_chunk)
-        gb = -(-nb // groups)  # blocks per group
-        nb_pad = groups * gb
-        elems = gb * _Q8_BLOCK
-
-        def gpad(x, fill):
-            return jnp.pad(x, [(0, nb_pad - nb)] + [(0, 0)] * (x.ndim - 1),
-                           constant_values=fill)
-
-        m_q = gpad(m._data, 0).reshape(groups, gb, _Q8_BLOCK)
-        v_q = gpad(v._data, 0).reshape(groups, gb, _Q8_BLOCK)
-        ms_s = gpad(ms._data, 1.0).reshape(groups, gb)
-        vs_s = gpad(vs._data, 1.0).reshape(groups, gb)
-        gflat = jnp.pad(g.reshape(-1), (0, nb_pad * _Q8_BLOCK - n)) \
-            .reshape(groups, elems)
+        gb = max(1, min(nb, int(self._Q8_CHUNK_ELEMS) // _Q8_BLOCK))
+        full_blocks = n // _Q8_BLOCK          # blocks with no ragged tail
+        loops = full_blocks // gb             # uniform in-loop chunks
         master = self._ensure_master(p)
-        base = master._data if master is not None else p._data
-        bflat = jnp.pad(base.reshape(-1), (0, nb_pad * _Q8_BLOCK - n)) \
-            .reshape(groups, elems)
+        base = (master._data if master is not None else p._data).reshape(-1)
+        gview = g.reshape(-1)
+        # SERIALIZE updates across parameters: without an explicit ordering
+        # XLA overlaps every param's chunk pipeline, and the summed fp32
+        # transients of several giant scan-stacked params blow the HBM
+        # headroom the chunking just bought. optimization_barrier threads a
+        # token from the previous param's result into this one's input.
+        tok = getattr(self, "_q8_serial_token", None)
+        if tok is not None:
+            gview, _ = jax.lax.optimization_barrier((gview, tok))
         use_sr = (master is None and p._data.dtype == jnp.bfloat16
                   and self._stochastic_rounding)
         if use_sr:
             from ..core.random import default_generator
             key = default_generator.split_key()
 
-        def body(args):
-            mq, msq, vq, vsq, gg, bb, idx = args
+        def chunk_update(mq, msq, vq, vsq, gg, bb, kidx):
+            """(k, B) int8 moments + (k*B,) grad/base chunk -> updated.
+
+            The SECOND moment is stored in SQRT SPACE: linear absmax int8
+            of raw v zeroes every entry below absmax/127 — Adam divides by
+            sqrt(v), so a zeroed v turns into a lr*m/eps update and the
+            run EXPLODES (reproduced: 60-step MLP diverges to 1e18; this
+            is why bitsandbytes uses nonlinear quantization maps for v).
+            Quantizing sqrt(v) squares the representable dynamic range
+            (absmax ratio 1e-4 in v is 1e-2 in sqrt space -> survives) and
+            is free: the update needs sqrt(v) anyway."""
             g32 = gg.astype(jnp.float32)
             m32 = (mq.astype(jnp.float32) * msq[:, None]).reshape(-1)
-            v32 = (vq.astype(jnp.float32) * vsq[:, None]).reshape(-1)
+            sv = (vq.astype(jnp.float32) * vsq[:, None]).reshape(-1)
+            v32 = sv * sv
             nm = b1 * m32 + (1 - b1) * g32
             nv = b2 * v32 + (1 - b2) * g32 * g32
-            # requantize per block (absmax now reduces over a chunk only;
             # ONE quantization rule shared with the whole-tensor path —
-            # nm/nv are exact block multiples, so _q8_quantize pads nothing)
+            # nm/nv are exact block multiples, so _q8_quantize pads nothing
             qm, msc = _q8_quantize(nm)
-            qv, vsc = _q8_quantize(nv)
+            qv, vsc = _q8_quantize(jnp.sqrt(nv))
             upd = bb.astype(jnp.float32)
             if decoupled_wd:
                 upd = upd * (1.0 - lr_eff * decoupled_wd)
             upd = upd - lr_eff * (nm / c1) / (jnp.sqrt(nv / c2) +
                                               self._epsilon)
             if use_sr:
-                nb_out = _stochastic_round_bf16(
-                    upd, jax.random.fold_in(key, idx))
+                new_b = _stochastic_round_bf16(
+                    upd, jax.random.fold_in(key, kidx))
             else:
-                nb_out = upd.astype(base.dtype)
-            return qm, msc.astype(jnp.float32), qv, vsc.astype(jnp.float32), \
-                nb_out
+                new_b = upd.astype(base.dtype)
+            return qm, msc, qv, vsc, new_b
 
-        qm, qms, qv, qvs, new_base = jax.lax.map(
-            body, (m_q, ms_s, v_q, vs_s, gflat, bflat,
-                   jnp.arange(groups, dtype=jnp.uint32)))
-        m._set_data(qm.reshape(nb_pad, _Q8_BLOCK)[:nb])
-        ms._set_data(qms.reshape(nb_pad)[:nb])
-        v._set_data(qv.reshape(nb_pad, _Q8_BLOCK)[:nb])
-        vs._set_data(qvs.reshape(nb_pad)[:nb])
-        new_flat = new_base.reshape(-1)[:n].reshape(shape)
+        def body(i, carry):
+            mb, msb, vb, vsb, bb = carry
+            blk = i * gb
+            off = blk * _Q8_BLOCK
+            s2 = lambda a: jax.lax.dynamic_slice_in_dim(a, blk, gb, 0)
+            s1 = lambda a: jax.lax.dynamic_slice_in_dim(a, off,
+                                                        gb * _Q8_BLOCK, 0)
+            qm, msc, qv, vsc, new_b = chunk_update(
+                s2(mb), s2(msb), s2(vb), s2(vsb), s1(gview), s1(bb), i)
+            u2 = jax.lax.dynamic_update_slice_in_dim
+            return (u2(mb, qm, blk, 0), u2(msb, msc, blk, 0),
+                    u2(vb, qv, blk, 0), u2(vsb, vsc, blk, 0),
+                    u2(bb, new_b, off, 0))
+
+        carry0 = (m._data, ms._data, v._data, vs._data, base)
+        if loops > 0:  # fori_loop traces the body even for a 0-trip loop
+            mb, msb, vb, vsb, newb = jax.lax.fori_loop(0, loops, body, carry0)
+        else:
+            mb, msb, vb, vsb, newb = carry0
+
+        # ragged tail: remaining blocks (incl. the partial last block) as one
+        # static-shape chunk — only the SMALL tail slices get padded
+        tail_blocks = nb - loops * gb
+        if tail_blocks > 0:
+            blk = loops * gb
+            off = blk * _Q8_BLOCK
+            tail_n = n - off
+            pad = tail_blocks * _Q8_BLOCK - tail_n
+            gg = jnp.pad(jax.lax.dynamic_slice_in_dim(gview, off, tail_n, 0),
+                         (0, pad))
+            bb_t = jnp.pad(jax.lax.dynamic_slice_in_dim(newb, off, tail_n, 0),
+                           (0, pad))
+            qm, msc, qv, vsc, new_b = chunk_update(
+                mb[blk:], msb[blk:], vb[blk:], vsb[blk:], gg, bb_t,
+                jnp.uint32(loops))
+            mb = mb.at[blk:].set(qm)
+            msb = msb.at[blk:].set(msc)
+            vb = vb.at[blk:].set(qv)
+            vsb = vsb.at[blk:].set(vsc)
+            newb = jax.lax.dynamic_update_slice_in_dim(
+                newb, new_b[:tail_n], off, 0)
+
+        m._set_data(mb)
+        ms._set_data(msb)
+        v._set_data(vb)
+        vs._set_data(vsb)
+        self._q8_serial_token = msb[0]  # next param's update orders after us
+        new_flat = newb.reshape(shape)
         if master is not None:
             master._set_data(new_flat)
             p._set_data(new_flat.astype(p._data.dtype))

@@ -258,3 +258,36 @@ def test_q8_chunked_update_matches_single_chunk():
     w_single, m_single = run(8 * 1024 * 1024)  # everything in one chunk
     np.testing.assert_allclose(w_multi, w_single, rtol=0, atol=0)
     np.testing.assert_array_equal(m_multi, m_single)
+
+
+def test_q8_legacy_linear_v_checkpoint_converts_on_load():
+    """Round-3 int8 checkpoints stored moment2 as LINEAR v; the current
+    layout stores sqrt(v) under the versioned key moment2_sqrt. Loading a
+    legacy dict must convert (binding raw would shrink v ~1000x)."""
+    paddle.seed(13)
+    model = nn.Linear(64, 32)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters(),
+                                 moment_dtype="int8",
+                                 stochastic_rounding=False)
+    p = model.weight
+    n = p.size
+    nb = -(-n // 2048)
+    rng = np.random.default_rng(0)
+    v_true = (rng.uniform(0.001, 1.0, (nb * 2048,)) ** 2).astype(np.float32)
+    blocks = v_true.reshape(nb, 2048)
+    scale = np.abs(blocks).max(1) / 127.0
+    q_lin = np.clip(np.round(blocks / scale[:, None]), -127, 127) \
+        .astype(np.int8)
+    legacy = {
+        "step": 3,
+        f"{p.name}_moment2": paddle.to_tensor(q_lin),
+        f"{p.name}_moment2_scale": paddle.to_tensor(scale.astype(np.float32)),
+    }
+    with pytest.warns(UserWarning, match="sqrt-space"):
+        opt.set_state_dict(legacy)
+    assert "moment2" not in opt._accumulators
+    q = opt._accumulators["moment2_sqrt"][id(p)]._data
+    s = opt._accumulators["moment2_sqrt_scale"][id(p)]._data
+    got_v = (np.asarray(q, np.float32) * np.asarray(s)[:, None]) ** 2
+    # reconstruction error bounded by double quantization, relative scale
+    np.testing.assert_allclose(got_v.reshape(-1), v_true, atol=2e-2)
