@@ -208,31 +208,37 @@ class FusedEcMoe(nn.Layer):
                                         attr=bias_attr, is_bias=True)
 
     def forward(self, x, gate):
-        act = self.act_type
+        return _ec_moe_apply(x, gate, self.w0, self.b0, self.w1, self.b1,
+                             self.act_type)
 
-        def f(xv, gv, w0, b0, w1, b1):
-            B, S, H = xv.shape
-            tokens = xv.reshape(B * S, H)
-            probs = jax.nn.softmax(gv.reshape(B * S, -1), axis=-1)
-            T = tokens.shape[0]
-            E = w0.shape[0]
-            capacity = max(T // E, 1)
-            # expert choice: each expert takes its top-`capacity` tokens
-            gate_t = probs.T                            # (E, T)
-            weight, sel = jax.lax.top_k(gate_t, capacity)  # (E, C)
-            picked = tokens[sel]                        # (E, C, H)
-            h = jnp.einsum("ech,ehi->eci", picked, w0) + b0
-            h = jax.nn.gelu(h) if act == "gelu" else jnp.maximum(h, 0)
-            out_e = jnp.einsum("eci,eih->ech", h, w1) + b1  # (E, C, H)
-            out_e = out_e * weight[..., None]
-            # scatter-add expert outputs back to token positions
-            flat_out = jnp.zeros((T, H), xv.dtype)
-            flat_out = flat_out.at[sel.reshape(-1)].add(
-                out_e.reshape(-1, H))
-            return flat_out.reshape(B, S, H)
 
-        return apply("fused_ec_moe", f, x, gate,
-                     self.w0, self.b0, self.w1, self.b1)
+def _ec_moe_apply(x, gate, w0_t, b0_t, w1_t, b1_t, act):
+    """Shared expert-choice MoE math (the FusedEcMoe layer AND the
+    paddle.incubate.nn.functional.fused_ec_moe functional both call this —
+    one implementation, two upstream surfaces)."""
+
+    def f(xv, gv, w0, b0, w1, b1):
+        B, S, H = xv.shape
+        tokens = xv.reshape(B * S, H)
+        probs = jax.nn.softmax(gv.reshape(B * S, -1), axis=-1)
+        T = tokens.shape[0]
+        E = w0.shape[0]
+        capacity = max(T // E, 1)
+        # expert choice: each expert takes its top-`capacity` tokens
+        gate_t = probs.T                            # (E, T)
+        weight, sel = jax.lax.top_k(gate_t, capacity)  # (E, C)
+        picked = tokens[sel]                        # (E, C, H)
+        h = jnp.einsum("ech,ehi->eci", picked, w0) + b0
+        h = jax.nn.gelu(h) if act == "gelu" else jnp.maximum(h, 0)
+        out_e = jnp.einsum("eci,eih->ech", h, w1) + b1  # (E, C, H)
+        out_e = out_e * weight[..., None]
+        # scatter-add expert outputs back to token positions
+        flat_out = jnp.zeros((T, H), xv.dtype)
+        flat_out = flat_out.at[sel.reshape(-1)].add(
+            out_e.reshape(-1, H))
+        return flat_out.reshape(B, S, H)
+
+    return apply("fused_ec_moe", f, x, gate, w0_t, b0_t, w1_t, b1_t)
 
 
 __all__ += ["FusedDropoutAdd", "FusedEcMoe"]

@@ -450,3 +450,19 @@ def identity_loss(x, reduction="none", name=None):
         return a
 
     return apply("identity_loss", f, x)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Functional expert-choice MoE (upstream
+    paddle.incubate.nn.functional.fused_ec_moe — the op behind the
+    FusedEcMoe layer): weights (E, H, I)/(E, 1, I)/(E, I, H)/(E, 1, H),
+    gate LOGITS (B, S, E). Same einsum-over-experts lowering as the layer;
+    see incubate/nn.py FusedEcMoe for the capacity policy."""
+    from .nn import _ec_moe_apply
+    if act_type not in ("gelu", "relu"):
+        raise ValueError("act_type must be gelu or relu")
+    return _ec_moe_apply(ensure_tensor(x), ensure_tensor(gate),
+                         ensure_tensor(bmm0_weight), ensure_tensor(bmm0_bias),
+                         ensure_tensor(bmm1_weight), ensure_tensor(bmm1_bias),
+                         act_type)

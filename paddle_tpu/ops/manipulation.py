@@ -435,3 +435,28 @@ def as_complex(x, name=None):
 
 register_op("as_real", as_real, methods=("as_real",))
 register_op("as_complex", as_complex, methods=("as_complex",))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop (reference: paddle.crop): take a ``shape``-sized box
+    starting at ``offsets`` (default 0s). -1 in shape keeps the rest of
+    that dim."""
+    x = ensure_tensor(x)
+    nd = x._data.ndim
+    full = x._data.shape
+
+    def _as_list(v, fill):
+        if v is None:
+            return [fill] * nd
+        if isinstance(v, Tensor):
+            v = [int(i) for i in np.asarray(v._data)]
+        return [int(i._data) if isinstance(i, Tensor) else int(i) for i in v]
+
+    offs = _as_list(offsets, 0)
+    shp = _as_list(shape, -1)
+    shp = [full[i] - offs[i] if s == -1 else s for i, s in enumerate(shp)]
+    slices = tuple(_py_slice(o, o + s) for o, s in zip(offs, shp))
+    return apply("crop", lambda a: a[slices], x)
+
+
+register_op("crop", crop, methods=("crop",))

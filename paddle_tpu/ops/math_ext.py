@@ -328,6 +328,15 @@ def log_normal_(x, mean=1.0, std=2.0, name=None):
         x, lambda k: jnp.exp(mean + std * jax.random.normal(k, shape)))
 
 
+def bernoulli_(x, p=0.5, name=None):
+    """Fill with Bernoulli(p) samples (reference: paddle.bernoulli_ /
+    Tensor.bernoulli_; p may be a float or a broadcastable tensor)."""
+    shape = tuple(x._data.shape)
+    pv = p._data if isinstance(p, Tensor) else p
+    return _inplace_random(
+        x, lambda k: jax.random.bernoulli(k, pv, shape).astype(jnp.float32))
+
+
 def index_fill(x, index, axis, value, name=None):
     """Fill the rows selected by ``index`` along ``axis`` with ``value``."""
     x, index = ensure_tensor(x), ensure_tensor(index)
@@ -413,6 +422,12 @@ register_tensor_method("cauchy_", cauchy_)
 register_tensor_method("geometric_", geometric_)
 register_tensor_method("exponential_", exponential_)
 register_tensor_method("log_normal_", log_normal_)
+register_tensor_method("bernoulli_", bernoulli_)
+register_op("bernoulli_", bernoulli_)
+# top-level paddle.normal_ reuses the ONE in-place fill implementation
+# (ops/creation.py normal_, already the Tensor.normal_ method)
+from .creation import normal_ as _creation_normal_  # noqa: E402
+register_op("normal_", _creation_normal_)
 register_tensor_method("apply", _tensor_apply)
 register_tensor_method("apply_", _tensor_apply_)
 register_tensor_method("to_sparse_coo", _to_sparse_coo)

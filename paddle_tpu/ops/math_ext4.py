@@ -346,3 +346,43 @@ def is_tensor(x):
 
 
 register_op("is_tensor", is_tensor)
+
+
+def msort(x, name=None):
+    """Sort along the FIRST axis (reference: paddle.msort == sort(x, 0))."""
+    return apply("msort", lambda a: jnp.sort(a, axis=0), ensure_tensor(x))
+
+
+def float_power(x, y, name=None):
+    """Element-wise x**y computed in the widest float (reference promotes
+    to float64; TPU compute clamps to fp32 — MIGRATING.md divergence #7)."""
+    x = ensure_tensor(x)
+    if isinstance(y, Tensor):
+        return apply("float_power",
+                     lambda a, b: jnp.power(a.astype(jnp.float32),
+                                            b.astype(jnp.float32)), x, y)
+    return apply("float_power",
+                 lambda a: jnp.power(a.astype(jnp.float32), float(y)), x)
+
+
+def binomial(count, prob, name=None):
+    """Draw Binomial(count, prob) samples (reference: paddle.binomial;
+    int64 output, per-element n/p broadcasting)."""
+    from ..core.random import default_generator
+    count = ensure_tensor(count)
+    prob = ensure_tensor(prob)
+    key = default_generator.split_key()
+
+    def f(n, p):
+        out = jax.random.binomial(key, n.astype(jnp.float32),
+                                  p.astype(jnp.float32))
+        # reference returns int64; x64-off canonicalizes to int32 (same
+        # policy as every integer-output op here)
+        return out.astype(jnp.int32)
+
+    return apply("binomial", f, count, prob, differentiable=False)
+
+
+register_op("msort", msort, methods=("msort",))
+register_op("float_power", float_power, methods=("float_power",))
+register_op("binomial", binomial)

@@ -554,4 +554,28 @@ isnan = _unary("isnan", jnp.isnan)
 rad2deg = _unary("rad2deg", jnp.rad2deg)
 deg2rad = _unary("deg2rad", jnp.deg2rad)
 
-__all__ += ["mv", "addmm", "slice", "isnan", "rad2deg", "deg2rad"]
+def mask_as(x, mask, name=None):
+    """Take dense ``x``'s entries at ``mask``'s sparsity pattern (parity:
+    paddle.sparse.mask_as) — returns a sparse tensor with mask's layout
+    and x's values."""
+    from ..ops._helpers import ensure_tensor
+    x = ensure_tensor(x)
+    mshape = getattr(mask, "_shape", None)
+    if mshape is not None and tuple(x._data.shape) != tuple(mshape):
+        # jax gathers CLAMP out-of-range indices — a shape mismatch would
+        # silently duplicate edge values instead of erroring (reference
+        # raises on mismatched shapes)
+        raise ValueError(f"mask_as shape mismatch: x {tuple(x._data.shape)} "
+                         f"vs mask {tuple(mshape)}")
+    if isinstance(mask, SparseCsrTensor):
+        rows, cols = mask._rows(), mask._cols
+        vals = apply("sparse_mask_as", lambda a: a[rows, cols], x)
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    if isinstance(mask, SparseCooTensor):
+        idx = mask._indices
+        vals = apply("sparse_mask_as", lambda a: a[tuple(idx)], x)
+        return SparseCooTensor(idx, vals, mask._shape, mask._coalesced)
+    raise ValueError("mask must be a sparse COO/CSR tensor")
+
+
+__all__ += ["mv", "addmm", "slice", "isnan", "rad2deg", "deg2rad", "mask_as"]

@@ -1,0 +1,217 @@
+"""paddle.sparse.nn.functional parity surface.
+
+Reference: python/paddle/sparse/nn/functional/ (activation.py, conv.py,
+pooling.py, transformer.py). TPU-native lowering mirrors the layer
+classes: scatter-to-dense -> XLA conv/reduce_window -> re-sparsify for
+full convs/pooling, gather-at-sites for submanifold convs, and
+segment-softmax SDDMM/SpMM for the CSR-masked attention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from . import SparseCooTensor, SparseCsrTensor, _unary
+
+__all__ = ["relu", "relu6", "leaky_relu", "softmax", "conv3d", "subm_conv3d",
+           "conv2d", "subm_conv2d", "max_pool3d", "attention"]
+
+
+def relu(x, name=None):
+    return _unary("relu", lambda v: jnp.maximum(v, 0.0))(x)
+
+
+def relu6(x, name=None):
+    return _unary("relu6", lambda v: jnp.clip(v, 0.0, 6.0))(x)
+
+
+def leaky_relu(x, negative_slope: float = 0.01, name=None):
+    return _unary("leaky_relu",
+                  lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def softmax(x, axis: int = -1, name=None):
+    from .nn import Softmax
+    return Softmax(axis)(x)
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, subm,
+             data_format):
+    """Shared functional sparse conv (see nn._SparseConvND for the layout
+    contract: COO indices over [N, *spatial], dense channel values;
+    weight [*k, C/groups, M])."""
+    fmt = "NDHWC" if nd == 3 else "NHWC"
+    if data_format not in (None, fmt):
+        raise ValueError(f"sparse conv{nd}d expects {fmt}")
+    dimnums = (fmt, ("DHWIO" if nd == 3 else "HWIO"), fmt)
+    stride = (stride,) * nd if isinstance(stride, int) else tuple(stride)
+    padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation,) * nd if isinstance(dilation, int) \
+        else tuple(dilation)
+    if x.sparse_dim != nd + 1 or x.dense_dim != 1:
+        raise ValueError(
+            f"sparse conv{nd}d expects COO with indices over [N, *spatial] "
+            "and dense channel values")
+    weight = weight if isinstance(weight, Tensor) else Tensor(jnp.asarray(weight))
+    if bias is not None and not isinstance(bias, Tensor):
+        bias = Tensor(jnp.asarray(bias))
+    out_channels = int(weight._data.shape[-1])
+    idx = x._indices
+    shape = x._shape
+
+    def fn(v, w, *maybe_b):
+        dense = jnp.zeros(shape, v.dtype).at[tuple(idx)].add(v)
+        out = jax.lax.conv_general_dilated(
+            dense, w, window_strides=stride,
+            padding=[(p, p) for p in padding],
+            rhs_dilation=dilation, dimension_numbers=dimnums,
+            feature_group_count=groups)
+        if subm:
+            out = out[tuple(idx)]
+            if maybe_b:
+                out = out + maybe_b[0]
+        return out
+
+    args = [x._values, weight] + ([bias] if (bias is not None and subm) else [])
+    out = apply(f"{'subm_' if subm else ''}sparse_conv{nd}d_fn", fn, *args)
+    if subm:
+        return SparseCooTensor(idx, out, shape[:nd + 1] + (out_channels,),
+                               x._coalesced)
+    from .nn import _dense_to_coo
+    return _dense_to_coo(out, bias)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    False, data_format)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    True, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    False, data_format)
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    True, data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse max pooling over ACTIVE sites only (reference
+    python/paddle/sparse/nn/functional/pooling.py): an output site is
+    active iff its window contains an active input; inactive positions
+    never contribute (lowered with a -inf background). ``ceil_mode``
+    extends hi-side padding so the trailing partial window emits. Output
+    nnz is data-dependent, so this runs eagerly (MIGRATING.md #2)."""
+    nd = 3
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d expects NDHWC")
+    if x.sparse_dim != nd + 1 or x.dense_dim != 1:
+        raise ValueError("sparse max_pool3d expects COO [N, D, H, W] + "
+                         "channel values")
+    k = (kernel_size,) * nd if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    s = k if stride is None else (
+        (stride,) * nd if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * nd if isinstance(padding, int) else tuple(padding)
+    idx = np.asarray(x._indices)
+    shape = x._shape
+    vals = np.asarray(x._values._data, np.float32)
+    dense = np.full(shape, -np.inf, np.float32)
+    dense[tuple(idx)] = vals
+    window = (1,) + k + (1,)
+    strides = (1,) + s + (1,)
+    pad_cfg = [(0, 0)] + [(pp, pp) for pp in p] + [(0, 0)]
+    if ceil_mode:
+        # emit the trailing partial window (reference ceil rule: the extra
+        # window must still START inside input+pad_lo) — extend hi padding;
+        # -inf background keeps the extension out of every max
+        for j in range(nd):
+            length = shape[1 + j]
+            eff = length + 2 * p[j] - k[j]
+            if eff % s[j] != 0:
+                out_ceil = -(-eff // s[j]) + 1
+                if (out_ceil - 1) * s[j] >= length + p[j]:
+                    continue
+                hi_extra = (out_ceil - 1) * s[j] + k[j] - (length + 2 * p[j])
+                lo, hi = pad_cfg[1 + j]
+                pad_cfg[1 + j] = (lo, hi + hi_extra)
+    pooled = jax.lax.reduce_window(jnp.asarray(dense), -jnp.inf, jax.lax.max,
+                                   window, strides, pad_cfg)
+    pooled = np.asarray(pooled)
+    active = np.isfinite(pooled).any(axis=-1)
+    out_idx = np.stack(np.nonzero(active))
+    out_vals = pooled[tuple(out_idx)]
+    out_vals[~np.isfinite(out_vals)] = 0.0  # channels with no active input
+    return SparseCooTensor(out_idx, out_vals,
+                           tuple(active.shape) + (shape[-1],), True)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """CSR-masked scaled-dot-product attention (reference
+    python/paddle/sparse/nn/functional/transformer.py attention):
+    q/k/v (B, H, L, D) dense; ``sparse_mask`` a 2-D SparseCsrTensor
+    (L, L) giving the attention LAYOUT shared by every batch*head (the
+    reference takes (B*H, L, L); pass the shared per-head pattern here —
+    the TPU lowering keeps one static pattern for the whole batch).
+    Scores are computed ONLY at stored positions (SDDMM), softmaxed per
+    row over stored entries, then SpMM'd with V. key_padding_mask (B, L)
+    and attn_mask (L, L) follow the reference: masked positions drop out
+    of the normalization (additive -inf)."""
+    if not isinstance(sparse_mask, SparseCsrTensor):
+        raise ValueError("sparse_mask must be a 2-D SparseCsrTensor")
+    q = query if isinstance(query, Tensor) else Tensor(jnp.asarray(query))
+    k = key if isinstance(key, Tensor) else Tensor(jnp.asarray(key))
+    v = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value))
+    b, h, L, d = q._data.shape
+    rows = sparse_mask._rows()
+    cols = sparse_mask._cols
+    m = sparse_mask._shape[0]
+    kp = None if key_padding_mask is None else (
+        key_padding_mask._data if isinstance(key_padding_mask, Tensor)
+        else jnp.asarray(key_padding_mask))
+    am = None if attn_mask is None else (
+        attn_mask._data if isinstance(attn_mask, Tensor)
+        else jnp.asarray(attn_mask))
+
+    def fn(qa, ka, va):
+        qf = qa.reshape(b * h, L, d).astype(jnp.float32)
+        kf = ka.reshape(b * h, L, d).astype(jnp.float32)
+        vf = va.reshape(b * h, L, d).astype(jnp.float32)
+        scale = 1.0 / np.sqrt(d)
+
+        def one(args):
+            qi, ki, vi, bi = args
+            s = jnp.sum(qi[rows] * ki[cols], axis=-1) * scale  # SDDMM
+            if am is not None:
+                s = s + am[rows, cols]
+            if kp is not None:
+                s = s + kp[bi][cols]
+            smax = jax.ops.segment_max(s, rows, num_segments=m)
+            e = jnp.exp(s - smax[rows])
+            denom = jax.ops.segment_sum(e, rows, num_segments=m)
+            p = e / jnp.maximum(denom[rows], 1e-30)
+            out = jax.ops.segment_sum(p[:, None] * vi[cols], rows,
+                                      num_segments=m)  # SpMM
+            return out
+
+        bh_batch = jnp.repeat(jnp.arange(b), h)
+        out = jax.lax.map(one, (qf, kf, vf, bh_batch))
+        return out.reshape(b, h, L, d).astype(qa.dtype)
+
+    args = [q, k, v]
+    return apply("sparse_attention", fn, *args)

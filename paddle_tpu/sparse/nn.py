@@ -271,3 +271,29 @@ class SubmConv2D(_SparseConv2D):
 
 
 __all__ += ["LeakyReLU", "ReLU6", "Conv2D", "SubmConv2D"]
+
+
+class MaxPool3D(Layer):
+    """Parity: paddle.sparse.nn.MaxPool3D (active-site max pooling)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        from . import functional as F
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            ceil_mode=self.ceil_mode,
+                            data_format=self.data_format)
+
+
+# paddle.sparse.nn.functional lives beside the layers (upstream package
+# layout); imported last — it reuses the layer internals above
+from . import functional  # noqa: E402,F401
+
+__all__ += ["MaxPool3D", "functional"]

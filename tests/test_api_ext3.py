@@ -748,3 +748,55 @@ class TestFunctionalTail:
         got = F.gather_tree(paddle.to_tensor(ids),
                             paddle.to_tensor(parents)).numpy()
         np.testing.assert_array_equal(got, ref)
+
+
+class TestRound4TailOps:
+    """Round-4 API-tail wave: msort, float_power, binomial, crop,
+    bernoulli_/normal_ in-place fills (reference python/paddle/tensor/)."""
+
+    def test_msort(self):
+        x = np.random.default_rng(0).normal(0, 1, (5, 4)).astype(np.float32)
+        np.testing.assert_allclose(paddle.msort(paddle.to_tensor(x)).numpy(),
+                                   np.sort(x, axis=0))
+
+    def test_float_power(self):
+        x = np.random.default_rng(1).uniform(0.5, 3, (8,)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.float_power(paddle.to_tensor(x), 2.5).numpy(),
+            np.float_power(x, 2.5).astype(np.float32), rtol=1e-5)
+        y = np.full((8,), 1.5, np.float32)
+        np.testing.assert_allclose(
+            paddle.float_power(paddle.to_tensor(x),
+                               paddle.to_tensor(y)).numpy(),
+            np.float_power(x, y).astype(np.float32), rtol=1e-5)
+
+    def test_crop(self):
+        x = np.arange(60, dtype=np.float32).reshape(3, 4, 5)
+        got = paddle.crop(paddle.to_tensor(x), shape=[2, 2, 3],
+                          offsets=[1, 1, 2]).numpy()
+        np.testing.assert_allclose(got, x[1:3, 1:3, 2:5])
+        got = paddle.crop(paddle.to_tensor(x), shape=[-1, 2, -1]).numpy()
+        np.testing.assert_allclose(got, x[:, :2, :])
+
+    def test_binomial_moments(self):
+        paddle.seed(0)
+        n = paddle.to_tensor(np.full((4000,), 20.0, np.float32))
+        p = paddle.to_tensor(np.full((4000,), 0.3, np.float32))
+        s = paddle.binomial(n, p).numpy()
+        assert np.issubdtype(s.dtype, np.integer)
+        assert s.min() >= 0 and s.max() <= 20
+        assert abs(s.mean() - 6.0) < 0.3          # n*p
+        assert abs(s.var() - 4.2) < 0.6           # n*p*(1-p)
+
+    def test_inplace_random_fills(self):
+        paddle.seed(1)
+        t = paddle.to_tensor(np.zeros((6000,), np.float32))
+        out = t.bernoulli_(0.25)
+        assert out is t
+        vals = t.numpy()
+        assert set(np.unique(vals)).issubset({0.0, 1.0})
+        assert 0.22 < vals.mean() < 0.28
+        t2 = paddle.to_tensor(np.zeros((6000,), np.float32))
+        paddle.normal_(t2, mean=2.0, std=0.5)
+        assert abs(t2.numpy().mean() - 2.0) < 0.05
+        assert abs(t2.numpy().std() - 0.5) < 0.05

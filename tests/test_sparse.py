@@ -280,3 +280,86 @@ def test_sparse_activations():
     np.testing.assert_allclose(lr.values().numpy(), [-0.2, 8.0], rtol=1e-6)
     r6 = sparse.nn.ReLU6()(coo)
     np.testing.assert_allclose(r6.values().numpy(), [0.0, 6.0])
+
+
+class TestSparseFunctional:
+    """paddle.sparse.nn.functional (round-4): conv/pool/attention
+    functionals vs dense references."""
+
+    def _voxels(self, rng, shape=(1, 6, 6, 6, 4), n=30):
+        pts = np.unique(
+            rng.integers(0, shape[1], (n, 3)), axis=0)
+        idx = np.concatenate(
+            [np.zeros((pts.shape[0], 1), np.int64), pts], axis=1).T
+        vals = rng.normal(0, 1, (idx.shape[1], shape[-1])).astype(np.float32)
+        return paddle.sparse.sparse_coo_tensor(idx, vals, shape), idx, vals
+
+    def test_functional_activations(self):
+        rng = np.random.default_rng(0)
+        x, idx, vals = self._voxels(rng)
+        F = paddle.sparse.nn.functional
+        np.testing.assert_allclose(
+            F.relu(x).values().numpy(), np.maximum(vals, 0))
+        np.testing.assert_allclose(
+            F.relu6(x).values().numpy(), np.clip(vals, 0, 6))
+        np.testing.assert_allclose(
+            F.leaky_relu(x, 0.1).values().numpy(),
+            np.where(vals >= 0, vals, 0.1 * vals), rtol=1e-6)
+
+    def test_functional_subm_conv3d_matches_layer(self):
+        rng = np.random.default_rng(1)
+        x, idx, vals = self._voxels(rng)
+        F = paddle.sparse.nn.functional
+        paddle.seed(3)
+        layer = paddle.sparse.nn.SubmConv3D(4, 8, kernel_size=3, padding=1)
+        want = layer(x)
+        got = F.subm_conv3d(x, layer.weight, layer.bias, padding=1)
+        np.testing.assert_allclose(got.values().numpy(),
+                                   want.values().numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        assert got.shape == want.shape
+
+    def test_functional_max_pool3d(self):
+        rng = np.random.default_rng(2)
+        x, idx, vals = self._voxels(rng)
+        F = paddle.sparse.nn.functional
+        out = F.max_pool3d(x, kernel_size=2, stride=2)
+        # dense reference over active sites (-inf background)
+        dense = np.full((1, 6, 6, 6, 4), -np.inf, np.float32)
+        dense[tuple(idx)] = vals
+        ref = dense.reshape(1, 3, 2, 3, 2, 3, 2, 4).max((2, 4, 6))
+        got = out.to_dense().numpy()
+        active = np.isfinite(ref).any(-1)
+        ref_vals = np.where(np.isfinite(ref), ref, 0.0)
+        np.testing.assert_allclose(got[active], ref_vals[active], rtol=1e-6)
+        assert np.allclose(got[~active], 0.0)
+        # the layer form agrees
+        got2 = paddle.sparse.nn.MaxPool3D(2, 2)(x).to_dense().numpy()
+        np.testing.assert_allclose(got2, got)
+
+    def test_csr_masked_attention_matches_dense(self):
+        rng = np.random.default_rng(3)
+        B, H, L, D = 2, 2, 8, 4
+        q = rng.normal(0, 1, (B, H, L, D)).astype(np.float32)
+        k = rng.normal(0, 1, (B, H, L, D)).astype(np.float32)
+        v = rng.normal(0, 1, (B, H, L, D)).astype(np.float32)
+        # banded causal-ish layout as the CSR pattern
+        mask = np.tril(np.ones((L, L), bool)) & \
+            ~np.tril(np.ones((L, L), bool), -4)
+        crows = np.concatenate([[0], np.cumsum(mask.sum(1))]).astype(np.int32)
+        cols = np.concatenate([np.nonzero(mask[i])[0] for i in range(L)]) \
+            .astype(np.int32)
+        sm = paddle.sparse.sparse_csr_tensor(
+            crows, cols, np.ones(cols.shape[0], np.float32), (L, L))
+        kp = np.zeros((B, L), np.float32)
+        kp[:, -2:] = -1e30  # pad out the last two keys
+        out = paddle.sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            sm, key_padding_mask=paddle.to_tensor(kp)).numpy()
+        # dense reference
+        s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        s = np.where(mask, s, -np.inf) + kp[:, None, None, :]
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=1e-5)
