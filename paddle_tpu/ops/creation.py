@@ -238,11 +238,11 @@ def randn_like(x, name=None):
     return standard_normal(tuple(x._data.shape), x.dtype)
 
 
-def normal_(tensor, mean=0.0, std=1.0):
-    arr = jax.random.normal(_key(), tuple(tensor._data.shape),
-                            dtype=tensor._data.dtype) * std + mean
-    tensor._set_data(arr)
-    return tensor
+def normal_(x, mean=0.0, std=1.0, name=None):
+    arr = jax.random.normal(_key(), tuple(x._data.shape),
+                            dtype=x._data.dtype) * std + mean
+    x._set_data(arr)
+    return x
 
 
 def uniform_(tensor, min=-1.0, max=1.0, seed=0, name=None):

@@ -455,6 +455,13 @@ def crop(x, shape=None, offsets=None, name=None):
     offs = _as_list(offsets, 0)
     shp = _as_list(shape, -1)
     shp = [full[i] - offs[i] if s == -1 else s for i, s in enumerate(shp)]
+    for i, (o, s) in enumerate(zip(offs, shp)):
+        if o < 0 or s < 0 or o + s > full[i]:
+            # python slicing would CLAMP and silently return a smaller
+            # tensor; the reference validates and raises
+            raise ValueError(
+                f"crop out of bounds on dim {i}: offset {o} + shape {s} > "
+                f"input dim {full[i]}")
     slices = tuple(_py_slice(o, o + s) for o, s in zip(offs, shp))
     return apply("crop", lambda a: a[slices], x)
 

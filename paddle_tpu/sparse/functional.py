@@ -51,6 +51,20 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd, subm,
     padding = (padding,) * nd if isinstance(padding, int) else tuple(padding)
     dilation = (dilation,) * nd if isinstance(dilation, int) \
         else tuple(dilation)
+    if subm:
+        # submanifold gathers the output at INPUT coordinates, so the conv
+        # must be size-preserving; silently accepting other configs would
+        # gather clamped/shifted edge values (jax clamps OOB indices)
+        if stride != (1,) * nd:
+            raise ValueError("submanifold sparse conv requires stride 1")
+        w_k = tuple(int(s) for s in np.shape(
+            weight._data if isinstance(weight, Tensor) else weight)[:nd])
+        for p, d, kk in zip(padding, dilation, w_k):
+            if 2 * p != d * (kk - 1):
+                raise ValueError(
+                    "submanifold sparse conv requires size-preserving "
+                    "padding (2*padding == dilation*(kernel-1)); got "
+                    f"padding={padding}, dilation={dilation}, kernel={w_k}")
     if x.sparse_dim != nd + 1 or x.dense_dim != 1:
         raise ValueError(
             f"sparse conv{nd}d expects COO with indices over [N, *spatial] "
@@ -210,7 +224,9 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
             return out
 
         bh_batch = jnp.repeat(jnp.arange(b), h)
-        out = jax.lax.map(one, (qf, kf, vf, bh_batch))
+        # vmap, not lax.map: all B*H heads share one pattern — run them as
+        # one batched SDDMM/softmax/SpMM program instead of a serial scan
+        out = jax.vmap(one)((qf, kf, vf, bh_batch))
         return out.reshape(b, h, L, d).astype(qa.dtype)
 
     args = [q, k, v]

@@ -109,42 +109,13 @@ class _SparseConvND(Layer):
             (out_channels,), attr=bias_attr, is_bias=True)
 
     def forward(self, x: SparseCooTensor) -> SparseCooTensor:
-        nd = self.NDIM
-        if x.sparse_dim != nd + 1 or x.dense_dim != 1:
-            raise ValueError(
-                f"sparse Conv{nd}D expects COO with indices over "
-                f"[N, *{nd} spatial dims] and dense channel values")
-        idx = x._indices
-        shape = x._shape
-        subm = self.SUBM
-        stride, padding, dilation = self.stride, self.padding, self.dilation
-        groups = self.groups
-        dimnums = self.DIMNUMS
-
-        def fn(v, w, b):
-            # bias deliberately NOT added here: it belongs only at retained
-            # output sites (adding it grid-wide would densify the output)
-            dense = jnp.zeros(shape, v.dtype).at[tuple(idx)].add(v)
-            out = jax.lax.conv_general_dilated(
-                dense, w,
-                window_strides=stride,
-                padding=[(p, p) for p in padding],
-                rhs_dilation=dilation,
-                dimension_numbers=dimnums,
-                feature_group_count=groups)
-            if subm:
-                return out[tuple(idx)] + b
-            return out
-
-        if self.SUBM:
-            vals = apply(f"subm_conv{nd}d", fn, x._values, self.weight,
-                         self.bias)
-            return SparseCooTensor(idx, vals,
-                                   shape[:nd + 1] + (self.out_channels,),
-                                   x._coalesced)
-        out_dense = apply(f"sparse_conv{nd}d", fn, x._values, self.weight,
-                          self.bias)
-        return _dense_to_coo(out_dense, self.bias)
+        # one lowering, two surfaces: the functional op is the
+        # implementation (scatter-to-dense -> XLA conv -> gather at input
+        # sites for subm / re-sparsify otherwise)
+        from .functional import _conv_nd
+        return _conv_nd(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.NDIM, self.SUBM,
+                        self.DATA_FORMAT)
 
 
 _SparseConv3D = _SparseConvND  # back-compat alias
