@@ -466,3 +466,83 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
                          ensure_tensor(bmm0_weight), ensure_tensor(bmm0_bias),
                          ensure_tensor(bmm1_weight), ensure_tensor(bmm1_bias),
                          act_type)
+
+
+def masked_multihead_attention(x, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, cache_kv=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Single-token decode attention over a pre-allocated KV cache
+    (reference: paddle.incubate.nn.functional.masked_multihead_attention —
+    the generation-loop kernel behind FusedMultiTransformer decode).
+
+    ``x``: (B, 3*H*D) fused qkv for ONE new token; ``cache_kv``:
+    (2, B, H, max_len, D) pre-allocated; ``sequence_lengths`` (B,) gives
+    each row's current length t — k/v write at position t and attention
+    spans positions <= t (static shapes: the span mask is built from
+    ``sequence_lengths``, no dynamic slicing). Returns (out (B, H*D),
+    updated cache). The int8/quant knobs (out_shift/out_smooth/out_scale)
+    and beam offsets are inference-server features the XLA path does not
+    need — accepted for signature parity, non-default values raise."""
+    for unsupported, label in ((rotary_tensor, "rotary_tensor"),
+                               (beam_cache_offset, "beam_cache_offset"),
+                               (out_shift, "out_shift"),
+                               (out_smooth, "out_smooth")):
+        if unsupported is not None:
+            raise NotImplementedError(
+                f"masked_multihead_attention: {label} is not supported on "
+                "the XLA path (quant/beam serving knobs)")
+    x = ensure_tensor(x)
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    cache = ensure_tensor(cache_kv)
+    two, b, nh, max_len, hd = (int(s) for s in cache.shape)
+    if bias is not None:
+        x = x + ensure_tensor(bias)
+    if sequence_lengths is None:
+        from ..ops.creation import zeros
+        sequence_lengths = zeros([b], dtype="int32")
+    seq_lens = ensure_tensor(sequence_lengths)
+    mask_t = ensure_tensor(src_mask) if src_mask is not None else None
+
+    from ..core.tensor import _is_tracer
+    sl_data = seq_lens._data
+    if not _is_tracer(sl_data) and bool(jnp.any(sl_data >= max_len)):
+        raise ValueError(
+            f"masked_multihead_attention: sequence length >= cache max_len "
+            f"{max_len} — the write would be silently dropped")
+
+    def f(xa, ca, sl, *maybe_mask):
+        qkv = xa.reshape(b, 3, nh, hd)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B, H, D)
+        t = jnp.broadcast_to(sl.astype(jnp.int32).reshape(-1), (b,))  # (B,)
+        onehot = jax.nn.one_hot(t, max_len, dtype=jnp.bool_)  # (B, L)
+        sel = onehot[:, None, :, None]                      # (B, 1, L, 1)
+        k_cache, v_cache = ca[0], ca[1]                     # (B, H, L, D)
+        # OVERWRITE slot t (not accumulate): cache reuse / step retry must
+        # replace, never sum with stale contents
+        k_cache = jnp.where(sel, k_new[:, :, None, :], k_cache)
+        v_cache = jnp.where(sel, v_new[:, :, None, :], v_cache)
+        logits = jnp.einsum("bhd,bhld->bhl", q, k_cache) / (hd ** 0.5)
+        span = jnp.arange(max_len)[None, :] <= t[:, None]   # (B, L)
+        logits = jnp.where(span[:, None, :], logits, -1e30)
+        if maybe_mask:
+            # upstream src_mask: (B, 1|nh, 1, Lm) additive, Lm = t+1 —
+            # keep the head axis and zero-pad to max_len (positions past t
+            # are already -1e30 via the span mask)
+            m = maybe_mask[0].reshape(b, -1, maybe_mask[0].shape[-1])
+            lm = m.shape[-1]
+            if lm < max_len:
+                m = jnp.pad(m, ((0, 0), (0, 0), (0, max_len - lm)))
+            logits = logits + m[:, :, :max_len]
+        p = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhl,bhld->bhd", p, v_cache)
+        return out.reshape(b, nh * hd), jnp.stack([k_cache, v_cache])
+
+    args = [x, cache, seq_lens] + ([mask_t] if mask_t is not None else [])
+    out, new_cache = apply("masked_multihead_attention", f, *args)
+    return out, new_cache

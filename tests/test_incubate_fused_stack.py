@@ -1,0 +1,157 @@
+"""FusedMultiTransformer / masked_multihead_attention /
+FusedBiasDropoutResidualLayerNorm (round-4 incubate tail — the PaddleNLP
+fused-generation surface; reference python/paddle/incubate/nn/layer/
+fused_transformer.py + functional/masked_multihead_attention)."""
+
+import numpy as np
+import pytest
+from scipy.special import erf
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn as inn
+import paddle_tpu.nn.functional as F
+
+B, S, E, H, FF, L = 2, 6, 16, 4, 32, 2
+
+
+@pytest.fixture
+def fmt_and_input():
+    paddle.seed(5)
+    fmt = inn.FusedMultiTransformer(E, H, FF, num_layers=L,
+                                    activation="gelu")
+    fmt.eval()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (B, S, E)).astype(np.float32)
+    return fmt, x
+
+
+def _causal(s):
+    return np.where(np.tril(np.ones((s, s), bool)), 0.0, -1e30) \
+        .astype(np.float32)
+
+
+def _ref_forward(fmt, xv):
+    """Numpy composition of the pre-LN decoder stack with fmt's weights."""
+    h = xv
+    hd = E // H
+    for i in range(L):
+        res = h
+        y = F.layer_norm(paddle.to_tensor(h), [E],
+                         weight=fmt.ln_scales[i], bias=fmt.ln_biases[i],
+                         epsilon=fmt.epsilon).numpy()
+        qkv = (y @ fmt.qkv_weights[i].numpy().reshape(3 * E, E).T +
+               fmt.qkv_biases[i].numpy().reshape(3 * E))
+        qkv = qkv.reshape(B, -1, 3, H, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        logits = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd) + \
+            _causal(h.shape[1])
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        a = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, -1, E)
+        a = a @ fmt.linear_weights[i].numpy() + fmt.linear_biases[i].numpy()
+        h = res + a
+        res = h
+        y = F.layer_norm(paddle.to_tensor(h), [E],
+                         weight=fmt.ffn_ln_scales[i],
+                         bias=fmt.ffn_ln_biases[i],
+                         epsilon=fmt.epsilon).numpy()
+        z = y @ fmt.ffn1_weights[i].numpy() + fmt.ffn1_biases[i].numpy()
+        z = 0.5 * z * (1 + erf(z / np.sqrt(2)))  # exact gelu
+        z = z @ fmt.ffn2_weights[i].numpy() + fmt.ffn2_biases[i].numpy()
+        h = res + z
+    return h
+
+
+def test_prefill_matches_reference_composition(fmt_and_input):
+    fmt, x = fmt_and_input
+    mask = paddle.to_tensor(
+        np.broadcast_to(_causal(S), (B, 1, S, S)).copy())
+    out = fmt(paddle.to_tensor(x), attn_mask=mask)
+    np.testing.assert_allclose(out.numpy(), _ref_forward(fmt, x),
+                               rtol=2e-3, atol=1e-3)
+
+
+def test_decode_step_matches_full_sequence(fmt_and_input):
+    """Prefill S-1 tokens into pre-allocated caches, decode token S-1 via
+    masked_multihead_attention — must equal the full-sequence forward's
+    last position (the upstream generation-loop contract)."""
+    fmt, x = fmt_and_input
+    mask = paddle.to_tensor(
+        np.broadcast_to(_causal(S), (B, 1, S, S)).copy())
+    full = fmt(paddle.to_tensor(x), attn_mask=mask)
+
+    max_len = S + 2
+    caches = [paddle.to_tensor(np.zeros((2, B, H, max_len, E // H),
+                                        np.float32)) for _ in range(L)]
+    pre_mask = paddle.to_tensor(
+        np.broadcast_to(_causal(S - 1), (B, 1, S - 1, S - 1)).copy())
+    _, caches2 = fmt(paddle.to_tensor(x[:, :S - 1]), attn_mask=pre_mask,
+                     caches=caches)
+    step_out, caches3 = fmt(paddle.to_tensor(x[:, S - 1:S]),
+                            caches=caches2, time_step=S - 1)
+    np.testing.assert_allclose(step_out.numpy()[:, 0],
+                               full.numpy()[:, -1], rtol=2e-4, atol=2e-4)
+    assert len(caches3) == L
+    assert caches3[0].shape == [2, B, H, max_len, E // H]
+
+
+def test_masked_mha_rejects_serving_knobs():
+    x = paddle.to_tensor(np.zeros((B, 3 * E), np.float32))
+    cache = paddle.to_tensor(np.zeros((2, B, H, 4, E // H), np.float32))
+    with pytest.raises(NotImplementedError, match="rotary_tensor"):
+        inn.functional.masked_multihead_attention(
+            x, cache_kv=cache, rotary_tensor=x)
+
+
+def test_fused_bias_dropout_residual_layer_norm_layer():
+    paddle.seed(9)
+    layer = inn.FusedBiasDropoutResidualLayerNorm(E, dropout_rate=0.0)
+    layer.eval()
+    rng = np.random.default_rng(3)
+    x = rng.normal(0, 1, (B, S, E)).astype(np.float32)
+    r = rng.normal(0, 1, (B, S, E)).astype(np.float32)
+    out = layer(paddle.to_tensor(x), paddle.to_tensor(r))
+    ref = F.layer_norm(
+        paddle.to_tensor(x + layer.linear_bias.numpy() + r), [E],
+        weight=layer.ln_scale, bias=layer.ln_bias, epsilon=layer.epsilon)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_masked_mha_contracts():
+    """Scalar-tensor time_step, upstream (B,1,1,t+1) masks, and slot
+    OVERWRITE semantics (cache reuse must replace, never accumulate)."""
+    rng = np.random.default_rng(4)
+    hd = E // H
+    max_len = 5
+    x = rng.normal(0, 1, (B, 3 * E)).astype(np.float32)
+    cache = np.zeros((2, B, H, max_len, hd), np.float32)
+    t = 2
+    # dirty the t-th slot: overwrite semantics must make this irrelevant
+    dirty = cache.copy()
+    dirty[:, :, :, t, :] = 99.0
+    mha = inn.functional.masked_multihead_attention
+    seqs = paddle.to_tensor(np.full((B,), t, np.int32))
+    out_clean, cache_clean = mha(paddle.to_tensor(x),
+                                 cache_kv=paddle.to_tensor(cache),
+                                 sequence_lengths=seqs)
+    out_dirty, _ = mha(paddle.to_tensor(x),
+                       cache_kv=paddle.to_tensor(dirty),
+                       sequence_lengths=seqs)
+    np.testing.assert_allclose(out_dirty.numpy(), out_clean.numpy())
+    # scalar 0-d tensor broadcasts over the batch
+    out_s, _ = mha(paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+                   sequence_lengths=paddle.to_tensor(
+                       np.asarray(t, np.int32)))
+    np.testing.assert_allclose(out_s.numpy(), out_clean.numpy())
+    # upstream additive mask of length t+1 (not max_len): must broadcast
+    m = np.zeros((B, 1, 1, t + 1), np.float32)
+    m[:, :, :, 0] = -1e30  # mask out position 0
+    out_m, _ = mha(paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+                   sequence_lengths=seqs, src_mask=paddle.to_tensor(m))
+    assert not np.allclose(out_m.numpy(), out_clean.numpy())
+    # seq length beyond the cache raises instead of dropping the write
+    with pytest.raises(ValueError, match="max_len"):
+        mha(paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+            sequence_lengths=paddle.to_tensor(
+                np.full((B,), max_len, np.int32)))
