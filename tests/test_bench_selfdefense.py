@@ -1,0 +1,68 @@
+"""The benchmark of record must defend its own capture (VERDICT r4 #1).
+
+Pins the pure logic bench.py uses: last-known-good parsing out of
+RESULTS.md and the anomaly classifier that decides when a run retries and
+when it publishes ``"suspect": true``.
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench = _load_bench()
+
+
+def test_lkg_record_parses_from_results_md():
+    rec = bench._read_lkg("llama_train_tokens_per_sec_per_chip")
+    assert rec is not None, "RESULTS.md must carry an LKG record"
+    assert isinstance(rec["value"], (int, float)) and rec["value"] > 0
+    assert "device" in rec
+
+
+def test_lkg_unknown_metric_is_none():
+    assert bench._read_lkg("no_such_metric") is None
+
+
+def test_lkg_skips_malformed_value(tmp_path, monkeypatch, capsys):
+    # a hand-edited record with a string value must disable the guard,
+    # not crash the bench
+    fake = tmp_path / "benchmarks"
+    fake.mkdir()
+    (fake / "RESULTS.md").write_text(
+        '<!-- LKG {"metric": "m", "value": "10252"} -->\n')
+    monkeypatch.setattr(bench.os.path, "dirname",
+                        lambda p: str(tmp_path))
+    assert bench._read_lkg("m") is None
+
+
+def test_anomaly_flags_throughput_collapse():
+    lkg = {"metric": "m", "value": 10252.0}
+    reasons = bench._anomaly_reasons(2713.0, [100.0] * 6, lkg)
+    assert any("last-known-good" in r for r in reasons)
+
+
+def test_anomaly_flags_step_time_skew():
+    reasons = bench._anomaly_reasons(10000.0, [100, 100, 100, 100, 100, 900],
+                                     None)
+    assert any("p90" in r for r in reasons)
+
+
+def test_healthy_run_is_clean():
+    lkg = {"metric": "m", "value": 10252.0}
+    assert bench._anomaly_reasons(9800.0, [101, 100, 99, 100, 102, 100],
+                                  lkg) == []
+
+
+def test_no_lkg_disables_throughput_guard_only():
+    assert bench._anomaly_reasons(10.0, [100.0] * 6, None) == []
