@@ -220,8 +220,11 @@ def main() -> None:
     lkg = _read_lkg(metric) if on_tpu else None
     probe_ms = _dispatch_probe(jax)
 
-    # the throughput guard only makes sense against the same device class
-    if lkg and lkg.get("device") and lkg["device"] not in str(dev):
+    # the throughput guard only makes sense against the same device class;
+    # device_kind is the stable name ("TPU v5 lite"), str(dev) varies by
+    # platform/runtime
+    dev_names = f"{dev} {getattr(dev, 'device_kind', '')}"
+    if lkg and lkg.get("device") and lkg["device"] not in dev_names:
         lkg = None
 
     def anomalous(tok_per_sec, call_ms):

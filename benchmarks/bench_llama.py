@@ -41,6 +41,12 @@ def main() -> None:
                     help="int8-state chunk size in elements (0 = default); "
                          "bigger = fewer serial optimizer chunks, more "
                          "transient HBM")
+    ap.add_argument("--q8-unroll", type=int, default=0,
+                    help="chunks per int8-update loop iteration "
+                         "(0 = default)")
+    ap.add_argument("--q8-window", type=int, default=0,
+                    help="params in flight in the int8 update "
+                         "(0 = default)")
     ap.add_argument("--scan-layers", action="store_true",
                     help="stack identical decoder layers under lax.scan")
     ap.add_argument("--recompute", action="store_true",
@@ -73,6 +79,10 @@ def main() -> None:
               "int8": "int8"}[args.state]
     if args.q8_chunk:
         paddle.optimizer.Adam._Q8_CHUNK_ELEMS = args.q8_chunk
+    if args.q8_unroll:
+        paddle.optimizer.Adam._Q8_UNROLL = args.q8_unroll
+    if args.q8_window:
+        paddle.optimizer.Adam._Q8_PARAM_WINDOW = args.q8_window
     opt = paddle.optimizer.AdamW(
         learning_rate=1e-4, parameters=model.parameters(),
         use_multi_tensor=not args.scan_layers and args.state != "int8",

@@ -124,4 +124,7 @@ define_flag("use_stream_safe_cuda_allocator", False, "parity no-op on TPU")
 # bf16xbf16->fp32 path is reached through bf16 dtypes / AMP, where this flag
 # is irrelevant; lower it only to allow bf16-split passes for fp32 inputs.
 define_flag("tpu_matmul_precision", "highest", "jax matmul precision: default|high|highest")
+define_flag("q8_pallas_update", True,
+            "route block-multiple int8-state Adam updates through the fused "
+            "Pallas kernel on TPU (one kernel/param, zero HBM transients)")
 define_flag("log_level", 0, "framework VLOG-style verbosity")

@@ -1,0 +1,130 @@
+"""Fused int8-state AdamW update as ONE Pallas kernel per parameter.
+
+Why this exists (round 5): the chunked XLA formulation of the int8 update
+(`optimizer._adam_q8_update`) runs ~1000 dynamic-slice fusions back-to-back
+per giant scan-stacked parameter — TPUs execute fusions sequentially, so
+the serialized tail cost (~0.19 s/step at 2.07B params, ~8x over the HBM
+floor of its ~10 B/param traffic) cannot be recovered by unrolling or
+cross-param windows at the HLO level. The Pallas kernel streams the whole
+parameter once: the grid walks (G, 2048)-block tiles with double-buffered
+DMA, all fp32 intermediates live in VMEM (zero HBM transients — the very
+thing the chunking existed to bound), and the five state buffers update
+in place via input_output_aliases.
+
+Reference parity surface: the bitsandbytes-style 8-bit optimizer layout
+(1 byte/element + 4 bytes/block scale) recorded in SURVEY §2.1 "fused
+kernels" (upstream: paddle/phi/kernels/gpu/fused_adam_kernel.cu and the
+multi_tensor_adam family); the sqrt-space second moment is this repo's
+round-4 finding (linear int8 of v explodes training).
+
+Layout contract (matches `optimizer._q8_quantize`):
+  m_q, v_q : int8  (nb, 2048)   v_q stores quantized sqrt(v)
+  m_s, v_s : fp32  (nb, 1)      per-block absmax/127 scales
+  base     : param dtype (nb, 2048) flattened view of the param/master
+  grad     : any float (nb, 2048)
+The caller guarantees n % 2048 == 0 (the optimizer routes ragged params
+to the chunked XLA path — they are small, so their cost is noise).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK = 2048      # quantization block (elements) — fixed by the q8 layout
+_TILE_BLOCKS = 256  # blocks per grid step: ~0.5M elems, ~16MB fp32 in VMEM
+
+
+def _kernel(sc_ref, seed_ref, mq_ref, ms_ref, vq_ref, vs_ref, base_ref,
+            g_ref, mq_o, ms_o, vq_o, vs_o, base_o, *, use_sr, has_wd,
+            out_dtype):
+    lr, wd, c1, c2, eps, b1, b2 = (sc_ref[i] for i in range(7))
+    g32 = g_ref[:].astype(jnp.float32)
+    m32 = mq_ref[:].astype(jnp.float32) * ms_ref[:]
+    sv = vq_ref[:].astype(jnp.float32) * vs_ref[:]
+    v32 = sv * sv
+    nm = b1 * m32 + (1.0 - b1) * g32
+    nv = b2 * v32 + (1.0 - b2) * g32 * g32
+
+    # requantize m (linear) and v (sqrt space) — same rule as _q8_quantize
+    msc = jnp.max(jnp.abs(nm), axis=1, keepdims=True) / 127.0
+    msc = jnp.where(msc == 0.0, 1.0, msc)
+    mq_o[:] = jnp.clip(jnp.round(nm / msc), -127, 127).astype(jnp.int8)
+    ms_o[:] = msc
+    sq = jnp.sqrt(nv)
+    vsc = jnp.max(jnp.abs(sq), axis=1, keepdims=True) / 127.0
+    vsc = jnp.where(vsc == 0.0, 1.0, vsc)
+    vq_o[:] = jnp.clip(jnp.round(sq / vsc), -127, 127).astype(jnp.int8)
+    vs_o[:] = vsc
+
+    upd = base_ref[:].astype(jnp.float32)
+    if has_wd:
+        upd = upd * (1.0 - lr * wd)
+    upd = upd - lr * (nm / c1) / (jnp.sqrt(nv / c2) + eps)
+    if use_sr:
+        # stochastic f32->bf16 rounding, per-tile seeded (unbiased: adds
+        # uniform low mantissa bits then truncates — optimizer.
+        # _stochastic_round_bf16's rule with the on-core PRNG)
+        pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+        bits = jax.lax.bitcast_convert_type(upd, jnp.uint32)
+        rnd = pltpu.prng_random_bits(upd.shape).astype(jnp.uint32) \
+            & jnp.uint32(0xFFFF)
+        rounded = (bits + rnd) & jnp.uint32(0xFFFF0000)
+        out = jax.lax.bitcast_convert_type(rounded, jnp.float32)
+        out = jnp.where(jnp.isfinite(upd), out, upd)
+        base_o[:] = out.astype(jnp.bfloat16)
+    else:
+        base_o[:] = upd.astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("use_sr", "has_wd",
+                                             "interpret"))
+def q8_adam_update(m_q, m_s, v_q, v_s, base, grad, scalars, seed, *,
+                   use_sr: bool, has_wd: bool, interpret: bool = False):
+    """One-kernel in-place int8 AdamW step.
+
+    scalars: (7,) fp32 — lr_eff, weight_decay, c1 (=1-b1^t), c2 (=1-b2^t),
+    epsilon, beta1, beta2. seed: (1,) int32 (ignored unless use_sr).
+    Returns (m_q', m_s', v_q', v_s', base') aliased onto the inputs."""
+    nb = m_q.shape[0]
+    g = min(_TILE_BLOCKS, nb)
+    grid = (pl.cdiv(nb, g),)
+    row = lambda i: (i, 0)
+    const = lambda i: (0,)
+    out_dtype = base.dtype
+    kern = functools.partial(_kernel, use_sr=use_sr, has_wd=has_wd,
+                             out_dtype=out_dtype)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((7,), const, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), const, memory_space=pltpu.SMEM),
+            pl.BlockSpec((g, _BLOCK), row),
+            pl.BlockSpec((g, 1), row),
+            pl.BlockSpec((g, _BLOCK), row),
+            pl.BlockSpec((g, 1), row),
+            pl.BlockSpec((g, _BLOCK), row),
+            pl.BlockSpec((g, _BLOCK), row),
+        ],
+        out_specs=[
+            pl.BlockSpec((g, _BLOCK), row),
+            pl.BlockSpec((g, 1), row),
+            pl.BlockSpec((g, _BLOCK), row),
+            pl.BlockSpec((g, 1), row),
+            pl.BlockSpec((g, _BLOCK), row),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(m_q.shape, jnp.int8),
+            jax.ShapeDtypeStruct(m_s.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v_q.shape, jnp.int8),
+            jax.ShapeDtypeStruct(v_s.shape, jnp.float32),
+            jax.ShapeDtypeStruct(base.shape, out_dtype),
+        ],
+        input_output_aliases={2: 0, 3: 1, 4: 2, 5: 3, 6: 4},
+        interpret=interpret,
+    )(scalars, seed, m_q, m_s, v_q, v_s, base, grad)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import flags as _flags
 from ..core.tensor import Tensor, register_state_tensor
 from ..core.tracing import no_grad
 from . import lr as lr_mod
@@ -364,7 +365,7 @@ class Optimizer:
     def _step_impl(self) -> None:
         """The update math proper (pure jnp over the state payloads; also
         traced by the recorded optimizer-step segment)."""
-        self._q8_serial_token = None  # per-trace ordering chain (q8 path)
+        self._q8_serial_tokens = []  # per-trace ordering chain (q8 path)
         self._step_t._set_data(self._step_t._data + 1)
         base_lr = self._lr_value()
         for group in self._groups:
@@ -1107,6 +1108,32 @@ class Adam(Optimizer):
     # ~45MB there), and per-chunk traffic is already bandwidth-amortized.
     _Q8_CHUNK_ELEMS = 2 * 1024 * 1024
 
+    # Software-pipelining knobs for the chunked int8 update (round 5).
+    # The serialized tail is LATENCY-bound, not bandwidth-bound: at 2.07B
+    # params the ~0.19s/step tail is ~7x over the ~25ms HBM floor of its
+    # ~10 B/param traffic, because every chunk's read->compute->write chain
+    # conservatively orders after the previous chunk's writes (dynamic
+    # slice offsets defeat XLA's alias analysis). Two semantics-preserving
+    # levers recover the bubbles:
+    #  - _Q8_UNROLL chunks per fori_loop iteration, with ALL reads hoisted
+    #    before ANY write — the chunks' pipelines overlap inside one
+    #    iteration (regions are disjoint by construction);
+    #  - _Q8_PARAM_WINDOW params in flight: the ordering barrier threads
+    #    the token from the param WINDOW back, so a bounded number of
+    #    per-param pipelines overlap while the summed fp32 transients stay
+    #    O(WINDOW * chunk) — full serialization (window 1) was the round-4
+    #    fix for unordered updates blowing the HBM headroom.
+    # Both default to 1: the 2.07B on-chip sweep measured unroll-2 and
+    # window-2 WITHIN NOISE of baseline (TPUs execute fusions
+    # sequentially — there is no cross-fusion overlap for the HLO
+    # scheduler to unlock) while doubling transient HBM against a
+    # ~46MB-tight headroom. The knobs remain for re-measurement
+    # (`bench_llama.py --q8-unroll/--q8-window`); the real fix is the
+    # fused Pallas kernel (ops/q8_adam_pallas.py), which TPU runs route
+    # to automatically.
+    _Q8_UNROLL = 1
+    _Q8_PARAM_WINDOW = 1
+
     def _adam_q8_update(self, p, g, lr_eff, decoupled_wd=0.0):
         """Fully-chunked int8-moment Adam step.
 
@@ -1136,6 +1163,17 @@ class Adam(Optimizer):
         t = self._step_t._data.astype(jnp.float32)
         c1 = 1.0 - b1 ** t
         c2 = 1.0 - b2 ** t
+        if (n % _Q8_BLOCK == 0 and n >= _Q8_BLOCK
+                and _flags.flag("q8_pallas_update")
+                and jax.default_backend() == "tpu"):
+            # TPU: the whole update is ONE Pallas kernel (pipelined DMA
+            # over (G, 2048) tiles, fp32 intermediates in VMEM, in-place
+            # via aliasing). No cross-param ordering barrier needed — the
+            # HBM fp32 transients that forced serialization don't exist
+            # on this path. Ragged params fall through to the chunked
+            # XLA loop below (they are small; their cost is noise).
+            return self._adam_q8_update_pallas(
+                p, g, lr_eff, decoupled_wd, m, ms, v, vs, n, nb, c1, c2)
         gb = max(1, min(nb, int(self._Q8_CHUNK_ELEMS) // _Q8_BLOCK))
         full_blocks = n // _Q8_BLOCK          # blocks with no ragged tail
         loops = full_blocks // gb             # uniform in-loop chunks
@@ -1147,9 +1185,14 @@ class Adam(Optimizer):
         # transients of several giant scan-stacked params blow the HBM
         # headroom the chunking just bought. optimization_barrier threads a
         # token from the previous param's result into this one's input.
-        tok = getattr(self, "_q8_serial_token", None)
-        if tok is not None:
-            gview, _ = jax.lax.optimization_barrier((gview, tok))
+        toks = getattr(self, "_q8_serial_tokens", None)
+        if toks is None:
+            toks = self._q8_serial_tokens = []
+        if len(toks) >= self._Q8_PARAM_WINDOW:
+            # order after the param WINDOW back: params in between stay in
+            # flight concurrently with this one (bounded transient memory)
+            gview, _ = jax.lax.optimization_barrier(
+                (gview, toks[-self._Q8_PARAM_WINDOW]))
         use_sr = (master is None and p._data.dtype == jnp.bfloat16
                   and self._stochastic_rounding)
         if use_sr:
@@ -1189,25 +1232,55 @@ class Adam(Optimizer):
                 new_b = upd.astype(base.dtype)
             return qm, msc, qv, vsc, new_b
 
-        def body(i, carry):
-            mb, msb, vb, vsb, bb = carry
-            blk = i * gb
-            off = blk * _Q8_BLOCK
-            s2 = lambda a: jax.lax.dynamic_slice_in_dim(a, blk, gb, 0)
-            s1 = lambda a: jax.lax.dynamic_slice_in_dim(a, off,
-                                                        gb * _Q8_BLOCK, 0)
-            qm, msc, qv, vsc, new_b = chunk_update(
-                s2(mb), s2(msb), s2(vb), s2(vsb), s1(gview), s1(bb), i)
-            u2 = jax.lax.dynamic_update_slice_in_dim
-            return (u2(mb, qm, blk, 0), u2(msb, msc, blk, 0),
-                    u2(vb, qv, blk, 0), u2(vsb, vsc, blk, 0),
-                    u2(bb, new_b, off, 0))
+        def unrolled_body(u):
+            """fori_loop body processing ``u`` chunks per iteration.
 
-        carry0 = (m._data, ms._data, v._data, vs._data, base)
-        if loops > 0:  # fori_loop traces the body even for a 0-trip loop
-            mb, msb, vb, vsb, newb = jax.lax.fori_loop(0, loops, body, carry0)
-        else:
-            mb, msb, vb, vsb, newb = carry0
+            All reads come off the carry BEFORE any write enters the
+            dataflow graph: the u chunk updates are then independent and
+            XLA overlaps their read->compute->write pipelines. Reading the
+            carry-in is correct because the chunks' regions are disjoint —
+            chunk j's region is untouched by chunk j' != j's writes."""
+            def body(i, carry):
+                mb, msb, vb, vsb, bb = carry
+                outs = []
+                for j in range(u):
+                    blk = (i * u + j) * gb
+                    off = blk * _Q8_BLOCK
+                    s2 = lambda a, blk=blk: \
+                        jax.lax.dynamic_slice_in_dim(a, blk, gb, 0)
+                    s1 = lambda a, off=off: \
+                        jax.lax.dynamic_slice_in_dim(a, off,
+                                                     gb * _Q8_BLOCK, 0)
+                    outs.append(chunk_update(
+                        s2(mb), s2(msb), s2(vb), s2(vsb),
+                        s1(gview), s1(bb), i * u + j))
+                u2 = jax.lax.dynamic_update_slice_in_dim
+                for j, (qm, msc, qv, vsc, new_b) in enumerate(outs):
+                    blk = (i * u + j) * gb
+                    off = blk * _Q8_BLOCK
+                    mb = u2(mb, qm, blk, 0)
+                    msb = u2(msb, msc, blk, 0)
+                    vb = u2(vb, qv, blk, 0)
+                    vsb = u2(vsb, vsc, blk, 0)
+                    bb = u2(bb, new_b, off, 0)
+                return (mb, msb, vb, vsb, bb)
+            return body
+
+        U = max(1, int(self._Q8_UNROLL))
+        loops_u, peel = divmod(loops, U)
+        carry = (m._data, ms._data, v._data, vs._data, base)
+        if loops_u > 0:
+            carry = jax.lax.fori_loop(0, loops_u, unrolled_body(U), carry)
+        if peel:
+            # leftover full chunks run in a SECOND fori_loop, not inlined:
+            # a chunk executed outside a compiled loop body fuses
+            # differently (FMA grouping) and drifts 1 ulp from its in-loop
+            # twin, breaking the chunk-shape-invariance bit-equality the
+            # q8 tests pin. unrolled_body(1)'s body indexes chunks
+            # globally, so iterating the global range works directly.
+            carry = jax.lax.fori_loop(loops_u * U, loops,
+                                      unrolled_body(1), carry)
+        mb, msb, vb, vsb, newb = carry
 
         # ragged tail: remaining blocks (incl. the partial last block) as one
         # static-shape chunk — only the SMALL tail slices get padded
@@ -1235,8 +1308,51 @@ class Adam(Optimizer):
         ms._set_data(msb)
         v._set_data(vb)
         vs._set_data(vsb)
-        self._q8_serial_token = msb[0]  # next param's update orders after us
+        toks.append(msb[0])  # later params' updates order after us (window)
         new_flat = newb.reshape(shape)
+        if master is not None:
+            master._set_data(new_flat)
+            p._set_data(new_flat.astype(p._data.dtype))
+            self._note_param_written(p)
+        else:
+            p._set_data(new_flat)
+
+    def _adam_q8_update_pallas(self, p, g, lr_eff, decoupled_wd,
+                               m, ms, v, vs, n, nb, c1, c2):
+        """Fused single-kernel int8 update (see ops/q8_adam_pallas.py)."""
+        from ..ops.q8_adam_pallas import q8_adam_update
+
+        master = self._ensure_master(p)
+        base = (master._data if master is not None else p._data) \
+            .reshape(nb, _Q8_BLOCK)
+        gview = g.reshape(nb, _Q8_BLOCK)
+        use_sr = (master is None and p._data.dtype == jnp.bfloat16
+                  and self._stochastic_rounding)
+        if use_sr:
+            from ..core.random import default_generator
+            key = default_generator.split_key()
+            # the kernel's on-core PRNG takes an int32 seed; folding the
+            # (raw uint32[2]) threefry key halves keeps per-step/per-param
+            # streams distinct
+            kd = jnp.asarray(key, jnp.uint32).reshape(-1)
+            seed = (kd[0] ^ kd[-1]).astype(jnp.int32).reshape(1)
+        else:
+            seed = jnp.zeros((1,), jnp.int32)
+        wd = float(decoupled_wd) if decoupled_wd else 0.0
+        scalars = jnp.stack([
+            jnp.asarray(lr_eff, jnp.float32).reshape(()),
+            jnp.float32(wd), c1.astype(jnp.float32),
+            c2.astype(jnp.float32), jnp.float32(self._epsilon),
+            jnp.float32(self._beta1), jnp.float32(self._beta2)])
+        mq, msc, vq, vsc, newb = q8_adam_update(
+            m._data, ms._data.reshape(nb, 1), v._data,
+            vs._data.reshape(nb, 1), base, gview, scalars, seed,
+            use_sr=use_sr, has_wd=bool(wd))
+        m._set_data(mq)
+        ms._set_data(msc.reshape(nb))
+        v._set_data(vq)
+        vs._set_data(vsc.reshape(nb))
+        new_flat = newb.reshape(p._data.shape)
         if master is not None:
             master._set_data(new_flat)
             p._set_data(new_flat.astype(p._data.dtype))

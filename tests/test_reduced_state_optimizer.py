@@ -291,3 +291,68 @@ def test_q8_legacy_linear_v_checkpoint_converts_on_load():
     got_v = (np.asarray(q, np.float32) * np.asarray(s)[:, None]) ** 2
     # reconstruction error bounded by double quantization, relative scale
     np.testing.assert_allclose(got_v.reshape(-1), v_true, atol=2e-2)
+
+
+def test_q8_pallas_kernel_matches_chunked_path():
+    """Round 5: the fused Pallas int8-Adam kernel (interpret mode on CPU)
+    must track the chunked XLA path — same blockwise quantization rule,
+    same sqrt-space v, same update math. int8 codes may differ by 1 at
+    quantization boundaries (different fp32 fusion), params stay within
+    float tolerance."""
+    import jax
+    import paddle_tpu.optimizer as optim
+    from paddle_tpu.ops.q8_adam_pallas import q8_adam_update
+
+    rng = np.random.default_rng(7)
+    nb, B = 4, 2048
+    n = nb * B
+    base = rng.normal(0, 0.1, (nb, B)).astype(np.float32)
+    grad = rng.normal(0, 0.01, (nb, B)).astype(np.float32)
+    m_q = np.zeros((nb, B), np.int8)
+    m_s = np.ones((nb, 1), np.float32)
+    v_q = np.zeros((nb, B), np.int8)
+    v_s = np.ones((nb, 1), np.float32)
+    lr, wd, eps, b1, b2 = 1e-2, 0.01, 1e-8, 0.9, 0.999
+    c1, c2 = 1.0 - b1, 1.0 - b2  # t = 1
+    scalars = jnp.array([lr, wd, c1, c2, eps, b1, b2], jnp.float32)
+    seed = jnp.zeros((1,), jnp.int32)
+
+    mq2, ms2, vq2, vs2, newb = q8_adam_update(
+        jnp.asarray(m_q), jnp.asarray(m_s), jnp.asarray(v_q),
+        jnp.asarray(v_s), jnp.asarray(base), jnp.asarray(grad),
+        scalars, seed, use_sr=False, has_wd=True, interpret=True)
+
+    # reference: the same math in numpy (the rule _q8_quantize pins)
+    g32 = grad
+    nm = b1 * (m_q.astype(np.float32) * m_s) + (1 - b1) * g32
+    nv = b2 * (v_q.astype(np.float32) * v_s) ** 2 + (1 - b2) * g32 * g32
+    msc = np.abs(nm).max(1, keepdims=True) / 127.0
+    msc[msc == 0] = 1.0
+    vsc = np.sqrt(nv).max(1, keepdims=True) / 127.0
+    vsc[vsc == 0] = 1.0
+    upd = base * (1 - lr * wd) - lr * (nm / c1) / (np.sqrt(nv / c2) + eps)
+
+    # numpy promotes the python-float coefficients to float64 where the
+    # kernel stays fp32 — a few-ulp gap on the tiny v scales is expected
+    np.testing.assert_allclose(np.asarray(ms2), msc, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(vs2), vsc, rtol=2e-5)
+    assert np.abs(np.asarray(mq2).astype(np.int32) -
+                  np.clip(np.round(nm / msc), -127, 127)).max() <= 1
+    np.testing.assert_allclose(np.asarray(newb), upd, rtol=1e-5, atol=1e-7)
+
+
+def test_q8_pallas_routing_gate():
+    """The Pallas route is TPU-only and block-multiple-only; CPU and
+    ragged params stay on the chunked XLA path (this whole test file runs
+    on CPU, so passing tests above already prove the fallback works)."""
+    import jax
+    assert jax.default_backend() == "cpu"  # test env contract
+    paddle.seed(3)
+    model = nn.Linear(64, 96)  # n=6144: block-multiple, but CPU -> XLA path
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters(),
+                                 moment_dtype="int8",
+                                 stochastic_rounding=False)
+    x = paddle.to_tensor(np.ones((4, 64), np.float32))
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()  # must not raise (would, if Pallas ran on CPU)
