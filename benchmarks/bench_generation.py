@@ -1,0 +1,206 @@
+"""Generation/decode throughput on the fused serving stack.
+
+The serving path VERDICT r4 flagged as unmeasured: FusedMultiTransformer
+decode over pre-allocated KV caches (reference:
+paddle.incubate.nn.FusedMultiTransformer + masked_multihead_attention —
+the kernels behind PaddleNLP fused generation; upstream AnalysisPredictor
+is a *performance* artifact).
+
+Three numbers, one JSON line:
+  * prefill: full-prompt forward filling the stacked cache
+  * decode (per-token): ONE compiled program per token (to_static; the
+    stacked cache makes the per-layer loop a lax.scan, so program size is
+    O(1) in depth)
+  * decode (scan-K): K greedy tokens per dispatch — one compiled program
+    runs the closed loop embed -> stack -> head -> argmax -> embed via
+    lax.scan. On a relay-attached chip (~100 ms/dispatch here) this is
+    the only honest serving number; on directly-attached TPUs the
+    per-token path converges toward it.
+
+Usage: python benchmarks/bench_generation.py [--layers 22] [--prompt 512]
+       [--tokens 64] [--scan-k 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=2560)
+    ap.add_argument("--inter", type=int, default=6912)
+    ap.add_argument("--layers", type=int, default=22)
+    ap.add_argument("--heads", type=int, default=20)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--prompt", type=int, default=512)
+    ap.add_argument("--tokens", type=int, default=64)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--scan-k", type=int, default=16)
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core.tensor import Tensor as _T, apply
+    from paddle_tpu.core.tracing import no_grad
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if not on_tpu:  # CPU CI smoke: shrink to seconds
+        args.hidden, args.inter, args.layers, args.heads = 128, 256, 2, 4
+        args.vocab, args.prompt, args.tokens = 512, 16, 8
+        args.max_len, args.scan_k = 64, 4
+    E, H, L = args.hidden, args.heads, args.layers
+    B, V, M = args.batch, args.vocab, args.max_len
+    dtype = "bfloat16" if on_tpu else "float32"
+
+    paddle.seed(0)
+    with paddle.amp.auto_cast(False):
+        embed = nn.Embedding(V, E)
+        fmt = FusedMultiTransformer(E, H, args.inter, num_layers=L,
+                                    activation="gelu")
+        final_ln = nn.LayerNorm(E)
+        head = nn.Linear(E, V, bias_attr=False)
+    for layer in (embed, fmt, final_ln, head):
+        layer.to(dtype=dtype)
+        layer.eval()
+    fmt.prepare_decode()  # stacked scan-decode weights, built eagerly
+    n_params = sum(int(np.prod(p.shape)) for l in (embed, fmt, final_ln, head)
+                   for p in l.parameters())
+
+    def lm_step(tok, cache, t):
+        """(B, 1) int32 token -> (next (B, 1) int32, new cache). Pure
+        Tensor ops: shared by the compiled per-token step and the scan-K
+        loop body."""
+        x = embed(tok)
+        x, cache = fmt(x, caches=cache, time_step=t)
+        x = final_ln(x)
+        logits = head(x)                       # (B, 1, V)
+        nxt = paddle.argmax(logits, axis=-1)   # (B, 1) greedy
+        return nxt.astype("int32"), cache
+
+    @paddle.jit.to_static
+    def prefill(ids, cache):
+        x = embed(ids)
+        x, cache = fmt(x, caches=cache, time_step=None)
+        x = final_ln(x)
+        logits = head(x[:, -1:])
+        nxt = paddle.argmax(logits, axis=-1)
+        return nxt.astype("int32"), cache
+
+    @paddle.jit.to_static
+    def decode_one(tok, cache, t):
+        nxt, cache = lm_step(tok, cache, t)
+        return nxt, cache, t + 1
+
+    K = args.scan_k
+
+    @paddle.jit.to_static
+    def decode_scan(tok, cache, t):
+        """K greedy tokens in ONE program: lax.scan over the closed
+        decode recurrence (the TPU serving loop — dispatch cost amortizes
+        over K tokens)."""
+        def fn(tok_a, cache_a, t_a):
+            def body(carry, _):
+                ta, ca, tt = carry
+                with no_grad():
+                    nxt, newc = lm_step(_T(ta), _T(ca), _T(tt))
+                return (nxt._data, newc._data, tt + 1), nxt._data[:, 0]
+
+            carry, toks = jax.lax.scan(body, (tok_a, cache_a, t_a), None,
+                                       length=K)
+            return carry[0], carry[1], carry[2], toks
+
+        return apply("decode_scan_k", fn, tok, cache, t, amp=False)
+
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, V, (B, args.prompt),
+                                        dtype=np.int32))
+    zero_cache = paddle.zeros([L, 2, B, H, M, E // H], dtype=dtype)
+
+    def sync(x):
+        return np.asarray(x._data)
+
+    # ---- prefill ----
+    t0 = time.perf_counter()
+    tok, cache = prefill(ids, zero_cache)
+    sync(tok)
+    prefill_compile = time.perf_counter() - t0
+    tok, cache = prefill(ids, zero_cache)
+    sync(tok)
+    t0 = time.perf_counter()
+    tok, cache = prefill(ids, zero_cache)
+    sync(tok)
+    prefill_s = time.perf_counter() - t0
+
+    # ---- per-token compiled decode ----
+    t = paddle.full([B], args.prompt, dtype="int32")
+    tok1, cache1, t1 = decode_one(tok, cache, t)  # compile
+    sync(tok1)
+    n_tok = min(args.tokens, M - args.prompt - 2)
+    t0 = time.perf_counter()
+    tk, ck, tt = tok, cache, t
+    for _ in range(n_tok):
+        tk, ck, tt = decode_one(tk, ck, tt)
+    sync(tk)
+    per_token_s = (time.perf_counter() - t0) / n_tok
+
+    # ---- scan-K decode ----
+    tokS, cacheS, tS, toksS = decode_scan(tok, cache, t)  # compile
+    sync(tokS)
+    calls = max(1, n_tok // K)
+    t0 = time.perf_counter()
+    tk, ck, tt = tok, cache, t
+    outs = []
+    for _ in range(calls):
+        tk, ck, tt, toks = decode_scan(tk, ck, tt)
+        outs.append(toks)
+    sync(tk)
+    scan_s = (time.perf_counter() - t0) / (calls * K)
+
+    # greedy parity: the scanned loop should emit the tokens the per-token
+    # path emits. The two programs compile (and fuse) differently, so a
+    # 1-ulp bf16 logit tie can legitimately flip an argmax — gate on a
+    # match FRACTION, not exact equality, and report it.
+    tk2, ck2, tt2 = tok, cache, t
+    ref = []
+    for _ in range(K):
+        tk2, ck2, tt2 = decode_one(tk2, ck2, tt2)
+        ref.append(int(np.asarray(tk2._data)[0, 0]))
+    got = [int(x) for x in np.asarray(outs[0]._data)[:, 0]] if hasattr(
+        outs[0], "_data") else [int(x) for x in np.asarray(outs[0])[:, 0]]
+    match_frac = sum(a == b for a, b in zip(got, ref)) / K
+    parity = match_frac >= 0.75
+
+    print(json.dumps({
+        "benchmark": "fused_generation",
+        "params": n_params, "layers": L, "hidden": E, "batch": B,
+        "prompt": args.prompt, "dtype": dtype,
+        "prefill_ms": round(prefill_s * 1e3, 1),
+        "prefill_tokens_per_sec": round(B * args.prompt / prefill_s, 1),
+        "decode_per_token_ms": round(per_token_s * 1e3, 2),
+        "decode_tokens_per_sec": round(B / per_token_s, 1),
+        "decode_scan_per_token_ms": round(scan_s * 1e3, 2),
+        "decode_scan_tokens_per_sec": round(B / scan_s, 1),
+        "scan_k": K, "scan_greedy_parity": parity,
+        "scan_greedy_match_frac": round(match_frac, 3),
+        "prefill_compile_s": round(prefill_compile, 1),
+        "device": str(jax.devices()[0]),
+    }))
+    if not parity:
+        print(f"PARITY FAIL: scan {got} vs per-token {ref}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

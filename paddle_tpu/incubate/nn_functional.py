@@ -511,7 +511,12 @@ def masked_multihead_attention(x, bias=None, src_mask=None,
 
     from ..core.tensor import _is_tracer
     sl_data = seq_lens._data
-    if not _is_tracer(sl_data) and bool(jnp.any(sl_data >= max_len)):
+    # bounds check in NUMPY: jnp ops on a concrete array still stage to
+    # tracers when an outer trace (e.g. the scan-decode body) is active,
+    # and a staged bool cannot branch
+    import numpy as _np
+    if not _is_tracer(sl_data) and bool(_np.any(_np.asarray(sl_data)
+                                                >= max_len)):
         raise ValueError(
             f"masked_multihead_attention: sequence length >= cache max_len "
             f"{max_len} — the write would be silently dropped")
