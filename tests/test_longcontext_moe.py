@@ -326,3 +326,121 @@ class TestFusedEcMoe:
         y = da_train(x, x).numpy()
         # residual always survives; dropped positions equal 1.0 exactly
         assert set(np.round(np.unique(y), 4)).issubset({1.0, 3.0})
+
+
+class TestFlashDropout:
+    """Round 5: attention-prob dropout runs IN the Pallas kernels (keep
+    mask = stateless hash of absolute coordinates, regenerated by the
+    backward) instead of falling back to materialized XLA attention."""
+
+    def _qkv(self, L=256, B=2, H=2, D=16):
+        paddle.seed(7)
+        return (paddle.randn([B, L, H, D]), paddle.randn([B, L, H, D]),
+                paddle.randn([B, L, H, D]))
+
+    def test_dropout_statistical_parity(self):
+        """E[dropout attention] == no-dropout attention: average over many
+        seeds converges to the clean output (unbiasedness of the
+        normalized-prob dropout formulation)."""
+        q, k, v = self._qkv()
+        clean = nn.functional.flash_attention(q, k, v, causal=True).numpy()
+        acc = np.zeros_like(clean, dtype=np.float64)
+        n = 24
+        for s in range(n):
+            out = nn.functional.flash_attention(
+                q, k, v, dropout=0.3, causal=True, training=True,
+                fixed_seed_offset=paddle.to_tensor([1000 + s], dtype="int32"))
+            acc += out.numpy().astype(np.float64)
+        mean = acc / n
+        # elementwise SEM is large for p=0.3, n=24; compare on aggregate
+        err = np.abs(mean - clean).mean() / (np.abs(clean).mean() + 1e-9)
+        assert err < 0.15, err
+
+    def test_dropout_deterministic_in_seed(self):
+        q, k, v = self._qkv()
+        kw = dict(dropout=0.2, causal=True, training=True)
+        a = nn.functional.flash_attention(
+            q, k, v, fixed_seed_offset=paddle.to_tensor([5], "int32"), **kw)
+        b = nn.functional.flash_attention(
+            q, k, v, fixed_seed_offset=paddle.to_tensor([5], "int32"), **kw)
+        c = nn.functional.flash_attention(
+            q, k, v, fixed_seed_offset=paddle.to_tensor([6], "int32"), **kw)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        assert np.abs(a.numpy() - c.numpy()).max() > 0
+
+    def test_dropout_actually_drops(self):
+        """Output must differ from the clean path and zero out some
+        contributions (not a silent no-op)."""
+        q, k, v = self._qkv()
+        clean = nn.functional.flash_attention(q, k, v, causal=False).numpy()
+        out = nn.functional.flash_attention(
+            q, k, v, dropout=0.5, causal=False, training=True,
+            fixed_seed_offset=paddle.to_tensor([3], "int32")).numpy()
+        assert np.abs(out - clean).max() > 1e-3
+        # eval mode: dropout off regardless
+        ev = nn.functional.flash_attention(
+            q, k, v, dropout=0.5, causal=False, training=False).numpy()
+        np.testing.assert_allclose(ev, clean, rtol=1e-5, atol=1e-6)
+
+    def test_dropout_grad_flows_and_matches_fallback(self):
+        """Gradients through the kernel dropout path match AD through the
+        XLA fallback formulation with the SAME mask — the backward's
+        regenerated mask is the forward's."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.flash_attention import (_flash_core_drop,
+                                                    _keep_tile)
+
+        rng = np.random.default_rng(3)
+        B, H, L, D = 1, 2, 256, 16
+        q = jnp.asarray(rng.normal(0, 1, (B, H, L, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(0, 1, (B, H, L, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(0, 1, (B, H, L, D)).astype(np.float32))
+        segs = jnp.zeros((B, L), jnp.int32)
+        seed = jnp.asarray([11], jnp.int32)
+        p_drop, scale = 0.25, 1.0 / np.sqrt(D)
+
+        def kernel_loss(q, k, v):
+            out = _flash_core_drop(q, k, v, segs, segs, seed, True, scale,
+                                   p_drop)
+            return (out * out).sum()
+
+        def ref_loss(q, k, v):
+            # same math, dense: softmax then the SAME hash mask
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((L, L), bool))
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            # bh index: the kernel grid maps (batch*head) to program_id(0)
+            keeps = [
+                _keep_tile(seed[0], bh, 0, 0, L, L, 1.0 - p_drop)
+                for bh in range(B * H)]
+            keep = jnp.stack(keeps).reshape(B, H, L, L)
+            pd = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+            out = jnp.einsum("bhqk,bhkd->bhqd", pd, v)
+            return (out * out).sum()
+
+        lk, gk = jax.value_and_grad(kernel_loss, argnums=(0, 1, 2))(q, k, v)
+        lr, gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(lk), float(lr), rtol=2e-4)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_unpadded_dropout_stays_streaming(self):
+        """flash_attn_unpadded with dropout routes the drop core (not the
+        materializing parity path) and stays deterministic in the seed."""
+        paddle.seed(1)
+        total, H, D = 256, 2, 16
+        q = paddle.randn([total, H, D])
+        k = paddle.randn([total, H, D])
+        v = paddle.randn([total, H, D])
+        cu = paddle.to_tensor(np.array([0, 100, 256], np.int32))
+        kw = dict(cu_seqlens_q=cu, cu_seqlens_k=cu, max_seqlen_q=156,
+                  max_seqlen_k=156, dropout=0.2, causal=True, training=True)
+        a = nn.functional.flash_attn_unpadded(
+            q, k, v, fixed_seed_offset=paddle.to_tensor([9], "int32"), **kw)
+        b = nn.functional.flash_attn_unpadded(
+            q, k, v, fixed_seed_offset=paddle.to_tensor([9], "int32"), **kw)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        assert np.isfinite(a.numpy()).all()
