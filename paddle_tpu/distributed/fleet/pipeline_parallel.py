@@ -73,6 +73,7 @@ class PipelineLayer(Layer):
         if num_stages is None:
             num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
         self._num_stages = num_stages
+        self._seg_method = seg_method
         self._loss_fn = loss_fn
         self._descs = list(layers)
         self._shared: Dict[str, Layer] = {}
@@ -167,20 +168,44 @@ class PipelineParallel(Layer):
         self._engine = None
         pp = self._hcg.get_pipe_parallel_world_size() if self._hcg else 1
         if pp > 1 and "pp" in getattr(self._hcg.mesh, "axis_names", ()):
-            from .tpu_pipeline import NonUniformStackError, PipelinedStack
+            from .tpu_pipeline import (HeteroPipelinedStack,
+                                       NonUniformStackError, PipelinedStack)
+            v_chunks = max(int(cfg.get("virtual_pp_degree", 1)), 1)
             try:
                 self._engine = PipelinedStack(
                     layers, self._hcg.mesh, axis="pp",
                     micro_batches=self.accumulate_steps,
-                    remat=bool(cfg.get("remat", True)))
-            except NonUniformStackError as e:
-                self._engine = None  # non-uniform stack: fallback path
+                    remat=bool(cfg.get("remat", True)),
+                    v_chunks=v_chunks)
+            except NonUniformStackError as uniform_err:
+                # round 5: non-uniform stacks get REAL stage placement too —
+                # contiguous param-balanced stages as lax.switch branches in
+                # the same ppermute scan (grad accumulation only as the
+                # last resort, or on hetero_pipeline=False)
                 import warnings
-                warnings.warn(
-                    f"pipeline parallel (pp={pp}): {e}. Falling back to the "
-                    "grad-accumulation path — numerics match 1F1B but stages "
-                    "are NOT placed on devices (no pipelining).",
-                    stacklevel=2)
+                if v_chunks > 1:
+                    warnings.warn(
+                        f"pipeline parallel (pp={pp}): "
+                        f"virtual_pp_degree={v_chunks} needs a uniform run "
+                        f"of {pp * v_chunks} stage-chunks and none exists "
+                        f"({uniform_err}); interleaved placement is "
+                        "dropped for this model.", stacklevel=2)
+                try:
+                    if not cfg.get("hetero_pipeline", True):
+                        raise NonUniformStackError(
+                            "hetero_pipeline disabled by pipeline_configs "
+                            f"(uniform engine: {uniform_err})")
+                    self._engine = HeteroPipelinedStack(
+                        layers, self._hcg.mesh, axis="pp",
+                        micro_batches=self.accumulate_steps,
+                        remat=bool(cfg.get("remat", True)))
+                except NonUniformStackError as e:
+                    self._engine = None  # last resort: grad accumulation
+                    warnings.warn(
+                        f"pipeline parallel (pp={pp}): {e}. Falling back to "
+                        "the grad-accumulation path — numerics match 1F1B "
+                        "but stages are NOT placed on devices (no "
+                        "pipelining).", stacklevel=2)
 
     def forward(self, *args, **kwargs):
         if self._engine is not None:

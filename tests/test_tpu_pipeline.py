@@ -229,8 +229,29 @@ def test_fleet_pipeline_shared_embedding_grads():
 
 
 def test_non_uniform_stack_falls_back():
-    """A PipelineLayer with no uniform run keeps the documented
-    grad-accumulation fallback."""
+    """hetero_pipeline=False restores the documented grad-accumulation
+    fallback for stacks the uniform engine cannot place."""
+    paddle.seed(5)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+        strategy.pipeline_configs = {"hetero_pipeline": False}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = PipelineLayer(layers=[LayerDesc(Emb), LayerDesc(Head)],
+                              loss_fn=_mse)
+        with pytest.warns(UserWarning, match="grad-accumulation"):
+            wrapped = fleet.distributed_model(model)
+        assert wrapped._engine is None
+    finally:
+        set_hybrid_communicate_group(None)
+
+
+def test_hetero_shape_varying_stack_raises_actionable():
+    """Round 5: a shape-VARYING non-uniform stack gets the hetero engine at
+    construction, and the first call raises the actionable boundary-shape
+    error (the SPMD scan needs one uniform hop buffer)."""
+    from paddle_tpu.distributed.fleet.tpu_pipeline import (
+        HeteroPipelinedStack, NonUniformStackError)
     paddle.seed(5)
     try:
         strategy = fleet.DistributedStrategy()
@@ -239,9 +260,164 @@ def test_non_uniform_stack_falls_back():
         model = PipelineLayer(layers=[LayerDesc(Emb), LayerDesc(Head)],
                               loss_fn=_mse)
         wrapped = fleet.distributed_model(model)
-        assert wrapped._engine is None
+        assert isinstance(wrapped._engine, HeteroPipelinedStack)
+        x = paddle.to_tensor(np.zeros((4, D), np.float32))
+        with pytest.raises(NonUniformStackError, match="hetero_pipeline"):
+            wrapped(x)
     finally:
         set_hybrid_communicate_group(None)
+
+
+class WideBlock(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.up = nn.Linear(D, 2 * D)
+        self.down = nn.Linear(2 * D, D)
+
+    def forward(self, x):
+        return x + self.down(paddle.tanh(self.up(x)))
+
+
+class NarrowBlock(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.up = nn.Linear(D, D // 2)
+        self.down = nn.Linear(D // 2, D)
+
+    def forward(self, x):
+        return x + self.down(paddle.nn.functional.relu(self.up(x)))
+
+
+class GatedBlock(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(D, D)
+        self.gate = nn.Linear(D, D)
+
+    def forward(self, x):
+        return x + self.fc(x) * paddle.nn.functional.sigmoid(self.gate(x))
+
+
+def _build_hetero_layer():
+    # aperiodic mix: no stage-periodic run exists, but every block is
+    # shape-preserving (B, D) -> (B, D)
+    descs = [LayerDesc(Emb), LayerDesc(WideBlock), LayerDesc(NarrowBlock),
+             LayerDesc(WideBlock), LayerDesc(GatedBlock),
+             LayerDesc(NarrowBlock), LayerDesc(GatedBlock), LayerDesc(Head)]
+    return PipelineLayer(layers=descs, loss_fn=_mse)
+
+
+def test_hetero_pipeline_parity_vs_serial():
+    """Round 5 (VERDICT r4 #4): non-uniform stacks train with REAL stage
+    placement — switch-branch stages in the ppermute scan — and match the
+    serial model's loss trajectory."""
+    from paddle_tpu.distributed.fleet.tpu_pipeline import HeteroPipelinedStack
+    rng = np.random.default_rng(21)
+    data_np = rng.normal(0, 1, (8, D)).astype(np.float32)
+    label_np = rng.normal(0, 1, (8, 4)).astype(np.float32)
+
+    paddle.seed(77)
+    set_hybrid_communicate_group(None)
+    serial = _build_hetero_layer()
+    s_losses = _train(serial, serial.parameters(),
+                      paddle.to_tensor(data_np), paddle.to_tensor(label_np))
+
+    paddle.seed(77)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = _build_hetero_layer()
+        wrapped = fleet.distributed_model(model)
+        assert isinstance(wrapped._engine, HeteroPipelinedStack), \
+            "hetero engine not selected"
+        p_losses = _train(wrapped, wrapped.parameters(),
+                          paddle.to_tensor(data_np),
+                          paddle.to_tensor(label_np))
+    finally:
+        set_hybrid_communicate_group(None)
+
+    np.testing.assert_allclose(p_losses, s_losses, rtol=2e-4, atol=2e-5)
+
+
+def test_hetero_pipeline_stage_placement_physical():
+    """Each device stores only its stage's (padded) fused weights, and the
+    compiled schedule really hops activations (collective-permute in HLO)."""
+    from paddle_tpu.distributed.fleet.tpu_pipeline import HeteroPipelinedStack
+    paddle.seed(3)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 4}
+        strategy.pipeline_configs = {"accumulate_steps": 4}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = _build_hetero_layer()
+        wrapped = fleet.distributed_model(model)
+        eng = wrapped._engine
+        assert isinstance(eng, HeteroPipelinedStack)
+        buf = eng._buffers["float32"]._data
+        S = 4
+        assert buf.shape[0] == S
+        shards = buf.addressable_shards
+        assert len(shards) >= S
+        per_dev = {sh.device for sh in shards}
+        assert len(per_dev) >= S  # spread over the pp axis, 1 row each
+        for sh in shards:
+            assert sh.data.shape[0] == 1  # one stage row per device
+
+        # HLO of the schedule carries the ppermute hop
+        import jax
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.fleet.tpu_pipeline import pipelined_forward
+        mesh = eng._mesh
+        rows = {dt: eng._buffers[dt]._data for dt in eng._dtypes}
+        micro = jnp.zeros((4, 2, D), jnp.float32)
+
+        def fn(rows, micro):
+            def stage_fn(rows_local, h):
+                stage = jax.lax.axis_index("pp")
+                return jax.lax.switch(
+                    stage, [lambda h, s=s: eng._branch(s)(rows_local, h)
+                            for s in range(S)], h)
+            return pipelined_forward(stage_fn, rows, micro, mesh, "pp")
+
+        hlo = jax.jit(fn).lower(rows, micro).compile().as_text()
+        assert "collective-permute" in hlo
+    finally:
+        set_hybrid_communicate_group(None)
+
+
+def test_interleaved_vpp_parity_vs_serial():
+    """virtual_pp_degree=2 (interleaved placement, upstream VPP parity):
+    same numerics as serial; the option exists for schedule parity even
+    though RESULTS.md documents the compiled-scan slowdown."""
+    rng = np.random.default_rng(31)
+    data_np = rng.normal(0, 1, (8, D)).astype(np.float32)
+    label_np = rng.normal(0, 1, (8, 4)).astype(np.float32)
+
+    paddle.seed(55)
+    set_hybrid_communicate_group(None)
+    serial = _build_pipeline_layer()
+    s_losses = _train(serial, serial.parameters(),
+                      paddle.to_tensor(data_np), paddle.to_tensor(label_np))
+
+    paddle.seed(55)
+    try:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2,
+                                     "virtual_pp_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = _build_pipeline_layer()
+        wrapped = fleet.distributed_model(model)
+        assert wrapped._engine is not None and wrapped._engine._V == 2
+        p_losses = _train(wrapped, wrapped.parameters(),
+                          paddle.to_tensor(data_np),
+                          paddle.to_tensor(label_np))
+    finally:
+        set_hybrid_communicate_group(None)
+
+    np.testing.assert_allclose(p_losses, s_losses, rtol=2e-4, atol=2e-5)
 
 
 @pytest.mark.slow
