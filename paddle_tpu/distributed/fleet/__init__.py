@@ -229,7 +229,9 @@ def init_worker(scopes=None) -> None:
             _rpc.init_rpc(f"worker/{widx}", rank=rm.server_num() + widx,
                           world_size=rm.server_num() + rm.worker_num(),
                           master_endpoint=_ps_rpc_endpoint(rm))
-            remote = PsClient("ps/0")
+            # round 5: all servers — hash sparse tables shard by
+            # id % server_num across them (dense tables stay on ps/0)
+            remote = PsClient([f"ps/{i}" for i in range(rm.server_num())])
         _communicator = Communicator(
             mode=mode, geo_k=int(cfg.get("k_steps", 0) or 8),
             send_queue_size=int(cfg.get("send_queue_size", 32) or 32),

@@ -19,26 +19,232 @@ is the semantics the Communicator contract promises).
 
 from __future__ import annotations
 
+import os
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
-__all__ = ["PsClient", "serve_stats", "reset_server_state"]
+__all__ = ["PsClient", "serve_stats", "reset_server_state", "SparseTable"]
+
+# ---------------------------------------------------------------------------
+# accessor rules (round 5 — upstream paddle/fluid/distributed/ps/table/
+# accessors: the per-table/per-slot optimizer rule applied ON the server)
+# ---------------------------------------------------------------------------
+
+
+class _RuleBase:
+    state_keys = ()
+
+    def ensure_state(self, state, dim):
+        """Create this rule's missing state keys on demand: a row's rule
+        binds at APPLY time (slot overrides can differ from the slot a row
+        was first materialized/pulled under), so state cannot be fixed at
+        materialization."""
+        for k in self.state_keys:
+            if k not in state:
+                state[k] = np.zeros(1 if k == "t" else dim, np.float32)
+
+
+class _SgdRule(_RuleBase):
+    state_keys = ()
+
+    def apply(self, value, state, grad, lr):
+        value -= lr * grad
+
+
+class _AdagradRule(_RuleBase):
+    state_keys = ("g2",)
+
+    def __init__(self, epsilon=1e-8):
+        self.epsilon = float(epsilon)
+
+    def apply(self, value, state, grad, lr):
+        self.ensure_state(state, grad.shape[-1])
+        state["g2"] += grad * grad
+        value -= lr * grad / (np.sqrt(state["g2"]) + self.epsilon)
+
+
+class _AdamRule(_RuleBase):
+    state_keys = ("m", "v", "t")
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.beta1, self.beta2 = float(beta1), float(beta2)
+        self.epsilon = float(epsilon)
+
+    def apply(self, value, state, grad, lr):
+        self.ensure_state(state, grad.shape[-1])
+        state["t"] += 1.0
+        t = state["t"][0] if state["t"].ndim else state["t"]
+        state["m"][:] = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        state["v"][:] = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        mhat = state["m"] / (1 - self.beta1 ** t)
+        vhat = state["v"] / (1 - self.beta2 ** t)
+        value -= lr * mhat / (np.sqrt(vhat) + self.epsilon)
+
+
+_RULES = {"sgd": _SgdRule, "adagrad": _AdagradRule, "adam": _AdamRule}
+
+
+def _make_rule(spec: Union[str, Dict[str, Any]]):
+    if isinstance(spec, str):
+        return _RULES[spec]()
+    spec = dict(spec)
+    return _RULES[spec.pop("name")](**spec)
+
+
+class SparseTable:
+    """Hash-map sparse table with a per-table accessor rule, per-SLOT
+    overrides, frequency/recency metadata, TTL eviction and
+    snapshot/restore (upstream: ps/table/ MemorySparseTable + the
+    accessor's slot-parameterized SGD rules + shrink()/save()/load()).
+
+    Rows materialize on first touch via the initializer; each row carries
+    its accessor state (adagrad g2 / adam m,v,t), a show counter and the
+    last-seen logical tick (one tick per push batch). ``slot_params`` maps
+    a feature's SLOT id to overrides — currently ``lr`` and ``rule`` —
+    which is how CTR models give embeddings per-field learning rates."""
+
+    def __init__(self, dim: int, dtype: str = "float32",
+                 accessor: Union[str, Dict[str, Any]] = "sgd",
+                 lr: float = 0.01, initializer: str = "zeros",
+                 init_scale: float = 0.01, seed: int = 0,
+                 slot_params: Optional[Dict[int, Dict[str, Any]]] = None):
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.lr = float(lr)
+        self.rule = _make_rule(accessor)
+        self.initializer = initializer
+        self.init_scale = float(init_scale)
+        self._rng = np.random.default_rng(seed)
+        self.slot_params = {int(k): dict(v)
+                            for k, v in (slot_params or {}).items()}
+        self._slot_rules = {s: _make_rule(p["rule"])
+                            for s, p in self.slot_params.items()
+                            if "rule" in p}
+        self.values: Dict[int, np.ndarray] = {}
+        self.state: Dict[int, Dict[str, np.ndarray]] = {}
+        self.show: Dict[int, int] = {}
+        self.last_seen: Dict[int, int] = {}
+        self.tick = 0
+
+    def _rule_for(self, slot: int):
+        return self._slot_rules.get(slot, self.rule)
+
+    def _lr_for(self, slot: int) -> float:
+        return float(self.slot_params.get(slot, {}).get("lr", self.lr))
+
+    def _materialize(self, fid: int, slot: int = -1):
+        if fid not in self.values:
+            if self.initializer == "uniform":
+                row = self._rng.uniform(-self.init_scale, self.init_scale,
+                                        self.dim).astype(self.dtype)
+            else:
+                row = np.zeros(self.dim, self.dtype)
+            self.values[fid] = row
+            self.state[fid] = {}  # rule state materializes at apply time
+            self.show[fid] = 0
+            self.last_seen[fid] = self.tick
+        return self.values[fid]
+
+    def pull(self, ids: np.ndarray, slots: Optional[np.ndarray] = None):
+        out = np.empty((len(ids), self.dim), self.dtype)
+        for i, fid in enumerate(ids):
+            fid = int(fid)
+            slot = int(slots[i]) if slots is not None else -1
+            out[i] = self._materialize(fid, slot)
+            self.show[fid] += 1
+            self.last_seen[fid] = self.tick
+        return out
+
+    def push(self, ids: np.ndarray, grads: np.ndarray,
+             slots: Optional[np.ndarray] = None, lr: Optional[float] = None):
+        self.tick += 1
+        for i, fid in enumerate(ids):
+            fid = int(fid)
+            slot = int(slots[i]) if slots is not None else -1
+            value = self._materialize(fid, slot)
+            eff_lr = float(lr) if lr is not None else self._lr_for(slot)
+            self._rule_for(slot).apply(value, self.state[fid],
+                                       grads[i].astype(np.float32), eff_lr)
+            self.last_seen[fid] = self.tick
+
+    def shrink(self, max_unseen: Optional[int] = None,
+               min_show: Optional[int] = None) -> int:
+        """Evict rows not seen for ``max_unseen`` ticks or with fewer than
+        ``min_show`` accesses (upstream table->shrink() with the
+        accessor's delete thresholds). Returns the eviction count."""
+        drop = [fid for fid in self.values
+                if (max_unseen is not None
+                    and self.tick - self.last_seen[fid] > max_unseen)
+                or (min_show is not None and self.show[fid] < min_show)]
+        for fid in drop:
+            for d in (self.values, self.state, self.show, self.last_seen):
+                d.pop(fid, None)
+        return len(drop)
+
+    # -- snapshot / restore --------------------------------------------------
+    def save(self, path: str) -> None:
+        ids = np.array(sorted(self.values), np.int64)
+        vals = np.stack([self.values[i] for i in ids]) if len(ids) \
+            else np.zeros((0, self.dim), self.dtype)
+        # UNION of state keys across rows (slot-rule overrides mean rows
+        # carry different keys); absent keys round-trip as zeros, which is
+        # exactly the lazily-created initial state
+        all_keys = sorted({k for st in self.state.values() for k in st})
+        state_blob = {}
+        for k in all_keys:
+            shape = next(st[k].shape for st in self.state.values()
+                         if k in st)
+            state_blob[f"state_{k}"] = np.stack(
+                [self.state[i].get(k, np.zeros(shape, np.float32))
+                 for i in ids]) if len(ids) else np.zeros((0,) + shape)
+        np.savez(path, ids=ids, values=vals,
+                 show=np.array([self.show[i] for i in ids], np.int64),
+                 last_seen=np.array([self.last_seen[i] for i in ids],
+                                    np.int64),
+                 tick=np.int64(self.tick), **state_blob)
+
+    def load(self, path: str) -> None:
+        z = np.load(path)
+        keys = [f[len("state_"):] for f in z.files if f.startswith("state_")]
+        self.values.clear(); self.state.clear()
+        self.show.clear(); self.last_seen.clear()
+        self.tick = int(z["tick"])
+        for i, fid in enumerate(z["ids"]):
+            fid = int(fid)
+            self.values[fid] = z["values"][i].astype(self.dtype)
+            self.state[fid] = {k: z[f"state_{k}"][i].astype(np.float32)
+                               for k in keys}
+            self.show[fid] = int(z["show"][i])
+            self.last_seen[fid] = int(z["last_seen"][i])
+
 
 # ---------------------------------------------------------------------------
 # server-side state (lives in the PS SERVER process; reached via rpc)
 # ---------------------------------------------------------------------------
 
 _TABLES: Dict[str, np.ndarray] = {}
+_SPARSE: Dict[str, SparseTable] = {}
+_SPARSE_CFG: Dict[str, Dict[str, Any]] = {}
+# at-most-once guard for retried pushes: last applied sequence id per
+# client (a retry after a lost REPLY must not re-apply the gradient).
+# Reset on server restart — a snapshot restore rewinds past any in-flight
+# push anyway, so exactly-once holds within a server incarnation.
+_PUSH_SEQ: Dict[str, int] = {}
 _LOCK = threading.Lock()
-_STATS = {"pushes": 0, "pulls": 0, "creates": 0}
+_STATS = {"pushes": 0, "pulls": 0, "creates": 0, "evictions": 0,
+          "dup_pushes": 0}
 
 
 def reset_server_state() -> None:
     with _LOCK:
         _TABLES.clear()
-        _STATS.update(pushes=0, pulls=0, creates=0)
+        _SPARSE.clear()
+        _SPARSE_CFG.clear()
+        _PUSH_SEQ.clear()
+        _STATS.update(pushes=0, pulls=0, creates=0, evictions=0,
+                      dup_pushes=0)
 
 
 def _srv_create(name: str, value_bytes: bytes, shape: Tuple[int, ...],
@@ -53,11 +259,26 @@ def _srv_create(name: str, value_bytes: bytes, shape: Tuple[int, ...],
     return True
 
 
+def _seq_is_dup(client_key: Optional[str], seq: Optional[int]) -> bool:
+    """True when (client, seq) was already applied (caller holds _LOCK)."""
+    if client_key is None or seq is None:
+        return False
+    if _PUSH_SEQ.get(client_key, -1) >= seq:
+        _STATS["dup_pushes"] += 1
+        return True
+    _PUSH_SEQ[client_key] = seq
+    return False
+
+
 def _srv_push(name: str, ids_bytes: bytes, grad_bytes: bytes,
-              n: int, dim: int, lr: float) -> bool:
+              n: int, dim: int, lr: float,
+              client_key: Optional[str] = None,
+              seq: Optional[int] = None) -> bool:
     """Apply an SGD scatter-update: table[ids] -= lr * grad. Duplicate ids
     accumulate (segment-sum semantics, the reference accessor's rule)."""
     with _LOCK:
+        if _seq_is_dup(client_key, seq):
+            return True
         t = _TABLES[name]
         ids = np.frombuffer(ids_bytes, dtype=np.int64)
         g = np.frombuffer(grad_bytes, dtype=np.float32).reshape(n, dim)
@@ -92,51 +313,265 @@ def serve_stats() -> Dict[str, int]:
     return _srv_stats()
 
 
+# -- hash sparse-table endpoints (round 5) -----------------------------------
+
+def _srv_create_sparse(name: str, cfg: Dict[str, Any]) -> bool:
+    with _LOCK:
+        if name not in _SPARSE:
+            _SPARSE[name] = SparseTable(**cfg)
+            _SPARSE_CFG[name] = dict(cfg)
+            _STATS["creates"] += 1
+    return True
+
+
+def _srv_push_sparse(name: str, ids_bytes: bytes, grad_bytes: bytes, n: int,
+                     slots_bytes: Optional[bytes],
+                     lr: Optional[float],
+                     client_key: Optional[str] = None,
+                     seq: Optional[int] = None) -> bool:
+    with _LOCK:
+        if _seq_is_dup(client_key, seq):
+            return True
+        t = _SPARSE[name]
+        ids = np.frombuffer(ids_bytes, np.int64)
+        g = np.frombuffer(grad_bytes, np.float32).reshape(n, t.dim)
+        slots = np.frombuffer(slots_bytes, np.int64) \
+            if slots_bytes is not None else None
+        t.push(ids, g, slots, lr)
+        _STATS["pushes"] += 1
+    return True
+
+
+def _srv_pull_sparse(name: str, ids_bytes: bytes,
+                     slots_bytes: Optional[bytes]) -> bytes:
+    with _LOCK:
+        t = _SPARSE[name]
+        ids = np.frombuffer(ids_bytes, np.int64)
+        slots = np.frombuffer(slots_bytes, np.int64) \
+            if slots_bytes is not None else None
+        _STATS["pulls"] += 1
+        return t.pull(ids, slots).tobytes()
+
+
+def _srv_shrink(name: str, max_unseen: Optional[int],
+                min_show: Optional[int]) -> int:
+    with _LOCK:
+        n = _SPARSE[name].shrink(max_unseen, min_show)
+        _STATS["evictions"] += n
+        return n
+
+
+def _srv_sparse_rows(name: str) -> int:
+    with _LOCK:
+        return len(_SPARSE[name].values)
+
+
+def _srv_save(dirname: str) -> List[str]:
+    """Snapshot every table (dense + sparse + sparse configs) to
+    ``dirname`` — the recovery image a respawned server loads."""
+    os.makedirs(dirname, exist_ok=True)
+    saved = []
+    with _LOCK:
+        for name, t in _TABLES.items():
+            np.save(os.path.join(dirname, f"dense_{name}.npy"), t)
+            saved.append(name)
+        for name, t in _SPARSE.items():
+            t.save(os.path.join(dirname, f"sparse_{name}.npz"))
+            saved.append(name)
+        if _SPARSE_CFG:
+            import json
+            with open(os.path.join(dirname, "sparse_cfg.json"), "w") as f:
+                json.dump(_SPARSE_CFG, f)
+    return saved
+
+
+def _srv_load(dirname: str) -> List[str]:
+    """Restore a `_srv_save` snapshot (server-restart recovery)."""
+    import json
+    loaded = []
+    with _LOCK:
+        cfg_path = os.path.join(dirname, "sparse_cfg.json")
+        cfgs = {}
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfgs = json.load(f)
+        for fn in os.listdir(dirname):
+            path = os.path.join(dirname, fn)
+            if fn.startswith("dense_") and fn.endswith(".npy"):
+                name = fn[len("dense_"):-len(".npy")]
+                _TABLES[name] = np.load(path)
+                loaded.append(name)
+            elif fn.startswith("sparse_") and fn.endswith(".npz"):
+                name = fn[len("sparse_"):-len(".npz")]
+                cfg = cfgs.get(name, {"dim": 1})
+                # json stringifies the slot keys; restore int slots
+                if "slot_params" in cfg:
+                    cfg["slot_params"] = {int(k): v for k, v in
+                                          cfg["slot_params"].items()}
+                t = SparseTable(**cfg)
+                t.load(path)
+                _SPARSE[name] = t
+                _SPARSE_CFG[name] = cfg
+                loaded.append(name)
+    return loaded
+
+
 # ---------------------------------------------------------------------------
 # worker-side client
 # ---------------------------------------------------------------------------
 
 class PsClient:
-    """push/pull against tables living in a PS SERVER process.
+    """push/pull against tables living in PS SERVER process(es).
 
     The analogue of the reference BrpcPsClient: every method is a remote
     call; nothing is cached worker-side (pulls observe the server's real,
-    possibly-stale-under-geo state)."""
+    possibly-stale-under-geo state). Round 5:
 
-    def __init__(self, server: str, lr: float = 0.01):
-        self.server = server
+    * MULTI-SERVER sharding — pass a list of server names and sparse rows
+      shard by ``id % num_servers`` (upstream shards tables across
+      PServers the same way); dense tables live whole on server 0.
+    * FAILOVER — a connection failure re-resolves the server's endpoint
+      from the rendezvous store and retries with backoff for up to
+      ``retry_timeout`` seconds, so a killed-and-respawned server (which
+      re-registers under the same name) is transparent to workers."""
+
+    def __init__(self, server: Union[str, List[str]], lr: float = 0.01,
+                 retry_timeout: float = 60.0):
+        import uuid
+        self.servers = [server] if isinstance(server, str) else list(server)
+        self.server = self.servers[0]  # dense/back-compat target
         self.lr = float(lr)
+        self.retry_timeout = float(retry_timeout)
+        # per-client push sequencing: a retried push the server already
+        # applied (lost reply) is recognized and skipped server-side
+        self._client_key = uuid.uuid4().hex
+        self._seq = 0
+        self._seq_lock = threading.Lock()
 
     def _rpc(self):
         from . import rpc
         return rpc
 
+    def _next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    def _call(self, server: str, fn, args):
+        """rpc_sync with endpoint re-resolution + backoff on TRANSPORT
+        failure only — a server-side exception (shipped back with its
+        original type) means the call executed and must not be retried."""
+        import time as _time
+        rpc = self._rpc()
+        deadline = _time.monotonic() + self.retry_timeout
+        delay = 0.2
+        while True:
+            try:
+                return rpc.rpc_sync(server, fn, args=args)
+            except rpc.RpcTransportError:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(delay)
+                delay = min(delay * 1.6, 2.0)
+                try:
+                    rpc.refresh_worker_info(server)
+                except Exception:
+                    pass  # store briefly unreachable: keep backing off
+
     def create_table(self, name: str, value) -> None:
         arr = np.asarray(value)
-        self._rpc().rpc_sync(self.server, _srv_create,
-                             args=(name, arr.tobytes(), arr.shape,
-                                   str(arr.dtype)))
+        self._call(self.server, _srv_create,
+                   (name, arr.tobytes(), arr.shape, str(arr.dtype)))
 
     def push(self, name: str, ids, grad, wait: bool = True):
         ids = np.asarray(ids, np.int64).reshape(-1)
         g = np.asarray(grad, np.float32).reshape(ids.shape[0], -1)
-        rpc = self._rpc()
         args = (name, ids.tobytes(), g.tobytes(), g.shape[0], g.shape[1],
-                self.lr)
+                self.lr, self._client_key, self._next_seq())
         if wait:
-            return rpc.rpc_sync(self.server, _srv_push, args=args)
-        return rpc.rpc_async(self.server, _srv_push, args=args)
+            return self._call(self.server, _srv_push, args)
+        return self._rpc().rpc_async(self.server, _srv_push, args=args)
 
     def pull(self, name: str, ids, dim: int, dtype=np.float32) -> np.ndarray:
         ids = np.asarray(ids, np.int64).reshape(-1)
-        raw = self._rpc().rpc_sync(self.server, _srv_pull,
-                                   args=(name, ids.tobytes()))
+        raw = self._call(self.server, _srv_pull, (name, ids.tobytes()))
         return np.frombuffer(raw, dtype=dtype).reshape(ids.shape[0], dim)
 
     def table_snapshot(self, name: str) -> np.ndarray:
-        raw, shape, dtype = self._rpc().rpc_sync(
-            self.server, _srv_table_snapshot, args=(name,))
+        raw, shape, dtype = self._call(self.server, _srv_table_snapshot,
+                                       (name,))
         return np.frombuffer(raw, dtype=dtype).reshape(shape)
 
     def stats(self) -> Dict[str, int]:
-        return self._rpc().rpc_sync(self.server, _srv_stats)
+        """Aggregated counters across every server shard."""
+        total: Dict[str, int] = {}
+        for srv in self.servers:
+            for k, v in self._call(srv, _srv_stats, ()).items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    # -- hash sparse tables (sharded across servers) -------------------------
+    def _shard(self, ids: np.ndarray):
+        return ids % len(self.servers)
+
+    def create_sparse_table(self, name: str, dim: int, **cfg) -> None:
+        cfg = dict(cfg, dim=int(dim))
+        for srv in self.servers:
+            self._call(srv, _srv_create_sparse, (name, cfg))
+
+    def push_sparse(self, name: str, ids, grad, slots=None, lr=None) -> None:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        g = np.asarray(grad, np.float32).reshape(ids.shape[0], -1)
+        slots = None if slots is None else \
+            np.asarray(slots, np.int64).reshape(-1)
+        shard = self._shard(ids)
+        for s, srv in enumerate(self.servers):
+            m = shard == s
+            if not m.any():
+                continue
+            self._call(srv, _srv_push_sparse,
+                       (name, ids[m].tobytes(), g[m].tobytes(),
+                        int(m.sum()),
+                        slots[m].tobytes() if slots is not None else None,
+                        lr, f"{self._client_key}/{s}", self._next_seq()))
+
+    def pull_sparse(self, name: str, ids, dim: int, slots=None,
+                    dtype=np.float32) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        slots = None if slots is None else \
+            np.asarray(slots, np.int64).reshape(-1)
+        dtype = np.dtype(dtype)
+        out = np.empty((ids.shape[0], dim), dtype)
+        shard = self._shard(ids)
+        for s, srv in enumerate(self.servers):
+            m = shard == s
+            if not m.any():
+                continue
+            raw = self._call(srv, _srv_pull_sparse,
+                             (name, ids[m].tobytes(),
+                              slots[m].tobytes() if slots is not None
+                              else None))
+            out[m] = np.frombuffer(raw, dtype).reshape(-1, dim)
+        return out
+
+    def shrink(self, name: str, max_unseen: Optional[int] = None,
+               min_show: Optional[int] = None) -> int:
+        return sum(self._call(srv, _srv_shrink,
+                              (name, max_unseen, min_show))
+                   for srv in self.servers)
+
+    def sparse_rows(self, name: str) -> int:
+        return sum(self._call(srv, _srv_sparse_rows, (name,))
+                   for srv in self.servers)
+
+    def save(self, dirname: str) -> None:
+        """Each server snapshots its shard into ``dirname/shard_<i>``."""
+        for i, srv in enumerate(self.servers):
+            self._call(srv, _srv_save, (os.path.join(dirname, f"shard_{i}"),))
+
+    def load(self, dirname: str, server_index: Optional[int] = None) -> None:
+        """Restore snapshots — all servers, or just the respawned one."""
+        for i, srv in enumerate(self.servers):
+            if server_index is not None and i != server_index:
+                continue
+            self._call(srv, _srv_load, (os.path.join(dirname, f"shard_{i}"),))

@@ -34,7 +34,16 @@ from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
-           "get_worker_info", "get_all_worker_infos", "get_current_worker_info"]
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "RpcTransportError"]
+
+
+class RpcTransportError(ConnectionError):
+    """The REQUEST never completed at the transport layer (dial/read
+    failure). Distinct from a server-side exception (re-raised as its
+    original type), so failover retry loops can retry ONLY transport
+    failures instead of re-executing calls the server already ran and
+    answered with an error."""
 
 WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 
@@ -184,6 +193,20 @@ def get_worker_info(name: str) -> WorkerInfo:
     return _state["infos"][name]
 
 
+def refresh_worker_info(name: str) -> WorkerInfo:
+    """Re-resolve ``name``'s endpoint from the rendezvous store.
+
+    A respawned peer (PS failover) re-registers under the same name with a
+    NEW port; callers that cached the old endpoint re-resolve on
+    connection failure instead of failing the job."""
+    info = _state["infos"][name]
+    raw = _state["store"].get(f"rpc/{info.rank}").decode()
+    wname, whost, wport = raw.split(",")
+    fresh = WorkerInfo(wname, info.rank, whost, int(wport))
+    _state["infos"][wname] = fresh
+    return fresh
+
+
 def get_all_worker_infos():
     return list(_state["infos"].values())
 
@@ -194,11 +217,15 @@ def get_current_worker_info() -> WorkerInfo:
 
 def _call(to: str, fn, args, kwargs, timeout):
     info = get_worker_info(to)
-    with socket.create_connection((info.ip, info.port),
-                                  timeout=timeout if timeout and
-                                  timeout > 0 else None) as sock:
-        _send_msg(sock, pickle.dumps((fn, args or (), kwargs or {})))
-        ok, payload = pickle.loads(_recv_msg(sock))
+    try:
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout if timeout and
+                                      timeout > 0 else None) as sock:
+            _send_msg(sock, pickle.dumps((fn, args or (), kwargs or {})))
+            ok, payload = pickle.loads(_recv_msg(sock))
+    except (ConnectionError, OSError, EOFError) as e:
+        raise RpcTransportError(f"rpc to {to} failed in transport: {e}") \
+            from e
     if not ok:
         raise payload
     return payload
