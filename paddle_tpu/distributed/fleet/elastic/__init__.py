@@ -18,9 +18,11 @@ watch loop (a host that loses its slice stops beating).
 
 from .manager import (ELASTIC_ENV_MASTER, ELASTIC_ENV_RESTARTS,
                       ElasticLevel, ElasticManager, ElasticStatus,
-                      enable_elastic, start_worker_heartbeat)
+                      MultiNodeElasticAgent, enable_elastic,
+                      start_worker_heartbeat)
 
 __all__ = [
     "ElasticLevel", "ElasticManager", "ElasticStatus", "enable_elastic",
-    "start_worker_heartbeat", "ELASTIC_ENV_MASTER", "ELASTIC_ENV_RESTARTS",
+    "start_worker_heartbeat", "MultiNodeElasticAgent",
+    "ELASTIC_ENV_MASTER", "ELASTIC_ENV_RESTARTS",
 ]

@@ -9,6 +9,7 @@ This module keeps those states and the watch-loop shape, over our TCPStore.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
@@ -207,9 +208,236 @@ class ElasticManager:
 
     def _clear_beats(self) -> None:
         """Delete (not re-seed) leases: a seeded key would falsely register a
-        worker that never heartbeats, turning every restart into a hang."""
-        for rank in range(self.world_size):
+        worker that never heartbeats, turning every restart into a hang.
+        Clears THIS manager's global-rank window (multi-node: a node
+        supervises ranks [rank_offset, rank_offset + world_size))."""
+        for rank in range(self.rank_offset,
+                          self.rank_offset + self.world_size):
             try:
                 self.store.delete_key(f"elastic/beat/{rank}")
             except Exception:
                 pass
+
+
+class MultiNodeElasticAgent:
+    """Per-NODE launcher agent: level-2 resize beyond one node (round 5;
+    upstream parity: the etcd-backed membership watch in
+    fleet/elastic/manager.py, where every node's launcher leases itself
+    into etcd and np shrinks when a node lease expires).
+
+    Coordination rides one SHARED job store (the analogue of upstream's
+    external etcd — host it outside the trainer nodes so any node may
+    die):
+
+    * every agent leases ``elastic/node/{node_rank}`` each tick;
+    * the SUPERVISOR is the lowest-ranked live node of the current
+      topology — election is implicit in the lease set, so it survives
+      the supervisor's own death (the next-lowest node takes over on the
+      next tick);
+    * only the supervisor writes ``elastic/topology`` records
+      ``{epoch, nodes, restarts}``: node-loss (stale lease) at level 2
+      shrinks ``nodes``; a worker fault flagged by any agent
+      (``elastic/fault/{node}``) keeps ``nodes`` and bumps the epoch so
+      every pod restarts together (the collective must re-form);
+    * every agent ADOPTS a newer epoch: kill local workers, respawn via
+      the launcher-provided callable with its new node index and world.
+
+    Completion: an agent whose pod exits clean sets ``elastic/done`` and
+    waits for the other live nodes (so a late resize still finds a
+    supervisor).
+    """
+
+    def __init__(self, node_rank: int, nnodes: int, nproc_per_node: int,
+                 store, elastic_level: int = ElasticLevel.ELASTIC,
+                 beat_timeout: float = 30.0, node_timeout: float = 10.0,
+                 max_restarts: int = 3, node_grace: float = 120.0,
+                 master_endpoint: Optional[str] = None):
+        # the address WORKERS dial for heartbeats — must be the shared
+        # store's routable endpoint, not loopback, on real multi-host jobs
+        self.master_endpoint = master_endpoint
+        self.node_rank = int(node_rank)
+        self.nproc = int(nproc_per_node)
+        self.elastic_level = int(elastic_level)
+        self.node_timeout = float(node_timeout)
+        self.max_restarts = int(max_restarts)
+        # rolling starts: a node that has NEVER leased is presumed coming
+        # up (not lost) until the grace window ends — resizing it away at
+        # t=0 would shrink a job that was merely starting unevenly
+        self.node_grace = float(node_grace)
+        self._started = time.time()
+        self.store = store
+        self.epoch = 0
+        self.nodes = list(range(int(nnodes)))  # current topology
+        self._local = ElasticManager(
+            world_size=self.nproc, elastic_level=elastic_level,
+            beat_timeout=beat_timeout, max_restarts=max_restarts,
+            store=store, rank_offset=self.node_rank * self.nproc)
+
+    # -- store records -------------------------------------------------------
+    def _beat(self) -> None:
+        self.store.set(f"elastic/node/{self.node_rank}", str(time.time()))
+
+    def _node_age(self, node: int) -> Optional[float]:
+        """None = never leased; a TRANSIENT store error reads as age 0
+        (fresh): one 1-second read hiccup must not count a healthy node
+        as lost and permanently shrink the job."""
+        try:
+            if not self.store.check(f"elastic/node/{node}"):
+                return None
+        except Exception:
+            return 0.0
+        try:
+            raw = self.store.get(f"elastic/node/{node}", timeout=1.0)
+            return time.time() - float(raw.decode())
+        except Exception:
+            return 0.0
+
+    def _live_nodes(self) -> List[int]:
+        in_grace = time.time() - self._started < self.node_grace
+        live = []
+        for n in self.nodes:
+            age = self._node_age(n)
+            if age is None:
+                if in_grace:
+                    live.append(n)  # not yet registered: presumed starting
+            elif age <= self.node_timeout:
+                live.append(n)
+        return live
+
+    def _read_topology(self) -> Optional[Dict]:
+        try:
+            if not self.store.check("elastic/topology"):
+                return None
+            return json.loads(self.store.get("elastic/topology",
+                                             timeout=1.0).decode())
+        except Exception:
+            return None
+
+    def _write_topology(self, nodes: List[int], restarts: int) -> None:
+        """Publish the next-epoch topology WITHOUT adopting it: the
+        supervisor applies its own record through the same adoption path
+        as every other node on its next tick (pre-bumping self.epoch here
+        would make the supervisor skip its own resize — found by the
+        kill-a-node test running the dead topology to completion)."""
+        self.store.set("elastic/topology", json.dumps(
+            {"epoch": self.epoch + 1, "nodes": sorted(nodes),
+             "restarts": restarts}).encode())
+
+    def _my_index(self) -> int:
+        return self.nodes.index(self.node_rank)
+
+    def world_size(self) -> int:
+        return len(self.nodes) * self.nproc
+
+    def worker_env(self) -> Dict[str, str]:
+        env = self._local.worker_env()
+        if self.master_endpoint:
+            env[ELASTIC_ENV_MASTER] = self.master_endpoint
+        env[ELASTIC_ENV_RESTARTS] = str(self._local.restarts)
+        return env
+
+    # -- the loop ------------------------------------------------------------
+    def watch(self, procs: List, respawn: Callable[..., List],
+              poll_interval: float = 0.5) -> int:
+        """Supervise this node's pod; coordinate restarts/resizes through
+        the shared store. ``respawn(restart_count, node_index, n_nodes)``
+        recreates the local worker list for the CURRENT topology."""
+        done = False
+        warned_lost: List[int] = []
+        while True:
+            self._beat()
+            # 1. adopt a newer topology (written by the supervisor)
+            topo = self._read_topology()
+            if topo and topo["epoch"] > self.epoch:
+                self.epoch = topo["epoch"]
+                self.nodes = list(topo["nodes"])
+                self._local.restarts = int(topo["restarts"])
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except Exception:
+                        p.kill()
+                if self.node_rank not in self.nodes:
+                    return 0  # evicted (we were presumed dead): stand down
+                self._local.rank_offset = self._my_index() * self.nproc
+                self._local.world_size = self.nproc
+                self._local._clear_beats()
+                try:
+                    self.store.delete_key(f"elastic/fault/{self.node_rank}")
+                except Exception:
+                    pass
+                done = False
+                procs = respawn(self._local.restarts, self._my_index(),
+                                len(self.nodes))
+                continue
+
+            # 2. local pod state
+            status = self._local.classify(procs)
+            if status == ElasticStatus.COMPLETED and not done:
+                done = True
+                # EPOCH-scoped: a done flag from a pre-restart epoch must
+                # not satisfy this epoch's completion check
+                self.store.set(f"elastic/done/{self.node_rank}",
+                               str(self.epoch))
+            if done:
+                # hold until every live node is done (a supervisor must
+                # remain for stragglers' resizes), then stand down
+                live = self._live_nodes()
+                if all(self._done_epoch(n) >= self.epoch for n in live):
+                    return 0
+            elif status == ElasticStatus.RESTART:
+                if self._local.restarts >= self.max_restarts:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.terminate()
+                    return 1
+                # flag the fault (epoch-tagged); the supervisor bumps the
+                # epoch for all
+                self.store.set(f"elastic/fault/{self.node_rank}",
+                               str(self.epoch))
+
+            # 3. supervisor duties
+            live = self._live_nodes()
+            if live and self.node_rank == min(live):
+                lost = [n for n in self.nodes if n not in live]
+                # a fault flag only counts for the CURRENT epoch — the
+                # flag of a fault the last restart already resolved must
+                # not burn a second restart
+                faults = [n for n in self.nodes
+                          if self._fault_epoch(n) >= self.epoch]
+                if lost and self.elastic_level >= ElasticLevel.ELASTIC:
+                    # RESIZE: drop the dead nodes, everyone restarts on
+                    # the smaller topology
+                    self._write_topology(live, self._local.restarts + 1)
+                elif lost:
+                    if lost != warned_lost:  # level 1: hold for rejoin
+                        warned_lost = list(lost)
+                        print(f"elastic: node(s) {lost} lost; level-1 "
+                              "holds for rejoin (level 2 would resize)",
+                              flush=True)
+                elif faults:
+                    # same-size restart across all pods
+                    self._write_topology(self.nodes,
+                                         self._local.restarts + 1)
+            time.sleep(poll_interval)
+
+    def _done_epoch(self, node: int) -> int:
+        try:
+            if not self.store.check(f"elastic/done/{node}"):
+                return -1
+            return int(self.store.get(f"elastic/done/{node}",
+                                      timeout=1.0).decode())
+        except Exception:
+            return -1
+
+    def _fault_epoch(self, node: int) -> int:
+        try:
+            if not self.store.check(f"elastic/fault/{node}"):
+                return -1
+            return int(self.store.get(f"elastic/fault/{node}",
+                                      timeout=1.0).decode())
+        except Exception:
+            return -1

@@ -32,6 +32,14 @@ def _parse():
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--elastic_timeout", type=float, default=30.0,
                    help="heartbeat staleness that counts as a hang (s)")
+    p.add_argument("--elastic_master", default=None,
+                   help="multi-node elastic: host:port of the SHARED job "
+                        "store (host it outside the trainer nodes — the "
+                        "etcd analogue — so any node may die); node 0 "
+                        "hosts one when omitted")
+    p.add_argument("--node_timeout", type=float, default=10.0,
+                   help="multi-node elastic: node-lease staleness that "
+                        "counts a whole node as lost (s)")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -106,7 +114,33 @@ def launch_main() -> int:
         else os.environ.get("PADDLE_TRAINER_ENDPOINTS", master)
 
     manager = None
-    if args.elastic_level > 0:
+    agent = None
+    if args.elastic_level > 0 and nnodes > 1:
+        # round 5: per-node agents coordinating through a SHARED job store
+        # (supervisor = lowest live node) — level-2 resize works across
+        # nodes; kill a whole node and the survivors re-form the world
+        from ..fleet.elastic import MultiNodeElasticAgent
+        from ..store import TCPStore
+        if args.elastic_master:
+            host, port = args.elastic_master.rsplit(":", 1)
+            job_store = TCPStore(host, int(port))
+            store_ep = args.elastic_master
+        else:
+            # default: node 0 hosts the job store BELOW the endpoint port
+            # ladder (base_port + i grows upward — sharing a port with a
+            # trainer endpoint would break rendezvous)
+            mhost, mport = master.rsplit(":", 1)
+            store_ep = f"{mhost}:{int(mport) - 2}"
+            job_store = TCPStore(mhost, int(mport) - 2,
+                                 is_master=(args.rank == 0))
+        agent = MultiNodeElasticAgent(
+            node_rank=args.rank, nnodes=nnodes, nproc_per_node=nproc,
+            store=job_store, elastic_level=args.elastic_level,
+            beat_timeout=args.elastic_timeout,
+            node_timeout=args.node_timeout,
+            max_restarts=args.max_restarts,
+            master_endpoint=store_ep)
+    elif args.elastic_level > 0:
         from ..fleet.elastic import ElasticManager
         manager = ElasticManager(world_size=world,
                                  elastic_level=args.elastic_level,
@@ -114,6 +148,47 @@ def launch_main() -> int:
                                  max_restarts=args.max_restarts,
                                  rank_offset=args.rank * nproc,
                                  single_node=(nnodes == 1))
+
+    if agent is not None:
+        def spawn_node(restart_count: int, node_index: int,
+                       n_nodes: int) -> List[subprocess.Popen]:
+            cur_world = n_nodes * nproc
+            # real clusters provide PADDLE_TRAINER_ENDPOINTS (one per
+            # global rank); the localhost ladder is the single-host
+            # simulation fallback. NOTE: after a resize the provided list
+            # is sliced to the surviving ranks in topology order.
+            provided = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+            if provided and len(provided.split(",")) >= cur_world:
+                cur_endpoints = ",".join(
+                    provided.split(",")[:cur_world])
+            else:
+                cur_endpoints = ",".join(
+                    f"127.0.0.1:{base_port + 100 * restart_count + i}"
+                    for i in range(cur_world))
+            out: List[subprocess.Popen] = []
+            for local_rank in range(nproc):
+                rank = node_index * nproc + local_rank
+                env = dict(os.environ)
+                env.update({
+                    "PADDLE_TRAINER_ID": str(rank),
+                    "PADDLE_TRAINERS_NUM": str(cur_world),
+                    "PADDLE_TRAINER_ENDPOINTS": cur_endpoints,
+                    "PADDLE_CURRENT_ENDPOINT":
+                        cur_endpoints.split(",")[rank],
+                    "PADDLE_MASTER": master,
+                    "FLAGS_selected_devices": args.devices or "",
+                })
+                env.update(agent.worker_env())
+                suffix = f".{restart_count}" if restart_count else ""
+                logf = open(os.path.join(
+                    args.log_dir, f"workerlog.{local_rank}{suffix}"), "w")
+                cmd = [sys.executable, args.script] + list(args.script_args)
+                out.append(subprocess.Popen(cmd, env=env, stdout=logf,
+                                            stderr=logf))
+            return out
+
+        procs = spawn_node(0, agent._my_index(), len(agent.nodes))
+        return agent.watch(procs, spawn_node)
 
     def spawn(restart_count: int = 0) -> List[subprocess.Popen]:
         # elastic level 2 may have RESIZED the world on membership loss:
