@@ -313,6 +313,14 @@ class MultiNodeElasticAgent:
         except Exception:
             return None
 
+    def _write_exit(self) -> None:
+        """Publish a terminal record: restart budget exhausted — every
+        adopter terminates its pod and exits 1 (instead of the whole job
+        hanging with dead workers)."""
+        self.store.set("elastic/topology", json.dumps(
+            {"epoch": self.epoch + 1, "nodes": sorted(self.nodes),
+             "restarts": self._local.restarts, "exit": True}).encode())
+
     def _write_topology(self, nodes: List[int], restarts: int) -> None:
         """Publish the next-epoch topology WITHOUT adopting it: the
         supervisor applies its own record through the same adoption path
@@ -333,19 +341,37 @@ class MultiNodeElasticAgent:
         env = self._local.worker_env()
         if self.master_endpoint:
             env[ELASTIC_ENV_MASTER] = self.master_endpoint
-        env[ELASTIC_ENV_RESTARTS] = str(self._local.restarts)
+        # the reload-your-checkpoint signal follows the EPOCH (resizes
+        # bump it too), not the fault-restart budget counter
+        env[ELASTIC_ENV_RESTARTS] = str(self.epoch)
         return env
 
     # -- the loop ------------------------------------------------------------
     def watch(self, procs: List, respawn: Callable[..., List],
               poll_interval: float = 0.5) -> int:
         """Supervise this node's pod; coordinate restarts/resizes through
-        the shared store. ``respawn(restart_count, node_index, n_nodes)``
-        recreates the local worker list for the CURRENT topology."""
+        the shared store. ``respawn(epoch, node_index, topology_nodes)``
+        recreates the local worker list for the CURRENT topology
+        (``topology_nodes`` carries the surviving ORIGINAL node ranks so
+        the launcher can map operator-provided per-node endpoints)."""
         done = False
         warned_lost: List[int] = []
+
+        def _safe_set(key, val):
+            # the shared store may blip (or its host may be the one that
+            # died) — supervision must keep looping, not unwind and
+            # orphan the running workers
+            try:
+                self.store.set(key, val)
+                return True
+            except Exception:
+                return False
+
         while True:
-            self._beat()
+            try:
+                self._beat()
+            except Exception:
+                pass
             # 1. adopt a newer topology (written by the supervisor)
             topo = self._read_topology()
             if topo and topo["epoch"] > self.epoch:
@@ -360,6 +386,8 @@ class MultiNodeElasticAgent:
                         p.wait(timeout=10)
                     except Exception:
                         p.kill()
+                if topo.get("exit"):
+                    return 1  # restart budget exhausted: terminal record
                 if self.node_rank not in self.nodes:
                     return 0  # evicted (we were presumed dead): stand down
                 self._local.rank_offset = self._my_index() * self.nproc
@@ -370,34 +398,29 @@ class MultiNodeElasticAgent:
                 except Exception:
                     pass
                 done = False
-                procs = respawn(self._local.restarts, self._my_index(),
-                                len(self.nodes))
+                procs = respawn(self.epoch, self._my_index(),
+                                list(self.nodes))
                 continue
 
             # 2. local pod state
             status = self._local.classify(procs)
             if status == ElasticStatus.COMPLETED and not done:
-                done = True
                 # EPOCH-scoped: a done flag from a pre-restart epoch must
                 # not satisfy this epoch's completion check
-                self.store.set(f"elastic/done/{self.node_rank}",
-                               str(self.epoch))
+                done = _safe_set(f"elastic/done/{self.node_rank}",
+                                 str(self.epoch))
             if done:
                 # hold until every live node is done (a supervisor must
                 # remain for stragglers' resizes), then stand down
                 live = self._live_nodes()
                 if all(self._done_epoch(n) >= self.epoch for n in live):
                     return 0
-            elif status == ElasticStatus.RESTART:
-                if self._local.restarts >= self.max_restarts:
-                    for p in procs:
-                        if p.poll() is None:
-                            p.terminate()
-                    return 1
-                # flag the fault (epoch-tagged); the supervisor bumps the
-                # epoch for all
-                self.store.set(f"elastic/fault/{self.node_rank}",
-                               str(self.epoch))
+            elif status in (ElasticStatus.RESTART, ElasticStatus.ERROR):
+                # flag the fault (epoch-tagged); the SUPERVISOR decides
+                # between a restart and a terminal exit record — a local
+                # return here would leave the other nodes waiting forever
+                _safe_set(f"elastic/fault/{self.node_rank}",
+                          str(self.epoch))
 
             # 3. supervisor duties
             live = self._live_nodes()
@@ -410,8 +433,13 @@ class MultiNodeElasticAgent:
                           if self._fault_epoch(n) >= self.epoch]
                 if lost and self.elastic_level >= ElasticLevel.ELASTIC:
                     # RESIZE: drop the dead nodes, everyone restarts on
-                    # the smaller topology
-                    self._write_topology(live, self._local.restarts + 1)
+                    # the smaller topology. Does NOT consume the
+                    # fault-restart budget — checkpoint reload is keyed
+                    # on the epoch, which bumps anyway.
+                    try:
+                        self._write_topology(live, self._local.restarts)
+                    except Exception:
+                        pass
                 elif lost:
                     if lost != warned_lost:  # level 1: hold for rejoin
                         warned_lost = list(lost)
@@ -419,9 +447,15 @@ class MultiNodeElasticAgent:
                               "holds for rejoin (level 2 would resize)",
                               flush=True)
                 elif faults:
-                    # same-size restart across all pods
-                    self._write_topology(self.nodes,
-                                         self._local.restarts + 1)
+                    if self._local.restarts + 1 > self.max_restarts:
+                        try:
+                            self._write_exit()
+                        except Exception:
+                            pass
+                    else:
+                        # same-size restart across all pods
+                        self._write_topology(self.nodes,
+                                             self._local.restarts + 1)
             time.sleep(poll_interval)
 
     def _done_epoch(self, node: int) -> int:

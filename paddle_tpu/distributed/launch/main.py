@@ -28,7 +28,8 @@ def _parse():
     p.add_argument("--trainer_num", type=int, default=0)
     p.add_argument("--elastic_level", type=int, default=0,
                    help="0 off; 1 restart-on-fault (same world size); "
-                        "2 reserved for resize")
+                        "2 resize on membership loss (single- AND "
+                        "multi-node; see --elastic_master)")
     p.add_argument("--max_restarts", type=int, default=3)
     p.add_argument("--elastic_timeout", type=float, default=30.0,
                    help="heartbeat staleness that counts as a hang (s)")
@@ -149,45 +150,54 @@ def launch_main() -> int:
                                  rank_offset=args.rank * nproc,
                                  single_node=(nnodes == 1))
 
+    def _spawn_worker(rank, cur_world, cur_endpoints, local_rank,
+                      restart_count, extra_env):
+        """One worker Popen — shared by the single-node and multi-node
+        spawn paths so their env assembly cannot diverge."""
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(cur_world),
+            "PADDLE_TRAINER_ENDPOINTS": cur_endpoints,
+            "PADDLE_CURRENT_ENDPOINT":
+                cur_endpoints.split(",")[rank]
+                if rank < len(cur_endpoints.split(",")) else master,
+            "PADDLE_MASTER": master,
+            "FLAGS_selected_devices": args.devices or "",
+        })
+        env.update(extra_env)
+        suffix = f".{restart_count}" if restart_count else ""
+        logf = open(os.path.join(
+            args.log_dir, f"workerlog.{local_rank}{suffix}"), "w")
+        cmd = [sys.executable, args.script] + list(args.script_args)
+        return subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
+
     if agent is not None:
-        def spawn_node(restart_count: int, node_index: int,
-                       n_nodes: int) -> List[subprocess.Popen]:
-            cur_world = n_nodes * nproc
+        def spawn_node(epoch: int, node_index: int,
+                       topo_nodes: List[int]) -> List[subprocess.Popen]:
+            cur_world = len(topo_nodes) * nproc
             # real clusters provide PADDLE_TRAINER_ENDPOINTS (one per
-            # global rank); the localhost ladder is the single-host
-            # simulation fallback. NOTE: after a resize the provided list
-            # is sliced to the surviving ranks in topology order.
+            # ORIGINAL global rank); after a resize the surviving nodes
+            # keep THEIR OWN addresses (selected by original node rank),
+            # remapped into the new dense rank order. The localhost
+            # ladder is the single-host simulation fallback.
             provided = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
-            if provided and len(provided.split(",")) >= cur_world:
+            if provided and                     len(provided.split(",")) >= (max(topo_nodes) + 1) * nproc:
+                eps = provided.split(",")
                 cur_endpoints = ",".join(
-                    provided.split(",")[:cur_world])
+                    eps[n * nproc + j]
+                    for n in topo_nodes for j in range(nproc))
             else:
                 cur_endpoints = ",".join(
-                    f"127.0.0.1:{base_port + 100 * restart_count + i}"
+                    f"127.0.0.1:{base_port + 100 * epoch + i}"
                     for i in range(cur_world))
-            out: List[subprocess.Popen] = []
-            for local_rank in range(nproc):
-                rank = node_index * nproc + local_rank
-                env = dict(os.environ)
-                env.update({
-                    "PADDLE_TRAINER_ID": str(rank),
-                    "PADDLE_TRAINERS_NUM": str(cur_world),
-                    "PADDLE_TRAINER_ENDPOINTS": cur_endpoints,
-                    "PADDLE_CURRENT_ENDPOINT":
-                        cur_endpoints.split(",")[rank],
-                    "PADDLE_MASTER": master,
-                    "FLAGS_selected_devices": args.devices or "",
-                })
-                env.update(agent.worker_env())
-                suffix = f".{restart_count}" if restart_count else ""
-                logf = open(os.path.join(
-                    args.log_dir, f"workerlog.{local_rank}{suffix}"), "w")
-                cmd = [sys.executable, args.script] + list(args.script_args)
-                out.append(subprocess.Popen(cmd, env=env, stdout=logf,
-                                            stderr=logf))
-            return out
+            return [
+                _spawn_worker(node_index * nproc + lr, cur_world,
+                              cur_endpoints, lr, epoch,
+                              agent.worker_env())
+                for lr in range(nproc)]
 
-        procs = spawn_node(0, agent._my_index(), len(agent.nodes))
+        procs = spawn_node(0, agent._my_index(), list(agent.nodes))
         return agent.watch(procs, spawn_node)
 
     def spawn(restart_count: int = 0) -> List[subprocess.Popen]:
@@ -199,28 +209,11 @@ def launch_main() -> int:
         cur_endpoints = ",".join(
             f"127.0.0.1:{base_port + i}" for i in range(cur_world)) \
             if nnodes == 1 else endpoints
-        out: List[subprocess.Popen] = []
-        for local_rank in range(cur_nproc):
-            rank = args.rank * cur_nproc + local_rank
-            env = dict(os.environ)
-            env.update({
-                "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_TRAINERS_NUM": str(cur_world),
-                "PADDLE_TRAINER_ENDPOINTS": cur_endpoints,
-                "PADDLE_CURRENT_ENDPOINT": cur_endpoints.split(",")[rank]
-                if rank < len(cur_endpoints.split(",")) else master,
-                "PADDLE_MASTER": master,
-                "FLAGS_selected_devices": args.devices or "",
-            })
-            if manager is not None:
-                env.update(manager.worker_env())
-            suffix = f".{restart_count}" if restart_count else ""
-            logf = open(os.path.join(
-                args.log_dir, f"workerlog.{local_rank}{suffix}"), "w")
-            cmd = [sys.executable, args.script] + list(args.script_args)
-            out.append(subprocess.Popen(cmd, env=env, stdout=logf,
-                                        stderr=logf))
-        return out
+        extra = manager.worker_env() if manager is not None else {}
+        return [
+            _spawn_worker(args.rank * cur_nproc + lr, cur_world,
+                          cur_endpoints, lr, restart_count, extra)
+            for lr in range(cur_nproc)]
 
     if world == 1 and manager is None:
         # single worker: run inline so stdout/tty behave normally

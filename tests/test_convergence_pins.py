@@ -1,0 +1,141 @@
+"""Convergence-quality pins for the detection/recommendation configs
+(VERDICT r4 #8): BASELINE's PP-YOLOE mAP and DeepFM AUC parity targets
+are unverifiable against real datasets in a zero-egress build, so these
+fixed-seed SYNTHETIC tasks put numeric thresholds on the same train
+pipelines — a silent quality regression (assigner, loss, embedding path)
+now fails a test instead of passing a loss-goes-down smoke.
+
+Calibration (2026-07-31, CPU): DeepFM reaches AUC 0.829 on a held-out
+split vs the Bayes ceiling 0.865 of the generating process (600 steps,
+~4 s); PP-YOLOE reaches detection-recall 1.0 (from 0.0) overfitting a
+4-image set in 120 steps (~2-3 min — slow tier).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _auc(scores, labels):
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def test_deepfm_auc_pin():
+    """DeepFM on a synthetic CTR task with known structure must reach
+    AUC >= 0.78 (measured 0.829; Bayes ceiling of the task 0.865)."""
+    from paddle_tpu.models.deepfm import DeepFM, DeepFMConfig
+
+    paddle.seed(7)
+    F, V, D = 8, 1000, 13
+    cfg = DeepFMConfig(sparse_feature_number=V, sparse_feature_dim=8,
+                       num_sparse_fields=F, dense_feature_dim=D,
+                       fc_sizes=(64, 32))
+    model = DeepFM(cfg)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=model.parameters())
+    bce = paddle.nn.BCEWithLogitsLoss()
+
+    rng = np.random.default_rng(0)
+    w_sparse = rng.normal(0, 1.0, V).astype(np.float32)
+    w_dense = rng.normal(0, 0.5, D).astype(np.float32)
+
+    def make_batch(n, r):
+        sp = r.integers(0, V, (n, F)).astype(np.int64)
+        de = r.normal(0, 1, (n, D)).astype(np.float32)
+        logit = w_sparse[sp].sum(1) * 0.6 + de @ w_dense
+        y = (r.uniform(0, 1, n) < 1 / (1 + np.exp(-logit))) \
+            .astype(np.float32)
+        return sp, de, y
+
+    @paddle.jit.to_static
+    def step(sp, de, y):
+        logit = model(sp, de)
+        loss = bce(logit.reshape([-1]), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    tr = np.random.default_rng(1)
+    for _ in range(600):
+        sp, de, y = make_batch(256, tr)
+        step(*[paddle.to_tensor(v) for v in (sp, de, y)])
+
+    vr = np.random.default_rng(99)
+    sp, de, y = make_batch(4096, vr)
+    model.eval()
+    s = model(paddle.to_tensor(sp), paddle.to_tensor(de)).numpy().reshape(-1)
+    auc = _auc(s, y)
+    assert auc >= 0.78, f"DeepFM AUC regressed: {auc:.4f} (pin 0.78, " \
+                        f"measured 0.829, ceiling 0.865)"
+
+
+@pytest.mark.slow
+def test_ppyoloe_detection_recall_pin():
+    """PP-YOLOE must OVERFIT a fixed 4-image synthetic set: after 120
+    steps, >= 75% of ground-truth boxes are matched by a prediction of
+    the right class at IoU >= 0.5 and score > 0.3 (measured 1.0 from a
+    0.0 pre-train baseline) — the full assigner/VFL/GIoU/DFL/NMS pipeline
+    has to work end to end for this to move at all."""
+    from paddle_tpu.models.ppyoloe import PPYOLOE, PPYOLOEConfig
+
+    paddle.seed(11)
+    C, SZ, B, M = 4, 128, 4, 2
+    model = PPYOLOE(PPYOLOEConfig.tiny(num_classes=C))
+    opt = paddle.optimizer.Adam(learning_rate=1.5e-3,
+                                parameters=model.parameters())
+
+    rng = np.random.default_rng(5)
+    imgs = rng.normal(0, 1, (B, SZ, SZ, 3)).astype(np.float32)
+    centers = rng.uniform(30, SZ - 30, (B, M, 2))
+    wh = rng.uniform(30, 60, (B, M, 2))
+    boxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                           -1).astype(np.float32)
+    labels = rng.integers(0, C, (B, M)).astype(np.int32)
+    mask = np.ones((B, M), np.float32)
+    t = tuple(paddle.to_tensor(v) for v in (imgs, labels, boxes, mask))
+
+    @paddle.jit.to_static
+    def step(img, lab, box, msk):
+        out = model.loss(img, lab, box, msk)
+        out["loss"].backward()
+        opt.step()
+        opt.clear_grad()
+        return out["loss"]
+
+    def iou(a, b):
+        x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+        x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(0, x2 - x1) * max(0, y2 - y1)
+        ua = (a[2] - a[0]) * (a[3] - a[1]) + \
+            (b[2] - b[0]) * (b[3] - b[1]) - inter
+        return inter / max(ua, 1e-9)
+
+    def recall():
+        model.eval()
+        dets = model.predict(t[0], score_threshold=0.3)
+        out = dets[0].numpy() if isinstance(dets, (tuple, list)) \
+            else dets.numpy()
+        matched = total = 0
+        for b in range(B):
+            det_b = out[b] if out.ndim == 3 else out
+            for m in range(M):
+                total += 1
+                gt, gl = boxes[b, m], labels[b, m]
+                matched += any(
+                    d[1] > 0.3 and int(d[0]) == gl
+                    and iou(d[2:6], gt) >= 0.5 for d in det_b)
+        model.train()
+        return matched / total
+
+    for _ in range(120):
+        step(*t)
+    rec = recall()
+    assert rec >= 0.75, f"PP-YOLOE recall regressed: {rec:.2f} " \
+                        "(pin 0.75, measured 1.0)"

@@ -338,6 +338,7 @@ class TestFlashDropout:
         return (paddle.randn([B, L, H, D]), paddle.randn([B, L, H, D]),
                 paddle.randn([B, L, H, D]))
 
+    @pytest.mark.slow
     def test_dropout_statistical_parity(self):
         """E[dropout attention] == no-dropout attention: average over many
         seeds converges to the clean output (unbiasedness of the

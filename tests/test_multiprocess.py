@@ -54,6 +54,7 @@ def test_two_process_dp_parity(tmp_path):
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_four_process_hybrid_dp2mp4_and_checkpoint(tmp_path):
     """4 processes x 2 devices = 8-device global mesh running a hybrid
     dp2 x mp4 train step with loss parity vs a serial reference, then a

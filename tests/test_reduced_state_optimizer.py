@@ -102,6 +102,7 @@ def test_master_free_fused_flat_buffer_is_bf16():
     assert per_param == 6
 
 
+@pytest.mark.slow
 def test_master_free_without_sr_stalls_where_sr_learns():
     """Proof stochastic rounding is load-bearing: with a small LR the
     deterministic bf16 write-back loses sub-ulp updates and learns slower

@@ -307,6 +307,7 @@ def _build_hetero_layer():
     return PipelineLayer(layers=descs, loss_fn=_mse)
 
 
+@pytest.mark.slow
 def test_hetero_pipeline_parity_vs_serial():
     """Round 5 (VERDICT r4 #4): non-uniform stacks train with REAL stage
     placement — switch-branch stages in the ppermute scan — and match the
@@ -387,6 +388,7 @@ def test_hetero_pipeline_stage_placement_physical():
         set_hybrid_communicate_group(None)
 
 
+@pytest.mark.slow
 def test_interleaved_vpp_parity_vs_serial():
     """virtual_pp_degree=2 (interleaved placement, upstream VPP parity):
     same numerics as serial; the option exists for schedule parity even
@@ -565,7 +567,7 @@ def test_find_uniform_run_periodic():
 
 
 @pytest.mark.parametrize("dp,pp", [
-    pytest.param(1, 2),
+    pytest.param(1, 2, marks=pytest.mark.slow),
     pytest.param(2, 4, marks=pytest.mark.slow),
 ])
 def test_fleet_pipeline_periodic_stack_parity(dp, pp):
