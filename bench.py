@@ -4,11 +4,14 @@ Prints ONE JSON line:
   {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
 
 Metric of record (BASELINE.json): tokens/sec/chip on a Llama-2-style decoder.
-A single TPU v5 lite chip cannot hold 7B for training, so the bench runs the
-LARGEST Llama that fits — 1.59B params at seq 4096 (the north-star regime's
-per-chip story) — using the reduced-footprint optimizer (bf16 moments,
-master-weight-free bf16 params with stochastic rounding; 6 bytes/param of
-state), scan-over-layers and activation recompute. ``vs_baseline`` is
+A single TPU v5 lite chip cannot hold 7B for training, so the bench runs
+1.59B params at seq 4096 — the benchmark-of-record config since round 3
+(kept for cross-round continuity; the measured single-chip ceiling is
+2.067B, RESULTS.md "single-chip wall") — using the reduced-footprint
+optimizer (int8 block-
+quantized moments via the fused Pallas update, master-weight-free bf16
+params with stochastic rounding; ~4 bytes/param of state), scan-over-layers
+and activation recompute. ``vs_baseline`` is
 achieved-MFU / 0.45 (the A100-class MFU target recorded in BASELINE.md —
 the reference published no numbers).
 """
@@ -144,17 +147,18 @@ def main() -> None:
     on_tpu = dev.platform != "cpu"
 
     if on_tpu:
-        # 1.59B params: the largest config that trains on one 16GB v5e —
-        # enabled by bf16 m/v + master-free bf16 AdamW (6 B/param state)
+        # 1.59B params at batch 6 on one 16GB v5e — enabled by int8 m/v
+        # (fused Pallas update) + master-free bf16 AdamW (~4 B/param state)
         cfg = LlamaConfig(vocab_size=32000, hidden_size=2560,
                           intermediate_size=6912, num_hidden_layers=18,
                           num_attention_heads=20, num_key_value_heads=20,
                           max_position_embeddings=4096,
                           scan_layers=True, recompute=True)
-        # seq 4096 / bs 3 is the measured MFU sweet spot for this model
-        # (RESULTS.md north-star table: 0.614 vs 0.595 at seq 2048/bs 6);
-        # 24 steps = 6 timed calls, enough samples for honest p50/p90
-        batch, seq, steps, scan_k = 3, 4096, 24, 4
+        # int8 moments (round 5: fused Pallas update) free ~3GB vs bf16
+        # state, so batch 6 now fits — the measured sweet spot (b3 0.6123,
+        # b5 0.6202, b6 0.6306, b8 OOM); 24 steps = 6 timed calls, enough
+        # samples for honest p50/p90
+        batch, seq, steps, scan_k = 6, 4096, 24, 4
         peak_flops = 197e12  # v5e bf16 peak per chip
     else:  # CPU smoke config so the bench always runs
         cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=4,
@@ -164,13 +168,13 @@ def main() -> None:
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
-    # big scan-stacked params: the per-param update path is the fused one
-    # under whole-step jit (XLA folds it in); bf16 state halves optimizer
-    # HBM traffic and the master-free write-back uses stochastic rounding
+    # big scan-stacked params: on TPU the int8-state update runs as ONE
+    # fused Pallas kernel per param (ops/q8_adam_pallas.py); the
+    # master-free bf16 write-back uses stochastic rounding
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters(),
                                  use_multi_tensor=not on_tpu,
-                                 moment_dtype="bfloat16" if on_tpu else "float32",
+                                 moment_dtype="int8" if on_tpu else "float32",
                                  use_master_weights=False if on_tpu else None)
     if on_tpu:
         model, opt = paddle.amp.decorate(model, opt, level="O2",

@@ -454,8 +454,11 @@ class MultiNodeElasticAgent:
                             pass
                     else:
                         # same-size restart across all pods
-                        self._write_topology(self.nodes,
-                                             self._local.restarts + 1)
+                        try:
+                            self._write_topology(self.nodes,
+                                                 self._local.restarts + 1)
+                        except Exception:
+                            pass  # store blip: retried next tick
             time.sleep(poll_interval)
 
     def _done_epoch(self, node: int) -> int:
