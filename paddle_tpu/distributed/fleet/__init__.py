@@ -160,13 +160,19 @@ def _ps_rpc_endpoint(rm) -> str:
     return f"{host or '127.0.0.1'}:{int(port) + 1}"
 
 
-def init_server(*args, use_ps_service: bool = False, **kwargs) -> None:
+def init_server(*args, use_ps_service: bool = False,
+                recover_dir: Optional[str] = None, **kwargs) -> None:
     """Start this server's KV plane (reference: BrpcPsServer startup loading
     table shards). ``use_ps_service=True`` additionally joins the job RPC
     plane and HOSTS TABLE STATE in this process (``distributed.ps_service``)
     — workers then push (rows, values) sparse grads across the process
-    boundary instead of mutating mesh-local tables."""
+    boundary instead of mutating mesh-local tables. ``recover_dir``: load
+    this server's shard snapshot (``<dir>/shard_<index>``) BEFORE joining
+    the RPC plane, so a respawned server never serves an empty table to a
+    worker whose push raced the operator's recovery call (upstream:
+    PServer startup table load)."""
     global _server_store
+    import os as _os
     from ..store import TCPStore
     rm = _rm()
     ep = rm.get_pserver_endpoints()[rm.server_index()]
@@ -178,6 +184,10 @@ def init_server(*args, use_ps_service: bool = False, **kwargs) -> None:
         from .. import ps_service
         ps_service.reset_server_state()
         idx = rm.server_index()
+        if recover_dir:
+            shard = _os.path.join(recover_dir, f"shard_{idx}")
+            if _os.path.isdir(shard):
+                ps_service._srv_load(shard)
         _rpc.init_rpc(f"ps/{idx}", rank=idx,
                       world_size=rm.server_num() + rm.worker_num(),
                       master_endpoint=_ps_rpc_endpoint(rm))

@@ -207,9 +207,34 @@ class PipelineParallel(Layer):
                         "but stages are NOT placed on devices (no "
                         "pipelining).", stacklevel=2)
 
+    def _dismantle_hetero(self, e) -> bool:
+        """First-call shape validation rejected the stack: unpack the
+        weights back into the original blocks and fall back to grad
+        accumulation — the pre-round-5 behavior for shape-changing stacks
+        (numerics match 1F1B, no stage placement). Optimizers built from
+        wrapped.parameters() (the fused buffers) must be rebuilt;
+        optimizers built from the ORIGINAL layer's parameters keep
+        working. Validation runs before any compute, so retrying the same
+        batch on the fallback is safe."""
+        from .tpu_pipeline import HeteroPipelinedStack
+        if not isinstance(self._engine, HeteroPipelinedStack):
+            return False
+        import warnings
+        warnings.warn(
+            f"pipeline parallel: {e}. Dismantled the hetero engine; "
+            "continuing on the grad-accumulation fallback.", stacklevel=3)
+        self._engine.dismantle()
+        self._engine = None
+        return True
+
     def forward(self, *args, **kwargs):
         if self._engine is not None:
-            return self._engine(*args, **kwargs)
+            from .tpu_pipeline import NonUniformStackError
+            try:
+                return self._engine(*args, **kwargs)
+            except NonUniformStackError as e:
+                if not self._dismantle_hetero(e):
+                    raise
         return self._layers(*args, **kwargs)
 
     def _split_micro(self, data):
@@ -225,8 +250,13 @@ class PipelineParallel(Layer):
     def train_batch(self, data, optimizer=None, lr_scheduler=None, scaler=None):
         self._layers.train()
         if self._engine is not None:
-            return self._train_batch_pipelined(data, optimizer, lr_scheduler,
-                                               scaler)
+            from .tpu_pipeline import NonUniformStackError
+            try:
+                return self._train_batch_pipelined(data, optimizer,
+                                                   lr_scheduler, scaler)
+            except NonUniformStackError as e:
+                if not self._dismantle_hetero(e):
+                    raise  # falls through to the grad-accum loop below
         micros = self._split_micro(data)
         n = len(micros)
         total = None

@@ -655,6 +655,9 @@ class HeteroPipelinedStack:
             arr = jax.device_put(arr, NamedSharding(mesh, P(axis, None)))
             self._buffers[dt] = Parameter(arr, name=f"pp_hetero_{dt}")
 
+        self._pipeline_layer = pipeline_layer
+        self._orig_entries = list(entries)
+        self._orig_run_function = pipeline_layer.run_function
         # the originals are TRACE TEMPLATES from here on — their values
         # live in the fused buffers; shrink every packed leaf to a scalar
         # placeholder so the engine doesn't keep a second full copy of the
@@ -671,6 +674,25 @@ class HeteroPipelinedStack:
             [l for l, _ in self._post if isinstance(l, _Layer)]
         pipeline_layer.run_function = LayerList(keep)
         pipeline_layer._engine = self
+
+    def dismantle(self) -> None:
+        """Undo engine construction: unpack every stage's weights from the
+        fused buffers back into the original block parameters and restore
+        the PipelineLayer's entry list — the graceful path back to the
+        grad-accumulation fallback when first-call validation rejects the
+        stack. NOTE: an optimizer built from this engine's parameters()
+        (the fused buffers) must be rebuilt after dismantling."""
+        for s in range(self._S):
+            row = {dt: self._buffers[dt]._data[s] for dt in self._dtypes}
+            for bi, name, shape, off, dt in self._layouts[s]:
+                sd = self._stage_blocks[s][bi].state_dict()
+                n = int(np.prod(shape))
+                sd[name]._set_data(
+                    jax.lax.dynamic_slice_in_dim(row[dt], off, n, 0)
+                    .reshape(shape))
+        self._pipeline_layer._entries = self._orig_entries
+        self._pipeline_layer.run_function = self._orig_run_function
+        self._pipeline_layer._engine = None
 
     # -- parameters the optimizer owns --------------------------------------
     def parameters(self):

@@ -305,10 +305,11 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.fleet.role_maker import UserDefinedRoleMaker, Role
 idx = int(sys.argv[1])
 eps = sys.argv[2].split(",")
+recover = sys.argv[3] if len(sys.argv) > 3 else None
 rm = UserDefinedRoleMaker(role=Role.SERVER, current_id=idx, worker_num=2,
                           server_endpoints=eps)
 fleet.init(rm, is_collective=False)
-fleet.init_server(use_ps_service=True)
+fleet.init_server(use_ps_service=True, recover_dir=recover)
 fleet.run_server()
 """
 
@@ -360,9 +361,11 @@ def test_deepfm_ps_2server_failover(tmp_path):
     stop_file = str(tmp_path / "stop2")
     snap_dir = str(tmp_path / "snaps")
 
-    def spawn_server(idx):
-        return subprocess.Popen(
-            [sys.executable, "-c", _SERVER_CODE, str(idx), eps], env=env)
+    def spawn_server(idx, recover=None):
+        cmd = [sys.executable, "-c", _SERVER_CODE, str(idx), eps]
+        if recover:
+            cmd.append(recover)
+        return subprocess.Popen(cmd, env=env)
 
     servers = [spawn_server(0), spawn_server(1)]
     worker2 = subprocess.Popen(
@@ -422,10 +425,10 @@ def test_deepfm_ps_2server_failover(tmp_path):
         # --- kill server 1 (the non-rendezvous-master shard) mid-run ------
         servers[1].kill()
         servers[1].wait(timeout=30)
-        servers[1] = spawn_server(1)
-        # the respawned shard re-registers under ps/1; the client's retry
-        # loop re-resolves it. Restore its shard from the snapshot.
-        client.load(snap_dir, server_index=1)
+        # the respawn loads its shard snapshot BEFORE joining the RPC
+        # plane (init_server recover_dir), so a worker push that races
+        # the recovery never observes an empty table
+        servers[1] = spawn_server(1, recover=snap_dir)
         recovered = val_auc()   # shard-1 rows back at snapshot state
         train_steps(30)
         final = val_auc()

@@ -246,24 +246,37 @@ def test_non_uniform_stack_falls_back():
         set_hybrid_communicate_group(None)
 
 
-def test_hetero_shape_varying_stack_raises_actionable():
+def test_hetero_shape_varying_stack_dismantles_to_fallback():
     """Round 5: a shape-VARYING non-uniform stack gets the hetero engine at
-    construction, and the first call raises the actionable boundary-shape
-    error (the SPMD scan needs one uniform hop buffer)."""
-    from paddle_tpu.distributed.fleet.tpu_pipeline import (
-        HeteroPipelinedStack, NonUniformStackError)
+    construction; the first call's boundary-shape validation DISMANTLES it
+    (weights unpacked back into the original blocks) and training
+    continues on the grad-accumulation fallback — the pre-round-5 UX for
+    such stacks, with a warning instead of a silent engine."""
+    from paddle_tpu.distributed.fleet.tpu_pipeline import HeteroPipelinedStack
     paddle.seed(5)
     try:
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 2}
         fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(9)
         model = PipelineLayer(layers=[LayerDesc(Emb), LayerDesc(Head)],
                               loss_fn=_mse)
         wrapped = fleet.distributed_model(model)
         assert isinstance(wrapped._engine, HeteroPipelinedStack)
-        x = paddle.to_tensor(np.zeros((4, D), np.float32))
-        with pytest.raises(NonUniformStackError, match="hetero_pipeline"):
-            wrapped(x)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(0, 1, (4, D)).astype(np.float32))
+        with pytest.warns(UserWarning, match="Dismantled"):
+            out = wrapped(x)
+        assert wrapped._engine is None
+        assert out.shape == [4, 4]
+        # the dismantled weights are the originals: the fallback output
+        # matches a same-seed serial twin
+        paddle.seed(9)
+        set_hybrid_communicate_group(None)
+        twin = PipelineLayer(layers=[LayerDesc(Emb), LayerDesc(Head)],
+                             loss_fn=_mse)
+        np.testing.assert_allclose(out.numpy(), twin(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
     finally:
         set_hybrid_communicate_group(None)
 
