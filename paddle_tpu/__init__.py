@@ -92,7 +92,7 @@ from . import distribution  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import version  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
-from .nn.layer import LazyGuard  # noqa: F401,E402
+from .nn.layer import LazyGuard, ParamAttr  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from . import hub  # noqa: F401,E402
 from . import sysconfig  # noqa: F401,E402

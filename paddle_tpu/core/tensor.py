@@ -596,3 +596,13 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
                 # mesh-committed (sharded) arrays without transfer errors
                 arr = jnp.asarray(arr)
     return Tensor(arr, stop_gradient=stop_gradient)
+
+
+# Tensor.is_floating_point()/is_integer()/is_complex() methods (upstream
+# exposes these both as paddle.* functions and as Tensor methods)
+register_tensor_method("is_floating_point",
+                       lambda self: _dtype.is_floating_point(self.dtype))
+register_tensor_method("is_integer",
+                       lambda self: _dtype.is_integer(self.dtype))
+register_tensor_method("is_complex",
+                       lambda self: _dtype.is_complex(self.dtype))

@@ -177,9 +177,11 @@ class StudentT(Distribution):
     def rsample(self, shape=()):
         key = self._key()
         shp = tuple(shape) + self.batch_shape
+        # jax.random.t broadcasts df against the explicit shape argument —
+        # pre-broadcasting df while leaving shape=() rejects any batched
+        # df (found by the round-5 API probe)
         return apply("studentt_rsample",
-                     lambda d, l, s: l + s * jax.random.t(
-                         key, jnp.broadcast_to(d, shp)),
+                     lambda d, l, s: l + s * jax.random.t(key, d, shp),
                      self.df, self.loc, self.scale)
 
     def log_prob(self, value):

@@ -7,7 +7,7 @@ from .ops._helpers import ensure_tensor
 from .ops.linalg import (  # noqa: F401
     matmul, bmm, dot, inner, outer, einsum, kron, mv, addmm, norm, dist,
     inv, pinv, det, slogdet, svd, qr, eigh, eig, eigvals, eigvalsh, cholesky,
-    cholesky_solve, solve, triangular_solve, lstsq, matrix_power, matrix_rank,
+    cholesky_inverse, cholesky_solve, solve, triangular_solve, lstsq, matrix_power, matrix_rank,
     cond, cov, corrcoef, multi_dot, cross, householder_product,
     vecdot, matrix_exp, lu, lu_unpack, ormqr,
 )

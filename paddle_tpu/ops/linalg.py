@@ -227,6 +227,27 @@ def cholesky_solve(x, y, upper=False, name=None):
     return apply("cholesky_solve", f, x, y)
 
 
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of the SPD matrix whose Cholesky factor is ``x``
+    (reference: paddle.linalg.cholesky_inverse / torch.cholesky_inverse,
+    upstream paddle/phi/kernels/cholesky_inverse_kernel): given lower L
+    with A = L L^T (or upper U with A = U^T U), returns A^{-1} via two
+    triangular solves against the identity — no explicit inverse of A is
+    formed."""
+    x = ensure_tensor(x)
+
+    def f(l):
+        if upper:
+            l = jnp.swapaxes(l, -1, -2)
+        eye = jnp.broadcast_to(jnp.eye(l.shape[-1], dtype=l.dtype),
+                               l.shape)
+        z = jax.scipy.linalg.solve_triangular(l, eye, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(l, -1, -2), z, lower=False)
+
+    return apply("cholesky_inverse", f, x)
+
+
 def solve(x, y, name=None):
     x, y = ensure_tensor(x), ensure_tensor(y)
     return apply("solve", jnp.linalg.solve, x, y)

@@ -236,6 +236,27 @@ def flip(x, axis, name=None):
 register_op("flip", flip, methods=("flip",))
 
 
+def fliplr(x, name=None):
+    """Flip left/right — flip(axis=1), ndim >= 2 (reference:
+    paddle.fliplr)."""
+    x = ensure_tensor(x)
+    if len(x._data.shape) < 2:
+        raise ValueError("fliplr requires a tensor of at least 2-D")
+    return flip(x, axis=1)
+
+
+def flipud(x, name=None):
+    """Flip up/down — flip(axis=0), ndim >= 1 (reference: paddle.flipud)."""
+    x = ensure_tensor(x)
+    if len(x._data.shape) < 1:
+        raise ValueError("flipud requires a tensor of at least 1-D")
+    return flip(x, axis=0)
+
+
+register_op("fliplr", fliplr, methods=("fliplr",))
+register_op("flipud", flipud, methods=("flipud",))
+
+
 def rot90(x, k=1, axes=(0, 1), name=None):
     x = ensure_tensor(x)
     return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)

@@ -58,7 +58,7 @@ def trunc(input, name=None):  # upstream names the arg ``input``
     return apply("trunc", jnp.trunc, ensure_tensor(input))
 
 
-register_op("trunc", trunc, methods=("trunc",))
+register_op("trunc", trunc, methods=("trunc",), inplace_method="trunc_")
 angle = make_unary("angle", jnp.angle)
 conj = make_unary("conj", jnp.conj)
 real = make_unary("real", jnp.real)

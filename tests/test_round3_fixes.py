@@ -243,3 +243,72 @@ def test_split_cache_purged_on_topology_change():
     finally:
         set_hybrid_communicate_group(None)
     assert not _SPLIT_LAYERS
+
+
+def test_cholesky_inverse_matches_inverse():
+    """Round 5 probe gap: paddle.linalg.cholesky_inverse (upstream
+    cholesky_inverse_kernel) — A^{-1} from the Cholesky factor, lower and
+    upper conventions, batched."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(0, 1, (3, 4, 4)).astype(np.float32)
+    spd = a @ np.swapaxes(a, -1, -2) + 4 * np.eye(4, dtype=np.float32)
+    want = np.linalg.inv(spd)
+
+    L = paddle.linalg.cholesky(paddle.to_tensor(spd))
+    got = paddle.linalg.cholesky_inverse(L).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    U = paddle.to_tensor(np.swapaxes(L.numpy(), -1, -2).copy())
+    got_u = paddle.linalg.cholesky_inverse(U, upper=True).numpy()
+    np.testing.assert_allclose(got_u, want, rtol=1e-3, atol=1e-4)
+
+
+def test_studentt_batched_sample_shapes():
+    """Round-5 probe regression: StudentT.sample with BATCHED df/loc/scale
+    (the pre-broadcast df rejected every batched construction)."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    d = paddle.distribution.StudentT(paddle.ones([2]) * 3, paddle.zeros([2]),
+                                     paddle.ones([2]))
+    assert tuple(d.sample([3]).shape) == (3, 2)
+    assert tuple(d.sample().shape) == (2,)
+    s = d.sample([2000]).numpy()
+    assert np.isfinite(s).all()
+    assert abs(s.mean()) < 0.2  # symmetric around loc=0
+
+
+def test_round5_probe_tail_apis():
+    """Round-5 probe gaps: fliplr/flipud, Tensor.trunc_,
+    Tensor.is_floating_point family, top-level paddle.ParamAttr."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_array_equal(paddle.fliplr(x).numpy(),
+                                  np.fliplr(x.numpy()))
+    np.testing.assert_array_equal(paddle.flipud(x).numpy(),
+                                  np.flipud(x.numpy()))
+    np.testing.assert_array_equal(x.fliplr().numpy(), np.fliplr(x.numpy()))
+    try:
+        paddle.fliplr(paddle.ones([3]))
+        raise AssertionError("fliplr must reject 1-D input")
+    except ValueError:
+        pass
+
+    t = paddle.to_tensor(np.array([1.7, -2.3], np.float32))
+    t.trunc_()
+    np.testing.assert_array_equal(t.numpy(), [1.0, -2.0])
+
+    assert x.is_floating_point() is True
+    assert paddle.to_tensor([1]).is_floating_point() is False
+    assert paddle.to_tensor([1]).is_integer() is True
+    assert x.is_complex() is False
+
+    lin = paddle.nn.Linear(
+        4, 4, weight_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Constant(0.5)))
+    np.testing.assert_allclose(lin.weight.numpy(), 0.5)
