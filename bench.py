@@ -117,6 +117,19 @@ def _anomaly_reasons(tok_per_sec, call_ms, lkg) -> list[str]:
     return reasons
 
 
+TELEMETRY_FIELDS = ("dispatch.ops_total", "jit.traces_total",
+                    "jit.compiles_total", "jit.cache_hits_total",
+                    "jit.graph_breaks_total")
+
+
+def _telemetry_detail(snap: dict) -> dict:
+    """Select the bench-relevant counters out of an observability snapshot.
+
+    Every field in ``TELEMETRY_FIELDS`` is always present (0 when never
+    bumped) so BENCH JSON rows stay schema-stable across rounds."""
+    return {k: int(snap.get(k, 0)) for k in TELEMETRY_FIELDS}
+
+
 def _dispatch_probe(jax) -> float:
     """Median round-trip latency (ms) of a trivial compiled dispatch.
 
@@ -141,7 +154,13 @@ def main() -> None:
     import jax.numpy as jnp
 
     import paddle_tpu as paddle
+    from paddle_tpu import observability as obs
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    # dispatch/compile telemetry rides along in the JSON: per-op dispatch
+    # cost inside the timed loop is one counter bump + histogram insert,
+    # noise next to the ~seconds-scale compiled steps being measured
+    obs.enable()
 
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
@@ -267,6 +286,7 @@ def main() -> None:
             "compile_s": round(compile_s, 1),
             "dispatch_probe_ms": round(probe_ms, 2),
             "retried": retried,
+            "telemetry": _telemetry_detail(obs.snapshot()),
         },
     }
     if suspect_reasons:

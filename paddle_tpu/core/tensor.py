@@ -433,6 +433,11 @@ def _autocast_targets(op_name: str, arrays):
 # hook(op_name, t0, t1) after each dispatch. None ⇒ zero overhead.
 _op_profile_hook: Optional[Callable[[str, float, float], None]] = None
 
+# Set by paddle_tpu.observability while metrics are enabled; same signature
+# and same zero-overhead contract as the profiler hook (the disabled path
+# pays only the is-None probes below).
+_op_metrics_hook: Optional[Callable[[str, float, float], None]] = None
+
 # Set by paddle_tpu.static while static-graph mode is capturing; called as
 # hook(op_name, pure_fn, tensor_inputs, out_tensors) after each dispatch so
 # the Program can record a replayable op node. None ⇒ zero overhead.
@@ -475,7 +480,9 @@ def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
     autocast applied, and — when grad is enabled and some input requires grad
     — the op is linearized with ``jax.vjp`` and a ``GradNode`` recorded.
     """
-    if _op_profile_hook is not None:
+    prof_hook = _op_profile_hook
+    metrics_hook = _op_metrics_hook
+    if prof_hook is not None or metrics_hook is not None:
         import time as _time
         _t0 = _time.perf_counter()
         try:
@@ -483,7 +490,11 @@ def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
                                differentiable=differentiable, amp=amp,
                                **static_kwargs)
         finally:
-            _op_profile_hook(op_name, _t0, _time.perf_counter())
+            _t1 = _time.perf_counter()
+            if prof_hook is not None:
+                prof_hook(op_name, _t0, _t1)
+            if metrics_hook is not None:
+                metrics_hook(op_name, _t0, _t1)
     return _apply_impl(op_name, fn, *tensor_inputs,
                        differentiable=differentiable, amp=amp, **static_kwargs)
 

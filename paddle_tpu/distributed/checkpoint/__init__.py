@@ -38,7 +38,7 @@ def _spec_of(t: Tensor):
         if hasattr(sh, "spec"):
             return [list(p) if isinstance(p, tuple) else p for p in sh.spec]
     except Exception:
-        pass
+        pass  # tracer / committed-elsewhere array: no readable sharding spec
     return None
 
 
@@ -137,7 +137,7 @@ def wait_async_saves() -> None:
             try:
                 h.close()
             except Exception:
-                pass
+                pass  # double-close of a finished async handle is benign
         else:
             h.join()
     _ASYNC.clear()
@@ -157,7 +157,7 @@ def _target_sharding(t: Tensor):
         if isinstance(sh, jax.sharding.Sharding):
             return sh
     except Exception:
-        pass
+        pass  # tracer payload: sharding is unreadable, caller falls back
     return None
 
 

@@ -92,7 +92,7 @@ def get_world_size(group=None) -> int:
         if jax.process_count() > 1:
             return jax.process_count()
     except RuntimeError:
-        pass
+        pass  # backend not initialized yet: single-process by definition
     return 1
 
 

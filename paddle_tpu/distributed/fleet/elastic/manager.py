@@ -10,15 +10,20 @@ This module keeps those states and the watch-loop shape, over our TCPStore.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+from .... import observability as _obs
 
 __all__ = [
     "ElasticLevel", "ElasticStatus", "ElasticManager", "enable_elastic",
     "start_worker_heartbeat", "ELASTIC_ENV_MASTER", "ELASTIC_ENV_RESTARTS",
 ]
+
+_log = logging.getLogger(__name__)
 
 ELASTIC_ENV_MASTER = "PADDLE_ELASTIC_MASTER"      # host:port of the beat store
 ELASTIC_ENV_RESTARTS = "PADDLE_RESTART_COUNT"     # bumped on every respawn
@@ -107,6 +112,7 @@ class ElasticManager:
             store = TCPStore(is_master=True, world_size=world_size)
         self.store = store
         self._started = time.time()
+        self._beat_fail_throttle = _obs.LogThrottle()
 
     @property
     def endpoint(self) -> str:
@@ -126,12 +132,22 @@ class ElasticManager:
             if not self.store.check(f"elastic/beat/{rank}"):
                 return None  # never registered: not hang-monitored
             raw = self.store.get(f"elastic/beat/{rank}", timeout=1.0)
-        except Exception:
+        except Exception as e:
+            # unreadable lease -> hang detection is OFF for this worker;
+            # counted so a flaky local store is visible, not silent. The
+            # log is rate-limited (1/10s): a dead store fails every rank
+            # every watch tick and the counter already carries magnitude
+            _obs.inc("elastic.store_read_failures_total")
+            if self._beat_fail_throttle.ready():
+                _log.warning("elastic: beat read for rank %s failed "
+                             "(%s: %s)", rank, type(e).__name__, e)
             return None
         try:
-            return time.time() - float(raw.decode())
+            age = time.time() - float(raw.decode())
         except (ValueError, AttributeError):
-            return None
+            return None  # malformed lease payload: not hang-monitored
+        _obs.set_gauge("elastic.worker_beat_age_seconds", age, rank=rank)
+        return age
 
     def classify(self, procs: List) -> str:
         """One watch tick over child processes + leases. Also records the
@@ -181,6 +197,7 @@ class ElasticManager:
                 return 1
             if status == ElasticStatus.RESTART:
                 self.restarts += 1
+                _obs.inc("elastic.restarts_total")
                 if (self.elastic_level >= ElasticLevel.ELASTIC
                         and self.single_node):
                     # level 2 (resize): the lost members LEAVE the job —
@@ -216,7 +233,8 @@ class ElasticManager:
             try:
                 self.store.delete_key(f"elastic/beat/{rank}")
             except Exception:
-                pass
+                pass  # key absent / store blip: a stale lease only delays
+                #       hang detection by one beat interval
 
 
 class MultiNodeElasticAgent:
@@ -251,7 +269,8 @@ class MultiNodeElasticAgent:
                  store, elastic_level: int = ElasticLevel.ELASTIC,
                  beat_timeout: float = 30.0, node_timeout: float = 10.0,
                  max_restarts: int = 3, node_grace: float = 120.0,
-                 master_endpoint: Optional[str] = None):
+                 master_endpoint: Optional[str] = None,
+                 store_lost_deadline: float = 60.0):
         # the address WORKERS dial for heartbeats — must be the shared
         # store's routable endpoint, not loopback, on real multi-host jobs
         self.master_endpoint = master_endpoint
@@ -266,6 +285,23 @@ class MultiNodeElasticAgent:
         self.node_grace = float(node_grace)
         self._started = time.time()
         self.store = store
+        # store health (ADVICE r5): a read failure must never read as "node
+        # is healthy" forever — consecutive failures are counted and, past
+        # the deadline, the store is declared LOST and watch() exits loudly
+        self.store_lost_deadline = float(store_lost_deadline)
+        self.store_lost = False
+        self._store_fail_first: Optional[float] = None
+        self._store_fail_count = 0
+        self._read_fail_throttle = _obs.LogThrottle()
+        self._write_fail_throttle = _obs.LogThrottle()
+        # per-KEY read-failure windows (keyed by node rank for leases,
+        # by key name otherwise). A lease key failing past the deadline
+        # reads as a LOST NODE (evictable); a coordination key
+        # (topology/fault/done) failing past it means the agent can no
+        # longer coordinate at all and escalates to store-LOST — even
+        # while other keys read fine and keep resetting the global
+        # window.
+        self._key_fail_first: Dict[Any, float] = {}
         self.epoch = 0
         self.nodes = list(range(int(nnodes)))  # current topology
         self._local = ElasticManager(
@@ -277,20 +313,95 @@ class MultiNodeElasticAgent:
     def _beat(self) -> None:
         self.store.set(f"elastic/node/{self.node_rank}", str(time.time()))
 
+    def _store_read_failed(self, what, exc: BaseException) -> None:
+        """Track CONSECUTIVE store read failures (ADVICE r5: these used to
+        map silently to age 0.0 = "healthy node", so a dead store meant
+        dead nodes were live forever and the job hung signal-free). Every
+        failure is counted + logged; past ``store_lost_deadline`` seconds
+        of unbroken failures the store is declared lost, which watch()
+        turns into a loud exit instead of an invisible hang."""
+        now = time.monotonic()
+        if self._store_fail_first is None:
+            self._store_fail_first = now
+        self._store_fail_count += 1
+        self._key_fail_first.setdefault(what, now)
+        _obs.inc("elastic.store_read_failures_total")
+        # throttled on a MONOTONIC clock that window resets never rewind:
+        # one flaky node among healthy ones resets the consecutive-failure
+        # window every tick, and that must not grant a fresh log line each
+        # time — at most one per 10s, period
+        if self._read_fail_throttle.ready():
+            _log.warning(
+                "elastic: job-store read of %s failed (%s: %s; "
+                "%d consecutive failure(s) over %.1fs)", what,
+                type(exc).__name__, exc, self._store_fail_count,
+                now - self._store_fail_first)
+        # escalate on EITHER signal: the whole store failing unbroken
+        # past the deadline, or a COORDINATION key (non-lease: topology,
+        # fault/N, done/N) unreadable past it — healthy lease reads reset
+        # the global window every tick, so without the per-key check a
+        # permanently unreadable fault flag would hang the job silently.
+        # (An unreadable LEASE key instead evicts just that node, via
+        # _node_failed_past_deadline in _node_age.)
+        key_dead = (not isinstance(what, int)
+                    and now - self._key_fail_first[what]
+                    > self.store_lost_deadline)
+        if key_dead or                 now - self._store_fail_first > self.store_lost_deadline:
+            if not self.store_lost:
+                _log.error(
+                    "elastic: job-store read of %s failing for %.0fs "
+                    "(deadline %.0fs) — declaring the store LOST", what,
+                    now - self._key_fail_first[what], self.store_lost_deadline)
+            self.store_lost = True
+
+    def _store_read_ok(self, what: Optional[Any] = None) -> None:
+        self._store_fail_first = None
+        self._store_fail_count = 0
+        if what is not None:
+            self._key_fail_first.pop(what, None)
+
+    def _store_write_failed(self, what: str, exc: BaseException) -> None:
+        """Count every store write failure; log at most one line per 10s
+        (a write-dead store fails every tick — the counter carries the
+        magnitude, same policy as the read path)."""
+        _obs.inc("elastic.store_write_failures_total")
+        if self._write_fail_throttle.ready():
+            _log.warning("elastic: job-store write of %s failed (%s: %s)",
+                         what, type(exc).__name__, exc)
+
+    def _node_failed_past_deadline(self, node: int) -> bool:
+        """True once THIS node's reads have failed unbroken past the
+        deadline while the store itself may be healthy (other nodes
+        reading fine keep resetting the global window): its lease is
+        effectively unreadable, and an unreadable lease is a lost lease —
+        eternal age-0 "freshness" would make the node unevictable."""
+        first = self._key_fail_first.get(node)
+        return (first is not None
+                and time.monotonic() - first > self.store_lost_deadline)
+
     def _node_age(self, node: int) -> Optional[float]:
-        """None = never leased; a TRANSIENT store error reads as age 0
-        (fresh): one 1-second read hiccup must not count a healthy node
-        as lost and permanently shrink the job."""
+        """None = never leased; a TRANSIENT store error still reads as age
+        0 (fresh) — one 1-second read hiccup must not count a healthy node
+        as lost and permanently shrink the job — but the failure is now
+        counted, logged, and escalated via ``_store_read_failed`` (whole
+        store) / ``_node_failed_past_deadline`` (single unreadable lease).
+        """
         try:
             if not self.store.check(f"elastic/node/{node}"):
+                self._store_read_ok(node)
                 return None
-        except Exception:
-            return 0.0
+        except Exception as e:
+            self._store_read_failed(node, e)
+            return None if self._node_failed_past_deadline(node) else 0.0
         try:
             raw = self.store.get(f"elastic/node/{node}", timeout=1.0)
-            return time.time() - float(raw.decode())
-        except Exception:
-            return 0.0
+            age = time.time() - float(raw.decode())
+        except Exception as e:
+            self._store_read_failed(node, e)
+            return None if self._node_failed_past_deadline(node) else 0.0
+        self._store_read_ok(node)
+        _obs.set_gauge("elastic.node_age_seconds", age, node=node)
+        return age
 
     def _live_nodes(self) -> List[int]:
         in_grace = time.time() - self._started < self.node_grace
@@ -305,13 +416,22 @@ class MultiNodeElasticAgent:
         return live
 
     def _read_topology(self) -> Optional[Dict]:
+        """Every agent store read routes through the health seam: a store
+        that serves node leases but consistently fails other keys must
+        still count failures and eventually trip the LOST escalation —
+        otherwise a crashed pod whose fault flag is unreadable hangs the
+        job with zero signal (the original ADVICE r5 class)."""
         try:
             if not self.store.check("elastic/topology"):
+                self._store_read_ok("topology")
                 return None
-            return json.loads(self.store.get("elastic/topology",
+            topo = json.loads(self.store.get("elastic/topology",
                                              timeout=1.0).decode())
-        except Exception:
+        except Exception as e:
+            self._store_read_failed("topology", e)
             return None
+        self._store_read_ok("topology")
+        return topo
 
     def _write_exit(self) -> None:
         """Publish a terminal record: restart budget exhausted — every
@@ -360,18 +480,35 @@ class MultiNodeElasticAgent:
         def _safe_set(key, val):
             # the shared store may blip (or its host may be the one that
             # died) — supervision must keep looping, not unwind and
-            # orphan the running workers
+            # orphan the running workers; the failure is still counted
             try:
                 self.store.set(key, val)
                 return True
-            except Exception:
+            except Exception as e:
+                self._store_write_failed(key, e)
                 return False
 
         while True:
+            if self.store_lost:
+                # reads have failed past the deadline: the agent can no
+                # longer tell live nodes from dead ones, adopt topologies,
+                # or be seen by the supervisor — exit loudly instead of
+                # supervising blind (ADVICE r5)
+                _log.error("elastic: job store lost; terminating local "
+                           "workers and exiting")
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in procs:
+                    try:
+                        p.wait(timeout=10)
+                    except Exception:
+                        p.kill()  # SIGTERM ignored (hung collective): force
+                return 1
             try:
                 self._beat()
-            except Exception:
-                pass
+            except Exception as e:
+                self._store_write_failed("node beat", e)
             # 1. adopt a newer topology (written by the supervisor)
             topo = self._read_topology()
             if topo and topo["epoch"] > self.epoch:
@@ -395,8 +532,10 @@ class MultiNodeElasticAgent:
                 self._local._clear_beats()
                 try:
                     self.store.delete_key(f"elastic/fault/{self.node_rank}")
-                except Exception:
-                    pass
+                except Exception as e:
+                    self._store_write_failed("fault-flag delete", e)
+                    # a lingering fault flag of an OLD epoch is ignored by
+                    # the epoch-scoped fault check; safe to continue
                 done = False
                 procs = respawn(self.epoch, self._my_index(),
                                 list(self.nodes))
@@ -438,8 +577,9 @@ class MultiNodeElasticAgent:
                     # on the epoch, which bumps anyway.
                     try:
                         self._write_topology(live, self._local.restarts)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        self._store_write_failed("resize topology", e)
+                        # store blip: the resize retries next tick
                 elif lost:
                     if lost != warned_lost:  # level 1: hold for rejoin
                         warned_lost = list(lost)
@@ -450,31 +590,41 @@ class MultiNodeElasticAgent:
                     if self._local.restarts + 1 > self.max_restarts:
                         try:
                             self._write_exit()
-                        except Exception:
-                            pass
+                        except Exception as e:
+                            self._store_write_failed("exit record", e)
+                            # store blip: the exit record retries next tick
                     else:
                         # same-size restart across all pods
                         try:
                             self._write_topology(self.nodes,
                                                  self._local.restarts + 1)
-                        except Exception:
-                            pass  # store blip: retried next tick
+                        except Exception as e:
+                            self._store_write_failed("restart topology", e)
+                            # store blip: retried next tick
             time.sleep(poll_interval)
 
     def _done_epoch(self, node: int) -> int:
         try:
             if not self.store.check(f"elastic/done/{node}"):
+                self._store_read_ok(f"done/{node}")
                 return -1
-            return int(self.store.get(f"elastic/done/{node}",
-                                      timeout=1.0).decode())
-        except Exception:
+            epoch = int(self.store.get(f"elastic/done/{node}",
+                                       timeout=1.0).decode())
+        except Exception as e:
+            self._store_read_failed(f"done/{node}", e)
             return -1
+        self._store_read_ok(f"done/{node}")
+        return epoch
 
     def _fault_epoch(self, node: int) -> int:
         try:
             if not self.store.check(f"elastic/fault/{node}"):
+                self._store_read_ok(f"fault/{node}")
                 return -1
-            return int(self.store.get(f"elastic/fault/{node}",
-                                      timeout=1.0).decode())
-        except Exception:
+            epoch = int(self.store.get(f"elastic/fault/{node}",
+                                       timeout=1.0).decode())
+        except Exception as e:
+            self._store_read_failed(f"fault/{node}", e)
             return -1
+        self._store_read_ok(f"fault/{node}")
+        return epoch

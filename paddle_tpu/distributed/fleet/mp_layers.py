@@ -64,7 +64,7 @@ def _constrain(t: Tensor, spec) -> Tensor:
             if cur is not None and cur.axis_names:
                 use = cur
         except Exception:
-            pass
+            pass  # no abstract mesh in scope: constrain on the concrete one
         return jax.lax.with_sharding_constraint(a, NamedSharding(use, spec))
 
     return apply("sharding_constraint", f, t)

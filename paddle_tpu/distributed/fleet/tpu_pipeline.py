@@ -18,12 +18,15 @@ PipelineLayer of identical LayerDescs.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ... import observability as _obs
 
 __all__ = ["pipelined_forward", "stack_stage_params", "PipelinedStack",
            "HeteroPipelinedStack", "find_uniform_run",
@@ -279,6 +282,63 @@ def find_uniform_run(entries, num_stages):
     return -neg_off, n_used
 
 
+def _record_schedule_metrics(engine: str, S: int, M: int, V: int) -> None:
+    """Per-step schedule telemetry. The schedule is compiled SPMD, so true
+    per-stage wall time is not host-observable; what IS exact from the
+    schedule structure is the bubble: of T = M + S*V - 1 scan ticks each
+    stage computes useful microbatches for M, so the idle fraction is
+    (S*V - 1) / T — the GPipe bubble. ``pipeline.step_seconds`` (observed
+    around the dispatch at the call sites) covers the whole-step host time."""
+    if not _obs.enabled():
+        return
+    T = M + S * V - 1
+    _obs.inc("pipeline.steps_total", engine=engine)
+    _obs.set_gauge("pipeline.stages", S)
+    _obs.set_gauge("pipeline.micro_batches", M)
+    _obs.set_gauge("pipeline.bubble_fraction", (S * V - 1) / T)
+
+
+def _refine_run_bounds(entries, keys, lo, hi, num_stages, seg_method):
+    """Refine a stackable run's edges to [lo, hi) for the hetero engine.
+
+    ``seg_method="layer:Name"`` (upstream parity: stages split at the named
+    block class) bounds the run to [first..last] Name block — but ONLY when
+    at least ``num_stages`` named blocks exist. With fewer, upstream's
+    placement contract cannot be honored; we WARN + count
+    (``pipeline.seg_method_fallbacks_total``) and fall back to the
+    param-balanced heuristic instead of silently diverging (ADVICE r5).
+    Note the cuts inside the bounded run are still param-balanced, not
+    aligned to Name blocks — see MIGRATING.md.
+
+    The default heuristic trims edge blocks whose structural key is UNIQUE
+    in the run while their inward neighbor's key repeats — the
+    embedding/head shape of real models.
+    """
+    S = int(num_stages)
+    if seg_method.startswith("layer:"):
+        name = seg_method.split(":", 1)[1]
+        idxs = [i for i in range(lo, hi)
+                if type(entries[i][0]).__name__ == name]
+        if len(idxs) >= S:
+            return idxs[0], idxs[-1] + 1
+        _obs.inc("pipeline.seg_method_fallbacks_total")
+        warnings.warn(
+            f"hetero pipeline: seg_method={seg_method!r} found only "
+            f"{len(idxs)} {name!r} block(s) in the stackable run but "
+            f"{S} pipeline stages need at least one each; falling back "
+            "to param-balanced stage cuts (upstream would split at the "
+            "named blocks)")
+        # fall through to the heuristic
+    from collections import Counter
+    count = Counter(keys[lo:hi])
+    while hi - lo > S and count[keys[lo]] == 1 and count[keys[lo + 1]] > 1:
+        lo += 1
+    while hi - lo > S and count[keys[hi - 1]] == 1 \
+            and count[keys[hi - 2]] > 1:
+        hi -= 1
+    return lo, hi
+
+
 class PipelinedStack:
     """Executes a PipelineLayer with REAL stage placement on the pp mesh
     axis (upstream parity: meta_parallel PipelineParallel + p2p_communication
@@ -489,8 +549,10 @@ class PipelinedStack:
                                     v_chunks=self._V)
             return out.reshape((B,) + out.shape[2:])
 
-        out = apply("pipelined_stack", fn, *flat_params, x,
-                    differentiable=True, amp=False)
+        _record_schedule_metrics("uniform", S, M, self._V)
+        with _obs.scoped_timer("pipeline.step_seconds"):
+            out = apply("pipelined_stack", fn, *flat_params, x,
+                        differentiable=True, amp=False)
         return self._run_edge(self._post, out)
 
 
@@ -569,23 +631,9 @@ class HeteroPipelinedStack:
         #   UNIQUE in the run while their inward neighbor's key repeats —
         #   the embedding/head shape of real models. Validation at first
         #   call still backstops both with an actionable error.
-        lo, hi = start, start + n_run
         seg = getattr(pipeline_layer, "_seg_method", "uniform") or "uniform"
-        if seg.startswith("layer:"):
-            name = seg.split(":", 1)[1]
-            idxs = [i for i in range(lo, hi)
-                    if type(entries[i][0]).__name__ == name]
-            if len(idxs) >= self._S:
-                lo, hi = idxs[0], idxs[-1] + 1
-        else:
-            from collections import Counter
-            count = Counter(keys[lo:hi])
-            while hi - lo > self._S and count[keys[lo]] == 1 \
-                    and count[keys[lo + 1]] > 1:
-                lo += 1
-            while hi - lo > self._S and count[keys[hi - 1]] == 1 \
-                    and count[keys[hi - 2]] > 1:
-                hi -= 1
+        lo, hi = _refine_run_bounds(entries, keys, start, start + n_run,
+                                    self._S, seg)
         start, n_run = lo, hi - lo
         self._pre = entries[:start]
         self._post = entries[start + n_run:]
@@ -654,6 +702,16 @@ class HeteroPipelinedStack:
             arr = jnp.stack(stackrows, 0)
             arr = jax.device_put(arr, NamedSharding(mesh, P(axis, None)))
             self._buffers[dt] = Parameter(arr, name=f"pp_hetero_{dt}")
+
+        # placement telemetry inputs, kept on the engine: the gauges are
+        # (re)recorded on every __call__ so metrics enabled AFTER engine
+        # construction (the StepTelemetry flow) still see them
+        self._stage_param_sizes = [sum(sizes[bounds[s]:bounds[s + 1]])
+                                   for s in range(self._S)]
+        real = sum(int(r[dt].shape[0]) for r in stage_rows for dt in r)
+        padded = sum(int(np.prod(self._buffers[dt]._data.shape))
+                     for dt in dtypes)
+        self._padding_fraction = 0.0 if padded == 0 else 1.0 - real / padded
 
         self._pipeline_layer = pipeline_layer
         self._orig_entries = list(entries)
@@ -826,7 +884,16 @@ class HeteroPipelinedStack:
                                     batch_axis=batch_axis)
             return out.reshape((B,) + out.shape[2:])
 
+        _record_schedule_metrics("hetero", S, M, 1)
+        if _obs.enabled():
+            # placement telemetry: balanced cuts are only as good as their
+            # skew, and pad-to-max SPMD slots are pure memory waste
+            for s, n in enumerate(self._stage_param_sizes):
+                _obs.set_gauge("pipeline.stage_params", n, stage=s)
+            _obs.set_gauge("pipeline.padding_fraction",
+                           self._padding_fraction)
         flat = [self._buffers[dt] for dt in dtypes]
-        out = apply("hetero_pipelined_stack", fn, *flat, x,
-                    differentiable=True, amp=False)
+        with _obs.scoped_timer("pipeline.step_seconds"):
+            out = apply("hetero_pipelined_stack", fn, *flat, x,
+                        differentiable=True, amp=False)
         return self._run_edge(self._post, out)

@@ -19,13 +19,18 @@ is the semantics the Communicator contract promises).
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
+from .. import observability as _obs
+
 __all__ = ["PsClient", "serve_stats", "reset_server_state", "SparseTable"]
+
+_log = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
 # accessor rules (round 5 — upstream paddle/fluid/distributed/ps/table/
@@ -436,17 +441,25 @@ class PsClient:
       re-registers under the same name) is transparent to workers."""
 
     def __init__(self, server: Union[str, List[str]], lr: float = 0.01,
-                 retry_timeout: float = 60.0):
+                 retry_timeout: float = 60.0,
+                 max_pending_async: int = 256):
         import uuid
         self.servers = [server] if isinstance(server, str) else list(server)
         self.server = self.servers[0]  # dense/back-compat target
         self.lr = float(lr)
         self.retry_timeout = float(retry_timeout)
+        # cap on queued-but-unsent async pushes: a down server must not
+        # grow an unbounded buffer of gradient blobs (oldest are dropped,
+        # counted, and logged once the cap is hit)
+        self.max_pending_async = int(max_pending_async)
         # per-client push sequencing: a retried push the server already
         # applied (lost reply) is recognized and skipped server-side
         self._client_key = uuid.uuid4().hex
         self._seq = 0
         self._seq_lock = threading.Lock()
+        self._async_pool = None  # lazy single-thread executor for wait=False
+        self._async_gen = 0  # bumps per drain-thread generation (see below)
+        self._async_drop_throttle = _obs.LogThrottle()
 
     def _rpc(self):
         from . import rpc
@@ -465,16 +478,35 @@ class PsClient:
         rpc = self._rpc()
         deadline = _time.monotonic() + self.retry_timeout
         delay = 0.2
+        _obs.inc("ps.rpc_calls_total")
         while True:
             try:
+                # only SUCCESSFUL attempts land in the latency histogram —
+                # timing failed attempts would fill ps.rpc_seconds with
+                # connect-timeout durations and break count parity with
+                # ps.rpc_calls_total
+                if _obs.enabled():
+                    t0 = _time.perf_counter()
+                    result = rpc.rpc_sync(server, fn, args=args)
+                    _obs.observe("ps.rpc_seconds",
+                                 _time.perf_counter() - t0)
+                    return result
                 return rpc.rpc_sync(server, fn, args=args)
             except rpc.RpcTransportError:
                 if _time.monotonic() >= deadline:
+                    _obs.inc("ps.rpc_failures_total")
                     raise
+                _obs.inc("ps.rpc_retries_total")
                 _time.sleep(delay)
                 delay = min(delay * 1.6, 2.0)
                 try:
-                    rpc.refresh_worker_info(server)
+                    old = rpc.get_worker_info(server)
+                    fresh = rpc.refresh_worker_info(server)
+                    # a FAILOVER is an endpoint change (respawned server
+                    # re-registered); a same-endpoint refresh is just a
+                    # retry and must not inflate the failover count
+                    if (fresh.ip, fresh.port) != (old.ip, old.port):
+                        _obs.inc("ps.rpc_failovers_total")
                 except Exception:
                     pass  # store briefly unreachable: keep backing off
 
@@ -483,14 +515,150 @@ class PsClient:
         self._call(self.server, _srv_create,
                    (name, arr.tobytes(), arr.shape, str(arr.dtype)))
 
+    def _submit_async(self, server: str, fn, args):
+        """Async path THROUGH the retrying ``_call`` wrapper (ADVICE r5:
+        ``rpc_async`` bypassed failover, so a transport failure silently
+        dropped the gradient). The returned future still resolves to the
+        call result; a push that exhausts its retry budget is logged AND
+        counted (``ps.dropped_async_pushes_total``) before the exception is
+        parked on the future — visible even to callers that never wait.
+
+        Pushes drain on ONE daemon thread; the stream's seq is assigned and
+        the item enqueued under ONE lock hold, so enqueue order == seq
+        order == apply order even with concurrent pushers (a lower seq
+        arriving after a higher one would be discarded by the server's
+        dedup watermark as a "duplicate"). A retry loop still backing off
+        at interpreter exit cannot block shutdown the way a joined
+        ThreadPoolExecutor worker would, and the thread holds only a WEAK
+        reference to the client between items so an abandoned client is
+        still collectible (its __del__ shuts the thread down)."""
+        import queue as _queue
+        import weakref
+        from concurrent.futures import Future
+        from .rpc import FutureWrapper
+
+        with self._seq_lock:
+            if self._async_pool is None:
+                q: "_queue.Queue" = _queue.Queue()
+                wself = weakref.ref(self)
+
+                def drain():
+                    while True:
+                        item = q.get()
+                        if item is None:
+                            return
+                        fut, srv, f, a = item
+                        client = wself()
+                        if client is None:
+                            # owner collected mid-queue: stop draining;
+                            # the unapplied push still counts as dropped
+                            if fut.cancel():
+                                _obs.inc("ps.dropped_async_pushes_total")
+                            return
+                        if not fut.set_running_or_notify_cancel():
+                            del client
+                            continue
+                        try:
+                            fut.set_result(client._call(srv, f, a))
+                        except Exception as e:
+                            _obs.inc("ps.dropped_async_pushes_total")
+                            _log.error(
+                                "ps: async push to %s dropped after "
+                                "retries (%s: %s)", srv,
+                                type(e).__name__, e)
+                            fut.set_exception(e)
+                        del client  # hold no strong ref while idle
+
+                # each drain-thread GENERATION dedups on its own key
+                # stream: after a timed-out close() an old thread may
+                # still be mid-retry, and if a recreated pool shared its
+                # stream, the new thread's pushes would advance the
+                # server watermark past the old retry — which would then
+                # be discarded as a "duplicate" (a silent drop)
+                self._async_gen += 1
+                t = threading.Thread(
+                    target=drain, daemon=True,
+                    name=f"ps-async-{self._client_key[:8]}")
+                t.start()
+                self._async_pool = (q, t)
+
+            q2 = self._async_pool[0]
+            # bounded buffer: drop the OLDEST queued push once the cap is
+            # hit — recency wins for gradients, memory stays bounded, and
+            # the drop is counted + logged like every other drop
+            dropped = 0
+            while q2.qsize() >= self.max_pending_async:
+                try:
+                    old = q2.get_nowait()
+                except Exception:  # Empty: drain thread got there first
+                    break
+                if old is not None and old[0].cancel():
+                    dropped += 1
+            if dropped:
+                _obs.inc("ps.dropped_async_pushes_total", dropped)
+                # rate-limited: at cap this fires on every push; the
+                # counter carries the magnitude
+                if self._async_drop_throttle.ready():
+                    _log.error(
+                        "ps: async push queue full (cap %d); dropping "
+                        "oldest queued push(es)", self.max_pending_async)
+            fut: Future = Future()
+            self._seq += 1
+            q2.put((fut, server, fn,
+                    args + (f"{self._client_key}/async{self._async_gen}",
+                            self._seq)))
+        return FutureWrapper(fut)
+
+    def close(self, wait: bool = True, timeout: Optional[float] = None
+              ) -> None:
+        """Stop the async-push drain thread (queued-but-unstarted pushes
+        are cancelled). ``wait`` joins the thread so a push currently in
+        its retry loop gets up to ``timeout`` seconds to finish."""
+        with self._seq_lock:
+            pool, self._async_pool = self._async_pool, None
+        if pool is None:
+            return
+        q, t = pool
+        cancelled = 0
+        try:
+            while True:
+                item = q.get_nowait()
+                if item is not None and item[0].cancel():
+                    cancelled += 1
+        except Exception:
+            pass  # queue drained (Empty): nothing left to cancel
+        if cancelled:
+            # the dropped-push contract covers cancellation too: a queued
+            # gradient discarded by close() must never vanish silently
+            _obs.inc("ps.dropped_async_pushes_total", cancelled)
+            _log.error("ps: close() cancelled %d queued async push(es); "
+                       "those gradients were not applied", cancelled)
+        q.put(None)
+        if wait:
+            t.join(timeout)
+
+    def __del__(self):
+        try:
+            self.close(wait=False)
+        except Exception:
+            pass  # interpreter teardown: drain thread is daemon anyway
+
     def push(self, name: str, ids, grad, wait: bool = True):
         ids = np.asarray(ids, np.int64).reshape(-1)
         g = np.asarray(grad, np.float32).reshape(ids.shape[0], -1)
         args = (name, ids.tobytes(), g.tobytes(), g.shape[0], g.shape[1],
-                self.lr, self._client_key, self._next_seq())
+                self.lr)
         if wait:
-            return self._call(self.server, _srv_push, args)
-        return self._rpc().rpc_async(self.server, _srv_push, args=args)
+            # sync stream: caller-ordered, keyed on the plain client key
+            return self._call(self.server, _srv_push,
+                              args + (self._client_key, self._next_seq()))
+        # async pushes dedup on their OWN key stream (appended with their
+        # seq inside _submit_async, atomically with the enqueue): with a
+        # shared stream, a sync push overtaking an async retry during its
+        # backoff window would advance the server's seq watermark past the
+        # retry, and the server would then discard the retried gradient as
+        # a "duplicate" — a silent drop reported as success.
+        return self._submit_async(self.server, _srv_push, args)
 
     def pull(self, name: str, ids, dim: int, dtype=np.float32) -> np.ndarray:
         ids = np.asarray(ids, np.int64).reshape(-1)

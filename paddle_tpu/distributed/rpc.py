@@ -130,7 +130,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 result = (False, exc)
             _send_msg(self.request, pickle.dumps(result))
         except ConnectionError:
-            pass
+            pass  # peer hung up mid-reply: client-side retry owns recovery
 
 
 class _Server(socketserver.ThreadingTCPServer):

@@ -163,7 +163,7 @@ class _PyClient:
         try:
             self._sock.close()
         except OSError:
-            pass
+            pass  # already closed by the peer/GC: close is best-effort
 
 
 # ---------------------------------------------------------------------------
@@ -351,4 +351,4 @@ class TCPStore(Store):
         try:
             self.close()
         except Exception:
-            pass
+            pass  # interpreter-teardown close: nothing left to signal to

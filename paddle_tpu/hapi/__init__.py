@@ -161,32 +161,47 @@ class Model:
                          "verbose": verbose, "save_dir": save_dir,
                          "metrics": ["loss"]})
         self.stop_training = False
+        self._train_aborted = False
 
-        cbks.on_train_begin()
         history: Dict[str, List[Any]] = {"loss": []}
         logs: Dict[str, Any] = {}
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                ins, lbls = self._split_batch(batch)
-                losses, _ = self.train_batch(ins, lbls)
-                logs = {"loss": losses[0]}
-                self._metric_logs(logs)
-                cbks.on_train_batch_end(step, logs)
+        # on_train_end runs even when training (or a sibling callback's
+        # on_train_begin) raises: callbacks that hold resources or
+        # process-global state (StepTelemetry's JSONL handle + metrics
+        # enable) must get their teardown hook on every exit path —
+        # teardown hooks are expected to tolerate a begin that never ran
+        try:
+            cbks.on_train_begin()
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    ins, lbls = self._split_batch(batch)
+                    losses, _ = self.train_batch(ins, lbls)
+                    logs = {"loss": losses[0]}
+                    self._metric_logs(logs)
+                    cbks.on_train_batch_end(step, logs)
+                    if self.stop_training:
+                        break
+                history["loss"].append(logs.get("loss"))
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self._run_eval(eval_loader, cbks)
+                    for k, v in eval_logs.items():
+                        history.setdefault("eval_" + k, []).append(v)
                 if self.stop_training:
                     break
-            history["loss"].append(logs.get("loss"))
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self._run_eval(eval_loader, cbks)
-                for k, v in eval_logs.items():
-                    history.setdefault("eval_" + k, []).append(v)
-            if self.stop_training:
-                break
-        cbks.on_train_end(logs)
+        except BaseException:
+            # teardown on the failure path, but never let a teardown error
+            # MASK the real training exception; callbacks can see
+            # model._train_aborted to skip success-only work (e.g.
+            # ModelCheckpoint's "final" save)
+            self._train_aborted = True
+            cbks.call_shielded("on_train_end", logs)
+            raise
+        cbks.call_all("on_train_end", logs)
         return history
 
     def _split_batch(self, batch):

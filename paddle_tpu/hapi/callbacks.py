@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "CallbackList"]
+           "LRScheduler", "CallbackList", "StepTelemetry"]
 
 
 class Callback:
@@ -66,6 +66,42 @@ class CallbackList:
     def _call(self, name, *args):
         for cb in self.callbacks:
             getattr(cb, name)(*args)
+
+    def call_shielded(self, name, *args):
+        """Invoke a hook on EVERY callback, logging (not propagating) per-
+        callback failures — the abort-path teardown contract: one broken
+        callback must not rob the rest of their cleanup."""
+        import logging
+        for cb in self.callbacks:
+            try:
+                getattr(cb, name)(*args)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "callback %s.%s failed during abort teardown",
+                    type(cb).__name__, name)
+
+    def call_all(self, name, *args):
+        """Invoke a hook on EVERY callback even if one raises, then
+        re-raise the FIRST failure — the success-path teardown contract:
+        the caller still sees the error, but later callbacks (e.g.
+        StepTelemetry restoring global metrics state) are not robbed of
+        their cleanup by an earlier one."""
+        import logging
+        first = None
+        for cb in self.callbacks:
+            try:
+                getattr(cb, name)(*args)
+            except Exception as e:
+                if first is None:
+                    first = e
+                else:
+                    # later failures would otherwise vanish behind the
+                    # re-raised first one — log them, don't swallow
+                    logging.getLogger(__name__).exception(
+                        "callback %s.%s also failed during teardown",
+                        type(cb).__name__, name)
+        if first is not None:
+            raise first
 
     def __getattr__(self, name):
         if name.startswith("on_"):
@@ -130,7 +166,10 @@ class ModelCheckpoint(Callback):
             self.model.save(path)
 
     def on_train_end(self, logs=None):
-        if self.model is not None:
+        # no "final" artifact for a crashed run: a partially-trained model
+        # must not be indistinguishable from a completed one
+        if self.model is not None and \
+                not getattr(self.model, "_train_aborted", False):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
@@ -271,6 +310,79 @@ class ReduceLROnPlateau(Callback):
             self.cooldown_counter = self.cooldown
 
 
+def _scalar_logs(logs):
+    """Float-coercible entries of a logs dict (shared by the scalar-sink
+    callbacks)."""
+    out = {}
+    for k, v in (logs or {}).items():
+        try:
+            out[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+class StepTelemetry(Callback):
+    """Per-step runtime telemetry to a JSONL file (paddle_tpu extension).
+
+    Each train batch appends one record with the step's scalar logs plus
+    the observability counter DELTAS for that step (op dispatches, jit
+    cache traffic, dataloader waits, ...) and current gauges — the same
+    stream ``bench.py`` consumes, surfaced through the hapi loop so any
+    ``Model.fit`` run gets step telemetry without a profiler session.
+
+    ``enable_metrics=True`` (default) turns the observability registry on
+    for the duration of training and restores the prior enabled state at
+    train end; pass False to only record what an already-enabled registry
+    collects.
+    """
+
+    def __init__(self, path: str, enable_metrics: bool = True):
+        super().__init__()
+        self.path = path
+        self._enable_metrics = enable_metrics
+        self._writer = None
+        self._global_step = 0
+        self._was_enabled = False
+        self._began = False
+
+    def on_train_begin(self, logs=None):
+        from .. import observability as obs
+
+        # writer first: if the path is unwritable the raise happens BEFORE
+        # global state is touched
+        self._writer = obs.StepTelemetryWriter(self.path)
+        self._was_enabled = obs.enabled()
+        if self._enable_metrics:
+            obs.enable()
+        self._began = True
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if self._writer is not None:
+            self._writer.write(self._global_step, **_scalar_logs(logs))
+
+    def on_train_end(self, logs=None):
+        from .. import observability as obs
+
+        if not self._began:
+            # a sibling callback's on_train_begin raised before ours ran
+            # (fit's finally still fires every teardown hook): we changed
+            # no state, so restore nothing
+            return
+        self._began = False
+        try:
+            if self._writer is not None:
+                self._writer.close()
+                self._writer = None
+        finally:
+            # restore, don't clobber: metrics the USER enabled before
+            # fit() must stay on after it — and the restore must happen
+            # even when the writer's close/flush raises
+            if self._enable_metrics and not self._was_enabled:
+                obs.disable()
+
+
 class VisualDL(Callback):
     """Scalar-sink callback (parity: paddle.callbacks.VisualDL): writes
     per-step train metrics and per-epoch eval metrics through
@@ -287,15 +399,7 @@ class VisualDL(Callback):
             self._writer = LogWriter(logdir=self.log_dir)
         return self._writer
 
-    @staticmethod
-    def _scalars(logs):
-        out = {}
-        for k, v in (logs or {}).items():
-            try:
-                out[k] = float(v[0] if isinstance(v, (list, tuple)) else v)
-            except (TypeError, ValueError):
-                continue
-        return out
+    _scalars = staticmethod(_scalar_logs)  # back-compat alias
 
     def on_train_batch_end(self, step, logs=None):
         self._global_step += 1

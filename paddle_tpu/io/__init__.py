@@ -19,6 +19,7 @@ from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from .. import observability as _obs
 from ..core.random import default_generator
 from ..core.tensor import Tensor, to_tensor
 
@@ -426,6 +427,17 @@ class _WorkerPool:
     def _get_result(self, timeout):
         """Blocking get with worker-liveness polling: a hard worker death
         (segfault/OOM-kill) must raise, not hang the trainer forever."""
+        if _obs.enabled():
+            try:  # queue depth BEFORE the take: how far ahead workers are
+                _obs.set_gauge("dataloader.queue_depth",
+                               self._result_queue.qsize())
+            except NotImplementedError:
+                pass  # macOS: mp.Queue.qsize is unimplemented
+            with _obs.scoped_timer("dataloader.wait_seconds"):
+                return self._get_result_impl(timeout)
+        return self._get_result_impl(timeout)
+
+    def _get_result_impl(self, timeout):
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             poll = 5.0 if deadline is None else max(
@@ -573,6 +585,13 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
+        mode = ("workers" if self.num_workers and self.num_workers > 0
+                else "buffered" if self.use_buffer_reader else "sync")
+        for batch in self._iter_impl():
+            _obs.inc("dataloader.batches_total", mode=mode)
+            yield batch
+
+    def _iter_impl(self):
         if self.num_workers and self.num_workers > 0:
             pool = self._pool
             if pool is None:
@@ -617,7 +636,14 @@ class DataLoader:
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         while True:
-            item = q.get()
+            if _obs.enabled():
+                # depth before the take = how far ahead the prefetcher is;
+                # wait time = how long the trainer starved
+                _obs.set_gauge("dataloader.queue_depth", q.qsize())
+                with _obs.scoped_timer("dataloader.wait_seconds"):
+                    item = q.get()
+            else:
+                item = q.get()
             if item is sentinel:
                 break
             yield item
@@ -644,7 +670,11 @@ class DataLoader:
         t.start()
         try:
             while True:
-                item = q.pop()
+                if _obs.enabled():
+                    with _obs.scoped_timer("dataloader.wait_seconds"):
+                        item = q.pop()
+                else:
+                    item = q.pop()
                 if item is _native.BlockingQueue.CLOSED:
                     break
                 yield item

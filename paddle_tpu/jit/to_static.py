@@ -13,6 +13,7 @@ import numpy as np
 from ..core.tensor import (Tensor, TraceBreakError, _state_registry,
                            _is_tracer)
 from .. import flags as _flags
+from .. import observability as _obs
 from ..core.tracing import (TraceState, pop_trace_state, push_trace_state,
                             trace_state)
 
@@ -194,7 +195,9 @@ class StaticFunction:
             if self._iters > 1:
                 return self._run_iters_eager(args, kwargs)
             return self._fn(*args, **kwargs)
-        if entry is None:
+        fresh_build = entry is None
+        if fresh_build:
+            _obs.inc("jit.cache_misses_total")
             entry = self._build(treedef, proto, statics,
                                 [t for _, t in state_items])
             self._cache[key] = entry
@@ -205,10 +208,21 @@ class StaticFunction:
             # a state tensor died between building and calling (rare): rebuild
             del self._cache[key]
             return self.__call__(*args, **kwargs)
+        if not fresh_build:
+            # counted AFTER the dead-state check: a stale entry that forces
+            # the rebuild recursion above is one logical call, not a hit
+            # plus a miss
+            _obs.inc("jit.cache_hits_total")
 
         try:
-            return self._invoke(jitted, holder, state_tensors, arg_arrays,
-                                leaves, key)
+            result = self._invoke(jitted, holder, state_tensors, arg_arrays,
+                                  leaves, key)
+            if fresh_build:
+                # counted on SUCCESS, not at _build: a first call that
+                # graph-breaks discards the executable without XLA ever
+                # compiling it, and must not read as a compile
+                _obs.inc("jit.compiles_total")
+            return result
         except Exception as e:
             if self._full_graph or not _is_trace_failure(e):
                 # full-graph mode, or a genuine runtime failure (XLA execution
@@ -221,6 +235,7 @@ class StaticFunction:
             # lazy segment executor — compiled segments around the break,
             # Python as the control-flow interpreter (core/lazy.py). Falls
             # back to plain eager only if segmenting itself fails.
+            _obs.inc("jit.graph_breaks_total")
             if self._iters > 1:
                 self._cache[key] = _FALLBACK
                 self._warn_break(e, "eager execution (iters_per_call)")
@@ -331,6 +346,7 @@ class StaticFunction:
 
     # -------------------------------------------------------------------------
     def _build(self, treedef, proto, statics, state_tensors):
+        _obs.inc("jit.traces_total")
         if self._iters > 1:
             return self._build_scan(treedef, proto, statics, state_tensors)
         holder: Dict[str, Any] = {"spec": None}

@@ -25,6 +25,8 @@ import time
 from enum import Enum
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from .. import observability as _obs
+
 __all__ = [
     "ProfilerTarget", "ProfilerState", "Profiler", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
@@ -167,8 +169,14 @@ class RecordEvent:
 
     def end(self) -> None:
         if self._t0 is not None:
-            _tracer.emit(self.name, self._t0, time.perf_counter(),
-                         self.event_type)
+            t1 = time.perf_counter()
+            _tracer.emit(self.name, self._t0, t1, self.event_type)
+            if _obs.enabled():
+                # profiler ranges double as metric samples: a RecordEvent
+                # around e.g. "data_augment" feeds the same telemetry
+                # stream whether or not a Profiler window is recording
+                _obs.observe("profiler.record_event_seconds", t1 - self._t0,
+                             name=self.name)
             self._t0 = None
 
     def __enter__(self) -> "RecordEvent":

@@ -66,3 +66,32 @@ def test_healthy_run_is_clean():
 
 def test_no_lkg_disables_throughput_guard_only():
     assert bench._anomaly_reasons(10.0, [100.0] * 6, None) == []
+
+
+def test_telemetry_detail_is_schema_stable():
+    # every bench JSON row must carry the full telemetry field set, zeros
+    # included, so BENCH_r0*.json stays diffable across rounds
+    detail = bench._telemetry_detail({})
+    assert set(detail) == set(bench.TELEMETRY_FIELDS)
+    assert all(v == 0 for v in detail.values())
+    assert "dispatch.ops_total" in detail and "jit.compiles_total" in detail
+
+
+def test_telemetry_detail_selects_counters():
+    snap = {"dispatch.ops_total": 123.0, "jit.compiles_total": 2.0,
+            "dispatch.latency_seconds": {"count": 123},  # ignored: not selected
+            "jit.cache_hits_total": 7.0}
+    detail = bench._telemetry_detail(snap)
+    assert detail["dispatch.ops_total"] == 123
+    assert detail["jit.compiles_total"] == 2
+    assert detail["jit.cache_hits_total"] == 7
+    assert detail["jit.graph_breaks_total"] == 0
+
+
+def test_bench_main_emits_telemetry():
+    # main() must wire _telemetry_detail into the JSON "detail" block (the
+    # full main() needs a device-sized run; pin the wiring statically)
+    import inspect
+    src = inspect.getsource(bench.main)
+    assert "_telemetry_detail" in src and '"telemetry"' in src
+    assert "obs.enable()" in src

@@ -1,0 +1,62 @@
+"""Lint: no SILENT exception swallowing in ``paddle_tpu/distributed/``.
+
+ADVICE r5 flagged failure paths that mapped errors to healthy states with
+no signal at all (elastic store reads -> "fresh node", async pushes ->
+dropped gradients). The rule enforced here is deliberately tiny: an
+``except`` handler whose body is a bare ``pass`` must carry a SIGNAL —
+either an inline comment (on the except/pass lines or immediately after)
+justifying why swallowing is correct, or an actual logged/counted
+statement in the body (which makes it not-a-bare-pass). New silent
+swallows fail this test with their file:line.
+"""
+
+import ast
+import glob
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DISTRIBUTED = os.path.join(REPO, "paddle_tpu", "distributed")
+
+
+def _silent_except_pass(path):
+    with open(path) as f:
+        src = f.read()
+    lines = src.splitlines()
+    offenders = []
+    for node in ast.walk(ast.parse(src)):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
+            continue
+        # window: except line .. pass line, plus trailing comment-only lines
+        lo, hi = node.lineno - 1, node.body[0].lineno
+        window = lines[lo:hi]
+        j = hi
+        while j < len(lines) and lines[j].lstrip().startswith("#"):
+            window.append(lines[j])
+            j += 1
+        if not any("#" in ln for ln in window):
+            offenders.append(f"{path}:{node.lineno}")
+    return offenders
+
+
+def test_no_silent_except_pass_in_distributed():
+    offenders = []
+    for path in sorted(glob.glob(os.path.join(DISTRIBUTED, "**", "*.py"),
+                                 recursive=True)):
+        offenders.extend(_silent_except_pass(path))
+    assert offenders == [], (
+        "silent `except ...: pass` without a comment or counted signal "
+        f"(add a justification comment or count it via observability): "
+        f"{offenders}")
+
+
+def test_lint_actually_detects_a_swallow(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("try:\n    x = 1\nexcept Exception:\n    pass\n")
+    found = _silent_except_pass(str(bad))
+    assert len(found) == 1 and found[0].endswith("bad.py:3")
+    good = tmp_path / "good.py"
+    good.write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass  # why: benign\n")
+    assert _silent_except_pass(str(good)) == []
