@@ -1,10 +1,19 @@
 """Eager-dispatch overhead microbenchmark (SURVEY.md §7 hard-part #2).
 
 Measures fwd+bwd through the eager tape (apply() -> vjp record, one device
-dispatch per op) vs the SAME fwd+bwd chain compiled under ``to_static`` —
-quantifying the Python dispatch cost the reference buries in codegen'd C++
-ad_funcs, and the factor whole-step compilation buys back. Both paths run
-forward AND backward; timing blocks on the produced gradient.
+dispatch per op) three ways over the SAME repeated-signature chain:
+
+* ``cold_ms``   — compiled-op cache disabled (the seed dispatch path:
+  un-jitted fn + a fresh ``jax.vjp`` trace per op per call);
+* ``cached_ms`` — signature-keyed compiled-op cache enabled and warm
+  (``core/dispatch_cache.py``): each op dispatches to a cached jitted
+  executable, the tape reuses the cached vjp;
+* ``compiled_fwd_bwd_ms`` — the whole chain under ``to_static`` (the
+  upper bound whole-program compilation buys).
+
+``speedup_x = cold_ms / cached_ms`` is the acceptance metric (ISSUE 2
+target: >= 3x); ``hit_rate`` comes from the cache's own counters and
+pins that the measurement actually exercised the hot path.
 
 Prints one JSON line.
 """
@@ -23,11 +32,20 @@ import numpy as np
 N_ITERS = 200          # loop iterations; each runs 2 elementwise ops
 OPS = 2 * N_ITERS      # elementwise ops per forward chain (+ final sum)
 
+# schema of the JSON row, pinned by tests/test_bench_selfdefense.py
+RESULT_FIELDS = (
+    "benchmark", "chain_elementwise_ops",
+    "cold_ms", "cached_ms", "speedup_x", "hit_rate",
+    "cold_us_per_op", "cached_us_per_op",
+    "compiled_fwd_bwd_ms", "device",
+)
+
 
 def main() -> None:
     import jax
 
     import paddle_tpu as paddle
+    from paddle_tpu.core import dispatch_cache as dcache
 
     x = paddle.to_tensor(np.random.randn(64, 64).astype("float32"),
                          stop_gradient=False)
@@ -43,12 +61,24 @@ def main() -> None:
         jax.block_until_ready(x.grad._data)  # wait on the actual output
         x.clear_grad()
 
-    eager_step()  # warm-up covers backward-path setup too
+    def time_steps(reps: int) -> float:
+        eager_step()  # warm-up covers backward-path setup too
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            eager_step()
+        return (time.perf_counter() - t0) / reps
+
     reps = 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        eager_step()
-    eager_dt = (time.perf_counter() - t0) / reps
+    dcache.configure(enabled=False)
+    cold_dt = time_steps(reps)
+
+    dcache.configure(enabled=True, warmup=2)
+    dcache.cache_clear()
+    eager_step()  # sighting 1: cold misses
+    eager_step()  # sighting 2: per-signature compiles
+    dcache.stats_clear()  # count hit_rate over the timed (warm) reps only
+    cached_dt = time_steps(reps)
+    info = dcache.cache_info()
 
     # compiled fwd+bwd (symmetric with the eager measurement)
     @paddle.jit.to_static
@@ -66,15 +96,20 @@ def main() -> None:
     static_dt = (time.perf_counter() - t0) / (reps * 10)
     x.clear_grad()
 
-    print(json.dumps({
+    row = {
         "benchmark": "eager_dispatch",
         "chain_elementwise_ops": OPS,
-        "eager_fwd_bwd_ms": round(eager_dt * 1e3, 2),
-        "eager_us_per_op": round(1e6 * eager_dt / OPS, 1),
+        "cold_ms": round(cold_dt * 1e3, 2),
+        "cached_ms": round(cached_dt * 1e3, 2),
+        "speedup_x": round(cold_dt / cached_dt, 2),
+        "hit_rate": round(info["hit_rate"], 4),
+        "cold_us_per_op": round(1e6 * cold_dt / OPS, 1),
+        "cached_us_per_op": round(1e6 * cached_dt / OPS, 1),
         "compiled_fwd_bwd_ms": round(static_dt * 1e3, 3),
-        "eager_vs_compiled_x": round(eager_dt / static_dt, 1),
         "device": str(jax.devices()[0]),
-    }))
+    }
+    assert set(row) == set(RESULT_FIELDS)
+    print(json.dumps(row))
 
 
 if __name__ == "__main__":

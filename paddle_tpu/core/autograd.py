@@ -29,6 +29,15 @@ class GradNode:
     Input grad linkage (``Edge``s) is SNAPSHOTTED at record time — in-place
     ops rebind a tensor onto the node they just produced, so reading the
     *current* ``_grad_node`` of an input during backward would find a cycle.
+
+    ``vjp_fn`` contract: callable taking the output cotangent structure
+    (tuple iff ``multi_out``) and returning one cotangent per input, where a
+    non-differentiable input may come back as ``jax.dtypes.float0`` or
+    ``None`` — both skipped by ``backward``. Eager dispatch records a fresh
+    ``jax.vjp`` closure; the compiled-op cache (core/dispatch_cache.py)
+    instead hands the tape a cached jitted backward that re-linearizes the
+    op at its primals inside ONE compiled program per signature, so
+    repeated-signature backward pays no per-call retrace.
     """
 
     __slots__ = ("id", "op_name", "vjp_fn", "pure_fn", "inputs",

@@ -1,0 +1,472 @@
+"""Signature-keyed compiled-op cache for eager dispatch.
+
+Parity surface: the reference buries per-op dispatch cost in codegen'd C++
+``*_ad_func``s plus the Phi kernel fast path; our ``apply()`` is Python and
+re-traces every op through un-jitted ``jax.vjp``/``fn`` calls. This module
+amortizes that work the way LazyTensor and TorchDynamo do: the FIRST calls
+for a signature run the plain eager path, and once a signature repeats it is
+compiled (``jax.jit``) and every later call goes straight to the cached
+executable — no retrace, no closure rebuild, no per-op ``jnp`` re-lowering.
+
+A signature is ``(op_name, fn structural fingerprint, static kwargs, input
+avals (shape/dtype/weak-type), resolved-autocast token, needs_grad,
+check_nan_inf)``. The fingerprint walks the op fn's closure cells and
+defaults (ops here are tiny per-call lambdas closing over python scalars —
+``lambda a: jfn(a, y)``), so two calls with equal closure state share one
+compiled executable while ``reshape([2, 3])`` vs ``reshape([3, 2])`` do not.
+Anything value-unstable (arrays/tensors/tracers in closures, unhashable
+statics) makes the op fall back to the uncached path, counted per reason.
+
+The cache is process-global, thread-safe (one lock; jitted callables are
+themselves thread-safe), LRU-bounded, and toggleable:
+
+* ``PADDLE_TPU_EAGER_CACHE=0``       — disable entirely (dispatch identical
+  to the uncached path; ``core.tensor`` probes one module bool).
+* ``PADDLE_TPU_EAGER_CACHE_SIZE``    — LRU capacity (default 1024).
+* ``PADDLE_TPU_EAGER_CACHE_WARMUP``  — sightings of a signature before it is
+  compiled (default 2: never pay a compile for a signature seen once).
+
+``core.tensor`` owns the dispatch integration; this module owns keys,
+storage, policy, and counters (mirrored into ``paddle_tpu.observability``
+through ``_obs_hook`` while metrics are enabled).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import types
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+try:
+    from jax.core import Tracer as _Tracer
+except Exception:  # pragma: no cover
+    from jax._src.core import Tracer as _Tracer
+
+__all__ = [
+    "CachedOp", "configure", "cache_clear", "cache_info", "lookup", "store",
+    "note_bypass", "make_key", "NEEDS_COMPILE",
+]
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_EAGER_CACHE", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def _env_int(name: str, default: int, lo: int = 1) -> int:
+    try:
+        return max(lo, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+_ENABLED: bool = _env_enabled()
+_MAXSIZE: int = _env_int("PADDLE_TPU_EAGER_CACHE_SIZE", 1024)
+_WARMUP: int = _env_int("PADDLE_TPU_EAGER_CACHE_WARMUP", 2)
+
+_LOCK = threading.Lock()
+# key -> CachedOp | _UNCACHEABLE. Kept SEPARATE from the warmup counters:
+# identity-keyed signatures that never repeat (fresh functools.partial-like
+# callables) would otherwise churn counter insertions through the LRU and
+# flush genuinely hot compiled entries.
+_CACHE: "OrderedDict[Any, Any]" = OrderedDict()
+# key -> sighting count (seen, not yet compiled); same bound, own churn.
+_PENDING: "OrderedDict[Any, int]" = OrderedDict()
+# key -> consecutive failed compile attempts (non-trace errors); a key that
+# keeps failing is poisoned after _MAX_COMPILE_RETRIES so dispatch doesn't
+# silently pay a doomed re-trace per call forever.
+_FAILS: "OrderedDict[Any, int]" = OrderedDict()
+_MAX_COMPILE_RETRIES = 3
+
+_STATS: Dict[str, Any] = {
+    "hits": 0, "misses": 0, "compiles": 0, "evictions": 0,
+    "pending_drops": 0,  # warmup counters displaced before compiling —
+    #                      NOT evictions: no compile work was lost
+    "bypass": {},  # reason -> count
+}
+
+# Installed by paddle_tpu.observability while enabled; called as
+# hook(kind, reason) with kind in {hit, miss, compile, evict, bypass}.
+# None => the cache pays nothing beyond the is-None probe.
+_obs_hook: Optional[Callable[[str, Optional[str]], None]] = None
+
+NEEDS_COMPILE = object()  # lookup() verdict: signature is warm, build an entry
+_UNCACHEABLE = object()   # poisoned signature: fn untraceable, never retry
+
+
+class CachedOp:
+    """One compiled signature: jitted forward (+ fused finite check) and a
+    lazily-jitted backward that re-linearizes the op inside XLA.
+
+    ``fwd(*arrays) -> (outs, finite)`` where ``finite`` is None when the
+    nan-check is off (or no inexact outputs) and a scalar bool otherwise —
+    ONE host sync replaces the per-output blocking ``jnp.all`` loop.
+    ``bwd(arrays, cts) -> input cotangents`` recomputes the vjp of the
+    composed fn at the primals inside one compiled program; numerics are
+    identical to an eager ``jax.vjp`` at the same primals, but the
+    linearization is traced once per signature instead of once per call.
+    """
+
+    __slots__ = ("fn", "fwd", "bwd", "nan_check")
+
+    def __init__(self, fn: Callable, nan_check: bool):
+        self.fn = fn  # the composed pure fn (casts + static kwargs baked in)
+        self.nan_check = nan_check
+
+        def _fwd(*xs):
+            r = fn(*xs)
+            if not nan_check:
+                return r, None
+            outs = r if isinstance(r, tuple) else (r,)
+            finite = None
+            for o in outs:
+                if jax.numpy.issubdtype(o.dtype, jax.numpy.inexact):
+                    ok = jax.numpy.all(jax.numpy.isfinite(o))
+                    finite = ok if finite is None else \
+                        jax.numpy.logical_and(finite, ok)
+            return r, finite
+
+        def _bwd(xs, cts):
+            _, vjp = jax.vjp(fn, *xs)
+            gs = vjp(cts)
+            # float0 cotangents (integer primals) never leave the program:
+            # backward skips None exactly like it skips float0
+            return tuple(
+                None if getattr(g, "dtype", None) == jax.dtypes.float0 else g
+                for g in gs)
+
+        self.fwd = jax.jit(_fwd)
+        self.bwd = jax.jit(_bwd)
+
+    def make_vjp(self, arrays: Tuple[Any, ...]) -> Callable:
+        """A vjp callable for the tape with the ``jax.vjp`` contract (takes
+        the output cotangent structure, returns per-input grads)."""
+        bwd = self.bwd
+
+        def vjp_fn(cts):
+            return bwd(arrays, cts)
+
+        return vjp_fn
+
+    def warm_bwd(self, arrays, out_arrays, multi: bool) -> None:
+        """Trace+compile the backward NOW (at dispatch/store time) with
+        zero cotangents of the outputs' avals. The seed's ``jax.vjp``
+        snapshots the op fn's closure state at dispatch; deferring the bwd
+        trace to the first ``backward()`` would instead read closure state
+        as of backward time — observable if a caller mutates e.g. a
+        closure-held list in between. One throwaway execution on zeros per
+        signature keeps the snapshot semantics."""
+        zeros = tuple(jax.numpy.zeros(o.shape, o.dtype) for o in out_arrays)
+        self.bwd(tuple(arrays), zeros if multi else zeros[0])
+
+
+# ---------------------------------------------------------------------------
+# signature fingerprinting
+# ---------------------------------------------------------------------------
+
+class _Bypass(Exception):
+    def __init__(self, reason: str):
+        self.reason = reason
+
+
+_SCALARS = (bool, int, float, str, bytes, complex)
+_MAX_FN_DEPTH = 3
+
+
+def _is_arraylike(v) -> bool:
+    return (isinstance(v, (jax.Array, np.ndarray)) or isinstance(v, _Tracer)
+            or type(v).__name__ == "LazyValue" or hasattr(v, "_grad_node"))
+
+
+def _fp_value(v, depth: int):
+    """Hashable, value-stable fingerprint of one closure/static value.
+
+    Mutable containers are keyed by CONTENT (a later mutation yields a new
+    key, never a stale hit); arrays, tensors, tracers and unknown objects
+    raise ``_Bypass`` — unhashable or identity-keyed-but-mutable values must
+    not silently pin a compiled constant.
+    """
+    if v is None:
+        return None
+    t = v.__class__
+    if t in _SCALARS:
+        return (t, v)
+    if t is tuple or t is list:
+        return ("T" if t is tuple else "L",
+                tuple(_fp_value(x, depth) for x in v))
+    if t is dict:
+        return ("D", tuple(sorted(
+            (str(k), _fp_value(x, depth)) for k, x in v.items())))
+    if t is slice:
+        return ("SL", _fp_value(v.start, depth), _fp_value(v.stop, depth),
+                _fp_value(v.step, depth))
+    if isinstance(v, np.dtype) or (isinstance(v, type)
+                                   and issubclass(v, np.generic)):
+        return ("DT", np.dtype(v).str)
+    if isinstance(v, np.generic):  # 0-d numpy scalar: immutable, hashable
+        return (t, v.item())
+    if _is_arraylike(v):
+        raise _Bypass("closure_array")
+    if isinstance(v, types.FunctionType):
+        if depth >= _MAX_FN_DEPTH:
+            # deep nesting: key on the function object itself — stable for
+            # module-level fns, per-call churn (bounded by the LRU) for
+            # fresh closures
+            return ("F", v)
+        return _fp_fn(v, depth + 1)
+    if isinstance(v, functools.partial):
+        # ops build fresh partials per call (e.g. partial(_pairwise_iou,
+        # mode=mode)): identity keying would never hit — fingerprint by
+        # (func, args, keywords), which IS stable across calls
+        return ("P", _fp_value(v.func, depth),
+                tuple(_fp_value(a, depth) for a in v.args),
+                tuple(sorted((k, _fp_value(a, depth))
+                             for k, a in v.keywords.items())))
+    if callable(v):
+        # builtins / ufuncs / jitted wrappers: module-level singletons with
+        # stable identity; keyed by the object (the key tuple keeps it alive
+        # so the id can never be reused)
+        return ("C", v)
+    if t is frozenset:
+        return ("FS", v)
+    raise _Bypass("static_unhashable")
+
+
+def _fp_fn(fn, depth: int):
+    code = fn.__code__
+    parts = [code]
+    closure = fn.__closure__
+    if closure:
+        for cell in closure:
+            try:
+                parts.append(_fp_value(cell.cell_contents, depth))
+            except ValueError:  # empty cell
+                parts.append(("E",))
+    defaults = fn.__defaults__
+    if defaults:
+        parts.append(tuple(_fp_value(v, depth) for v in defaults))
+    kwdefaults = fn.__kwdefaults__
+    if kwdefaults:
+        parts.append(tuple(sorted(
+            (k, _fp_value(v, depth)) for k, v in kwdefaults.items())))
+    return ("FN", tuple(parts))
+
+
+def make_key(op_name: str, fn: Callable, in_sigs: Tuple,
+             static_kwargs: Dict[str, Any], amp_key, needs_grad: bool,
+             nan_check: bool, flags_epoch: int):
+    """Build the cache key, or ``(None, reason)`` when the op must bypass.
+
+    ``flags_epoch`` folds every runtime ``set_flags`` write into the key:
+    op fns read flags at trace time (tpu_matmul_precision, flash_block_*),
+    so a flag flip must retire all compiled entries rather than serve the
+    baked-in old value.
+    """
+    try:
+        if isinstance(fn, types.FunctionType):
+            fn_key = _fp_fn(fn, 0)
+        else:
+            fn_key = _fp_value(fn, 0)  # partial/builtin/ufunc rules
+        if static_kwargs:
+            statics = tuple(sorted(
+                (k, _fp_value(v, 0)) for k, v in static_kwargs.items()))
+        else:
+            statics = ()
+        key = (op_name, fn_key, statics, in_sigs, amp_key, needs_grad,
+               nan_check, flags_epoch)
+        hash(key)  # identity-keyed callables may be hash-less: probe NOW,
+        #            not inside the cache dict where it would escape
+    except _Bypass as e:
+        return None, e.reason
+    except TypeError:
+        return None, "static_unhashable"
+    return key, None
+
+
+# ---------------------------------------------------------------------------
+# storage / policy
+# ---------------------------------------------------------------------------
+
+def lookup(key):
+    """One cache probe. Returns a ``CachedOp`` (hit), ``NEEDS_COMPILE``
+    (signature warm: caller builds + ``store()``s an entry), or ``None``
+    (cold miss: caller runs the uncached path). The observability hook is
+    invoked AFTER the lock is released — a hit must never serialize on a
+    metric-family lock."""
+    hook = _obs_hook
+    with _LOCK:
+        v = _CACHE.get(key)
+        if v.__class__ is CachedOp:
+            _CACHE.move_to_end(key)
+            _STATS["hits"] += 1
+            event, result = "hit", v
+        elif v is _UNCACHEABLE:
+            _CACHE.move_to_end(key)
+            b = _STATS["bypass"]
+            b["untraceable"] = b.get("untraceable", 0) + 1
+            event, result = "bypass", None
+        else:
+            _STATS["misses"] += 1
+            event = "miss"
+            n = _PENDING.get(key)
+            if n is None:
+                if _WARMUP <= 1:  # compile-on-first-sighting mode
+                    result = NEEDS_COMPILE
+                else:
+                    _PENDING[key] = 1
+                    result = None
+                    if len(_PENDING) > _MAXSIZE:
+                        _PENDING.popitem(last=False)
+                        _STATS["pending_drops"] += 1
+            elif n + 1 >= _WARMUP:
+                result = NEEDS_COMPILE
+            else:
+                _PENDING[key] = n + 1
+                _PENDING.move_to_end(key)
+                result = None
+    if hook is not None:
+        hook(event, "untraceable" if event == "bypass" else None)
+    return result
+
+
+def _insert_locked(key, value) -> bool:
+    """Put a compiled/poisoned entry; returns True if the LRU evicted."""
+    _CACHE[key] = value
+    _CACHE.move_to_end(key)
+    _PENDING.pop(key, None)
+    _FAILS.pop(key, None)
+    if len(_CACHE) > _MAXSIZE:
+        _CACHE.popitem(last=False)
+        _STATS["evictions"] += 1
+        return True
+    return False
+
+
+def store(key, entry: CachedOp) -> None:
+    hook = _obs_hook
+    with _LOCK:
+        evicted = _insert_locked(key, entry)
+        _STATS["compiles"] += 1
+    if hook is not None:
+        hook("compile", None)
+        if evicted:
+            hook("evict", None)
+
+
+def mark_uncacheable(key) -> None:
+    """Poison a signature whose fn failed to trace/compile (e.g. it branches
+    on concrete array values, legal eagerly but not under jit). Later calls
+    take the uncached path immediately instead of re-tracing every time."""
+    with _LOCK:
+        _insert_locked(key, _UNCACHEABLE)
+
+
+def note_compile_failure(key) -> None:
+    """A compile attempt failed with a non-trace error (transient runtime
+    fault, input-dependent failure). Retrying on a later call is desirable
+    — ONCE or twice; a key that keeps failing gets poisoned so dispatch
+    stops paying a doomed re-trace on every call. Each attempt is counted
+    (``bypass{compile_retry}``) so the retry loop is diagnosable."""
+    with _LOCK:
+        n = _FAILS.get(key, 0) + 1
+        if n >= _MAX_COMPILE_RETRIES:
+            _insert_locked(key, _UNCACHEABLE)
+        else:
+            _FAILS[key] = n
+            _FAILS.move_to_end(key)
+            if len(_FAILS) > 64:
+                # displaced under pressure: poison instead of forgetting —
+                # dropping the count would let >64 rotating failing
+                # signatures each re-trace forever without ever reaching
+                # the retry cap (poisoning early is always safe, it only
+                # costs that signature the cached fast path)
+                old_key, _n = _FAILS.popitem(last=False)
+                _insert_locked(old_key, _UNCACHEABLE)
+    note_bypass("compile_retry")
+
+
+def note_bypass(reason: str) -> None:
+    # no lock: this runs per op while a capture seam is live (to_static
+    # trace, EVERY op of a lazy segment re-record), where the promise is
+    # "unchanged dispatch" — a GIL-racy dict bump that can rarely lose a
+    # count is the right trade for a diagnostic; the observability
+    # counters (when enabled) take their own per-family lock and stay
+    # exact
+    b = _STATS["bypass"]
+    b[reason] = b.get(reason, 0) + 1
+    hook = _obs_hook
+    if hook is not None:
+        hook("bypass", reason)
+
+
+# ---------------------------------------------------------------------------
+# control surface
+# ---------------------------------------------------------------------------
+
+def configure(enabled: Optional[bool] = None, maxsize: Optional[int] = None,
+              warmup: Optional[int] = None) -> None:
+    """Runtime override of the env-derived settings (tests, tuning)."""
+    global _ENABLED, _MAXSIZE, _WARMUP
+    with _LOCK:
+        if enabled is not None:
+            _ENABLED = bool(enabled)
+        if maxsize is not None:
+            _MAXSIZE = max(1, int(maxsize))
+            while len(_CACHE) > _MAXSIZE:
+                _CACHE.popitem(last=False)
+                _STATS["evictions"] += 1
+            while len(_PENDING) > _MAXSIZE:
+                _PENDING.popitem(last=False)
+                _STATS["pending_drops"] += 1
+        if warmup is not None:
+            _WARMUP = max(1, int(warmup))
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def cache_clear(reset_stats: bool = True) -> None:
+    with _LOCK:
+        _CACHE.clear()
+        _PENDING.clear()
+        _FAILS.clear()
+        if reset_stats:
+            _STATS.update(hits=0, misses=0, compiles=0, evictions=0,
+                          pending_drops=0, bypass={})
+
+
+def stats_clear() -> None:
+    """Zero the counters without dropping compiled entries (benchmarks
+    measure hit_rate over a window that starts warm)."""
+    with _LOCK:
+        _STATS.update(hits=0, misses=0, compiles=0, evictions=0,
+                      pending_drops=0, bypass={})
+
+
+def cache_info() -> Dict[str, Any]:
+    with _LOCK:
+        compiled = sum(1 for v in _CACHE.values() if v.__class__ is CachedOp)
+        hits, misses = _STATS["hits"], _STATS["misses"]
+        total = hits + misses
+        return {
+            "enabled": _ENABLED,
+            "maxsize": _MAXSIZE,
+            "warmup": _WARMUP,
+            "size": len(_CACHE),
+            "pending": len(_PENDING),
+            "compiled": compiled,
+            "hits": hits,
+            "misses": misses,
+            "compiles": _STATS["compiles"],
+            "evictions": _STATS["evictions"],
+            "pending_drops": _STATS["pending_drops"],
+            "bypass": dict(_STATS["bypass"]),
+            "hit_rate": (hits / total) if total else 0.0,
+        }

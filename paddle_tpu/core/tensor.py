@@ -11,7 +11,9 @@ tape recording (via ``jax.vjp``), trace-state read logging, and NaN checks.
 
 from __future__ import annotations
 
+import itertools
 import numbers
+import time as _time
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -21,6 +23,8 @@ import numpy as np
 from .. import flags as _flags
 from .. import device as _device
 from . import dtype as _dtype
+from . import dispatch_cache as _dcache
+from . import lazy as _lazy
 from . import tracing as _tracing
 from .autograd import GradNode, backward as _backward
 
@@ -44,12 +48,14 @@ def _is_tracer(x) -> bool:
 
 
 class RemovableHandle:
-    _next_id = 0
+    # itertools.count is a single C-level atomic step: hook registration from
+    # dataloader worker threads can't mint duplicate ids the way the old
+    # unlocked ``_next_id += 1`` read-modify-write could
+    _id_counter = itertools.count()
 
     def __init__(self, hooks: dict):
         self._hooks = hooks
-        self.hook_id = RemovableHandle._next_id
-        RemovableHandle._next_id += 1
+        self.hook_id = next(RemovableHandle._id_counter)
 
     def remove(self) -> None:
         self._hooks.pop(self.hook_id, None)
@@ -108,6 +114,12 @@ class Tensor:
     @property
     def shape(self):
         return list(self._data.shape)
+
+    def shape_tuple(self) -> Tuple[int, ...]:
+        """``shape`` without the per-access list build: the payload's shape
+        tuple as-is. Hot-path consumers (dispatch-cache key extraction)
+        use this so metadata reads don't allocate."""
+        return self._data.shape
 
     @property
     def ndim(self) -> int:
@@ -176,7 +188,6 @@ class Tensor:
         if type(self._data).__name__ == "LazyValue":
             # concrete read of a pending value: segment boundary — flush the
             # recorded graph (the SOT graph-break point)
-            from . import lazy as _lazy
             if self._data.array is None:
                 _lazy.flush()
             if type(self._data).__name__ == "LazyValue":
@@ -449,9 +460,6 @@ def _lazy_apply(op_name, f, tensor_inputs, arrays, needs_grad):
     op is RECORDED, outputs are LazyValue placeholders, and the tape node
     carries only pure_fn — backward re-dispatches through apply() so the
     gradient ops land in the (compiled) segment too."""
-    from . import lazy as _lazy
-    from .autograd import GradNode
-
     out_lazies, multi = _lazy.record(op_name, f, arrays)
     out_tensors = []
     if needs_grad:
@@ -483,7 +491,6 @@ def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
     prof_hook = _op_profile_hook
     metrics_hook = _op_metrics_hook
     if prof_hook is not None or metrics_hook is not None:
-        import time as _time
         _t0 = _time.perf_counter()
         try:
             return _apply_impl(op_name, fn, *tensor_inputs,
@@ -499,9 +506,142 @@ def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
                        differentiable=differentiable, amp=amp, **static_kwargs)
 
 
+def _build_pure_fn(fn: Callable, cast_targets, static_kwargs) -> Callable:
+    """The traced/differentiated form of one op: autocast applied INSIDE so
+    the cast itself is differentiated, static kwargs baked, list outputs
+    normalized to tuples. Shared by the uncached, cached, and lazy paths."""
+    def f(*xs):
+        if cast_targets is not None:
+            xs = [x.astype(d) if d is not None else x
+                  for x, d in zip(xs, cast_targets)]
+        r = fn(*xs, **static_kwargs) if static_kwargs else fn(*xs)
+        return tuple(r) if isinstance(r, list) else r
+    return f
+
+
+def _input_sig(t: Tensor):
+    """(shape, dtype, weak_type) of one input — the aval when the payload
+    carries one (jax arrays), ``shape_tuple()`` otherwise (numpy payloads)."""
+    a = t._data
+    av = getattr(a, "aval", None)
+    if av is not None:
+        return (av.shape, av.dtype, av.weak_type)
+    return (t.shape_tuple(), np.dtype(a.dtype), False)
+
+
+def _make_out_tensors(op_name, tensor_inputs, out_arrays, multi, needs_grad,
+                      vjp_fn, pure_fn):
+    out_tensors = []
+    if needs_grad:
+        node = GradNode(op_name, vjp_fn, tensor_inputs, len(out_arrays),
+                        tuple((oa.shape, oa.dtype) for oa in out_arrays),
+                        pure_fn=pure_fn, multi_out=multi)
+        for i, oa in enumerate(out_arrays):
+            t = Tensor(oa, stop_gradient=False)
+            t._grad_node = node
+            t._grad_index = i
+            out_tensors.append(t)
+    else:
+        for oa in out_arrays:
+            out_tensors.append(Tensor(oa, stop_gradient=True))
+    return out_tensors
+
+
+_UNCACHED = object()  # _apply_cached verdict: run the uncached path
+
+
+def _apply_cached(op_name, fn, tensor_inputs, differentiable, amp,
+                  static_kwargs):
+    """Fast path: dispatch through the signature-keyed compiled-op cache.
+
+    Returns ``_UNCACHED`` whenever the op must see the plain path: any
+    tracing/capture seam is live (to_static functionalization, lazy segment
+    recording, static-graph capture), an input payload is symbolic, or the
+    signature cannot be keyed safely. The caller falls through with NO state
+    changed, so the bypass is semantically invisible.
+    """
+    if (_tracing.trace_state() is not None or _op_graph_hook is not None
+            or _lazy.active()):
+        _dcache.note_bypass("capture")
+        return _UNCACHED
+    arrays = []
+    for t in tensor_inputs:
+        a = t._data
+        if _is_tracer(a) or type(a).__name__ == "LazyValue":
+            _dcache.note_bypass("symbolic_input")
+            return _UNCACHED
+        arrays.append(a)
+
+    needs_grad = (differentiable and _tracing.grad_enabled()
+                  and any(not t.stop_gradient for t in tensor_inputs))
+    st = _tracing.amp_state() if amp else None
+    amp_key = st.cache_key if (st is not None and st.enable) else None
+    nan_check = _flags.flag("check_nan_inf")
+
+    in_sigs = tuple(_input_sig(t) for t in tensor_inputs)
+    key, reason = _dcache.make_key(op_name, fn, in_sigs, static_kwargs,
+                                   amp_key, needs_grad, nan_check,
+                                   _flags._EPOCH)
+    if key is None:
+        _dcache.note_bypass(reason)
+        return _UNCACHED
+
+    entry = _dcache.lookup(key)
+    if entry is None:
+        return _UNCACHED  # cold signature: stay on the uncached path
+    fresh = entry is _dcache.NEEDS_COMPILE
+    if fresh:
+        # signature is warm: resolve autocast targets ONCE, build the
+        # compiled pair, and serve this call from it
+        cast_targets = _autocast_targets(op_name, arrays) if amp else None
+        entry = _dcache.CachedOp(
+            _build_pure_fn(fn, cast_targets, static_kwargs), nan_check)
+
+    try:
+        outs, finite = entry.fwd(*arrays)
+        multi = isinstance(outs, tuple)
+        out_arrays = outs if multi else (outs,)
+        if fresh and needs_grad:
+            # snapshot the linearization at dispatch time, like jax.vjp did
+            entry.warm_bwd(arrays, out_arrays, multi)
+    except (jax.errors.JAXTypeError, NotImplementedError):
+        if fresh:
+            # the fn is legal eagerly but not under jit (it branches on
+            # concrete values / lacks an abstract eval): poison the
+            # signature so it is never re-traced, and run the plain path —
+            # a genuine op error re-raises identically from there
+            _dcache.mark_uncacheable(key)
+        return _UNCACHED
+    except Exception:
+        # anything else (transient runtime fault, input-dependent error)
+        # must not poison outright: fall through, eager decides. Counted,
+        # and poisoned after a few consecutive failures so a persistent
+        # non-trace failure can't levy a doomed re-trace per call forever.
+        if fresh:
+            _dcache.note_compile_failure(key)
+        return _UNCACHED
+    if fresh:
+        _dcache.store(key, entry)
+    if finite is not None and not bool(finite):
+        raise FloatingPointError(f"op {op_name} produced nan/inf")
+
+    vjp_fn = entry.make_vjp(tuple(arrays)) if needs_grad else None
+    out_tensors = _make_out_tensors(op_name, tensor_inputs, out_arrays, multi,
+                                    needs_grad, vjp_fn, entry.fn)
+    if multi:
+        return tuple(out_tensors)
+    return out_tensors[0]
+
+
 def _apply_impl(op_name: str, fn: Callable, *tensor_inputs: Tensor,
                 differentiable: bool = True, amp: bool = True,
                 **static_kwargs) -> Any:
+    if _dcache._ENABLED:
+        out = _apply_cached(op_name, fn, tensor_inputs, differentiable, amp,
+                            static_kwargs)
+        if out is not _UNCACHED:
+            return out
+
     ts = _tracing.trace_state()
     arrays = []
     for t in tensor_inputs:
@@ -515,14 +655,8 @@ def _apply_impl(op_name: str, fn: Callable, *tensor_inputs: Tensor,
     needs_grad = (differentiable and _tracing.grad_enabled()
                   and any(not t.stop_gradient for t in tensor_inputs))
 
-    def f(*xs):
-        if cast_targets is not None:
-            xs = [x.astype(d) if d is not None else x
-                  for x, d in zip(xs, cast_targets)]
-        r = fn(*xs, **static_kwargs) if static_kwargs else fn(*xs)
-        return tuple(r) if isinstance(r, list) else r
+    f = _build_pure_fn(fn, cast_targets, static_kwargs)
 
-    from . import lazy as _lazy
     if _lazy.active():
         return _lazy_apply(op_name, f, tensor_inputs, arrays, needs_grad)
 
@@ -541,19 +675,8 @@ def _apply_impl(op_name: str, fn: Callable, *tensor_inputs: Tensor,
                 if not bool(jnp.all(jnp.isfinite(oa))):
                     raise FloatingPointError(f"op {op_name} produced nan/inf")
 
-    out_tensors = []
-    if needs_grad:
-        node = GradNode(op_name, vjp_fn, tensor_inputs, len(out_arrays),
-                        tuple((oa.shape, oa.dtype) for oa in out_arrays),
-                        pure_fn=f, multi_out=multi)
-        for i, oa in enumerate(out_arrays):
-            t = Tensor(oa, stop_gradient=False)
-            t._grad_node = node
-            t._grad_index = i
-            out_tensors.append(t)
-    else:
-        for oa in out_arrays:
-            out_tensors.append(Tensor(oa, stop_gradient=True))
+    out_tensors = _make_out_tensors(op_name, tensor_inputs, out_arrays, multi,
+                                    needs_grad, vjp_fn, f)
 
     if _op_graph_hook is not None:
         _op_graph_hook(op_name, f, tensor_inputs, tuple(out_tensors))
@@ -592,7 +715,14 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
             np_arr = np_arr.astype(np.int64)
         arr = np_arr
     if dtype is not None and arr.dtype != dtype:
-        arr = jnp.asarray(arr, dtype=dtype) if _is_tracer(arr) else np.asarray(arr).astype(dtype) if isinstance(arr, np.ndarray) else arr.astype(dtype)
+        if _is_tracer(arr):
+            arr = jnp.asarray(arr, dtype=dtype)
+        elif isinstance(arr, np.ndarray):
+            arr = arr.astype(dtype)
+        else:
+            # committed jax.Array (device_put upstream or passed in by the
+            # caller): cast on device, preserving its placement
+            arr = jnp.asarray(arr, dtype=dtype)
     if not _is_tracer(arr):
         if place is not None:
             # explicit placement commits the array to that device

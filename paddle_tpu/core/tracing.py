@@ -75,8 +75,18 @@ def set_grad_enabled(enabled: bool):
 
 # --- amp state ---------------------------------------------------------------
 
+# interned AmpState.cache_key tuples: every auto_cast scope with the same
+# config shares ONE tuple object, so per-op dispatch-cache key equality
+# short-circuits on element identity (PyObject_RichCompareBool) instead of
+# walking two ~50-entry frozensets. Bounded: a workload cycling through
+# more distinct amp configs than this simply stops sharing.
+_amp_key_intern: Dict[tuple, tuple] = {}
+_AMP_KEY_INTERN_MAX = 256
+
+
 class AmpState:
-    __slots__ = ("enable", "dtype", "level", "white_set", "black_set")
+    __slots__ = ("enable", "dtype", "level", "white_set", "black_set",
+                 "cache_key")
 
     def __init__(self, enable, dtype, level, white_set, black_set):
         self.enable = enable
@@ -84,6 +94,15 @@ class AmpState:
         self.level = level  # 'O1' | 'O2'
         self.white_set = white_set
         self.black_set = black_set
+        # hashable token for the dispatch-cache key, computed ONCE per
+        # autocast scope: op dispatch must not re-hash the op lists per call
+        key = (bool(enable), str(dtype), str(level),
+               frozenset(white_set), frozenset(black_set))
+        if len(_amp_key_intern) < _AMP_KEY_INTERN_MAX:
+            key = _amp_key_intern.setdefault(key, key)
+        else:
+            key = _amp_key_intern.get(key, key)
+        self.cache_key = key
 
 
 def amp_state() -> Optional[AmpState]:

@@ -73,12 +73,19 @@ class _FlagRegistry:
             return f.default
 
     def set(self, name: str, value: Any) -> None:
+        global _EPOCH
         name = self._canon(name)
         with self._lock:
             f = self._flags.get(name)
             if f is None:
                 raise KeyError(f"flag {name!r} is not defined")
             f.value = f.type(value) if not isinstance(value, f.type) else value
+            # runtime flag writes bump the epoch: caches keyed on flag-
+            # dependent behavior (the eager dispatch cache bakes flag reads
+            # like tpu_matmul_precision/flash_block_* into compiled entries
+            # at trace time) include it in their keys, so a set_flags()
+            # coarsely invalidates them instead of serving stale compiles
+            _EPOCH += 1
 
     def names(self) -> Iterable[str]:
         with self._lock:
@@ -86,6 +93,15 @@ class _FlagRegistry:
 
 
 _registry = _FlagRegistry()
+
+# monotone count of runtime flag writes (never of env-derived first reads);
+# see _FlagRegistry.set for the invalidation contract
+_EPOCH = 0
+
+
+def epoch() -> int:
+    """Current runtime-flag epoch (bumped by every ``set_flags`` write)."""
+    return _EPOCH
 
 
 def define_flag(name: str, default: Any, help: str = "", flag_type: Optional[type] = None) -> None:

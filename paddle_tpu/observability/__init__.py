@@ -79,6 +79,21 @@ _DISPATCH_BY_OP = _REGISTRY.counter(
 _DISPATCH_LATENCY = _REGISTRY.histogram(
     "dispatch.latency_seconds", "host-side latency of one eager dispatch")
 
+# eager compiled-op cache (core/dispatch_cache.py): hit/miss/compile/evict
+# plus bypasses labeled by reason (capture, symbolic_input, closure_array,
+# static_unhashable, untraceable)
+_CACHE_HITS = _REGISTRY.counter(
+    "dispatch.cache_hits_total", "eager-cache dispatches served compiled")
+_CACHE_MISSES = _REGISTRY.counter(
+    "dispatch.cache_misses_total", "eager-cache probes that found no entry")
+_CACHE_COMPILES = _REGISTRY.counter(
+    "dispatch.cache_compiles_total", "signatures compiled into the cache")
+_CACHE_EVICTIONS = _REGISTRY.counter(
+    "dispatch.cache_evictions_total", "LRU evictions from the eager cache")
+_CACHE_BYPASS = _REGISTRY.counter(
+    "dispatch.cache_bypass_total", "dispatches that bypassed the eager cache",
+    labelnames=("reason",))
+
 
 def _dispatch_hook(op_name: str, t0: float, t1: float) -> None:
     """Installed into ``core.tensor._op_metrics_hook`` while enabled."""
@@ -87,13 +102,29 @@ def _dispatch_hook(op_name: str, t0: float, t1: float) -> None:
     _DISPATCH_LATENCY.observe(t1 - t0)
 
 
+def _cache_hook(kind: str, reason) -> None:
+    """Installed into ``core.dispatch_cache._obs_hook`` while enabled."""
+    if kind == "hit":
+        _CACHE_HITS.inc()
+    elif kind == "miss":
+        _CACHE_MISSES.inc()
+    elif kind == "compile":
+        _CACHE_COMPILES.inc()
+    elif kind == "evict":
+        _CACHE_EVICTIONS.inc()
+    else:
+        _CACHE_BYPASS.inc(reason=reason or "other")
+
+
 def enable() -> None:
-    """Turn metrics collection on and install the dispatch hook."""
+    """Turn metrics collection on and install the dispatch hooks."""
     global _ENABLED
     with _LOCK:
         _ENABLED = True
         from ..core import tensor as _tensor_mod
+        from ..core import dispatch_cache as _dcache_mod
         _tensor_mod._op_metrics_hook = _dispatch_hook
+        _dcache_mod._obs_hook = _cache_hook
 
 
 def disable() -> None:
@@ -102,7 +133,9 @@ def disable() -> None:
     with _LOCK:
         _ENABLED = False
         from ..core import tensor as _tensor_mod
+        from ..core import dispatch_cache as _dcache_mod
         _tensor_mod._op_metrics_hook = None
+        _dcache_mod._obs_hook = None
 
 
 # -- family accessors (get-or-create on the default registry) ----------------

@@ -95,3 +95,51 @@ def test_bench_main_emits_telemetry():
     src = inspect.getsource(bench.main)
     assert "_telemetry_detail" in src and '"telemetry"' in src
     assert "obs.enable()" in src
+
+
+# ---------------------------------------------------------------------------
+# eager-dispatch bench schema + dispatch fast-path hygiene (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+def _load_bench_eager_dispatch():
+    spec = importlib.util.spec_from_file_location(
+        "bench_eager_dispatch",
+        os.path.join(REPO, "benchmarks", "bench_eager_dispatch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_eager_dispatch_bench_pins_cache_fields():
+    # the JSON row of record must carry the cache-vs-cold comparison; these
+    # names are what RESULTS.md / BENCH_r0*.json diffs key on
+    mod = _load_bench_eager_dispatch()
+    assert {"cached_ms", "cold_ms", "hit_rate", "speedup_x"} <= \
+        set(mod.RESULT_FIELDS)
+    import inspect
+    src = inspect.getsource(mod.main)
+    # main() must build the row from exactly the pinned schema
+    assert "RESULT_FIELDS" in src
+    for field in mod.RESULT_FIELDS:
+        assert f'"{field}"' in src, field
+
+
+def test_dispatch_fast_path_has_no_per_call_imports():
+    # the eager fast path (_apply_impl and the cached dispatch it fronts)
+    # must not pay a per-call ``import`` statement: module lookups belong at
+    # module scope (PR 2 hoisted the lazy import; keep it that way)
+    import ast
+    path = os.path.join(REPO, "paddle_tpu", "core", "tensor.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    fast_path_fns = {"apply", "_apply_impl", "_apply_cached",
+                     "_build_pure_fn", "_input_sig", "_make_out_tensors"}
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in fast_path_fns:
+            seen.add(node.name)
+            for sub in ast.walk(node):
+                assert not isinstance(sub, (ast.Import, ast.ImportFrom)), (
+                    f"per-call import inside {node.name} "
+                    f"(line {sub.lineno}): hoist it to module scope")
+    assert {"apply", "_apply_impl", "_apply_cached"} <= seen
