@@ -226,4 +226,5 @@ class BlockingQueue:
                 self._lib.pt_bq_free(self._h)
                 self._h = None
         except Exception:
-            pass
+            pass  # interpreter teardown: ctypes lib/handle may already be
+            #       unloaded; nothing to release into

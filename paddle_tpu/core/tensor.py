@@ -14,6 +14,7 @@ from __future__ import annotations
 import itertools
 import numbers
 import time as _time
+import weakref
 from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
@@ -374,7 +375,6 @@ class _StateRegistry:
     """
 
     def __init__(self):
-        import weakref
         self._items = weakref.WeakValueDictionary()
         self._next = 0
 

@@ -115,7 +115,7 @@ def _accelerator_type() -> str:
         if any(d.platform != "cpu" for d in jax.devices()):
             return "tpu"
     except RuntimeError:
-        pass
+        pass  # backend probe failed (no TPU runtime reachable): cpu below
     return "cpu"
 
 
@@ -284,7 +284,8 @@ class _DeviceStatsNS:
         try:
             jax.effects_barrier()
         except Exception:
-            pass
+            pass  # older jax without effects_barrier: the per-device
+            #       block_until_ready below still drains compute
         devs = ([default_jax_device()] if device is None
                 else [device.jax_device() if isinstance(device, Place)
                       else default_jax_device()])

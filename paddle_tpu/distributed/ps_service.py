@@ -264,7 +264,7 @@ def _srv_create(name: str, value_bytes: bytes, shape: Tuple[int, ...],
     return True
 
 
-def _seq_is_dup(client_key: Optional[str], seq: Optional[int]) -> bool:
+def _seq_is_dup_locked(client_key: Optional[str], seq: Optional[int]) -> bool:
     """True when (client, seq) was already applied (caller holds _LOCK)."""
     if client_key is None or seq is None:
         return False
@@ -282,7 +282,7 @@ def _srv_push(name: str, ids_bytes: bytes, grad_bytes: bytes,
     """Apply an SGD scatter-update: table[ids] -= lr * grad. Duplicate ids
     accumulate (segment-sum semantics, the reference accessor's rule)."""
     with _LOCK:
-        if _seq_is_dup(client_key, seq):
+        if _seq_is_dup_locked(client_key, seq):
             return True
         t = _TABLES[name]
         ids = np.frombuffer(ids_bytes, dtype=np.int64)
@@ -335,7 +335,7 @@ def _srv_push_sparse(name: str, ids_bytes: bytes, grad_bytes: bytes, n: int,
                      client_key: Optional[str] = None,
                      seq: Optional[int] = None) -> bool:
     with _LOCK:
-        if _seq_is_dup(client_key, seq):
+        if _seq_is_dup_locked(client_key, seq):
             return True
         t = _SPARSE[name]
         ids = np.frombuffer(ids_bytes, np.int64)

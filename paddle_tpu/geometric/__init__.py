@@ -24,7 +24,9 @@ __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_max", "segment_min", "reindex_graph",
            "sample_neighbors"]
 
-_REDUCES = {"sum", "mean", "max", "min"}
+# frozenset: _segment_reduce is jax-traced (reachable from apply()), so a
+# mutable module global read there would be baked in at trace time
+_REDUCES = frozenset({"sum", "mean", "max", "min"})
 
 
 def _segment_reduce(data, seg_ids, num, pool):

@@ -341,7 +341,8 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
             from .. import device as _device
             _device.force_platform("cpu")
         except Exception:
-            pass
+            pass  # device module import raced/failed in the fresh worker:
+            #       the JAX_PLATFORMS env pin above already keeps jax on cpu
         _worker_info = _WorkerInfo(worker_id, num_workers, dataset)
         if init_fn is not None:
             init_fn(worker_id)
@@ -377,7 +378,8 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, init_fn,
                 except Exception as e:  # ship the error, keep serving
                     result_queue.put((epoch, "error", (seq, repr(e))))
     except KeyboardInterrupt:
-        pass
+        pass  # parent is shutting down (Ctrl-C fans out to the process
+        #       group): exit the worker loop without a traceback
 
 
 class _WorkerPool:
@@ -520,7 +522,8 @@ class _WorkerPool:
             try:
                 iq.put(None)
             except Exception:
-                pass
+                pass  # queue torn down by a dead worker: join/terminate
+                #       below still reaps the process
         for p in self._procs:
             p.join(timeout=5)
             if p.is_alive():
@@ -531,7 +534,8 @@ class _WorkerPool:
         try:
             self.shutdown()
         except Exception:
-            pass
+            pass  # interpreter teardown: queues/processes may be half-dead
+            #       and shutdown is best-effort by contract
 
 
 class DataLoader:

@@ -88,7 +88,8 @@ def save(layer, path: str, input_spec: Optional[List[Any]] = None, **configs) ->
         try:
             output_names = [f"out{i}" for i in range(len(exp.out_avals))]
         except Exception:
-            pass
+            pass  # exported object lacks out_avals (older jax_export):
+            #       artifact ships without output names, loaders tolerate it
 
     from ..framework.artifact import write_artifact
     write_artifact(path + ".pdmodel", {

@@ -422,5 +422,6 @@ def _maybe_parallel_linear(row: bool = False):
                                                        RowParallelLinear)
             return RowParallelLinear if row else ColumnParallelLinear
     except Exception:
-        pass
+        pass  # no hybrid communicate group initialized (single-process
+        #       run): plain nn.Linear is the correct degenerate layer
     return nn.Linear

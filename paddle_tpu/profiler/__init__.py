@@ -19,6 +19,7 @@ CudaTracer — see SURVEY.md §5). TPU-native design:
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -318,12 +319,21 @@ class Profiler:
                     jax.profiler.start_trace(self.trace_dir)
                     self._device_tracing = True
                 except Exception:
-                    pass
+                    # a profile without device events is still useful, but
+                    # say so: silently missing XLA traces cost a debug day
+                    _obs.inc("profiler.device_trace_failures_total")
+                    logging.getLogger(__name__).warning(
+                        "jax.profiler.start_trace(%s) failed; profile will "
+                        "carry host events only", self.trace_dir,
+                        exc_info=True)
             elif not on and self._device_tracing:
                 try:
                     jax.profiler.stop_trace()
                 except Exception:
-                    pass
+                    _obs.inc("profiler.device_trace_failures_total")
+                    logging.getLogger(__name__).warning(
+                        "jax.profiler.stop_trace() failed; device trace in "
+                        "%s may be truncated", self.trace_dir, exc_info=True)
                 self._device_tracing = False
 
     def _harvest(self) -> None:

@@ -4,6 +4,8 @@ python/paddle/utils/profiler.py), forwarding to the modern
 
 from __future__ import annotations
 
+import logging
+
 from ..profiler import Profiler, ProfilerTarget, RecordEvent  # noqa: F401
 
 _active: Profiler | None = None
@@ -24,7 +26,11 @@ def stop_profiler(sorted_key: str = "total",
         try:
             _active.export_chrome_tracing(profile_path)
         except Exception:
-            pass
+            # the session still stopped cleanly; losing the export file is
+            # worth a line, not a crash of the training run being profiled
+            logging.getLogger(__name__).warning(
+                "chrome trace export to %s failed", profile_path,
+                exc_info=True)
         _active = None
 
 

@@ -125,21 +125,23 @@ def test_eager_dispatch_bench_pins_cache_fields():
 
 
 def test_dispatch_fast_path_has_no_per_call_imports():
-    # the eager fast path (_apply_impl and the cached dispatch it fronts)
-    # must not pay a per-call ``import`` statement: module lookups belong at
-    # module scope (PR 2 hoisted the lazy import; keep it that way)
+    # bridge: the per-call-import ban is graft-lint's ``hot-path-import``
+    # rule now (tools/lint/rules/hot_path_import.py), configured over the
+    # whole core/{tensor,dispatch_cache,autograd}.py set instead of three
+    # hardcoded functions. core/tensor.py must stay at ZERO findings with
+    # no baseline allowance — the dispatch fast path pays that import per
+    # op, not per backward walk.
     import ast
-    path = os.path.join(REPO, "paddle_tpu", "core", "tensor.py")
-    with open(path) as f:
+    import sys
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint import run_lint
+    result = run_lint(paths=["paddle_tpu/core/tensor.py",
+                             "paddle_tpu/core/dispatch_cache.py"],
+                      rules=["hot-path-import"])
+    assert [f.text() for f in result.new] == []
+    # structural pin: the fast-path functions this protects still exist
+    with open(os.path.join(REPO, "paddle_tpu", "core", "tensor.py")) as f:
         tree = ast.parse(f.read())
-    fast_path_fns = {"apply", "_apply_impl", "_apply_cached",
-                     "_build_pure_fn", "_input_sig", "_make_out_tensors"}
-    seen = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name in fast_path_fns:
-            seen.add(node.name)
-            for sub in ast.walk(node):
-                assert not isinstance(sub, (ast.Import, ast.ImportFrom)), (
-                    f"per-call import inside {node.name} "
-                    f"(line {sub.lineno}): hoist it to module scope")
-    assert {"apply", "_apply_impl", "_apply_cached"} <= seen
+    names = {n.name for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)}
+    assert {"apply", "_apply_impl", "_apply_cached"} <= names

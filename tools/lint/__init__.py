@@ -1,0 +1,28 @@
+"""graft-lint — framework-aware static analysis for the paddle_tpu tree.
+
+A self-contained AST lint engine (stdlib only, ``python -m tools.lint``)
+that mechanically enforces the invariants this codebase keeps re-learning
+by hand: trace-time purity for everything ``jax.jit``/the dispatch-cache
+compile path can reach, no silently swallowed exceptions, no per-call
+imports on the dispatch hot path, lock discipline around module-level
+mutable state, and no hidden host syncs inside loops.
+
+Layout:
+
+* ``engine``   — file walking, rule registry, ``# graft-lint:`` pragmas,
+  baseline bookkeeping, text/JSON reporting.
+* ``rules``    — one module per rule; importing ``tools.lint.rules``
+  registers them all.
+* ``cli``      — argument parsing + exit-code policy (0 clean, 1
+  non-baselined findings, 2 usage error).
+* ``baseline.json`` — checked-in grandfather list; every entry carries a
+  human-written ``reason``. Regenerate with ``--update-baseline`` (new
+  entries get a TODO reason so grandfathering stays a reviewed diff).
+"""
+
+from .engine import (  # noqa: F401
+    Finding, FileContext, Rule, RULES, register_rule,
+    DEFAULT_CONFIG, default_baseline_path, load_baseline, match_baseline,
+    update_baseline, run_lint, LintResult, REPO_ROOT,
+)
+from . import rules  # noqa: F401  (imports register the built-in rules)

@@ -1,0 +1,99 @@
+"""Small AST helpers shared by graft-lint rules (stdlib only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+#: module-level assignments of these constructors (or dict/list/set
+#: literals) are treated as mutable module state
+MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                 "deque", "Counter"}
+
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains, else ``""``."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """Base ``Name.id`` under any Attribute/Subscript chain, else None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def module_mutable_globals(tree: ast.Module) -> Set[str]:
+    """Names assigned a mutable container at module scope (``__all__``
+    excluded: written once at import, read-only after)."""
+    out: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.Name] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        if not targets or value is None:
+            continue
+        is_mut = isinstance(value, (ast.Dict, ast.List, ast.Set))
+        if isinstance(value, ast.Call):
+            fname = value.func.attr if isinstance(value.func, ast.Attribute) \
+                else getattr(value.func, "id", "")
+            is_mut = fname in MUTABLE_CTORS
+        if is_mut:
+            out.update(t.id for t in targets)
+    out.discard("__all__")
+    return out
+
+
+def module_lock_names(tree: ast.Module) -> Set[str]:
+    """Names assigned ``threading.Lock()``/``RLock()`` at module scope."""
+    out: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.Name] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        if not targets or not isinstance(value, ast.Call):
+            continue
+        fname = value.func.attr if isinstance(value.func, ast.Attribute) \
+            else getattr(value.func, "id", "")
+        if fname in ("Lock", "RLock"):
+            out.update(t.id for t in targets)
+    return out
+
+
+def function_table(tree: ast.Module) -> Dict[str, List[ast.AST]]:
+    """Simple-name -> FunctionDefs (top-level, methods, and nested)."""
+    fns: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, []).append(node)
+    return fns
+
+
+def snippet(node: ast.AST, limit: int = 64) -> str:
+    s = ast.unparse(node)
+    return s if len(s) <= limit else s[:limit - 1] + "…"
+
+
+def path_matches(path: str, patterns) -> bool:
+    """True when repo-relative ``path`` equals a pattern or ends with
+    ``/<pattern>`` (so fixture trees rooted elsewhere still match)."""
+    return any(path == p or path.endswith("/" + p) for p in patterns)

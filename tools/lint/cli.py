@@ -1,0 +1,126 @@
+"""graft-lint command line.
+
+Usage::
+
+    python -m tools.lint                       # lint paddle_tpu/ (default)
+    python -m tools.lint paddle_tpu/core       # lint a subtree / files
+    python -m tools.lint --format=json         # machine-readable report
+    python -m tools.lint --rules=silent-swallow,host-sync
+    python -m tools.lint --list-rules
+    python -m tools.lint --no-baseline         # show baselined findings too
+    python -m tools.lint --update-baseline     # regenerate the grandfather
+                                               # list (reviewed diff!)
+
+Exit codes: 0 — clean (every finding baselined); 1 — non-baselined
+findings; 2 — usage error (unknown rule, path matching no python files).
+Stale baseline entries are reported but do not fail a CLI run; the tier-1
+gate (``tests/test_lint.py``) rejects them so the baseline cannot rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from .engine import (RULES, default_baseline_path, iter_python_files,
+                     load_baseline, run_lint, save_baseline, update_baseline)
+from . import rules as _rules  # noqa: F401  (registers built-ins)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graft-lint: framework-aware static analysis")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: paddle_tpu/)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule names (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: {default_baseline_path()})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "(preserves existing reasons; new entries get a "
+                        "TODO reason to force review)")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:18s} {RULES[name].description}")
+        return 0
+
+    rule_names = None
+    if args.rules:
+        rule_names = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_names if r not in RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+
+    for p in args.paths:
+        if not iter_python_files([p]):
+            # a renamed/typo'd path must not silently go green — that is
+            # the silent-failure class this tool exists to prevent
+            print(f"no python files found under {p!r}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or default_baseline_path()
+    entries = [] if (args.no_baseline or args.update_baseline) \
+        else load_baseline(baseline_path)
+    result = run_lint(paths=args.paths or None, rules=rule_names,
+                      baseline_entries=entries)
+
+    if args.update_baseline:
+        # regenerate only what this run could SEE: entries for unscanned
+        # files / inactive rules pass through untouched, so a scoped
+        # `tools.lint paddle_tpu/core --update-baseline` can never delete
+        # the rest of the tree's reviewed justifications
+        old = load_baseline(baseline_path)
+        scanned = set(result.scanned)
+        active = set(rule_names or RULES)
+        in_scope = [e for e in old
+                    if e["path"] in scanned and e["rule"] in active]
+        out_scope = [e for e in old
+                     if not (e["path"] in scanned and e["rule"] in active)]
+        new_entries = sorted(
+            update_baseline(result.new, in_scope) + out_scope,
+            key=lambda e: (e["path"], e["rule"], e["message"]))
+        save_baseline(baseline_path, new_entries)
+        print(f"wrote {len(new_entries)} entr"
+              f"{'y' if len(new_entries) == 1 else 'ies'} to "
+              f"{baseline_path}")
+        todo = sum(1 for e in new_entries
+                   if str(e.get("reason", "")).startswith("TODO"))
+        if todo:
+            print(f"{todo} new entr{'y' if todo == 1 else 'ies'} carry a "
+                  f"TODO reason — edit the justification before committing")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(result.as_dict(), indent=2, sort_keys=True))
+    else:
+        for f in result.new:
+            print(f.text())
+        for e in result.stale:
+            print(f"stale baseline entry (code improved — run "
+                  f"--update-baseline): {e['path']}: {e['rule']} "
+                  f"x{e['unused']}")
+        for err in result.errors:
+            print(f"error: {err}", file=sys.stderr)
+        summary = (f"{result.files_checked} files, "
+                   f"{len(result.new)} finding(s), "
+                   f"{len(result.baselined)} baselined, "
+                   f"{len(result.stale)} stale baseline entr"
+                   f"{'y' if len(result.stale) == 1 else 'ies'}")
+        print(("FAILED: " if not result.clean else "ok: ") + summary)
+    return 0 if result.clean else 1

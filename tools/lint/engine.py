@@ -1,0 +1,324 @@
+"""graft-lint engine: contexts, registry, pragmas, baseline, reporting.
+
+Design notes (mirrors how large-framework CIs structure this):
+
+* One ``FileContext`` per file, parsed once, shared by every rule — rules
+  are pure functions of the context and must not mutate it.
+* Findings are keyed for baseline purposes by ``(path, rule, message)``
+  WITHOUT the line number, so an unrelated edit that shifts lines does not
+  invalidate a grandfathered entry; identical findings in one file
+  collapse into a single baseline entry with a ``count``.
+* Suppression is explicit and greppable: ``# graft-lint: disable=<rule>``
+  on the finding's line (or on a comment-only line directly above it), or
+  ``# graft-lint: disable-file=<rule>`` anywhere in the file. ``all``
+  matches every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# ---------------------------------------------------------------------------
+# findings + file context
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str          # repo-relative, posix separators
+    line: int
+    rule: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Line-free fingerprint used for baseline matching."""
+        return (self.path, self.rule, self.message)
+
+    def text(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"path": self.path, "line": self.line, "rule": self.rule,
+                "message": self.message}
+
+
+class FileContext:
+    """One parsed source file handed to every rule."""
+
+    def __init__(self, path: str, source: str, config: Dict[str, Any]):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.config = config
+        self.tree = ast.parse(source)
+
+    def finding(self, node_or_line, rule: str, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(self.path, int(line), rule, message)
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """Base class: subclass, set ``name``/``description``, implement
+    ``check(ctx) -> iterable of Finding``."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    """Class decorator: instantiate + register a rule by its ``name``."""
+    inst = cls()
+    if not inst.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    RULES[inst.name] = inst
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# default configuration
+# ---------------------------------------------------------------------------
+
+DEFAULT_CONFIG: Dict[str, Any] = {
+    # directories (repo-relative) scanned when the CLI gets no paths
+    "default_paths": ["paddle_tpu"],
+    # hot-path-import: modules whose function bodies must not import
+    "hot_path_modules": [
+        "paddle_tpu/core/tensor.py",
+        "paddle_tpu/core/dispatch_cache.py",
+        "paddle_tpu/core/autograd.py",
+    ],
+    # trace-impurity: extra per-file trace roots beyond the auto-detected
+    # ``jax.jit(fn)`` / ``@jax.jit`` / ``apply(name, fn, ...)`` seams
+    "trace_roots": {
+        "paddle_tpu/core/tensor.py": ["_build_pure_fn"],
+    },
+    # unguarded-global: functions whose NAME ends with one of these
+    # suffixes are assumed to run with the module lock already held by
+    # their caller (the ``_locked`` convention used across core/)
+    "lock_held_suffixes": ["_locked"],
+}
+
+
+# ---------------------------------------------------------------------------
+# suppression pragmas
+# ---------------------------------------------------------------------------
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graft-lint:\s*(disable|disable-file)\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+
+def _pragma_tables(lines: Sequence[str]) -> Tuple[Dict[int, set], set]:
+    """(line -> suppressed rule names, file-level suppressed names)."""
+    per_line: Dict[int, set] = {}
+    file_level: set = set()
+    pending: set = set()  # from comment-only lines, applies to next code line
+    for i, raw in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(raw)
+        stripped = raw.strip()
+        is_comment_only = stripped.startswith("#")
+        if m:
+            names = {n.strip() for n in m.group(2).split(",") if n.strip()}
+            if m.group(1) == "disable-file":
+                file_level |= names
+            elif is_comment_only:
+                pending |= names
+            else:
+                per_line.setdefault(i, set()).update(names)
+        elif stripped and not is_comment_only:
+            if pending:
+                per_line.setdefault(i, set()).update(pending)
+                pending = set()
+    return per_line, file_level
+
+
+def _suppressed(f: Finding, per_line: Dict[int, set], file_level: set) -> bool:
+    names = per_line.get(f.line, set()) | file_level
+    return f.rule in names or "all" in names
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def default_baseline_path() -> str:
+    return os.path.join(REPO_ROOT, "tools", "lint", "baseline.json")
+
+
+def load_baseline(path: Optional[str]) -> List[Dict[str, Any]]:
+    if path is None or not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    return list(data.get("entries", []))
+
+
+def match_baseline(findings: Sequence[Finding],
+                   entries: Sequence[Dict[str, Any]]
+                   ) -> Tuple[List[Finding], List[Finding], List[Dict[str, Any]]]:
+    """Split ``findings`` into (new, baselined) and report stale entries.
+
+    An entry ``{path, rule, message, count}`` absorbs up to ``count``
+    findings with the same (path, rule, message); an entry that absorbs
+    fewer than ``count`` is stale (the code improved — prune it with
+    ``--update-baseline``).
+    """
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in entries:
+        k = (e["path"], e["rule"], e["message"])
+        budget[k] = budget.get(k, 0) + int(e.get("count", 1))
+    remaining = dict(budget)
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if remaining.get(k, 0) > 0:
+            remaining[k] -= 1
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = []
+    for e in entries:
+        k = (e["path"], e["rule"], e["message"])
+        if remaining.get(k, 0) > 0:
+            stale.append(dict(e, unused=remaining[k]))
+            remaining[k] = 0  # report duplicates of the same key once
+    return new, baselined, stale
+
+
+def update_baseline(findings: Sequence[Finding],
+                    old_entries: Sequence[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """Regenerate baseline entries from the CURRENT findings, preserving
+    the human-written ``reason`` of any surviving entry. New entries get a
+    TODO reason on purpose: grandfathering must be a reviewed diff, not a
+    silent flag-flip."""
+    reasons = {(e["path"], e["rule"], e["message"]): e.get("reason", "")
+               for e in old_entries}
+    grouped: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        grouped[f.key()] = grouped.get(f.key(), 0) + 1
+    entries = []
+    for (path, rule, message), count in sorted(grouped.items()):
+        entries.append({
+            "path": path, "rule": rule, "message": message, "count": count,
+            "reason": reasons.get((path, rule, message))
+            or "TODO: justify this grandfathered finding",
+        })
+    return entries
+
+
+def save_baseline(path: str, entries: Sequence[Dict[str, Any]]) -> None:
+    with open(path, "w") as f:
+        json.dump({"version": BASELINE_VERSION, "entries": list(entries)},
+                  f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# running
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LintResult:
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale: List[Dict[str, Any]] = field(default_factory=list)
+    files_checked: int = 0
+    scanned: List[str] = field(default_factory=list)  # repo-relative paths
+    errors: List[str] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.errors
+
+    def as_dict(self) -> Dict[str, Any]:
+        counts: Dict[str, int] = {}
+        for f in self.new:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "files_checked": self.files_checked,
+            "findings": [f.as_dict() for f in self.new],
+            "baselined": len(self.baselined),
+            "stale_baseline_entries": self.stale,
+            "counts_by_rule": counts,
+            "errors": self.errors,
+            "clean": self.clean,
+        }
+
+
+def iter_python_files(paths: Sequence[str], root: str = REPO_ROOT
+                      ) -> List[str]:
+    """Expand files/directories into a sorted list of absolute .py paths."""
+    out = []
+    for p in paths:
+        absp = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isdir(absp):
+            for dirpath, dirnames, filenames in os.walk(absp):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif absp.endswith(".py"):
+            out.append(absp)
+    return sorted(set(out))
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             rules: Optional[Sequence[str]] = None,
+             config: Optional[Dict[str, Any]] = None,
+             baseline_entries: Optional[Sequence[Dict[str, Any]]] = None,
+             root: str = REPO_ROOT) -> LintResult:
+    """Run the engine. ``paths`` may be absolute or ``root``-relative;
+    findings always report ``root``-relative paths."""
+    cfg = dict(DEFAULT_CONFIG)
+    if config:
+        cfg.update(config)
+    if paths is None:
+        paths = cfg["default_paths"]
+    active = [RULES[n] for n in (rules or sorted(RULES))]
+    result = LintResult()
+    findings: List[Finding] = []
+    for abspath in iter_python_files(paths, root=root):
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        result.scanned.append(rel)
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                src = f.read()
+            ctx = FileContext(rel, src, cfg)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            result.errors.append(f"{rel}: {e.__class__.__name__}: {e}")
+            continue
+        result.files_checked += 1
+        per_line, file_level = _pragma_tables(ctx.lines)
+        for rule in active:
+            for f in rule.check(ctx) or ():
+                if not _suppressed(f, per_line, file_level):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    new, baselined, stale = match_baseline(findings, baseline_entries or [])
+    result.new, result.baselined, result.stale = new, baselined, stale
+    return result

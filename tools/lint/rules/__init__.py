@@ -1,0 +1,9 @@
+"""Built-in graft-lint rules; importing this package registers them."""
+
+from . import (  # noqa: F401
+    hot_path_import,
+    host_sync,
+    silent_swallow,
+    trace_impurity,
+    unguarded_global,
+)

@@ -1,0 +1,99 @@
+"""host-sync: device→host transfers inside loops.
+
+Each ``.item()``, ``.numpy()``, ``float(tensor)``/``bool(tensor)``/
+``int(tensor)`` or ``np.asarray(device_value)`` blocks the Python thread
+until the device catches up — inside a loop that serializes every
+iteration against the accelerator pipeline (the classic "GPU-bound
+training loop that is actually host-bound" bug). This rule flags, inside
+``for``/``while`` bodies in library code:
+
+* ``<expr>.item()`` and ``<expr>.numpy()`` calls;
+* ``bool/float/int(X)`` and ``np.asarray/np.array(X)`` where ``X``
+  mentions a device value — a ``._data`` read (Tensor's backing
+  ``jax.Array``) that is not just shape/dtype metadata, or a ``jnp.*``
+  call;
+* ``bool(X.all())`` / ``bool(X.any())`` — the reduce-then-branch idiom.
+
+Intentional syncs (early-exit decode loops, debug-flag nan checks) get a
+pragma or a baseline entry with the reason stating why the sync is the
+semantics, not an accident.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..astutil import dotted_name, snippet
+from ..engine import FileContext, Rule, register_rule
+
+_META_ATTRS = ("shape", "dtype", "ndim", "size")
+_NP_CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+
+
+def _mentions_device_value(expr: ast.AST) -> bool:
+    """``._data`` reads (minus pure-metadata ``._data.shape``-style chains)
+    or ``jnp.`` / ``jax.numpy.`` calls anywhere in the subtree."""
+    meta_only = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _META_ATTRS \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "_data":
+            meta_only.add(id(node.value))
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == "_data" \
+                and id(node) not in meta_only:
+            return True
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn.startswith(("jnp.", "jax.numpy.")):
+                return True
+    return False
+
+
+@register_rule
+class HostSyncRule(Rule):
+    name = "host-sync"
+    description = ("no .item()/.numpy()/float(Tensor)/np.asarray(device "
+                   "value) inside loops")
+
+    def check(self, ctx: FileContext):
+        findings: List = []
+        seen_lines = set()  # one finding per line: bool(np.asarray(x._data)
+        #                     .all()) matches two patterns but is one sync
+
+        def flag(node, what):
+            if node.lineno in seen_lines:
+                return
+            seen_lines.add(node.lineno)
+            findings.append(ctx.finding(
+                node, self.name,
+                f"host sync inside a loop: {what} blocks on the device "
+                f"every iteration (hoist/batch it, or baseline with the "
+                f"reason the sync IS the semantics)"))
+
+        def visit(node, in_loop):
+            if isinstance(node, (ast.For, ast.While)):
+                in_loop = True
+            elif in_loop and isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and not node.args and \
+                        f.attr in ("item", "numpy"):
+                    flag(node, f"`{snippet(node)}`")
+                elif isinstance(f, ast.Name) and f.id in ("bool", "float",
+                                                          "int") and \
+                        len(node.args) == 1:
+                    arg = node.args[0]
+                    if _mentions_device_value(arg) or (
+                            f.id == "bool" and isinstance(arg, ast.Call)
+                            and isinstance(arg.func, ast.Attribute)
+                            and arg.func.attr in ("all", "any")):
+                        flag(node, f"`{snippet(node)}`")
+                elif dotted_name(f) in _NP_CONVERTERS and node.args and \
+                        _mentions_device_value(node.args[0]):
+                    flag(node, f"`{snippet(node)}`")
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_loop)
+
+        visit(ctx.tree, False)
+        return findings

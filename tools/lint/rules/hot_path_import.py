@@ -1,0 +1,48 @@
+"""hot-path-import: no ``import`` statements inside function bodies of the
+configured hot-path modules.
+
+The eager dispatch fast path (``apply`` → ``_apply_impl``/``_apply_cached``
+→ tape record) runs once per op; a function-body ``import`` there pays a
+sys.modules lookup plus name binding on every call — PR 2 hoisted one by
+hand and pinned three functions, this rule covers the whole module set
+(``hot_path_modules`` in the engine config). Deferred imports that exist
+to break genuine circular-import cycles belong in the baseline with a
+reason, not silently in the code.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import path_matches
+from ..engine import FileContext, Rule, register_rule
+
+
+@register_rule
+class HotPathImportRule(Rule):
+    name = "hot-path-import"
+    description = ("function-body imports are banned in hot-path modules "
+                   "(hoist to module scope)")
+
+    def check(self, ctx: FileContext):
+        if not path_matches(ctx.path, ctx.config.get("hot_path_modules", [])):
+            return
+        rule = self.name
+        findings = []
+
+        def visit(node, fn_name):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_name = node.name  # attribute imports to the INNERMOST fn
+            elif fn_name and isinstance(node, (ast.Import, ast.ImportFrom)):
+                mod = node.module if isinstance(node, ast.ImportFrom) \
+                    else ",".join(a.name for a in node.names)
+                findings.append(ctx.finding(
+                    node, rule,
+                    f"per-call import of '{mod or '.'}' inside hot-path "
+                    f"function '{fn_name}' (hoist to module scope, or "
+                    f"baseline with the circular-import reason)"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn_name)
+
+        visit(ctx.tree, None)
+        return findings

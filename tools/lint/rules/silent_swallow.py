@@ -1,0 +1,46 @@
+"""silent-swallow: an ``except`` whose body is a bare ``pass`` must carry
+a signal.
+
+Generalizes the PR 1 rule (then scoped to ``paddle_tpu/distributed/``) to
+every scanned file: failure paths that map errors to healthy states with
+no comment, log line, or counter are exactly how dropped gradients and
+"fresh node" elastic restarts shipped. A swallow is fine when it says why
+— an inline comment on the ``except``/``pass`` lines (or a comment-only
+line directly below), or an actual logged/counted statement in the body
+(which makes it not-a-bare-pass).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileContext, Rule, register_rule
+
+MESSAGE = ("silent `except ...: pass` maps a failure to a healthy state "
+           "with no signal (add a justifying comment, a log line, or an "
+           "observability counter)")
+
+
+@register_rule
+class SilentSwallowRule(Rule):
+    name = "silent-swallow"
+    description = ("bare `except: pass` handlers must carry a justifying "
+                   "comment or an observable signal")
+
+    def check(self, ctx: FileContext):
+        lines = ctx.lines
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
+                continue
+            # window: except line .. pass line, plus trailing comment-only
+            # lines (a justification written just below the pass counts)
+            lo, hi = node.lineno - 1, node.body[0].lineno
+            window = list(lines[lo:hi])
+            j = hi
+            while j < len(lines) and lines[j].lstrip().startswith("#"):
+                window.append(lines[j])
+                j += 1
+            if not any("#" in ln for ln in window):
+                yield ctx.finding(node, self.name, MESSAGE)

@@ -1,0 +1,156 @@
+"""trace-impurity: code reachable from a jax trace must be pure.
+
+Functions that jax traces — anything passed to ``jax.jit``, decorated with
+it, handed to the eager dispatcher as the op body (second argument of
+``apply(name, fn, ...)``), or named in the engine config's ``trace_roots``
+— execute ONCE at trace time; whatever they read is baked into the
+compiled executable and silently served stale forever after (the exact
+class PR 2's flags-epoch fix patched by hand). Inside the trace-reachable
+set this rule flags:
+
+* wall-clock / process-state reads: ``time.*``, ``datetime.*``, ``uuid.*``
+* unkeyed host randomness: stdlib ``random.*`` and ``np.random.*``
+  (``jax.random`` is keyed and trace-safe — not flagged)
+* environment reads: ``os.environ`` / ``os.getenv``
+* loads of module-level MUTABLE globals (dicts/lists/sets): a mutation
+  after compile would not invalidate the baked value. Immutable module
+  constants are fine; runtime-settable knobs must go through the
+  epoch-keyed flags accessor (``flags.flag()`` — every ``set_flags`` bumps
+  ``flags.epoch()``, which the dispatch cache folds into its keys).
+
+Reachability is intra-module by simple name: from each trace root, every
+same-module function it calls is scanned too (an over-approximation — a
+name shared by a traced and an untraced helper is treated as traced).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..astutil import (dotted_name, function_table, module_mutable_globals,
+                       path_matches)
+from ..engine import FileContext, Rule, register_rule
+
+IMPURE_MODULES = {"time", "random", "datetime", "uuid"}
+IMPURE_PREFIXES = ("np.random.", "numpy.random.")
+
+
+def _trace_roots(ctx: FileContext):
+    """(root function names, inline traced lambdas) for one module."""
+    names: Set[str] = set()
+    lambdas: List[ast.Lambda] = []
+
+    def grab(arg):
+        if isinstance(arg, ast.Name):
+            names.add(arg.id)
+        elif isinstance(arg, ast.Lambda):
+            lambdas.append(arg)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            # jax.jit(fn, ...) / jit(fn, ...)
+            if (isinstance(fn, ast.Attribute) and fn.attr == "jit") or \
+                    (isinstance(fn, ast.Name) and fn.id == "jit"):
+                if node.args:
+                    grab(node.args[0])
+            # apply("op", fn, ...): the eager dispatcher traces arg 2
+            elif isinstance(fn, ast.Name) and fn.id == "apply" \
+                    and len(node.args) >= 2:
+                grab(node.args[1])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if "jax.jit" in ast.unparse(dec):
+                    names.add(node.name)
+    for cfg_path, extra in ctx.config.get("trace_roots", {}).items():
+        if path_matches(ctx.path, [cfg_path]):
+            names.update(extra)
+    return names, lambdas
+
+
+@register_rule
+class TraceImpurityRule(Rule):
+    name = "trace-impurity"
+    description = ("no clock/randomness/env/mutable-global reads in "
+                   "functions jax can trace")
+
+    def check(self, ctx: FileContext):
+        roots, lambdas = _trace_roots(ctx)
+        if not roots and not lambdas:
+            return
+        fns = function_table(ctx.tree)
+        mutables = module_mutable_globals(ctx.tree)
+
+        reachable: Set[str] = set()
+        work = [r for r in roots if r in fns]
+        for lam in lambdas:  # helpers called from inline traced lambdas
+            for sub in ast.walk(lam):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name) and sub.func.id in fns:
+                    work.append(sub.func.id)
+        while work:
+            name = work.pop()
+            if name in reachable:
+                continue
+            reachable.add(name)
+            for fn in fns[name]:
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call) and \
+                            isinstance(sub.func, ast.Name) and \
+                            sub.func.id in fns:
+                        work.append(sub.func.id)
+
+        bodies = [(fn, name) for name in sorted(reachable)
+                  for fn in fns[name]]
+        bodies += [(lam, "<lambda>") for lam in lambdas]
+        for body, name in bodies:
+            yield from self._scan_body(ctx, body, name, mutables)
+
+    def _scan_body(self, ctx: FileContext, body, name: str,
+                   mutables: Set[str]):
+        # locals shadow module globals: a parameter or local assignment
+        # named like a mutable global is NOT a global read
+        local_names: Set[str] = set()
+        args = getattr(body, "args", None)
+        if args is not None:
+            for a in (args.posonlyargs + args.args + args.kwonlyargs
+                      + ([args.vararg] if args.vararg else [])
+                      + ([args.kwarg] if args.kwarg else [])):
+                local_names.add(a.arg)
+        for sub in ast.walk(body):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                local_names.add(sub.id)
+
+        for sub in ast.walk(body):
+            if isinstance(sub, ast.Call):
+                dn = dotted_name(sub.func)
+                base = dn.split(".")[0]
+                if "." in dn and base in IMPURE_MODULES:
+                    yield ctx.finding(
+                        sub, self.name,
+                        f"'{dn}(...)' in trace-reachable '{name}': the "
+                        f"result is baked in at trace time (pass it in as "
+                        f"an argument, or use jax.random for randomness)")
+                elif dn.startswith(IMPURE_PREFIXES) or dn == "os.getenv":
+                    yield ctx.finding(
+                        sub, self.name,
+                        f"'{dn}(...)' in trace-reachable '{name}': the "
+                        f"result is baked in at trace time (pass it in as "
+                        f"an argument, or use jax.random for randomness)")
+            elif isinstance(sub, ast.Attribute) and \
+                    dotted_name(sub) == "os.environ":
+                yield ctx.finding(
+                    sub, self.name,
+                    f"'os.environ' read in trace-reachable '{name}': the "
+                    f"value is baked in at trace time (read it before the "
+                    f"traced call and pass it in)")
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in mutables and sub.id not in local_names:
+                yield ctx.finding(
+                    sub, self.name,
+                    f"module-level mutable global '{sub.id}' read in "
+                    f"trace-reachable '{name}': later mutations are "
+                    f"silently ignored by compiled executables (make it "
+                    f"immutable, pass it as an argument, or route the knob "
+                    f"through the epoch-keyed flags accessor)")

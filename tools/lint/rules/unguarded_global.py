@@ -1,0 +1,117 @@
+"""unguarded-global: lock discipline for module-level mutable state.
+
+Applies to modules that BOTH define a module-level ``threading.Lock``/
+``RLock`` and hold module-level mutable containers (the metrics registry,
+the dispatch-cache LRU, the PS table maps): such a module has already
+declared its state is shared across threads, so every mutation of those
+containers from function code must happen lexically inside a
+``with <lock>:`` block. Escape hatches, in order of preference:
+
+* name the helper ``*_locked`` (configurable suffixes) — the convention
+  used across core/ for "caller holds the lock";
+* a ``# graft-lint: disable=unguarded-global`` pragma for a mutation that
+  is deliberately racy (document why on the same line);
+* a baseline entry with a reason.
+
+Module-scope statements are exempt (imports execute single-threaded), and
+aliases are followed one level (``b = _STATS["x"]; b[k] = v`` is still a
+mutation of ``_STATS``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from ..astutil import module_lock_names, module_mutable_globals, root_name
+from ..engine import FileContext, Rule, register_rule
+
+MUTATORS = {"append", "extend", "insert", "pop", "popitem", "clear",
+            "update", "setdefault", "remove", "discard", "add",
+            "move_to_end", "appendleft", "extendleft"}
+
+
+def _is_lock_expr(node: ast.AST, locks: Set[str]) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in locks
+    if isinstance(node, ast.Attribute):
+        return node.attr in locks
+    return False
+
+
+@register_rule
+class UnguardedGlobalRule(Rule):
+    name = "unguarded-global"
+    description = ("module-level mutable containers in threading modules "
+                   "must only be mutated under the module lock")
+
+    def check(self, ctx: FileContext):
+        locks = module_lock_names(ctx.tree)
+        mutables = module_mutable_globals(ctx.tree)
+        if not locks or not mutables:
+            return
+        suffixes = tuple(ctx.config.get("lock_held_suffixes", ["_locked"]))
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name.endswith(suffixes):
+                continue
+            yield from self._scan_fn(ctx, node, locks, mutables)
+
+    def _scan_fn(self, ctx, fn, locks, mutables):
+        # one-level alias tracking: locals bound from a tracked global
+        # (or a sub-container of one) still reference the shared object;
+        # map every alias back to the module global it came from
+        tracked = {g: g for g in mutables}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name) and \
+                    isinstance(sub.value, (ast.Name, ast.Subscript,
+                                           ast.Attribute)):
+                src = root_name(sub.value)
+                if src in tracked:
+                    tracked[sub.targets[0].id] = tracked[src]
+
+        findings = []
+
+        def visit(node, locked):
+            if isinstance(node, ast.With):
+                if any(_is_lock_expr(item.context_expr, locks)
+                       for item in node.items):
+                    locked = True
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                return  # nested defs are scanned as their own functions
+            elif not locked:
+                hit = None
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        if isinstance(t, (ast.Subscript, ast.Attribute)) and \
+                                root_name(t) in tracked:
+                            hit = root_name(t)
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, (ast.Subscript, ast.Attribute)) and \
+                                root_name(t) in tracked:
+                            hit = root_name(t)
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in MUTATORS and \
+                        root_name(node.func.value) in tracked:
+                    hit = root_name(node.func.value)
+                if hit is not None:
+                    # report against the module global, not the alias
+                    findings.append(ctx.finding(
+                        node, self.name,
+                        f"mutation of module-level mutable state "
+                        f"('{tracked[hit]}') in '{fn.name}' outside `with "
+                        f"<module lock>:` (guard it, or rename the helper "
+                        f"*_locked if the caller holds the lock)"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, locked)
+
+        for child in ast.iter_child_nodes(fn):
+            visit(child, False)
+        return findings
