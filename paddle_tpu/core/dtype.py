@@ -8,6 +8,8 @@ interoperate with jax with zero conversion.
 
 from __future__ import annotations
 
+from types import MappingProxyType
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -30,7 +32,10 @@ complex128 = jnp.dtype(jnp.complex128)
 float8_e4m3fn = jnp.dtype(jnp.float8_e4m3fn)
 float8_e5m2 = jnp.dtype(jnp.float8_e5m2)
 
-_ALIASES = {
+# Read-only by construction: convert_dtype is called inside traced op
+# bodies, so a writable alias table would be baked into compiled
+# executables and silently served stale after any mutation.
+_ALIASES = MappingProxyType({
     "bfloat16": bfloat16, "bf16": bfloat16,
     "float16": float16, "fp16": float16, "half": float16,
     "float32": float32, "fp32": float32, "float": float32,
@@ -39,7 +44,7 @@ _ALIASES = {
     "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
     "bool": bool_, "complex64": complex64, "complex128": complex128,
     "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
-}
+})
 
 _FLOATS = (bfloat16, float16, float32, float64, float8_e4m3fn, float8_e5m2)
 _INTS = (int8, int16, int32, int64, uint8, uint16, uint32, uint64)

@@ -10,18 +10,26 @@ mutable state, and no hidden host syncs inside loops.
 Layout:
 
 * ``engine``   — file walking, rule registry, ``# graft-lint:`` pragmas,
-  baseline bookkeeping, text/JSON reporting.
+  baseline bookkeeping, ``--changed-only`` git narrowing, text/JSON
+  reporting (with ``run_seconds`` + cache-hit accounting).
 * ``rules``    — one module per rule; importing ``tools.lint.rules``
-  registers them all.
+  registers them all. Per-file rules implement ``check(ctx)``;
+  whole-program rules subclass ``ProjectRule`` and implement
+  ``check_project(project)``.
+* ``wholeprogram`` — graft-lint 2.0 substrate: per-module summaries,
+  the content-hash disk cache, and the ``Project`` import/call graphs
+  with alias-resolving reachability queries.
 * ``cli``      — argument parsing + exit-code policy (0 clean, 1
-  non-baselined findings, 2 usage error).
+  non-baselined findings or TODO-stamped baseline reasons, 2 usage
+  error).
 * ``baseline.json`` — checked-in grandfather list; every entry carries a
   human-written ``reason``. Regenerate with ``--update-baseline`` (new
-  entries get a TODO reason so grandfathering stays a reviewed diff).
+  entries get a TODO reason so grandfathering stays a reviewed diff —
+  and fails any normal run until replaced, ``--allow-todo`` excepted).
 """
 
 from .engine import (  # noqa: F401
-    Finding, FileContext, Rule, RULES, register_rule,
+    Finding, FileContext, Rule, ProjectRule, RULES, register_rule,
     DEFAULT_CONFIG, default_baseline_path, load_baseline, match_baseline,
     update_baseline, run_lint, LintResult, REPO_ROOT,
 )

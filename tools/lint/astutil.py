@@ -10,6 +10,14 @@ from typing import Dict, List, Optional, Set
 MUTABLE_CTORS = {"dict", "list", "set", "OrderedDict", "defaultdict",
                  "deque", "Counter"}
 
+#: stdlib modules whose call results are process state, not math — calling
+#: them at trace time bakes one sample into the compiled executable
+IMPURE_MODULES = {"time", "random", "datetime", "uuid"}
+IMPURE_PREFIXES = ("np.random.", "numpy.random.")
+
+#: constructors that create a lock-like object (Condition wraps a Lock)
+LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
 
 def dotted_name(node: ast.AST) -> str:
     """``a.b.c`` for Name/Attribute chains, else ``""``."""
@@ -57,9 +65,10 @@ def module_mutable_globals(tree: ast.Module) -> Set[str]:
     return out
 
 
-def module_lock_names(tree: ast.Module) -> Set[str]:
-    """Names assigned ``threading.Lock()``/``RLock()`` at module scope."""
-    out: Set[str] = set()
+def module_lock_defs(tree: ast.Module) -> Dict[str, str]:
+    """Name -> ctor kind for ``threading.Lock()``/``RLock()``/``Condition()``
+    assigned at module scope."""
+    out: Dict[str, str] = {}
     for node in tree.body:
         targets: List[ast.Name] = []
         value: Optional[ast.AST] = None
@@ -74,9 +83,51 @@ def module_lock_names(tree: ast.Module) -> Set[str]:
             continue
         fname = value.func.attr if isinstance(value.func, ast.Attribute) \
             else getattr(value.func, "id", "")
-        if fname in ("Lock", "RLock"):
-            out.update(t.id for t in targets)
+        if fname in LOCK_CTORS:
+            for t in targets:
+                out[t.id] = LOCK_CTORS[fname]
     return out
+
+
+def module_lock_names(tree: ast.Module) -> Set[str]:
+    """Names assigned ``threading.Lock()``/``RLock()`` at module scope."""
+    return {n for n, kind in module_lock_defs(tree).items()
+            if kind in ("Lock", "RLock")}
+
+
+def lock_ctor_in(expr: ast.AST) -> Optional[str]:
+    """Lock kind when ``expr`` constructs one anywhere in its subtree
+    (covers ``lock if lock is not None else threading.Lock()`` defaults)."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            fname = n.func.attr if isinstance(n.func, ast.Attribute) \
+                else getattr(n.func, "id", "")
+            if fname in LOCK_CTORS:
+                return LOCK_CTORS[fname]
+    return None
+
+
+_META_ATTRS = ("shape", "dtype", "ndim", "size")
+
+
+def mentions_device_value(expr: ast.AST) -> bool:
+    """``._data`` reads (minus pure-metadata ``._data.shape``-style chains)
+    or ``jnp.`` / ``jax.numpy.`` calls anywhere in the subtree."""
+    meta_only = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _META_ATTRS \
+                and isinstance(node.value, ast.Attribute) \
+                and node.value.attr == "_data":
+            meta_only.add(id(node.value))
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == "_data" \
+                and id(node) not in meta_only:
+            return True
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn.startswith(("jnp.", "jax.numpy.")):
+                return True
+    return False
 
 
 def function_table(tree: ast.Module) -> Dict[str, List[ast.AST]]:

@@ -4,17 +4,25 @@ Usage::
 
     python -m tools.lint                       # lint paddle_tpu/ (default)
     python -m tools.lint paddle_tpu/core       # lint a subtree / files
+    python -m tools.lint --changed-only        # only files changed vs the
+                                               # merge-base with main (whole-
+                                               # program rules still see the
+                                               # full tree via the cache)
     python -m tools.lint --format=json         # machine-readable report
     python -m tools.lint --rules=silent-swallow,host-sync
     python -m tools.lint --list-rules
     python -m tools.lint --no-baseline         # show baselined findings too
+    python -m tools.lint --no-cache            # ignore + don't write the
+                                               # content-hash summary cache
     python -m tools.lint --update-baseline     # regenerate the grandfather
                                                # list (reviewed diff!)
 
 Exit codes: 0 — clean (every finding baselined); 1 — non-baselined
-findings; 2 — usage error (unknown rule, path matching no python files).
-Stale baseline entries are reported but do not fail a CLI run; the tier-1
-gate (``tests/test_lint.py``) rejects them so the baseline cannot rot.
+findings, or the baseline still carries ``TODO`` reasons (write the
+justification, or pass ``--allow-todo`` while drafting); 2 — usage error
+(unknown rule, path matching no python files). Stale baseline entries are
+reported but do not fail a CLI run; the tier-1 gate
+(``tests/test_lint.py``) rejects them so the baseline cannot rot.
 """
 
 from __future__ import annotations
@@ -24,8 +32,10 @@ import json
 import sys
 from typing import Optional, Sequence
 
-from .engine import (RULES, default_baseline_path, iter_python_files,
-                     load_baseline, run_lint, save_baseline, update_baseline)
+from .engine import (ProjectRule, RULES, default_baseline_path,
+                     iter_python_files, load_baseline, run_lint,
+                     save_baseline, update_baseline)
+from .wholeprogram.cache import default_cache_path
 from . import rules as _rules  # noqa: F401  (registers built-ins)
 
 
@@ -39,14 +49,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rules", default=None,
                    help="comma-separated rule names (default: all)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--changed-only", action="store_true",
+                   help="narrow the per-file pass to files changed vs "
+                        "`git merge-base HEAD main` (+ untracked); falls "
+                        "back to a full run outside git. Whole-program "
+                        "rules always analyze the full tree (cached).")
+    p.add_argument("--diff-base", default="main",
+                   help="branch/ref for --changed-only (default: main)")
     p.add_argument("--baseline", default=None,
                    help=f"baseline file (default: {default_baseline_path()})")
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore the baseline: report every finding")
+    p.add_argument("--allow-todo", action="store_true",
+                   help="do not fail on baseline entries whose reason is "
+                        "still the TODO stamp (drafting escape hatch; the "
+                        "tier-1 gate never allows them)")
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline from current findings "
                         "(preserves existing reasons; new entries get a "
                         "TODO reason to force review)")
+    p.add_argument("--cache-file", default=None,
+                   help=f"summary/findings cache "
+                        f"(default: {default_cache_path()})")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the content-hash cache for this run")
     return p
 
 
@@ -55,7 +81,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     if args.list_rules:
         for name in sorted(RULES):
-            print(f"{name:18s} {RULES[name].description}")
+            print(f"{name:20s} {RULES[name].description}")
         return 0
 
     rule_names = None
@@ -77,23 +103,61 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     baseline_path = args.baseline or default_baseline_path()
     entries = [] if (args.no_baseline or args.update_baseline) \
         else load_baseline(baseline_path)
+
+    # TODO-stamped reasons are a drafting state, not a shipped state: a
+    # baseline that still carries them fails the run (after reporting, so
+    # JSON consumers always get the report) unless --allow-todo
+    todo_entries = [] if args.allow_todo else \
+        [e for e in entries
+         if str(e.get("reason", "")).strip().startswith("TODO")]
+
+    cache_path = None if args.no_cache \
+        else (args.cache_file or default_cache_path())
     result = run_lint(paths=args.paths or None, rules=rule_names,
-                      baseline_entries=entries)
+                      baseline_entries=entries,
+                      changed_only=args.changed_only,
+                      diff_base=args.diff_base,
+                      cache_path=cache_path)
 
     if args.update_baseline:
         # regenerate only what this run could SEE: entries for unscanned
         # files / inactive rules pass through untouched, so a scoped
         # `tools.lint paddle_tpu/core --update-baseline` can never delete
-        # the rest of the tree's reviewed justifications
+        # the rest of the tree's reviewed justifications. Whole-program
+        # rules need the FULL default selection to be regenerable at all —
+        # a path-narrowed run builds a partial graph whose missing roots /
+        # call edges make their findings vanish spuriously — so their
+        # entries are only in scope on a default-paths run (which is also
+        # what --changed-only uses: its narrowing hits the per-file pass
+        # only, so project findings in unchanged files keep matching their
+        # justified entries instead of growing TODO-stamped twins). Files
+        # that failed to read/parse produced no findings either way —
+        # their entries always pass through untouched.
         old = load_baseline(baseline_path)
         scanned = set(result.scanned)
+        selection = set(result.selection)
+        failed = set(result.failed_files)
+        full_selection = not args.paths
         active = set(rule_names or RULES)
-        in_scope = [e for e in old
-                    if e["path"] in scanned and e["rule"] in active]
-        out_scope = [e for e in old
-                     if not (e["path"] in scanned and e["rule"] in active)]
+        project_names = {n for n, r in RULES.items()
+                         if isinstance(r, ProjectRule)}
+
+        def saw(e):
+            if e["rule"] not in active or e["path"] in failed:
+                return False
+            if e["rule"] in project_names:
+                return full_selection and e["path"] in selection
+            return e["path"] in scanned
+
+        in_scope = [e for e in old if saw(e)]
+        out_scope = [e for e in old if not saw(e)]
+        # symmetric filter on the findings side: project-rule findings
+        # from a partial graph must not mint entries next to the
+        # preserved (out-of-scope) justified ones
+        regen = [f for f in result.new
+                 if full_selection or f.rule not in project_names]
         new_entries = sorted(
-            update_baseline(result.new, in_scope) + out_scope,
+            update_baseline(regen, in_scope) + out_scope,
             key=lambda e: (e["path"], e["rule"], e["message"]))
         save_baseline(baseline_path, new_entries)
         print(f"wrote {len(new_entries)} entr"
@@ -106,8 +170,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"TODO reason — edit the justification before committing")
         return 0
 
+    cache_line = (f"cache: {result.parsed_files} parsed, "
+                  f"{result.findings_cache_hits} file-pass hits, "
+                  f"{result.summary_cache_hits} summary hits "
+                  f"(of {result.total_files} files) "
+                  f"in {result.run_seconds:.2f}s")
     if args.format == "json":
-        print(json.dumps(result.as_dict(), indent=2, sort_keys=True))
+        report = result.as_dict()
+        report["todo_baseline_entries"] = [
+            {"path": e["path"], "rule": e["rule"], "message": e["message"]}
+            for e in todo_entries]
+        if todo_entries:
+            report["clean"] = False
+        print(json.dumps(report, indent=2, sort_keys=True))
     else:
         for f in result.new:
             print(f.text())
@@ -121,6 +196,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    f"{len(result.new)} finding(s), "
                    f"{len(result.baselined)} baselined, "
                    f"{len(result.stale)} stale baseline entr"
-                   f"{'y' if len(result.stale) == 1 else 'ies'}")
-        print(("FAILED: " if not result.clean else "ok: ") + summary)
-    return 0 if result.clean else 1
+                   f"{'y' if len(result.stale) == 1 else 'ies'}"
+                   + ("; changed-only" if result.changed_only else ""))
+        print(cache_line)
+        ok = result.clean and not todo_entries
+        print(("FAILED: " if not ok else "ok: ") + summary)
+    if todo_entries:
+        for e in todo_entries:
+            print(f"baseline entry without a reviewed reason: "
+                  f"{e['path']}: {e['rule']}: {e['message'][:60]}…",
+                  file=sys.stderr)
+        print(f"{len(todo_entries)} baseline entr"
+              f"{'y' if len(todo_entries) == 1 else 'ies'} still "
+              f"carr{'ies' if len(todo_entries) == 1 else 'y'} a TODO "
+              f"reason — write the justification (or pass --allow-todo "
+              f"while drafting)", file=sys.stderr)
+    return 0 if result.clean and not todo_entries else 1

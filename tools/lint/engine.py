@@ -20,8 +20,10 @@ import ast
 import json
 import os
 import re
+import subprocess
+import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -81,6 +83,25 @@ class Rule:
         raise NotImplementedError
 
 
+class ProjectRule(Rule):
+    """Whole-program rule: sees every scanned module at once.
+
+    Registered in the same ``RULES`` registry, but instead of ``check``
+    (which is a no-op), the engine calls ``check_project`` exactly once
+    per run with the assembled :class:`tools.lint.wholeprogram.Project`.
+    Findings still name a (path, line) — suppression pragmas and the
+    baseline apply unchanged. Under ``--changed-only`` project rules keep
+    analyzing the FULL tree (an edit in one file can create a finding in
+    another); the summary cache makes that cheap.
+    """
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
 RULES: Dict[str, Rule] = {}
 
 
@@ -115,6 +136,39 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # suffixes are assumed to run with the module lock already held by
     # their caller (the ``_locked`` convention used across core/)
     "lock_held_suffixes": ["_locked"],
+    # cross-host-sync: whole-program reachability roots of the eager
+    # dispatch fast path ("<path>::<function simple name>"): anything a
+    # dispatch can reach pays its host syncs once per op
+    "fast_path_roots": [
+        "paddle_tpu/core/tensor.py::apply",
+        "paddle_tpu/core/tensor.py::_apply_impl",
+    ],
+    # import-layering: the declared layer DAG, base layers first; a module
+    # may (module-scope) import same-or-lower layers only. Matching is by
+    # most-specific prefix, so the bare package in the top layer covers
+    # the root __init__ without swallowing the rest.
+    "import_layers": [
+        {"name": "foundation", "prefixes": [
+            "paddle_tpu.version", "paddle_tpu.flags", "paddle_tpu.device",
+            "paddle_tpu.sysconfig", "paddle_tpu._native",
+            "paddle_tpu.observability"]},
+        {"name": "core", "prefixes": [
+            "paddle_tpu.core", "paddle_tpu.autograd", "paddle_tpu.framework",
+            "paddle_tpu.profiler", "paddle_tpu.utils", "paddle_tpu.amp",
+            "paddle_tpu.ops", "paddle_tpu.tensor", "paddle_tpu.jit"]},
+        {"name": "api", "prefixes": [
+            "paddle_tpu.nn", "paddle_tpu.optimizer", "paddle_tpu.regularizer",
+            "paddle_tpu.io", "paddle_tpu.metric", "paddle_tpu.distribution",
+            "paddle_tpu.linalg", "paddle_tpu.fft", "paddle_tpu.signal",
+            "paddle_tpu.sparse", "paddle_tpu.geometric",
+            "paddle_tpu.quantization", "paddle_tpu.text", "paddle_tpu.audio",
+            "paddle_tpu.flops_counter", "paddle_tpu.vision"]},
+        {"name": "distributed", "prefixes": ["paddle_tpu.distributed"]},
+        {"name": "apps", "prefixes": [
+            "paddle_tpu.hapi", "paddle_tpu.models", "paddle_tpu.incubate",
+            "paddle_tpu.static", "paddle_tpu.inference", "paddle_tpu.onnx",
+            "paddle_tpu.hub", "paddle_tpu"]},
+    ],
 }
 
 
@@ -247,8 +301,23 @@ class LintResult:
     baselined: List[Finding] = field(default_factory=list)
     stale: List[Dict[str, Any]] = field(default_factory=list)
     files_checked: int = 0
-    scanned: List[str] = field(default_factory=list)  # repo-relative paths
+    scanned: List[str] = field(default_factory=list)  # per-file pass paths
+    #                         (successfully checked only — a file that failed
+    #                          to read/parse is NOT "seen", so baseline
+    #                          regeneration cannot prune its entries)
+    selection: List[str] = field(default_factory=list)  # full selection
+    #                         (what the whole-program pass covers, even when
+    #                          --changed-only narrowed the per-file pass)
+    failed_files: List[str] = field(default_factory=list)
     errors: List[str] = field(default_factory=list)
+    # incremental-run bookkeeping (graft-lint 2.0)
+    total_files: int = 0          # files in project scope (incl. unscanned)
+    parsed_files: int = 0         # files actually parsed this run
+    findings_cache_hits: int = 0  # per-file passes served from cache
+    summary_cache_hits: int = 0   # project summaries served from cache
+    cache_enabled: bool = False
+    changed_only: bool = False    # git narrowing actually applied
+    run_seconds: float = 0.0
 
     @property
     def clean(self) -> bool:
@@ -266,6 +335,15 @@ class LintResult:
             "counts_by_rule": counts,
             "errors": self.errors,
             "clean": self.clean,
+            "run_seconds": round(self.run_seconds, 4),
+            "changed_only": self.changed_only,
+            "cache": {
+                "enabled": self.cache_enabled,
+                "total_files": self.total_files,
+                "parsed_files": self.parsed_files,
+                "findings_hits": self.findings_cache_hits,
+                "summary_hits": self.summary_cache_hits,
+            },
         }
 
 
@@ -287,38 +365,188 @@ def iter_python_files(paths: Sequence[str], root: str = REPO_ROOT
     return sorted(set(out))
 
 
+def _git_changed_files(root: str, base: str = "main") -> Optional[Set[str]]:
+    """Repo-relative paths changed vs ``git merge-base HEAD <base>`` plus
+    untracked files; None when git (or the merge base) is unavailable, in
+    which case the caller falls back to a full run."""
+    def git(*args):
+        return subprocess.run(["git", *args], cwd=root, capture_output=True,
+                              text=True, timeout=30)
+    try:
+        mb = git("merge-base", "HEAD", base)
+        if mb.returncode != 0:
+            return None
+        diff = git("diff", "--name-only", mb.stdout.strip())
+        if diff.returncode != 0:
+            return None
+        changed = {ln for ln in diff.stdout.splitlines() if ln}
+        untracked = git("ls-files", "--others", "--exclude-standard")
+        if untracked.returncode == 0:
+            changed |= {ln for ln in untracked.stdout.splitlines() if ln}
+        return changed
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
 def run_lint(paths: Optional[Sequence[str]] = None,
              rules: Optional[Sequence[str]] = None,
              config: Optional[Dict[str, Any]] = None,
              baseline_entries: Optional[Sequence[Dict[str, Any]]] = None,
-             root: str = REPO_ROOT) -> LintResult:
+             root: str = REPO_ROOT,
+             changed_only: bool = False,
+             diff_base: str = "main",
+             cache_path: Optional[str] = None) -> LintResult:
     """Run the engine. ``paths`` may be absolute or ``root``-relative;
-    findings always report ``root``-relative paths."""
+    findings always report ``root``-relative paths.
+
+    ``changed_only`` narrows the per-file pass to files changed vs the
+    merge base with ``diff_base`` (full run when not in a git repo);
+    whole-program rules always analyze the full selection, served from
+    the summary cache. ``cache_path`` enables the content-hash cache —
+    per-file findings and project summaries keyed by file sha, so warm
+    runs skip parsing.
+    """
+    t_start = time.perf_counter()
     cfg = dict(DEFAULT_CONFIG)
     if config:
         cfg.update(config)
     if paths is None:
         paths = cfg["default_paths"]
     active = [RULES[n] for n in (rules or sorted(RULES))]
+    file_rules = [r for r in active if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in active if isinstance(r, ProjectRule)]
+
     result = LintResult()
-    findings: List[Finding] = []
-    for abspath in iter_python_files(paths, root=root):
-        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
-        result.scanned.append(rel)
-        try:
+    all_files = iter_python_files(paths, root=root)
+    rels = {p: os.path.relpath(p, root).replace(os.sep, "/")
+            for p in all_files}
+    result.total_files = len(all_files)
+    result.selection = [rels[p] for p in all_files]
+
+    changed: Optional[Set[str]] = None
+    if changed_only:
+        changed = _git_changed_files(root, diff_base)
+        result.changed_only = changed is not None
+    scan_files = all_files if changed is None \
+        else [p for p in all_files if rels[p] in changed]
+
+    from .wholeprogram.cache import SummaryCache, content_sha
+    cache = None
+    if cache_path:
+        cache = SummaryCache.load(
+            cache_path, cfg, [r.name for r in RULES.values()], root)
+        result.cache_enabled = True
+
+    sources: Dict[str, Tuple[str, str]] = {}   # rel -> (sha, src)
+    contexts: Dict[str, FileContext] = {}      # rel -> parsed ctx
+
+    def read(abspath: str, rel: str) -> Tuple[str, str]:
+        if rel not in sources:
             with open(abspath, encoding="utf-8") as f:
                 src = f.read()
-            ctx = FileContext(rel, src, cfg)
-        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            sources[rel] = (content_sha(src), src)
+        return sources[rel]
+
+    def parse(rel: str, src: str) -> FileContext:
+        if rel not in contexts:
+            contexts[rel] = FileContext(rel, src, cfg)
+            result.parsed_files += 1
+        return contexts[rel]
+
+    findings: List[Finding] = []
+    failed: Set[str] = set()
+
+    # ---- per-file pass over the (possibly narrowed) scan set ----
+    for abspath in scan_files:
+        rel = rels[abspath]
+        try:
+            sha, src = read(abspath, rel)
+        except (UnicodeDecodeError, OSError) as e:
             result.errors.append(f"{rel}: {e.__class__.__name__}: {e}")
+            failed.add(rel)
             continue
+        ent = cache.get(rel, sha) if cache else None
+        if ent is not None and \
+                all(r.name in ent["findings"] for r in file_rules):
+            result.scanned.append(rel)
+            result.files_checked += 1
+            result.findings_cache_hits += 1
+            for r in file_rules:
+                findings.extend(Finding(**d) for d in ent["findings"][r.name])
+            continue
+        try:
+            ctx = parse(rel, src)
+        except SyntaxError as e:
+            result.errors.append(f"{rel}: {e.__class__.__name__}: {e}")
+            failed.add(rel)
+            continue
+        result.scanned.append(rel)
         result.files_checked += 1
         per_line, file_level = _pragma_tables(ctx.lines)
-        for rule in active:
-            for f in rule.check(ctx) or ():
-                if not _suppressed(f, per_line, file_level):
-                    findings.append(f)
+        per_rule: Dict[str, list] = {}
+        for rule in file_rules:
+            fs = [f for f in (rule.check(ctx) or ())
+                  if not _suppressed(f, per_line, file_level)]
+            findings.extend(fs)
+            per_rule[rule.name] = [f.as_dict() for f in fs]
+        if cache is not None:
+            cache.put_findings(rel, sha, per_rule)
+
+    # ---- whole-program pass over the FULL selection ----
+    if project_rules:
+        from .wholeprogram.project import Project
+        from .wholeprogram.summary import ModuleSummary, build_summary
+        summaries: Dict[str, ModuleSummary] = {}
+        for abspath in all_files:
+            rel = rels[abspath]
+            if rel in failed:
+                continue
+            try:
+                sha, src = read(abspath, rel)
+            except (UnicodeDecodeError, OSError) as e:
+                result.errors.append(f"{rel}: {e.__class__.__name__}: {e}")
+                failed.add(rel)
+                continue
+            ent = cache.get(rel, sha) if cache else None
+            if ent is not None and ent.get("summary") is not None:
+                summaries[rel] = ModuleSummary.from_dict(ent["summary"])
+                result.summary_cache_hits += 1
+                continue
+            try:
+                ctx = parse(rel, src)
+            except SyntaxError as e:
+                result.errors.append(f"{rel}: {e.__class__.__name__}: {e}")
+                failed.add(rel)
+                continue
+            s = build_summary(rel, ctx.tree, ctx.lines, cfg)
+            summaries[rel] = s
+            if cache is not None:
+                cache.put_summary(rel, sha, s.to_dict())
+        project = Project(summaries, cfg)
+        for rule in project_rules:
+            for f in rule.check_project(project) or ():
+                s = summaries.get(f.path)
+                if s is not None and s.suppressed(f.rule, f.line):
+                    continue
+                findings.append(f)
+
+    if cache is not None:
+        cache.save()
+
+    result.failed_files = sorted(failed)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    new, baselined, stale = match_baseline(findings, baseline_entries or [])
+
+    # under an APPLIED git narrowing, baseline entries of per-file rules
+    # for unscanned files can neither match nor meaningfully go stale —
+    # scope them out so a warm incremental run doesn't scream "stale"
+    entries = list(baseline_entries or [])
+    if result.changed_only:
+        project_names = {r.name for r in project_rules}
+        scanned_set = set(result.scanned)
+        entries = [e for e in entries
+                   if e["rule"] in project_names or e["path"] in scanned_set]
+
+    new, baselined, stale = match_baseline(findings, entries)
     result.new, result.baselined, result.stale = new, baselined, stale
+    result.run_seconds = time.perf_counter() - t_start
     return result

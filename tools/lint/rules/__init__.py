@@ -1,8 +1,17 @@
-"""Built-in graft-lint rules; importing this package registers them."""
+"""Built-in graft-lint rules; importing this package registers them.
+
+Per-file rules see one parsed module; the ``cross_*``/``lock_order``/
+``import_layering`` rules are :class:`~tools.lint.engine.ProjectRule`
+subclasses and run once per invocation over the whole-program graphs.
+"""
 
 from . import (  # noqa: F401
+    cross_host_sync,
+    cross_trace_impurity,
     hot_path_import,
     host_sync,
+    import_layering,
+    lock_order,
     silent_swallow,
     trace_impurity,
     unguarded_global,

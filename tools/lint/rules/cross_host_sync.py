@@ -1,0 +1,54 @@
+"""cross-host-sync: no device→host transfer reachable from the dispatch
+fast path, through any call chain.
+
+The per-file ``host-sync`` rule flags syncs lexically inside loops; the
+dispatch fast path is a different budget — ``apply()`` runs once per op,
+so a ``.item()`` / ``.numpy()`` / ``np.asarray(x._data)`` ANYWHERE in its
+transitive callees stalls every eager op, even with no loop in sight
+(PR 2 bought ~10× per-op exactly by deleting such stalls). Roots come
+from the engine config (``fast_path_roots``: ``"<path>::<fn>"``) and the
+reachability is the whole-program call graph, so a helper three modules
+away is still attributed to the dispatch root that reaches it.
+
+Deliberate syncs (the fused check_nan_inf verdict, debug paths) carry a
+baseline entry whose reason says the sync IS the semantics.
+"""
+
+from __future__ import annotations
+
+from ..astutil import path_matches
+from ..engine import Finding, ProjectRule, register_rule
+
+
+@register_rule
+class CrossHostSyncRule(ProjectRule):
+    name = "cross-host-sync"
+    description = ("no .item()/.numpy()/host-forcing conversions reachable "
+                   "from the dispatch fast path (any call chain)")
+
+    def check_project(self, project):
+        specs = project.config.get("fast_path_roots", [])
+        roots = []
+        for spec in specs:
+            path, _, fname = spec.partition("::")
+            for mod in sorted(project.modules):
+                s = project.modules[mod]
+                if not path_matches(s.path, [path]):
+                    continue
+                for fi in project.fn_by_simple.get((mod, fname), []):
+                    roots.append((mod, fi, f"{mod}.{fname}"))
+        if not roots:
+            return
+        reached = project.reachable_from(roots)
+        for (mod, qualname) in sorted(reached):
+            root_label = reached[(mod, qualname)]
+            fi = project.fn_by_qual[(mod, qualname)]
+            s = project.modules[mod]
+            for what, line in fi.host_syncs:
+                yield Finding(
+                    s.path, line, self.name,
+                    f"host sync {what} in '{fi.qualname}' is reachable "
+                    f"from the dispatch fast path (root '{root_label}'): "
+                    f"every eager op dispatch can pay this device "
+                    f"round-trip (move it off the fast path, or baseline "
+                    f"with the reason the sync IS the semantics)")

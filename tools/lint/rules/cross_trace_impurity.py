@@ -1,0 +1,116 @@
+"""cross-trace-impurity: trace purity across module boundaries.
+
+The per-file ``trace-impurity`` rule stops at the module edge: a traced
+function in ``core/tensor.py`` that calls an impure helper imported from
+``paddle_tpu/utils/`` looks clean in both files. This rule runs the same
+root detection (``jax.jit``, ``apply(name, fn, …)``, configured roots,
+inline traced lambdas) but walks the PROJECT call graph, so the helper's
+``time.time()`` / unkeyed randomness / ``os.environ`` / mutable-global
+read is attributed back to the trace root that bakes it in.
+
+Division of labor (no double reporting):
+
+* functions covered by the per-file rule's own reachability — the
+  intra-module simple-name closure from a root in the SAME module — stay
+  its findings (it needs no project graph and keeps working on
+  scoped/single-file runs, the fallback when resolution fails), even
+  when a root in another module ALSO reaches them;
+* this rule reports (a) impure reads in functions only reachable from a
+  root in ANOTHER module, and (b) ``alias.NAME`` reads of a mutable
+  global that LIVES in another module — invisible to any per-file scan
+  regardless of where the root is.
+"""
+
+from __future__ import annotations
+
+from typing import Set
+
+from ..engine import Finding, ProjectRule, register_rule
+
+
+def _intra_covered(project, mod: str) -> Set[str]:
+    """Simple names the per-file rule's reachability covers in ``mod``:
+    the closure of plain-name same-module calls from the module's own
+    trace roots (mirrors trace_impurity's worklist)."""
+    s = project.modules[mod]
+    seen: Set[str] = set()
+    work = [n for n in s.trace_roots
+            if (mod, n) in project.fn_by_simple]
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for fi in project.fn_by_simple[(mod, name)]:
+            for dn, _line in fi.calls:
+                if "." not in dn and (mod, dn) in project.fn_by_simple:
+                    work.append(dn)
+    return seen
+
+_ADVICE = ("the value is baked in at trace time and silently served stale "
+           "(pass it in as an argument, use jax.random for randomness, or "
+           "route the knob through the epoch-keyed flags accessor)")
+
+
+@register_rule
+class CrossTraceImpurityRule(ProjectRule):
+    name = "cross-trace-impurity"
+    description = ("no clock/randomness/env/mutable-global reads anywhere "
+                   "a jax trace can reach, across module boundaries")
+
+    def check_project(self, project):
+        roots = []
+        for mod in sorted(project.modules):
+            s = project.modules[mod]
+            for rname in s.trace_roots:
+                for fi in project.fn_by_simple.get((mod, rname), []):
+                    roots.append((mod, fi, (mod, rname)))
+        if not roots:
+            return
+        reached = project.reachable_from(roots)
+        intra_cov = {mod: _intra_covered(project, mod)
+                     for mod in {m for m, _q in reached}}
+        for (mod, qualname) in sorted(reached):
+            root_mod, root_name = reached[(mod, qualname)]
+            fi = project.fn_by_qual[(mod, qualname)]
+            s = project.modules[mod]
+            # the per-file rule owns anything its own intra-module closure
+            # reaches, regardless of which root the BFS labeled it with
+            cross_root = mod != root_mod and fi.name not in intra_cov[mod]
+            root_label = f"{root_mod}.{root_name}"
+            for kind, detail, line in fi.impure:
+                if kind == "attr":
+                    # alias.NAME — flag only when it resolves to a mutable
+                    # module global living in ANOTHER project module
+                    alias, attr = detail.split(".", 1)
+                    target = s.bindings.get(alias)
+                    if not target or target == mod or \
+                            target not in project.modules:
+                        continue
+                    if attr not in project.modules[target].mutable_globals:
+                        continue
+                    yield Finding(
+                        s.path, line, self.name,
+                        f"mutable global '{target}.{attr}' (another "
+                        f"module's) read in '{fi.qualname}', which is "
+                        f"trace-reachable from '{root_label}': {_ADVICE}")
+                elif cross_root:
+                    if kind == "call":
+                        yield Finding(
+                            s.path, line, self.name,
+                            f"'{detail}(...)' in '{fi.qualname}' is "
+                            f"trace-reachable from '{root_label}' in "
+                            f"another module: {_ADVICE}")
+                    elif kind == "environ":
+                        yield Finding(
+                            s.path, line, self.name,
+                            f"'os.environ' read in '{fi.qualname}', which "
+                            f"is trace-reachable from '{root_label}' in "
+                            f"another module: {_ADVICE}")
+                    elif kind == "global":
+                        yield Finding(
+                            s.path, line, self.name,
+                            f"module-level mutable global '{detail}' read "
+                            f"in '{fi.qualname}', which is trace-reachable "
+                            f"from '{root_label}' in another module: "
+                            f"{_ADVICE}")

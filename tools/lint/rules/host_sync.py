@@ -24,31 +24,11 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ..astutil import dotted_name, snippet
+from ..astutil import dotted_name, mentions_device_value, snippet
 from ..engine import FileContext, Rule, register_rule
 
-_META_ATTRS = ("shape", "dtype", "ndim", "size")
 _NP_CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
-
-
-def _mentions_device_value(expr: ast.AST) -> bool:
-    """``._data`` reads (minus pure-metadata ``._data.shape``-style chains)
-    or ``jnp.`` / ``jax.numpy.`` calls anywhere in the subtree."""
-    meta_only = set()
-    for node in ast.walk(expr):
-        if isinstance(node, ast.Attribute) and node.attr in _META_ATTRS \
-                and isinstance(node.value, ast.Attribute) \
-                and node.value.attr == "_data":
-            meta_only.add(id(node.value))
-    for node in ast.walk(expr):
-        if isinstance(node, ast.Attribute) and node.attr == "_data" \
-                and id(node) not in meta_only:
-            return True
-        if isinstance(node, ast.Call):
-            dn = dotted_name(node.func)
-            if dn.startswith(("jnp.", "jax.numpy.")):
-                return True
-    return False
+_mentions_device_value = mentions_device_value
 
 
 @register_rule

@@ -1,0 +1,76 @@
+"""import-layering: the declared layer DAG plus import-cycle detection.
+
+The engine config declares the intended layering (``import_layers``: base
+layers first — foundation → core → api → distributed → apps for the
+shipped tree). A module may module-scope-import modules of its own or
+LOWER layers only; a lower layer importing a higher one is a back-edge
+that inverts the architecture (``core`` silently depending on
+``distributed`` is how god-modules happen). Matching is by most-specific
+dotted prefix; modules matching no prefix are unconstrained.
+
+Separately, any strongly-connected component in the module-scope import
+graph is reported as an import cycle: such modules only import because
+somebody currently imports them in a lucky order. Function-body deferred
+imports are the sanctioned cycle-breaker and are deliberately NOT part of
+this graph (the hot-path-import rule prices them where they cost).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+from ..engine import Finding, ProjectRule, register_rule
+from ..wholeprogram.project import strongly_connected
+
+
+def _layer_of(module: str, layers) -> Optional[int]:
+    """Most-specific prefix match wins; None = unconstrained."""
+    best: Optional[int] = None
+    best_len = -1
+    for i, layer in enumerate(layers):
+        for p in layer.get("prefixes", []):
+            if (module == p or module.startswith(p + ".")) and \
+                    len(p) > best_len:
+                best, best_len = i, len(p)
+    return best
+
+
+@register_rule
+class ImportLayeringRule(ProjectRule):
+    name = "import-layering"
+    description = ("module-scope imports must follow the declared layer "
+                   "DAG and form no cycles")
+
+    def check_project(self, project):
+        layers = project.config.get("import_layers", [])
+        order = " -> ".join(l["name"] for l in layers)
+        edges = project.import_edges()
+
+        for src, dst, line in edges:
+            ls, ld = _layer_of(src, layers), _layer_of(dst, layers)
+            if ls is not None and ld is not None and ls < ld:
+                yield Finding(
+                    project.modules[src].path, line, self.name,
+                    f"layering violation: '{src}' (layer "
+                    f"'{layers[ls]['name']}') imports '{dst}' from the "
+                    f"higher layer '{layers[ld]['name']}' at module scope "
+                    f"(declared order: {order}; defer the import into the "
+                    f"function that needs it, or move the shared piece "
+                    f"down a layer)")
+
+        graph: Dict[str, Set[str]] = {}
+        for src, dst, _line in edges:
+            graph.setdefault(src, set()).add(dst)
+        nodes = set(graph)
+        for tgts in graph.values():
+            nodes |= tgts
+        for scc in strongly_connected(nodes, graph):
+            first = scc[0]
+            line = min((ln for s, d, ln in edges
+                        if s == first and d in scc), default=1)
+            cycle = " -> ".join(scc + [first])
+            yield Finding(
+                project.modules[first].path, line, self.name,
+                f"import cycle (module-scope): {cycle} — import order is "
+                f"load-bearing; break the cycle with a function-body "
+                f"import or by moving the shared names to a leaf module")

@@ -1,0 +1,148 @@
+"""lock-order: static deadlock detection across the whole lock plane.
+
+Builds the lock-acquisition graph: an edge A → B means some code path
+acquires lock B while holding lock A, either lexically (``with A: …
+with B:``) or through a call chain (``with A: f()`` where ``f`` — in any
+module — transitively acquires B). Lock identity is static: module-level
+``threading.Lock/RLock/Condition`` objects and ``self.<attr>`` instance
+locks, named ``<module>.<name>`` / ``<module>.<Class>.<attr>`` (all
+instances of a class share one node — an over-approximation that errs
+toward reporting).
+
+Findings:
+
+* a cycle through ≥ 2 locks — two threads taking the locks in opposing
+  orders can deadlock (the PS/store/communicator failover class);
+* a self-edge on a NON-reentrant ``Lock`` — the thread re-acquiring it
+  deadlocks against itself (RLock/Condition self-edges are fine and
+  skipped).
+
+The ``*_locked`` caller-holds convention is honored: calls to functions
+whose name carries a configured suffix (``lock_held_suffixes``) do not
+propagate acquisitions — the convention promises the callee runs under
+the caller's lock and takes none of its own, so a defensive re-acquire
+pattern behind the suffix is not reported as a self-deadlock.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from ..engine import Finding, ProjectRule, register_rule
+from ..wholeprogram.project import strongly_connected
+
+
+@register_rule
+class LockOrderRule(ProjectRule):
+    name = "lock-order"
+    description = ("no cycles in the static lock-acquisition order "
+                   "(potential deadlocks), across call chains")
+
+    def check_project(self, project):
+        suffixes = tuple(project.config.get("lock_held_suffixes",
+                                            ["_locked"]))
+
+        def is_locked_call(dotted: str) -> bool:
+            return dotted.split(".")[-1].endswith(suffixes)
+
+        # direct lock sets + resolved callee edges, computed ONCE per node
+        # (resolution results never change across fixpoint iterations)
+        direct: Dict[Tuple[str, str], Set[str]] = {}
+        nodes: List[Tuple[str, object]] = []
+        callee_nodes: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        resolve_memo: Dict[Tuple[str, str, str],
+                           List[Tuple[str, object]]] = {}
+
+        def resolve(mod, fi, dn):
+            key = (mod, fi.cls or "", dn)
+            hit = resolve_memo.get(key)
+            if hit is None:
+                hit = project.resolve_call(mod, fi.cls, dn)
+                resolve_memo[key] = hit
+            return hit
+
+        for mod in sorted(project.modules):
+            for fi in project.modules[mod].functions:
+                nodes.append((mod, fi))
+                d = set()
+                for lr, _line in fi.acquires:
+                    lid = project.lock_id(mod, lr)
+                    if lid is not None:
+                        d.add(lid)
+                direct[(mod, fi.qualname)] = d
+                outs: Dict[Tuple[str, str], None] = {}
+                for dn, _line in fi.calls:
+                    if is_locked_call(dn):
+                        continue
+                    for m2, f2 in resolve(mod, fi, dn):
+                        outs[(m2, f2.qualname)] = None
+                callee_nodes[(mod, fi.qualname)] = list(outs)
+
+        trans = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:  # fixpoint is now pure set arithmetic over edges
+            changed = False
+            for mod, fi in nodes:
+                cur = trans[(mod, fi.qualname)]
+                for node in callee_nodes[(mod, fi.qualname)]:
+                    extra = trans[node] - cur
+                    if extra:
+                        cur |= extra
+                        changed = True
+
+        # edge set with one witness per (A, B)
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        def add_edge(a: str, b: str, path: str, line: int, desc: str):
+            edges.setdefault((a, b), (path, line, desc))
+
+        for mod, fi in nodes:
+            path = project.modules[mod].path
+            for lr_out, lr_in, line in fi.nest_edges:
+                a = project.lock_id(mod, lr_out)
+                b = project.lock_id(mod, lr_in)
+                if a and b:
+                    add_edge(a, b, path, line,
+                             f"'{fi.qualname}' nests `with` blocks")
+            for lr, dn, line in fi.calls_under_lock:
+                if is_locked_call(dn):
+                    continue
+                a = project.lock_id(mod, lr)
+                if a is None:
+                    continue
+                for m2, f2 in resolve(mod, fi, dn):
+                    for b in sorted(trans[(m2, f2.qualname)]):
+                        add_edge(a, b, path, line,
+                                 f"'{fi.qualname}' calls "
+                                 f"'{m2}.{f2.qualname}' (which acquires "
+                                 f"'{b}') while holding '{a}'")
+
+        # self-deadlocks: A -> A on a non-reentrant Lock
+        for (a, b), (path, line, desc) in sorted(edges.items()):
+            if a == b and project.lock_kinds.get(a) == "Lock":
+                yield Finding(
+                    path, line, self.name,
+                    f"potential self-deadlock: non-reentrant lock '{a}' "
+                    f"can be re-acquired while held — {desc} (make the "
+                    f"callee *_locked, or split the lock-free inner)")
+
+        # multi-lock cycles: SCCs of the acquisition graph
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+        lock_nodes = set(graph)
+        for tgts in graph.values():
+            lock_nodes |= tgts
+        for scc in strongly_connected(lock_nodes, graph):
+            witnesses = sorted(
+                (p, ln, d) for (a, b), (p, ln, d) in edges.items()
+                if a in scc and b in scc and a != b)
+            descs = "; ".join(d for _p, _l, d in witnesses[:3])
+            path, line = witnesses[0][0], witnesses[0][1]
+            yield Finding(
+                path, line, self.name,
+                f"potential deadlock: lock-order cycle between "
+                f"{', '.join(scc)} — threads can acquire them in opposing "
+                f"orders ({descs}); pick one global order and baseline it "
+                f"in MIGRATING.md, or drop a lock before the cross-call")

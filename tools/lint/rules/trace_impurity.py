@@ -21,6 +21,14 @@ set this rule flags:
 Reachability is intra-module by simple name: from each trace root, every
 same-module function it calls is scanned too (an over-approximation — a
 name shared by a traced and an untraced helper is treated as traced).
+
+Since graft-lint 2.0 this rule is the SAME-MODULE half of the invariant:
+the whole-program ``cross-trace-impurity`` rule follows call edges across
+module boundaries (import/from-import aliases resolved through the project
+call graph) and reports impure reads that only become trace-reachable
+through another module. This rule stays registered as the fallback that
+needs no project graph — it works on a single file, so scoped runs and
+files whose imports cannot be resolved keep their intra-module coverage.
 """
 
 from __future__ import annotations
@@ -28,12 +36,9 @@ from __future__ import annotations
 import ast
 from typing import List, Set
 
-from ..astutil import (dotted_name, function_table, module_mutable_globals,
-                       path_matches)
+from ..astutil import (IMPURE_MODULES, IMPURE_PREFIXES, dotted_name,
+                       function_table, module_mutable_globals, path_matches)
 from ..engine import FileContext, Rule, register_rule
-
-IMPURE_MODULES = {"time", "random", "datetime", "uuid"}
-IMPURE_PREFIXES = ("np.random.", "numpy.random.")
 
 
 def _trace_roots(ctx: FileContext):

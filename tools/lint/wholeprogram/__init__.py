@@ -1,0 +1,28 @@
+"""Whole-program analysis substrate for graft-lint 2.0.
+
+Every module in the scanned tree is distilled ONCE into a
+:class:`~tools.lint.wholeprogram.summary.ModuleSummary` — import bindings,
+module-scope import edges, per-function call lists, impure reads, host
+syncs, and ``with <lock>:`` structure. Summaries are plain-JSON values, so
+they cache on disk keyed by file content hash (``cache.SummaryCache``) and
+a warm run rebuilds the project graphs without re-parsing a single file.
+
+:class:`~tools.lint.wholeprogram.project.Project` assembles the summaries
+into the two graphs the interprocedural rules query:
+
+* the **import graph** (module-scope imports between project modules) for
+  ``import-layering``;
+* the **call graph** (module-qualified function nodes; ``import`` /
+  ``from-import`` aliases and one-hop re-exports resolved) for
+  ``cross-trace-impurity``, ``cross-host-sync``, and ``lock-order``.
+
+Resolution is deliberately pragmatic — the same one-level alias tracking
+as the per-file rules, extended across files.  Unresolvable calls (params,
+dynamic attributes, star imports) are dropped, making reachability an
+under-approximation across dynamic seams and an over-approximation within
+resolved names (simple-name matching inside a module).
+"""
+
+from .summary import ModuleSummary, build_summary, module_name_for  # noqa: F401
+from .cache import CACHE_FORMAT_VERSION, SummaryCache, default_cache_path  # noqa: F401
+from .project import Project  # noqa: F401

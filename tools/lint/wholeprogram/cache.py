@@ -1,0 +1,127 @@
+"""Content-hash keyed on-disk cache for summaries and per-file findings.
+
+One JSON file holds, per repo-relative path:
+
+* ``sha``      — sha256 of the file content the entry was built from;
+* ``summary``  — the :class:`ModuleSummary` dict (project-graph input);
+* ``findings`` — per-rule post-suppression finding dicts from the
+  per-file rules, so a warm full run skips parsing entirely.
+
+Self-invalidation, in decreasing blast radius:
+
+* ``CACHE_FORMAT_VERSION`` — bump when the cache layout, the summary
+  schema (``SUMMARY_FORMAT`` is folded in), or any rule's semantics
+  change; a mismatch discards the whole file;
+* config fingerprint — the engine config is hashed into the header, so a
+  changed layer DAG / root list / rule option rebuilds everything;
+* per-entry sha — an edited file rebuilds alone (the incremental path).
+
+Entries whose file no longer exists are dropped at load so tmp-path runs
+cannot grow the cache without bound. Saves go through a temp file +
+``os.replace`` so a crashed run never leaves a torn cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+from .summary import SUMMARY_FORMAT
+
+#: bump to invalidate every existing cache file (format/semantic changes)
+CACHE_FORMAT_VERSION = 1
+
+
+def default_cache_path() -> str:
+    from ..engine import REPO_ROOT
+    return os.path.join(REPO_ROOT, "tools", "lint", ".graft_lint_cache.json")
+
+
+def content_sha(src: str) -> str:
+    return hashlib.sha256(src.encode("utf-8")).hexdigest()
+
+
+def config_fingerprint(config: Dict[str, Any], rule_names) -> str:
+    blob = json.dumps({"config": config, "rules": sorted(rule_names),
+                       "cache_format": CACHE_FORMAT_VERSION,
+                       "summary_format": SUMMARY_FORMAT},
+                      sort_keys=True, default=repr)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+class SummaryCache:
+    def __init__(self, path: str, fingerprint: str,
+                 entries: Optional[Dict[str, Dict[str, Any]]] = None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.entries = entries or {}
+        self.dirty = False
+
+    @classmethod
+    def load(cls, path: str, config: Dict[str, Any],
+             rule_names, root: str) -> "SummaryCache":
+        fp = config_fingerprint(config, rule_names)
+        entries: Dict[str, Dict[str, Any]] = {}
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("format") == CACHE_FORMAT_VERSION and \
+                    data.get("fingerprint") == fp:
+                for rel, ent in data.get("entries", {}).items():
+                    if os.path.exists(os.path.join(root, rel)):
+                        entries[rel] = ent
+        except (OSError, ValueError):
+            pass  # missing or torn cache: start cold, first run rebuilds it
+        return cls(path, fp, entries)
+
+    def get(self, rel: str, sha: str) -> Optional[Dict[str, Any]]:
+        ent = self.entries.get(rel)
+        if ent is not None and ent.get("sha") == sha:
+            return ent
+        return None
+
+    def _entry(self, rel: str, sha: str) -> Dict[str, Any]:
+        ent = self.entries.get(rel)
+        if ent is None or ent.get("sha") != sha:
+            ent = {"sha": sha, "summary": None, "findings": {}}
+            self.entries[rel] = ent
+        return ent
+
+    def put_summary(self, rel: str, sha: str,
+                    summary_dict: Dict[str, Any]) -> None:
+        self._entry(rel, sha)["summary"] = summary_dict
+        self.dirty = True
+
+    def put_findings(self, rel: str, sha: str,
+                     per_rule: Dict[str, list]) -> None:
+        self._entry(rel, sha)["findings"].update(per_rule)
+        self.dirty = True
+
+    def save(self) -> None:
+        if not self.dirty:
+            return
+        data = {"format": CACHE_FORMAT_VERSION,
+                "fingerprint": self.fingerprint,
+                "entries": self.entries}
+        d = os.path.dirname(self.path) or "."
+        tmp = None
+        try:
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(prefix=".graft_lint_cache.",
+                                       dir=d, suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(data, f, separators=(",", ":"))
+            os.replace(tmp, self.path)
+            tmp = None
+            self.dirty = False  # only a successful write clears it
+        except OSError:
+            pass  # read-only checkout / disk full: run correctly, stay cold
+        finally:
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass  # best-effort cleanup of the torn temp file

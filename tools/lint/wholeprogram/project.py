@@ -1,0 +1,275 @@
+"""Project: module summaries assembled into queryable whole-program graphs.
+
+Name resolution is the heart of this module and follows the same
+pragmatics as the per-file rules, extended across files:
+
+* a dotted call ``a.b.c`` first matches locals of the calling module
+  (functions, classes), then the module's import bindings, then walks the
+  qualified name module-prefix-first;
+* ``from pkg import f`` where ``pkg/__init__`` itself binds ``f`` from a
+  submodule (a re-export) is followed through the ``__init__`` binding
+  table, depth-limited so cyclic re-exports terminate;
+* ``self.m()`` / ``cls.m()`` resolve to same-class methods first, then any
+  same-module function of that simple name (the intra-module
+  over-approximation the per-file rules already accept);
+* anything else (parameters, dynamic attributes, star imports) resolves to
+  nothing and drops out of the graph.
+
+Calls to classes resolve to ``__init__`` so constructor side effects stay
+on the graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from .summary import FunctionInfo, ModuleSummary
+
+#: function node identity in the project call graph
+Node = Tuple[str, str]  # (module dotted name, qualname)
+
+_RESOLVE_DEPTH = 6  # max re-export hops before giving up
+
+
+class Project:
+    def __init__(self, summaries: Dict[str, ModuleSummary],
+                 config: Dict[str, Any]):
+        self.config = config
+        self.by_path: Dict[str, ModuleSummary] = dict(summaries)
+        self.modules: Dict[str, ModuleSummary] = {}
+        for s in summaries.values():
+            self.modules[s.module] = s
+
+        # indexes
+        self.fn_by_simple: Dict[Tuple[str, str], List[FunctionInfo]] = {}
+        self.fn_by_qual: Dict[Node, FunctionInfo] = {}
+        self.methods: Dict[Tuple[str, str, str], FunctionInfo] = {}
+        self.classes: Dict[str, Set[str]] = {}
+        self.lock_kinds: Dict[str, str] = {}
+        for mod, s in self.modules.items():
+            cls_names: Set[str] = set(s.class_locks)
+            for fi in s.functions:
+                self.fn_by_simple.setdefault((mod, fi.name), []).append(fi)
+                self.fn_by_qual[(mod, fi.qualname)] = fi
+                if fi.cls:
+                    cls_names.add(fi.cls)
+                    self.methods.setdefault((mod, fi.cls, fi.name), fi)
+            self.classes[mod] = cls_names
+            for name, kind in s.locks.items():
+                self.lock_kinds[f"{mod}.{name}"] = kind
+            for cls, attrs in s.class_locks.items():
+                for attr, kind in attrs.items():
+                    self.lock_kinds[f"{mod}.{cls}.{attr}"] = kind
+
+    # -- resolution ---------------------------------------------------------
+
+    def _in_module(self, mod: str, head: str,
+                   tail: List[str]) -> List[Tuple[str, FunctionInfo]]:
+        if head in self.classes.get(mod, ()):
+            meth = tail[0] if tail else "__init__"
+            fi = self.methods.get((mod, head, meth))
+            return [(mod, fi)] if fi is not None else []
+        fns = self.fn_by_simple.get((mod, head))
+        if fns:
+            return [(mod, fi) for fi in fns]
+        return []
+
+    def resolve_qualified(self, dotted: str, depth: int = 0
+                          ) -> List[Tuple[str, FunctionInfo]]:
+        """Resolve a fully-qualified dotted name to function records."""
+        if depth > _RESOLVE_DEPTH:
+            return []
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.modules:
+                rest = parts[i:]
+                head, tail = rest[0], rest[1:]
+                hit = self._in_module(mod, head, tail)
+                if hit:
+                    return hit
+                target = self.modules[mod].bindings.get(head)
+                if target:  # re-export: follow the __init__ binding
+                    return self.resolve_qualified(
+                        ".".join([target] + tail), depth + 1)
+                return []
+        return []
+
+    def resolve_call(self, mod: str, cls: Optional[str], dotted: str
+                     ) -> List[Tuple[str, FunctionInfo]]:
+        """Resolve one call site's dotted name from inside ``mod``."""
+        s = self.modules.get(mod)
+        if s is None:
+            return []
+        parts = dotted.split(".")
+        head, tail = parts[0], parts[1:]
+        if head in ("self", "cls") and tail:
+            name = tail[0]
+            if cls is not None:
+                fi = self.methods.get((mod, cls, name))
+                if fi is not None:
+                    return [(mod, fi)]
+            return [(mod, fi)
+                    for fi in self.fn_by_simple.get((mod, name), [])]
+        hit = self._in_module(mod, head, tail)
+        if hit:
+            return hit
+        target = s.bindings.get(head)
+        if target and target != head:
+            return self.resolve_qualified(".".join([target] + tail))
+        if target:  # plain `import pkg` style binding: head == target
+            return self.resolve_qualified(dotted)
+        return []
+
+    # -- call-graph queries -------------------------------------------------
+
+    def callees(self, mod: str, fi: FunctionInfo) -> List[Node]:
+        out: List[Node] = []
+        seen: Set[Node] = set()
+        for dn, _line in fi.calls:
+            for m2, f2 in self.resolve_call(mod, fi.cls, dn):
+                node = (m2, f2.qualname)
+                if node not in seen:
+                    seen.add(node)
+                    out.append(node)
+        return sorted(out)
+
+    def reachable_from(self, roots: Iterable[Tuple[str, FunctionInfo, Any]]
+                       ) -> Dict[Node, Any]:
+        """BFS over the call graph; each reached node keeps the label of
+        the first root that reached it (deterministic: roots in given
+        order, sorted callees)."""
+        seen: Dict[Node, Any] = {}
+        queue: List[Tuple[str, FunctionInfo, Any]] = []
+        for mod, fi, label in roots:
+            node = (mod, fi.qualname)
+            if node not in seen:
+                seen[node] = label
+                queue.append((mod, fi, label))
+        i = 0
+        while i < len(queue):
+            mod, fi, label = queue[i]
+            i += 1
+            for m2, qn in self.callees(mod, fi):
+                node = (m2, qn)
+                if node not in seen:
+                    seen[node] = label
+                    queue.append((m2, self.fn_by_qual[node], label))
+        return seen
+
+    # -- locks --------------------------------------------------------------
+
+    def lock_id(self, mod: str, lockref: list) -> Optional[str]:
+        """Canonical project-wide lock id for a summary lockref, or None
+        when the reference does not resolve to a known lock object."""
+        tag = lockref[0]
+        s = self.modules.get(mod)
+        if s is None:
+            return None
+        if tag == "mod":
+            name = lockref[1]
+            return f"{mod}.{name}" if name in s.locks else None
+        if tag == "self":
+            _, cls, attr = lockref
+            if attr in s.class_locks.get(cls, {}):
+                return f"{mod}.{cls}.{attr}"
+            return None
+        if tag == "ext":
+            _, alias, attr = lockref
+            target = s.bindings.get(alias)
+            if target and target in self.modules and \
+                    attr in self.modules[target].locks:
+                return f"{target}.{attr}"
+            return None
+        return None
+
+    # -- import graph -------------------------------------------------------
+
+    def import_edges(self) -> List[Tuple[str, str, int]]:
+        """(src module, dst module, line) for module-scope imports between
+        project modules. ``from pkg import name`` targets ``pkg.name``
+        when that is itself a project module, else ``pkg``."""
+        edges: Dict[Tuple[str, str], int] = {}
+        for mod in sorted(self.modules):
+            s = self.modules[mod]
+            for imp in s.module_imports:
+                targets: List[str] = []
+                base = imp["module"]
+                if imp["names"] is None:
+                    t = self._project_prefix(base)
+                    if t:
+                        targets.append(t)
+                else:
+                    for name in imp["names"]:
+                        child = f"{base}.{name}"
+                        if child in self.modules:
+                            targets.append(child)
+                        else:
+                            t = self._project_prefix(base)
+                            if t:
+                                targets.append(t)
+                for t in targets:
+                    if t != mod:
+                        edges.setdefault((mod, t), imp["line"])
+        return sorted((a, b, line) for (a, b), line in edges.items())
+
+    def _project_prefix(self, dotted: str) -> Optional[str]:
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            cand = ".".join(parts[:i])
+            if cand in self.modules:
+                return cand
+        return None
+
+
+def strongly_connected(nodes: Iterable[str],
+                       edges: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan; returns SCCs with >= 2 nodes, each sorted, the
+    list sorted by first element (deterministic)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for start in sorted(nodes):
+        if start in index:
+            continue
+        work: List[Tuple[str, int]] = [(start, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+            advanced = False
+            succs = sorted(edges.get(v, ()))
+            while pi < len(succs):
+                w = succs[pi]
+                pi += 1
+                if w not in index:
+                    work[-1] = (v, pi)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+    return sorted(sccs)

@@ -1,0 +1,466 @@
+"""Per-module summaries: everything the whole-program rules need, as JSON.
+
+``build_summary`` walks one parsed module and extracts
+
+* ``bindings``      — local name -> dotted import target (``import x.y as
+  z``, ``from .m import f``; function-body imports included on purpose:
+  the deferred-import idiom that breaks circular imports still creates
+  call edges the trace/host-sync reachability must follow);
+* ``module_imports``— module-scope import statements only (these run at
+  import time and are what the layering/cycle rule constrains);
+* ``functions``     — one record per def (methods carry their class):
+  outgoing calls, impure reads, host-sync sites, and the lock structure
+  (acquisitions, lexical lock nesting, calls made while holding a lock);
+* ``locks`` / ``class_locks`` — module-level and ``self.<attr>`` lock
+  objects with their ctor kind (Lock / RLock / Condition);
+* ``trace_roots``   — the same root detection as the per-file
+  trace-impurity rule (jax.jit, ``apply(name, fn, …)``, config extras,
+  names called from inline traced lambdas);
+* ``pragmas``       — the file's ``# graft-lint:`` suppression tables, so
+  cached summaries can suppress project-rule findings without re-reading
+  the file.
+
+Everything is plain lists/dicts/strings → ``to_dict``/``from_dict`` are
+trivial and the summary is exactly what ``SummaryCache`` persists.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..astutil import (IMPURE_MODULES, IMPURE_PREFIXES, dotted_name,
+                       lock_ctor_in, mentions_device_value,
+                       module_lock_defs, module_mutable_globals,
+                       path_matches, snippet)
+
+#: bump when the extracted shape changes so cached summaries self-invalidate
+SUMMARY_FORMAT = 1
+
+_NP_CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+
+# lock references are stored as small tagged lists (JSON-friendly):
+#   ["mod", name]         — module-level lock of this module
+#   ["self", Class, attr] — instance lock of a class in this module
+#   ["ext", alias, attr]  — <import alias>.<attr>, resolved at project time
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for a root-relative posix path."""
+    p = path[:-3] if path.endswith(".py") else path
+    parts = [x for x in p.split("/") if x not in (".", "")]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _resolve_relative(module: str, is_pkg: bool, level: int,
+                      target: str) -> str:
+    parts = module.split(".")
+    if not is_pkg:
+        parts = parts[:-1]
+    drop = level - 1
+    if drop:
+        parts = parts[:-drop] if drop <= len(parts) else []
+    base = ".".join(parts)
+    if target:
+        base = f"{base}.{target}" if base else target
+    return base
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str                     # "Class.method" / "fn" / "fn.inner"
+    name: str                         # simple name
+    cls: Optional[str]                # enclosing class simple name
+    line: int
+    calls: List[Tuple[str, int]] = field(default_factory=list)
+    impure: List[Tuple[str, str, int]] = field(default_factory=list)
+    host_syncs: List[Tuple[str, int]] = field(default_factory=list)
+    acquires: List[Tuple[list, int]] = field(default_factory=list)
+    nest_edges: List[Tuple[list, list, int]] = field(default_factory=list)
+    calls_under_lock: List[Tuple[list, str, int]] = field(
+        default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"q": self.qualname, "n": self.name, "c": self.cls,
+                "l": self.line, "calls": [list(x) for x in self.calls],
+                "impure": [list(x) for x in self.impure],
+                "sync": [list(x) for x in self.host_syncs],
+                "acq": [list(x) for x in self.acquires],
+                "nest": [list(x) for x in self.nest_edges],
+                "cul": [list(x) for x in self.calls_under_lock]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FunctionInfo":
+        return cls(qualname=d["q"], name=d["n"], cls=d["c"], line=d["l"],
+                   calls=[tuple(x) for x in d["calls"]],
+                   impure=[tuple(x) for x in d["impure"]],
+                   host_syncs=[tuple(x) for x in d["sync"]],
+                   acquires=[(list(x[0]), x[1]) for x in d["acq"]],
+                   nest_edges=[(list(x[0]), list(x[1]), x[2])
+                               for x in d["nest"]],
+                   calls_under_lock=[(list(x[0]), x[1], x[2])
+                                     for x in d["cul"]])
+
+
+@dataclass
+class ModuleSummary:
+    path: str
+    module: str
+    bindings: Dict[str, str] = field(default_factory=dict)
+    module_imports: List[Dict[str, Any]] = field(default_factory=list)
+    functions: List[FunctionInfo] = field(default_factory=list)
+    mutable_globals: List[str] = field(default_factory=list)
+    locks: Dict[str, str] = field(default_factory=dict)
+    class_locks: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    trace_roots: List[str] = field(default_factory=list)
+    pragmas: Dict[str, List[str]] = field(default_factory=dict)  # line -> names
+    file_pragmas: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"path": self.path, "module": self.module,
+                "bindings": self.bindings,
+                "module_imports": self.module_imports,
+                "functions": [f.to_dict() for f in self.functions],
+                "mutable_globals": self.mutable_globals,
+                "locks": self.locks, "class_locks": self.class_locks,
+                "trace_roots": self.trace_roots,
+                "pragmas": self.pragmas,
+                "file_pragmas": self.file_pragmas}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ModuleSummary":
+        return cls(path=d["path"], module=d["module"],
+                   bindings=dict(d["bindings"]),
+                   module_imports=list(d["module_imports"]),
+                   functions=[FunctionInfo.from_dict(x)
+                              for x in d["functions"]],
+                   mutable_globals=list(d["mutable_globals"]),
+                   locks=dict(d["locks"]),
+                   class_locks={k: dict(v)
+                                for k, v in d["class_locks"].items()},
+                   trace_roots=list(d["trace_roots"]),
+                   pragmas={k: list(v) for k, v in d["pragmas"].items()},
+                   file_pragmas=list(d["file_pragmas"]))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        names = set(self.pragmas.get(str(line), ())) | set(self.file_pragmas)
+        return rule in names or "all" in names
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+
+def _collect_bindings(tree: ast.Module, module: str, is_pkg: bool
+                      ) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    # `import a.b.c` binds `a` — attribute chains resolve
+                    # through the qualified walk at project time
+                    out[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            base = _resolve_relative(module, is_pkg, node.level,
+                                     node.module or "") \
+                if node.level else (node.module or "")
+            if not base:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{base}.{a.name}"
+    return out
+
+
+def _module_scope_imports(tree: ast.Module, module: str, is_pkg: bool
+                          ) -> List[Dict[str, Any]]:
+    """Import statements that execute at import time: top-level statements
+    plus those nested in top-level If/Try/With (version guards), but NOT
+    inside function or class bodies."""
+    out: List[Dict[str, Any]] = []
+    stack: List[ast.AST] = list(tree.body)
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out.append({"module": a.name, "names": None,
+                            "line": node.lineno})
+        elif isinstance(node, ast.ImportFrom):
+            base = _resolve_relative(module, is_pkg, node.level,
+                                     node.module or "") \
+                if node.level else (node.module or "")
+            if base:
+                out.append({"module": base,
+                            "names": [a.name for a in node.names],
+                            "line": node.lineno})
+        elif isinstance(node, (ast.If, ast.Try, ast.With)):
+            for fld in ("body", "orelse", "finalbody", "handlers"):
+                for sub in getattr(node, fld, []) or []:
+                    stack.append(sub)
+        elif isinstance(node, ast.ExceptHandler):
+            stack.extend(node.body)
+    return out
+
+
+def _class_lock_table(tree: ast.Module) -> Dict[str, Dict[str, str]]:
+    out: Dict[str, Dict[str, str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        d: Dict[str, str] = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        kind = lock_ctor_in(sub.value)
+                        if kind:
+                            d[t.attr] = kind
+        if d:
+            out.setdefault(node.name, {}).update(d)
+    return out
+
+
+def _trace_root_names(tree: ast.Module, path: str,
+                      config: Dict[str, Any]) -> Set[str]:
+    """Same pragmatics as the per-file trace-impurity rule, collapsed to a
+    set of simple names (names called from inline traced lambdas become
+    roots themselves)."""
+    names: Set[str] = set()
+    lambdas: List[ast.Lambda] = []
+
+    def grab(arg):
+        if isinstance(arg, ast.Name):
+            names.add(arg.id)
+        elif isinstance(arg, ast.Lambda):
+            lambdas.append(arg)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute) and fn.attr == "jit") or \
+                    (isinstance(fn, ast.Name) and fn.id == "jit"):
+                if node.args:
+                    grab(node.args[0])
+            elif isinstance(fn, ast.Name) and fn.id == "apply" \
+                    and len(node.args) >= 2:
+                grab(node.args[1])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if "jax.jit" in ast.unparse(dec):
+                    names.add(node.name)
+    for lam in lambdas:
+        for sub in ast.walk(lam):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                names.add(sub.func.id)
+    for cfg_path, extra in config.get("trace_roots", {}).items():
+        if path_matches(path, [cfg_path]):
+            names.update(extra)
+    return names
+
+
+def _walk_functions(tree: ast.Module):
+    """Yield (qualname, simple name, class, node) for every def."""
+    out = []
+
+    def rec(node, cls, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                rec(child, child.name, prefix + child.name + ".")
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((prefix + child.name, child.name, cls, child))
+                rec(child, cls, prefix + child.name + ".")
+
+    rec(tree, None, "")
+    return out
+
+
+def _own_nodes(fn: ast.AST):
+    """Nodes of ``fn``'s body excluding nested def/class bodies (those are
+    summarized as their own functions). Lambdas stay — they execute inline."""
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            yield child
+            yield from rec(child)
+    yield from rec(fn)
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            out.add(a.arg)
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+            out.add(sub.id)
+    return out
+
+
+def _scan_function(fn: ast.AST, cls: Optional[str],
+                   mutables: Set[str], bindings: Dict[str, str],
+                   module_locks: Dict[str, str],
+                   class_locks: Dict[str, Dict[str, str]]) -> Dict[str, list]:
+    calls: List[Tuple[str, int]] = []
+    seen_calls: Set[str] = set()
+    impure: List[Tuple[str, str, int]] = []
+    seen_impure: Set[Tuple[str, str]] = set()
+    host_syncs: List[Tuple[str, int]] = []
+    sync_lines: Set[int] = set()
+    locals_ = _local_names(fn)
+
+    def add_impure(kind, detail, line):
+        if (kind, detail) not in seen_impure:
+            seen_impure.add((kind, detail))
+            impure.append((kind, detail, line))
+
+    def add_sync(node, what):
+        if node.lineno not in sync_lines:
+            sync_lines.add(node.lineno)
+            host_syncs.append((what, node.lineno))
+
+    for sub in _own_nodes(fn):
+        if isinstance(sub, ast.Call):
+            dn = dotted_name(sub.func)
+            if dn and dn not in seen_calls:
+                seen_calls.add(dn)
+                calls.append((dn, sub.lineno))
+            base = dn.split(".")[0] if dn else ""
+            if "." in dn and base in IMPURE_MODULES:
+                add_impure("call", dn, sub.lineno)
+            elif dn.startswith(IMPURE_PREFIXES) or dn == "os.getenv":
+                add_impure("call", dn, sub.lineno)
+            # host-sync shapes (anywhere in the body, not only loops —
+            # the fast-path rule decides whether the location matters)
+            f = sub.func
+            if isinstance(f, ast.Attribute) and not sub.args and \
+                    f.attr in ("item", "numpy"):
+                add_sync(sub, f"`{snippet(sub)}`")
+            elif isinstance(f, ast.Name) and f.id in ("bool", "float",
+                                                      "int") and \
+                    len(sub.args) == 1:
+                arg = sub.args[0]
+                if mentions_device_value(arg) or (
+                        f.id == "bool" and isinstance(arg, ast.Call)
+                        and isinstance(arg.func, ast.Attribute)
+                        and arg.func.attr in ("all", "any")):
+                    add_sync(sub, f"`{snippet(sub)}`")
+            elif dn in _NP_CONVERTERS and sub.args and \
+                    mentions_device_value(sub.args[0]):
+                add_sync(sub, f"`{snippet(sub)}`")
+        elif isinstance(sub, ast.Attribute):
+            dn = dotted_name(sub)
+            if dn == "os.environ":
+                add_impure("environ", "os.environ", sub.lineno)
+            elif isinstance(sub.ctx, ast.Load) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id in bindings and \
+                    sub.value.id not in locals_:
+                # candidate cross-module global read; the project resolves
+                # whether the target is a mutable module global
+                add_impure("attr", f"{sub.value.id}.{sub.attr}", sub.lineno)
+        elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                and sub.id in mutables and sub.id not in locals_:
+            add_impure("global", sub.id, sub.lineno)
+
+    # lock structure: recursive walk tracking the held-lock stack
+    acquires: List[Tuple[list, int]] = []
+    nest_edges: List[Tuple[list, list, int]] = []
+    calls_under_lock: List[Tuple[list, str, int]] = []
+
+    def lockref(expr):
+        if isinstance(expr, ast.Name):
+            if expr.id in module_locks:
+                return ["mod", expr.id]
+        elif isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base in ("self", "cls") and cls and \
+                    attr in class_locks.get(cls, {}):
+                return ["self", cls, attr]
+            if base in bindings:
+                return ["ext", base, attr]
+        return None
+
+    def rec(node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new = held
+            for item in node.items:
+                lr = lockref(item.context_expr)
+                if lr is not None:
+                    line = item.context_expr.lineno
+                    acquires.append((lr, line))
+                    for h in new:
+                        nest_edges.append((h, lr, line))
+                    new = new + [lr]
+            for child in node.body:
+                rec(child, new)
+            return
+        if isinstance(node, ast.Call) and held:
+            dn = dotted_name(node.func)
+            if dn:
+                for h in held:
+                    calls_under_lock.append((h, dn, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            rec(child, held)
+
+    for child in ast.iter_child_nodes(fn):
+        rec(child, [])
+
+    return {"calls": calls, "impure": impure, "host_syncs": host_syncs,
+            "acquires": acquires, "nest_edges": nest_edges,
+            "calls_under_lock": calls_under_lock}
+
+
+def build_summary(path: str, tree: ast.Module, lines: List[str],
+                  config: Dict[str, Any]) -> ModuleSummary:
+    """Distill one parsed module into its JSON-serializable summary."""
+    # imported here (not at module top) to avoid an import cycle:
+    # engine -> wholeprogram (at run time) -> engine (pragma parsing)
+    from ..engine import _pragma_tables
+
+    is_pkg = path.endswith("__init__.py")
+    module = module_name_for(path)
+    bindings = _collect_bindings(tree, module, is_pkg)
+    mutables = module_mutable_globals(tree)
+    module_locks = module_lock_defs(tree)
+    class_locks = _class_lock_table(tree)
+    per_line, file_level = _pragma_tables(lines)
+
+    functions: List[FunctionInfo] = []
+    for qualname, name, cls, node in _walk_functions(tree):
+        data = _scan_function(node, cls, mutables, bindings, module_locks,
+                              class_locks)
+        functions.append(FunctionInfo(
+            qualname=qualname, name=name, cls=cls, line=node.lineno,
+            calls=data["calls"], impure=data["impure"],
+            host_syncs=data["host_syncs"], acquires=data["acquires"],
+            nest_edges=data["nest_edges"],
+            calls_under_lock=data["calls_under_lock"]))
+
+    return ModuleSummary(
+        path=path, module=module, bindings=bindings,
+        module_imports=_module_scope_imports(tree, module, is_pkg),
+        functions=functions,
+        mutable_globals=sorted(mutables),
+        locks=module_locks, class_locks=class_locks,
+        trace_roots=sorted(_trace_root_names(tree, path, config)),
+        pragmas={str(k): sorted(v) for k, v in per_line.items()},
+        file_pragmas=sorted(file_level))
