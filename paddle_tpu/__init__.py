@@ -76,6 +76,7 @@ from . import vision  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import observability  # noqa: F401,E402
+from . import resilience  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .static import enable_static, disable_static  # noqa: F401,E402

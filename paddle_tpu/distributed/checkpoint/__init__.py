@@ -1,4 +1,4 @@
-"""Distributed checkpoint with reshard-on-load.
+"""Distributed checkpoint with reshard-on-load and crash-safe commits.
 
 Parity surface: python/paddle/distributed/checkpoint/
 (``save_state_dict``/``load_state_dict`` — per-rank shard files + metadata
@@ -13,22 +13,65 @@ sharding as a restore arg, so orbax reads exactly the shards the new
 topology needs — reshard-on-load across different meshes (e.g. save on
 (dp=2, mp=4), load on (dp=4, mp=2)) is exercised by
 tests/test_distributed_checkpoint.py.
+
+Crash safety (paddle_tpu.resilience integration):
+
+* every bookkeeping file is written atomically — unique tmp name, fsync,
+  ``os.replace``, directory fsync — so a kill mid-write never leaves a
+  half-written ``metadata.json`` masquerading as a real one;
+* a save COMMITS by writing ``manifest.json`` LAST: per-array CRC32
+  checksums (``null`` for arrays not fully addressable by this process —
+  multi-host shards can't be checksummed without the gather this module
+  exists to avoid) plus shapes/dtypes. A directory without a committed
+  manifest is an interrupted save, never a loadable checkpoint;
+* after the manifest commits, ``latest`` / ``latest.prev`` pointer files
+  in the checkpoint's PARENT directory record the last two good
+  checkpoints;
+* ``load_state_dict`` verifies the manifest + checksums and, on a corrupt
+  or interrupted checkpoint, falls back through the pointer chain to the
+  last-good checkpoint (counted in ``checkpoint.fallbacks_total``,
+  logged). A kill injected mid-save (``FaultSchedule.kill`` at the
+  ``checkpoint.write``/``checkpoint.commit`` sites) therefore leaves the
+  previous checkpoint loadable — proven by tests/test_resilience.py.
+  ``verify=False`` skips verification (and fallback) for pre-manifest
+  legacy directories.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import logging
 import os
 import threading
-from typing import Any, Dict, List, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
 from ...core.tensor import Tensor, to_tensor
+from ... import observability as _obs
+from ...resilience import faults as _faults
 
 __all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict",
-           "wait_async_saves"]
+           "wait_async_saves", "CheckpointCorruptError", "verify_checkpoint"]
+
+_log = logging.getLogger(__name__)
+
+MANIFEST_VERSION = 1
+_MANIFEST = "manifest.json"
+_LATEST = "latest"
+_LATEST_PREV = "latest.prev"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Raised when a checkpoint fails verification and no last-good
+    fallback can be loaded."""
+
+
+class _CorruptCheckpoint(Exception):
+    """Internal: this candidate failed verification, try the next."""
 
 
 def _spec_of(t: Tensor):
@@ -43,7 +86,141 @@ def _spec_of(t: Tensor):
 
 
 _ASYNC: List[Any] = []  # pending (ckptr | thread) handles
+# pointer-rotation ordering: async commits finish in arbitrary order, and
+# a slow OLD save completing after a newer one must not roll ``latest``
+# back; every save takes a sequence number at entry and the rotation
+# skips stale ones. _LOCK also guards the _ASYNC handle list.
+_LOCK = threading.Lock()
+_SAVE_SEQ = itertools.count(1)
+_last_committed_seq = 0
 
+
+# ---------------------------------------------------------------------------
+# atomic file plumbing + manifest / pointer helpers
+# ---------------------------------------------------------------------------
+
+def _fsync_dir(dirname: str) -> None:
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return  # platform without directory fds: rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # fs without dir fsync (e.g. some network mounts): best effort
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + ``os.replace``: readers see the old file or the new
+    file, never a torn write. The tmp name is pid-unique because
+    multi-process saves write the same bookkeeping files concurrently
+    (same content — last replace wins)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def _crc_of(arr) -> Optional[int]:
+    """CRC32 of the array's logical row-major bytes; None when this
+    process cannot see the whole array (multi-host shards) or the value
+    is not host-copyable (tracer) — unverifiable, recorded as such."""
+    try:
+        if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            return None
+        host = np.ascontiguousarray(np.asarray(arr))
+    except Exception:
+        return None
+    return zlib.crc32(host.tobytes()) & 0xFFFFFFFF
+
+
+def _pointer_paths(path: str) -> Tuple[str, str, str]:
+    norm = os.path.normpath(os.path.abspath(path))
+    parent = os.path.dirname(norm)
+    return (os.path.join(parent, _LATEST),
+            os.path.join(parent, _LATEST_PREV),
+            os.path.basename(norm))
+
+
+def _read_pointer(p: str) -> Optional[str]:
+    try:
+        with open(p, "rb") as f:
+            name = f.read().decode().strip()
+        return name or None
+    except OSError:
+        return None  # pointer absent: no checkpoint committed here yet
+
+
+def _update_latest(path: str, seq: int) -> None:
+    """Rotate the last-good pointers after a COMMITTED save: ``latest``
+    names this checkpoint, ``latest.prev`` whatever ``latest`` named
+    before (the fallback when the newest one is later found corrupt).
+    ``seq`` orders commits within this process: an older async save
+    finishing late is skipped instead of rolling ``latest`` backward."""
+    global _last_committed_seq
+    latest_p, prev_p, name = _pointer_paths(path)
+    with _LOCK:
+        if seq < _last_committed_seq:
+            _log.warning(
+                "checkpoint: save of %s (seq %d) committed after a newer "
+                "save (seq %d); leaving the latest pointer alone",
+                path, seq, _last_committed_seq)
+            return
+        _last_committed_seq = seq
+        old = _read_pointer(latest_p)
+        if old and old != name:
+            _atomic_write(prev_p, old.encode())
+        _atomic_write(latest_p, name.encode())
+
+
+def _last_good_candidates(path: str) -> List[str]:
+    """Fallback chain for ``path``: the pointer targets in the same parent
+    directory, newest first, excluding ``path`` itself."""
+    latest_p, prev_p, name = _pointer_paths(path)
+    parent = os.path.dirname(os.path.normpath(os.path.abspath(path)))
+    out: List[str] = []
+    for ptr in (latest_p, prev_p):
+        target = _read_pointer(ptr)
+        if target and target != name:
+            cand = os.path.join(parent, target)
+            if os.path.isdir(cand) and cand not in out:
+                out.append(cand)
+    return out
+
+
+def _read_manifest(path: str) -> Dict[str, Any]:
+    mpath = os.path.join(path, _MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        raise _CorruptCheckpoint(
+            "no committed manifest.json (interrupted or pre-manifest "
+            f"save): {e}") from e
+    except (ValueError, json.JSONDecodeError) as e:
+        raise _CorruptCheckpoint(f"unparsable manifest.json: {e}") from e
+    if not isinstance(manifest.get("arrays"), dict):
+        raise _CorruptCheckpoint("manifest.json missing 'arrays' table")
+    return manifest
+
+
+def verify_checkpoint(path: str) -> Dict[str, Any]:
+    """Committed-manifest probe (no array IO): returns the manifest or
+    raises :class:`CheckpointCorruptError`. Harness/tooling surface."""
+    try:
+        return _read_manifest(path)
+    except _CorruptCheckpoint as e:
+        raise CheckpointCorruptError(str(e)) from e
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
 
 def _globalize_host_local(arrays: Dict[str, Any]) -> None:
     """Multi-process saves can only serialize GLOBAL arrays. Host-local
@@ -83,7 +260,16 @@ def _globalize_host_local(arrays: Dict[str, Any]) -> None:
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, async_save: bool = False) -> None:
+    _faults.fault_point("checkpoint.save")
+    _obs.inc("checkpoint.saves_total")
     os.makedirs(path, exist_ok=True)
+    # the directory is UNCOMMITTED for the whole write window: a stale
+    # manifest from an earlier save into the same path must not vouch for
+    # the new arrays if this save dies partway
+    try:
+        os.remove(os.path.join(path, _MANIFEST))
+    except OSError:
+        pass  # first save into this directory: nothing to invalidate
     flat = _flatten("", state_dict)
     meta = {}
     arrays: Dict[str, Any] = {}
@@ -98,8 +284,24 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         else:
             meta[k] = {"value": v}
     _globalize_host_local(arrays)
-    with open(os.path.join(path, "metadata.json"), "w") as f:
-        json.dump(meta, f)
+    _atomic_write(os.path.join(path, "metadata.json"),
+                  json.dumps(meta).encode())
+    _faults.fault_point("checkpoint.write")
+    seq = next(_SAVE_SEQ)
+
+    def _commit(fmt: str) -> None:
+        # checksums are taken at commit time from the arrays as handed to
+        # the writer (jax.Arrays are immutable, so async completion
+        # threads compute them off the training thread), one at a time —
+        # a transient host copy per array, never the whole tree at once;
+        # unaddressable shards record null
+        entries = {k: {"crc32": _crc_of(a), "dtype": str(a.dtype),
+                       "shape": list(a.shape)} for k, a in arrays.items()}
+        _faults.fault_point("checkpoint.commit")
+        _atomic_write(os.path.join(path, _MANIFEST), json.dumps(
+            {"version": MANIFEST_VERSION, "format": fmt,
+             "arrays": entries}).encode())
+        _update_latest(path, seq)
 
     try:
         import orbax.checkpoint as ocp
@@ -110,28 +312,55 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         if async_save:
             ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
             ckptr.save(os.path.join(path, "arrays"), arrays, force=True)
-            _ASYNC.append(ckptr)
+
+            def _wait_and_commit():
+                ckptr.wait_until_finished()
+                try:
+                    ckptr.close()
+                except Exception:
+                    pass  # double-close of a finished async handle is benign
+                _commit("orbax")
+
+            t = threading.Thread(target=_wait_and_commit, daemon=True)
+            t.start()
+            with _LOCK:
+                _ASYNC.append(t)
         else:
             ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).save(
                 os.path.join(path, "arrays"), arrays, force=True)
+            _commit("orbax")
         return
 
     # fallback without orbax: single-file npz (full host gather — small
-    # states only; orbax is the supported path)
+    # states only; orbax is the supported path), written atomically so a
+    # kill mid-write leaves no half npz behind the committed name
     def _write():
-        np.savez(os.path.join(path, "arrays.npz"),
-                 **{k: np.asarray(a) for k, a in arrays.items()})
+        final = os.path.join(path, "arrays.npz")
+        tmp = f"{final}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **{k: np.asarray(a) for k, a in arrays.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        _fsync_dir(path)
+        _commit("npz")
 
     if async_save:
         t = threading.Thread(target=_write, daemon=True)
         t.start()
-        _ASYNC.append(t)
+        with _LOCK:
+            _ASYNC.append(t)
     else:
         _write()
 
 
 def wait_async_saves() -> None:
-    for h in _ASYNC:
+    # snapshot under the lock, join OUTSIDE it: completion threads take
+    # _LOCK themselves to rotate the latest pointer
+    with _LOCK:
+        pending = list(_ASYNC)
+        _ASYNC.clear()
+    for h in pending:
         if hasattr(h, "wait_until_finished"):
             h.wait_until_finished()
             try:
@@ -140,12 +369,15 @@ def wait_async_saves() -> None:
                 pass  # double-close of a finished async handle is benign
         else:
             h.join()
-    _ASYNC.clear()
 
 
 def async_save_state_dict(state_dict, path, **kw):
     return save_state_dict(state_dict, path, async_save=True, **kw)
 
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
 
 def _target_sharding(t: Tensor):
     """The destination's concrete sharding (NamedSharding for mesh-placed
@@ -161,33 +393,20 @@ def _target_sharding(t: Tensor):
     return None
 
 
-def load_state_dict(state_dict: Dict[str, Any], path: str,
-                    process_group=None, coordinator_rank: int = 0,
-                    unique_id=None, offload: bool = False) -> None:
-    """Load INTO ``state_dict``'s tensors (paddle semantics), resharding to
-    each destination tensor's current placement."""
-    with open(os.path.join(path, "metadata.json")) as f:
-        meta = json.load(f)
-    flat = {k: t for k, t in _flatten("", state_dict).items()
-            if isinstance(t, Tensor)}
-    for k in flat:
-        if k not in meta or "value" in meta.get(k, {}):
-            raise KeyError(f"checkpoint at {path} has no entry {k!r}")
-        src_shape = meta[k]["shape"]
-        if list(src_shape) != list(flat[k]._data.shape):
-            raise ValueError(
-                f"shape mismatch for {k}: checkpoint {src_shape} vs target "
-                f"{tuple(flat[k]._data.shape)}")
-
-    arrays = None
+def _read_arrays(path: str, flat: Dict[str, Tensor], meta: Dict[str, Any]
+                 ) -> Dict[str, Any]:
+    """Restore exactly the target tree's arrays; any IO/parse failure in
+    the payload is a verification failure (corrupt candidate), not a user
+    error."""
     arrays_dir = os.path.join(path, "arrays")
     if os.path.isdir(arrays_dir):
         import orbax.checkpoint as ocp
-        # PARTIAL restore: only the target tree's keys are read (item template
-        # + transforms={} makes orbax skip the rest) — a model-only load from
-        # a checkpoint that also holds optimizer m/v never materializes the
-        # optimizer state, and each restored key reads exactly the shards its
-        # destination sharding needs (reshard-on-load)
+        # PARTIAL restore: only the target tree's keys are read (item
+        # template + transforms={} makes orbax skip the rest) — a
+        # model-only load from a checkpoint that also holds optimizer m/v
+        # never materializes the optimizer state, and each restored key
+        # reads exactly the shards its destination sharding needs
+        # (reshard-on-load)
         restore_args = {}
         item = {}
         for k, t in flat.items():
@@ -203,11 +422,59 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             except TypeError:
                 item[k] = jax.ShapeDtypeStruct(
                     tuple(meta[k]["shape"]), np.dtype(meta[k]["dtype"]))
-        arrays = ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).restore(
-            arrays_dir, item=item, restore_args=restore_args, transforms={})
-    else:
+        try:
+            return ocp.Checkpointer(ocp.PyTreeCheckpointHandler()).restore(
+                arrays_dir, item=item, restore_args=restore_args,
+                transforms={})
+        except Exception as e:
+            raise _CorruptCheckpoint(
+                f"array restore failed ({type(e).__name__}: {e})") from e
+    try:
         npz = np.load(os.path.join(path, "arrays.npz"))
-        arrays = {k: npz[k] for k in npz.files}
+        return {k: npz[k] for k in npz.files}
+    except Exception as e:
+        raise _CorruptCheckpoint(
+            f"array payload unreadable ({type(e).__name__}: {e})") from e
+
+
+def _load_into(flat: Dict[str, Tensor], path: str, verify: bool) -> None:
+    try:
+        with open(os.path.join(path, "metadata.json")) as f:
+            meta = json.load(f)
+    except OSError as e:
+        raise _CorruptCheckpoint(f"metadata.json unreadable: {e}") from e
+    except (ValueError, json.JSONDecodeError) as e:
+        raise _CorruptCheckpoint(f"metadata.json unparsable: {e}") from e
+
+    # USER errors (wrong tree for this checkpoint), never fallback bait
+    for k in flat:
+        if k not in meta or "value" in meta.get(k, {}):
+            raise KeyError(f"checkpoint at {path} has no entry {k!r}")
+        src_shape = meta[k]["shape"]
+        if list(src_shape) != list(flat[k]._data.shape):
+            raise ValueError(
+                f"shape mismatch for {k}: checkpoint {src_shape} vs target "
+                f"{tuple(flat[k]._data.shape)}")
+
+    manifest = _read_manifest(path) if verify else None
+    arrays = _read_arrays(path, flat, meta)
+
+    if manifest is not None:
+        table = manifest["arrays"]
+        for k in flat:
+            ent = table.get(k)
+            if ent is None:
+                raise _CorruptCheckpoint(
+                    f"key {k!r} absent from the committed manifest")
+            want = ent.get("crc32")
+            if want is None:
+                continue  # recorded unverifiable (multi-host shard)
+            got = _crc_of(arrays[k])
+            if got is not None and got != int(want):
+                _obs.inc("checkpoint.crc_mismatches_total")
+                raise _CorruptCheckpoint(
+                    f"checksum mismatch for {k!r} "
+                    f"(manifest {int(want)}, payload {got})")
 
     for k, tgt in flat.items():
         src = arrays[k]
@@ -223,6 +490,63 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
             except Exception:
                 arr = jax.numpy.asarray(host.astype(tgt._data.dtype))
         tgt._set_data(arr)
+
+
+def load_state_dict(state_dict: Dict[str, Any], path: str,
+                    process_group=None, coordinator_rank: int = 0,
+                    unique_id=None, offload: bool = False,
+                    verify: bool = True, fallback: bool = True) -> None:
+    """Load INTO ``state_dict``'s tensors (paddle semantics), resharding to
+    each destination tensor's current placement.
+
+    With ``verify`` (default) the checkpoint must carry a committed
+    manifest and every verifiable array must match its CRC32; a candidate
+    that fails moves the load down the last-good pointer chain
+    (``fallback``), counting ``checkpoint.fallbacks_total``. Missing-key /
+    shape-mismatch errors are USER errors and always raise immediately.
+    ``verify=False`` restores the pre-manifest behavior for legacy
+    directories: no verification, no fallback, and IO failures surface
+    with their original types (``FileNotFoundError``, ...)."""
+    flat = {k: t for k, t in _flatten("", state_dict).items()
+            if isinstance(t, Tensor)}
+    if not verify:
+        # legacy path: no manifest check, no fallback — and the original
+        # error surface (FileNotFoundError etc.), not a corruption wrap
+        try:
+            _load_into(flat, path, verify=False)
+        except _CorruptCheckpoint as e:
+            raise e.__cause__ if e.__cause__ is not None \
+                else CheckpointCorruptError(str(e))
+        _obs.inc("checkpoint.loads_total")
+        return
+    candidates = [path]
+    if fallback:
+        candidates += _last_good_candidates(path)
+    last_reason: Optional[str] = None
+    for i, p in enumerate(candidates):
+        try:
+            _load_into(flat, p, verify=True)
+        except _CorruptCheckpoint as e:
+            _obs.inc("checkpoint.verification_failures_total")
+            more = i + 1 < len(candidates)
+            if more:
+                # counts actual FALLBACKS (moving to the next candidate),
+                # not bare verification failures — alerting keys on this
+                _obs.inc("checkpoint.fallbacks_total")
+            _log.error(
+                "checkpoint: %s failed verification (%s)%s", p, e,
+                "; falling back to last-good" if more else "")
+            last_reason = f"{p}: {e}"
+            continue
+        if i > 0:
+            _log.warning(
+                "checkpoint: restored last-good %s after %s failed "
+                "verification (checksums verified)", p, path)
+        _obs.inc("checkpoint.loads_total")
+        return
+    raise CheckpointCorruptError(
+        f"no loadable checkpoint ({last_reason}); for a legacy pre-manifest "
+        "directory pass verify=False")
 
 
 def _flatten(prefix: str, obj) -> Dict[str, Any]:

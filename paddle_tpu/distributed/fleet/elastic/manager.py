@@ -17,6 +17,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .... import observability as _obs
+from ....resilience import jitter_sleep as _jitter_sleep
 
 __all__ = [
     "ElasticLevel", "ElasticStatus", "ElasticManager", "enable_elastic",
@@ -73,7 +74,9 @@ def start_worker_heartbeat(rank: Optional[int] = None,
                 store.set(f"elastic/beat/{rank}", str(time.time()))
             except Exception:
                 return  # manager gone: job is shutting down
-            time.sleep(interval)
+            # jittered (±25%): a pod of workers respawned together must
+            # not lease in phase against the manager's store forever
+            _jitter_sleep(interval)
 
     t = threading.Thread(target=beat, daemon=True,
                          name=f"elastic-heartbeat-{rank}")
@@ -221,7 +224,9 @@ class ElasticManager:
                 self._clear_beats()
                 procs = respawn(self.restarts)
                 continue
-            time.sleep(poll_interval)
+            # jittered so simultaneously-restarted node managers spread
+            # their store-health polling instead of stampeding rank 0
+            _jitter_sleep(poll_interval)
 
     def _clear_beats(self) -> None:
         """Delete (not re-seed) leases: a seeded key would falsely register a
@@ -601,7 +606,10 @@ class MultiNodeElasticAgent:
                         except Exception as e:
                             self._store_write_failed("restart topology", e)
                             # store blip: retried next tick
-            time.sleep(poll_interval)
+            # jittered: after an epoch adoption every agent's watch tick
+            # fires at the same instant; desynchronize the shared-store
+            # lease/topology reads across nodes
+            _jitter_sleep(poll_interval)
 
     def _done_epoch(self, node: int) -> int:
         try:

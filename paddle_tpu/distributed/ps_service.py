@@ -27,6 +27,8 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from .. import observability as _obs
+from .. import resilience as _resil
+from ..resilience import faults as _faults
 
 __all__ = ["PsClient", "serve_stats", "reset_server_state", "SparseTable"]
 
@@ -281,6 +283,9 @@ def _srv_push(name: str, ids_bytes: bytes, grad_bytes: bytes,
               seq: Optional[int] = None) -> bool:
     """Apply an SGD scatter-update: table[ids] -= lr * grad. Duplicate ids
     accumulate (segment-sum semantics, the reference accessor's rule)."""
+    # before the dedup/apply critical section: an injected handler fault
+    # models a server that died BEFORE applying (the client may retry)
+    _faults.fault_point("ps.handler")
     with _LOCK:
         if _seq_is_dup_locked(client_key, seq):
             return True
@@ -334,6 +339,7 @@ def _srv_push_sparse(name: str, ids_bytes: bytes, grad_bytes: bytes, n: int,
                      lr: Optional[float],
                      client_key: Optional[str] = None,
                      seq: Optional[int] = None) -> bool:
+    _faults.fault_point("ps.handler")
     with _LOCK:
         if _seq_is_dup_locked(client_key, seq):
             return True
@@ -473,42 +479,93 @@ class PsClient:
     def _call(self, server: str, fn, args):
         """rpc_sync with endpoint re-resolution + backoff on TRANSPORT
         failure only — a server-side exception (shipped back with its
-        original type) means the call executed and must not be retried."""
+        original type) means the call executed and must not be retried.
+
+        The retry schedule is the named ``ps.rpc`` :class:`RetryPolicy`
+        (jittered 0.2s→2.0s backoff; override via
+        ``PADDLE_TPU_RETRY_PS_RPC_*``) under a ``deadline_scope`` of
+        ``retry_timeout`` seconds, so the rpc layer's own dial retries
+        clamp to the same monotonic instant instead of compounding. A
+        per-server :class:`CircuitBreaker` turns a dead shard into fast
+        :class:`BreakerOpen` failures between probes — the loop treats
+        those exactly like transport failures (keep backing off until the
+        deadline), so failover semantics are unchanged."""
         import time as _time
         rpc = self._rpc()
-        deadline = _time.monotonic() + self.retry_timeout
-        delay = 0.2
+        policy = _resil.get_policy("ps.rpc", base_delay=0.2, multiplier=1.6,
+                                   max_delay=2.0, jitter=0.25)
+        breaker = _resil.breaker_for(f"ps/{server}")
         _obs.inc("ps.rpc_calls_total")
-        while True:
-            try:
-                # only SUCCESSFUL attempts land in the latency histogram —
-                # timing failed attempts would fill ps.rpc_seconds with
-                # connect-timeout durations and break count parity with
-                # ps.rpc_calls_total
-                if _obs.enabled():
-                    t0 = _time.perf_counter()
-                    result = rpc.rpc_sync(server, fn, args=args)
-                    _obs.observe("ps.rpc_seconds",
-                                 _time.perf_counter() - t0)
-                    return result
-                return rpc.rpc_sync(server, fn, args=args)
-            except rpc.RpcTransportError:
-                if _time.monotonic() >= deadline:
-                    _obs.inc("ps.rpc_failures_total")
-                    raise
-                _obs.inc("ps.rpc_retries_total")
-                _time.sleep(delay)
-                delay = min(delay * 1.6, 2.0)
+        last_transport_err: Optional[BaseException] = None
+        with _resil.deadline_scope(self.retry_timeout):
+            for attempt in policy.start():
                 try:
-                    old = rpc.get_worker_info(server)
-                    fresh = rpc.refresh_worker_info(server)
-                    # a FAILOVER is an endpoint change (respawned server
-                    # re-registered); a same-endpoint refresh is just a
-                    # retry and must not inflate the failover count
-                    if (fresh.ip, fresh.port) != (old.ip, old.port):
-                        _obs.inc("ps.rpc_failovers_total")
-                except Exception:
-                    pass  # store briefly unreachable: keep backing off
+                    breaker.before_call()
+                    _faults.fault_point("ps.call")
+                    try:
+                        # only SUCCESSFUL attempts land in the latency
+                        # histogram — timing failed attempts would fill
+                        # ps.rpc_seconds with connect-timeout durations
+                        # and break count parity with ps.rpc_calls_total
+                        if _obs.enabled():
+                            t0 = _time.perf_counter()
+                            result = rpc.rpc_sync(server, fn, args=args)
+                            _faults.fault_point("ps.reply")
+                            _obs.observe("ps.rpc_seconds",
+                                         _time.perf_counter() - t0)
+                        else:
+                            result = rpc.rpc_sync(server, fn, args=args)
+                            _faults.fault_point("ps.reply")
+                    except rpc.RpcTransportError:
+                        raise
+                    except BaseException:
+                        # server-side exception shipped back with its
+                        # original type: the endpoint EXECUTED the call —
+                        # healthy. Recording success here also frees a
+                        # half-open probe slot; without it, a probe that
+                        # hit an application error would wedge the
+                        # breaker half-open forever.
+                        breaker.record_success()
+                        raise
+                    breaker.record_success()
+                    return result
+                except (rpc.RpcTransportError, _resil.BreakerOpen) as e:
+                    if isinstance(e, rpc.RpcTransportError):
+                        breaker.record_failure()
+                        last_transport_err = e
+                    try:
+                        # backoff-sleeps, or re-raises on a spent budget;
+                        # exhaustion surfaces the last REAL transport
+                        # error (callers pin on RpcTransportError), never
+                        # a BreakerOpen short-circuit
+                        attempt.fail(last_transport_err or e)
+                    except _resil.BreakerOpen as bo:
+                        # budget spent while this call only ever saw the
+                        # breaker (opened by PREVIOUS calls): surface the
+                        # documented transport type, not a third one
+                        _obs.inc("ps.rpc_failures_total")
+                        raise rpc.RpcTransportError(
+                            f"rpc to {server} failed: retry budget spent "
+                            f"while circuit breaker open") from bo
+                    except BaseException:
+                        _obs.inc("ps.rpc_failures_total")
+                        raise
+                    _obs.inc("ps.rpc_retries_total")
+                    try:
+                        old = rpc.get_worker_info(server)
+                        fresh = rpc.refresh_worker_info(server)
+                        # a FAILOVER is an endpoint change (respawned
+                        # server re-registered); a same-endpoint refresh
+                        # is just a retry and must not inflate the
+                        # failover count
+                        if (fresh.ip, fresh.port) != (old.ip, old.port):
+                            _obs.inc("ps.rpc_failovers_total")
+                            # new address: the old failure run says
+                            # nothing about it — close the breaker so the
+                            # respawned server is probed immediately
+                            breaker.reset()
+                    except Exception:
+                        pass  # store briefly unreachable: keep backing off
 
     def create_table(self, name: str, value) -> None:
         arr = np.asarray(value)

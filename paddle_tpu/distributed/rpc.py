@@ -33,6 +33,9 @@ from collections import namedtuple
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
 
+from .. import resilience as _resil
+from ..resilience import faults as _faults
+
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos",
            "get_current_worker_info", "RpcTransportError"]
@@ -215,14 +218,39 @@ def get_current_worker_info() -> WorkerInfo:
     return _state["infos"][_state["name"]]
 
 
+def _dial(info, timeout):
+    """Connect to a peer under the ``rpc.dial`` policy: a couple of quick
+    jittered re-dials absorb transient SYN drops / listen-backlog races
+    without re-executing anything (nothing was sent yet). The caller's
+    ``timeout`` is the TOTAL dial budget — each attempt's connect timeout
+    is clamped to what remains, so ``rpc_sync(timeout=T)`` still fails by
+    ~T against a blackholed host instead of 3×T. The policy also clamps
+    to any ambient ``deadline_scope`` (e.g. the PS failover budget), so
+    dial retries never extend a caller's deadline."""
+    policy = _resil.get_policy("rpc.dial", base_delay=0.05, multiplier=2.0,
+                               max_delay=0.4, jitter=0.25, max_attempts=3)
+    total = timeout if timeout and timeout > 0 else None
+    for attempt in policy.start(deadline=total):
+        left = attempt.remaining()
+        try:
+            return socket.create_connection(
+                (info.ip, info.port),
+                timeout=None if left is None else max(0.01, left))
+        except OSError as e:
+            attempt.fail(e)  # re-raises the OSError once the budget is spent
+
+
 def _call(to: str, fn, args, kwargs, timeout):
     info = get_worker_info(to)
+    _faults.fault_point("rpc.call")
     try:
-        with socket.create_connection((info.ip, info.port),
-                                      timeout=timeout if timeout and
-                                      timeout > 0 else None) as sock:
+        with _dial(info, timeout) as sock:
             _send_msg(sock, pickle.dumps((fn, args or (), kwargs or {})))
             ok, payload = pickle.loads(_recv_msg(sock))
+        # lost-reply seam: the peer EXECUTED the call but the reply
+        # "never arrived" — retrying callers must tolerate re-execution
+        # (the PS plane does, via its seq dedup watermark)
+        _faults.fault_point("rpc.reply")
     except (ConnectionError, OSError, EOFError) as e:
         raise RpcTransportError(f"rpc to {to} failed in transport: {e}") \
             from e

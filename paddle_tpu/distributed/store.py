@@ -8,11 +8,20 @@ wire protocol (see tcp_store.cc header comment) is the fallback, and the two
 interoperate. On TPU the rendezvous role is normally played by
 ``jax.distributed.initialize``'s coordination service; TCPStore remains for
 API parity and for launcher/elastic bookkeeping that wants a plain KV store.
+
+Failure handling (PR 5): the pure-python client dials under the
+``store.connect`` :class:`~paddle_tpu.resilience.RetryPolicy` and every
+``get``/``wait``/``set`` round-trip reconnects once on a connection torn
+down mid-request (``store.reconnects_total``), so a restarted store host
+or reaped idle socket surfaces as one transparent retry instead of a raw
+socket error; ``store.connect``/``store.request`` are fault-injection
+sites for driving those paths deterministically in tests.
 """
 
 from __future__ import annotations
 
 import ctypes
+import logging
 import socket
 import socketserver
 import struct
@@ -21,6 +30,11 @@ import time
 from typing import Dict, List, Optional, Union
 
 from .. import _native
+from .. import observability as _obs
+from .. import resilience as _resil
+from ..resilience import faults as _faults
+
+_log = logging.getLogger(__name__)
 
 __all__ = ["TCPStore", "Store"]
 
@@ -126,20 +140,34 @@ class _PyServer:
 
 class _PyClient:
     def __init__(self, host: str, port: int, timeout: float):
-        deadline = time.monotonic() + timeout
-        last_err: Optional[Exception] = None
-        while True:
+        self._host, self._port = host, port
+        self._timeout = timeout
+        self._mu = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._connect(timeout)
+
+    def _connect(self, timeout: float) -> None:
+        """Dial (or re-dial) the store under the ``store.connect`` policy
+        (jittered 50ms→500ms backoff, ``PADDLE_TPU_RETRY_STORE_CONNECT_*``
+        overrides) for up to ``timeout`` seconds."""
+        policy = _resil.get_policy("store.connect", base_delay=0.05,
+                                   multiplier=1.6, max_delay=0.5,
+                                   jitter=0.25)
+        for attempt in policy.start(deadline=timeout):
             try:
-                self._sock = socket.create_connection((host, port), timeout=5)
+                _faults.fault_point("store.connect")
+                sock = socket.create_connection((self._host, self._port),
+                                                timeout=5)
                 break
             except OSError as e:
-                last_err = e
-                if time.monotonic() >= deadline:
+                try:
+                    attempt.fail(e)
+                except OSError as last:
                     raise ConnectionError(
-                        f"TCPStore connect to {host}:{port} failed") from last_err
-                time.sleep(0.05)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._mu = threading.Lock()
+                        f"TCPStore connect to {self._host}:{self._port} "
+                        f"failed") from last
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
 
     def _read_full(self, n: int) -> bytes:
         buf = b""
@@ -151,13 +179,39 @@ class _PyClient:
         return buf
 
     def request(self, op: int, key: bytes, val: bytes) -> tuple:
+        """One wire round-trip; reconnects ONCE on a connection torn down
+        mid-request (server restarted / idle socket reaped) instead of
+        surfacing the raw socket error to callers. CAVEAT: the request is
+        re-sent after reconnecting, so a non-idempotent ``add`` whose
+        first send reached a server that then answered into the dead
+        socket could double-apply — acceptable for rendezvous counters
+        where the realistic failure is the server dying (state gone)
+        rather than the lone socket."""
+        msg = (struct.pack("<BI", op, len(key)) + key +
+               struct.pack("<Q", len(val)) + val)
         with self._mu:
-            self._sock.sendall(struct.pack("<BI", op, len(key)) + key +
-                               struct.pack("<Q", len(val)) + val)
-            self._sock.settimeout(None)
-            status, vlen = struct.unpack("<BQ", self._read_full(9))
-            out = self._read_full(vlen) if vlen else b""
-        return status, out
+            for attempt_no in (1, 2):
+                try:
+                    _faults.fault_point("store.request")
+                    self._sock.sendall(msg)
+                    self._sock.settimeout(None)
+                    status, vlen = struct.unpack("<BQ", self._read_full(9))
+                    out = self._read_full(vlen) if vlen else b""
+                    return status, out
+                except (ConnectionError, BrokenPipeError) as e:
+                    # ConnectionError covers ConnectionResetError and the
+                    # clean-EOF "connection closed" raise in _read_full
+                    if attempt_no == 2:
+                        raise
+                    _obs.inc("store.reconnects_total")
+                    _log.warning(
+                        "TCPStore: connection lost mid-request (%s: %s); "
+                        "reconnecting once", type(e).__name__, e)
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass  # half-dead socket: close is best-effort
+                    self._connect(self._timeout)
 
     def close(self) -> None:
         try:

@@ -1,0 +1,47 @@
+"""``paddle_tpu.resilience`` — unified failure handling for the framework.
+
+Production TPU jobs fail in boring, recurring ways — a PS reply lost on
+the wire, a rendezvous store socket reset, a worker preempted mid-
+checkpoint — and before this layer every subsystem hand-rolled its own
+recovery idiom (private backoff loops, fixed sleeps, ad-hoc reconnects).
+This package centralizes the three pieces the ROADMAP's
+"as many scenarios as you can imagine" goal needs:
+
+* :mod:`~paddle_tpu.resilience.policy` — named :class:`RetryPolicy`
+  objects (jittered exponential backoff, attempt caps, monotonic
+  deadlines that propagate through nested calls via
+  :class:`deadline_scope`), registry + ``PADDLE_TPU_RETRY_*`` env
+  overrides, and :func:`jitter_sleep` for poll loops;
+* :mod:`~paddle_tpu.resilience.breaker` — per-endpoint
+  :class:`CircuitBreaker` (closed → open → half-open with cooldown) so a
+  dead peer costs one fast :class:`BreakerOpen` instead of a connect
+  timeout per attempt;
+* :mod:`~paddle_tpu.resilience.faults` — deterministic
+  :class:`FaultSchedule` injection (drop/delay/error/kill, scoped by
+  site tag, seeded or scripted) threaded through the store client, rpc
+  transport, PS service, and checkpoint writer — a no-op global probe
+  when not installed.
+
+Everything is observable through :mod:`paddle_tpu.observability`:
+``resilience.retries_total``, ``resilience.giveups_total``,
+``resilience.breaker_state``, ``resilience.breaker_transitions_total``,
+``resilience.injected_faults_total``, ``checkpoint.fallbacks_total``.
+"""
+
+from __future__ import annotations
+
+from .policy import (RetryPolicy, current_deadline, deadline_scope,
+                     get_policy, jitter_sleep, register_policy,
+                     reset_policies)
+from .breaker import (BreakerOpen, CircuitBreaker, breaker_for,
+                      reset_breakers)
+from .faults import (FaultInjected, FaultSchedule, KillPoint, fault_point,
+                     install, installed, uninstall)
+
+__all__ = [
+    "RetryPolicy", "deadline_scope", "current_deadline", "get_policy",
+    "register_policy", "reset_policies", "jitter_sleep",
+    "BreakerOpen", "CircuitBreaker", "breaker_for", "reset_breakers",
+    "FaultInjected", "FaultSchedule", "KillPoint", "fault_point",
+    "install", "installed", "uninstall",
+]

@@ -1,0 +1,210 @@
+"""Deterministic fault injection: scripted/seeded failures at named sites.
+
+The failure-handling layer is only trustworthy if every path through it
+can be DRIVEN: a lost PS reply, a store socket reset mid-request, a kill
+halfway through a checkpoint commit. Each such seam in the framework is a
+``fault_point("<site>")`` call — a module-global ``None`` probe when no
+schedule is installed (the production state: zero work, zero allocation)
+— and a test/harness installs a :class:`FaultSchedule` that decides, per
+site and per call index, whether to delay, raise, or "kill".
+
+Determinism contract: a schedule is driven only by (a) the per-site call
+counter and (b) its own seeded RNG for probabilistic specs. Re-running the
+same workload against an identical schedule therefore produces the same
+``trace`` — the acceptance surface for "the same schedule yields the same
+retry/failover trace twice".
+
+Sites threaded through the framework (exact-match tags):
+
+====================  =====================================================
+``store.connect``     ``_PyClient`` dial (per attempt)
+``store.request``     ``_PyClient.request`` wire round-trip (per attempt)
+``rpc.call``          ``distributed.rpc._call`` entry (before dialing)
+``rpc.reply``         after the rpc reply was received (lost-reply seam)
+``ps.call``           ``PsClient._call`` attempt entry
+``ps.reply``          after a successful PS rpc (lost-REPLY: the server
+                      executed, the client must retry → seq dedup)
+``ps.handler``        PS server handler entry (server-side error seam)
+``checkpoint.save``   ``save_state_dict`` entry
+``checkpoint.write``  after metadata, before the array payload
+``checkpoint.commit`` after the array payload, before the manifest commit
+====================  =====================================================
+
+Kinds: ``delay`` sleeps; ``error`` raises a fresh instance of the
+configured exception type; ``kill`` raises :class:`KillPoint` — a
+``BaseException`` so ordinary ``except Exception`` recovery code cannot
+swallow the simulated process death.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+from .. import observability as _obs
+
+__all__ = ["FaultInjected", "KillPoint", "FaultSchedule", "fault_point",
+           "install", "uninstall", "installed"]
+
+
+class FaultInjected(ConnectionError):
+    """Default exception for injected ``error``/``drop`` faults."""
+
+
+class KillPoint(BaseException):
+    """Simulated process death at a fault point. Deliberately NOT an
+    ``Exception``: recovery code that catches ``Exception`` must behave as
+    if the process vanished, exactly like a real SIGKILL."""
+
+
+class _Spec:
+    __slots__ = ("kind", "on", "prob", "times", "error", "message",
+                 "seconds", "fired")
+
+    def __init__(self, kind: str, on, prob, times, error, message, seconds):
+        self.kind = kind
+        self.on = frozenset(int(i) for i in on) if on else None
+        self.prob = None if prob is None else float(prob)
+        self.times = None if times is None else int(times)
+        self.error = error
+        self.message = message
+        self.seconds = float(seconds)
+        self.fired = 0
+
+    def make_error(self, site: str, call_index: int) -> BaseException:
+        if isinstance(self.error, BaseException):
+            return self.error  # caller supplied an instance: use as-is
+        msg = self.message or f"injected {self.kind} at {site} " \
+                              f"(call {call_index})"
+        return self.error(msg)
+
+
+class FaultSchedule:
+    """A set of per-site fault specs plus the trace of what fired.
+
+    ``seed`` drives the probabilistic specs; scripted specs (``on=``) need
+    no RNG at all. ``trace`` is the ordered list of
+    ``(site, call_index, kind)`` tuples of every fired fault — compare two
+    runs' traces to prove determinism.
+    """
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self._specs: Dict[str, List[_Spec]] = {}
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.trace: List[Tuple[str, int, str]] = []
+
+    # -- authoring ----------------------------------------------------------
+    def inject(self, site: str, kind: str, *, on=None,
+               prob: Optional[float] = None, times: Optional[int] = None,
+               error: Any = FaultInjected, message: Optional[str] = None,
+               seconds: float = 0.0) -> "FaultSchedule":
+        """Add one spec for ``site``.
+
+        ``on`` — 1-based call indices that fire (scripted); ``prob`` —
+        seeded per-call probability (ignored when ``on`` given); ``times``
+        — cap on total fires; ``error`` — exception type (or instance) for
+        ``error`` kind; ``seconds`` — sleep for ``delay`` kind.
+        """
+        if kind not in ("delay", "error", "kill"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        with self._lock:
+            self._specs.setdefault(site, []).append(
+                _Spec(kind, on, prob, times, error, message, seconds))
+        return self
+
+    def error(self, site: str, **kw) -> "FaultSchedule":
+        return self.inject(site, "error", **kw)
+
+    # "drop" reads better at transport seams; the mechanics are identical
+    # (raise a transport-shaped error the caller's retry loop handles)
+    drop = error
+
+    def delay(self, site: str, *, seconds: float, **kw) -> "FaultSchedule":
+        return self.inject(site, "delay", seconds=seconds, **kw)
+
+    def kill(self, site: str, **kw) -> "FaultSchedule":
+        return self.inject(site, "kill", **kw)
+
+    # -- execution ----------------------------------------------------------
+    def calls(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def check(self, site: str) -> None:
+        """One pass through ``site``: bump the counter, fire at most one
+        matching spec (first match wins, in authoring order)."""
+        with self._lock:
+            n = self._counts.get(site, 0) + 1
+            self._counts[site] = n
+            hit: Optional[_Spec] = None
+            for spec in self._specs.get(site, ()):
+                if spec.times is not None and spec.fired >= spec.times:
+                    continue
+                if spec.on is not None:
+                    fire = n in spec.on
+                elif spec.prob is not None:
+                    fire = self._rng.random() < spec.prob
+                else:
+                    fire = True
+                if fire:
+                    spec.fired += 1
+                    hit = spec
+                    break
+            if hit is not None:
+                self.trace.append((site, n, hit.kind))
+        if hit is None:
+            return
+        _obs.inc("resilience.injected_faults_total", site=site, kind=hit.kind)
+        if hit.kind == "delay":
+            time.sleep(hit.seconds)
+            return
+        if hit.kind == "kill":
+            raise KillPoint(f"injected kill at {site} (call {n})")
+        raise hit.make_error(site, n)
+
+
+# ---------------------------------------------------------------------------
+# global install seam
+# ---------------------------------------------------------------------------
+
+_SCHEDULE: Optional[FaultSchedule] = None
+
+
+def install(schedule: FaultSchedule) -> FaultSchedule:
+    """Make ``schedule`` the process-wide active schedule (test/harness
+    only; there is deliberately no way to enable this per-call on a hot
+    path)."""
+    global _SCHEDULE
+    _SCHEDULE = schedule
+    return schedule
+
+
+def uninstall() -> None:
+    global _SCHEDULE
+    _SCHEDULE = None
+
+
+class installed:
+    """``with installed(schedule): ...`` — scoped install for tests."""
+
+    def __init__(self, schedule: FaultSchedule):
+        self.schedule = schedule
+
+    def __enter__(self) -> FaultSchedule:
+        install(self.schedule)
+        return self.schedule
+
+    def __exit__(self, *exc) -> None:
+        uninstall()
+
+
+def fault_point(site: str) -> None:
+    """Zero-overhead when no schedule is installed: one global load and a
+    ``None`` test."""
+    s = _SCHEDULE
+    if s is not None:
+        s.check(site)

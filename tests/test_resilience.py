@@ -1,0 +1,600 @@
+"""paddle_tpu.resilience: retry policies, circuit breakers, deterministic
+fault injection, and crash-safe verified checkpointing.
+
+The acceptance surface of PR 5: (a) a seeded/scripted ``FaultSchedule``
+yields the SAME retry/failover trace on identical runs; (b) PS push dedup
+holds under injected lost REPLIES; (c) the store client reconnects once on
+a mid-request connection reset; (d) breaker state walks
+closed→open→half-open→closed; (e) a kill injected during a checkpoint
+save leaves the last-good checkpoint loadable with checksums verified;
+(f) all of it is visible through the observability Prometheus exporter.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401  (backend init)
+from paddle_tpu import observability as obs
+from paddle_tpu import resilience as resil
+from paddle_tpu.resilience.breaker import CircuitBreaker
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience(monkeypatch):
+    """Fresh policies/breakers (re-reading env), fast backoffs, metrics
+    on, no leftover schedule."""
+    for name in ("PS_RPC", "STORE_CONNECT", "RPC_DIAL"):
+        monkeypatch.setenv(f"PADDLE_TPU_RETRY_{name}_BASE_DELAY", "0.001")
+        monkeypatch.setenv(f"PADDLE_TPU_RETRY_{name}_MAX_DELAY", "0.002")
+    resil.reset_policies()
+    resil.reset_breakers()
+    resil.uninstall()
+    obs.enable()
+    obs.reset()
+    yield
+    resil.uninstall()
+    resil.reset_policies()
+    resil.reset_breakers()
+    obs.disable()
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        pol = resil.RetryPolicy("t.ok", base_delay=0.001, max_delay=0.002)
+        calls = [0]
+        for attempt in pol.start():
+            calls[0] += 1
+            try:
+                if calls[0] < 3:
+                    raise ConnectionError("transient")
+                break
+            except ConnectionError as e:
+                attempt.fail(e)
+        assert calls[0] == 3
+        assert obs.snapshot()["resilience.retries_total"] == {
+            "policy=t.ok": 2.0}
+
+    def test_attempt_cap_reraises_original_and_counts_giveup(self):
+        pol = resil.RetryPolicy("t.cap", base_delay=0.001, max_attempts=3)
+        calls = [0]
+        with pytest.raises(ConnectionError, match="always"):
+            for attempt in pol.start():
+                calls[0] += 1
+                try:
+                    raise ConnectionError("always")
+                except ConnectionError as e:
+                    attempt.fail(e)
+        assert calls[0] == 3
+        assert obs.snapshot()["resilience.giveups_total"] == {
+            "policy=t.cap": 1.0}
+
+    def test_deadline_bounds_attempts(self):
+        pol = resil.RetryPolicy("t.dl", base_delay=0.005, jitter=0.0)
+        calls = [0]
+        with pytest.raises(TimeoutError):
+            for attempt in pol.start(deadline=0.02):
+                calls[0] += 1
+                try:
+                    raise TimeoutError("slow")
+                except TimeoutError as e:
+                    attempt.fail(e)
+        assert 2 <= calls[0] <= 10  # bounded by the 20ms budget, not ∞
+
+    def test_deadline_scope_propagates_and_clamps(self):
+        import time
+        # ambient 10ms scope clamps a policy whose own deadline is 10s
+        pol = resil.RetryPolicy("t.scope", base_delay=0.001, deadline=10.0)
+        with resil.deadline_scope(0.01):
+            att = pol.start()
+            assert att.remaining() <= 0.01 + 1e-3
+            # a nested LOOSER scope cannot extend the outer budget
+            with resil.deadline_scope(5.0):
+                assert resil.current_deadline() <= time.monotonic() + 0.011
+        assert resil.current_deadline() is None
+
+    def test_backoff_growth_and_jitter_bounds(self):
+        slept = []
+        pol = resil.RetryPolicy("t.growth", base_delay=0.1, multiplier=2.0,
+                                max_delay=0.4, jitter=0.25,
+                                sleep=slept.append)
+        with pytest.raises(OSError):
+            for attempt in pol.start():
+                try:
+                    raise OSError("x")
+                except OSError as e:
+                    if len(slept) >= 5:
+                        raise
+                    attempt.fail(e)
+        # nominal schedule 0.1, 0.2, 0.4, 0.4, 0.4 — each within ±25%
+        for nominal, got in zip([0.1, 0.2, 0.4, 0.4, 0.4], slept):
+            assert nominal * 0.75 - 1e-9 <= got <= nominal * 1.25 + 1e-9
+
+    def test_env_overrides_apply_at_creation(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_RETRY_T_ENV_MAX_ATTEMPTS", "7")
+        monkeypatch.setenv("PADDLE_TPU_RETRY_T_ENV_BASE_DELAY", "0.5")
+        resil.reset_policies()
+        pol = resil.get_policy("t.env", base_delay=0.1)
+        assert pol.max_attempts == 7 and pol.base_delay == 0.5
+        # cached: later defaults do not reconfigure
+        assert resil.get_policy("t.env", base_delay=9.9).base_delay == 0.5
+
+    def test_jitter_sleep_bounds(self):
+        import random
+        slept = []
+        d = resil.jitter_sleep(1.0, frac=0.25, rng=random.Random(3),
+                               sleep=slept.append)
+        assert slept == [d] and 0.75 <= d <= 1.25
+        assert resil.jitter_sleep(0.0, sleep=slept.append) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_full_state_walk(self):
+        t = [0.0]
+        br = CircuitBreaker("ep", failure_threshold=2, cooldown=5.0,
+                            clock=lambda: t[0])
+        br.before_call(); br.record_failure()
+        br.before_call(); br.record_failure()
+        assert br.state == "open"
+        with pytest.raises(resil.BreakerOpen):
+            br.before_call()  # cooling: fast local failure
+        t[0] = 6.0
+        br.before_call()      # cooldown elapsed: half-open probe admitted
+        assert br.state == "half_open"
+        with pytest.raises(resil.BreakerOpen):
+            br.before_call()  # single probe slot taken
+        br.record_success()
+        assert br.state == "closed"
+        snap = obs.snapshot()
+        assert snap["resilience.breaker_state"] == {"endpoint=ep": 0.0}
+        trans = snap["resilience.breaker_transitions_total"]
+        assert trans["endpoint=ep,to=open"] == 1.0
+        assert trans["endpoint=ep,to=half_open"] == 1.0
+        assert trans["endpoint=ep,to=closed"] == 1.0
+        assert snap["resilience.breaker_short_circuits_total"] == {
+            "endpoint=ep": 2.0}
+
+    def test_failed_probe_reopens(self):
+        t = [0.0]
+        br = CircuitBreaker("ep2", failure_threshold=1, cooldown=1.0,
+                            clock=lambda: t[0])
+        br.before_call(); br.record_failure()
+        t[0] = 2.0
+        br.before_call()
+        br.record_failure()   # probe failed
+        assert br.state == "open"
+        with pytest.raises(resil.BreakerOpen):
+            br.before_call()  # new cooldown window
+        t[0] = 4.0
+        br.before_call(); br.record_success()
+        assert br.state == "closed"
+
+    def test_reset_closes_and_registry_caches(self):
+        br = resil.breaker_for("ps/srv0", failure_threshold=1)
+        assert resil.breaker_for("ps/srv0") is br
+        br.before_call(); br.record_failure()
+        assert br.state == "open"
+        br.reset()
+        assert br.state == "closed"
+        br.before_call()  # admitted again
+
+
+# ---------------------------------------------------------------------------
+# FaultSchedule
+# ---------------------------------------------------------------------------
+
+class TestFaultSchedule:
+    def test_zero_overhead_when_uninstalled(self):
+        resil.fault_point("nowhere")  # no schedule: pure no-op
+
+    def test_scripted_indices_and_kinds(self):
+        s = resil.FaultSchedule()
+        s.error("a.site", on=(2,), error=ConnectionResetError)
+        s.delay("a.site", on=(3,), seconds=0.0)
+        with resil.installed(s):
+            resil.fault_point("a.site")
+            with pytest.raises(ConnectionResetError):
+                resil.fault_point("a.site")
+            resil.fault_point("a.site")  # delay(0): returns
+        assert s.trace == [("a.site", 2, "error"), ("a.site", 3, "delay")]
+        assert s.calls("a.site") == 3
+
+    def test_kill_is_not_an_ordinary_exception(self):
+        s = resil.FaultSchedule().kill("k.site", on=(1,))
+        with resil.installed(s):
+            with pytest.raises(resil.KillPoint):
+                try:
+                    resil.fault_point("k.site")
+                except Exception:  # noqa: BLE001 — the point of the test
+                    pytest.fail("KillPoint must evade `except Exception`")
+
+    def test_seeded_schedule_is_deterministic(self):
+        def run(seed):
+            s = resil.FaultSchedule(seed=seed)
+            s.error("p.site", prob=0.5, error=ConnectionError)
+            with resil.installed(s):
+                for _ in range(32):
+                    try:
+                        resil.fault_point("p.site")
+                    except ConnectionError:
+                        pass  # injected: the workload keeps going
+            return list(s.trace)
+
+        t1, t2 = run(1234), run(1234)
+        assert t1 == t2 and t1  # same seed → same trace, and faults fired
+        assert run(99) != t1    # different seed → different plan
+
+    def test_times_cap(self):
+        s = resil.FaultSchedule().error("c.site", prob=1.0, times=2)
+        with resil.installed(s):
+            for _ in range(2):
+                with pytest.raises(resil.FaultInjected):
+                    resil.fault_point("c.site")
+            resil.fault_point("c.site")  # budget spent: clean
+        assert len(s.trace) == 2
+        assert obs.snapshot()["resilience.injected_faults_total"] == {
+            "kind=error,site=c.site": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# PS client under injected faults (in-process: deterministic, no sockets)
+# ---------------------------------------------------------------------------
+
+def _fake_rpc_client(monkeypatch):
+    """PsClient whose rpc plane executes handlers in-process: the real
+    ``_call`` retry/breaker path runs unchanged, transport faults come
+    from the installed FaultSchedule."""
+    from types import SimpleNamespace
+    from paddle_tpu.distributed import ps_service as ps
+    from paddle_tpu.distributed.rpc import RpcTransportError, WorkerInfo
+
+    info = WorkerInfo("srv", 0, "127.0.0.1", 1)
+    fake = SimpleNamespace(
+        rpc_sync=lambda server, fn, args=None: fn(*(args or ())),
+        RpcTransportError=RpcTransportError,
+        get_worker_info=lambda name: info,
+        refresh_worker_info=lambda name: info)
+    monkeypatch.setattr(ps.PsClient, "_rpc", lambda self: fake)
+    ps.reset_server_state()
+    return ps
+
+
+class TestPsFaultInjection:
+    def test_push_dedup_under_injected_reply_drops(self, monkeypatch):
+        ps = _fake_rpc_client(monkeypatch)
+        from paddle_tpu.distributed.rpc import RpcTransportError
+
+        def scenario():
+            ps.reset_server_state()
+            resil.reset_breakers()
+            client = ps.PsClient("srv", lr=1.0, retry_timeout=5.0)
+            client.create_table("t", np.zeros((4, 2), np.float32))
+            sched = resil.FaultSchedule()
+            # drop the REPLY of the first push rpc: the server APPLIED the
+            # gradient, the client must retry, the seq watermark must
+            # discard the duplicate
+            sched.drop("ps.reply", on=(2,), error=RpcTransportError)
+            with resil.installed(sched):
+                client.push("t", [1], np.ones((1, 2), np.float32))
+                client.push("t", [1], np.ones((1, 2), np.float32))
+            snap = client.table_snapshot("t")
+            return list(sched.trace), snap.copy(), dict(ps.serve_stats())
+
+        trace1, table1, stats1 = scenario()
+        # exactly-once despite the retried wire push
+        np.testing.assert_allclose(table1[1], [-2.0, -2.0])
+        assert stats1["dup_pushes"] == 1
+        assert obs.snapshot()["ps.rpc_retries_total"] >= 1.0
+
+        # acceptance: the same schedule yields the same retry/failover
+        # trace twice
+        trace2, table2, stats2 = scenario()
+        assert trace1 == trace2 == [("ps.reply", 2, "error")]
+        np.testing.assert_array_equal(table1, table2)
+        assert stats1["dup_pushes"] == stats2["dup_pushes"]
+
+    def test_exhausted_budget_raises_transport_error_not_breaker(
+            self, monkeypatch):
+        ps = _fake_rpc_client(monkeypatch)
+        from paddle_tpu.distributed.rpc import RpcTransportError
+
+        client = ps.PsClient("srv", retry_timeout=0.05)
+        sched = resil.FaultSchedule()
+        sched.drop("ps.call", prob=1.0, error=RpcTransportError)
+        with resil.installed(sched):
+            with pytest.raises(RpcTransportError):
+                client.create_table("t", np.zeros((2, 2), np.float32))
+        snap = obs.snapshot()
+        assert snap["ps.rpc_failures_total"] == 1.0
+        # the per-server breaker opened along the way (threshold 5 < the
+        # ~50 attempts a 50ms budget of 1ms backoffs admits)
+        assert snap["resilience.breaker_state"]["endpoint=ps/srv"] == 2.0
+        assert snap["resilience.breaker_short_circuits_total"][
+            "endpoint=ps/srv"] >= 1.0
+
+    def test_server_side_error_is_not_retried(self, monkeypatch):
+        ps = _fake_rpc_client(monkeypatch)
+
+        client = ps.PsClient("srv", retry_timeout=5.0)
+        sched = resil.FaultSchedule()
+        # a HANDLER error ships back with its original type: the call
+        # executed, the client must not retry it
+        sched.error("ps.handler", on=(1,), error=RuntimeError)
+        with resil.installed(sched):
+            with pytest.raises(RuntimeError):
+                client.push("t-absent", [0], np.ones((1, 1), np.float32))
+        assert obs.snapshot().get("ps.rpc_retries_total") is None
+
+    def test_server_side_error_during_probe_frees_breaker(self, monkeypatch):
+        ps = _fake_rpc_client(monkeypatch)
+
+        # force the per-server breaker open, with a zero cooldown so the
+        # very next call runs as the half-open PROBE
+        br = resil.breaker_for("ps/srv", failure_threshold=1, cooldown=0.0)
+        br.before_call(); br.record_failure()
+        assert br.state == "open"
+        client = ps.PsClient("srv", retry_timeout=5.0)
+        sched = resil.FaultSchedule().error("ps.handler", on=(1,),
+                                            error=RuntimeError)
+        with resil.installed(sched):
+            with pytest.raises(RuntimeError):
+                client.push("t-absent", [0], np.ones((1, 1), np.float32))
+        # the probe hit an APPLICATION error: endpoint executed the call,
+        # so the breaker closes and the probe slot is freed (a wedged
+        # half_open here would fail every future call to this server)
+        assert br.state == "closed"
+        client.create_table("t", np.zeros((2, 2), np.float32))  # admitted
+
+    def test_breaker_only_exhaustion_raises_transport_error(
+            self, monkeypatch):
+        ps = _fake_rpc_client(monkeypatch)
+        from paddle_tpu.distributed.rpc import RpcTransportError
+
+        # breaker opened by a PREVIOUS call's failures, long cooldown: a
+        # new call whose budget is shorter than the cooldown only ever
+        # sees BreakerOpen — it must still surface the documented
+        # transport type
+        br = resil.breaker_for("ps/srv", failure_threshold=1, cooldown=60.0)
+        br.before_call(); br.record_failure()
+        client = ps.PsClient("srv", retry_timeout=0.02)
+        with pytest.raises(RpcTransportError, match="breaker"):
+            client.create_table("t", np.zeros((2, 2), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# TCPStore reconnect (pure-python client; native skipped by use_native)
+# ---------------------------------------------------------------------------
+
+class TestStoreReconnect:
+    def test_reconnect_once_on_injected_reset(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore(is_master=True, world_size=1, use_native=False,
+                         timeout=5.0)
+        try:
+            store.set("k", b"v1")
+            sched = resil.FaultSchedule()
+            sched.error("store.request", on=(1,),
+                        error=ConnectionResetError)
+            with resil.installed(sched):
+                assert store.get("k") == b"v1"  # reconnected + resent
+            assert obs.snapshot()["store.reconnects_total"] == 1.0
+            assert store.get("k") == b"v1"      # healthy afterwards
+        finally:
+            store.close()
+
+    def test_second_consecutive_failure_surfaces(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore(is_master=True, world_size=1, use_native=False,
+                         timeout=5.0)
+        try:
+            sched = resil.FaultSchedule()
+            sched.error("store.request", on=(1, 2),
+                        error=ConnectionResetError)
+            with resil.installed(sched):
+                with pytest.raises(ConnectionError):
+                    store.set("k", b"v")
+        finally:
+            store.close()
+
+
+class TestRpcDial:
+    def test_total_timeout_not_multiplied_by_attempts(self, monkeypatch):
+        import time
+        from paddle_tpu.distributed import rpc
+
+        seen = []
+
+        def refuse(addr, timeout=None):
+            seen.append(timeout)
+            raise ConnectionRefusedError("refused")
+
+        monkeypatch.setattr(rpc.socket, "create_connection", refuse)
+        info = rpc.WorkerInfo("w", 0, "127.0.0.1", 1)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            rpc._dial(info, 0.05)
+        # the caller's timeout is a TOTAL budget: per-attempt connect
+        # timeouts are clamped to what remains, never 3 × 0.05
+        assert time.monotonic() - t0 < 1.0
+        assert 1 <= len(seen) <= 3
+        assert all(t is not None and t <= 0.06 for t in seen)
+
+
+# ---------------------------------------------------------------------------
+# crash-safe checkpointing
+# ---------------------------------------------------------------------------
+
+def _state(values):
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    return {"model": {"w": Tensor(jnp.asarray(values, jnp.float32))}}
+
+
+class TestCrashSafeCheckpoint:
+    def test_manifest_commit_and_pointers(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        ckpt.save_state_dict(_state([1.0, 2.0]), str(tmp_path / "step1"))
+        m = ckpt.verify_checkpoint(str(tmp_path / "step1"))
+        assert m["version"] == 1 and "model.w" in m["arrays"]
+        assert m["arrays"]["model.w"]["crc32"] is not None
+        assert (tmp_path / "latest").read_text().strip() == "step1"
+        ckpt.save_state_dict(_state([3.0, 4.0]), str(tmp_path / "step2"))
+        assert (tmp_path / "latest").read_text().strip() == "step2"
+        assert (tmp_path / "latest.prev").read_text().strip() == "step1"
+
+    @pytest.mark.parametrize("site", ["checkpoint.write",
+                                      "checkpoint.commit"])
+    def test_kill_during_save_leaves_last_good_loadable(self, tmp_path,
+                                                        site):
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        ckpt.save_state_dict(_state([1.0, 2.0]), str(tmp_path / "step1"))
+        sched = resil.FaultSchedule().kill(site, on=(1,))
+        with resil.installed(sched):
+            with pytest.raises(resil.KillPoint):
+                ckpt.save_state_dict(_state([9.0, 9.0]),
+                                     str(tmp_path / "step2"))
+        # the interrupted save never committed, never moved the pointer
+        assert (tmp_path / "latest").read_text().strip() == "step1"
+        target = _state([0.0, 0.0])
+        ckpt.load_state_dict(target, str(tmp_path / "step2"))
+        np.testing.assert_array_equal(
+            np.asarray(target["model"]["w"]._data), [1.0, 2.0])
+        assert obs.snapshot()["checkpoint.fallbacks_total"] == 1.0
+
+    def test_crc_mismatch_falls_back(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        ckpt.save_state_dict(_state([1.0, 2.0]), str(tmp_path / "s1"))
+        ckpt.save_state_dict(_state([3.0, 4.0]), str(tmp_path / "s2"))
+        # corrupt s2's recorded checksum: verification must reject it
+        mpath = tmp_path / "s2" / "manifest.json"
+        m = json.loads(mpath.read_text())
+        m["arrays"]["model.w"]["crc32"] ^= 0xDEAD
+        mpath.write_text(json.dumps(m))
+        target = _state([0.0, 0.0])
+        ckpt.load_state_dict(target, str(tmp_path / "s2"))
+        np.testing.assert_array_equal(
+            np.asarray(target["model"]["w"]._data), [1.0, 2.0])
+        snap = obs.snapshot()
+        assert snap["checkpoint.fallbacks_total"] == 1.0
+        assert snap["checkpoint.crc_mismatches_total"] == 1.0
+
+    def test_no_fallback_available_raises(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        ckpt.save_state_dict(_state([1.0, 2.0]), str(tmp_path / "only"))
+        os.remove(tmp_path / "only" / "manifest.json")
+        with pytest.raises(ckpt.CheckpointCorruptError, match="verify"):
+            ckpt.load_state_dict(_state([0.0, 0.0]),
+                                 str(tmp_path / "only"))
+        snap = obs.snapshot()
+        # a verification failure with nowhere to fall back is NOT a
+        # fallback — alerting keys on fallbacks_total
+        assert snap.get("checkpoint.fallbacks_total") is None
+        assert snap["checkpoint.verification_failures_total"] == 1.0
+
+    def test_stale_async_commit_cannot_roll_latest_back(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        ckpt.save_state_dict(_state([1.0]), str(tmp_path / "old"))
+        ckpt.save_state_dict(_state([2.0]), str(tmp_path / "new"))
+        assert (tmp_path / "latest").read_text().strip() == "new"
+        # a slow async save of "old" finishing NOW (stale seq) must not
+        # rotate the pointer backwards
+        ckpt._update_latest(str(tmp_path / "old"), seq=0)
+        assert (tmp_path / "latest").read_text().strip() == "new"
+
+    def test_verify_false_keeps_original_error_surface(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        with pytest.raises(FileNotFoundError):
+            ckpt.load_state_dict(_state([0.0]), str(tmp_path / "absent"),
+                                 verify=False)
+
+    def test_verify_false_loads_legacy_directory(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        ckpt.save_state_dict(_state([5.0, 6.0]), str(tmp_path / "legacy"))
+        os.remove(tmp_path / "legacy" / "manifest.json")
+        target = _state([0.0, 0.0])
+        ckpt.load_state_dict(target, str(tmp_path / "legacy"),
+                             verify=False)
+        np.testing.assert_array_equal(
+            np.asarray(target["model"]["w"]._data), [5.0, 6.0])
+
+    def test_async_save_commits_manifest(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        ckpt.async_save_state_dict(_state([7.0, 8.0]),
+                                   str(tmp_path / "as1"))
+        ckpt.wait_async_saves()
+        ckpt.verify_checkpoint(str(tmp_path / "as1"))
+        target = _state([0.0, 0.0])
+        ckpt.load_state_dict(target, str(tmp_path / "as1"))
+        np.testing.assert_array_equal(
+            np.asarray(target["model"]["w"]._data), [7.0, 8.0])
+
+    def test_user_errors_never_trigger_fallback(self, tmp_path):
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed import checkpoint as ckpt
+
+        ckpt.save_state_dict(_state([1.0, 2.0]), str(tmp_path / "u1"))
+        with pytest.raises(KeyError):
+            ckpt.load_state_dict(
+                {"nope": Tensor(jnp.zeros(2))}, str(tmp_path / "u1"))
+        with pytest.raises(ValueError, match="shape mismatch"):
+            ckpt.load_state_dict(
+                {"model": {"w": Tensor(jnp.zeros((3, 3)))}},
+                str(tmp_path / "u1"))
+        assert obs.snapshot().get("checkpoint.fallbacks_total") is None
+
+
+# ---------------------------------------------------------------------------
+# exporter visibility (acceptance: metrics scrape-able)
+# ---------------------------------------------------------------------------
+
+def test_resilience_metrics_visible_in_prometheus_text(tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    pol = resil.RetryPolicy("t.prom", base_delay=0.001)
+    for attempt in pol.start():
+        try:
+            if attempt.attempt < 2:
+                raise OSError("x")
+            break
+        except OSError as e:
+            attempt.fail(e)
+    br = resil.breaker_for("prom/ep", failure_threshold=1)
+    br.before_call(); br.record_failure()
+    sched = resil.FaultSchedule().kill("checkpoint.commit", on=(1,))
+    ckpt.save_state_dict(_state([1.0]), str(tmp_path / "a"))
+    with resil.installed(sched):
+        with pytest.raises(resil.KillPoint):
+            ckpt.save_state_dict(_state([2.0]), str(tmp_path / "b"))
+    ckpt.load_state_dict(_state([0.0]), str(tmp_path / "b"))
+
+    text = obs.prometheus_text()
+    for sample in ("resilience_retries_total", "resilience_breaker_state",
+                   "resilience_breaker_transitions_total",
+                   "resilience_injected_faults_total",
+                   "checkpoint_fallbacks_total", "checkpoint_saves_total"):
+        assert sample in text, sample
+    parsed = obs.parse_prometheus_text(text)
+    assert parsed["checkpoint_fallbacks_total"][""] == 1.0
+    assert parsed["resilience_breaker_state"]['{endpoint="prom/ep"}'] == 2.0

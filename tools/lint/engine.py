@@ -136,6 +136,9 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # suffixes are assumed to run with the module lock already held by
     # their caller (the ``_locked`` convention used across core/)
     "lock_held_suffixes": ["_locked"],
+    # naked-retry: the module(s) allowed to own raw sleep-in-retry-loop
+    # mechanics — everything else routes through their policies
+    "retry_allowed_paths": ["paddle_tpu/resilience"],
     # cross-host-sync: whole-program reachability roots of the eager
     # dispatch fast path ("<path>::<function simple name>"): anything a
     # dispatch can reach pays its host syncs once per op
@@ -151,7 +154,7 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         {"name": "foundation", "prefixes": [
             "paddle_tpu.version", "paddle_tpu.flags", "paddle_tpu.device",
             "paddle_tpu.sysconfig", "paddle_tpu._native",
-            "paddle_tpu.observability"]},
+            "paddle_tpu.observability", "paddle_tpu.resilience"]},
         {"name": "core", "prefixes": [
             "paddle_tpu.core", "paddle_tpu.autograd", "paddle_tpu.framework",
             "paddle_tpu.profiler", "paddle_tpu.utils", "paddle_tpu.amp",

@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     host_sync,
     import_layering,
     lock_order,
+    naked_retry,
     silent_swallow,
     trace_impurity,
     unguarded_global,

@@ -1,0 +1,92 @@
+"""naked-retry: ad-hoc ``time.sleep`` retry/poll loops belong in
+``paddle_tpu/resilience``.
+
+PR 5 centralized failure handling: retry loops ride
+``resilience.RetryPolicy`` (jittered backoff, attempt caps, deadline
+propagation, counted retries) and poll loops ride
+``resilience.jitter_sleep`` (stampede-free cadence). A loop that both
+catches exceptions and sleeps is the hand-rolled version of one of those
+— invisible to the retry metrics, fixed-cadence (thundering-herd bait),
+and deadline-free. The rule flags every ``time.sleep`` call lexically
+inside a ``While``/``For`` whose body also contains a ``try/except``,
+outside the allowed paths (``retry_allowed_paths`` config, default
+``paddle_tpu/resilience``). Deliberate survivors go in the baseline with
+a written reason, per the PR-3 convention.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import path_matches
+from ..engine import FileContext, Rule, register_rule
+
+
+def _time_sleep_names(tree: ast.Module):
+    """(module-alias names for ``time``, direct names for ``time.sleep``)
+    collected from every import in the file (function-body deferred
+    imports included — the PS client's ``import time as _time`` idiom)."""
+    aliases, sleeps = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    sleeps.add(a.asname or "sleep")
+    return aliases, sleeps
+
+
+def _loop_has_try(loop: ast.AST) -> bool:
+    return any(isinstance(n, ast.Try) and n.handlers
+               for n in ast.walk(loop))
+
+
+@register_rule
+class NakedRetryRule(Rule):
+    name = "naked-retry"
+    description = ("time.sleep inside a try/except loop outside "
+                   "paddle_tpu/resilience (use RetryPolicy / jitter_sleep)")
+
+    def check(self, ctx: FileContext):
+        allowed = ctx.config.get("retry_allowed_paths",
+                                 ["paddle_tpu/resilience"])
+        if any(ctx.path == p or ctx.path.startswith(p + "/")
+               or path_matches(ctx.path, [p]) for p in allowed):
+            return
+        aliases, sleeps = _time_sleep_names(ctx.tree)
+        if not aliases and not sleeps:
+            return
+        rule = self.name
+        findings = []
+
+        def is_sleep(call: ast.Call) -> bool:
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr == "sleep" and \
+                    isinstance(f.value, ast.Name) and f.value.id in aliases:
+                return True
+            return isinstance(f, ast.Name) and f.id in sleeps
+
+        def visit(node, fn_name, loops):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_name = node.name
+                loops = ()  # a nested def starts its own loop context
+            elif isinstance(node, (ast.While, ast.For)):
+                loops = loops + (node,)
+            elif isinstance(node, ast.Call) and loops and is_sleep(node):
+                if any(_loop_has_try(lp) for lp in loops):
+                    findings.append(ctx.finding(
+                        node, rule,
+                        f"ad-hoc `time.sleep` retry/poll loop in "
+                        f"'{fn_name or '<module>'}': sleeps inside a "
+                        f"try/except loop — use resilience.RetryPolicy "
+                        f"for retries or resilience.jitter_sleep for "
+                        f"polls (or baseline with the written reason the "
+                        f"cadence is deliberate)"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn_name, loops)
+
+        visit(ctx.tree, None, ())
+        return findings
