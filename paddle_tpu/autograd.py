@@ -180,8 +180,11 @@ class PyLayerContext:
     def save_for_backward(self, *tensors):
         self._saved = tensors
 
-    @property
     def saved_tensor(self):
+        # method, not property: the reference API spells it
+        # ``ctx.saved_tensor()`` (python/paddle/autograd/py_layer.py), and
+        # reference PyLayer code calls it — a property here broke that code
+        # with "tuple is not callable"
         return self._saved
 
     def saved_tensors(self):

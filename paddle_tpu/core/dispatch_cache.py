@@ -260,13 +260,19 @@ def _fp_fn(fn, depth: int):
 
 def make_key(op_name: str, fn: Callable, in_sigs: Tuple,
              static_kwargs: Dict[str, Any], amp_key, needs_grad: bool,
-             nan_check: bool, flags_epoch: int):
+             nan_check: bool, flags_epoch: int, backend: str = ""):
     """Build the cache key, or ``(None, reason)`` when the op must bypass.
 
     ``flags_epoch`` folds every runtime ``set_flags`` write into the key:
     op fns read flags at trace time (tpu_matmul_precision, flash_block_*),
     so a flag flip must retire all compiled entries rather than serve the
     baked-in old value.
+
+    ``backend`` is the placement token from ``core/fallback.py`` (``""``
+    for default placement, ``"cpu"`` for an op on the CPU-fallback path):
+    the moment an op falls back its signatures key differently, so a
+    TPU-compiled callable can never be served for it — and the CPU
+    executable compiled under the new key never leaks back.
     """
     try:
         if isinstance(fn, types.FunctionType):
@@ -279,7 +285,7 @@ def make_key(op_name: str, fn: Callable, in_sigs: Tuple,
         else:
             statics = ()
         key = (op_name, fn_key, statics, in_sigs, amp_key, needs_grad,
-               nan_check, flags_epoch)
+               nan_check, flags_epoch, backend)
         hash(key)  # identity-keyed callables may be hash-less: probe NOW,
         #            not inside the cache dict where it would escape
     except _Bypass as e:

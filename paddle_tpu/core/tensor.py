@@ -23,8 +23,10 @@ import numpy as np
 
 from .. import flags as _flags
 from .. import device as _device
+from ..resilience.faults import fault_point as _fault_point
 from . import dtype as _dtype
 from . import dispatch_cache as _dcache
+from . import fallback as _fallback
 from . import lazy as _lazy
 from . import tracing as _tracing
 from .autograd import GradNode, backward as _backward
@@ -301,7 +303,7 @@ class Tensor:
                 out = Tensor(self._data, stop_gradient=self.stop_gradient, name=self.name)
                 out._grad_node, out._grad_index = self._grad_node, self._grad_index
             if not _is_tracer(out._data):
-                out._data = jax.device_put(out._data, place.jax_device())
+                out._data = _device.device_put(out._data, place)
         return out
 
     def cpu(self) -> "Tensor":
@@ -550,6 +552,50 @@ def _make_out_tensors(op_name, tensor_inputs, out_arrays, multi, needs_grad,
 _UNCACHED = object()  # _apply_cached verdict: run the uncached path
 
 
+def _concrete_dispatch(ts, arrays) -> bool:
+    """True when every input is a concrete array and no functionalization
+    seam is live — the only state in which re-executing on another device
+    is meaningful (symbolic values cannot be ``device_put``)."""
+    if ts is not None:
+        return False
+    for a in arrays:
+        if _is_tracer(a) or type(a).__name__ == "LazyValue":
+            return False
+    return True
+
+
+def _dispatch_execute(op_name: str, f: Callable, arrays, needs_grad: bool,
+                      ts):
+    """Run one op's pure fn (with ``jax.vjp`` when grad is needed), with
+    backend fallback: a primitive with no TPU lowering degrades to a CPU
+    re-execution instead of crashing the program (core/fallback.py — the
+    KernelFactory-fallback analogue). Returns ``(outs, vjp_fn)``.
+
+    ``dispatch.lower`` / ``dispatch.execute`` are resilience fault sites:
+    CPU-only CI installs a FaultSchedule raising e.g. NotImplementedError
+    here to drive the full degrade-warn-count-cache sequence
+    deterministically (tests/test_fallback.py).
+    """
+    if (_fallback.should_fallback(op_name)
+            and _concrete_dispatch(ts, arrays)):
+        # registry/denylist short-circuit: the doomed TPU compile is
+        # skipped entirely — this is what makes the SECOND call cheap
+        return _fallback.run_cpu(op_name, f, arrays, needs_grad)
+    try:
+        _fault_point("dispatch.lower")
+        if needs_grad:
+            outs, vjp_fn = jax.vjp(f, *arrays)
+        else:
+            outs, vjp_fn = f(*arrays), None
+        _fault_point("dispatch.execute")
+    except Exception as e:
+        if not (_fallback.enabled() and _fallback.is_lowering_failure(e)
+                and _concrete_dispatch(ts, arrays)):
+            raise
+        return _fallback.run_cpu(op_name, f, arrays, needs_grad, exc=e)
+    return outs, vjp_fn
+
+
 def _apply_cached(op_name, fn, tensor_inputs, differentiable, amp,
                   static_kwargs):
     """Fast path: dispatch through the signature-keyed compiled-op cache.
@@ -577,11 +623,16 @@ def _apply_cached(op_name, fn, tensor_inputs, differentiable, amp,
     st = _tracing.amp_state() if amp else None
     amp_key = st.cache_key if (st is not None and st.enable) else None
     nan_check = _flags.flag("check_nan_inf")
+    # backend joins the signature key: an op that fell back to CPU keys
+    # separately, so a TPU-compiled callable is never served for it — the
+    # fallen-back signature compiles its own CPU executable below
+    backend = _fallback.backend_token(op_name)
+    fb_cpu = bool(backend)
 
     in_sigs = tuple(_input_sig(t) for t in tensor_inputs)
     key, reason = _dcache.make_key(op_name, fn, in_sigs, static_kwargs,
                                    amp_key, needs_grad, nan_check,
-                                   _flags._EPOCH)
+                                   _flags._EPOCH, backend=backend)
     if key is None:
         _dcache.note_bypass(reason)
         return _UNCACHED
@@ -597,13 +648,18 @@ def _apply_cached(op_name, fn, tensor_inputs, differentiable, amp,
         entry = _dcache.CachedOp(
             _build_pure_fn(fn, cast_targets, static_kwargs), nan_check)
 
+    # fallen-back op: inputs move to host CPU first, so the jitted entry
+    # compiles for (and executes on) the CPU backend — committed inputs
+    # decide the jit placement — and the key's backend token keeps this
+    # executable separate from any TPU-compiled one
+    run_arrays = _fallback.to_cpu(arrays) if fb_cpu else arrays
     try:
-        outs, finite = entry.fwd(*arrays)
+        outs, finite = entry.fwd(*run_arrays)
         multi = isinstance(outs, tuple)
         out_arrays = outs if multi else (outs,)
         if fresh and needs_grad:
             # snapshot the linearization at dispatch time, like jax.vjp did
-            entry.warm_bwd(arrays, out_arrays, multi)
+            entry.warm_bwd(run_arrays, out_arrays, multi)
     except (jax.errors.JAXTypeError, NotImplementedError):
         if fresh:
             # the fn is legal eagerly but not under jit (it branches on
@@ -625,7 +681,13 @@ def _apply_cached(op_name, fn, tensor_inputs, differentiable, amp,
     if finite is not None and not bool(finite):
         raise FloatingPointError(f"op {op_name} produced nan/inf")
 
-    vjp_fn = entry.make_vjp(tuple(arrays)) if needs_grad else None
+    vjp_fn = entry.make_vjp(tuple(run_arrays)) if needs_grad else None
+    if fb_cpu:
+        _fallback.note_fallback(op_name)  # warn-once for denylist-seeded ops
+        _fallback.count_cpu_dispatch(op_name)
+        if vjp_fn is not None:
+            vjp_fn = _fallback.wrap_vjp(vjp_fn)
+        out_arrays = _fallback.from_cpu(out_arrays)
     out_tensors = _make_out_tensors(op_name, tensor_inputs, out_arrays, multi,
                                     needs_grad, vjp_fn, entry.fn)
     if multi:
@@ -660,11 +722,7 @@ def _apply_impl(op_name: str, fn: Callable, *tensor_inputs: Tensor,
     if _lazy.active():
         return _lazy_apply(op_name, f, tensor_inputs, arrays, needs_grad)
 
-    if needs_grad:
-        outs, vjp_fn = jax.vjp(f, *arrays)
-    else:
-        outs = f(*arrays)
-        vjp_fn = None
+    outs, vjp_fn = _dispatch_execute(op_name, f, arrays, needs_grad, ts)
 
     multi = isinstance(outs, tuple)
     out_arrays = outs if multi else (outs,)
@@ -726,12 +784,12 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tenso
     if not _is_tracer(arr):
         if place is not None:
             # explicit placement commits the array to that device
-            arr = jax.device_put(arr, _parse_place(place).jax_device())
+            arr = _device.device_put(arr, _parse_place(place))
         else:
             cur = _device.current_place()
             default_platform = "cpu" if not _device.is_compiled_with_tpu() else "tpu"
             if cur.device_type != default_platform or cur.device_id != 0:
-                arr = jax.device_put(arr, cur.jax_device())
+                arr = _device.device_put(arr, cur)
             else:
                 # UNCOMMITTED on the default device: lets eager ops mix with
                 # mesh-committed (sharded) arrays without transfer errors

@@ -19,7 +19,7 @@ __all__ = [
     "XPUPlace", "MLUPlace", "IPUPlace", "CUDAPinnedPlace",
     "set_device", "get_device", "get_all_devices", "device_count",
     "is_compiled_with_cuda", "is_compiled_with_tpu", "current_place",
-    "force_platform", "force_platform_from_env",
+    "device_put", "force_platform", "force_platform_from_env",
 ]
 
 
@@ -200,6 +200,25 @@ def is_compiled_with_custom_device(device_type: str = "") -> bool:
 
 def default_jax_device() -> jax.Device:
     return current_place().jax_device()
+
+
+def device_put(x, place: Union[str, Place, jax.Device, None] = None):
+    """The sanctioned single-device transfer: ``jax.device_put`` with the
+    target resolved through the Place taxonomy (``None`` → the current
+    default device). Every non-distributed transfer in the framework
+    routes through here or through ``core/fallback.py`` — enforced by the
+    ``device-access`` lint rule; the distributed layer's mesh-sharded
+    ``device_put(x, NamedSharding(...))`` calls are a different API and
+    stay in that layer (baselined)."""
+    if place is None:
+        dev = default_jax_device()
+    elif isinstance(place, Place):
+        dev = place.jax_device()
+    elif isinstance(place, jax.Device):
+        dev = place
+    else:
+        dev = Place(*_parse_device_str(str(place).lower())).jax_device()
+    return jax.device_put(x, dev)
 
 
 # ---------------------------------------------------------------------------

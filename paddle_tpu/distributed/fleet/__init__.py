@@ -78,8 +78,8 @@ def init(role_maker=None, is_collective: bool = True,
     init_parallel_env()
     _strategy = strategy or DistributedStrategy()
     hc = _strategy.hybrid_configs
-    import jax
-    ndev = len(jax.devices())
+    from ... import device as _device
+    ndev = len(_device.get_all_devices())
     degrees = {
         "dp": hc.get("dp_degree", -1),
         "mp": hc.get("mp_degree", 1),

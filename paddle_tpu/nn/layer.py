@@ -346,7 +346,8 @@ class Layer:
             if dtype is not None and jnp.issubdtype(arr.dtype, jnp.floating):
                 arr = arr.astype(dtype)
             if device is not None:
-                arr = jax.device_put(arr, _parse_place(device).jax_device())
+                from .. import device as _device
+                arr = _device.device_put(arr, _parse_place(device))
             t._set_data(arr)
         if dtype is not None:
             self._dtype = dtype

@@ -220,6 +220,30 @@ def as_strided(x, shape, stride, offset=0, name=None):
     return apply("as_strided", f, x)
 
 
+def strides(x, name=None):
+    """Element strides of ``x`` (reference: Tensor.strides / get_strides).
+
+    XLA buffers are always dense row-major, so the strides are the
+    canonical C-contiguous ones derived from the shape — in ELEMENTS, like
+    the reference (numpy reports bytes; divide its strides by itemsize to
+    compare)."""
+    x = ensure_tensor(x)
+    out, acc = [], 1
+    for s in reversed(x._data.shape):
+        out.append(acc)
+        acc *= int(s)
+    out.reverse()
+    return out
+
+
+def is_contiguous(x, name=None):
+    """Always True (reference: Tensor.is_contiguous): jax arrays carry no
+    user-visible stride permutations — ``as_strided`` and friends gather
+    into fresh dense buffers instead of aliasing."""
+    ensure_tensor(x)
+    return True
+
+
 def view_as(x, other, name=None):
     x, other = ensure_tensor(x), ensure_tensor(other)
     shp = tuple(other._data.shape)
@@ -280,6 +304,11 @@ register_op("diagonal_scatter", diagonal_scatter, methods=("diagonal_scatter",))
 register_op("fill_diagonal_tensor", fill_diagonal_tensor,
             methods=("fill_diagonal_tensor",))
 register_op("as_strided", as_strided, methods=("as_strided",))
+register_op("strides", strides)
+# Tensor.strides is an ATTRIBUTE upstream (t.strides, no call) while
+# paddle.strides(t) is the functional spelling — install a property, not
+# a method, so reference code reads it unparenthesized
+register_tensor_method("strides", property(strides))
 register_op("view_as", view_as, methods=("view_as",))
 register_op("standard_gamma", standard_gamma)
 register_op("top_p_sampling", top_p_sampling)
@@ -292,7 +321,7 @@ register_tensor_method("unfold", tensor_unfold)
 # im2col — two different upstream APIs share the bare name
 register_op("unfold", tensor_unfold)
 register_tensor_method("contiguous", lambda self: self)
-register_tensor_method("is_contiguous", lambda self: True)
+register_op("is_contiguous", is_contiguous, methods=("is_contiguous",))
 
 
 # --- in-place random fills / scatter family ---------------------------------

@@ -28,6 +28,12 @@ Sites threaded through the framework (exact-match tags):
 ``checkpoint.save``   ``save_state_dict`` entry
 ``checkpoint.write``  after metadata, before the array payload
 ``checkpoint.commit`` after the array payload, before the manifest commit
+``dispatch.lower``    ``core.tensor._dispatch_execute`` before the op's
+                      trace/execution — inject ``NotImplementedError``
+                      here to simulate a missing TPU lowering and drive
+                      the backend-fallback path (core/fallback.py)
+``dispatch.execute``  after the op executed, before results are consumed
+                      (first-execution compile failure seam)
 ====================  =====================================================
 
 Kinds: ``delay`` sleeps; ``error`` raises a fresh instance of the

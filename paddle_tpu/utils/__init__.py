@@ -78,7 +78,8 @@ def run_check() -> None:
     a = to_tensor(np.ones((16, 16), np.float32))
     out = matmul(a, a)
     assert float(out._data[0, 0]) == 16.0
-    ndev = len(jax.devices())
+    from .. import device as _device
+    ndev = len(_device.get_all_devices())
     print(f"PaddleTPU works well on 1 {jax.default_backend()} device.")
     if ndev > 1:
         print(f"PaddleTPU is installed successfully across {ndev} devices!")

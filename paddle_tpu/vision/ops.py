@@ -214,7 +214,61 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
     padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
     dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    # InferMeta-style validation (reference: DeformableConvInferMeta in
+    # paddle/phi/infermeta/multiary.cc): name the op, the offending
+    # argument, and got-vs-expected shapes instead of letting a raw jax
+    # reshape/broadcast error escape from deep inside the kernel body.
+    def _bad(arg, expected, got):
+        raise ValueError(
+            f"deform_conv2d: {arg} expected {expected}, got {got}")
+
+    if x.ndim != 4:
+        _bad("x", "a 4-D NCHW tensor", f"rank {x.ndim} with shape {x.shape}")
+    if weight.ndim != 4:
+        _bad("weight", "a 4-D (C_out, C_in/groups, kh, kw) tensor",
+             f"rank {weight.ndim} with shape {weight.shape}")
+    if offset.ndim != 4:
+        _bad("offset", "a 4-D (N, 2*deformable_groups*kh*kw, H_out, W_out) "
+             "tensor", f"rank {offset.ndim} with shape {offset.shape}")
     kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    n, cin = int(x.shape[0]), int(x.shape[1])
+    if cin % groups != 0:
+        _bad("x", f"channel count divisible by groups={groups}",
+             f"{cin} channels")
+    if cin % deformable_groups != 0:
+        _bad("x", f"channel count divisible by "
+             f"deformable_groups={deformable_groups}", f"{cin} channels")
+    if int(weight.shape[0]) % groups != 0:
+        _bad("weight", f"output channels divisible by groups={groups}",
+             f"{int(weight.shape[0])} output channels")
+    if int(weight.shape[1]) * groups != cin:
+        _bad("weight", f"shape[1] == C_in/groups = {cin // groups} "
+             f"(C_in={cin}, groups={groups})", f"shape[1] == {int(weight.shape[1])}")
+    if int(offset.shape[0]) != n:
+        _bad("offset", f"batch size {n} (matching x)",
+             f"batch size {int(offset.shape[0])}")
+    off_c = 2 * deformable_groups * kh * kw
+    if int(offset.shape[1]) != off_c:
+        _bad("offset", f"shape[1] == 2 * deformable_groups * kh * kw = "
+             f"{off_c} (deformable_groups={deformable_groups}, "
+             f"kernel={kh}x{kw})", f"shape[1] == {int(offset.shape[1])}")
+    hp = int(x.shape[2]) + 2 * padding[0]
+    wp = int(x.shape[3]) + 2 * padding[1]
+    out_hw = ((hp - (dilation[0] * (kh - 1) + 1)) // stride[0] + 1,
+              (wp - (dilation[1] * (kw - 1) + 1)) // stride[1] + 1)
+    if tuple(int(s) for s in offset.shape[2:]) != out_hw:
+        _bad("offset", f"spatial shape {list(out_hw)} (the conv output "
+             f"H_out x W_out)", f"spatial shape {[int(s) for s in offset.shape[2:]]}")
+    if mask is not None:
+        mask = ensure_tensor(mask)
+        m_c = deformable_groups * kh * kw
+        m_want = (n, m_c) + out_hw
+        if (mask.ndim != 4
+                or tuple(int(s) for s in mask.shape) != m_want):
+            _bad("mask", f"shape {list(m_want)} "
+                 f"(N, deformable_groups*kh*kw, H_out, W_out)",
+                 f"shape {[int(s) for s in mask.shape]}")
 
     def f(inp, off, w, *rest):
         msk = rest[0] if mask is not None else None

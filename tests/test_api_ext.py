@@ -87,6 +87,26 @@ class TestMathExt:
         assert v.shape == [2, 5]
         assert x.contiguous() is x and x.is_contiguous()
 
+    def test_strides_matches_numpy(self):
+        # element strides, not bytes: numpy strides / itemsize
+        for shape in [(2, 3, 4), (5,), (1, 1), (3, 1, 2)]:
+            a = np.zeros(shape, np.float32)
+            t = paddle.to_tensor(a)
+            want = [s // a.itemsize for s in a.strides]
+            assert t.strides == want            # attribute, like upstream
+            assert paddle.strides(t) == want    # functional spelling
+        s = paddle.to_tensor(np.float32(3.0))
+        assert s.strides == [] and paddle.strides(s) == []
+
+    def test_is_contiguous_dense_buffers(self):
+        t = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        assert t.is_contiguous() is True
+        assert paddle.is_contiguous(t) is True
+        # derived views gather into fresh dense buffers: still contiguous,
+        # with the canonical strides of the NEW shape
+        s = paddle.as_strided(t, [2, 2], [4, 1])
+        assert s.is_contiguous() and s.strides == [2, 1]
+
     def test_standard_gamma(self):
         alpha = paddle.full([1000], 5.0)
         g = paddle.standard_gamma(alpha)

@@ -111,6 +111,31 @@ def test_pylayer():
     np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
 
 
+def test_pylayer_saved_tensor_is_a_method():
+    # upstream spells it ctx.saved_tensor() — a CALL (py_layer.py); it was
+    # briefly a property here, which broke reference PyLayer code
+    seen = {}
+
+    class Square(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            seen["consistent"] = ctx.saved_tensor() == ctx.saved_tensors()
+            return g * 2 * x
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Square.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+    assert seen["consistent"]
+    assert callable(paddle.autograd.PyLayerContext.saved_tensor)
+
+
 def test_setitem_grad_flow():
     x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
     y = x * 2

@@ -1,0 +1,313 @@
+"""Backend-fallback dispatch (ISSUE 6): graceful CPU degradation.
+
+The acceptance surface, proven deterministically on CPU-only CI via the
+resilience fault sites (``dispatch.lower`` / ``dispatch.execute``):
+
+* an injected lowering failure makes the op return the correct CPU result,
+  emit exactly one :class:`BackendFallbackWarning`, and increment
+  ``dispatch.fallbacks_total{op}``;
+* the SECOND call of a fallen-back op never reaches the TPU compile
+  attempt (fallback registry short-circuit — the fault site's call counter
+  is the witness);
+* ``PADDLE_TPU_FALLBACK=off`` restores the hard-fail surface;
+* the dispatch cache keys on the backend token, so a pre-fallback compiled
+  callable is never served for a fallen-back op (and vice versa);
+* the denylist engages only when an accelerator is present — tier-1 CPU
+  semantics are byte-identical;
+* everything above is visible in the Prometheus exposition.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import device as device_mod
+from paddle_tpu import observability as obs
+from paddle_tpu.core import dispatch_cache as dcache
+from paddle_tpu.core import fallback as fb
+from paddle_tpu.core.tensor import apply, to_tensor
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.faults import FaultSchedule, installed
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    fb.reset()
+    obs.disable()
+    obs.reset()
+    yield
+    faults.uninstall()
+    fb.reset()
+    obs.disable()
+    obs.reset()
+
+
+def _t(data, grad=False):
+    return to_tensor(np.asarray(data, np.float32), stop_gradient=not grad)
+
+
+def _mul2(x):
+    return x * 2.0
+
+
+def _lowering_fault(site="dispatch.lower", on=(1,)):
+    return FaultSchedule().error(site, on=on, error=NotImplementedError)
+
+
+# ---------------------------------------------------------------------------
+# the degradation proof
+# ---------------------------------------------------------------------------
+
+def test_injected_lowering_failure_degrades_to_cpu():
+    obs.enable()
+    x = _t([[1.0, 2.0], [3.0, 4.0]])
+    sched = _lowering_fault()
+    with installed(sched):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            y1 = apply("fb_op_a", _mul2, x)
+            y2 = apply("fb_op_a", _mul2, x)
+    want = np.asarray([[2.0, 4.0], [6.0, 8.0]], np.float32)
+    np.testing.assert_allclose(np.asarray(y1._data), want)
+    np.testing.assert_allclose(np.asarray(y2._data), want)
+    # exactly one warning, naming the op and the escape knob
+    fbw = [m for m in w if issubclass(m.category, fb.BackendFallbackWarning)]
+    assert len(fbw) == 1
+    assert "fb_op_a" in str(fbw[0].message)
+    assert "PADDLE_TPU_FALLBACK=off" in str(fbw[0].message)
+    # attributed to the USER call site, not a dispatch-internals frame
+    assert fbw[0].filename == __file__
+    # both dispatches counted on the fallback path
+    c = obs.counter("dispatch.fallbacks_total", labelnames=("op",))
+    assert c.value(op="fb_op_a") == 2
+    # the second call short-circuited through the registry: the fault site
+    # was never reached again, i.e. no second TPU compile attempt
+    assert sched.calls("dispatch.lower") == 1
+    assert "fb_op_a" in fb.fallback_ops()
+    assert obs.gauge("dispatch.fallback_ops").value() == 1
+
+
+def test_same_schedule_yields_same_trace():
+    def run():
+        fb.reset()
+        sched = _lowering_fault()
+        x = _t([1.0, 2.0])
+        with installed(sched):
+            apply("fb_det", _mul2, x)
+            apply("fb_det", _mul2, x)
+        return tuple(sched.trace)
+
+    t1, t2 = run(), run()
+    assert t1 == t2 == (("dispatch.lower", 1, "error"),)
+
+
+def test_execute_site_failure_also_degrades():
+    # first-execution compile failure (after trace, before results land)
+    x = _t([1.0, -1.0])
+    sched = _lowering_fault(site="dispatch.execute")
+    with installed(sched), warnings.catch_warnings():
+        warnings.simplefilter("ignore", fb.BackendFallbackWarning)
+        y = apply("fb_exec", _mul2, x)
+    np.testing.assert_allclose(np.asarray(y._data), [2.0, -2.0])
+    assert "fb_exec" in fb.fallback_ops()
+
+
+def test_gradient_flows_through_the_fallback_vjp():
+    x = _t([[1.0, 2.0], [3.0, 4.0]], grad=True)
+    with installed(_lowering_fault()), warnings.catch_warnings():
+        warnings.simplefilter("ignore", fb.BackendFallbackWarning)
+        y = apply("fb_grad", _mul2, x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((2, 2), 2.0))
+    # registry short-circuit path (second call) differentiates too
+    x2 = _t([1.0, 2.0], grad=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", fb.BackendFallbackWarning)
+        y2 = apply("fb_grad", _mul2, x2)
+    y2.sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [2.0, 2.0])
+
+
+# ---------------------------------------------------------------------------
+# the off knob / failure classification
+# ---------------------------------------------------------------------------
+
+def test_off_restores_the_hard_fail_surface():
+    fb.configure(mode="off")
+    x = _t([1.0])
+    with installed(_lowering_fault()):
+        with pytest.raises(NotImplementedError):
+            apply("fb_off", _mul2, x)
+    assert fb.fallback_ops() == frozenset()
+
+
+def test_env_knob_parsing(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FALLBACK", "off")
+    fb.reset()
+    assert not fb.enabled()
+    monkeypatch.setenv("PADDLE_TPU_FALLBACK", "auto")
+    fb.reset()
+    assert fb.enabled()
+    with pytest.raises(ValueError):
+        fb.configure(mode="sideways")
+
+
+def test_non_lowering_errors_propagate_unchanged():
+    x = _t([1.0])
+    sched = FaultSchedule().error("dispatch.lower", on=(1,),
+                                  error=ValueError("bad input"))
+    with installed(sched):
+        with pytest.raises(ValueError):
+            apply("fb_valerr", _mul2, x)
+    # OOM-shaped runtime errors are excluded: rerunning an OOM'd batch on
+    # host CPU would hide a capacity problem behind a 100x slowdown
+    sched = FaultSchedule().error(
+        "dispatch.lower", on=(1,),
+        error=fb.XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    with installed(sched):
+        with pytest.raises(fb.XlaRuntimeError):
+            apply("fb_oom", _mul2, x)
+    assert fb.fallback_ops() == frozenset()
+
+
+def test_cpu_side_failure_does_not_pin_the_op():
+    # an op whose fn fails on CPU too keeps its real error surface: no
+    # registry entry (which would skip the TPU compile forever), no
+    # "falling back from now on" warning, no fallbacks_total count
+    obs.enable()
+
+    def broken(x):
+        raise NotImplementedError("no lowering on ANY backend")
+
+    x = _t([1.0])
+    with installed(_lowering_fault()):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            with pytest.raises(NotImplementedError):
+                apply("fb_cpu_broken", broken, x)
+    assert fb.fallback_ops() == frozenset()
+    assert not any(issubclass(m.category, fb.BackendFallbackWarning)
+                   for m in w)
+    c = obs.counter("dispatch.fallbacks_total", labelnames=("op",))
+    assert c.value(op="fb_cpu_broken") == 0
+
+
+def test_is_lowering_failure_classification():
+    assert fb.is_lowering_failure(NotImplementedError("no lowering"))
+    assert fb.is_lowering_failure(
+        fb.XlaRuntimeError("UNIMPLEMENTED: op not supported on this backend"))
+    assert not fb.is_lowering_failure(
+        fb.XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory on device"))
+    assert not fb.is_lowering_failure(ValueError("unsupported dtype"))
+
+
+# ---------------------------------------------------------------------------
+# dispatch-cache composition (backend joins the key)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _cache_on():
+    prev = (dcache._ENABLED, dcache._MAXSIZE, dcache._WARMUP)
+    dcache.configure(enabled=True, maxsize=64, warmup=1)
+    dcache.cache_clear()
+    yield
+    dcache.configure(enabled=prev[0], maxsize=prev[1], warmup=prev[2])
+    dcache.cache_clear()
+
+
+def test_backend_token_changes_the_cache_key():
+    sigs = (((2, 2), np.dtype("float32"), False),)
+    k1, _ = dcache.make_key("op", _mul2, sigs, {}, None, False, False, 0,
+                            backend="")
+    k2, _ = dcache.make_key("op", _mul2, sigs, {}, None, False, False, 0,
+                            backend="cpu")
+    assert k1 is not None and k2 is not None and k1 != k2
+
+
+def test_cached_tpu_callable_never_served_after_fallback(_cache_on):
+    obs.enable()
+    x = _t([[1.0, 2.0], [3.0, 4.0]])
+    want = np.asarray(x._data) * 2.0
+    y1 = apply("fb_cache", _mul2, x)       # cold: uncached path
+    y2 = apply("fb_cache", _mul2, x)       # warm: compiled + served
+    y3 = apply("fb_cache", _mul2, x)       # hit
+    pre = dcache.cache_info()
+    assert pre["compiles"] == 1 and pre["hits"] >= 1
+
+    # the op falls back mid-process: its signatures now key differently,
+    # so the compiled default-placement callable above is unreachable
+    fb.note_fallback("fb_cache")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", fb.BackendFallbackWarning)
+        y4 = apply("fb_cache", _mul2, x)   # cold under the cpu-backend key
+        y5 = apply("fb_cache", _mul2, x)   # warm: compiles the CPU entry
+        y6 = apply("fb_cache", _mul2, x)   # hit on the cpu-backend key
+    for y in (y1, y2, y3, y4, y5, y6):
+        np.testing.assert_allclose(np.asarray(y._data), want)
+    post = dcache.cache_info()
+    assert post["compiles"] == 2           # one per backend key, no reuse
+    assert post["compiled"] == 2
+    # every post-fallback dispatch was counted on the fallback path
+    c = obs.counter("dispatch.fallbacks_total", labelnames=("op",))
+    assert c.value(op="fb_cache") == 3
+
+
+def test_cached_fallback_path_differentiates(_cache_on):
+    fb.note_fallback("fb_cache_grad")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", fb.BackendFallbackWarning)
+        for _ in range(3):                 # cold, compile, hit
+            x = _t([1.0, 2.0], grad=True)
+            y = apply("fb_cache_grad", _mul2, x)
+            y.sum().backward()
+            np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+# ---------------------------------------------------------------------------
+# denylist semantics
+# ---------------------------------------------------------------------------
+
+def test_denylist_is_inert_without_an_accelerator():
+    for op in fb.DEFAULT_DENYLIST:
+        assert not fb.should_fallback(op)
+        assert fb.backend_token(op) == ""
+
+
+def test_denylist_engages_with_an_accelerator(monkeypatch):
+    monkeypatch.setattr(device_mod, "is_compiled_with_tpu", lambda: True)
+    assert fb.should_fallback("eig")
+    assert fb.backend_token("eig") == "cpu"
+    # a denylist-seeded op skips the doomed compile on its FIRST call:
+    # no fault ever fires because the fault site is never reached
+    fb.configure(denylist=frozenset({"fb_deny"}))
+    x = _t([1.0, 2.0])
+    sched = _lowering_fault()
+    with installed(sched):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            y = apply("fb_deny", _mul2, x)
+    np.testing.assert_allclose(np.asarray(y._data), [2.0, 4.0])
+    assert sched.calls("dispatch.lower") == 0
+    fbw = [m for m in w if issubclass(m.category, fb.BackendFallbackWarning)]
+    assert len(fbw) == 1 and "denylisted" in str(fbw[0].message)
+
+
+# ---------------------------------------------------------------------------
+# observability: Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def test_fallback_series_appear_in_prometheus_export():
+    obs.enable()
+    x = _t([1.0])
+    with installed(_lowering_fault()), warnings.catch_warnings():
+        warnings.simplefilter("ignore", fb.BackendFallbackWarning)
+        apply("fb_prom", _mul2, x)
+        apply("fb_prom", _mul2, x)
+    parsed = obs.parse_prometheus_text(obs.prometheus_text())
+    assert parsed["dispatch_fallbacks_total"]['{op="fb_prom"}'] == 2.0
+    assert parsed["dispatch_fallback_ops"][""] == 1.0
+    # the injected fault itself is visible too (resilience integration)
+    assert parsed["resilience_injected_faults_total"][
+        '{kind="error",site="dispatch.lower"}'] == 1.0

@@ -35,7 +35,10 @@ EXPECTED_RULES = {"trace-impurity", "silent-swallow", "hot-path-import",
                   "cross-trace-impurity", "cross-host-sync",
                   "lock-order", "import-layering",
                   # PR 5 (resilience): retry loops belong to the policies
-                  "naked-retry"}
+                  "naked-retry",
+                  # PR 6 (backend fallback): placement belongs to
+                  # device.py / core/fallback.py
+                  "device-access"}
 
 
 def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
@@ -49,7 +52,7 @@ def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
 # rule registry
 # ---------------------------------------------------------------------------
 
-def test_all_ten_rules_registered():
+def test_all_eleven_rules_registered():
     assert EXPECTED_RULES <= set(RULES)
 
 
@@ -297,6 +300,57 @@ def test_naked_retry_negative_plain_poll_and_allowed_path(tmp_path):
     assert _lint_snippet(
         tmp_path, dirty, "naked-retry", filename="policy.py",
         config={"retry_allowed_paths": ["policy.py"]}) == []
+
+
+# ---------------------------------------------------------------------------
+# device-access
+# ---------------------------------------------------------------------------
+
+def test_device_access_positive_call_alias_and_from_import(tmp_path):
+    found = _lint_snippet(tmp_path, """\
+        import jax as j
+        from jax import device_put
+
+        def move(arr):
+            dev = j.devices("cpu")[0]
+            return device_put(arr, dev)
+        """, "device-access")
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "jax.devices" in msgs and "from jax import device_put" in msgs
+    # `import jax.numpy` (no asname) also binds the top-level `jax` name
+    found = _lint_snippet(tmp_path, """\
+        import jax.numpy
+
+        def move(arr, dev):
+            return jax.device_put(arr, dev)
+        """, "device-access")
+    assert len(found) == 1 and "jax.device_put" in found[0].message
+
+
+def test_device_access_negative_allowed_paths_and_unrelated_attrs(tmp_path):
+    # the sanctioned owners are exempt (config default covers the real
+    # tree; fixture passes its own allowed list)
+    dirty = """\
+        import jax
+
+        def put(arr, dev):
+            return jax.device_put(arr, dev)
+        """
+    assert _lint_snippet(
+        tmp_path, dirty, "device-access", filename="fallback.py",
+        config={"device_access_allowed_paths": ["fallback.py"]}) == []
+    # an unrelated attr named devices on a non-jax object is not a finding
+    clean = """\
+        import jax
+
+        def shapes(mesh):
+            return mesh.devices()  # Mesh.devices, not jax.devices
+
+        def grids(x):
+            return jax.numpy.asarray(x)
+        """
+    assert _lint_snippet(tmp_path, clean, "device-access") == []
 
 
 def test_naked_retry_nested_def_does_not_inherit_loop(tmp_path):

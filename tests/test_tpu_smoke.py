@@ -1,0 +1,321 @@
+"""On-chip smoke tier: one fast test per op family, generated from ops.yaml.
+
+All 800+ default-tier tests pin ``jax_platforms=cpu`` (conftest), which is
+exactly how the real chip's missing lowerings survived five review rounds
+(ROADMAP item 2). This module is the transfer proof: every op FAMILY in the
+manifest (``paddle_tpu/ops/ops.yaml``) gets one tiny, fast invocation that
+runs UNPINNED on real hardware —
+
+    PADDLE_TPU_TIER=1 python -m pytest tests -m tpu
+
+— and skips cleanly on CPU hosts (conftest adds the skip when no
+accelerator is present). Green here means green CI finally says something
+about the device the framework is named for; an op with no TPU lowering
+degrades through the backend-fallback path (core/fallback.py) with a
+warning instead of failing the tier.
+
+Rot protection: the family list is DERIVED from ops.yaml at collection
+time, and ``test_smoke_covers_every_family`` (unmarked — it runs in
+tier-1, on CPU) fails the moment a new op lands in a family with no smoke
+entry. Adding an op to an existing family costs nothing; adding a new
+family means writing one ~3-line smoke fn here.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+OPS_YAML = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "paddle_tpu", "ops", "ops.yaml")
+
+
+def _load_ops():
+    """[{op, module (last segment), arity}] — tiny line parser so the test
+    does not depend on a yaml library."""
+    ops, cur = [], None
+    with open(OPS_YAML) as f:
+        for line in f:
+            line = line.rstrip()
+            if line.startswith("- op: "):
+                cur = {"op": line[6:].strip(), "module": "?", "arity": 0}
+                ops.append(cur)
+            elif cur is not None and line.startswith("  module: "):
+                cur["module"] = line[10:].strip().rsplit(".", 1)[-1]
+            elif cur is not None and line.startswith("  args: "):
+                sig = line[8:].strip().strip('"').strip("()")
+                n = 0
+                for part in sig.split(","):
+                    part = part.strip()
+                    if not part or "=" in part:
+                        break
+                    n += 1
+                cur["arity"] = n
+    return ops
+
+
+# name-pattern rules run first (ordered); then the module map; _helpers
+# splits by arity. Coarse on purpose: a family is "ops that exercise the
+# same lowering surface", not a taxonomy.
+_NAME_RULES = (
+    (re.compile(r"conv"), "conv"),
+    (re.compile(r"pool"), "pool"),
+    (re.compile(r"dropout"), "dropout"),
+    (re.compile(r"(_norm$|^normalize$)"), "norm"),
+    (re.compile(r"embedding"), "embedding"),
+    (re.compile(r"(attention|^softmax_mask_fuse)"), "attention"),
+    (re.compile(r"(loss|entropy|_cost$)"), "loss"),
+    (re.compile(r"^segment_"), "segment"),
+    (re.compile(r"^(as_strided|strides|is_contiguous|view_as|view|unfold)$"),
+     "strided"),
+    (re.compile(r"^(bernoulli_|standard_gamma|top_p_sampling|binomial|"
+                r"log_normal|cauchy_|geometric_)"), "sampling"),
+)
+
+_MODULE_FAMILIES = {
+    "activation": "activation",
+    "array": "tensor_array",
+    "conv_pool": "resample",       # leftovers: interpolate/upsample/shuffle
+    "creation": "creation",
+    "flash_attention": "attention",
+    "geometric": "segment",
+    "indexing": "indexing",
+    "linalg": "linalg",
+    "loss_ops": "loss",
+    "manipulation": "manipulation",
+    "math": "math",
+    "math_ext": "math_ext",
+    "math_ext2": "math_ext2",
+    "math_ext4": "math_ext4",
+    "nn_ext": "nn_misc",
+    "nn_ops": "nn_misc",
+    "quant": "quantization",
+    "reduce": "reduce",
+}
+
+
+def family_of(op: str, module: str, arity: int) -> str:
+    for pat, fam in _NAME_RULES:
+        if pat.search(op):
+            return fam
+    if module == "_helpers":
+        return "elementwise_unary" if arity <= 1 else "elementwise_binary"
+    return _MODULE_FAMILIES.get(module, module)
+
+
+_OPS = _load_ops()
+FAMILIES = sorted({family_of(o["op"], o["module"], o["arity"])
+                   for o in _OPS})
+
+
+def _t(data, dtype="float32", stop_gradient=True):
+    import paddle_tpu as paddle
+    return paddle.to_tensor(np.asarray(data, dtype=dtype),
+                            stop_gradient=stop_gradient)
+
+
+def _rand(*shape):
+    return np.random.default_rng(0).standard_normal(shape).astype("float32")
+
+
+# One tiny invocation per family. Keep each under a second of compile on
+# the chip: smallest shapes that still hit the family's real lowering.
+def _smoke_activation():
+    import paddle_tpu as paddle
+    out = paddle.nn.functional.gelu(_t(_rand(4, 8))).numpy()
+    assert out.shape == (4, 8) and np.isfinite(out).all()
+
+
+def _smoke_attention():
+    import paddle_tpu as paddle
+    q = _t(_rand(1, 4, 2, 8))
+    out = paddle.nn.functional.scaled_dot_product_attention(q, q, q)
+    assert out.numpy().shape == (1, 4, 2, 8)
+
+
+def _smoke_conv():
+    import paddle_tpu as paddle
+    out = paddle.nn.functional.conv2d(_t(_rand(1, 3, 8, 8)),
+                                      _t(_rand(4, 3, 3, 3)))
+    assert out.numpy().shape == (1, 4, 6, 6)
+
+
+def _smoke_creation():
+    import paddle_tpu as paddle
+    out = paddle.full([2, 3], 7.0).numpy()
+    np.testing.assert_allclose(out, np.full((2, 3), 7.0))
+
+
+def _smoke_dropout():
+    import paddle_tpu as paddle
+    x = _t(_rand(4, 4))
+    out = paddle.nn.functional.dropout(x, p=0.5, training=False).numpy()
+    np.testing.assert_allclose(out, x.numpy())
+
+
+def _smoke_elementwise_binary():
+    import paddle_tpu as paddle
+    a, b = _rand(3, 4), _rand(3, 4)
+    np.testing.assert_allclose(paddle.add(_t(a), _t(b)).numpy(), a + b,
+                               rtol=1e-6)
+
+
+def _smoke_elementwise_unary():
+    import paddle_tpu as paddle
+    a = np.abs(_rand(3, 4)) + 0.1
+    np.testing.assert_allclose(paddle.sqrt(_t(a)).numpy(), np.sqrt(a),
+                               rtol=1e-6)
+
+
+def _smoke_embedding():
+    import paddle_tpu as paddle
+    out = paddle.nn.functional.embedding(
+        _t([[0, 2], [1, 3]], dtype="int64"), _t(_rand(8, 5)))
+    assert out.numpy().shape == (2, 2, 5)
+
+
+def _smoke_indexing():
+    import paddle_tpu as paddle
+    a = _rand(5, 3)
+    out = paddle.index_select(_t(a), _t([0, 3], dtype="int64")).numpy()
+    np.testing.assert_allclose(out, a[[0, 3]])
+
+
+def _smoke_linalg():
+    import paddle_tpu as paddle
+    a, b = _rand(4, 3), _rand(3, 5)
+    np.testing.assert_allclose(paddle.matmul(_t(a), _t(b)).numpy(), a @ b,
+                               rtol=1e-4, atol=1e-5)
+
+
+def _smoke_loss():
+    import paddle_tpu as paddle
+    out = paddle.nn.functional.mse_loss(_t(_rand(4, 2)), _t(_rand(4, 2)))
+    assert np.isfinite(out.numpy()).all()
+
+
+def _smoke_manipulation():
+    import paddle_tpu as paddle
+    a = _rand(2, 6)
+    out = paddle.transpose(paddle.reshape(_t(a), [3, 4]), [1, 0]).numpy()
+    np.testing.assert_allclose(out, a.reshape(3, 4).T)
+
+
+def _smoke_math():
+    import paddle_tpu as paddle
+    a = _rand(3, 3)
+    np.testing.assert_allclose(paddle.clip(_t(a), -0.5, 0.5).numpy(),
+                               np.clip(a, -0.5, 0.5))
+
+
+def _smoke_math_ext():
+    import paddle_tpu as paddle
+    out = paddle.cdist(_t(_rand(4, 3)), _t(_rand(5, 3))).numpy()
+    assert out.shape == (4, 5) and (out >= 0).all()
+
+
+def _smoke_math_ext2():
+    import paddle_tpu as paddle
+    a, b = _rand(2, 2), _rand(2, 2)
+    out = paddle.block_diag(_t(a), _t(b)).numpy()
+    assert out.shape == (4, 4) and np.allclose(out[:2, :2], a)
+
+
+def _smoke_math_ext4():
+    import paddle_tpu as paddle
+    a, b = _rand(3, 2), _rand(3, 2)
+    np.testing.assert_allclose(paddle.add_n([_t(a), _t(b)]).numpy(), a + b,
+                               rtol=1e-6)
+
+
+def _smoke_nn_misc():
+    import paddle_tpu as paddle
+    out = paddle.nn.functional.linear(_t(_rand(4, 3)), _t(_rand(3, 5)))
+    assert out.numpy().shape == (4, 5)
+
+
+def _smoke_norm():
+    import paddle_tpu as paddle
+    out = paddle.nn.functional.layer_norm(
+        _t(_rand(4, 8)), 8, weight=_t(np.ones(8)), bias=_t(np.zeros(8)))
+    assert abs(float(out.numpy().mean())) < 1e-3
+
+
+def _smoke_pool():
+    import paddle_tpu as paddle
+    out = paddle.nn.functional.max_pool2d(_t(_rand(1, 2, 8, 8)),
+                                          kernel_size=2)
+    assert out.numpy().shape == (1, 2, 4, 4)
+
+
+def _smoke_quantization():
+    import paddle_tpu as paddle
+    w = _t(_rand(8, 4))
+    qw, scale = paddle.nn.quant.weight_quantize(w)
+    deq = paddle.nn.quant.weight_dequantize(qw, scale).numpy()
+    np.testing.assert_allclose(deq, w.numpy(), atol=0.05)
+
+
+def _smoke_reduce():
+    import paddle_tpu as paddle
+    a = _rand(3, 4)
+    np.testing.assert_allclose(paddle.logsumexp(_t(a)).numpy(),
+                               np.log(np.exp(a).sum()), rtol=1e-5)
+
+
+def _smoke_resample():
+    import paddle_tpu as paddle
+    out = paddle.nn.functional.pixel_shuffle(_t(_rand(1, 4, 3, 3)), 2)
+    assert out.numpy().shape == (1, 1, 6, 6)
+
+
+def _smoke_sampling():
+    import paddle_tpu as paddle
+    out = paddle.standard_gamma(_t(np.full((64,), 2.0))).numpy()
+    assert out.shape == (64,) and (out >= 0).all()
+
+
+def _smoke_segment():
+    import paddle_tpu as paddle
+    out = paddle.geometric.segment_sum(
+        _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]),
+        _t([0, 0, 1], dtype="int64")).numpy()
+    np.testing.assert_allclose(out, [[4.0, 6.0], [5.0, 6.0]])
+
+
+def _smoke_strided():
+    import paddle_tpu as paddle
+    t = _t(np.arange(12, dtype="float32").reshape(3, 4))
+    assert t.strides == [4, 1] and t.is_contiguous()
+    out = paddle.as_strided(t, [2, 2], [4, 1]).numpy()
+    np.testing.assert_allclose(out, [[0.0, 1.0], [4.0, 5.0]])
+
+
+def _smoke_tensor_array():
+    import paddle_tpu as paddle
+    arr = paddle.tensor.create_array("float32")
+    i = paddle.zeros([1], dtype="int64")
+    paddle.tensor.array_write(_t([1.0, 2.0]), i, arr)
+    out = paddle.tensor.array_read(arr, i).numpy()
+    np.testing.assert_allclose(out, [1.0, 2.0])
+
+
+SMOKE = {name[len("_smoke_"):]: fn for name, fn in list(globals().items())
+         if name.startswith("_smoke_")}
+
+
+def test_smoke_covers_every_family():
+    """Tier-1 (CPU) rot gate: every family derivable from ops.yaml has a
+    smoke entry, and the tier is big enough to mean something."""
+    missing = sorted(set(FAMILIES) - set(SMOKE))
+    assert not missing, (
+        f"op families with no on-chip smoke test: {missing} — add a "
+        f"_smoke_<family>() fn to tests/test_tpu_smoke.py")
+    assert len(FAMILIES) >= 25, FAMILIES
+
+
+@pytest.mark.tpu
+@pytest.mark.parametrize("family", FAMILIES)
+def test_family_smoke(family):
+    SMOKE[family]()

@@ -84,6 +84,40 @@ class TestRoIOps:
         out.mean().backward()
         assert layer.weight.grad is not None
 
+    def test_deform_conv2d_offset_shape_error_names_everything(self):
+        # InferMeta-style validation: the error names the op, the argument,
+        # and got-vs-expected shapes — not a raw jax broadcast error
+        x = paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32))
+        w = paddle.to_tensor(np.zeros((4, 3, 3, 3), np.float32))
+        bad_off = paddle.to_tensor(np.zeros((1, 17, 6, 6), np.float32))
+        with pytest.raises(ValueError) as ei:
+            vops.deform_conv2d(x, bad_off, w)
+        msg = str(ei.value)
+        assert "deform_conv2d" in msg and "offset" in msg
+        assert "18" in msg and "17" in msg   # expected 2*1*3*3 vs got
+
+    def test_deform_conv2d_more_shape_errors(self):
+        x = paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32))
+        w = paddle.to_tensor(np.zeros((4, 3, 3, 3), np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        # wrong x rank
+        with pytest.raises(ValueError, match=r"deform_conv2d: x expected"):
+            vops.deform_conv2d(
+                paddle.to_tensor(np.zeros((3, 8, 8), np.float32)), off, w)
+        # offset spatial shape must be the conv output H_out x W_out
+        with pytest.raises(ValueError, match=r"offset.*\[6, 6\]"):
+            vops.deform_conv2d(
+                x, paddle.to_tensor(np.zeros((1, 18, 8, 8), np.float32)), w)
+        # weight channel mismatch against groups
+        with pytest.raises(ValueError, match=r"deform_conv2d: weight"):
+            vops.deform_conv2d(
+                x, off, paddle.to_tensor(np.zeros((4, 2, 3, 3), np.float32)))
+        # mask shape (modulated variant)
+        with pytest.raises(ValueError, match=r"deform_conv2d: mask"):
+            vops.deform_conv2d(
+                x, off, w,
+                mask=paddle.to_tensor(np.zeros((1, 8, 6, 6), np.float32)))
+
 
 class TestNNUtils:
     def test_weight_norm_preserves_output_and_trains(self):

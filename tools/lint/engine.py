@@ -139,6 +139,13 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # naked-retry: the module(s) allowed to own raw sleep-in-retry-loop
     # mechanics — everything else routes through their policies
     "retry_allowed_paths": ["paddle_tpu/resilience"],
+    # device-access: the only modules allowed to call jax.devices /
+    # jax.device_put directly — the Place taxonomy and the backend-
+    # fallback dispatcher (PR 6); everything else routes through them
+    "device_access_allowed_paths": [
+        "paddle_tpu/device.py",
+        "paddle_tpu/core/fallback.py",
+    ],
     # cross-host-sync: whole-program reachability roots of the eager
     # dispatch fast path ("<path>::<function simple name>"): anything a
     # dispatch can reach pays its host syncs once per op

@@ -8,6 +8,7 @@ subclasses and run once per invocation over the whole-program graphs.
 from . import (  # noqa: F401
     cross_host_sync,
     cross_trace_impurity,
+    device_access,
     hot_path_import,
     host_sync,
     import_layering,
