@@ -17,8 +17,17 @@ Three numbers, one JSON line:
     the only honest serving number; on directly-attached TPUs the
     per-token path converges toward it.
 
+A fourth mode, ``--serving``, drives the continuous-batching engine
+(`paddle_tpu.serving`) over the SAME model: aggregate tok/s at batch
+sizes 1/4/16 through the paged KV cache (``--kv-dtype native|bf16|int8``),
+with per-request greedy parity pinned against the bs=1 per-token compiled
+loop. Serving throughput = batch x per-token rate — the "millions of
+users" number (ROADMAP item 1).
+
 Usage: python benchmarks/bench_generation.py [--layers 22] [--prompt 512]
        [--tokens 64] [--scan-k 16]
+       python benchmarks/bench_generation.py --serving [--kv-dtype int8]
+       [--serving-batches 1,4,16]
 """
 
 from __future__ import annotations
@@ -31,6 +40,16 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
+
+# --serving JSON schema of record: what RESULTS.md / BENCH_r0*.json diffs key
+# on, pinned by tests/test_bench_selfdefense.py. Change both together.
+SERVING_RESULT_FIELDS = (
+    "benchmark", "params", "layers", "hidden", "dtype", "kv_dtype",
+    "page_size", "prompt", "tokens", "single_stream_tokens_per_sec",
+    "serving", "speedup_vs_single_stream", "device")
+SERVING_ROW_FIELDS = (
+    "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "scan_greedy_parity",
+    "match_frac", "batch_utilization")
 
 
 def main() -> None:
@@ -45,6 +64,14 @@ def main() -> None:
     ap.add_argument("--tokens", type=int, default=64)
     ap.add_argument("--max-len", type=int, default=1024)
     ap.add_argument("--scan-k", type=int, default=16)
+    ap.add_argument("--serving", action="store_true",
+                    help="continuous-batching engine: aggregate tok/s at "
+                         "--serving-batches with greedy parity vs the bs=1 "
+                         "per-token loop")
+    ap.add_argument("--serving-batches", default="1,4,16")
+    ap.add_argument("--kv-dtype", default="native",
+                    choices=("native", "bf16", "int8"))
+    ap.add_argument("--page-size", type=int, default=64)
     args = ap.parse_args()
 
     import jax
@@ -89,14 +116,15 @@ def main() -> None:
         nxt = paddle.argmax(logits, axis=-1)   # (B, 1) greedy
         return nxt.astype("int32"), cache
 
-    @paddle.jit.to_static
-    def prefill(ids, cache):
+    def prefill_raw(ids, cache):
         x = embed(ids)
         x, cache = fmt(x, caches=cache, time_step=None)
         x = final_ln(x)
         logits = head(x[:, -1:])
         nxt = paddle.argmax(logits, axis=-1)
         return nxt.astype("int32"), cache
+
+    prefill = paddle.jit.to_static(prefill_raw)
 
     @paddle.jit.to_static
     def decode_one(tok, cache, t):
@@ -122,6 +150,11 @@ def main() -> None:
             return carry[0], carry[1], carry[2], toks
 
         return apply("decode_scan_k", fn, tok, cache, t, amp=False)
+
+    if args.serving:
+        _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
+                     n_params, L=L, H=H, E=E, V=V, M=M, dtype=dtype)
+        return
 
     rng = np.random.default_rng(0)
     ids = paddle.to_tensor(rng.integers(0, V, (B, args.prompt),
@@ -199,6 +232,137 @@ def main() -> None:
     }))
     if not parity:
         print(f"PARITY FAIL: scan {got} vs per-token {ref}", file=sys.stderr)
+        sys.exit(1)
+
+
+def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
+                 n_params, *, L, H, E, V, M, dtype):
+    """Continuous-batching throughput: aggregate tok/s per batch size with
+    per-request greedy parity against the bs=1 per-token compiled loop."""
+    import jax
+
+    from paddle_tpu import observability as obs
+    from paddle_tpu import serving
+
+    obs.enable()   # batch_utilization is MEASURED from the engine's step/
+    # token counters, not derived from config (which would pin it at 1.0)
+
+    def serving_counters():
+        snap = obs.snapshot()
+        return (snap.get("serving.steps_total", 0) or 0,
+                snap.get("serving.tokens_total", 0) or 0)
+
+    bss = sorted({int(b) for b in args.serving_batches.split(",") if b})
+    max_bs = bss[-1]
+    page_size = min(args.page_size, M)
+    if args.tokens < 2 or M - args.prompt - 2 < 2:
+        print(f"--serving needs >= 2 decode tokens (the single-stream rate "
+              f"is measured over tokens after the first): got --tokens "
+              f"{args.tokens} with prompt {args.prompt} / max_len {M}",
+              file=sys.stderr)
+        sys.exit(2)
+    n_new = min(args.tokens, M - args.prompt - 2)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, V, (args.prompt,), dtype=np.int32)
+               for _ in range(max_bs)]
+
+    def sync(x):
+        return np.asarray(x._data)
+
+    # ---- bs=1 per-token reference: the parity oracle ----
+    def reference(prompt):
+        ids = paddle.to_tensor(prompt[None, :])
+        cache = paddle.zeros([L, 2, 1, H, M, E // H], dtype=dtype)
+        tok, cache = prefill(ids, cache)
+        toks = [int(sync(tok)[0, 0])]
+        t = paddle.full([1], args.prompt, dtype="int32")
+        for _ in range(n_new - 1):
+            tok, cache, t = decode_one(tok, cache, t)
+            toks.append(int(sync(tok)[0, 0]))
+        return toks
+
+    refs = [reference(p) for p in prompts]
+
+    # single-stream steady-state rate (compiled; no per-token host sync —
+    # the protocol of the non-serving decode timing above)
+    ids = paddle.to_tensor(prompts[0][None, :])
+    cache0 = paddle.zeros([L, 2, 1, H, M, E // H], dtype=dtype)
+    tok, cache = prefill(ids, cache0)
+    sync(tok)
+    t = paddle.full([1], args.prompt, dtype="int32")
+    t0 = time.perf_counter()
+    tk, ck, tt = tok, cache, t
+    for _ in range(n_new - 1):
+        tk, ck, tt = decode_one(tk, ck, tt)
+    sync(tk)
+    single_rate = (n_new - 1) / (time.perf_counter() - t0)
+
+    rows, parity_all = {}, True
+    for bs in bss:
+        buckets = tuple(b for b in (1, 4, 16) if b <= bs)
+        if not buckets or buckets[-1] < bs:
+            buckets += (bs,)
+        cfg = serving.ServingConfig(
+            num_layers=L, num_heads=H, head_dim=E // H, max_len=M,
+            max_batch=bs, buckets=buckets, page_size=page_size,
+            kv_dtype=args.kv_dtype, compute_dtype=dtype)
+        eng = serving.Engine(prefill_raw, lm_step, cfg)
+        eng.warmup(prompt_lens=[args.prompt])
+
+        def drain():
+            futs = [eng.submit(serving.GenerationRequest(
+                prompts[i], max_new_tokens=n_new)) for i in range(bs)]
+            eng.run()
+            return [f.result() for f in futs]
+
+        drain()                        # warm pass: everything compiled
+        s0, tk0 = serving_counters()
+        t0 = time.perf_counter()
+        results = drain()
+        elapsed = time.perf_counter() - t0
+        s1, tk1 = serving_counters()
+
+        fracs = [sum(a == b for a, b in zip(r.tokens, refs[i])) / n_new
+                 for i, r in enumerate(results)]
+        # same tolerance as the scan-parity gate: compiled programs fuse
+        # differently, a 1-ulp bf16 logit tie may flip an argmax
+        parity = min(fracs) >= 0.75
+        parity_all &= parity
+        bucket = next(b for b in buckets if b >= bs)
+        # decode-token occupancy of the bs-slot bucket over the drain:
+        # prefill emits bs first tokens outside decode steps; a mixed-
+        # length workload (or mid-run eviction) pulls this below 1.0
+        steps = s1 - s0
+        util = ((tk1 - tk0) - bs) / (steps * bucket) if steps else 1.0
+        rows[f"bs{bs}"] = {
+            "aggregate_tokens_per_sec": round(bs * n_new / elapsed, 1),
+            "ttft_ms": round(1e3 * float(np.mean(
+                [r.ttft_s for r in results])), 2),
+            "tpot_ms": round(1e3 * float(np.mean(
+                [r.tpot_s for r in results])), 2),
+            "scan_greedy_parity": parity,
+            "match_frac": round(min(fracs), 3),
+            "batch_utilization": round(util, 3),
+        }
+        assert set(rows[f"bs{bs}"]) == set(SERVING_ROW_FIELDS), \
+            "serving row drifted from SERVING_ROW_FIELDS"
+
+    top = rows[f"bs{max_bs}"]["aggregate_tokens_per_sec"]
+    payload = {
+        "benchmark": "serving_generation",
+        "params": n_params, "layers": L, "hidden": E, "dtype": dtype,
+        "kv_dtype": args.kv_dtype, "page_size": page_size,
+        "prompt": args.prompt, "tokens": n_new,
+        "single_stream_tokens_per_sec": round(single_rate, 1),
+        "serving": rows,
+        "speedup_vs_single_stream": round(top / single_rate, 2),
+        "device": str(jax.devices()[0]),
+    }
+    assert set(payload) == set(SERVING_RESULT_FIELDS), \
+        "serving payload drifted from SERVING_RESULT_FIELDS"
+    print(json.dumps(payload))
+    if not parity_all:
+        print(f"SERVING PARITY FAIL: {rows}", file=sys.stderr)
         sys.exit(1)
 
 

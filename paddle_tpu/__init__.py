@@ -81,6 +81,7 @@ from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .static import enable_static, disable_static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402

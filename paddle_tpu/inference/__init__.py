@@ -16,9 +16,12 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from .. import observability as _obs
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
 
@@ -139,6 +142,10 @@ class Predictor:
             raise ValueError(f"unknown inference artifact format: {fmt!r}")
         self._inputs = {n: _IOHandle(n) for n in self._input_names}
         self._outputs = {n: _IOHandle(n) for n in self._output_names}
+        # the serving layer (and any multi-threaded server) calls run()
+        # concurrently on one Predictor; the compiled call itself is pure,
+        # but the handle read/writes around it are not — serialize them
+        self._run_lock = threading.Lock()
 
     @staticmethod
     def _n_data_inputs(payload) -> int:
@@ -159,23 +166,30 @@ class Predictor:
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Either positional-list style ``run([arr, ...]) -> [arr, ...]`` or
-        handle style (copy_from_cpu … run() … copy_to_cpu)."""
+        handle style (copy_from_cpu … run() … copy_to_cpu). run() bodies
+        serialize on a per-predictor lock, so POSITIONAL-LIST calls are
+        fully thread-safe (each returns its own outputs). Handle-style use
+        spans the lock (write handles, run, read handles are three calls):
+        concurrent handle-style callers must coordinate externally or use
+        the positional form."""
         import jax.numpy as jnp
 
-        if inputs is not None:
-            arrs = [jnp.asarray(a) for a in inputs]
-        else:
-            arrs = [jnp.asarray(self._inputs[n].copy_to_cpu())
-                    for n in self._input_names]
-        if self._params is not None:
-            outs = self._exported.call(self._params, *arrs)
-        else:
-            outs = self._exported.call(*arrs)
-        if not isinstance(outs, (tuple, list)):
-            outs = (outs,)
-        outs_np = [np.asarray(o) for o in outs]
-        for n, o in zip(self._output_names, outs_np):
-            self._outputs[n]._array = o
+        with self._run_lock:
+            if inputs is not None:
+                arrs = [jnp.asarray(a) for a in inputs]
+            else:
+                arrs = [jnp.asarray(self._inputs[n].copy_to_cpu())
+                        for n in self._input_names]
+            if self._params is not None:
+                outs = self._exported.call(self._params, *arrs)
+            else:
+                outs = self._exported.call(*arrs)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            outs_np = [np.asarray(o) for o in outs]
+            for n, o in zip(self._output_names, outs_np):
+                self._outputs[n]._array = o
+        _obs.inc("inference.runs_total")
         return outs_np if inputs is not None else None
 
 

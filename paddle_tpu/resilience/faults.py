@@ -34,6 +34,13 @@ Sites threaded through the framework (exact-match tags):
                       the backend-fallback path (core/fallback.py)
 ``dispatch.execute``  after the op executed, before results are consumed
                       (first-execution compile failure seam)
+``serving.admit``     ``serving.engine`` admission attempt, before the
+                      prefill program runs (retried once; a second fault
+                      fails the request and frees its pages)
+``serving.step``      once per (decode step, included slot), in admission
+                      order — call index N deterministically targets one
+                      slot; a faulted slot sits the step out, a second
+                      fault fails it ALONE (batchmates unaffected)
 ====================  =====================================================
 
 Kinds: ``delay`` sleeps; ``error`` raises a fresh instance of the

@@ -1,0 +1,43 @@
+"""``paddle_tpu.serving`` — continuous-batching inference over the
+compiled decode path.
+
+The "millions of users" layer (ROADMAP item 1): the repo's compiled
+decode machinery (``FusedMultiTransformer`` stacked-cache steps, the
+programs ``benchmarks/bench_generation.py`` builds) decodes ONE sequence
+per program; serving throughput is batch × per-token rate, so this
+package multiplies the missing factor. Three pieces:
+
+* :mod:`~paddle_tpu.serving.kv_cache` — a slot-paged KV cache: a
+  preallocated page pool, per-slot page tables, and an int8 leg with
+  per-page absmax scales (``PADDLE_TPU_KV_DTYPE=bf16|int8``), reusing
+  the q8 absmax grid the optimizer state already uses.
+* :mod:`~paddle_tpu.serving.scheduler` — the bounded request queue and
+  iteration-level admission policies (FIFO, prefill-token budget).
+* :mod:`~paddle_tpu.serving.engine` — the step loop: one compiled
+  batched decode program per batch bucket ({1, 4, 16}), admission via
+  prefill-into-slot at step boundaries, per-slot eviction on
+  EOS/length/cancel, ``observability`` metrics and ``resilience`` fault
+  seams (``serving.step`` / ``serving.admit``).
+
+Quick start (see README "Serving")::
+
+    from paddle_tpu import serving
+
+    cfg = serving.ServingConfig(num_layers=L, num_heads=H, head_dim=D,
+                                max_len=1024, max_batch=16)
+    eng = serving.Engine(prefill_fn, step_fn, cfg).warmup()
+    fut = eng.submit(serving.GenerationRequest(prompt, max_new_tokens=64))
+    eng.start()                  # or eng.run() to drain synchronously
+    print(fut.result().tokens)
+"""
+
+from .kv_cache import KVCacheConfig, PagedKVCache  # noqa: F401
+from .scheduler import (GenerationRequest, GenerationResult,  # noqa: F401
+                        QueueFull, Scheduler)
+from .engine import Engine, ServingConfig  # noqa: F401
+
+__all__ = [
+    "KVCacheConfig", "PagedKVCache",
+    "GenerationRequest", "GenerationResult", "QueueFull", "Scheduler",
+    "Engine", "ServingConfig",
+]

@@ -1,0 +1,546 @@
+"""The serving step loop: continuous batching over ONE compiled decode
+program per batch bucket.
+
+Shape of the engine (the Orca/vLLM iteration-level-scheduling design over
+this repo's compiled-decode machinery):
+
+* The model enters as two pure Tensor callables — the exact functions
+  ``benchmarks/bench_generation.py`` already compiles:
+
+  - ``prefill_fn(ids (1, Lp), cache (L, 2, 1, H, max_len, D))
+    -> (first_token (1, 1) int, filled cache)``
+  - ``step_fn(tok (B, 1) int, cache (L, 2, B, H, max_len, D), t (B,) int)
+    -> (next_tok (B, 1) int, new cache)``
+
+  The engine never imports a model class: anything that decodes through
+  the stacked-cache layout (FusedMultiTransformer's serving path) plugs
+  in unchanged.
+
+* Around ``step_fn`` the engine traces ONE program per batch bucket:
+  gather the active slots' pages into the dense stacked cache
+  (dequantizing on the int8 leg), run the step, scatter back only the
+  page each slot wrote (``serving/kv_cache.py``). Paging costs no extra
+  dispatches — one compiled call and one host sync per step, for
+  ``B`` tokens.
+
+* Batch rows are assigned to active slots PER STEP (per-slot state is
+  host-side: a page-table row, a position, a last token), so the batch
+  dimension is always compact. It is padded up to a BUCKET size
+  (default {1, 4, 16}); padded rows point at the scratch page and are
+  masked by construction, so admission/eviction changes which program
+  runs only when the bucket changes — and every bucket can be compiled
+  up front (:meth:`Engine.warmup`), so admission never recompiles
+  mid-flight.
+
+* Admission happens at step boundaries via prefill-into-slot: the
+  scheduler pops what fits (slots + pages for the request's WHOLE
+  lifetime — no mid-flight preemption), the single-slot prefill program
+  fills the prompt's pages and emits the first token. Prefill compiles
+  per distinct prompt LENGTH (prompt padding would change the model's
+  attention; serve bucketed prompt lengths if that matters).
+
+Failure semantics (``resilience`` seams — all functional state, so a
+faulted step never half-writes the pool):
+
+* ``serving.admit`` fires once per admission attempt, before prefill.
+  One retry; a second fault fails THAT request (future gets the error),
+  its pages are freed, nothing else is touched.
+* ``serving.step`` fires once per (step, included slot), in admission
+  order — call index N deterministically targets one slot. A faulted
+  slot sits out the current step; the first fault retries it at the next
+  step, a second fault fails it. Its batchmates run the very same step
+  unaffected: a faulted slot fails ALONE.
+* An error from the compiled batched step itself (a real device fault —
+  injected per-slot faults never reach it) is retried once; if the retry
+  also fails every in-flight request gets the error, because the device
+  gave no per-slot attribution.
+
+Metrics: ``serving.requests_total{status}``, ``serving.tokens_total``,
+``serving.steps_total``, ``serving.prefills_total``,
+``serving.step_retries_total``, ``serving.queue_depth``,
+``serving.active_slots``, ``serving.batch_utilization``, and
+``serving.ttft_seconds`` / ``serving.tpot_seconds`` histograms.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _obs
+from ..resilience import faults as _faults
+from . import kv_cache as _kv
+from .scheduler import (GenerationRequest, GenerationResult, Scheduler,
+                        _Pending)
+
+__all__ = ["ServingConfig", "Engine"]
+
+
+@dataclass
+class ServingConfig:
+    """Engine sizing + policy. Model-shape fields must match the cache
+    layout the step/prefill callables consume."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    max_len: int
+    max_batch: int = 16
+    buckets: Tuple[int, ...] = (1, 4, 16)
+    max_queue: int = 64
+    page_size: int = 64
+    num_pages: Optional[int] = None      # default: full coverage + scratch
+    kv_dtype: str = ""                   # "" -> $PADDLE_TPU_KV_DTYPE or native
+    compute_dtype: str = "float32"
+    policy: str = "fifo"
+    prefill_token_budget: Optional[int] = None
+
+    def __post_init__(self):
+        self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))
+        if not self.buckets or self.buckets[-1] < self.max_batch:
+            raise ValueError(
+                f"buckets {self.buckets} must cover max_batch "
+                f"{self.max_batch}")
+        if not self.kv_dtype:
+            self.kv_dtype = os.environ.get(
+                "PADDLE_TPU_KV_DTYPE", "native").strip().lower() or "native"
+        if self.kv_dtype not in ("native", "bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be native|bf16|int8, got {self.kv_dtype!r} "
+                "(env: PADDLE_TPU_KV_DTYPE)")
+
+    def kv_config(self) -> _kv.KVCacheConfig:
+        cfg = _kv.KVCacheConfig(
+            num_layers=self.num_layers, num_heads=self.num_heads,
+            head_dim=self.head_dim, max_len=self.max_len,
+            page_size=self.page_size, num_pages=self.num_pages,
+            compute_dtype=self.compute_dtype, kv_dtype=self.kv_dtype)
+        if cfg.num_pages is None:
+            # every slot fully resident + the scratch page; requests with
+            # short prompt+max_new claim fewer pages, freeing pool for a
+            # deeper queue when num_pages is set below this default
+            cfg.num_pages = self.max_batch * cfg.pages_per_slot + 1
+        return cfg
+
+
+@dataclass(eq=False)                     # identity semantics: slots hold an
+class _Slot:                             # ndarray-bearing request, and
+    """Host-side state of one in-flight request (the device holds only
+    pool pages; batch row assignment happens per step). ``list.remove``
+    in ``_release`` must match THIS slot, not a field-equal one."""
+
+    pending: _Pending
+    page_ids: List[int]
+    table_row: np.ndarray               # (pages_per_slot,) int32
+    t: int                              # next cache write position
+    last_tok: int
+    tokens: List[int] = field(default_factory=list)
+    faults: int = 0
+    first_token_time: float = 0.0
+    last_token_time: float = 0.0
+
+    @property
+    def request(self) -> GenerationRequest:
+        return self.pending.request
+
+
+class Engine:
+    """Continuous-batching decode engine over a paged KV pool.
+
+    ``step()`` is single-consumer (call it from one thread: your own loop,
+    :meth:`run`, or the :meth:`start` background thread); ``submit`` and
+    ``cancel`` are safe from any thread.
+    """
+
+    def __init__(self, prefill_fn: Callable, step_fn: Callable,
+                 config: ServingConfig):
+        self.config = config
+        self._prefill_fn = prefill_fn
+        self._step_fn = step_fn
+        self.kv = _kv.PagedKVCache(config.kv_config())
+        self._quantized = self.kv.config.quantized
+        self.scheduler = Scheduler(
+            max_queue=config.max_queue, policy=config.policy,
+            prefill_token_budget=config.prefill_token_budget)
+        self._slots: List[_Slot] = []    # admission order == batch row order
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._build_programs()
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _build_programs(self) -> None:
+        from ..core.tensor import Tensor as _T, apply as _apply
+        from ..core.tracing import no_grad
+        from ..jit import to_static
+
+        cfg = self.kv.config
+        ps = cfg.page_size
+        compute_dtype = jnp.dtype(cfg.compute_dtype)
+        quantized = self._quantized
+        step_fn, prefill_fn = self._step_fn, self._prefill_fn
+        L, H, M, D = (cfg.num_layers, cfg.num_heads, cfg.max_len,
+                      cfg.head_dim)
+
+        def decode_fn(tok_a, tables_a, t_a, pool_a, *maybe_scales):
+            sc = maybe_scales[0] if quantized else None
+            dense = _kv.gather_pages(pool_a, sc, tables_a, compute_dtype)
+            with no_grad():
+                nxt, new_dense = step_fn(_T(tok_a), _T(dense), _T(t_a))
+            pool2, sc2 = _kv.scatter_token_page(
+                new_dense._data.astype(compute_dtype), pool_a, sc,
+                tables_a, t_a, ps)
+            out = (nxt._data.astype(jnp.int32), pool2)
+            return out + ((sc2,) if quantized else ())
+
+        def prefill_body(ids_a, row_a, len_a, pool_a, *maybe_scales):
+            sc = maybe_scales[0] if quantized else None
+            zero = jnp.zeros((L, 2, 1, H, M, D), compute_dtype)
+            with no_grad():
+                nxt, dense = prefill_fn(_T(ids_a), _T(zero))
+            pool2, sc2 = _kv.scatter_prefill_pages(
+                dense._data.astype(compute_dtype), pool_a, sc, row_a,
+                len_a, ps)
+            out = (nxt._data.astype(jnp.int32), pool2)
+            return out + ((sc2,) if quantized else ())
+
+        def decode_program(tok, tables, t, pool, *scales):
+            return _apply("serving_decode_step", decode_fn, tok, tables, t,
+                          pool, *scales, differentiable=False, amp=False)
+
+        def prefill_program(ids, row, true_len, pool, *scales):
+            return _apply("serving_prefill", prefill_body, ids, row,
+                          true_len, pool, *scales, differentiable=False,
+                          amp=False)
+
+        self._decode_program = to_static(decode_program)
+        self._prefill_program = to_static(prefill_program)
+
+    def _scales_args(self):
+        from ..core.tensor import Tensor as _T
+        return (_T(self.kv.scales),) if self._quantized else ()
+
+    def _set_pool(self, pool_t, scales_t) -> None:
+        self.kv.pool = pool_t._data
+        if scales_t is not None:
+            self.kv.scales = scales_t._data
+
+    def warmup(self, prompt_lens: Sequence[int] = ()) -> "Engine":
+        """Compile every batch bucket (and optional prefill lengths) up
+        front, against the scratch page only — admission then never
+        recompiles mid-flight. Idempotent; call before serving traffic."""
+        from ..core.tensor import Tensor as _T
+        S = self.kv.config.pages_per_slot
+        for b in self.config.buckets:
+            outs = self._decode_program(
+                _T(jnp.zeros((b, 1), jnp.int32)),
+                _T(jnp.zeros((b, S), jnp.int32)),
+                _T(jnp.zeros((b,), jnp.int32)),
+                _T(self.kv.pool), *self._scales_args())
+            # scratch-page writes from the all-padded batch are garbage by
+            # design but harmless — still, keep the pre-warmup pool bytes
+            del outs
+        for lp in prompt_lens:
+            self._prefill_program(
+                _T(jnp.zeros((1, int(lp)), jnp.int32)),
+                _T(jnp.zeros((S,), jnp.int32)),
+                _T(jnp.zeros((), jnp.int32)),
+                _T(self.kv.pool), *self._scales_args())
+        return self
+
+    # ------------------------------------------------------------------
+    # request surface
+    # ------------------------------------------------------------------
+    def _pages_needed(self, request: GenerationRequest) -> int:
+        last = min(self.config.max_len,
+                   int(request.prompt.size) + request.max_new_tokens)
+        return self.kv.pages_for(last)
+
+    def submit(self, request: GenerationRequest):
+        """Enqueue; returns a Future resolving to GenerationResult.
+        Raises QueueFull / ValueError (request can never fit) here, on
+        the caller's thread."""
+        if int(request.prompt.size) + request.max_new_tokens \
+                > self.config.max_len:
+            raise ValueError(
+                f"prompt ({request.prompt.size}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds max_len "
+                f"{self.config.max_len}")
+        if self._pages_needed(request) > self.kv.config.num_pages - 1:
+            raise ValueError("request needs more pages than the pool holds")
+        fut = self.scheduler.submit(request, submit_time=time.monotonic())
+        self._wake.set()
+        return fut
+
+    def cancel(self, request_id: int) -> bool:
+        ok = self.scheduler.cancel(request_id)
+        self._wake.set()
+        return ok
+
+    @property
+    def active_requests(self) -> int:
+        return len(self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.scheduler.queue_depth
+
+    # ------------------------------------------------------------------
+    # the step loop
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One step boundary: evict cancellations, admit what fits, run
+        ONE batched decode step. Returns False when there was nothing to
+        do (the idle step — no program runs, no device touch)."""
+        progressed = self._process_cancellations()
+        progressed |= self._admit()
+        if not self._slots:
+            self._publish_gauges(0, 0)
+            return progressed
+
+        included = self._fault_gate()
+        if included:
+            self._decode_step(included)
+            progressed = True
+        self._publish_gauges(len(included),
+                             self._bucket_for(len(included))
+                             if included else 0)
+        return progressed
+
+    def run(self) -> None:
+        """Drive step() until queue and slots drain (bench/offline mode)."""
+        while self.scheduler.queue_depth or self._slots:
+            self.step()
+
+    def start(self) -> "Engine":
+        """Serve from a background thread until stop()."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.step():
+                    self._wake.wait(0.01)
+                    self._wake.clear()
+
+        self._thread = threading.Thread(
+            target=loop, name="paddle-tpu-serving", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # -- step phases ----------------------------------------------------
+    def _process_cancellations(self) -> bool:
+        cancelled = self.scheduler.take_cancelled_active()
+        if not cancelled:
+            return False
+        hit = False
+        for slot in [s for s in self._slots
+                     if s.request.request_id in cancelled]:
+            self._finish(slot, "cancelled")
+            hit = True
+        return hit
+
+    def _admit(self) -> bool:
+        free_slots = self.config.max_batch - len(self._slots)
+        if free_slots <= 0:
+            return False
+        # ``claimed`` reserves pages WITHIN this boundary's admission
+        # batch: free_pages alone would let every queued request pass the
+        # check against the same pages, over-committing the pool and then
+        # letting a small request slip past a requeued large one —
+        # breaking the scheduler's strict-FIFO contract
+        claimed = 0
+
+        def can_fit(req: GenerationRequest) -> bool:
+            nonlocal claimed
+            need = self._pages_needed(req)
+            if claimed + need > self.kv.free_pages:
+                return False
+            claimed += need
+            return True
+
+        pending = self.scheduler.next_admissions(free_slots, can_fit)
+        admitted = False
+        for i, p in enumerate(pending):
+            status = self._admit_one(p)
+            admitted |= status == "ok"
+            if status == "noroom":
+                # pool raced out from under the reservation (defensive —
+                # single consumer makes this unreachable today): put THIS
+                # request and everything behind it back in order
+                self.scheduler.requeue(pending[i:])
+                break
+        return admitted
+
+    def _admit_one(self, pending: _Pending) -> str:
+        """Admit one popped request: ``"ok"`` | ``"failed"`` (future got
+        the error, nothing to requeue) | ``"noroom"`` (untouched — the
+        caller must requeue it and everything behind it)."""
+        from ..core.tensor import Tensor as _T
+        req = pending.request
+        pages = self.kv.alloc(self._pages_needed(req))
+        if pages is None:
+            return "noroom"
+        try:
+            for attempt in (0, 1):
+                try:
+                    _faults.fault_point("serving.admit")
+                    break
+                except Exception as exc:
+                    if attempt:
+                        raise exc
+                    _obs.inc("serving.admit_retries_total")
+            row = self.kv.table_row(pages)
+            outs = self._prefill_program(
+                _T(jnp.asarray(req.prompt[None, :], jnp.int32)),
+                _T(jnp.asarray(row)),
+                _T(jnp.asarray(req.prompt.size, jnp.int32)),
+                _T(self.kv.pool), *self._scales_args())
+        except Exception as exc:
+            self.kv.free(pages)
+            _obs.inc("serving.requests_total", status="failed")
+            pending.future.set_exception(exc)
+            return "failed"
+        self._set_pool(outs[1], outs[2] if self._quantized else None)
+        first_tok = int(np.asarray(outs[0]._data)[0, 0])
+        now = time.monotonic()
+        _obs.inc("serving.prefills_total")
+        slot = _Slot(pending=pending, page_ids=pages, table_row=row,
+                     t=int(req.prompt.size), last_tok=first_tok,
+                     first_token_time=now, last_token_time=now)
+        self._slots.append(slot)
+        self._emit_token(slot, first_tok, now, first=True)
+        return "ok"
+
+    def _fault_gate(self) -> List[_Slot]:
+        """The per-slot ``serving.step`` seam, in admission order. A
+        faulted slot sits this step out; everyone else proceeds."""
+        included: List[_Slot] = []
+        for slot in list(self._slots):
+            try:
+                _faults.fault_point("serving.step")
+            except Exception as exc:
+                slot.faults += 1
+                if slot.faults > 1:
+                    self._finish_error(slot, exc)
+                else:
+                    _obs.inc("serving.step_retries_total")
+                continue
+            included.append(slot)
+        return included
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.config.buckets:
+            if b >= n:
+                return b
+        raise AssertionError(f"no bucket for batch {n}")  # __post_init__
+
+    def _decode_step(self, included: List[_Slot]) -> None:
+        from ..core.tensor import Tensor as _T
+        bucket = self._bucket_for(len(included))
+        S = self.kv.config.pages_per_slot
+        tok = np.zeros((bucket, 1), np.int32)
+        t = np.zeros((bucket,), np.int32)
+        tables = np.zeros((bucket, S), np.int32)   # padded rows -> scratch
+        for i, slot in enumerate(included):
+            tok[i, 0] = slot.last_tok
+            t[i] = slot.t
+            tables[i] = slot.table_row
+        args = (_T(jnp.asarray(tok)), _T(jnp.asarray(tables)),
+                _T(jnp.asarray(t)))
+        outs = None
+        for attempt in (0, 1):
+            try:
+                outs = self._decode_program(*args, _T(self.kv.pool),
+                                            *self._scales_args())
+                break
+            except Exception as exc:
+                # a whole-batch device fault: functional state means
+                # nothing was written — retry the identical step once
+                if attempt:
+                    for slot in list(included):
+                        self._finish_error(slot, exc)
+                    return
+                _obs.inc("serving.step_retries_total")
+        self._set_pool(outs[1], outs[2] if self._quantized else None)
+        next_np = np.asarray(outs[0]._data)        # the ONE host sync
+        now = time.monotonic()
+        _obs.inc("serving.steps_total")
+        for i, slot in enumerate(included):
+            slot.t += 1
+            self._emit_token(slot, int(next_np[i, 0]), now)
+
+    def _emit_token(self, slot: _Slot, token: int, now: float,
+                    first: bool = False) -> None:
+        req = slot.request
+        slot.tokens.append(token)
+        slot.last_tok = token
+        _obs.inc("serving.tokens_total")
+        if first:
+            sub = slot.pending.submit_time
+            if sub:
+                _obs.observe("serving.ttft_seconds", now - sub)
+        else:
+            _obs.observe("serving.tpot_seconds", now - slot.last_token_time)
+        slot.last_token_time = now
+        if req.stream is not None:
+            try:
+                req.stream(req.request_id, token)
+            except Exception as exc:
+                # the documented contract: a raising callback is the
+                # REQUEST's failure, never its batchmates' — without this
+                # catch it would unwind the whole step loop (and silently
+                # kill the start() thread), stranding every in-flight
+                # future with its pages leaked
+                self._finish_error(slot, exc)
+                return
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            self._finish(slot, "eos")
+        elif len(slot.tokens) >= req.max_new_tokens:
+            self._finish(slot, "length")
+        elif slot.t >= self.config.max_len:
+            self._finish(slot, "length")   # cache exhausted (validated
+            # at submit, reachable only with adversarial max_len configs)
+
+    def _release(self, slot: _Slot) -> None:
+        self._slots.remove(slot)
+        self.kv.free(slot.page_ids)
+
+    def _finish(self, slot: _Slot, reason: str) -> None:
+        self._release(slot)
+        _obs.inc("serving.requests_total", status=(
+            "completed" if reason in ("eos", "length") else reason))
+        n = len(slot.tokens)
+        tpot = ((slot.last_token_time - slot.first_token_time) / (n - 1)
+                if n > 1 else None)
+        slot.pending.future.set_result(GenerationResult(
+            slot.request.request_id, slot.tokens, reason,
+            ttft_s=(slot.first_token_time - slot.pending.submit_time
+                    if slot.pending.submit_time else None),
+            tpot_s=tpot))
+
+    def _finish_error(self, slot: _Slot, exc: BaseException) -> None:
+        self._release(slot)
+        _obs.inc("serving.requests_total", status="failed")
+        slot.pending.future.set_exception(exc)
+
+    def _publish_gauges(self, active: int, bucket: int) -> None:
+        _obs.set_gauge("serving.active_slots", len(self._slots))
+        _obs.set_gauge("serving.batch_utilization",
+                       active / bucket if bucket else 0.0)

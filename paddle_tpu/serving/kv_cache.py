@@ -1,0 +1,252 @@
+"""Slot-paged KV cache for the serving engine: a fixed pool of pages plus
+per-slot page tables, with an optional int8 leg.
+
+Layout (the vLLM/PagedAttention shape adapted to the stacked-cache decode
+path this repo already compiles — ``FusedMultiTransformer._scan_decode``
+consumes a dense ``(L, 2, B, H, max_len, D)`` cache):
+
+* ``pool``   — ``(num_pages, L, 2, H, page_size, D)``. One page holds
+  ``page_size`` consecutive token positions of ONE sequence across ALL
+  layers (K and V). Page 0 is a reserved scratch page: padded batch rows
+  and unallocated page-table entries point at it, so gathers and
+  scatters never need a validity branch.
+* ``scales`` — ``(num_pages, L, 2, H)`` fp32, int8 leg only. Symmetric
+  per-(page, layer, k/v, head) absmax scales following the q8 layout rule
+  (``optimizer._q8_quantize`` / ``ops/q8_adam_pallas.py``):
+  ``scale = absmax / 127``, zero absmax quantized with scale 1.
+* page table — ``(B, pages_per_slot)`` int32 per batch, row ``b`` maps
+  slot ``b``'s logical positions ``[i*page_size, (i+1)*page_size)`` to a
+  pool page; unused entries are 0 (scratch).
+
+The decode program gathers a slot's pages into the dense stacked layout
+(dequantizing on the int8 leg), runs the EXISTING compiled decode step
+unchanged, then writes back only the page containing the one position the
+step touched. Both halves are pure jnp functions traced into the same
+program as the decode itself — paging costs no extra dispatches.
+
+int8 requantization contract: writing position ``t`` re-quantizes the
+whole containing page (positions ``> t`` are masked to zero first, so a
+freshly allocated page never inherits stale pool bytes). While a page is
+filling, its scale can only grow; entries quantized under an earlier,
+smaller scale are re-gridded at most ``page_size`` times, each bounded by
+half a quantization step — the dense-vs-int8 logits-tolerance test in
+``tests/test_serving.py`` pins the accumulated effect.
+
+Host-side accounting (:class:`PagedKVCache`) is deliberately dumb: a free
+list over page ids with page 0 reserved. Admission policy (whether a
+request may claim pages at all) lives in ``serving.scheduler``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KVCacheConfig", "PagedKVCache", "gather_pages",
+           "scatter_token_page", "scatter_prefill_pages", "quantize_pages"]
+
+_Q8_MAX = 127.0  # symmetric absmax grid, same rule as the q8 optimizer state
+
+
+@dataclass
+class KVCacheConfig:
+    """Shape + dtype contract shared by the host pool and the traced ops."""
+
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    max_len: int
+    page_size: int = 64
+    num_pages: Optional[int] = None   # default set by PagedKVCache
+    compute_dtype: str = "float32"    # dtype the decode step consumes
+    kv_dtype: str = "native"          # "native" | "bf16" | "int8"
+
+    def __post_init__(self):
+        if self.max_len % self.page_size != 0:
+            raise ValueError(
+                f"max_len ({self.max_len}) must be a multiple of page_size "
+                f"({self.page_size})")
+
+    @property
+    def pages_per_slot(self) -> int:
+        return self.max_len // self.page_size
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
+
+    @property
+    def storage_dtype(self):
+        if self.kv_dtype == "int8":
+            return jnp.int8
+        if self.kv_dtype == "bf16":
+            return jnp.bfloat16
+        return jnp.dtype(self.compute_dtype)
+
+    def page_shape(self) -> Tuple[int, ...]:
+        return (self.num_layers, 2, self.num_heads, self.page_size,
+                self.head_dim)
+
+
+# ---------------------------------------------------------------------------
+# pure jnp halves — traced into the decode/prefill programs
+# ---------------------------------------------------------------------------
+
+def quantize_pages(pages: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """absmax-int8 quantize ``(..., L, 2, H, ps, D)`` pages → (int8 pages,
+    fp32 scales over ``(..., L, 2, H)``). Same grid rule as the q8
+    optimizer layout: ``scale = absmax/127``, zero absmax → scale 1."""
+    x = pages.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = absmax / _Q8_MAX
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x / scale[..., None, None]), -_Q8_MAX, _Q8_MAX)
+    return q.astype(jnp.int8), scale
+
+
+def gather_pages(pool: jnp.ndarray, scales: Optional[jnp.ndarray],
+                 tables: jnp.ndarray, compute_dtype) -> jnp.ndarray:
+    """Pages → dense stacked cache ``(L, 2, B, H, max_len, D)``.
+
+    ``tables`` is ``(B, pages_per_slot)`` int32. Rows gathered through
+    scratch entries carry garbage at positions the attention span mask
+    (``masked_multihead_attention``: span ``<= t``) never admits."""
+    taken = jnp.take(pool, tables, axis=0)          # (B, S, L, 2, H, ps, D)
+    if scales is not None:
+        sc = jnp.take(scales, tables, axis=0)       # (B, S, L, 2, H)
+        taken = taken.astype(jnp.float32) * sc[..., None, None]
+    b, s, l, two, h, ps, d = taken.shape
+    dense = taken.transpose(2, 3, 0, 4, 1, 5, 6)    # (L, 2, B, H, S, ps, D)
+    dense = dense.reshape(l, two, b, h, s * ps, d)
+    return dense.astype(compute_dtype)
+
+
+def scatter_token_page(dense: jnp.ndarray, pool: jnp.ndarray,
+                       scales: Optional[jnp.ndarray], tables: jnp.ndarray,
+                       t: jnp.ndarray, page_size: int):
+    """Write back the one page per slot containing position ``t``.
+
+    ``dense`` is the post-step stacked cache (the decode wrote K/V for the
+    current token at per-slot position ``t``); everything outside the
+    containing page is unchanged by a single decode step, so only that
+    page returns to the pool. Positions ``> t`` inside the page are masked
+    to zero: a fresh page never inherits stale pool bytes, and the int8
+    scale is computed over written positions only. Returns
+    ``(pool', scales')``."""
+    ps = page_size
+    l, two, b, h, m, d = dense.shape
+    t = t.astype(jnp.int32).reshape(-1)
+
+    def grab(dense_b, tb):                          # dense_b (L, 2, H, M, D)
+        start = (tb // ps) * ps
+        page = jax.lax.dynamic_slice(
+            dense_b, (0, 0, 0, start, 0), (l, two, h, ps, d))
+        valid = (start + jnp.arange(ps, dtype=jnp.int32)) <= tb
+        return jnp.where(valid[None, None, None, :, None], page, 0)
+
+    pages = jax.vmap(grab, in_axes=(2, 0), out_axes=0)(dense, t)
+    pids = jnp.take_along_axis(tables, (t // ps)[:, None], axis=1)[:, 0]
+    if scales is not None:
+        q, s = quantize_pages(pages)
+        return pool.at[pids].set(q), scales.at[pids].set(s)
+    return pool.at[pids].set(pages.astype(pool.dtype)), None
+
+
+def scatter_prefill_pages(dense: jnp.ndarray, pool: jnp.ndarray,
+                          scales: Optional[jnp.ndarray],
+                          page_ids: jnp.ndarray, true_len: jnp.ndarray,
+                          page_size: int):
+    """Store a freshly prefilled single-slot dense cache into the pool.
+
+    ``dense`` is ``(L, 2, 1, H, Lp, D)`` with positions ``[0, true_len)``
+    holding the prompt's K/V (right padding beyond ``true_len`` is masked
+    to zero — padded prompt positions never reach the pool). ``page_ids``
+    is ``(Lp // page_size,)``; entries past the prompt's last page are 0
+    and harmlessly overwrite the scratch page. Returns ``(pool',
+    scales')``."""
+    ps = page_size
+    l, two, _, h, lp, d = dense.shape
+    n = lp // ps
+    x = dense[:, :, 0]                               # (L, 2, H, Lp, D)
+    x = x.reshape(l, two, h, n, ps, d).transpose(3, 0, 1, 2, 4, 5)
+    pos = jnp.arange(lp, dtype=jnp.int32).reshape(n, ps)
+    valid = pos < true_len.astype(jnp.int32).reshape(())
+    x = jnp.where(valid[:, None, None, None, :, None], x, 0)
+    if scales is not None:
+        q, s = quantize_pages(x)
+        return pool.at[page_ids].set(q), scales.at[page_ids].set(s)
+    return pool.at[page_ids].set(x.astype(pool.dtype)), None
+
+
+# ---------------------------------------------------------------------------
+# host-side pool accounting
+# ---------------------------------------------------------------------------
+
+class PagedKVCache:
+    """The preallocated page pool plus a free list over page ids.
+
+    Holds the pool/scales as raw jnp arrays (the engine threads them
+    through its compiled programs as explicit inputs/outputs — functional
+    state, so a faulted step that is retried or abandoned cannot leave the
+    pool half-written). Thread-safe: alloc/free take the instance lock."""
+
+    def __init__(self, config: KVCacheConfig):
+        if config.num_pages is None:
+            raise ValueError("KVCacheConfig.num_pages must be set (the "
+                             "engine sizes it from max_batch)")
+        if config.num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is scratch)")
+        self.config = config
+        shape = (config.num_pages,) + config.page_shape()
+        self.pool = jnp.zeros(shape, config.storage_dtype)
+        self.scales: Optional[jnp.ndarray] = None
+        if config.quantized:
+            self.scales = jnp.ones(
+                (config.num_pages, config.num_layers, 2, config.num_heads),
+                jnp.float32)
+        self._lock = threading.Lock()
+        # page 0 is scratch: never allocated, target of padded rows.
+        # _free_set mirrors _free for O(1) double-free detection — free()
+        # runs on the step thread's critical path at every eviction.
+        self._free: List[int] = list(range(config.num_pages - 1, 0, -1))
+        self._free_set = set(self._free)
+
+    # -- accounting ---------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def pages_for(self, positions: int) -> int:
+        """Pages needed to cover logical positions ``[0, positions)``."""
+        ps = self.config.page_size
+        return min(self.config.pages_per_slot, -(-positions // ps))
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Claim ``n`` pages, or None if the pool cannot cover them (the
+        caller must not admit — partial claims never escape)."""
+        with self._lock:
+            if n > len(self._free):
+                return None
+            ids = [self._free.pop() for _ in range(n)]
+            self._free_set.difference_update(ids)
+        return ids
+
+    def free(self, ids: Sequence[int]) -> None:
+        with self._lock:
+            for pid in ids:
+                if pid == 0 or pid in self._free_set:
+                    raise ValueError(f"double free / scratch free: page {pid}")
+                self._free.append(pid)
+                self._free_set.add(pid)
+
+    def table_row(self, page_ids: Sequence[int]) -> np.ndarray:
+        """A slot's page-table row: allocated ids then scratch padding."""
+        row = np.zeros(self.config.pages_per_slot, np.int32)
+        row[:len(page_ids)] = np.asarray(page_ids, np.int32)
+        return row

@@ -1,0 +1,198 @@
+"""Continuous-batching admission control: the request queue and policies.
+
+The scheduler owns everything about a request BEFORE it holds a slot: the
+bounded FIFO queue (``max_queue``, overflow rejects at ``submit`` — the
+serving analogue of the dataloader's bounded prefetch), cancellation of
+queued requests, and the per-step-boundary admission decision. The engine
+calls :meth:`next_admissions` at every step boundary with "how much room
+do I have" closures; whatever the scheduler hands back joins the running
+batch via prefill-into-slot (Orca-style iteration-level scheduling — a
+request never waits for the batch to drain).
+
+Policies:
+
+* ``fifo`` — strict arrival order. If the head request does not fit
+  (no free slot, or the page pool cannot cover its whole lifetime),
+  admission stops: no reordering, so a large request cannot be starved
+  by small ones slipping past it.
+* ``budget`` — FIFO plus a per-boundary prefill-token budget
+  (``prefill_token_budget``): admission also stops once the prompt
+  tokens admitted at THIS boundary would exceed the budget. Bounds the
+  prefill stall a decode step can suffer (the TTFT/TPOT trade knob).
+
+Requests are host-side objects; nothing here touches the device.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import observability as _obs
+
+__all__ = ["GenerationRequest", "GenerationResult", "QueueFull", "Scheduler"]
+
+_req_ids = itertools.count()
+
+
+class QueueFull(RuntimeError):
+    """submit() on a queue already holding ``max_queue`` requests."""
+
+
+@dataclass(eq=False)   # identity equality: ``prompt`` is an ndarray, and a
+class GenerationRequest:   # request is a job, not a value
+    """One decode job: a prompt plus its stopping rule.
+
+    ``prompt`` is a 1-D int32 token array; ``stream`` (optional) is called
+    ``stream(request_id, token)`` from the engine step thread as each
+    token lands — keep it cheap. A raising callback fails THIS request
+    (its Future gets the exception, its pages free) and never touches its
+    batchmates."""
+
+    prompt: np.ndarray
+    max_new_tokens: int = 64
+    eos_token_id: Optional[int] = None
+    stream: Optional[Callable[[int, int], None]] = None
+    request_id: int = field(default_factory=lambda: next(_req_ids))
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+
+@dataclass
+class GenerationResult:
+    """What a request's Future resolves to."""
+
+    request_id: int
+    tokens: List[int]
+    finish_reason: str          # "eos" | "length" | "cancelled"
+    ttft_s: Optional[float] = None   # submit -> first token
+    tpot_s: Optional[float] = None   # mean inter-token time after the first
+
+
+@dataclass(eq=False)
+class _Pending:
+    request: GenerationRequest
+    future: "Future[GenerationResult]"
+    submit_time: float = 0.0
+
+
+class Scheduler:
+    """Bounded queue + admission policy. Thread-safe; the engine is the
+    only consumer (``next_admissions`` from the step loop), producers are
+    arbitrary ``submit``/``cancel`` threads."""
+
+    def __init__(self, max_queue: int = 64, policy: str = "fifo",
+                 prefill_token_budget: Optional[int] = None):
+        if policy not in ("fifo", "budget"):
+            raise ValueError(f"unknown admission policy: {policy!r}")
+        if policy == "budget" and not prefill_token_budget:
+            raise ValueError("policy='budget' needs prefill_token_budget")
+        self.max_queue = max_queue
+        self.policy = policy
+        self.prefill_token_budget = prefill_token_budget
+        self._lock = threading.Lock()
+        self._queue: List[_Pending] = []
+        # request ids cancelled while HOLDING A SLOT; the engine consumes
+        # these at its next step boundary (eviction is an engine action —
+        # pages and slots are engine state)
+        self._cancelled_active: set = set()
+
+    # -- producer side ------------------------------------------------------
+    def submit(self, request: GenerationRequest,
+               submit_time: float = 0.0) -> "Future[GenerationResult]":
+        fut: "Future[GenerationResult]" = Future()
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                _obs.inc("serving.requests_total", status="rejected")
+                raise QueueFull(
+                    f"serving queue full ({self.max_queue} pending)")
+            self._queue.append(_Pending(request, fut, submit_time))
+            depth = len(self._queue)
+        _obs.set_gauge("serving.queue_depth", depth)
+        return fut
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a request; always returns True. Queued: resolved
+        ``cancelled`` immediately. Anything else is flagged as
+        cancelled-while-active and consumed by the engine at its next
+        step boundary — ids of already-finished (or never-submitted)
+        requests are indistinguishable here and are silently ignored
+        there; the request's Future is the source of truth for what
+        actually happened."""
+        with self._lock:
+            for i, p in enumerate(self._queue):
+                if p.request.request_id == request_id:
+                    del self._queue[i]
+                    depth = len(self._queue)
+                    pend = p
+                    break
+            else:
+                # not queued: assume active; the engine ignores stale ids
+                self._cancelled_active.add(request_id)
+                return True
+        _obs.set_gauge("serving.queue_depth", depth)
+        _obs.inc("serving.requests_total", status="cancelled")
+        pend.future.set_result(GenerationResult(
+            request_id, [], "cancelled"))
+        return True
+
+    # -- engine side --------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def take_cancelled_active(self) -> set:
+        """Drain the cancelled-while-active set (engine, step boundary)."""
+        with self._lock:
+            out, self._cancelled_active = self._cancelled_active, set()
+        return out
+
+    def next_admissions(self, free_slots: int,
+                        can_fit: Callable[[GenerationRequest], bool]
+                        ) -> List[_Pending]:
+        """Pop the requests to admit at this step boundary.
+
+        ``can_fit`` answers "can the page pool cover this request's whole
+        lifetime right now" — it is consulted head-first and admission
+        stops at the first miss (strict FIFO; no slip-ahead). The engine
+        MUST admit every returned request or re-queue it: the pop is the
+        handoff."""
+        taken: List[_Pending] = []
+        budget = (self.prefill_token_budget
+                  if self.policy == "budget" else None)
+        spent = 0
+        with self._lock:
+            while self._queue and len(taken) < free_slots:
+                head = self._queue[0]
+                if not can_fit(head.request):
+                    break
+                cost = int(head.request.prompt.size)
+                if budget is not None and taken and spent + cost > budget:
+                    break
+                spent += cost
+                taken.append(self._queue.pop(0))
+            depth = len(self._queue)
+        if taken:
+            _obs.set_gauge("serving.queue_depth", depth)
+        return taken
+
+    def requeue(self, pending: Sequence[_Pending]) -> None:
+        """Return un-admitted requests to the queue head (engine aborting
+        an admission it could not complete)."""
+        if not pending:
+            return
+        with self._lock:
+            self._queue[:0] = list(pending)
+            depth = len(self._queue)
+        _obs.set_gauge("serving.queue_depth", depth)

@@ -145,3 +145,45 @@ def test_dispatch_fast_path_has_no_per_call_imports():
         tree = ast.parse(f.read())
     names = {n.name for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)}
     assert {"apply", "_apply_impl", "_apply_cached"} <= names
+
+
+# ---------------------------------------------------------------------------
+# serving bench schema (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+def _load_bench_generation():
+    spec = importlib.util.spec_from_file_location(
+        "bench_generation",
+        os.path.join(REPO, "benchmarks", "bench_generation.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serving_bench_pins_schema():
+    # the --serving JSON row of record: per-batch rows + the aggregate
+    # payload RESULTS.md keys on; drift must fail here, not in a diff
+    mod = _load_bench_generation()
+    assert set(mod.SERVING_ROW_FIELDS) == {
+        "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms",
+        "scan_greedy_parity", "match_frac", "batch_utilization"}
+    assert {"benchmark", "kv_dtype", "page_size",
+            "single_stream_tokens_per_sec", "serving",
+            "speedup_vs_single_stream", "device"} <= \
+        set(mod.SERVING_RESULT_FIELDS)
+    import inspect
+    src = inspect.getsource(mod._run_serving)
+    # rows/payload are asserted against the pinned schema at emit time
+    assert "SERVING_ROW_FIELDS" in src and "SERVING_RESULT_FIELDS" in src
+    for field in mod.SERVING_ROW_FIELDS + mod.SERVING_RESULT_FIELDS:
+        assert f'"{field}"' in src, field
+    # greedy-parity failure is a hard exit: no numbers without the gate
+    assert "sys.exit(1)" in src
+
+
+def test_serving_bench_wired_into_main():
+    mod = _load_bench_generation()
+    import inspect
+    src = inspect.getsource(mod.main)
+    assert "--serving" in src and "_run_serving" in src
+    assert "--kv-dtype" in src        # the int8 leg is reachable from CLI

@@ -105,8 +105,12 @@ def family_of(op: str, module: str, arity: int) -> str:
 
 
 _OPS = _load_ops()
+# + synthetic families for compiled SUBSYSTEM paths that no single ops.yaml
+# entry covers: the serving engine's paged gather->step->scatter decode
+# program is its own lowering surface (dynamic_slice/scatter over the page
+# pool fused with the decode step)
 FAMILIES = sorted({family_of(o["op"], o["module"], o["arity"])
-                   for o in _OPS})
+                   for o in _OPS} | {"serving_decode"})
 
 
 def _t(data, dtype="float32", stop_gradient=True):
@@ -282,6 +286,73 @@ def _smoke_segment():
         _t([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]),
         _t([0, 0, 1], dtype="int64")).numpy()
     np.testing.assert_allclose(out, [[4.0, 6.0], [5.0, 6.0]])
+
+
+def _smoke_serving_decode():
+    # the serving engine's compiled paged-decode program (gather pages ->
+    # step -> scatter written page) on the real chip: 2 requests batched
+    # continuously must decode the exact tokens of the dense bs=1 loop
+    # over the SAME toy callables
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import serving
+    from paddle_tpu.core.tensor import Tensor as T
+
+    L = H = 1
+    D, M, V = 8, 32, 13
+    posw = (jnp.arange(M, dtype=jnp.float32) + 1.0) / M
+    ramp = (jnp.arange(D, dtype=jnp.float32) + 1.0) / D
+
+    def readout(c, valid):                   # (B, H, M, D), (B, M) -> (B,)
+        s = (c.astype(jnp.float32) * valid[:, None, :, None]
+             * posw[None, None, :, None]).sum(axis=(1, 2, 3))
+        return (s * 97.0).astype(jnp.int32) % V
+
+    def step(tok, cache, t):
+        c, td = cache._data, t._data.astype(jnp.int32)
+        kv = ((tok._data[:, 0].astype(jnp.float32) + 1.0) / V)[:, None] * ramp
+
+        def wr(cb, kvb, tb):
+            page = jnp.broadcast_to(kvb[None, None, None, None, :],
+                                    (L, 2, H, 1, D)).astype(cb.dtype)
+            return jax.lax.dynamic_update_slice(cb, page, (0, 0, 0, tb, 0))
+
+        c2 = jax.vmap(wr, in_axes=(2, 0, 0), out_axes=2)(c, kv, td)
+        valid = (jnp.arange(M)[None, :] <= td[:, None]).astype(jnp.float32)
+        return T(readout(c2[0, 0], valid)[:, None]), T(c2)
+
+    def prefill(ids, cache):
+        c, idsd = cache._data, ids._data
+        lp = idsd.shape[1]
+        kv = ((idsd[0].astype(jnp.float32) + 1.0) / V)[:, None] * ramp
+        c = c.at[:, :, 0, :, :lp, :].set(
+            jnp.broadcast_to(kv[None, :, :], (H, lp, D)).astype(c.dtype))
+        valid = (jnp.arange(M) < lp)[None, :].astype(jnp.float32)
+        return T(readout(c[0, 0], valid)[:, None]), T(c)
+
+    prompts = [np.arange(8, dtype=np.int32) % V,
+               (np.arange(8, dtype=np.int32) * 3) % V]
+
+    def dense(prompt, n_new):
+        cache = T(jnp.zeros((L, 2, 1, H, M, D), jnp.float32))
+        tok, cache = prefill(T(jnp.asarray(prompt[None, :], jnp.int32)),
+                             cache)
+        toks, t = [int(np.asarray(tok._data)[0, 0])], prompt.size
+        for _ in range(n_new - 1):
+            tok, cache = step(tok, cache, T(jnp.asarray([t], jnp.int32)))
+            toks.append(int(np.asarray(tok._data)[0, 0]))
+            t += 1
+        return toks
+
+    cfg = serving.ServingConfig(num_layers=L, num_heads=H, head_dim=D,
+                                max_len=M, max_batch=2, buckets=(1, 2),
+                                page_size=8)
+    eng = serving.Engine(prefill, step, cfg)
+    futs = [eng.submit(serving.GenerationRequest(p, max_new_tokens=4))
+            for p in prompts]
+    eng.run()
+    for p, f in zip(prompts, futs):
+        assert f.result(timeout=30).tokens == dense(p, 4)
 
 
 def _smoke_strided():

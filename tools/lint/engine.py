@@ -172,7 +172,8 @@ DEFAULT_CONFIG: Dict[str, Any] = {
             "paddle_tpu.linalg", "paddle_tpu.fft", "paddle_tpu.signal",
             "paddle_tpu.sparse", "paddle_tpu.geometric",
             "paddle_tpu.quantization", "paddle_tpu.text", "paddle_tpu.audio",
-            "paddle_tpu.flops_counter", "paddle_tpu.vision"]},
+            "paddle_tpu.flops_counter", "paddle_tpu.vision",
+            "paddle_tpu.serving"]},
         {"name": "distributed", "prefixes": ["paddle_tpu.distributed"]},
         {"name": "apps", "prefixes": [
             "paddle_tpu.hapi", "paddle_tpu.models", "paddle_tpu.incubate",
