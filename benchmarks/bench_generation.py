@@ -46,10 +46,17 @@ import numpy as np
 SERVING_RESULT_FIELDS = (
     "benchmark", "params", "layers", "hidden", "dtype", "kv_dtype",
     "page_size", "prompt", "tokens", "single_stream_tokens_per_sec",
-    "serving", "speedup_vs_single_stream", "device")
+    "serving", "resilience", "speedup_vs_single_stream", "device")
 SERVING_ROW_FIELDS = (
     "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "scan_greedy_parity",
     "match_frac", "batch_utilization")
+# the "serving under fire" counters (ISSUE 8): a healthy offline drain
+# reports zeros, which is exactly the claim worth pinning — overload and
+# recovery are VISIBLE series, so a nonzero here in a bench diff means the
+# run itself degraded (shed requests, watchdog trips, replayed slots)
+SERVING_RESILIENCE_FIELDS = (
+    "rejected_queue_full", "rejected_deadline", "rejected_shed",
+    "watchdog_trips", "replays")
 
 
 def main() -> None:
@@ -348,6 +355,18 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
             "serving row drifted from SERVING_ROW_FIELDS"
 
     top = rows[f"bs{max_bs}"]["aggregate_tokens_per_sec"]
+    snap = obs.snapshot()
+    rejected = snap.get("serving.rejected_total", {}) or {}
+    trips = snap.get("serving.watchdog_trips_total", {}) or {}
+    fire = {
+        "rejected_queue_full": rejected.get("reason=queue_full", 0),
+        "rejected_deadline": rejected.get("reason=deadline", 0),
+        "rejected_shed": rejected.get("reason=shed", 0),
+        "watchdog_trips": sum(trips.values()),
+        "replays": snap.get("serving.replays_total", 0) or 0,
+    }
+    assert set(fire) == set(SERVING_RESILIENCE_FIELDS), \
+        "serving resilience block drifted from SERVING_RESILIENCE_FIELDS"
     payload = {
         "benchmark": "serving_generation",
         "params": n_params, "layers": L, "hidden": E, "dtype": dtype,
@@ -355,6 +374,7 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         "prompt": args.prompt, "tokens": n_new,
         "single_stream_tokens_per_sec": round(single_rate, 1),
         "serving": rows,
+        "resilience": fire,
         "speedup_vs_single_stream": round(top / single_rate, 2),
         "device": str(jax.devices()[0]),
     }

@@ -30,16 +30,17 @@ Everything is observable through :mod:`paddle_tpu.observability`:
 
 from __future__ import annotations
 
-from .policy import (RetryPolicy, current_deadline, deadline_scope,
-                     get_policy, jitter_sleep, register_policy,
-                     reset_policies)
+from .policy import (DeadlineExceeded, RetryPolicy, current_deadline,
+                     deadline_scope, get_policy, jitter_sleep,
+                     register_policy, reset_policies)
 from .breaker import (BreakerOpen, CircuitBreaker, breaker_for,
                       reset_breakers)
 from .faults import (FaultInjected, FaultSchedule, KillPoint, fault_point,
                      install, installed, uninstall)
 
 __all__ = [
-    "RetryPolicy", "deadline_scope", "current_deadline", "get_policy",
+    "RetryPolicy", "DeadlineExceeded", "deadline_scope", "current_deadline",
+    "get_policy",
     "register_policy", "reset_policies", "jitter_sleep",
     "BreakerOpen", "CircuitBreaker", "breaker_for", "reset_breakers",
     "FaultInjected", "FaultSchedule", "KillPoint", "fault_point",

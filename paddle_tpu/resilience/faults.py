@@ -41,6 +41,17 @@ Sites threaded through the framework (exact-match tags):
                       order — call index N deterministically targets one
                       slot; a faulted slot sits the step out, a second
                       fault fails it ALONE (batchmates unaffected)
+``serving.watchdog``  once per batched-decode ATTEMPT, inside the armed
+                      watchdog window, before the compiled step runs — a
+                      ``delay`` here simulates a hung device step (the
+                      watchdog trips and the step's outputs are
+                      abandoned), an ``error`` a whole-batch device
+                      fault; either way the affected slots recover via
+                      bounded prefill replay (``max_replays``)
+``serving.drain``     ``Engine.stop(drain=True)`` entry — an injected
+                      error degrades the graceful drain to an immediate
+                      stop (stragglers still resolve; the no-stranded-
+                      futures invariant outranks graceful finish)
 ====================  =====================================================
 
 Kinds: ``delay`` sleeps; ``error`` raises a fresh instance of the

@@ -41,8 +41,19 @@ from typing import Dict, Optional
 
 from .. import observability as _obs
 
-__all__ = ["RetryPolicy", "deadline_scope", "current_deadline",
-           "get_policy", "register_policy", "reset_policies", "jitter_sleep"]
+__all__ = ["RetryPolicy", "DeadlineExceeded", "deadline_scope",
+           "current_deadline", "get_policy", "register_policy",
+           "reset_policies", "jitter_sleep"]
+
+
+class DeadlineExceeded(TimeoutError):
+    """A deadline budget expired before the guarded work could run.
+
+    The typed surface for deadline-driven shedding: callers that gate work
+    on a per-request or ambient deadline (``deadline_scope``, the serving
+    admission boundary) resolve the work's future — or raise — with THIS
+    type, so "too late" is distinguishable from "failed" at every layer
+    above."""
 
 _TLS = threading.local()
 # module RNG for jitter: desynchronization noise, not reproducibility

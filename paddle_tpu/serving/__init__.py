@@ -17,7 +17,14 @@ package multiplies the missing factor. Three pieces:
   batched decode program per batch bucket ({1, 4, 16}), admission via
   prefill-into-slot at step boundaries, per-slot eviction on
   EOS/length/cancel, ``observability`` metrics and ``resilience`` fault
-  seams (``serving.step`` / ``serving.admit``).
+  seams (``serving.step`` / ``serving.admit`` / ``serving.watchdog`` /
+  ``serving.drain``), per-request deadlines with queue-wait load
+  shedding, bounded prefill replay after unrecoverable step faults, and
+  ``stop(drain=True)`` graceful shutdown.
+* :mod:`~paddle_tpu.serving.watchdog` — the monotonic-clock step
+  watchdog (``PADDLE_TPU_SERVING_WATCHDOG_S``): a hung compiled step is
+  classified, counted, and its slots recovered instead of wedging the
+  engine forever.
 
 Quick start (see README "Serving")::
 
@@ -32,12 +39,15 @@ Quick start (see README "Serving")::
 """
 
 from .kv_cache import KVCacheConfig, PagedKVCache  # noqa: F401
-from .scheduler import (GenerationRequest, GenerationResult,  # noqa: F401
-                        QueueFull, Scheduler)
-from .engine import Engine, ServingConfig  # noqa: F401
+from .scheduler import (DeadlineExceeded, GenerationRequest,  # noqa: F401
+                        GenerationResult, QueueFull, Scheduler)
+from .engine import (DrainTimeout, Engine, EngineStopped,  # noqa: F401
+                     ServingConfig)
+from .watchdog import StepWatchdog, WatchdogTimeout  # noqa: F401
 
 __all__ = [
     "KVCacheConfig", "PagedKVCache",
     "GenerationRequest", "GenerationResult", "QueueFull", "Scheduler",
-    "Engine", "ServingConfig",
+    "DeadlineExceeded", "Engine", "ServingConfig",
+    "EngineStopped", "DrainTimeout", "StepWatchdog", "WatchdogTimeout",
 ]

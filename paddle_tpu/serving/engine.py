@@ -50,23 +50,42 @@ faulted step never half-writes the pool):
   slot sits out the current step; the first fault retries it at the next
   step, a second fault fails it. Its batchmates run the very same step
   unaffected: a faulted slot fails ALONE.
-* An error from the compiled batched step itself (a real device fault —
-  injected per-slot faults never reach it) is retried once; if the retry
-  also fails every in-flight request gets the error, because the device
-  gave no per-slot attribution.
+* ``serving.watchdog`` fires once per batched-decode ATTEMPT, inside the
+  armed watchdog window: a ``delay`` fault there simulates a hung device
+  step, an ``error`` a whole-batch device fault. A device fault is
+  retried once (functional state: nothing was written); a second fault —
+  or a watchdog trip (``PADDLE_TPU_SERVING_WATCHDOG_S``) — abandons the
+  step's outputs and recovers the included slots through **bounded
+  prefill replay**: each slot's prompt + tokens-so-far are requeued at
+  the queue head and re-prefilled into a fresh slot (at most
+  ``max_replays`` times, then the request fails), so one bad step no
+  longer takes every batchmate down with it.
+* ``serving.drain`` fires at ``stop(drain=True)`` entry; an injected
+  error degrades the graceful drain to an immediate stop. Either way
+  every submitted Future resolves and every page returns to the pool.
+
+Overload protection: per-request ``deadline_s``/``ttft_budget_s`` and
+the scheduler's queue-wait shedding (see ``serving/scheduler.py``) keep
+queue time bounded; an admitted request's deadline becomes the ambient
+``resilience.deadline_scope`` around its prefill and around every decode
+step it joins, so nested retry policies inherit the same budget.
 
 Metrics: ``serving.requests_total{status}``, ``serving.tokens_total``,
 ``serving.steps_total``, ``serving.prefills_total``,
-``serving.step_retries_total``, ``serving.queue_depth``,
-``serving.active_slots``, ``serving.batch_utilization``, and
-``serving.ttft_seconds`` / ``serving.tpot_seconds`` histograms.
+``serving.step_retries_total``, ``serving.rejected_total{reason}``,
+``serving.watchdog_trips_total{kind}``, ``serving.replays_total``,
+``serving.queue_depth``, ``serving.active_slots``,
+``serving.batch_utilization``, and ``serving.ttft_seconds`` /
+``serving.tpot_seconds`` / ``serving.queue_wait_seconds`` histograms.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -74,12 +93,39 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
-from ..resilience import faults as _faults
+from ..resilience import deadline_scope, faults as _faults, jitter_sleep
 from . import kv_cache as _kv
 from .scheduler import (GenerationRequest, GenerationResult, Scheduler,
                         _Pending)
+from .watchdog import StepWatchdog, WatchdogTimeout
 
-__all__ = ["ServingConfig", "Engine"]
+__all__ = ["ServingConfig", "Engine", "EngineStopped", "DrainTimeout"]
+
+_log = logging.getLogger(__name__)
+
+# extra seconds past the drain budget the loop thread is given to come
+# back from its in-flight compiled call before stop() proceeds without it
+_JOIN_GRACE_S = 1.0
+
+
+class EngineStopped(RuntimeError):
+    """The engine is draining or stopped: ``submit`` rejects new work, and
+    queued-but-never-admitted requests resolve with this on a terminal
+    ``stop(drain=True, on_timeout="fail")``."""
+
+
+class DrainTimeout(EngineStopped):
+    """An in-flight request was still decoding when the drain budget
+    expired and ``on_timeout="fail"`` evicted it."""
+
+
+def _env_seconds(name: str) -> Optional[float]:
+    """Float seconds from the env, with 0/empty/absent meaning off."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    val = float(raw)
+    return val if val > 0 else None
 
 
 @dataclass
@@ -100,6 +146,16 @@ class ServingConfig:
     compute_dtype: str = "float32"
     policy: str = "fifo"
     prefill_token_budget: Optional[int] = None
+    # -- serving-under-fire knobs (ISSUE 8) --
+    # bounded prefill replay: how many times an unrecoverable step fault /
+    # watchdog trip may requeue a slot before its Future fails
+    max_replays: int = 1
+    # step watchdog budget in seconds; None -> $PADDLE_TPU_SERVING_WATCHDOG_S
+    # (0/absent = disabled). Pass 0 to force off regardless of env.
+    watchdog_s: Optional[float] = None
+    # hard cap on queue wait; None -> $PADDLE_TPU_SERVING_MAX_QUEUE_WAIT
+    # (0/absent = unbounded). Pass 0 to force off regardless of env.
+    max_queue_wait_s: Optional[float] = None
 
     def __post_init__(self):
         self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))
@@ -114,6 +170,18 @@ class ServingConfig:
             raise ValueError(
                 f"kv_dtype must be native|bf16|int8, got {self.kv_dtype!r} "
                 "(env: PADDLE_TPU_KV_DTYPE)")
+        if self.max_replays < 0:
+            raise ValueError(f"max_replays must be >= 0, got "
+                             f"{self.max_replays}")
+        if self.watchdog_s is None:
+            self.watchdog_s = _env_seconds("PADDLE_TPU_SERVING_WATCHDOG_S")
+        elif self.watchdog_s <= 0:
+            self.watchdog_s = None
+        if self.max_queue_wait_s is None:
+            self.max_queue_wait_s = _env_seconds(
+                "PADDLE_TPU_SERVING_MAX_QUEUE_WAIT")
+        elif self.max_queue_wait_s <= 0:
+            self.max_queue_wait_s = None
 
     def kv_config(self) -> _kv.KVCacheConfig:
         cfg = _kv.KVCacheConfig(
@@ -167,11 +235,27 @@ class Engine:
         self._quantized = self.kv.config.quantized
         self.scheduler = Scheduler(
             max_queue=config.max_queue, policy=config.policy,
-            prefill_token_budget=config.prefill_token_budget)
+            prefill_token_budget=config.prefill_token_budget,
+            max_queue_wait_s=config.max_queue_wait_s)
         self._slots: List[_Slot] = []    # admission order == batch row order
+        # serializes slot eviction only: normally the step loop is the
+        # single consumer, but a budgeted stop() that gave up on a wedged
+        # loop thread resolves stragglers from the CALLER's thread while
+        # the wedged call may return concurrently — _release must decide
+        # a slot's winner exactly once
+        self._slot_lock = threading.Lock()
+        # requests in transit between queue and slot at this step boundary
+        # (popped by _admit but prefill not yet finished) or between slot
+        # and queue (crash-recovery eviction before its requeue lands):
+        # the drain-owed probe polls from another thread and must not
+        # mistake either window for "nothing left to finish"
+        self._in_transit = 0
         self._wake = threading.Event()
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[StepWatchdog] = (
+            StepWatchdog(config.watchdog_s) if config.watchdog_s else None)
         self._build_programs()
 
     # ------------------------------------------------------------------
@@ -266,8 +350,13 @@ class Engine:
 
     def submit(self, request: GenerationRequest):
         """Enqueue; returns a Future resolving to GenerationResult.
-        Raises QueueFull / ValueError (request can never fit) here, on
-        the caller's thread."""
+        Raises QueueFull / DeadlineExceeded (shed on arrival) /
+        EngineStopped (draining) / ValueError (request can never fit)
+        here, on the caller's thread."""
+        if self._draining.is_set():
+            _obs.inc("serving.requests_total", status="rejected")
+            _obs.inc("serving.rejected_total", reason="shed")
+            raise EngineStopped("engine is draining/stopped: not admitting")
         if int(request.prompt.size) + request.max_new_tokens \
                 > self.config.max_len:
             raise ValueError(
@@ -277,6 +366,18 @@ class Engine:
         if self._pages_needed(request) > self.kv.config.num_pages - 1:
             raise ValueError("request needs more pages than the pool holds")
         fut = self.scheduler.submit(request, submit_time=time.monotonic())
+        if self._draining.is_set():
+            # raced a concurrent stop(drain=True) past the check above: the
+            # drain's queue resolution may already have run, in which case
+            # our fresh pending would sit in a queue nobody will ever pop —
+            # withdraw it and reject here; if the drain DID resolve it
+            # first, the Future already carries EngineStopped
+            if self.scheduler.withdraw(request.request_id) is not None:
+                _obs.inc("serving.requests_total", status="rejected")
+                _obs.inc("serving.rejected_total", reason="shed")
+                raise EngineStopped(
+                    "engine is draining/stopped: not admitting")
+            return fut
         self._wake.set()
         return fut
 
@@ -301,7 +402,11 @@ class Engine:
         ONE batched decode step. Returns False when there was nothing to
         do (the idle step — no program runs, no device touch)."""
         progressed = self._process_cancellations()
-        progressed |= self._admit()
+        # draining latches out NEW admissions only: slots evicted by
+        # crash-recovery mid-drain still re-admit, or the drain would
+        # misreport an in-flight (recoverable) request as never-admitted
+        progressed |= self._admit(
+            replay_only=self._draining.is_set())
         if not self._slots:
             self._publish_gauges(0, 0)
             return progressed
@@ -316,15 +421,23 @@ class Engine:
         return progressed
 
     def run(self) -> None:
-        """Drive step() until queue and slots drain (bench/offline mode)."""
+        """Drive step() until queue and slots drain (bench/offline mode).
+        Like :meth:`start`, clears the draining latch first, so run()
+        after ``stop(drain=True, on_timeout="requeue")`` resumes the
+        requeued work instead of refusing to admit it forever."""
+        self._stop.clear()
+        self._draining.clear()
         while self.scheduler.queue_depth or self._slots:
             self.step()
 
     def start(self) -> "Engine":
-        """Serve from a background thread until stop()."""
+        """Serve from a background thread until stop(). Re-entrant after
+        a stop: clears the draining latch, so requests requeued by
+        ``stop(drain=True, on_timeout="requeue")`` resume decoding."""
         if self._thread is not None:
             return self
         self._stop.clear()
+        self._draining.clear()
 
         def loop():
             while not self._stop.is_set():
@@ -337,12 +450,140 @@ class Engine:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain: bool = False, timeout: Optional[float] = None,
+             on_timeout: str = "fail") -> None:
+        """Stop serving.
+
+        ``drain=False`` (default) pauses the loop where it stands:
+        in-flight slots and the queue are left intact, ``start()``
+        resumes them — the PR 7 semantics, unchanged.
+
+        ``drain=True`` is the online-shutdown contract: stop admitting
+        (``submit`` raises :class:`EngineStopped`, queued requests stay
+        queued), keep stepping until every in-flight sequence finishes or
+        ``timeout`` seconds pass, then resolve the stragglers —
+        ``on_timeout="fail"`` fails still-active slots with
+        :class:`DrainTimeout` and never-admitted queued requests with
+        :class:`EngineStopped` (no Future is left stranded);
+        ``on_timeout="requeue"`` puts active stragglers back at the queue
+        head via the bounded-replay path (prompt + tokens so far) and
+        leaves the queue intact, so a later ``start()`` resumes exactly
+        where the drain stopped. Idempotent, callable from any thread
+        EXCEPT the engine step thread itself — a stream callback calling
+        ``stop()`` would be asking the loop to drain itself (raises
+        ``RuntimeError``; use :meth:`cancel`, or stop from another
+        thread). Signal handlers are fine: flag-set + a join bounded by
+        the drain budget (+1 s grace — if the loop thread is wedged
+        inside a compiled call past that, stop() logs it, resolves the
+        stragglers anyway, and abandons the zombie step's late return),
+        and a second concurrent call finds nothing left to resolve."""
+        if on_timeout not in ("fail", "requeue"):
+            raise ValueError(f"on_timeout must be fail|requeue, "
+                             f"got {on_timeout!r}")
+        if self._thread is not None \
+                and threading.current_thread() is self._thread:
+            raise RuntimeError(
+                "Engine.stop() called from the engine step thread (a "
+                "stream callback): the loop cannot drain itself — use "
+                "cancel(), or call stop() from another thread")
+        deadline = (time.monotonic() + timeout) if timeout is not None \
+            else None
+        graceful = False
+        if drain:
+            self._draining.set()
+            self._wake.set()
+            try:
+                _faults.fault_point("serving.drain")
+                graceful = True
+            except Exception:
+                # injected drain fault: degrade to an immediate stop — the
+                # no-stranded-futures invariant outranks graceful finish
+                graceful = False
+        if graceful:
+            # work still owed = active slots + crash-recovery requeues
+            # awaiting re-admission + requests in transit between the two
+            # (popped-but-prefilling, evicted-but-not-yet-requeued) — NOT
+            # new never-admitted requests
+            def owed() -> bool:
+                return bool(self._slots) or self._in_transit > 0 or \
+                    self.scheduler.queued_replays() > 0
+            if self._thread is not None:
+                # the loop thread keeps stepping (new admissions are
+                # latched off); poll until the last owed sequence evicts
+                # or the budget ends
+                while owed() and not self._stop.is_set():
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        break
+                    jitter_sleep(0.002)
+            else:
+                # offline/manually-driven engine: drive the steps inline
+                while owed() and \
+                        (deadline is None or time.monotonic() < deadline):
+                    self.step()
         self._stop.set()
         self._wake.set()
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            # bounded by the caller's budget: a loop thread wedged inside
+            # a hung compiled call (the watchdog's zombie case) must not
+            # turn stop() into a second unbounded hang
+            t.join(timeout=None if deadline is None else max(
+                0.0, deadline - time.monotonic()) + _JOIN_GRACE_S)
+            if t.is_alive():
+                _log.warning(
+                    "serving stop(): loop thread still wedged in a "
+                    "compiled call past the drain budget — resolving "
+                    "stragglers without it; its late return is abandoned "
+                    "(slots already released; restart the process to "
+                    "reclaim the thread)")
+        self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if drain:
+            self._resolve_stragglers(on_timeout)
+
+    def _resolve_stragglers(self, on_timeout: str) -> None:
+        """Terminal accounting for a drain: no Future may stay stranded
+        (``fail``) or every straggler is requeued resumable (``requeue``).
+        Runs after the loop thread has joined — single-threaded."""
+        requeue: List[_Pending] = []
+        for slot in list(self._slots):
+            pend = slot.pending
+            if on_timeout == "requeue":
+                # drain eviction is not a fault: it does not spend the
+                # replay budget — a restarted engine re-prefills
+                # prompt + tokens-so-far and continues bit-identically.
+                # A late-returning wedged step may have won the _release
+                # race and settled the Future: requeuing it then would
+                # re-decode settled work and set_result would raise
+                if not self._release(slot):
+                    continue
+                pend.replay_tokens = list(slot.tokens)
+                requeue.append(pend)
+            else:
+                self._finish_error(slot, DrainTimeout(
+                    f"request {slot.request.request_id} evicted at drain "
+                    f"timeout after {len(slot.tokens)} tokens"))
+        if requeue:
+            self.scheduler.requeue(requeue)
+        if on_timeout == "fail":
+            for pend in self.scheduler.drain_queue():
+                if pend.replays or pend.replay_tokens:
+                    # NOT overload shed: this request was admitted and
+                    # decoding when crash-recovery requeued it, and the
+                    # drain budget ran out before its re-admission
+                    _obs.inc("serving.requests_total", status="failed")
+                    pend.future.set_exception(DrainTimeout(
+                        f"request {pend.request.request_id} evicted at "
+                        f"drain timeout awaiting replay re-admission "
+                        f"after {len(pend.replay_tokens)} tokens"))
+                    continue
+                _obs.inc("serving.requests_total", status="shed")
+                _obs.inc("serving.rejected_total", reason="shed")
+                pend.future.set_exception(EngineStopped(
+                    f"request {pend.request.request_id} never admitted: "
+                    f"engine stopped"))
 
     # -- step phases ----------------------------------------------------
     def _process_cancellations(self) -> bool:
@@ -356,7 +597,7 @@ class Engine:
             hit = True
         return hit
 
-    def _admit(self) -> bool:
+    def _admit(self, replay_only: bool = False) -> bool:
         free_slots = self.config.max_batch - len(self._slots)
         if free_slots <= 0:
             return False
@@ -375,43 +616,66 @@ class Engine:
             claimed += need
             return True
 
-        pending = self.scheduler.next_admissions(free_slots, can_fit)
+        pending = self.scheduler.next_admissions(free_slots, can_fit,
+                                                 replay_only=replay_only)
         admitted = False
-        for i, p in enumerate(pending):
-            status = self._admit_one(p)
-            admitted |= status == "ok"
-            if status == "noroom":
-                # pool raced out from under the reservation (defensive —
-                # single consumer makes this unreachable today): put THIS
-                # request and everything behind it back in order
-                self.scheduler.requeue(pending[i:])
-                break
+        self._in_transit = len(pending)
+        try:
+            for i, p in enumerate(pending):
+                status = self._admit_one(p)
+                self._in_transit -= 1
+                admitted |= status == "ok"
+                if status == "noroom":
+                    # pool raced out from under the reservation (defensive
+                    # — single consumer makes this unreachable today): put
+                    # THIS request and everything behind it back in order
+                    self.scheduler.requeue(pending[i:])
+                    break
+        finally:
+            self._in_transit = 0
         return admitted
+
+    def _deadline_ctx(self, pendings: Sequence[_Pending]):
+        """The ambient deadline for work done on behalf of ``pendings``:
+        the tightest (submit_time + deadline_s) among them, as a
+        ``resilience.deadline_scope`` (or a no-op when none carries one).
+        Nested retry policies then clamp to the same monotonic instant."""
+        until = [p.submit_time + p.request.deadline_s for p in pendings
+                 if p.submit_time and p.request.deadline_s is not None]
+        return deadline_scope(until=min(until)) if until else nullcontext()
 
     def _admit_one(self, pending: _Pending) -> str:
         """Admit one popped request: ``"ok"`` | ``"failed"`` (future got
         the error, nothing to requeue) | ``"noroom"`` (untouched — the
-        caller must requeue it and everything behind it)."""
+        caller must requeue it and everything behind it). A replayed
+        request (``pending.replay_tokens``) re-prefills prompt + the
+        tokens already generated, so the continuation is bit-identical to
+        a never-faulted run."""
         from ..core.tensor import Tensor as _T
         req = pending.request
+        prompt = req.prompt
+        if pending.replay_tokens:
+            prompt = np.concatenate([
+                prompt, np.asarray(pending.replay_tokens, np.int32)])
         pages = self.kv.alloc(self._pages_needed(req))
         if pages is None:
             return "noroom"
         try:
-            for attempt in (0, 1):
-                try:
-                    _faults.fault_point("serving.admit")
-                    break
-                except Exception as exc:
-                    if attempt:
-                        raise exc
-                    _obs.inc("serving.admit_retries_total")
-            row = self.kv.table_row(pages)
-            outs = self._prefill_program(
-                _T(jnp.asarray(req.prompt[None, :], jnp.int32)),
-                _T(jnp.asarray(row)),
-                _T(jnp.asarray(req.prompt.size, jnp.int32)),
-                _T(self.kv.pool), *self._scales_args())
+            with self._deadline_ctx([pending]):
+                for attempt in (0, 1):
+                    try:
+                        _faults.fault_point("serving.admit")
+                        break
+                    except Exception as exc:
+                        if attempt:
+                            raise exc
+                        _obs.inc("serving.admit_retries_total")
+                row = self.kv.table_row(pages)
+                outs = self._prefill_program(
+                    _T(jnp.asarray(prompt[None, :], jnp.int32)),
+                    _T(jnp.asarray(row)),
+                    _T(jnp.asarray(prompt.size, jnp.int32)),
+                    _T(self.kv.pool), *self._scales_args())
         except Exception as exc:
             self.kv.free(pages)
             _obs.inc("serving.requests_total", status="failed")
@@ -422,7 +686,8 @@ class Engine:
         now = time.monotonic()
         _obs.inc("serving.prefills_total")
         slot = _Slot(pending=pending, page_ids=pages, table_row=row,
-                     t=int(req.prompt.size), last_tok=first_tok,
+                     t=int(prompt.size), last_tok=first_tok,
+                     tokens=list(pending.replay_tokens),
                      first_token_time=now, last_token_time=now)
         self._slots.append(slot)
         self._emit_token(slot, first_tok, now, first=True)
@@ -465,19 +730,46 @@ class Engine:
         args = (_T(jnp.asarray(tok)), _T(jnp.asarray(tables)),
                 _T(jnp.asarray(t)))
         outs = None
-        for attempt in (0, 1):
-            try:
-                outs = self._decode_program(*args, _T(self.kv.pool),
-                                            *self._scales_args())
-                break
-            except Exception as exc:
-                # a whole-batch device fault: functional state means
-                # nothing was written — retry the identical step once
-                if attempt:
-                    for slot in list(included):
-                        self._finish_error(slot, exc)
+        with self._deadline_ctx([s.pending for s in included]):
+            for attempt in (0, 1):
+                gen = self._watchdog.arm() if self._watchdog else None
+                try:
+                    # the device-step seam: delay = hung step (trips the
+                    # watchdog), error = whole-batch device fault
+                    _faults.fault_point("serving.watchdog")
+                    outs = self._decode_program(*args, _T(self.kv.pool),
+                                                *self._scales_args())
+                except Exception as exc:
+                    if gen is not None:
+                        self._watchdog.disarm(gen)
+                    # a whole-batch device fault: functional state means
+                    # nothing was written — retry the identical step once,
+                    # then recover the slots through bounded replay
+                    if attempt:
+                        self._recover_slots(included, exc)
+                        return
+                    _obs.inc("serving.step_retries_total")
+                    continue
+                verdict = self._watchdog.disarm(gen) if gen is not None \
+                    else None
+                if verdict is not None:
+                    # tripped step: abandon its outputs (nothing was
+                    # committed — functional pool state) and replay
+                    self._recover_slots(included, WatchdogTimeout(
+                        f"decode step classified {verdict} by the "
+                        f"watchdog (budget "
+                        f"{self._watchdog.timeout_s:.3f}s)"))
                     return
-                _obs.inc("serving.step_retries_total")
+                break
+        with self._slot_lock:
+            abandoned = any(s not in self._slots for s in included)
+        if abandoned:
+            # a budgeted stop() resolved these slots while the call was in
+            # flight (wedged step, watchdog disabled): the outputs are
+            # abandoned exactly like a tripped step's — functional pool
+            # state, nothing was committed, no late tokens reach settled
+            # futures or a restarted loop's pool
+            return
         self._set_pool(outs[1], outs[2] if self._quantized else None)
         next_np = np.asarray(outs[0]._data)        # the ONE host sync
         now = time.monotonic()
@@ -493,9 +785,12 @@ class Engine:
         slot.last_tok = token
         _obs.inc("serving.tokens_total")
         if first:
+            # a replay's re-prefill also lands here; TTFT is observed
+            # only for the request's true first token
             sub = slot.pending.submit_time
-            if sub:
+            if sub and not slot.pending.ttft_done:
                 _obs.observe("serving.ttft_seconds", now - sub)
+            slot.pending.ttft_done = True
         else:
             _obs.observe("serving.tpot_seconds", now - slot.last_token_time)
         slot.last_token_time = now
@@ -518,12 +813,22 @@ class Engine:
             self._finish(slot, "length")   # cache exhausted (validated
             # at submit, reachable only with adversarial max_len configs)
 
-    def _release(self, slot: _Slot) -> None:
-        self._slots.remove(slot)
+    def _release(self, slot: _Slot) -> bool:
+        """Evict ``slot`` and return its pages. Returns False when the
+        slot was already released — the one way that happens is a wedged
+        step returning AFTER a budgeted stop() resolved the stragglers
+        without it; the late return must not double-free pages or
+        re-resolve a settled Future."""
+        with self._slot_lock:
+            if slot not in self._slots:
+                return False
+            self._slots.remove(slot)
         self.kv.free(slot.page_ids)
+        return True
 
     def _finish(self, slot: _Slot, reason: str) -> None:
-        self._release(slot)
+        if not self._release(slot):
+            return
         _obs.inc("serving.requests_total", status=(
             "completed" if reason in ("eos", "length") else reason))
         n = len(slot.tokens)
@@ -536,9 +841,44 @@ class Engine:
             tpot_s=tpot))
 
     def _finish_error(self, slot: _Slot, exc: BaseException) -> None:
-        self._release(slot)
+        if not self._release(slot):
+            return
         _obs.inc("serving.requests_total", status="failed")
         slot.pending.future.set_exception(exc)
+
+    def _recover_slots(self, included: List[_Slot],
+                       exc: BaseException) -> None:
+        """Crash-recovery for an unrecoverable batched step (device fault
+        after the retry, or a watchdog trip): every included slot is
+        evicted with its pages reclaimed, and — replay budget permitting —
+        requeued AT THE QUEUE HEAD with bounded prefill replay (prompt +
+        tokens generated so far), so the continuation is bit-identical and
+        batchmates no longer share one slot's fate. Past ``max_replays``
+        the slot's Future gets ``exc``."""
+        requeue: List[_Pending] = []
+        # cover the eviction->requeue gap for the drain-owed probe: these
+        # slots leave _slots before their requeue lands in the queue
+        self._in_transit += len(included)
+        try:
+            for slot in list(included):
+                pend = slot.pending
+                if pend.replays >= self.config.max_replays:
+                    self._finish_error(slot, exc)
+                    continue
+                if not self._release(slot):
+                    # already resolved by a budgeted stop() that gave up
+                    # on this wedged step: requeuing would re-decode a
+                    # settled Future and set_result would raise
+                    continue
+                pend.replays += 1
+                pend.replay_tokens = list(slot.tokens)
+                _obs.inc("serving.replays_total")
+                requeue.append(pend)
+            if requeue:
+                self.scheduler.requeue(requeue)
+                self._wake.set()
+        finally:
+            self._in_transit -= len(included)
 
     def _publish_gauges(self, active: int, bucket: int) -> None:
         _obs.set_gauge("serving.active_slots", len(self._slots))

@@ -222,6 +222,14 @@ class PagedKVCache:
         with self._lock:
             return len(self._free)
 
+    @property
+    def outstanding_pages(self) -> int:
+        """Pages currently claimed by slots (scratch excluded). The drain
+        and chaos invariants pin this to 0 after shutdown: a nonzero value
+        with no active slots is a page leak."""
+        with self._lock:
+            return self.config.num_pages - 1 - len(self._free)
+
     def pages_for(self, positions: int) -> int:
         """Pages needed to cover logical positions ``[0, positions)``."""
         ps = self.config.page_size

@@ -20,6 +20,26 @@ Policies:
   tokens admitted at THIS boundary would exceed the budget. Bounds the
   prefill stall a decode step can suffer (the TTFT/TPOT trade knob).
 
+Overload protection (ISSUE 8) also lives at this boundary, because the
+queue is the only place a request can wait unboundedly:
+
+* **Shed on arrival** — when a request carries a ``deadline_s`` /
+  ``ttft_budget_s`` and the scheduler's estimated queue wait (EWMA of
+  the recent admission drain interval x current depth) already exceeds
+  it, ``submit`` raises :class:`~paddle_tpu.resilience.DeadlineExceeded`
+  instead of queueing work that is doomed to expire
+  (``serving.rejected_total{reason=shed}``).
+* **Shed at the admission boundary** — every ``next_admissions`` call
+  first sweeps the queue for requests whose deadline / TTFT budget /
+  ``max_queue_wait_s`` (env ``PADDLE_TPU_SERVING_MAX_QUEUE_WAIT``) has
+  expired while queued; their Futures resolve with ``DeadlineExceeded``
+  (``reason=deadline``, or ``reason=shed`` for the operator cap). A
+  request is NEVER shed once admitted — mid-batch eviction would break
+  the batchmates' bit-identical guarantee.
+* ``serving.queue_wait_seconds`` is observed for every admitted request,
+  so queueing delay is a first-class histogram, not an inference from
+  TTFT.
+
 Requests are host-side objects; nothing here touches the device.
 """
 
@@ -27,15 +47,22 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Callable, Deque, Dict, List, Optional, Sequence
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import observability as _obs
+from ..resilience import DeadlineExceeded
 
-__all__ = ["GenerationRequest", "GenerationResult", "QueueFull", "Scheduler"]
+__all__ = ["GenerationRequest", "GenerationResult", "QueueFull",
+           "DeadlineExceeded", "Scheduler"]
+
+# EWMA smoothing for the admission drain interval (the shed-on-arrival
+# wait model): ~10 admissions of memory
+_EWMA_ALPHA = 0.3
 
 _req_ids = itertools.count()
 
@@ -52,12 +79,23 @@ class GenerationRequest:   # request is a job, not a value
     ``stream(request_id, token)`` from the engine step thread as each
     token lands — keep it cheap. A raising callback fails THIS request
     (its Future gets the exception, its pages free) and never touches its
-    batchmates."""
+    batchmates.
+
+    ``deadline_s`` bounds the request END TO END from submit: if it
+    expires while the request is still queued, the request sheds with
+    :class:`DeadlineExceeded`; once admitted it also becomes the ambient
+    ``resilience.deadline_scope`` around the request's prefill and every
+    decode step it joins (a slot is never evicted mid-batch for an
+    expired deadline — batchmates stay bit-identical). ``ttft_budget_s``
+    bounds only the wait for the FIRST token and therefore only ever
+    sheds in the queue."""
 
     prompt: np.ndarray
     max_new_tokens: int = 64
     eos_token_id: Optional[int] = None
     stream: Optional[Callable[[int, int], None]] = None
+    deadline_s: Optional[float] = None
+    ttft_budget_s: Optional[float] = None
     request_id: int = field(default_factory=lambda: next(_req_ids))
 
     def __post_init__(self):
@@ -66,6 +104,10 @@ class GenerationRequest:   # request is a job, not a value
             raise ValueError("empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        for name in ("deadline_s", "ttft_budget_s"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0 when set, got {v}")
 
 
 @dataclass
@@ -84,6 +126,21 @@ class _Pending:
     request: GenerationRequest
     future: "Future[GenerationResult]"
     submit_time: float = 0.0
+    # when THIS stint in the queue began: equals submit_time on first
+    # enqueue, reset by requeue() — queue-wait accounting (the histogram,
+    # the max_queue_wait_s cap) must never charge a replayed request for
+    # the time it spent DECODING before the fault evicted it
+    queued_at: float = 0.0
+    # crash-recovery state (engine-owned): tokens already generated before
+    # an unrecoverable step fault evicted the slot; on re-admission the
+    # engine re-prefills prompt + replay_tokens into a fresh slot. replays
+    # counts recoveries against ServingConfig.max_replays; ttft_done keeps
+    # the TTFT histogram honest across replays (first token only) AND
+    # exempts a replayed request from the ttft_budget_s queue sweep — a
+    # budget already met cannot expire retroactively.
+    replays: int = 0
+    replay_tokens: List[int] = field(default_factory=list)
+    ttft_done: bool = False
 
 
 class Scheduler:
@@ -92,34 +149,98 @@ class Scheduler:
     arbitrary ``submit``/``cancel`` threads."""
 
     def __init__(self, max_queue: int = 64, policy: str = "fifo",
-                 prefill_token_budget: Optional[int] = None):
+                 prefill_token_budget: Optional[int] = None,
+                 max_queue_wait_s: Optional[float] = None):
         if policy not in ("fifo", "budget"):
             raise ValueError(f"unknown admission policy: {policy!r}")
         if policy == "budget" and not prefill_token_budget:
             raise ValueError("policy='budget' needs prefill_token_budget")
+        if max_queue_wait_s is not None and max_queue_wait_s < 0:
+            raise ValueError(
+                f"max_queue_wait_s must be >= 0, got {max_queue_wait_s}")
         self.max_queue = max_queue
         self.policy = policy
         self.prefill_token_budget = prefill_token_budget
+        # the operator's hard cap on queue wait (0/None = off); requests
+        # queued past it shed with DeadlineExceeded even with no deadline
+        self.max_queue_wait_s = max_queue_wait_s or None
         self._lock = threading.Lock()
         self._queue: List[_Pending] = []
+        # shed-on-arrival wait model: EWMA of the interval between
+        # successive admission pops; estimated wait ~= depth * interval
+        self._ewma_interval: Optional[float] = None
+        self._last_pop_t: Optional[float] = None
         # request ids cancelled while HOLDING A SLOT; the engine consumes
         # these at its next step boundary (eviction is an engine action —
         # pages and slots are engine state)
         self._cancelled_active: set = set()
 
     # -- producer side ------------------------------------------------------
+    def estimated_wait(self) -> float:
+        """Seconds a request arriving NOW is expected to queue (0.0 until
+        enough admissions have been observed to estimate a drain rate)."""
+        with self._lock:
+            return self._estimated_wait_locked()
+
+    def _estimated_wait_locked(self) -> float:
+        if self._ewma_interval is None:
+            return 0.0
+        return self._ewma_interval * len(self._queue)
+
+    def _reset_wait_model_locked(self) -> None:
+        """The queue just drained: both halves of the wait model are now
+        stale. The next pop interval would measure idle (arrival-bound)
+        time, and a drain rate learned under an earlier load regime would
+        shed the first requests of the next burst against an empty,
+        instantly-draining queue. Forget both — under sustained overload
+        the queue never empties, which is exactly when the estimate is
+        live and shedding matters."""
+        self._last_pop_t = None
+        self._ewma_interval = None
+
     def submit(self, request: GenerationRequest,
                submit_time: float = 0.0) -> "Future[GenerationResult]":
         fut: "Future[GenerationResult]" = Future()
         with self._lock:
-            if len(self._queue) >= self.max_queue:
-                _obs.inc("serving.requests_total", status="rejected")
-                raise QueueFull(
-                    f"serving queue full ({self.max_queue} pending)")
-            self._queue.append(_Pending(request, fut, submit_time))
             depth = len(self._queue)
+            if depth >= self.max_queue:
+                _obs.inc("serving.requests_total", status="rejected")
+                _obs.inc("serving.rejected_total", reason="queue_full")
+                raise QueueFull(
+                    f"serving queue full ({depth}/{self.max_queue} pending)")
+            # reject-on-arrival: queueing work whose wait estimate already
+            # blows its budget only delays the DeadlineExceeded and steals
+            # drain rate from requests that can still make theirs
+            budget = min((b for b in (request.deadline_s,
+                                      request.ttft_budget_s,
+                                      self.max_queue_wait_s)
+                          if b is not None), default=None)
+            est = self._estimated_wait_locked()
+            if submit_time and budget is not None and est > budget:
+                _obs.inc("serving.requests_total", status="rejected")
+                _obs.inc("serving.rejected_total", reason="shed")
+                raise DeadlineExceeded(
+                    f"request {request.request_id} shed on arrival: "
+                    f"estimated queue wait {est:.3f}s exceeds its "
+                    f"{budget:.3f}s budget (queue depth {depth})")
+            self._queue.append(_Pending(request, fut, submit_time,
+                                        queued_at=submit_time))
+            depth += 1
         _obs.set_gauge("serving.queue_depth", depth)
         return fut
+
+    def _pop_queued_locked(self, request_id: int) -> Optional[_Pending]:
+        """Remove one queued request by id and return it (lock held).
+        Owns ALL the queue bookkeeping for a removal (wait-model reset on
+        empty) so cancel/withdraw cannot diverge; the caller publishes
+        the depth gauge after releasing the lock."""
+        for i, p in enumerate(self._queue):
+            if p.request.request_id == request_id:
+                del self._queue[i]
+                if not self._queue:
+                    self._reset_wait_model_locked()
+                return p
+        return None
 
     def cancel(self, request_id: int) -> bool:
         """Cancel a request; always returns True. Queued: resolved
@@ -130,16 +251,12 @@ class Scheduler:
         there; the request's Future is the source of truth for what
         actually happened."""
         with self._lock:
-            for i, p in enumerate(self._queue):
-                if p.request.request_id == request_id:
-                    del self._queue[i]
-                    depth = len(self._queue)
-                    pend = p
-                    break
-            else:
+            pend = self._pop_queued_locked(request_id)
+            if pend is None:
                 # not queued: assume active; the engine ignores stale ids
                 self._cancelled_active.add(request_id)
                 return True
+            depth = len(self._queue)
         _obs.set_gauge("serving.queue_depth", depth)
         _obs.inc("serving.requests_total", status="cancelled")
         pend.future.set_result(GenerationResult(
@@ -158,16 +275,87 @@ class Scheduler:
             out, self._cancelled_active = self._cancelled_active, set()
         return out
 
+    def shed_expired(self, now: Optional[float] = None) -> int:
+        """Sweep the queue for requests whose wait budget expired and
+        resolve their Futures with :class:`DeadlineExceeded`. Runs at
+        every admission boundary (``next_admissions`` calls it first) —
+        NEVER against admitted slots. Returns the number shed."""
+        if now is None:
+            now = time.monotonic()
+        shed: List[Tuple[_Pending, str, float, float]] = []
+        with self._lock:
+            kept: List[_Pending] = []
+            for p in self._queue:
+                reason = self._expiry_reason(p, now)
+                if reason is None:
+                    kept.append(p)
+                else:
+                    shed.append(reason)
+            if shed:
+                self._queue = kept
+            if not self._queue:
+                self._reset_wait_model_locked()
+            depth = len(self._queue)
+        if not shed:
+            return 0
+        _obs.set_gauge("serving.queue_depth", depth)
+        for p, reason, waited, budget in shed:
+            _obs.inc("serving.requests_total", status="shed")
+            _obs.inc("serving.rejected_total", reason=reason)
+            p.future.set_exception(DeadlineExceeded(
+                f"request {p.request.request_id} expired in queue: waited "
+                f"{waited:.3f}s against a {budget:.3f}s "
+                f"{'operator max_queue_wait' if reason == 'shed' else 'request'}"
+                f" budget"))
+        return len(shed)
+
+    def _expiry_reason(self, p: _Pending, now: float):
+        """None, or ``(pending, reason, waited, budget)`` — lock held."""
+        if not p.submit_time:
+            return None  # no clock reference: direct scheduler use
+        waited = now - p.submit_time
+        r = p.request
+        if r.deadline_s is not None and waited >= r.deadline_s:
+            return (p, "deadline", waited, r.deadline_s)
+        # a replayed request that already produced its first token
+        # (ttft_done) met its TTFT budget — it cannot expire retroactively
+        if r.ttft_budget_s is not None and not p.ttft_done \
+                and waited >= r.ttft_budget_s:
+            return (p, "deadline", waited, r.ttft_budget_s)
+        # the operator cap bounds QUEUE wait, not request age: measure
+        # this stint only (queued_at resets on requeue), so a replayed
+        # request is not charged for the time it spent decoding
+        waited_q = now - (p.queued_at or p.submit_time)
+        if self.max_queue_wait_s is not None \
+                and waited_q >= self.max_queue_wait_s:
+            return (p, "shed", waited_q, self.max_queue_wait_s)
+        return None
+
+    def queued_replays(self) -> int:
+        """Queued requests that were already admitted once and are
+        waiting on crash-recovery re-admission (``replays`` spent or
+        ``replay_tokens`` carried). The drain wait loop blocks on these:
+        they are work the engine still owes, not new admissions."""
+        with self._lock:
+            return sum(1 for p in self._queue
+                       if p.replays or p.replay_tokens)
+
     def next_admissions(self, free_slots: int,
-                        can_fit: Callable[[GenerationRequest], bool]
-                        ) -> List[_Pending]:
+                        can_fit: Callable[[GenerationRequest], bool],
+                        replay_only: bool = False) -> List[_Pending]:
         """Pop the requests to admit at this step boundary.
 
+        Expired-in-queue requests are shed first (:meth:`shed_expired`).
         ``can_fit`` answers "can the page pool cover this request's whole
         lifetime right now" — it is consulted head-first and admission
-        stops at the first miss (strict FIFO; no slip-ahead). The engine
-        MUST admit every returned request or re-queue it: the pop is the
-        handoff."""
+        stops at the first miss (strict FIFO; no slip-ahead). With
+        ``replay_only`` (a draining engine) admission also stops at the
+        first request that is NOT a crash-recovery requeue — replays sit
+        at the queue head, so the drain finishes what was in flight
+        without admitting new work. The engine MUST admit every returned
+        request or re-queue it: the pop is the handoff."""
+        now = time.monotonic()
+        self.shed_expired(now)
         taken: List[_Pending] = []
         budget = (self.prefill_token_budget
                   if self.policy == "budget" else None)
@@ -175,6 +363,8 @@ class Scheduler:
         with self._lock:
             while self._queue and len(taken) < free_slots:
                 head = self._queue[0]
+                if replay_only and not (head.replays or head.replay_tokens):
+                    break
                 if not can_fit(head.request):
                     break
                 cost = int(head.request.prompt.size)
@@ -182,17 +372,67 @@ class Scheduler:
                     break
                 spent += cost
                 taken.append(self._queue.pop(0))
+            if taken:
+                # drain-interval EWMA feeds the shed-on-arrival estimate.
+                # One sample per BOUNDARY, divided by the pop count: the
+                # per-request drain interval. (A per-pop update would
+                # record dt=0 for every pop after the first — same `now`
+                # — and collapse the estimate under exactly the batched
+                # admission the engine is built for.)
+                if self._last_pop_t is not None:
+                    dt = max(0.0, now - self._last_pop_t) / len(taken)
+                    self._ewma_interval = dt if self._ewma_interval is None \
+                        else (_EWMA_ALPHA * dt +
+                              (1.0 - _EWMA_ALPHA) * self._ewma_interval)
+                self._last_pop_t = now
+            if not self._queue:
+                self._reset_wait_model_locked()
             depth = len(self._queue)
+        for p in taken:
+            if p.submit_time:
+                _obs.observe("serving.queue_wait_seconds",
+                             max(0.0, now - (p.queued_at or p.submit_time)))
         if taken:
             _obs.set_gauge("serving.queue_depth", depth)
         return taken
 
+    def drain_queue(self) -> List[_Pending]:
+        """Pop EVERY queued request (engine shutdown: the caller owns
+        resolving their Futures — nothing may stay stranded)."""
+        with self._lock:
+            out, self._queue = self._queue, []
+            self._reset_wait_model_locked()
+        if out:
+            _obs.set_gauge("serving.queue_depth", 0)
+        return out
+
+    def withdraw(self, request_id: int) -> Optional[_Pending]:
+        """Silently remove a still-queued request and hand it back (no
+        metrics, no Future resolution — the caller owns both). The
+        engine's submit/stop race repair: a request enqueued just as a
+        concurrent drain resolved the queue is withdrawn and rejected on
+        the caller's thread instead of stranding its Future."""
+        with self._lock:
+            p = self._pop_queued_locked(request_id)
+            if p is None:
+                return None
+            depth = len(self._queue)
+        _obs.set_gauge("serving.queue_depth", depth)
+        return p
+
     def requeue(self, pending: Sequence[_Pending]) -> None:
         """Return un-admitted requests to the queue head (engine aborting
-        an admission it could not complete)."""
+        an admission it could not complete, or requeuing replayed slots).
+        Resets each request's ``queued_at``: this is the start of a new
+        queue stint, and the queue-wait cap/histogram must not charge the
+        time the request spent holding a slot."""
         if not pending:
             return
+        now = time.monotonic()
         with self._lock:
+            for p in pending:
+                if p.submit_time:
+                    p.queued_at = now
             self._queue[:0] = list(pending)
             depth = len(self._queue)
         _obs.set_gauge("serving.queue_depth", depth)

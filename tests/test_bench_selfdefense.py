@@ -168,14 +168,22 @@ def test_serving_bench_pins_schema():
         "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms",
         "scan_greedy_parity", "match_frac", "batch_utilization"}
     assert {"benchmark", "kv_dtype", "page_size",
-            "single_stream_tokens_per_sec", "serving",
+            "single_stream_tokens_per_sec", "serving", "resilience",
             "speedup_vs_single_stream", "device"} <= \
         set(mod.SERVING_RESULT_FIELDS)
+    # the serving-under-fire counters (ISSUE 8): shed/deadline/watchdog
+    # visibility is part of the row of record — a bench diff showing
+    # nonzero here means the run itself degraded
+    assert set(mod.SERVING_RESILIENCE_FIELDS) == {
+        "rejected_queue_full", "rejected_deadline", "rejected_shed",
+        "watchdog_trips", "replays"}
     import inspect
     src = inspect.getsource(mod._run_serving)
     # rows/payload are asserted against the pinned schema at emit time
     assert "SERVING_ROW_FIELDS" in src and "SERVING_RESULT_FIELDS" in src
-    for field in mod.SERVING_ROW_FIELDS + mod.SERVING_RESULT_FIELDS:
+    assert "SERVING_RESILIENCE_FIELDS" in src
+    for field in (mod.SERVING_ROW_FIELDS + mod.SERVING_RESULT_FIELDS
+                  + mod.SERVING_RESILIENCE_FIELDS):
         assert f'"{field}"' in src, field
     # greedy-parity failure is a hard exit: no numbers without the gate
     assert "sys.exit(1)" in src

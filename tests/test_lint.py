@@ -353,6 +353,36 @@ def test_device_access_negative_allowed_paths_and_unrelated_attrs(tmp_path):
     assert _lint_snippet(tmp_path, clean, "device-access") == []
 
 
+def test_naked_retry_strict_poll_loop_paths(tmp_path):
+    # poll_loop_paths modules (serving) get the strict tier: a plain
+    # poll-loop sleep WITHOUT try/except is a finding there — watchdog/
+    # drain threads must ride resilience.jitter_sleep
+    poll = """\
+        import time
+
+        def wait_for(flag):
+            while not flag():
+                time.sleep(0.1)
+        """
+    found = _lint_snippet(
+        tmp_path, poll, "naked-retry", filename="watchdog.py",
+        config={"poll_loop_paths": ["watchdog.py"]})
+    assert len(found) == 1 and "jitter_sleep" in found[0].message
+    # the same file outside poll_loop_paths stays clean (non-strict tier)
+    assert _lint_snippet(tmp_path, poll, "naked-retry") == []
+    # jitter_sleep-based polling in a strict module is the sanctioned form
+    clean = """\
+        from paddle_tpu.resilience import jitter_sleep
+
+        def wait_for(flag):
+            while not flag():
+                jitter_sleep(0.1)
+        """
+    assert _lint_snippet(
+        tmp_path, clean, "naked-retry", filename="watchdog.py",
+        config={"poll_loop_paths": ["watchdog.py"]}) == []
+
+
 def test_naked_retry_nested_def_does_not_inherit_loop(tmp_path):
     # a function DEFINED inside a loop starts its own context: its sleep
     # is not "in" the enclosing loop

@@ -228,8 +228,13 @@ def test_q8_quantize_roundtrip():
 def test_q8_chunked_update_matches_single_chunk():
     """Round-4: the int8 update runs per-chunk under lax.map (so fp32
     transients stay O(chunk) at the 2B single-chip ceiling). Multi-chunk
-    (tiny _Q8_CHUNK_ELEMS) must match the single-chunk trajectory exactly —
-    the blockwise quantization math is chunk-shape invariant."""
+    (tiny _Q8_CHUNK_ELEMS) must match the single-chunk trajectory — the
+    blockwise quantization math is chunk-shape invariant, pinned BITWISE
+    on the int8 moment state below. The fp32 weights get a few-ulp
+    allowance: XLA does not promise identical fusion/fma ordering between
+    a lax.map body and the equivalent straight-line program, and some CPU
+    backends (this container's jax 0.4.37 among them) produce 1-ulp
+    differences in the weight-update arithmetic."""
     import paddle_tpu.optimizer as optim
 
     def run(chunk_elems):
@@ -257,7 +262,7 @@ def test_q8_chunked_update_matches_single_chunk():
 
     w_multi, m_multi = run(2048)          # 1 block/chunk -> 3 chunks
     w_single, m_single = run(8 * 1024 * 1024)  # everything in one chunk
-    np.testing.assert_allclose(w_multi, w_single, rtol=0, atol=0)
+    np.testing.assert_allclose(w_multi, w_single, rtol=0, atol=6e-8)
     np.testing.assert_array_equal(m_multi, m_single)
 
 

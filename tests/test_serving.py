@@ -19,6 +19,21 @@ Covers the ISSUE 7 acceptance surface:
 * deterministic fault injection through the existing
   ``resilience.FaultSchedule`` seams: a faulted slot fails ALONE —
   co-batched requests complete with bit-identical tokens.
+
+ISSUE 8 ("serving under fire") adds the overload/containment surface:
+* per-request deadlines + TTFT budgets: expired-in-queue requests shed
+  with a typed ``DeadlineExceeded`` at the admission boundary, batchmates
+  bit-identical to the no-fault run;
+* load shedding: queue-wait-aware reject-on-arrival, the
+  ``PADDLE_TPU_SERVING_MAX_QUEUE_WAIT`` hard cap, and
+  ``serving.rejected_total{reason}`` visibility;
+* the step watchdog: a hung compiled step (delay fault at
+  ``serving.watchdog``) trips, its outputs are abandoned, and its slots
+  recover via bounded prefill replay — zero stranded futures, zero
+  leaked pages;
+* graceful drain: ``stop(drain=True)`` finishes in-flight work, is
+  idempotent, and ``on_timeout="requeue"`` resumes bit-identically after
+  a restart.
 """
 
 import numpy as np
@@ -124,13 +139,8 @@ PROMPTS = [_RNG.integers(0, V, (n,), dtype=np.int32)
            for n in (8, 8, 8, 5, 11)]
 
 
-@pytest.fixture()
-def metrics():
-    obs.enable()
-    obs.reset()
-    yield obs
-    obs.disable()
-    obs.reset()
+# the shared ``metrics`` fixture (fresh enabled obs registry) lives in
+# tests/conftest.py
 
 
 # ---------------------------------------------------------------------------
@@ -587,3 +597,501 @@ class TestFaults:
         assert fb.result(timeout=5).tokens == dense_reference(PROMPTS[1], 5)
         assert fc.result(timeout=5).tokens == dense_reference(PROMPTS[2], 5)
         assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: deadlines, load shedding, queue-wait accounting
+# ---------------------------------------------------------------------------
+
+class TestDeadlinesAndShedding:
+    def test_request_budget_validation(self):
+        with pytest.raises(ValueError, match="deadline_s"):
+            serving.GenerationRequest(PROMPTS[0], deadline_s=0.0)
+        with pytest.raises(ValueError, match="ttft_budget_s"):
+            serving.GenerationRequest(PROMPTS[0], ttft_budget_s=-1.0)
+
+    def test_queue_full_message_has_depth_and_capacity(self, metrics):
+        s = serving.Scheduler(max_queue=2)
+        s.submit(serving.GenerationRequest(PROMPTS[0]))
+        s.submit(serving.GenerationRequest(PROMPTS[1]))
+        with pytest.raises(serving.QueueFull, match=r"2/2"):
+            s.submit(serving.GenerationRequest(PROMPTS[2]))
+        snap = obs.snapshot()
+        assert snap["serving.rejected_total"]["reason=queue_full"] == 1
+
+    def test_queue_wait_histogram_recorded_on_every_admission(self, metrics):
+        eng = make_engine()
+        futs = [eng.submit(serving.GenerationRequest(p, max_new_tokens=3))
+                for p in PROMPTS[:3]]
+        eng.run()
+        for f in futs:
+            f.result(timeout=5)
+        snap = obs.snapshot()
+        assert snap["serving.queue_wait_seconds"]["count"] == 3
+
+    def test_expired_in_queue_sheds_batchmates_bit_identical(self, metrics):
+        """Acceptance (a): under a scripted schedule, the expired request
+        sheds with a typed DeadlineExceeded at the admission boundary —
+        never mid-batch — and its batchmates' outputs are bit-identical
+        to the no-fault run."""
+        ref = {i: dense_reference(PROMPTS[i], 5) for i in (0, 2)}
+        # the scripted delay holds admission long enough for B's TTFT
+        # budget to expire while it queues behind A (max_batch=1)
+        sched = faults.FaultSchedule().delay("serving.admit", on=(1,),
+                                             seconds=0.15)
+        eng = make_engine(max_batch=1)
+        fa = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                  max_new_tokens=5))
+        fb = eng.submit(serving.GenerationRequest(
+            PROMPTS[1], max_new_tokens=5, ttft_budget_s=0.05))
+        fc = eng.submit(serving.GenerationRequest(PROMPTS[2],
+                                                  max_new_tokens=5))
+        with faults.installed(sched):
+            eng.run()
+        with pytest.raises(serving.DeadlineExceeded, match="expired in "
+                                                           "queue"):
+            fb.result(timeout=5)
+        assert fa.result(timeout=5).tokens == ref[0]
+        assert fc.result(timeout=5).tokens == ref[2]
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+        snap = obs.snapshot()
+        assert snap["serving.rejected_total"]["reason=deadline"] == 1
+        assert snap["serving.requests_total"]["status=shed"] == 1
+        assert snap["serving.requests_total"]["status=completed"] == 2
+        # determinism: same scripted schedule => same trace
+        assert sched.trace == [("serving.admit", 1, "delay")]
+
+    def test_shed_on_arrival_when_estimated_wait_exceeds_budget(
+            self, metrics):
+        import time as _t
+        s = serving.Scheduler()
+        s._ewma_interval = 5.0              # recent drain: 5 s per pop
+        s.submit(serving.GenerationRequest(PROMPTS[0]),
+                 submit_time=_t.monotonic())   # no budget: queued
+        with pytest.raises(serving.DeadlineExceeded, match="shed on "
+                                                           "arrival"):
+            s.submit(serving.GenerationRequest(PROMPTS[1], deadline_s=1.0),
+                     submit_time=_t.monotonic())
+        assert s.queue_depth == 1
+        snap = obs.snapshot()
+        assert snap["serving.rejected_total"]["reason=shed"] == 1
+        # a request with headroom still queues
+        s.submit(serving.GenerationRequest(PROMPTS[2], deadline_s=60.0),
+                 submit_time=_t.monotonic())
+        assert s.queue_depth == 2
+
+    def test_max_queue_wait_hard_cap_sheds(self, metrics):
+        import time as _t
+        s = serving.Scheduler(max_queue_wait_s=0.01)
+        fut = s.submit(serving.GenerationRequest(PROMPTS[0]),
+                       submit_time=_t.monotonic() - 1.0)   # waited 1 s
+        assert s.next_admissions(4, lambda r: True) == []
+        with pytest.raises(serving.DeadlineExceeded, match="max_queue_wait"):
+            fut.result(timeout=1)
+        assert obs.snapshot()["serving.rejected_total"]["reason=shed"] == 1
+
+    def test_requeued_replay_not_shed_by_met_ttft_or_queue_cap(
+            self, metrics):
+        """Queue-wait accounting must not charge a replayed request for
+        its time DECODING: a met TTFT budget cannot expire retroactively,
+        and max_queue_wait_s measures this queue stint (queued_at resets
+        on requeue), not request age."""
+        import time as _t
+        from concurrent.futures import Future
+        from paddle_tpu.serving.scheduler import _Pending
+        s = serving.Scheduler(max_queue_wait_s=0.5)
+        old = _t.monotonic() - 10.0       # "admitted 10 s ago, decoding"
+        p = _Pending(serving.GenerationRequest(PROMPTS[0],
+                                               ttft_budget_s=1.0),
+                     Future(), submit_time=old, queued_at=old,
+                     ttft_done=True, replays=1, replay_tokens=[3, 4])
+        s.requeue([p])                    # crash-recovery re-queue NOW
+        assert s.shed_expired() == 0      # neither budget fires
+        assert s.queue_depth == 1
+        # an end-to-end deadline_s, by contrast, still counts total age
+        q = _Pending(serving.GenerationRequest(PROMPTS[1], deadline_s=5.0),
+                     Future(), submit_time=old, queued_at=old,
+                     ttft_done=True, replays=1)
+        s.requeue([q])
+        assert s.shed_expired() == 1
+        with pytest.raises(serving.DeadlineExceeded):
+            q.future.result(timeout=1)
+
+    def test_ewma_wait_model_not_poisoned_by_idle_gap(self):
+        """Draining the queue drops the pop-interval reference: the first
+        admission after an idle lull must not fold the idle time into the
+        drain-rate estimate and shed healthy traffic."""
+        s = serving.Scheduler()
+        for p in PROMPTS[:2]:
+            s.submit(serving.GenerationRequest(p))
+        s.next_admissions(2, lambda r: True)   # queue drained
+        # BOTH halves of the wait model reset: a drain rate learned under
+        # an earlier load regime must not shed the next burst's first
+        # requests against an empty queue
+        assert s._last_pop_t is None and s._ewma_interval is None
+        # ... idle lull happens here; next burst starts a fresh estimate
+        s.submit(serving.GenerationRequest(PROMPTS[2]))
+        s.next_admissions(1, lambda r: True)
+        assert s._ewma_interval is None or s._ewma_interval < 1.0
+
+    def test_ewma_measures_per_request_interval_on_batched_pops(self):
+        """One EWMA sample per boundary, dt divided by the pop count: a
+        4-wide admission 8 s after the last boundary means ~2 s per
+        request — NOT one 8 s sample followed by three dt=0 samples that
+        collapse the estimate and disarm shed-on-arrival under exactly
+        the batched admission the engine is built for."""
+        import time as _t
+        s = serving.Scheduler()
+        for p in PROMPTS:                       # 5 queued; pop 4, 1 stays
+            s.submit(serving.GenerationRequest(p))
+        s._last_pop_t = _t.monotonic() - 8.0    # last boundary: 8 s ago
+        taken = s.next_admissions(4, lambda r: True)
+        assert len(taken) == 4 and s.queue_depth == 1
+        assert 1.5 < s._ewma_interval < 2.5     # ~8/4, not ~0
+
+    def test_withdraw_removes_silently(self, metrics):
+        s = serving.Scheduler()
+        req = serving.GenerationRequest(PROMPTS[0])
+        fut = s.submit(req)
+        pend = s.withdraw(req.request_id)
+        assert pend is not None and pend.future is fut
+        assert not fut.done() and s.queue_depth == 0
+        assert s.withdraw(req.request_id) is None      # already gone
+        snap = obs.snapshot()
+        assert "serving.requests_total" not in snap    # no accounting
+
+    def test_env_knobs_resolve_into_config(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_SERVING_MAX_QUEUE_WAIT", "0.25")
+        monkeypatch.setenv("PADDLE_TPU_SERVING_WATCHDOG_S", "1.5")
+        cfg = serving.ServingConfig(num_layers=L, num_heads=H, head_dim=D,
+                                    max_len=M, max_batch=1, buckets=(1,))
+        assert cfg.max_queue_wait_s == 0.25 and cfg.watchdog_s == 1.5
+        # explicit 0 forces OFF even with the env set
+        cfg0 = serving.ServingConfig(num_layers=L, num_heads=H, head_dim=D,
+                                     max_len=M, max_batch=1, buckets=(1,),
+                                     watchdog_s=0, max_queue_wait_s=0)
+        assert cfg0.max_queue_wait_s is None and cfg0.watchdog_s is None
+
+    def test_deadline_scope_propagates_request_deadline(self):
+        from concurrent.futures import Future
+        from paddle_tpu.resilience import current_deadline
+        from paddle_tpu.serving.scheduler import _Pending
+        eng = make_engine()
+        p = _Pending(serving.GenerationRequest(PROMPTS[0], deadline_s=5.0),
+                     Future(), submit_time=100.0)
+        with eng._deadline_ctx([p]):
+            assert current_deadline() == pytest.approx(105.0)
+        q = _Pending(serving.GenerationRequest(PROMPTS[1]), Future(),
+                     submit_time=100.0)
+        with eng._deadline_ctx([q]):
+            assert current_deadline() is None
+        # batched: the tightest deadline governs
+        r = _Pending(serving.GenerationRequest(PROMPTS[2], deadline_s=2.0),
+                     Future(), submit_time=100.0)
+        with eng._deadline_ctx([p, q, r]):
+            assert current_deadline() == pytest.approx(102.0)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: watchdog + bounded prefill replay
+# ---------------------------------------------------------------------------
+
+class TestWatchdogRecovery:
+    def test_watchdog_unit_trip_and_zombie(self, metrics):
+        import time as _t
+        wd = serving.StepWatchdog(0.03)
+        try:
+            gen = wd.arm()
+            _t.sleep(0.15)                   # > 2x budget: hung then zombie
+            assert wd.disarm(gen) == "zombie"
+            gen2 = wd.arm()
+            assert wd.disarm(gen2) is None   # came back in time
+        finally:
+            wd.stop()
+        snap = obs.snapshot()
+        assert snap["serving.watchdog_trips_total"]["kind=hung"] == 1
+        assert snap["serving.watchdog_trips_total"]["kind=zombie"] == 1
+
+    def test_watchdog_trip_recovers_via_replay(self, metrics):
+        """Acceptance (b): a hung step (scripted delay at the
+        serving.watchdog seam) trips the watchdog; its outputs are
+        abandoned and BOTH slots recover through bounded prefill replay —
+        the full transcripts stay bit-identical, no future strands, the
+        pool free-list returns to full."""
+        # budget generous vs CPU scheduling noise (a GC pause must not
+        # look hung), delay 4x the budget so the trip is unambiguous
+        sched = faults.FaultSchedule().delay("serving.watchdog", on=(2,),
+                                             seconds=1.0)
+        eng = make_engine(watchdog_s=0.25, max_replays=1)
+        with faults.installed(sched):
+            futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=4)) for p in PROMPTS[:2]]
+            eng.run()
+        eng.stop()                        # reap the watchdog poll thread
+        for p, f in zip(PROMPTS, futs):
+            assert f.result(timeout=5).tokens == dense_reference(p, 4)
+        assert eng.active_requests == 0 and eng.queue_depth == 0
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+        assert eng.kv.outstanding_pages == 0
+        snap = obs.snapshot()
+        assert snap["serving.watchdog_trips_total"]["kind=hung"] >= 1
+        assert snap["serving.replays_total"] == 2
+        assert snap["serving.requests_total"]["status=completed"] == 2
+        assert sched.trace == [("serving.watchdog", 2, "delay")]
+
+    def test_device_fault_single_retry_still_succeeds(self, metrics):
+        sched = faults.FaultSchedule().error("serving.watchdog", on=(1,))
+        eng = make_engine()
+        with faults.installed(sched):
+            futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=4)) for p in PROMPTS[:2]]
+            eng.run()
+        for p, f in zip(PROMPTS, futs):
+            assert f.result(timeout=5).tokens == dense_reference(p, 4)
+        assert obs.snapshot()["serving.step_retries_total"] == 1
+        assert obs.snapshot().get("serving.replays_total") is None
+
+    def test_device_double_fault_replays_not_fails(self, metrics):
+        """The crash-recovery contract change: an unrecoverable batched
+        step (fault + failed retry) used to fail EVERY in-flight request;
+        now the slots replay (prompt + tokens so far) and complete
+        bit-identically."""
+        sched = faults.FaultSchedule().error("serving.watchdog", on=(2, 3))
+        eng = make_engine(max_replays=1)
+        with faults.installed(sched):
+            futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=4)) for p in PROMPTS[:2]]
+            eng.run()
+        for p, f in zip(PROMPTS, futs):
+            assert f.result(timeout=5).tokens == dense_reference(p, 4)
+        snap = obs.snapshot()
+        assert snap["serving.replays_total"] == 2
+        assert snap["serving.requests_total"]["status=completed"] == 2
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+
+    def test_replay_budget_exhausted_fails_with_pages_reclaimed(
+            self, metrics):
+        sched = faults.FaultSchedule().error("serving.watchdog",
+                                             on=(2, 3, 4, 5))
+        eng = make_engine(max_replays=0)      # no replay budget at all
+        with faults.installed(sched):
+            futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=4)) for p in PROMPTS[:2]]
+            eng.run()
+        for f in futs:
+            with pytest.raises(faults.FaultInjected):
+                f.result(timeout=5)
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+        snap = obs.snapshot()
+        assert snap["serving.requests_total"]["status=failed"] == 2
+        assert snap.get("serving.replays_total") is None
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: graceful drain
+# ---------------------------------------------------------------------------
+
+class TestDrain:
+    def test_stop_drain_completes_inflight_and_is_idempotent(self, metrics):
+        """Acceptance (c): drain finishes the admitted sequences, resolves
+        everything, returns every page, and a second stop is a no-op."""
+        eng = make_engine()
+        futs = [eng.submit(serving.GenerationRequest(p, max_new_tokens=5))
+                for p in PROMPTS[:3]]
+        eng.step()                        # all three admitted
+        assert eng.active_requests == 3
+        eng.stop(drain=True, timeout=30)
+        for p, f in zip(PROMPTS, futs):
+            assert f.result(timeout=1).tokens == dense_reference(p, 5)
+        assert eng.active_requests == 0 and eng.queue_depth == 0
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+        eng.stop(drain=True, timeout=1)   # idempotent: nothing to resolve
+        eng.stop()
+        snap = obs.snapshot()
+        assert snap["serving.requests_total"]["status=completed"] == 3
+
+    def test_stop_drain_from_background_thread(self):
+        import threading as _th
+        seen = _th.Event()
+        eng = make_engine()
+        eng.start()
+        fut = eng.submit(serving.GenerationRequest(
+            PROMPTS[0], max_new_tokens=4,
+            stream=lambda rid, tok: seen.set()))
+        assert seen.wait(timeout=30)      # admitted before we drain
+        eng.stop(drain=True, timeout=30)
+        assert fut.result(timeout=1).tokens == dense_reference(PROMPTS[0], 4)
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+
+    def test_submit_while_draining_raises(self, metrics):
+        eng = make_engine()
+        eng.stop(drain=True, timeout=1)
+        with pytest.raises(serving.EngineStopped):
+            eng.submit(serving.GenerationRequest(PROMPTS[0]))
+        assert obs.snapshot()["serving.rejected_total"]["reason=shed"] == 1
+
+    def test_drain_timeout_fail_resolves_every_future(self, metrics):
+        """timeout=0 with work in flight: the straggler fails with
+        DrainTimeout, the never-admitted request with EngineStopped — no
+        stranded futures, no leaked pages."""
+        eng = make_engine(max_batch=1)
+        f0 = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                  max_new_tokens=40))
+        f1 = eng.submit(serving.GenerationRequest(PROMPTS[1],
+                                                  max_new_tokens=40))
+        eng.step()                        # A admitted, B queued
+        eng.stop(drain=True, timeout=0)
+        with pytest.raises(serving.DrainTimeout):
+            f0.result(timeout=1)
+        with pytest.raises(serving.EngineStopped):
+            f1.result(timeout=1)
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+        snap = obs.snapshot()
+        assert snap["serving.requests_total"]["status=failed"] == 1
+        assert snap["serving.requests_total"]["status=shed"] == 1
+
+    def test_run_after_requeue_drain_resumes_not_spins(self):
+        """run() clears the draining latch like start() does: the offline
+        drive mode after stop(drain=True, on_timeout='requeue') must
+        resume the requeued work, not refuse admission forever."""
+        eng = make_engine(max_batch=1)
+        f0 = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                  max_new_tokens=6))
+        eng.step()
+        eng.stop(drain=True, timeout=0, on_timeout="requeue")
+        assert not f0.done() and eng.queue_depth == 1
+        eng.run()                         # would busy-spin if still latched
+        assert f0.result(timeout=1).tokens == dense_reference(PROMPTS[0], 6)
+        assert eng.kv.outstanding_pages == 0
+
+    def test_drain_timeout_requeue_then_restart_resumes_bit_identical(self):
+        eng = make_engine(max_batch=1)
+        f0 = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                  max_new_tokens=6))
+        f1 = eng.submit(serving.GenerationRequest(PROMPTS[1],
+                                                  max_new_tokens=6))
+        eng.step()                        # A admitted + 1 token
+        eng.stop(drain=True, timeout=0, on_timeout="requeue")
+        assert not f0.done() and not f1.done()
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+        assert eng.queue_depth == 2      # A (with its replay token) then B
+        eng.start()                       # clears the draining latch
+        try:
+            assert f0.result(timeout=30).tokens == \
+                dense_reference(PROMPTS[0], 6)
+            assert f1.result(timeout=30).tokens == \
+                dense_reference(PROMPTS[1], 6)
+        finally:
+            eng.stop()
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+
+    def test_drain_readmits_crash_recovery_requeues(self, metrics):
+        """A double-faulted step DURING a graceful drain must not turn an
+        admitted, recoverable request into a never-admitted EngineStopped:
+        the drain re-admits crash-recovery requeues (replay_only
+        admission) and finishes the sequence."""
+        # call 1 fires at the first decode attempt; 2 at its retry — the
+        # slot is requeued with replay tokens while the drain is running
+        sched = faults.FaultSchedule().error("serving.watchdog", on=(1, 2))
+        eng = make_engine(max_batch=1, max_replays=1)
+        fut = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                   max_new_tokens=5))
+        eng.step()                        # admitted (prefill + 1st token)
+        with faults.installed(sched):
+            eng.stop(drain=True, timeout=30)
+        assert fut.result(timeout=1).tokens == dense_reference(PROMPTS[0], 5)
+        assert eng.kv.outstanding_pages == 0
+        snap = obs.snapshot()
+        assert snap["serving.replays_total"] == 1
+        assert snap["serving.requests_total"]["status=completed"] == 1
+        assert sched.trace == [("serving.watchdog", 1, "error"),
+                               ("serving.watchdog", 2, "error")]
+
+    def test_drain_zero_budget_fails_replay_as_drain_timeout(self, metrics):
+        """If the drain budget runs out before a crash-recovery requeue
+        re-admits, its Future fails with DrainTimeout / status=failed —
+        it was admitted once, so reporting it as never-admitted overload
+        shed (EngineStopped / status=shed) would lie to the operator."""
+        sched = faults.FaultSchedule().error("serving.watchdog", on=(1, 2))
+        eng = make_engine(max_batch=1, max_replays=1)
+        fut = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                   max_new_tokens=5))
+        eng.step()
+        with faults.installed(sched):
+            eng.step()                    # fault + failed retry: requeued
+        assert eng.queue_depth == 1 and eng.active_requests == 0
+        eng.stop(drain=True, timeout=0)
+        with pytest.raises(serving.DrainTimeout, match="replay"):
+            fut.result(timeout=1)
+        snap = obs.snapshot()
+        assert snap["serving.requests_total"]["status=failed"] == 1
+        assert "status=shed" not in snap.get("serving.requests_total", {})
+        assert eng.kv.outstanding_pages == 0
+
+    def test_stop_from_stream_callback_raises_not_wedges(self):
+        """stop() on the engine step thread would be the loop asking
+        itself to drain — with no timeout it would hang forever. The
+        guard raises instead; per the stream-callback contract the error
+        fails THAT request alone and the loop survives."""
+        eng = make_engine()
+        eng.start()
+        try:
+            fut = eng.submit(serving.GenerationRequest(
+                PROMPTS[0], max_new_tokens=4,
+                stream=lambda rid, tok: eng.stop(drain=True)))
+            with pytest.raises(RuntimeError, match="step thread"):
+                fut.result(timeout=30)
+            # the loop survived the callback's failure: new work completes
+            f2 = eng.submit(serving.GenerationRequest(PROMPTS[1],
+                                                      max_new_tokens=4))
+            assert f2.result(timeout=30).tokens == \
+                dense_reference(PROMPTS[1], 4)
+        finally:
+            eng.stop()
+        assert eng.kv.outstanding_pages == 0
+
+    @pytest.mark.slow
+    def test_stop_join_bounded_when_step_wedged(self, metrics):
+        """Acceptance hardening: stop(drain=True, timeout=...) must
+        return even when the loop thread is wedged inside a hung compiled
+        call (the exact zombie case the watchdog classifies) — bounded
+        join, stragglers resolved without it, late return abandoned
+        without double-free."""
+        import time as _t
+        sched = faults.FaultSchedule().delay("serving.watchdog", on=(2,),
+                                             seconds=3.0)
+        eng = make_engine(max_batch=1)
+        with faults.installed(sched):
+            eng.start()
+            fut = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                       max_new_tokens=40))
+            while not fut.done() and eng.active_requests == 0:
+                _t.sleep(0.01)            # admitted before we drain
+            t0 = _t.monotonic()
+            eng.stop(drain=True, timeout=0.2)
+            # returned well before the 3 s hang released (0.2 budget +
+            # 1 s join grace + slack)
+            assert _t.monotonic() - t0 < 2.5
+            with pytest.raises(serving.DrainTimeout):
+                fut.result(timeout=1)
+            assert eng.kv.outstanding_pages == 0
+            _t.sleep(3.2)                 # let the wedged step return
+        # the late return was abandoned: no double-free, no re-resolution
+        assert eng.kv.outstanding_pages == 0
+        assert fut.exception(timeout=0) is not None
+
+    def test_injected_drain_fault_degrades_to_immediate_stop(self, metrics):
+        """An error at the serving.drain seam must not strand anything:
+        the drain degrades to an immediate stop and still resolves every
+        future."""
+        sched = faults.FaultSchedule().error("serving.drain", on=(1,))
+        eng = make_engine(max_batch=1)
+        f0 = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                  max_new_tokens=40))
+        eng.step()
+        with faults.installed(sched):
+            eng.stop(drain=True, timeout=30)
+        with pytest.raises(serving.DrainTimeout):
+            f0.result(timeout=1)
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+        assert sched.trace == [("serving.drain", 1, "error")]

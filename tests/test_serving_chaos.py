@@ -1,0 +1,182 @@
+"""Seeded chaos/soak for the serving engine (ISSUE 8 satellite).
+
+A randomized-but-SEEDED ``FaultSchedule`` sweep over every serving fault
+site — ``serving.admit`` / ``serving.step`` / ``serving.watchdog`` /
+``serving.drain`` — driving the toy-LM engine from ``test_serving``
+through admission faults, per-slot step faults, whole-batch device
+faults, hung-step watchdog trips with bounded replay, and injected drain
+faults, while asserting the liveness invariants that make "serving under
+fire" trustworthy:
+
+* **every submitted Future resolves** — with a result or a typed error
+  (``FaultInjected`` / ``WatchdogTimeout`` / ``DeadlineExceeded`` /
+  ``DrainTimeout`` / ``EngineStopped``), never stranded;
+* **the page pool returns to empty** — free-list back to full, zero
+  outstanding pages: no leak on ANY recovery path;
+* **terminal accounting is exact** — each resolved request is counted
+  under exactly one ``serving.requests_total`` status, and the counters
+  are monotone across the sweep;
+* requests that DO complete under fire decode exactly the no-fault
+  reference sequence (faults may delay or kill a request, never corrupt
+  one — functional pool state).
+
+The per-seed schedules are deterministic (``FaultSchedule``'s own seeded
+RNG); wall-clock timing (the watchdog thread) decides only WHEN a hung
+step trips, never the invariants asserted here. Scripted bit-identical
+trace pins live in ``test_serving.py``.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (backend pin via conftest)
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.resilience import DeadlineExceeded, faults
+
+from test_serving import PROMPTS, dense_reference, make_engine
+
+EXPECTED_ERRORS = (faults.FaultInjected, serving.WatchdogTimeout,
+                   DeadlineExceeded, serving.DrainTimeout,
+                   serving.EngineStopped)
+
+# statuses a successfully-submitted request may terminally resolve under
+# (submit-time rejections raise on the caller thread and never get here)
+TERMINAL_STATUSES = ("completed", "failed", "shed", "cancelled")
+
+
+def _chaos_schedule(seed: int) -> faults.FaultSchedule:
+    """All four serving sites, seeded probabilities. The watchdog-site
+    delay is rare and long (vs. a generous budget) so a trip is
+    unambiguous without stretching the soak's wall clock."""
+    sched = faults.FaultSchedule(seed)
+    sched.error("serving.admit", prob=0.15)
+    sched.error("serving.step", prob=0.06)
+    sched.delay("serving.watchdog", prob=0.04, times=1, seconds=0.8)
+    sched.error("serving.watchdog", prob=0.05)
+    sched.error("serving.drain", prob=0.5)
+    return sched
+
+
+# the shared ``metrics`` fixture (fresh enabled obs registry) lives in
+# tests/conftest.py
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_chaos_sweep_invariants(seed, metrics):
+    sched = _chaos_schedule(seed)
+    eng = make_engine(max_batch=4, watchdog_s=0.2, max_replays=2,
+                      max_queue=16)
+    n_new = [4, 3, 5, 4, 3]
+    futs = []
+    with faults.installed(sched):
+        for i, (p, n) in enumerate(zip(PROMPTS, n_new)):
+            # a mix of unbounded requests and generous deadlines: the
+            # deadline paths stay live without making shedding the
+            # dominant outcome
+            kw = {"deadline_s": 30.0} if i % 2 else {}
+            futs.append(eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=n, **kw)))
+        eng.run()
+        eng.stop(drain=True, timeout=10)
+    eng.stop(drain=True, timeout=1)        # idempotent under fire
+
+    # 1) no stranded futures: everything resolved, typed
+    completed = 0
+    for p, n, f in zip(PROMPTS, n_new, futs):
+        assert f.done(), "stranded future after drain"
+        try:
+            res = f.result(timeout=0)
+        except EXPECTED_ERRORS:
+            continue
+        completed += 1
+        # survivors decode the exact no-fault sequence
+        assert res.tokens == dense_reference(p, n)
+        assert res.finish_reason in ("length", "eos")
+
+    # 2) no leaked pages, no residual slots/queue
+    assert eng.kv.outstanding_pages == 0
+    assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+    assert eng.active_requests == 0 and eng.queue_depth == 0
+
+    # 3) terminal accounting: every submitted request counted exactly once
+    snap = obs.snapshot()
+    req_counts = snap.get("serving.requests_total", {})
+    resolved = sum(req_counts.get(f"status={s}", 0)
+                   for s in TERMINAL_STATUSES)
+    assert resolved == len(futs)
+    assert req_counts.get("status=completed", 0) == completed
+
+    # 4) monotone/consistent counters: tokens were only ever added, and
+    #    replays never exceeded the budget x submissions
+    assert snap.get("serving.tokens_total", 0) >= completed * min(n_new)
+    assert snap.get("serving.replays_total", 0) <= 2 * len(futs)
+
+
+def test_chaos_same_seed_same_terminal_state(metrics):
+    """Two sweeps under the same seed agree on every per-request outcome
+    (result tokens or exception type) — the FaultSchedule determinism
+    contract holds through the full engine, with the timing-driven
+    watchdog excluded from the schedule."""
+    def run_once():
+        sched = faults.FaultSchedule(7)
+        sched.error("serving.admit", prob=0.2)
+        sched.error("serving.step", prob=0.08)
+        outcomes = []
+        eng = make_engine(max_batch=4, max_replays=1)
+        with faults.installed(sched):
+            futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=4)) for p in PROMPTS[:4]]
+            eng.run()
+            eng.stop(drain=True, timeout=10)
+        for f in futs:
+            try:
+                outcomes.append(("ok", tuple(f.result(timeout=0).tokens)))
+            except EXPECTED_ERRORS as exc:
+                outcomes.append(("err", type(exc).__name__))
+        return outcomes, list(sched.trace)
+
+    first, trace1 = run_once()
+    second, trace2 = run_once()
+    assert first == second
+    assert trace1 == trace2 and len(trace1) >= 1
+
+
+def test_soak_continuous_load_with_faults(metrics):
+    """Longer horizon: three waves of submissions against a live engine
+    (background thread) with step/admit faults and replays enabled; the
+    drain at the end must still resolve the world and return every
+    page."""
+    rng = np.random.default_rng(42)
+    sched = faults.FaultSchedule(99)
+    sched.error("serving.admit", prob=0.1)
+    sched.error("serving.step", prob=0.05)
+    sched.error("serving.watchdog", prob=0.03)
+    eng = make_engine(max_batch=4, max_queue=32, max_replays=2)
+    futs = []
+    with faults.installed(sched):
+        eng.start()
+        try:
+            for _ in range(3):
+                for _ in range(6):
+                    p = rng.integers(0, 31, (int(rng.integers(3, 12)),),
+                                     dtype=np.int32)
+                    futs.append(eng.submit(serving.GenerationRequest(
+                        p, max_new_tokens=int(rng.integers(2, 6)))))
+                # wait for the wave to mostly drain before the next
+                for f in futs:
+                    try:
+                        f.result(timeout=60)
+                    except EXPECTED_ERRORS:
+                        pass
+        finally:
+            eng.stop(drain=True, timeout=10)
+    assert len(futs) == 18
+    for f in futs:
+        assert f.done()
+    assert eng.kv.outstanding_pages == 0
+    assert eng.active_requests == 0 and eng.queue_depth == 0
+    snap = obs.snapshot()
+    resolved = sum(snap["serving.requests_total"].get(f"status={s}", 0)
+                   for s in TERMINAL_STATUSES)
+    assert resolved == len(futs)

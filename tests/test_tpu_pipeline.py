@@ -2,6 +2,9 @@
 
 import pytest
 
+# the whole module drives the shard_map pipeline engine
+pytestmark = pytest.mark.requires_shard_map
+
 import jax
 import jax.numpy as jnp
 import numpy as np

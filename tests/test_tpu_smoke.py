@@ -108,9 +108,12 @@ _OPS = _load_ops()
 # + synthetic families for compiled SUBSYSTEM paths that no single ops.yaml
 # entry covers: the serving engine's paged gather->step->scatter decode
 # program is its own lowering surface (dynamic_slice/scatter over the page
-# pool fused with the decode step)
+# pool fused with the decode step), and the online-shutdown contract
+# (stop(drain=True) against a live step loop) exercises the compiled path
+# from a background thread — host-sync + device-buffer lifetime behavior
+# the offline run() drain cannot see
 FAMILIES = sorted({family_of(o["op"], o["module"], o["arity"])
-                   for o in _OPS} | {"serving_decode"})
+                   for o in _OPS} | {"serving_decode", "serving_drain"})
 
 
 def _t(data, dtype="float32", stop_gradient=True):
@@ -353,6 +356,62 @@ def _smoke_serving_decode():
     eng.run()
     for p, f in zip(prompts, futs):
         assert f.result(timeout=30).tokens == dense(p, 4)
+
+
+def _smoke_serving_drain():
+    # the online-shutdown contract on the real chip: a live start() loop
+    # decoding on-device must stop(drain=True) with every Future resolved,
+    # every page back in the pool, and a second stop() a no-op — the
+    # graceful-drain path does its compiled steps from the background
+    # thread, which is exactly the surface the offline run() drain skips
+    import jax.numpy as jnp
+    from paddle_tpu import serving
+    from paddle_tpu.core.tensor import Tensor as T
+
+    L = H = 1
+    D, M, V = 8, 32, 13
+    ramp = (jnp.arange(D, dtype=jnp.float32) + 1.0) / D
+
+    def step(tok, cache, t):
+        c = cache._data
+        nxt = (tok._data[:, 0] * 7 + t._data.astype(jnp.int32)) % V
+        kv = ((nxt.astype(jnp.float32) + 1.0) / V)[:, None] * ramp
+        c = c + 0.0 * kv.sum()          # touch the cache: keep the gather/
+        return T(nxt[:, None].astype(jnp.int32)), T(c)  # scatter leg live
+
+    def prefill(ids, cache):
+        nxt = (ids._data.sum(axis=1).astype(jnp.int32)) % V
+        return T(nxt[:, None]), T(cache._data)
+
+    cfg = serving.ServingConfig(num_layers=L, num_heads=H, head_dim=D,
+                                max_len=M, max_batch=2, buckets=(1, 2),
+                                page_size=8, max_queue=8)
+    eng = serving.Engine(prefill, step, cfg).warmup()
+    prompts = [np.arange(6, dtype=np.int32) % V,
+               (np.arange(6, dtype=np.int32) * 5) % V]
+    eng.start()
+    import threading
+    admitted = threading.Event()
+    first = set()
+
+    def on_tok(rid, _tok):
+        first.add(rid)
+        if len(first) >= len(prompts):
+            admitted.set()
+
+    futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=6, stream=on_tok)) for p in prompts]
+    # drain finishes IN-FLIGHT work only (queued requests resolve
+    # EngineStopped): wait for both to hold slots before shutting down
+    assert admitted.wait(timeout=60)
+    eng.stop(drain=True, timeout=60)
+    eng.stop(drain=True, timeout=1)      # idempotent
+    for f in futs:
+        assert f.done()
+        res = f.result(timeout=0)
+        assert len(res.tokens) == 6 and res.finish_reason == "length"
+    assert eng.kv.outstanding_pages == 0
+    assert eng.active_requests == 0 and eng.queue_depth == 0
 
 
 def _smoke_strided():

@@ -139,6 +139,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # naked-retry: the module(s) allowed to own raw sleep-in-retry-loop
     # mechanics — everything else routes through their policies
     "retry_allowed_paths": ["paddle_tpu/resilience"],
+    # naked-retry strict tier: modules where ANY in-loop time.sleep is a
+    # finding (not just try/except loops) — serving-side poll threads
+    # (the step watchdog, drain waits) must use resilience.jitter_sleep
+    "poll_loop_paths": ["paddle_tpu/serving"],
     # device-access: the only modules allowed to call jax.devices /
     # jax.device_put directly — the Place taxonomy and the backend-
     # fallback dispatcher (PR 6); everything else routes through them

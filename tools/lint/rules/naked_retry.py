@@ -12,6 +12,13 @@ inside a ``While``/``For`` whose body also contains a ``try/except``,
 outside the allowed paths (``retry_allowed_paths`` config, default
 ``paddle_tpu/resilience``). Deliberate survivors go in the baseline with
 a written reason, per the PR-3 convention.
+
+Modules listed in ``poll_loop_paths`` (ISSUE 8: ``paddle_tpu/serving``
+— the watchdog poll thread and the drain wait loop) get the STRICT
+tier: ANY in-loop ``time.sleep`` is flagged, try/except or not. A
+serving-side thread that sleeps on a fixed cadence beats in phase
+across a fleet of engines; ``resilience.jitter_sleep`` is the only
+sanctioned poll primitive there.
 """
 
 from __future__ import annotations
@@ -56,6 +63,9 @@ class NakedRetryRule(Rule):
         if any(ctx.path == p or ctx.path.startswith(p + "/")
                or path_matches(ctx.path, [p]) for p in allowed):
             return
+        strict = any(ctx.path == p or ctx.path.startswith(p + "/")
+                     or path_matches(ctx.path, [p])
+                     for p in ctx.config.get("poll_loop_paths", []))
         aliases, sleeps = _time_sleep_names(ctx.tree)
         if not aliases and not sleeps:
             return
@@ -85,6 +95,14 @@ class NakedRetryRule(Rule):
                         f"for retries or resilience.jitter_sleep for "
                         f"polls (or baseline with the written reason the "
                         f"cadence is deliberate)"))
+                elif strict:
+                    findings.append(ctx.finding(
+                        node, rule,
+                        f"fixed-cadence `time.sleep` poll loop in "
+                        f"'{fn_name or '<module>'}': this module is in "
+                        f"poll_loop_paths — serving-side threads must "
+                        f"poll via resilience.jitter_sleep so a fleet of "
+                        f"engines never beats in phase"))
             for child in ast.iter_child_nodes(node):
                 visit(child, fn_name, loops)
 
