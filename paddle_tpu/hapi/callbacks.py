@@ -153,24 +153,40 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    """Save model+optimizer every ``save_freq`` epochs under ``save_dir``."""
+    """Save model+optimizer every ``save_freq`` epochs under ``save_dir``.
 
-    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint"):
+    Since PR 10 each save is a VERIFIED checkpoint directory
+    (``save_dir/epoch-N/``, ``save_dir/final/``) written by the PR 5
+    crash-safe writer — atomic payload, CRC32 manifest committed last,
+    ``latest``/``latest.prev`` pointers rotating in ``save_dir`` — instead
+    of bare ``.pdparams`` saves a kill could tear. Load with
+    ``Model.load_verified`` (checksums verified; a corrupt candidate
+    falls back down the pointer chain). ``legacy=True`` restores the old
+    ``Model.save``-based file pairs."""
+
+    def __init__(self, save_freq: int = 1, save_dir: str = "checkpoint",
+                 legacy: bool = False):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.legacy = legacy
+
+    def _save(self, name: str) -> None:
+        if self.legacy:
+            self.model.save(os.path.join(self.save_dir, name))
+        else:
+            self.model.save_verified(os.path.join(self.save_dir, name))
 
     def on_epoch_end(self, epoch, logs=None):
         if self.model is not None and epoch % self.save_freq == 0:
-            path = os.path.join(self.save_dir, str(epoch))
-            self.model.save(path)
+            self._save(str(epoch) if self.legacy else f"epoch-{epoch}")
 
     def on_train_end(self, logs=None):
         # no "final" artifact for a crashed run: a partially-trained model
         # must not be indistinguishable from a completed one
         if self.model is not None and \
                 not getattr(self.model, "_train_aborted", False):
-            self.model.save(os.path.join(self.save_dir, "final"))
+            self._save("final")
 
 
 class EarlyStopping(Callback):

@@ -564,6 +564,15 @@ class DataLoader:
         self.persistent_workers = persistent_workers
         self.timeout = timeout
         self._pool = None
+        # resumable-iteration cursor (state_dict/load_state_dict): the
+        # LAST-started iteration owns these — concurrent iterators over
+        # one DataLoader are outside the resume contract
+        self._sd_epochs = 0        # completed full iterations
+        self._sd_batch = 0         # batches handed out in the live iteration
+        self._sd_in_epoch = False
+        self._sd_epoch_rng = None  # generator key at iteration start
+        self._sd_token = None      # cursor owner (the live iteration)
+        self._resume = None        # pending load_state_dict payload
 
     def __del__(self):
         if self._pool is not None:
@@ -589,11 +598,129 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
-        mode = ("workers" if self.num_workers and self.num_workers > 0
+        resume, self._resume = self._resume, None
+        resuming = (resume is not None and resume.get("in_epoch")
+                    and int(resume.get("batch", 0)) > 0)
+        mode = ("resume" if resuming
+                else "workers" if self.num_workers and self.num_workers > 0
                 else "buffered" if self.use_buffer_reader else "sync")
-        for batch in self._iter_impl():
-            _obs.inc("dataloader.batches_total", mode=mode)
-            yield batch
+        # cursor bookkeeping: the key snapshot is taken BEFORE the sampler
+        # can split it, so a resume can replay this epoch's shuffle draw
+        self._sd_epoch_rng = self._rng_snapshot()
+        if resuming:
+            self._sd_epochs = int(resume.get("epochs_completed", 0))
+            self._sd_batch = int(resume.get("batch", 0))
+            if resume.get("epoch_rng") is not None:
+                self._sd_epoch_rng = list(resume["epoch_rng"])
+            inner = self._resume_iter(resume)
+        else:
+            if resume is not None:
+                self._sd_epochs = int(resume.get("epochs_completed", 0))
+            self._sd_batch = 0
+            inner = self._iter_impl()
+        self._sd_in_epoch = True
+        # ownership token: an ABANDONED iterator's deferred finally (it
+        # runs at GC time) must not clobber the cursor of a newer live
+        # iteration — the restart path abandons the faulted epoch's
+        # iterator and immediately starts the resumed one
+        token = object()
+        self._sd_token = token
+        finished = False
+        try:
+            for batch in inner:
+                if self._sd_token is token:
+                    self._sd_batch += 1
+                _obs.inc("dataloader.batches_total", mode=mode)
+                yield batch
+            finished = True
+        finally:
+            if self._sd_token is token:
+                self._sd_in_epoch = False
+                if finished:
+                    self._sd_epochs += 1
+                    self._sd_batch = 0
+
+    # -- resumable iteration state (PR 10) ----------------------------------
+    @staticmethod
+    def _rng_snapshot():
+        """Flat uint32 view of the framework generator key (None when the
+        key is not host-readable, e.g. inside a trace)."""
+        try:
+            arr = np.asarray(default_generator.state._data)
+        except Exception:
+            return None
+        return [int(x) for x in arr.ravel().tolist()]
+
+    def state_dict(self):
+        """Resumable iteration position: completed epochs, the batch cursor
+        of the live iteration, and the shuffle-generator key at its start.
+        JSON-serializable; pair with :meth:`load_state_dict` to resume
+        mid-epoch with the exact remaining batches (same shuffle order)."""
+        return {
+            "version": 1,
+            "epochs_completed": int(self._sd_epochs),
+            "batch": int(self._sd_batch) if self._sd_in_epoch else 0,
+            "in_epoch": bool(self._sd_in_epoch),
+            "epoch_rng": (None if self._sd_epoch_rng is None
+                          else list(self._sd_epoch_rng)),
+        }
+
+    def load_state_dict(self, state) -> None:
+        """Schedule a resume: the NEXT ``iter(loader)`` replays the
+        interrupted epoch's shuffle draw from the recorded generator state,
+        skips the already-consumed batches, and yields the remainder —
+        leaving the global generator exactly as it was (rng-neutral, so a
+        caller restoring its own RNG snapshot afterwards stays bitwise
+        reproducible). Map-style datasets skip on indices (no sample is
+        loaded or collated twice); iterable datasets re-consume the skipped
+        prefix (no random access). The resumed epoch runs on the in-process
+        path even when ``num_workers > 0``; worker pools re-engage on the
+        following epoch."""
+        if not isinstance(state, dict) or "version" not in state:
+            raise ValueError("not a DataLoader state_dict")
+        if int(state["version"]) != 1:
+            raise ValueError(
+                f"unsupported DataLoader state_dict version "
+                f"{state['version']!r}")
+        self._resume = dict(state)
+        self._sd_epochs = int(state.get("epochs_completed", 0))
+        self._sd_batch = 0
+        self._sd_in_epoch = False
+
+    def _resume_iter(self, resume):
+        """Rebuild the interrupted iteration (see :meth:`load_state_dict`)."""
+        import jax.numpy as jnp
+
+        skip = int(resume.get("batch", 0))
+        saved = resume.get("epoch_rng")
+        if not self._iterable_mode and self.batch_sampler is not None:
+            if saved is not None:
+                prev = self._rng_snapshot()
+                default_generator.set_state(
+                    jnp.asarray(np.asarray(saved, dtype=np.uint32)))
+                try:
+                    # the epoch's sampler split is replayed eagerly HERE so
+                    # the generator can be restored before anything else
+                    # (prefetch threads included) touches it
+                    batches = list(self.batch_sampler)
+                finally:
+                    if prev is not None:
+                        default_generator.set_state(
+                            jnp.asarray(np.asarray(prev, dtype=np.uint32)))
+            else:
+                batches = list(self.batch_sampler)
+
+            def _gen():
+                for idx_batch in batches[skip:]:
+                    yield self.collate_fn(
+                        [self.dataset[i] for i in idx_batch])
+
+            src = _gen()
+        else:
+            src = itertools.islice(self._iter_batches(), skip, None)
+        if self.use_buffer_reader:
+            return self._thread_prefetch(src)
+        return src
 
     def _iter_impl(self):
         if self.num_workers and self.num_workers > 0:

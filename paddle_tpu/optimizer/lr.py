@@ -44,8 +44,13 @@ class LRScheduler:
         raise NotImplementedError
 
     def state_dict(self):
+        # _bound_opts (weakrefs wiring carried-LR tensors) is runtime
+        # plumbing, not schedule position, and breaks JSON serialization;
+        # OTHER private attrs (MultiplicativeDecay._cur, the accumulated
+        # product) ARE position and must round-trip
         return {k: v for k, v in self.__dict__.items()
-                if isinstance(v, (int, float, bool, str, list))}
+                if k != "_bound_opts"
+                and isinstance(v, (int, float, bool, str, list))}
 
     def set_state_dict(self, state):
         self.__dict__.update(state)

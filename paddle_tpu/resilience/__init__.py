@@ -19,8 +19,16 @@ This package centralizes the three pieces the ROADMAP's
 * :mod:`~paddle_tpu.resilience.faults` — deterministic
   :class:`FaultSchedule` injection (drop/delay/error/kill, scoped by
   site tag, seeded or scripted) threaded through the store client, rpc
-  transport, PS service, and checkpoint writer — a no-op global probe
-  when not installed.
+  transport, PS service, checkpoint writer, serving engine, and the
+  training supervisor — a no-op global probe when not installed;
+* :mod:`~paddle_tpu.resilience.watchdog` — the monotonic-clock
+  :class:`StepWatchdog` (extracted from serving in PR 10) that classifies
+  a hung/zombie compiled call from a thread that cannot be wedged;
+* :mod:`~paddle_tpu.resilience.trainer` — the fault-tolerant training
+  supervisor: full resumable :class:`TrainState` (RNG, optimizer
+  step+moments, LR-schedule position, dataloader cursor) through the
+  verified-checkpoint writer, per-step retry/watchdog/NaN escalation,
+  and restart-from-last-good with a bit-identical loss trajectory.
 
 Everything is observable through :mod:`paddle_tpu.observability`:
 ``resilience.retries_total``, ``resilience.giveups_total``,
@@ -37,6 +45,9 @@ from .breaker import (BreakerOpen, CircuitBreaker, breaker_for,
                       reset_breakers)
 from .faults import (FaultInjected, FaultSchedule, KillPoint, fault_point,
                      install, installed, uninstall)
+from .watchdog import StepWatchdog, WatchdogTimeout
+from .trainer import (FaultTolerance, NonFiniteLossError, TrainAborted,
+                      TrainState, TrainingSupervisor)
 
 __all__ = [
     "RetryPolicy", "DeadlineExceeded", "deadline_scope", "current_deadline",
@@ -45,4 +56,7 @@ __all__ = [
     "BreakerOpen", "CircuitBreaker", "breaker_for", "reset_breakers",
     "FaultInjected", "FaultSchedule", "KillPoint", "fault_point",
     "install", "installed", "uninstall",
+    "StepWatchdog", "WatchdogTimeout",
+    "FaultTolerance", "NonFiniteLossError", "TrainAborted",
+    "TrainState", "TrainingSupervisor",
 ]

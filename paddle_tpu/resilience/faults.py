@@ -52,6 +52,20 @@ Sites threaded through the framework (exact-match tags):
                       error degrades the graceful drain to an immediate
                       stop (stragglers still resolve; the no-stranded-
                       futures invariant outranks graceful finish)
+``train.step``        ``resilience.trainer`` step attempt entry, inside
+                      the armed train watchdog window, before the step
+                      closure runs — ``error`` drives the per-step retry
+                      policy (exhaustion → restore-last-good), ``delay``
+                      past ``PADDLE_TPU_TRAIN_WATCHDOG_S`` a watchdog
+                      trip, ``kill`` a simulated process death (resume
+                      with a fresh supervisor, bit-identically)
+``train.data``        batch fetch from the training iterator, before
+                      ``next()`` — retried on the ``train.data`` policy,
+                      then restore-last-good
+``train.save``        ``TrainState.save`` entry, before the verified
+                      writer runs (compose with ``checkpoint.write`` /
+                      ``checkpoint.commit`` to kill deeper); a killed
+                      save leaves the previous checkpoint loadable
 ====================  =====================================================
 
 Kinds: ``delay`` sleeps; ``error`` raises a fresh instance of the

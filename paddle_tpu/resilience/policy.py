@@ -43,7 +43,35 @@ from .. import observability as _obs
 
 __all__ = ["RetryPolicy", "DeadlineExceeded", "deadline_scope",
            "current_deadline", "get_policy", "register_policy",
-           "reset_policies", "jitter_sleep"]
+           "reset_policies", "jitter_sleep", "env_float", "env_int"]
+
+
+def env_float(name: str) -> Optional[float]:
+    """Float env knob; unset/blank/non-numeric -> None (logged)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        import logging
+        logging.getLogger(__name__).warning(
+            "ignoring non-numeric %s=%r", name, raw)
+        return None
+
+
+def env_int(name: str, default: int) -> int:
+    """Int env knob; unset/blank/non-numeric -> ``default`` (logged)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        import logging
+        logging.getLogger(__name__).warning(
+            "ignoring non-numeric %s=%r", name, raw)
+        return default
 
 
 class DeadlineExceeded(TimeoutError):

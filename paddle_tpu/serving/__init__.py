@@ -24,7 +24,9 @@ package multiplies the missing factor. Three pieces:
 * :mod:`~paddle_tpu.serving.watchdog` — the monotonic-clock step
   watchdog (``PADDLE_TPU_SERVING_WATCHDOG_S``): a hung compiled step is
   classified, counted, and its slots recovered instead of wedging the
-  engine forever.
+  engine forever. (Since PR 10 the implementation lives in
+  :mod:`paddle_tpu.resilience.watchdog` — the training supervisor arms
+  the same guard — and this module re-exports it unchanged.)
 
 Quick start (see README "Serving")::
 

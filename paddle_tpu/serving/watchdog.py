@@ -1,149 +1,13 @@
-"""Step watchdog: a monotonic-clock guard around the compiled decode step.
-
-The engine's ``start()`` loop is single-threaded by design — one compiled
-call, one host sync, per step. That also means one hung device call (a
-wedged transfer, a runaway collective, a relay link gone quiet) wedges the
-WHOLE engine forever, silently: no metric moves, every queued request
-waits unboundedly. The watchdog is the observer that cannot be wedged:
-
-* the step thread ``arm()``s the watchdog immediately before the compiled
-  call and ``disarm()``s after — two lock-guarded scalar writes, nothing
-  else on the hot path;
-* a daemon thread polls the armed window off the hot path (cadence via
-  :func:`resilience.jitter_sleep` — the poll-loop primitive, so a fleet
-  of engines never beats in phase) and, when the window exceeds
-  ``timeout_s``, classifies the step:
-
-  - ``"hung"`` — armed past ``timeout_s``: the step is overdue. One trip
-    per armed window; ``serving.watchdog_trips_total{kind="hung"}``.
-  - ``"zombie"`` — the SAME window still armed past ``2 * timeout_s``
-    after tripping: the call may never return. Logged + counted
-    (``kind="zombie"``) so an operator sees the difference between "slow"
-    and "gone" — an in-process observer cannot preempt a thread blocked
-    inside a compiled call, so past this point recovery is external
-    (restart the process; crash-safe checkpointing and the engine's
-    bounded replay make that survivable).
-
-* ``disarm()`` returns the window's classification (or None). A tripped
-  step that DOES return is aborted by the engine: its outputs are
-  abandoned (functional pool state — nothing was committed), and the
-  affected slots recover through bounded prefill replay. The abort path
-  is therefore exactly the ``serving.step``-fault path, driven
-  deterministically in tests by a ``delay`` fault at the
-  ``serving.watchdog`` site.
+"""Back-compat shim: the step watchdog moved to
+:mod:`paddle_tpu.resilience.watchdog` (PR 10) so the training supervisor
+can arm the same guard around compiled train steps. Serving semantics are
+unchanged — the defaults (``serving.watchdog_trips_total`` metric, the
+"serving watchdog" log prefix) are the serving ones, and this module
+keeps every historical import path working.
 """
 
 from __future__ import annotations
 
-import logging
-import threading
-import time
-from typing import Optional
-
-from .. import observability as _obs
-from ..resilience import policy as _policy
+from ..resilience.watchdog import StepWatchdog, WatchdogTimeout
 
 __all__ = ["StepWatchdog", "WatchdogTimeout"]
-
-_log = logging.getLogger(__name__)
-
-
-class WatchdogTimeout(RuntimeError):
-    """A compiled step exceeded the watchdog budget; its outputs were
-    abandoned. Requests that exhaust ``max_replays`` recovering from this
-    see it as their Future's exception."""
-
-
-class StepWatchdog:
-    """Arm/disarm guard around one in-flight compiled step.
-
-    ``arm()`` opens a window and returns its generation token; ``disarm``
-    closes it and returns the classification the poll thread assigned
-    (``"hung"`` / ``"zombie"``) or None if the step came back in time.
-    The poll thread is started lazily on first arm and is restartable
-    after :meth:`stop` (the engine stops it on ``Engine.stop``).
-    Thread-safe; one window at a time (the engine is single-consumer).
-    """
-
-    def __init__(self, timeout_s: float, name: str = "paddle-tpu-watchdog"):
-        if timeout_s <= 0:
-            raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
-        self.timeout_s = float(timeout_s)
-        self._name = name
-        self._lock = threading.Lock()
-        self._armed_at: Optional[float] = None
-        self._gen = 0
-        self._verdicts = {}          # gen -> "hung" | "zombie"
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        # poll a few times per window; jitter_sleep decorrelates engines
-        self._poll_s = max(0.002, self.timeout_s / 4.0)
-
-    # -- hot path (step thread) ---------------------------------------------
-    def arm(self) -> int:
-        with self._lock:
-            self._gen += 1
-            self._armed_at = time.monotonic()
-            gen = self._gen
-            need_thread = self._thread is None or not self._thread.is_alive()
-        if need_thread:
-            self._start_thread()
-        return gen
-
-    def disarm(self, gen: int) -> Optional[str]:
-        with self._lock:
-            if self._gen == gen:
-                self._armed_at = None
-            return self._verdicts.pop(gen, None)
-
-    # -- lifecycle ----------------------------------------------------------
-    def _start_thread(self) -> None:
-        with self._lock:
-            if self._thread is not None and self._thread.is_alive():
-                return
-            self._stop.clear()
-            self._thread = threading.Thread(
-                target=self._loop, name=self._name, daemon=True)
-            self._thread.start()
-
-    def stop(self) -> None:
-        """Stop the poll thread (idempotent; a later arm() restarts it)."""
-        self._stop.set()
-        t = self._thread
-        if t is not None and t is not threading.current_thread():
-            t.join(timeout=5.0 * self._poll_s + 1.0)
-
-    # -- poll loop (watchdog thread) ----------------------------------------
-    def _loop(self) -> None:
-        while not self._stop.is_set():
-            with self._lock:
-                armed_at, gen = self._armed_at, self._gen
-                verdict = self._verdicts.get(gen)
-            if armed_at is not None:
-                waited = time.monotonic() - armed_at
-                if verdict is None and waited > self.timeout_s:
-                    self._trip(gen, armed_at, "hung", waited)
-                elif verdict == "hung" and waited > 2.0 * self.timeout_s:
-                    self._trip(gen, armed_at, "zombie", waited)
-            _policy.jitter_sleep(self._poll_s)
-
-    def _trip(self, gen: int, armed_at: float, kind: str,
-              waited: float) -> None:
-        with self._lock:
-            # the window may have closed between the unlocked read and now
-            if self._gen != gen or self._armed_at != armed_at:
-                return
-            self._verdicts[gen] = kind
-        _obs.inc("serving.watchdog_trips_total", kind=kind)
-        if kind == "hung":
-            _log.warning(
-                "serving watchdog: compiled step armed %.3fs > budget %.3fs "
-                "— step classified hung; its outputs will be abandoned and "
-                "its slots replayed", waited, self.timeout_s)
-        else:
-            _log.warning(
-                "serving watchdog: compiled step still running after %.3fs "
-                "(> 2x budget %.3fs) — step classified ZOMBIE; in-process "
-                "recovery is impossible if it never returns (restart the "
-                "process; bounded replay makes the restart survivable)",
-                waited, self.timeout_s)

@@ -98,6 +98,42 @@ def test_bench_main_emits_telemetry():
 
 
 # ---------------------------------------------------------------------------
+# training-under-fire counter block (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+def test_train_resilience_detail_is_schema_stable():
+    # the row of record pins the train.* recovery counters; all-zero on a
+    # healthy run IS the claim — a nonzero diff means the measured run
+    # itself retried/skipped/rolled back
+    detail = bench._train_resilience_detail({})
+    assert set(detail) == set(bench.TRAIN_RESILIENCE_FIELDS)
+    assert set(bench.TRAIN_RESILIENCE_FIELDS) == {
+        "retries", "restarts", "skipped_batches", "watchdog_trips"}
+    assert all(v == 0 for v in detail.values())
+
+
+def test_train_resilience_detail_sums_labeled_families():
+    # train.retries_total carries a site label and the watchdog a kind
+    # label — the bench block reports family totals
+    snap = {"train.retries_total": {"site=train.step": 2.0,
+                                    "site=train.data": 1.0},
+            "train.restarts_total": 1.0,
+            "train.watchdog_trips_total": {"kind=hung": 1.0}}
+    detail = bench._train_resilience_detail(snap)
+    assert detail["retries"] == 3
+    assert detail["restarts"] == 1
+    assert detail["watchdog_trips"] == 1
+    assert detail["skipped_batches"] == 0
+
+
+def test_bench_main_emits_train_resilience():
+    import inspect
+    src = inspect.getsource(bench.main)
+    assert "_train_resilience_detail" in src and '"train_resilience"' in src
+    assert "TRAIN_RESILIENCE_FIELDS" in src
+
+
+# ---------------------------------------------------------------------------
 # eager-dispatch bench schema + dispatch fast-path hygiene (ISSUE 2)
 # ---------------------------------------------------------------------------
 

@@ -110,10 +110,38 @@ def test_callbacks_fire_and_early_stopping():
 
 
 def test_model_checkpoint_callback(tmp_path):
+    # PR 10: ModelCheckpoint rides the verified writer — per-epoch
+    # checkpoint DIRECTORIES with a committed CRC manifest and rotating
+    # latest/latest.prev pointers, not bare .pdparams saves
+    from paddle_tpu.core.tensor import Parameter
+
+    Parameter._param_counter = 0  # deterministic optimizer-state keys
     model = _prepared_model()
     ds = _toy_dataset(n=32)
     save_dir = str(tmp_path / "ckpts")
     model.fit(ds, batch_size=16, epochs=2, verbose=0, save_dir=save_dir)
+    for name in ("epoch-0", "epoch-1", "final"):
+        assert os.path.exists(os.path.join(save_dir, name, "manifest.json"))
+    with open(os.path.join(save_dir, "latest")) as f:
+        assert f.read().strip() == "final"
+    # CRC-verified round trip into a fresh model (fresh-process simulation:
+    # same construction order => same state keys)
+    w_before = np.asarray(model.network.fc1.weight._data).copy()
+    Parameter._param_counter = 0
+    fresh = _prepared_model(lr=0.05)
+    fresh.load_verified(os.path.join(save_dir, "final"))
+    np.testing.assert_array_equal(
+        np.asarray(fresh.network.fc1.weight._data), w_before)
+
+
+def test_model_checkpoint_callback_legacy(tmp_path):
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+    model = _prepared_model()
+    ds = _toy_dataset(n=32)
+    save_dir = str(tmp_path / "ckpts")
+    model.fit(ds, batch_size=16, epochs=1, verbose=0,
+              callbacks=[ModelCheckpoint(1, save_dir, legacy=True)])
     assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
     assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
 

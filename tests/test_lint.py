@@ -383,6 +383,31 @@ def test_naked_retry_strict_poll_loop_paths(tmp_path):
         config={"poll_loop_paths": ["watchdog.py"]}) == []
 
 
+def test_naked_retry_strict_outranks_retry_allowed(tmp_path):
+    # ISSUE 10: the watchdog moved INTO paddle_tpu/resilience (which is
+    # retry_allowed). Its poll loops must still ride jitter_sleep — a
+    # module in poll_loop_paths keeps the strict tier even when it is
+    # also under retry_allowed_paths.
+    poll = """\
+        import time
+
+        def loop(flag):
+            while not flag():
+                time.sleep(0.1)
+        """
+    found = _lint_snippet(
+        tmp_path, poll, "naked-retry", filename="watchdog.py",
+        config={"retry_allowed_paths": ["watchdog.py"],
+                "poll_loop_paths": ["watchdog.py"]})
+    assert len(found) == 1 and "jitter_sleep" in found[0].message
+    # the shipped config actually covers the extracted modules
+    from tools.lint.engine import DEFAULT_CONFIG
+    assert "paddle_tpu/resilience/watchdog.py" in \
+        DEFAULT_CONFIG["poll_loop_paths"]
+    assert "paddle_tpu/resilience/trainer.py" in \
+        DEFAULT_CONFIG["poll_loop_paths"]
+
+
 def test_naked_retry_nested_def_does_not_inherit_loop(tmp_path):
     # a function DEFINED inside a loop starts its own context: its sleep
     # is not "in" the enclosing loop

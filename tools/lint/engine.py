@@ -140,9 +140,15 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # mechanics — everything else routes through their policies
     "retry_allowed_paths": ["paddle_tpu/resilience"],
     # naked-retry strict tier: modules where ANY in-loop time.sleep is a
-    # finding (not just try/except loops) — serving-side poll threads
-    # (the step watchdog, drain waits) must use resilience.jitter_sleep
-    "poll_loop_paths": ["paddle_tpu/serving"],
+    # finding (not just try/except loops) — poll threads (the step
+    # watchdog, drain waits, the training supervisor's loops) must use
+    # resilience.jitter_sleep. Strict outranks retry_allowed_paths, so
+    # the extracted watchdog stays strict inside paddle_tpu/resilience.
+    "poll_loop_paths": [
+        "paddle_tpu/serving",
+        "paddle_tpu/resilience/watchdog.py",
+        "paddle_tpu/resilience/trainer.py",
+    ],
     # device-access: the only modules allowed to call jax.devices /
     # jax.device_put directly — the Place taxonomy and the backend-
     # fallback dispatcher (PR 6); everything else routes through them

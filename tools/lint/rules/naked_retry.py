@@ -14,11 +14,15 @@ outside the allowed paths (``retry_allowed_paths`` config, default
 a written reason, per the PR-3 convention.
 
 Modules listed in ``poll_loop_paths`` (ISSUE 8: ``paddle_tpu/serving``
-— the watchdog poll thread and the drain wait loop) get the STRICT
-tier: ANY in-loop ``time.sleep`` is flagged, try/except or not. A
-serving-side thread that sleeps on a fixed cadence beats in phase
-across a fleet of engines; ``resilience.jitter_sleep`` is the only
-sanctioned poll primitive there.
+— the watchdog poll thread and the drain wait loop; ISSUE 10:
+``paddle_tpu/resilience/watchdog.py`` + ``trainer.py``, where the
+extracted watchdog and the training supervisor now live) get the STRICT
+tier: ANY in-loop ``time.sleep`` is flagged, try/except or not — and
+strict OUTRANKS the ``retry_allowed_paths`` exemption, so the watchdog
+stays strict inside the resilience package itself. A poll thread that
+sleeps on a fixed cadence beats in phase across a fleet of
+engines/trainers; ``resilience.jitter_sleep`` is the only sanctioned
+poll primitive there.
 """
 
 from __future__ import annotations
@@ -58,14 +62,18 @@ class NakedRetryRule(Rule):
                    "paddle_tpu/resilience (use RetryPolicy / jitter_sleep)")
 
     def check(self, ctx: FileContext):
-        allowed = ctx.config.get("retry_allowed_paths",
-                                 ["paddle_tpu/resilience"])
-        if any(ctx.path == p or ctx.path.startswith(p + "/")
-               or path_matches(ctx.path, [p]) for p in allowed):
+        def _in(paths):
+            return any(ctx.path == p or ctx.path.startswith(p + "/")
+                       or path_matches(ctx.path, [p]) for p in paths)
+
+        # the strict tier OUTRANKS the retry_allowed exemption: a module in
+        # poll_loop_paths stays strict even inside paddle_tpu/resilience
+        # (ISSUE 10 — the extracted watchdog and the training supervisor
+        # live there, and their poll threads must still ride jitter_sleep)
+        strict = _in(ctx.config.get("poll_loop_paths", []))
+        if not strict and _in(ctx.config.get("retry_allowed_paths",
+                                             ["paddle_tpu/resilience"])):
             return
-        strict = any(ctx.path == p or ctx.path.startswith(p + "/")
-                     or path_matches(ctx.path, [p])
-                     for p in ctx.config.get("poll_loop_paths", []))
         aliases, sleeps = _time_sleep_names(ctx.tree)
         if not aliases and not sleeps:
             return
