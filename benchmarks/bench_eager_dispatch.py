@@ -15,6 +15,12 @@ dispatch per op) three ways over the SAME repeated-signature chain:
 target: >= 3x); ``hit_rate`` comes from the cache's own counters and
 pins that the measurement actually exercised the hot path.
 
+``--captured-step`` (ISSUE 11) adds the whole-step capture leg: the same
+fwd+bwd chain through ``paddle.jit.capture_step`` — ``captured_step_ms``
+per step and ``captured_dispatches_per_step`` (the single compiled
+program call, plus any eager op dispatch that leaked around it during a
+warm step; the expectation pinned in tests is exactly 1).
+
 Prints one JSON line.
 """
 
@@ -33,12 +39,60 @@ N_ITERS = 200          # loop iterations; each runs 2 elementwise ops
 OPS = 2 * N_ITERS      # elementwise ops per forward chain (+ final sum)
 
 # schema of the JSON row, pinned by tests/test_bench_selfdefense.py
+# (captured_* fields are null unless --captured-step ran the leg)
 RESULT_FIELDS = (
     "benchmark", "chain_elementwise_ops",
     "cold_ms", "cached_ms", "speedup_x", "hit_rate",
     "cold_us_per_op", "cached_us_per_op",
     "compiled_fwd_bwd_ms", "device",
+    "captured_step_ms", "captured_dispatches_per_step",
+    "captured_speedup_x",
 )
+
+
+def _captured_leg(paddle, jax, x, chain, reps: int):
+    """Time the chain as ONE captured (donated) program and count what a
+    warm step dispatches: 1 program call + however many eager op
+    dispatches leaked around it (expected: none)."""
+    import time
+
+    from paddle_tpu import observability as obs
+
+    def step(v):
+        loss = chain(v)
+        loss.backward()
+        return loss
+
+    cap = paddle.jit.capture_step(step)
+    cap(x)                       # trace + compile
+    x.clear_grad()
+    if cap.stats["retraces"] == 0:
+        # capture bypassed (PADDLE_TPU_STEP_CAPTURE=off inherited from the
+        # environment, or a live seam): there is no captured leg to
+        # measure — report nulls rather than losing the whole row
+        print(f"bench_eager_dispatch: captured-step leg skipped "
+              f"(bypasses: {cap.stats['bypasses']})", file=sys.stderr)
+        return None, None
+    obs.enable()
+    before = obs.snapshot().get("dispatch.ops_total", 0)
+    cap(x)                       # one warm step under the op-dispatch hook
+    jax.block_until_ready(x.grad._data)
+    eager_ops = int(obs.snapshot().get("dispatch.ops_total", 0) - before)
+    obs.disable()
+    x.clear_grad()
+    t0 = time.perf_counter()
+    for _ in range(reps * 10):
+        cap(x)
+    jax.block_until_ready(x.grad._data)
+    dt = (time.perf_counter() - t0) / (reps * 10)
+    x.clear_grad()
+    if cap.stats["hits"] < reps * 10:
+        # the timed loop didn't actually run warm captured steps
+        # (mid-run bypass): the measurement is not the captured tier
+        print(f"bench_eager_dispatch: captured-step leg invalid "
+              f"({cap.stats}); reporting nulls", file=sys.stderr)
+        return None, None
+    return dt, 1 + eager_ops
 
 
 def main() -> None:
@@ -96,6 +150,11 @@ def main() -> None:
     static_dt = (time.perf_counter() - t0) / (reps * 10)
     x.clear_grad()
 
+    captured_dt = captured_dispatches = None
+    if "--captured-step" in sys.argv:
+        captured_dt, captured_dispatches = _captured_leg(paddle, jax, x,
+                                                         chain, reps)
+
     row = {
         "benchmark": "eager_dispatch",
         "chain_elementwise_ops": OPS,
@@ -107,6 +166,11 @@ def main() -> None:
         "cached_us_per_op": round(1e6 * cached_dt / OPS, 1),
         "compiled_fwd_bwd_ms": round(static_dt * 1e3, 3),
         "device": str(jax.devices()[0]),
+        "captured_step_ms": None if captured_dt is None
+        else round(captured_dt * 1e3, 3),
+        "captured_dispatches_per_step": captured_dispatches,
+        "captured_speedup_x": None if captured_dt is None
+        else round(cold_dt / captured_dt, 2),
     }
     assert set(row) == set(RESULT_FIELDS)
     print(json.dumps(row))

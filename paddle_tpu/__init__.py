@@ -18,6 +18,35 @@ design.
 from . import flags as _flags_mod
 from .flags import get_flags, set_flags, define_flag  # noqa: F401
 
+
+def _wire_compile_cache() -> None:
+    """ROADMAP 3b / ISSUE 11 satellite: point JAX's persistent compilation
+    cache at ``PADDLE_TPU_COMPILE_CACHE_DIR`` so fleet rollouts and
+    crash-restarts warm-start — the 1.59B bench program costs ~22 s to
+    compile cold; a warm process deserializes it from disk in seconds
+    (``bench.py`` pins cold vs warm). Unset ⇒ untouched (tests wire their
+    own cache dir). Thresholds drop to zero so even small per-op/step
+    programs round-trip — the cache is content-addressed, so sharing a
+    directory across configs is safe."""
+    import os as _os
+
+    d = _os.environ.get("PADDLE_TPU_COMPILE_CACHE_DIR")
+    if not d:
+        return
+    import jax as _jax
+
+    _os.makedirs(_os.path.expanduser(d), exist_ok=True)
+    for key, val in (("jax_compilation_cache_dir", _os.path.expanduser(d)),
+                     ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            _jax.config.update(key, val)
+        except Exception:  # older jax without the knob: best effort
+            pass
+
+
+_wire_compile_cache()
+
 from .device import (  # noqa: F401
     Place, CPUPlace, TPUPlace, CUDAPlace, CustomPlace,
     XPUPlace, MLUPlace, IPUPlace, CUDAPinnedPlace,

@@ -478,7 +478,20 @@ def _load_into(flat: Dict[str, Tensor], path: str, verify: bool) -> None:
 
     for k, tgt in flat.items():
         src = arrays[k]
-        if isinstance(src, jax.Array) and _target_sharding(tgt) is not None \
+        if not getattr(tgt._data, "_committed", True):
+            # the destination is UNCOMMITTED (plain single-host state):
+            # restore it uncommitted too — via host, because a jax.Array
+            # read back by orbax under an explicit sharding is committed
+            # and stays committed through jnp.asarray. device_put (or
+            # adopting the committed source) would pin the tensor to one
+            # device, and a later whole-program capture (to_static /
+            # step_capture functionalization carries EVERY registry
+            # tensor) commits its entire state carry to that device's
+            # placement — which then conflicts with mesh-committed arrays
+            # sharing a jit. "Reshard to the destination's placement"
+            # includes preserving its non-placement.
+            arr = jax.numpy.asarray(np.asarray(src).astype(tgt._data.dtype))
+        elif isinstance(src, jax.Array) and _target_sharding(tgt) is not None \
                 and src.sharding == tgt._data.sharding:
             arr = src.astype(tgt._data.dtype) \
                 if src.dtype != tgt._data.dtype else src

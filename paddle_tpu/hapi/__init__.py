@@ -90,6 +90,40 @@ class Model:
         metrics = self._update_metrics(outputs, labels)
         return [_to_float(loss)], metrics
 
+    # -- whole-step static capture (ISSUE 11) ------------------------------
+    def _make_captured_step(self):
+        """A :class:`~paddle_tpu.core.step_capture.CapturedStep` for the
+        fit loop — forward, backward and the optimizer update compiled
+        into ONE donated-buffer XLA program (``PADDLE_TPU_STEP_CAPTURE``;
+        ``off`` returns None and the loop stays on eager
+        ``train_batch``). Outputs ride out of the program so metrics
+        update on concrete arrays after each call."""
+        from ..core import step_capture as _cap
+
+        if self._optimizer is None or _cap.mode() == "off":
+            return None
+
+        def fwd_bwd(inputs, labels):
+            outputs = self._forward(inputs)
+            loss = self._compute_loss(outputs, labels)
+            loss.backward()
+            return loss, outputs
+
+        def update():
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+
+        return _cap.CapturedStep(fwd_bwd, update_fn=update, label="hapi")
+
+    def _train_batch_captured(self, cap, inputs, labels=None):
+        """``train_batch`` over the captured program: one compiled
+        dispatch per step instead of one per op (bypasses inside the
+        wrapper keep eager semantics, so callers never branch)."""
+        self.network.train()
+        loss, outputs = cap(inputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return [_to_float(loss)], metrics
+
     def eval_batch(self, inputs, labels=None):
         import paddle_tpu as paddle
 
@@ -169,6 +203,7 @@ class Model:
 
         history: Dict[str, List[Any]] = {"loss": []}
         logs: Dict[str, Any] = {}
+        captured = self._make_captured_step()
         # on_train_end runs even when training (or a sibling callback's
         # on_train_begin) raises: callbacks that hold resources or
         # process-global state (StepTelemetry's JSONL handle + metrics
@@ -183,7 +218,11 @@ class Model:
                 for step, batch in enumerate(loader):
                     cbks.on_train_batch_begin(step)
                     ins, lbls = self._split_batch(batch)
-                    losses, _ = self.train_batch(ins, lbls)
+                    if captured is not None:
+                        losses, _ = self._train_batch_captured(
+                            captured, ins, lbls)
+                    else:
+                        losses, _ = self.train_batch(ins, lbls)
                     logs = {"loss": losses[0]}
                     self._metric_logs(logs)
                     cbks.on_train_batch_end(step, logs)
@@ -240,17 +279,45 @@ class Model:
         history: Dict[str, List[Any]] = {"loss": []}
         last_logs: Dict[str, Any] = {}
 
-        def step_fn(batch):
-            ins, lbls = self._split_batch(batch)
-            losses, _ = self.train_batch(ins, lbls, update=False)
-            return losses[0]
-
         def update_fn():
             self._optimizer.step()
             self._optimizer.clear_grad()
 
         def clear_fn():
             self._optimizer.clear_grad()
+
+        from ..core import step_capture as _cap
+        if _cap.mode() != "off" and not self._metrics:
+            # ISSUE 11: the whole supervised step — fwd, bwd, NaN-gated
+            # optimizer update — rides ONE donated compiled program. The
+            # gate replaces train_batch(update=False)'s host-side split:
+            # a non-finite loss withholds the update in-program, so a
+            # skipped batch still leaves the parameters bitwise untouched.
+            # (Metrics need eager access to the step's outputs, so a
+            # metric-configured fit keeps the eager split step.)
+            def fwd_bwd(batch):
+                ins, lbls = self._split_batch(batch)
+                self.network.train()
+                outputs = self._forward(ins)
+                loss = self._compute_loss(outputs, lbls)
+                loss.backward()
+                return loss
+
+            step_fn = _cap.CapturedStep(fwd_bwd, update_fn=update_fn,
+                                        clear_fn=clear_fn, nan_gate=True,
+                                        label="hapi")
+            run_update_fn = None
+        else:
+            def step_fn(batch):
+                ins, lbls = self._split_batch(batch)
+                losses, _ = self.train_batch(ins, lbls, update=False)
+                return losses[0]
+
+            # metrics accumulate INSIDE this step: the supervisor must not
+            # speculatively trace it (a failed trace re-runs eagerly and
+            # would double-count the first batch's metric update)
+            step_fn.__step_capture__ = False
+            run_update_fn = update_fn
 
         def on_epoch_begin(epoch):
             cbks.on_epoch_begin(epoch)
@@ -287,7 +354,7 @@ class Model:
         try:
             cbks.on_train_begin()
             report = sup.run(
-                step_fn, loader, epochs=epochs, update_fn=update_fn,
+                step_fn, loader, epochs=epochs, update_fn=run_update_fn,
                 clear_fn=clear_fn, on_epoch_begin=on_epoch_begin,
                 on_epoch_end=on_epoch_end, on_batch_begin=on_batch_begin,
                 on_batch_end=on_batch_end,

@@ -9,10 +9,19 @@ body records which state locations it mutates, and those become jit outputs
 that are rebound after each call. The result is ONE fused XLA program per
 train step with buffer donation on the state (in-place optimizer semantics),
 which is where TPU performance lives.
+
+``capture_step`` (ISSUE 11) is the train-step-shaped surface over the same
+machinery: forward + backward + optimizer update captured as one donated
+program behind ``PADDLE_TPU_STEP_CAPTURE=auto|off``, with structural-
+signature + flags-epoch re-trace keying, NaN-gated in-program updates, and
+``train.capture_*`` observability — what ``hapi.Model.fit`` and the PR 10
+``TrainingSupervisor`` ride (``core/step_capture.py``).
 """
 
 from .to_static import (StaticFunction, TraceBreakError, to_static,  # noqa: F401
                         not_to_static, ignore_module)
+from ..core.step_capture import (CapturedStep, HostStateWriteError,  # noqa: F401
+                                 capture_step)
 from .save_load import save, load, TranslatedLayer  # noqa: F401
 
 

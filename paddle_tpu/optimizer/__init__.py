@@ -214,9 +214,27 @@ class Optimizer:
         return float(self._learning_rate)
 
     def _sync_lr_tensor(self) -> None:
-        if self._lr_t is not None:
-            self._lr_t._set_data(
-                jnp.asarray(self._learning_rate.last_lr, jnp.float32))
+        if self._lr_t is None:
+            return
+        from ..core.tracing import trace_state
+        if trace_state() is not None:
+            # scheduler.step() inside a captured/traced step: the host-
+            # computed LR would constant-fold into the compiled program and
+            # silently serve the trace-time value forever (inside a trace
+            # even jnp.asarray of a python float is a constant-derived
+            # tracer, so the step-capture concrete-write walk cannot see
+            # it). Fail loud and uniform instead — the LR VALUE already
+            # rides the program as carried state; the schedule's position
+            # advance belongs between steps, on the host.
+            from ..core.step_capture import HostStateWriteError
+            raise HostStateWriteError(
+                "scheduler.step() ran inside a captured/traced train step: "
+                "the new LR would bake into the compiled program as a "
+                "constant. Call scheduler.step() outside the captured step "
+                "(its value reaches the program via the carried opt_lr "
+                "state), or set PADDLE_TPU_STEP_CAPTURE=off")
+        self._lr_t._set_data(
+            jnp.asarray(self._learning_rate.last_lr, jnp.float32))
 
     @property
     def _param_groups(self):

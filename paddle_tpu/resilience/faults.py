@@ -172,6 +172,13 @@ class FaultSchedule:
         with self._lock:
             return self._counts.get(site, 0)
 
+    def sites(self) -> frozenset:
+        """Sites this schedule has specs for (bypass probes — e.g. step
+        capture stays eager while ``dispatch.*`` faults are scripted, so
+        per-op injections keep firing per op instead of once at trace)."""
+        with self._lock:
+            return frozenset(self._specs)
+
     def check(self, site: str) -> None:
         """One pass through ``site``: bump the counter, fire at most one
         matching spec (first match wins, in authoring order)."""

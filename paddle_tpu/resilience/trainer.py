@@ -371,6 +371,8 @@ class TrainingSupervisor:
         cfg = self.config
         if data is None and steps_per_epoch is None:
             raise ValueError("data=None requires steps_per_epoch")
+        step_fn, update_fn = self._route_step_capture(step_fn, update_fn,
+                                                      data)
         report = TrainReport()
         self._global_step = 0
         self._epoch = 0
@@ -447,6 +449,38 @@ class TrainingSupervisor:
         report.skipped_batches = self._skipped
         report.last_checkpoint = self._last_save
         return report
+
+    def _route_step_capture(self, step_fn, update_fn, data):
+        """ISSUE 11: run the step over whole-step static capture
+        (``PADDLE_TPU_STEP_CAPTURE=auto``, the default) — forward +
+        backward compiled as ONE donated-buffer XLA program per
+        signature, with the eager tier as the ``off`` debug escape.
+
+        A caller-supplied :class:`~paddle_tpu.core.step_capture.
+        CapturedStep` (what ``hapi.Model.fit`` builds: the optimizer
+        update folded in, NaN-gated in-program) is used as-is; a plain
+        closure is wrapped so its fwd+bwd compiles while ``update_fn``
+        stays an eager per-step call (an opaque update may legally do
+        per-step host work — ``scheduler.step()`` — that must never bake
+        into a replayed program). ``data=None`` (steps_per_epoch mode)
+        never wraps: a step that sources its own batches would consume
+        one during a failed speculative trace."""
+        from ..core.step_capture import CapturedStep, mode as _cap_mode
+        if isinstance(step_fn, CapturedStep):
+            if step_fn.applies_update and update_fn is not None:
+                raise ValueError(
+                    "the captured step already folds the optimizer update "
+                    "in-program; do not pass update_fn as well")
+            return step_fn, update_fn
+        if _cap_mode() == "off" or data is None:
+            return step_fn, update_fn
+        if getattr(step_fn, "__step_capture__", True) is False:
+            # opt-out marker: a closure with per-step host effects beyond
+            # tensors (hapi's metric-updating split step) must not even be
+            # speculatively traced — a failed trace re-runs the step
+            # eagerly, which would double-apply non-tensor side effects
+            return step_fn, update_fn
+        return CapturedStep(step_fn, label="train"), update_fn
 
     def _warn_unpositioned_data(self, data, py) -> None:
         """A restore repositions ``self.state.loader``; when ``data`` is a

@@ -22,6 +22,15 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
 # eager-dispatch benchmark.
 os.environ.setdefault("PADDLE_TPU_EAGER_CACHE", "0")
 
+# Whole-step static capture (ISSUE 11) stays off suite-wide for the same
+# wall-clock reason (every supervised/hapi test would compile a whole-step
+# program it runs a handful of times) AND because the eager tier's bitwise
+# pins are eager-tier claims: a captured step is bitwise-deterministic
+# within its own tier but differs from per-op eager at FMA/ulp scale (XLA
+# contracts a*x+b*y inside fused kernels). test_step_capture.py opts in
+# per-test and pins the captured tier's own invariants.
+os.environ.setdefault("PADDLE_TPU_STEP_CAPTURE", "off")
+
 import jax  # noqa: E402
 
 # The on-chip smoke tier (`PADDLE_TPU_TIER=1 pytest -m tpu`) must run

@@ -134,6 +134,101 @@ def test_bench_main_emits_train_resilience():
 
 
 # ---------------------------------------------------------------------------
+# whole-step capture block (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_step_capture_detail_is_schema_stable():
+    # the row of record pins the train.capture_* counters; hits > 0 with
+    # zero bypasses on a healthy run IS the claim — all-bypass means the
+    # measured run was the eager debug tier, not the compiled step
+    detail = bench._step_capture_detail({}, "auto")
+    assert set(detail) == set(bench.STEP_CAPTURE_FIELDS)
+    assert set(bench.STEP_CAPTURE_FIELDS) == {
+        "mode", "hits", "retraces", "bypasses", "donated_bytes"}
+    assert detail["mode"] == "auto"
+    assert detail["hits"] == 0 and detail["donated_bytes"] == 0
+
+
+def test_step_capture_detail_sums_labeled_bypasses():
+    snap = {"train.capture_hits_total": 20.0,
+            "train.capture_retraces_total": 1.0,
+            "train.capture_bypasses_total": {"reason=capture_seam": 2.0,
+                                             "reason=untraceable": 1.0},
+            "train.capture_donated_bytes": 7383052.0}
+    detail = bench._step_capture_detail(snap, "auto")
+    assert detail["hits"] == 20
+    assert detail["retraces"] == 1
+    assert detail["bypasses"] == 3
+    assert detail["donated_bytes"] == 7383052
+
+
+def test_all_bypass_run_is_suspect():
+    cap = {"mode": "auto", "hits": 0, "retraces": 0, "bypasses": 6,
+           "donated_bytes": 0}
+    reasons = bench._capture_suspect_reasons(cap)
+    assert reasons and "bypassed" in reasons[0]
+
+
+def test_capture_off_run_is_suspect_and_healthy_is_clean():
+    # mode=off means the number of record measured the eager debug tier —
+    # e.g. the test suite's PADDLE_TPU_STEP_CAPTURE=off leaking into the
+    # bench environment — which must read as suspect, not silently stand
+    reasons = bench._capture_suspect_reasons(
+        {"mode": "off", "hits": 0, "retraces": 0, "bypasses": 0,
+         "donated_bytes": 0})
+    assert reasons and "eager debug tier" in reasons[0]
+    assert bench._capture_suspect_reasons(
+        {"mode": "auto", "hits": 5, "retraces": 1, "bypasses": 0,
+         "donated_bytes": 123}) == []
+
+
+def test_bench_main_emits_step_capture_and_warm_compile():
+    # main() must route the train step over capture_step, report the
+    # step-capture counter block, and pin cold vs warm compile seconds
+    # (the persistent-compilation-cache win of record)
+    import inspect
+    src = inspect.getsource(bench.main)
+    assert "capture_step" in src
+    assert "_step_capture_detail" in src and '"step_capture"' in src
+    assert "_capture_suspect_reasons" in src
+    assert '"compile_warm_s"' in src and '"compile_s"' in src
+    assert "PADDLE_TPU_COMPILE_CACHE_DIR" in src
+    assert '"step_ms_p50"' in src  # the structural perf pin stays
+
+
+def test_compile_cache_is_wired_at_init():
+    # PADDLE_TPU_COMPILE_CACHE_DIR reaches jax's persistent compilation
+    # cache at import (ROADMAP 3b) — pinned structurally
+    import inspect
+
+    import paddle_tpu
+    src = inspect.getsource(paddle_tpu._wire_compile_cache)
+    assert "PADDLE_TPU_COMPILE_CACHE_DIR" in src
+    assert "jax_compilation_cache_dir" in src
+
+
+def test_cross_host_sync_roots_cover_captured_step():
+    # the captured-step entry joins the dispatch fast-path reachability
+    # roots: a .item()/.numpy() anywhere a captured call can reach is a
+    # per-STEP stall now, flagged by the same whole-program rule
+    import sys
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint.engine import DEFAULT_CONFIG
+    assert "paddle_tpu/core/step_capture.py::__call__" in \
+        DEFAULT_CONFIG["fast_path_roots"]
+
+
+def test_eager_dispatch_bench_pins_captured_leg():
+    mod = _load_bench_eager_dispatch()
+    assert {"captured_step_ms", "captured_dispatches_per_step",
+            "captured_speedup_x"} <= set(mod.RESULT_FIELDS)
+    import inspect
+    src = inspect.getsource(mod.main)
+    assert "--captured-step" in src and "_captured_leg" in src
+
+
+# ---------------------------------------------------------------------------
 # eager-dispatch bench schema + dispatch fast-path hygiene (ISSUE 2)
 # ---------------------------------------------------------------------------
 

@@ -162,6 +162,11 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     "fast_path_roots": [
         "paddle_tpu/core/tensor.py::apply",
         "paddle_tpu/core/tensor.py::_apply_impl",
+        # ISSUE 11: the captured-step entry — a host sync reachable from
+        # here stalls every TRAIN STEP of the compiled fast path (the
+        # eager-tier loss read lives behind the bypass seam and is
+        # baselined as the debug semantics)
+        "paddle_tpu/core/step_capture.py::__call__",
     ],
     # import-layering: the declared layer DAG, base layers first; a module
     # may (module-scope) import same-or-lower layers only. Matching is by
