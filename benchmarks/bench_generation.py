@@ -48,8 +48,8 @@ SERVING_RESULT_FIELDS = (
     "page_size", "prompt", "tokens", "single_stream_tokens_per_sec",
     "serving", "resilience", "speedup_vs_single_stream", "device")
 SERVING_ROW_FIELDS = (
-    "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "scan_greedy_parity",
-    "match_frac", "batch_utilization")
+    "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "queue_wait_ms",
+    "scan_greedy_parity", "match_frac", "batch_utilization")
 # the "serving under fire" counters (ISSUE 8): a healthy offline drain
 # reports zeros, which is exactly the claim worth pinning — overload and
 # recovery are VISIBLE series, so a nonzero here in a bench diff means the
@@ -259,6 +259,13 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         return (snap.get("serving.steps_total", 0) or 0,
                 snap.get("serving.tokens_total", 0) or 0)
 
+    def queue_wait_stats():
+        # the SLO-bucketed histogram (ISSUE 12) scraped by the front door;
+        # the per-bs row reports the mean over THIS drain's admissions
+        h = obs.default_registry().get("serving.queue_wait_seconds")
+        st = h.stats() if h is not None else {"sum": 0.0, "count": 0}
+        return float(st["sum"]), int(st["count"])
+
     bss = sorted({int(b) for b in args.serving_batches.split(",") if b})
     max_bs = bss[-1]
     page_size = min(args.page_size, M)
@@ -324,10 +331,12 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
 
         drain()                        # warm pass: everything compiled
         s0, tk0 = serving_counters()
+        qw0 = queue_wait_stats()
         t0 = time.perf_counter()
         results = drain()
         elapsed = time.perf_counter() - t0
         s1, tk1 = serving_counters()
+        qw1 = queue_wait_stats()
 
         fracs = [sum(a == b for a, b in zip(r.tokens, refs[i])) / n_new
                  for i, r in enumerate(results)]
@@ -347,6 +356,8 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
                 [r.ttft_s for r in results])), 2),
             "tpot_ms": round(1e3 * float(np.mean(
                 [r.tpot_s for r in results])), 2),
+            "queue_wait_ms": round(
+                1e3 * (qw1[0] - qw0[0]) / max(1, qw1[1] - qw0[1]), 3),
             "scan_greedy_parity": parity,
             "match_frac": round(min(fracs), 3),
             "batch_utilization": round(util, 3),

@@ -105,6 +105,9 @@ from . import vision  # noqa: F401,E402
 from . import models  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import observability  # noqa: F401,E402
+# PADDLE_TPU_TRACE=on at import: the per-op trace hook could not install
+# while the core was still importing — re-sync now that it exists
+observability.trace._sync_op_hook()
 from . import resilience  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import static  # noqa: F401,E402

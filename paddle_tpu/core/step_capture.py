@@ -80,6 +80,7 @@ import numpy as np
 
 from .. import flags as _flags
 from .. import observability as _obs
+from ..observability import trace as _trace
 from ..resilience import faults as _faults
 from . import dispatch_cache as _dcache
 from . import lazy as _lazy
@@ -309,7 +310,15 @@ class CapturedStep:
         else:
             self._programs.move_to_end(key)
         try:
-            out = sf(*args, **kwargs)
+            # span-discipline: this __call__ is a fast_path_roots entry, so
+            # even the disabled-mode span probe stays behind the explicit
+            # enabled() guard (the _op_metrics_hook discipline)
+            if _trace.enabled():
+                with _trace.span("train.captured_step", label=self._label,
+                                 fresh=fresh):
+                    out = sf(*args, **kwargs)
+            else:
+                out = sf(*args, **kwargs)
         except HostStateWriteError:
             raise  # deliberate, loud: never demote to a silently-stale tier
         except Exception as e:

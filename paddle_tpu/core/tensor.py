@@ -451,6 +451,11 @@ _op_profile_hook: Optional[Callable[[str, float, float], None]] = None
 # pays only the is-None probes below).
 _op_metrics_hook: Optional[Callable[[str, float, float], None]] = None
 
+# Set by paddle_tpu.observability.trace while PADDLE_TPU_TRACE=on; same
+# signature and zero-overhead contract — per-op events land in the trace
+# buffer so a Chrome export shows where each eager step's time went.
+_op_trace_hook: Optional[Callable[[str, float, float], None]] = None
+
 # Set by paddle_tpu.static while static-graph mode is capturing; called as
 # hook(op_name, pure_fn, tensor_inputs, out_tensors) after each dispatch so
 # the Program can record a replayable op node. None ⇒ zero overhead.
@@ -492,7 +497,9 @@ def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
     """
     prof_hook = _op_profile_hook
     metrics_hook = _op_metrics_hook
-    if prof_hook is not None or metrics_hook is not None:
+    trace_hook = _op_trace_hook
+    if prof_hook is not None or metrics_hook is not None \
+            or trace_hook is not None:
         _t0 = _time.perf_counter()
         try:
             return _apply_impl(op_name, fn, *tensor_inputs,
@@ -504,6 +511,8 @@ def apply(op_name: str, fn: Callable, *tensor_inputs: Tensor,
                 prof_hook(op_name, _t0, _t1)
             if metrics_hook is not None:
                 metrics_hook(op_name, _t0, _t1)
+            if trace_hook is not None:
+                trace_hook(op_name, _t0, _t1)
     return _apply_impl(op_name, fn, *tensor_inputs,
                        differentiable=differentiable, amp=amp, **static_kwargs)
 

@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ..observability import trace as _trace
 from . import callbacks as callbacks_mod
 from .callbacks import (Callback, CallbackList, ProgBarLogger,
                         ModelCheckpoint, VisualDL)
@@ -210,32 +211,36 @@ class Model:
         # enable) must get their teardown hook on every exit path —
         # teardown hooks are expected to tolerate a begin that never ran
         try:
-            cbks.on_train_begin()
-            for epoch in range(epochs):
-                cbks.on_epoch_begin(epoch)
-                for m in self._metrics:
-                    m.reset()
-                for step, batch in enumerate(loader):
-                    cbks.on_train_batch_begin(step)
-                    ins, lbls = self._split_batch(batch)
-                    if captured is not None:
-                        losses, _ = self._train_batch_captured(
-                            captured, ins, lbls)
-                    else:
-                        losses, _ = self.train_batch(ins, lbls)
-                    logs = {"loss": losses[0]}
-                    self._metric_logs(logs)
-                    cbks.on_train_batch_end(step, logs)
+            with _trace.span("hapi.fit", epochs=epochs):
+                cbks.on_train_begin()
+                for epoch in range(epochs):
+                    cbks.on_epoch_begin(epoch)
+                    for m in self._metrics:
+                        m.reset()
+                    for step, batch in enumerate(loader):
+                        cbks.on_train_batch_begin(step)
+                        ins, lbls = self._split_batch(batch)
+                        with _trace.span("hapi.train_batch", step=step,
+                                         epoch=epoch):
+                            if captured is not None:
+                                losses, _ = self._train_batch_captured(
+                                    captured, ins, lbls)
+                            else:
+                                losses, _ = self.train_batch(ins, lbls)
+                        logs = {"loss": losses[0]}
+                        self._metric_logs(logs)
+                        cbks.on_train_batch_end(step, logs)
+                        if self.stop_training:
+                            break
+                    history["loss"].append(logs.get("loss"))
+                    cbks.on_epoch_end(epoch, logs)
+                    if eval_loader is not None \
+                            and (epoch + 1) % eval_freq == 0:
+                        eval_logs = self._run_eval(eval_loader, cbks)
+                        for k, v in eval_logs.items():
+                            history.setdefault("eval_" + k, []).append(v)
                     if self.stop_training:
                         break
-                history["loss"].append(logs.get("loss"))
-                cbks.on_epoch_end(epoch, logs)
-                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                    eval_logs = self._run_eval(eval_loader, cbks)
-                    for k, v in eval_logs.items():
-                        history.setdefault("eval_" + k, []).append(v)
-                if self.stop_training:
-                    break
         except BaseException:
             # teardown on the failure path, but never let a teardown error
             # MASK the real training exception; callbacks can see
@@ -377,6 +382,10 @@ class Model:
         return batch, None
 
     def _run_eval(self, loader, cbks: CallbackList) -> Dict[str, Any]:
+        with _trace.span("hapi.eval"):
+            return self._run_eval_traced(loader, cbks)
+
+    def _run_eval_traced(self, loader, cbks: CallbackList) -> Dict[str, Any]:
         cbks.on_eval_begin()
         for m in self._metrics:
             m.reset()

@@ -27,6 +27,12 @@ suffixed ``_seconds`` (SI base units), gauges plain nouns — e.g.
 ``dispatch.ops_total``, ``ps.rpc_retries_total``,
 ``dataloader.wait_seconds``, ``pipeline.bubble_fraction``.
 
+Since ISSUE 12 the package also owns the TRACING surface: ``trace``
+(span trees, the Chrome trace-event exporter, and the always-on crash
+flight recorder — see :mod:`paddle_tpu.observability.trace`) and ``http``
+(the ``/metrics`` + ``/healthz`` + ``/debug`` scrape endpoint behind
+``PADDLE_TPU_OBS_HTTP_PORT`` — :mod:`paddle_tpu.observability.http`).
+
 Zero-overhead contract: when disabled (the default), the op-dispatch seam
 carries NO observability work — ``core.tensor._op_metrics_hook`` is
 ``None`` and ``apply()`` only performs the same is-None probe it already
@@ -43,6 +49,8 @@ from typing import Any, Dict, Optional, Sequence
 
 from .registry import (Counter, Gauge, Histogram, LogThrottle, Registry,
                        ScopedTimer, DEFAULT_LATENCY_BUCKETS)
+from . import trace  # noqa: F401  (ISSUE 12: spans + flight recorder;
+#                      imported BEFORE export, which shares its envelope)
 from .export import (StepTelemetryWriter, parse_prometheus_text,
                      prometheus_text as _prom_text, read_jsonl)
 
@@ -55,6 +63,7 @@ __all__ = [
     "inc", "set_gauge", "observe", "scoped_timer",
     "snapshot", "reset", "prometheus_text", "parse_prometheus_text",
     "read_jsonl",
+    "trace",
 ]
 
 _REGISTRY = Registry()

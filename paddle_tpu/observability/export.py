@@ -7,10 +7,16 @@ Two consumers, two formats:
   ``_total``-as-written names with dots mapped to underscores, cumulative
   ``_bucket{le=...}`` histogram lines). ``parse_prometheus_text`` is the
   inverse used by tests to prove the round trip.
-* ``StepTelemetryWriter`` — the push/stream surface: one JSON object per
+* ``StepTelemetryWriter`` — the push/stream surface: one JSON record per
   training step with counter DELTAS since the previous step (plus absolute
-  gauges), the shape ``bench.py`` and the hapi ``StepTelemetry`` callback
-  consume.
+  gauges), the stream the hapi ``StepTelemetry`` callback writes. Since
+  ISSUE 12 each record is the shared trace event envelope
+  (``trace.make_event``: ``ts``/``kind``/``name``/``attrs`` — kind
+  ``"step"``, the step number + counters + gauges inside ``attrs``) and is
+  mirrored into the flight recorder, so a crash dump's tail carries the
+  last steps' telemetry next to the fault events. ``bench.py``'s
+  ``detail.telemetry`` block reads the registry snapshot directly and is
+  byte-identical to before.
 """
 
 from __future__ import annotations
@@ -18,6 +24,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, IO, List, Optional, Union
 
+from . import trace as _trace
 from .registry import Counter, Gauge, Histogram, Registry
 
 __all__ = ["prometheus_text", "parse_prometheus_text",
@@ -135,18 +142,22 @@ def _flat_gauges(registry: Registry) -> Dict[str, float]:
 
 
 class StepTelemetryWriter:
-    """JSONL sink: one record per training step.
+    """JSONL sink: one envelope event per training step.
 
-    Record shape::
+    Record shape (the ISSUE 12 trace envelope)::
 
-        {"step": N, "counters": {name: delta_since_last_record},
-         "gauges": {name: value}, ...extra}
+        {"ts": perf_counter_s, "kind": "step", "name": "telemetry",
+         "attrs": {"step": N,
+                   "counters": {name: delta_since_last_record},
+                   "gauges": {name: value}, ...extra}}
 
     Counter deltas (not absolutes) are recorded so a consumer can plot
     per-step rates without diffing, and so concatenated runs don't need a
     monotonic epoch. The first record's deltas are measured from writer
     construction (``baseline="now"``, default) or from zero
-    (``baseline="zero"``).
+    (``baseline="zero"``). Every record is also appended to the flight
+    recorder ring, so a post-mortem dump ends with the last steps'
+    telemetry.
     """
 
     def __init__(self, path_or_file: Union[str, IO[str]],
@@ -170,11 +181,13 @@ class StepTelemetryWriter:
                   for k, v in cur.items()
                   if v != self._prev.get(k, 0.0)}
         self._prev = cur
-        rec: Dict[str, Any] = {"step": int(step), "counters": deltas,
-                               "gauges": _flat_gauges(self._registry)}
-        rec.update(extra)
+        attrs: Dict[str, Any] = {"step": int(step), "counters": deltas,
+                                 "gauges": _flat_gauges(self._registry)}
+        attrs.update(extra)
+        rec = _trace.make_event("step", "telemetry", attrs=attrs)
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
+        _trace.flight_recorder().record(rec)
         return rec
 
     def close(self) -> None:

@@ -82,6 +82,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple, Type
 
 from .. import observability as _obs
+from ..observability import trace as _trace
 
 __all__ = ["FaultInjected", "KillPoint", "FaultSchedule", "fault_point",
            "install", "uninstall", "installed"]
@@ -204,6 +205,9 @@ class FaultSchedule:
         if hit is None:
             return
         _obs.inc("resilience.injected_faults_total", site=site, kind=hit.kind)
+        # the flight recorder's post-mortem tail names the fault site: a
+        # killed/aborted run's dump ends at the seam that took it down
+        _trace.record("fault", site=site, injected=hit.kind, call=n)
         if hit.kind == "delay":
             time.sleep(hit.seconds)
             return

@@ -60,6 +60,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import http as _obs_http
+from ..observability import trace as _trace
 from . import faults as _faults
 from .policy import env_float, env_int, get_policy
 from .watchdog import StepWatchdog, WatchdogTimeout
@@ -79,7 +81,11 @@ _PYVALS_KEY = "train_pyvals"
 class TrainAborted(RuntimeError):
     """Training could not continue: the restart budget is exhausted, or an
     unrecoverable step happened with no verified TrainState to roll back
-    to. ``__cause__`` carries the final underlying error."""
+    to. ``__cause__`` carries the final underlying error;
+    ``flight_dump`` the path of the flight-recorder post-mortem written
+    at abort (None when the dump itself failed)."""
+
+    flight_dump: Optional[str] = None
 
 
 class NonFiniteLossError(RuntimeError):
@@ -183,9 +189,10 @@ class TrainState:
         leaves the previous checkpoint loadable."""
         _faults.fault_point("train.save")
         from ..distributed import checkpoint as _ckpt
-        tree = self._tensor_tree()
-        tree[_PYVALS_KEY] = json.dumps(self.pyvals(step, epoch, extra))
-        _ckpt.save_state_dict(tree, path)
+        with _trace.span("train.checkpoint", step=int(step)):
+            tree = self._tensor_tree()
+            tree[_PYVALS_KEY] = json.dumps(self.pyvals(step, epoch, extra))
+            _ckpt.save_state_dict(tree, path)
         return path
 
     def restore(self, path: str) -> Dict[str, Any]:
@@ -393,55 +400,84 @@ class TrainingSupervisor:
                           path, self._global_step, self._epoch)
         base_step = self._global_step
         restarts = 0
+        # opt-in scrape endpoint (ISSUE 12): /metrics + /healthz +
+        # /debug/flight behind PADDLE_TPU_OBS_HTTP_PORT; unset costs one
+        # env read
+        _obs_http.maybe_serve_from_env()
         try:
-            while True:
-                try:
-                    self._run_epochs(step_fn, data, epochs, steps_per_epoch,
-                                     update_fn, clear_fn, on_epoch_begin,
-                                     on_epoch_end, on_batch_begin,
-                                     on_batch_end, should_stop)
-                    break
-                except _StepUnrecoverable as exc:
-                    cause = exc.cause
-                    if not cfg.ckpt_dir:
-                        raise TrainAborted(
-                            "unrecoverable train step and no ckpt_dir to "
-                            "roll back to") from cause
-                    if restarts >= cfg.max_restarts:
-                        raise TrainAborted(
-                            f"restart budget exhausted "
-                            f"({cfg.max_restarts} restarts)") from cause
-                    got = self.state.restore_latest(cfg.ckpt_dir)
-                    if got is None:
-                        raise TrainAborted(
-                            "unrecoverable train step before the first "
-                            "TrainState save") from cause
-                    restarts += 1
-                    _obs.inc("train.restarts_total")
-                    path, py = got
-                    self._global_step = int(py.get("step", 0))
-                    self._epoch = int(py.get("epoch", 0))
-                    self._nan_streak = 0
-                    self._warn_unpositioned_data(data, py)
-                    # grads are not part of TrainState; whatever the failed
-                    # step left accumulated must not leak into the resumed
-                    # trajectory
-                    if clear_fn is not None:
-                        try:
-                            clear_fn()
-                        except Exception:
-                            _log.exception(
-                                "train: clear_fn failed after a restore")
-                    # the rolled-back steps re-run; they must not appear
-                    # twice in the trajectory
-                    del self._losses[max(0, self._global_step - base_step):]
-                    _log.warning(
-                        "train: restored last-good %s (step %d) after %r — "
-                        "restart %d/%d", path, self._global_step, cause,
-                        restarts, cfg.max_restarts)
+            with _trace.span("train.run", epochs=epochs):
+                while True:
+                    try:
+                        self._run_epochs(step_fn, data, epochs,
+                                         steps_per_epoch, update_fn,
+                                         clear_fn, on_epoch_begin,
+                                         on_epoch_end, on_batch_begin,
+                                         on_batch_end, should_stop)
+                        break
+                    except _StepUnrecoverable as exc:
+                        cause = exc.cause
+                        if not cfg.ckpt_dir:
+                            raise TrainAborted(
+                                "unrecoverable train step and no ckpt_dir "
+                                "to roll back to") from cause
+                        if restarts >= cfg.max_restarts:
+                            raise TrainAborted(
+                                f"restart budget exhausted "
+                                f"({cfg.max_restarts} restarts)") from cause
+                        got = self.state.restore_latest(cfg.ckpt_dir)
+                        if got is None:
+                            raise TrainAborted(
+                                "unrecoverable train step before the first "
+                                "TrainState save") from cause
+                        restarts += 1
+                        _obs.inc("train.restarts_total")
+                        path, py = got
+                        self._global_step = int(py.get("step", 0))
+                        self._epoch = int(py.get("epoch", 0))
+                        self._nan_streak = 0
+                        _trace.instant("train.restore", path=path,
+                                       step=self._global_step,
+                                       restart=restarts,
+                                       cause=type(cause).__name__)
+                        self._warn_unpositioned_data(data, py)
+                        # grads are not part of TrainState; whatever the
+                        # failed step left accumulated must not leak into
+                        # the resumed trajectory
+                        if clear_fn is not None:
+                            try:
+                                clear_fn()
+                            except Exception:
+                                _log.exception(
+                                    "train: clear_fn failed after a restore")
+                        # the rolled-back steps re-run; they must not appear
+                        # twice in the trajectory
+                        del self._losses[max(0,
+                                             self._global_step - base_step):]
+                        _log.warning(
+                            "train: restored last-good %s (step %d) after "
+                            "%r — restart %d/%d", path, self._global_step,
+                            cause, restarts, cfg.max_restarts)
+        except TrainAborted as exc:
+            # the abort carries its own post-mortem: the flight ring's
+            # tail names the fault site that exhausted the budget
+            exc.flight_dump = _trace.flight_dump(
+                "train_aborted", error=str(exc),
+                cause=type(exc.__cause__).__name__ if exc.__cause__
+                else None)
+            raise
+        except BaseException as exc:
+            # unhandled supervisor exit — a KillPoint (simulated process
+            # death), KeyboardInterrupt, or an unexpected user error: the
+            # dump is the part of the post-mortem that survives the
+            # process
+            _trace.flight_dump("supervisor_exit",
+                               error=type(exc).__name__)
+            raise
         finally:
             if self._watchdog is not None:
                 self._watchdog.stop()
+            _trace.heartbeat_clear("train.supervisor")
+            _trace.maybe_export_chrome("train")
         report.losses = list(self._losses)
         report.steps = self._global_step - base_step
         report.retries = self._retries
@@ -518,30 +554,38 @@ class TrainingSupervisor:
                 if steps_per_epoch is not None \
                         and step_in_epoch >= steps_per_epoch:
                     break
-                if it is not None:
-                    try:
-                        batch = self._fetch(it)
-                    except StopIteration:
-                        break
-                else:
-                    batch = None
-                if on_batch_begin is not None:
-                    on_batch_begin(step_in_epoch)
-                loss = self._run_step(step_fn, update_fn, clear_fn, batch)
-                idx = step_in_epoch
-                step_in_epoch += 1
-                if loss is None:       # skipped batch (non-finite loss)
-                    continue
-                self._global_step += 1
-                self._losses.append(loss)
-                _obs.inc("train.steps_total")
-                if on_batch_end is not None:
-                    on_batch_end(idx, loss)
-                if cfg.ckpt_dir and cfg.save_every \
-                        and self._global_step % cfg.save_every == 0:
-                    self._save_state()
-                if should_stop is not None and should_stop():
-                    return
+                _trace.heartbeat("train.supervisor")
+                # ONE span covers the whole step — fetch, forward/backward
+                # (child spans), update, checkpoint — so a training step's
+                # trace is a connected tree with the retry/restore/NaN
+                # events attached inside it
+                with _trace.span("train.step", step=self._global_step,
+                                 epoch=ep):
+                    if it is not None:
+                        try:
+                            batch = self._fetch(it)
+                        except StopIteration:
+                            break
+                    else:
+                        batch = None
+                    if on_batch_begin is not None:
+                        on_batch_begin(step_in_epoch)
+                    loss = self._run_step(step_fn, update_fn, clear_fn,
+                                          batch)
+                    idx = step_in_epoch
+                    step_in_epoch += 1
+                    if loss is None:   # skipped batch (non-finite loss)
+                        continue
+                    self._global_step += 1
+                    self._losses.append(loss)
+                    _obs.inc("train.steps_total")
+                    if on_batch_end is not None:
+                        on_batch_end(idx, loss)
+                    if cfg.ckpt_dir and cfg.save_every \
+                            and self._global_step % cfg.save_every == 0:
+                        self._save_state()
+                    if should_stop is not None and should_stop():
+                        return
             self._epoch += 1
             if on_epoch_end is not None:
                 on_epoch_end(ep)
@@ -549,6 +593,10 @@ class TrainingSupervisor:
                 return
 
     def _fetch(self, it):
+        with _trace.span("train.fetch"):
+            return self._fetch_traced(it)
+
+    def _fetch_traced(self, it):
         pol = get_policy("train.data", base_delay=0.05, max_delay=1.0,
                          max_attempts=3)
         for attempt in pol.start():
@@ -561,6 +609,8 @@ class TrainingSupervisor:
                     raise _StepUnrecoverable(final) from final
                 self._retries += 1
                 _obs.inc("train.retries_total", site="train.data")
+                _trace.instant("train.retry", site="train.data",
+                               error=type(e).__name__)
                 continue
             try:
                 return next(it)
@@ -581,7 +631,8 @@ class TrainingSupervisor:
             gen = self._watchdog.arm() if self._watchdog is not None else None
             try:
                 _faults.fault_point("train.step")
-                with _obs.scoped_timer("train.step_seconds"):
+                with _obs.scoped_timer("train.step_seconds"), \
+                        _trace.span("train.fwd_bwd"):
                     loss = step_fn(batch)
             except BaseException as e:
                 if gen is not None:
@@ -601,6 +652,8 @@ class TrainingSupervisor:
                     raise _StepUnrecoverable(final) from final
                 self._retries += 1
                 _obs.inc("train.retries_total", site="train.step")
+                _trace.instant("train.retry", site="train.step",
+                               error=type(e).__name__)
                 continue
             verdict = self._watchdog.disarm(gen) if gen is not None else None
             if verdict is not None:
@@ -616,6 +669,7 @@ class TrainingSupervisor:
                     except Exception:
                         _log.exception(
                             "train: clear_fn failed after a watchdog trip")
+                _trace.instant("train.watchdog", kind=verdict)
                 raise _StepUnrecoverable(WatchdogTimeout(
                     f"train step exceeded the watchdog budget "
                     f"({self._watchdog.timeout_s:.3f}s, classified "
@@ -634,6 +688,8 @@ class TrainingSupervisor:
             self._nan_streak += 1
             self._skipped += 1
             _obs.inc("train.skipped_batches_total")
+            _trace.instant("train.nan_skip", loss=repr(lossf),
+                           streak=self._nan_streak)
             if clear_fn is not None:
                 clear_fn()
             if self._nan_streak >= cfg.max_skipped:
@@ -650,7 +706,8 @@ class TrainingSupervisor:
             return None
         self._nan_streak = 0
         if update_fn is not None:
-            update_fn()
+            with _trace.span("train.update"):
+                update_fn()
         return lossf
 
     def _save_state(self) -> None:

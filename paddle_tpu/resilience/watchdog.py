@@ -46,6 +46,7 @@ import time
 from typing import Optional
 
 from .. import observability as _obs
+from ..observability import trace as _trace
 from . import policy as _policy
 
 __all__ = ["StepWatchdog", "WatchdogTimeout"]
@@ -122,6 +123,7 @@ class StepWatchdog:
         t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=5.0 * self._poll_s + 1.0)
+        _trace.heartbeat_clear(f"{self._label}.watchdog")
 
     # -- poll loop (watchdog thread) ----------------------------------------
     def _loop(self) -> None:
@@ -129,6 +131,12 @@ class StepWatchdog:
             with self._lock:
                 armed_at, gen = self._armed_at, self._gen
                 verdict = self._verdicts.get(gen)
+            # the /healthz beacon: the watchdog thread itself cannot be
+            # wedged by a compiled call, so its beat going stale means the
+            # PROCESS is in trouble; ok=False while a window is tripped
+            _trace.heartbeat(f"{self._label}.watchdog",
+                             ttl_s=max(1.0, 8.0 * self._poll_s),
+                             ok=verdict is None)
             if armed_at is not None:
                 waited = time.monotonic() - armed_at
                 if verdict is None and waited > self.timeout_s:
@@ -145,6 +153,13 @@ class StepWatchdog:
                 return
             self._verdicts[gen] = kind
         _obs.inc(self._metric, kind=kind)
+        # ISSUE 12: a trip is a post-mortem moment — put the event in the
+        # flight ring and snapshot it to disk while the process still can
+        _trace.record("watchdog_trip", label=self._label, kind=kind,
+                      waited_s=round(waited, 3),
+                      budget_s=self.timeout_s)
+        _trace.flight_dump(f"watchdog_{kind}", label=self._label,
+                           waited_s=round(waited, 3))
         if kind == "hung":
             _log.warning(
                 "%s watchdog: compiled step armed %.3fs > budget %.3fs "

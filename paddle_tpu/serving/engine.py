@@ -76,7 +76,15 @@ Metrics: ``serving.requests_total{status}``, ``serving.tokens_total``,
 ``serving.watchdog_trips_total{kind}``, ``serving.replays_total``,
 ``serving.queue_depth``, ``serving.active_slots``,
 ``serving.batch_utilization``, and ``serving.ttft_seconds`` /
-``serving.tpot_seconds`` / ``serving.queue_wait_seconds`` histograms.
+``serving.tpot_seconds`` / ``serving.queue_wait_seconds`` histograms
+(SLO-shaped buckets — see ``TTFT_BUCKETS``/``TPOT_BUCKETS`` below).
+
+Tracing (ISSUE 12): each request carries a trace root from ``submit()``
+(``observability.trace`` — spans for submit/prefill, instants for
+queue/decode-cadence/fault/replay/completion, all linked across the
+caller and step threads); unrecoverable batched steps dump the flight
+recorder (``serving_recover``); the step loop heartbeats ``/healthz``;
+``PADDLE_TPU_OBS_HTTP_PORT`` opts into the scrape endpoint.
 """
 
 from __future__ import annotations
@@ -93,19 +101,48 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import http as _obs_http
+from ..observability import trace as _trace
 from ..resilience import deadline_scope, faults as _faults, jitter_sleep
 from . import kv_cache as _kv
 from .scheduler import (GenerationRequest, GenerationResult, Scheduler,
                         _Pending)
 from .watchdog import StepWatchdog, WatchdogTimeout
 
-__all__ = ["ServingConfig", "Engine", "EngineStopped", "DrainTimeout"]
+__all__ = ["ServingConfig", "Engine", "EngineStopped", "DrainTimeout",
+           "TTFT_BUCKETS", "TPOT_BUCKETS"]
 
 _log = logging.getLogger(__name__)
 
 # extra seconds past the drain budget the loop thread is given to come
 # back from its in-flight compiled call before stop() proceeds without it
 _JOIN_GRACE_S = 1.0
+
+# SLO-shaped latency boundaries (ISSUE 12). The generic 10us..10s decade
+# grid clipped exactly the bands a serving SLO routes on: sub-10ms decode
+# steps all fell into two buckets, and TTFT targets (100ms/250ms/500ms)
+# sat between boundaries. Registered at import so every later observe
+# joins these families.
+TTFT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 0.75, 1.0, 2.5, 5.0,
+    10.0, 30.0,
+)
+TPOT_BUCKETS = (
+    0.0005, 0.001, 0.002, 0.004, 0.006, 0.008, 0.01, 0.015, 0.025,
+    0.05, 0.1, 0.25, 1.0,
+)
+_obs.histogram("serving.ttft_seconds",
+               "submit -> first token (once per request)",
+               buckets=TTFT_BUCKETS)
+_obs.histogram("serving.tpot_seconds",
+               "inter-token time after the first", buckets=TPOT_BUCKETS)
+
+# every Nth decode step drops an instant on the request's trace: enough to
+# see a request's cadence in Perfetto without an event per token
+_DECODE_TRACE_EVERY = 8
+
+# engine step-loop liveness beacon ttl (/healthz goes 503 past this)
+_HEARTBEAT_TTL_S = 60.0
 
 
 class EngineStopped(RuntimeError):
@@ -256,6 +293,10 @@ class Engine:
         self._thread: Optional[threading.Thread] = None
         self._watchdog: Optional[StepWatchdog] = (
             StepWatchdog(config.watchdog_s) if config.watchdog_s else None)
+        # ISSUE 12: one trace track for the engine's own batched steps
+        # (requests carry their own), and the opt-in scrape endpoint
+        self._engine_trace = None
+        self._obs_http = _obs_http.maybe_serve_from_env()
         self._build_programs()
 
     # ------------------------------------------------------------------
@@ -352,7 +393,20 @@ class Engine:
         """Enqueue; returns a Future resolving to GenerationResult.
         Raises QueueFull / DeadlineExceeded (shed on arrival) /
         EngineStopped (draining) / ValueError (request can never fit)
-        here, on the caller's thread."""
+        here, on the caller's thread.
+
+        With tracing enabled the request gets its own trace root here
+        (one Perfetto track per request): the context rides the pending
+        through the scheduler queue to the engine step thread, so the
+        span tree follows the request across threads."""
+        ctx = _trace.new_trace(f"request-{request.request_id}",
+                               rid=request.request_id) \
+            if _trace.enabled() else None
+        with _trace.span("serving.submit", parent=ctx,
+                         rid=request.request_id):
+            return self._submit(request, ctx)
+
+    def _submit(self, request: GenerationRequest, ctx):
         if self._draining.is_set():
             _obs.inc("serving.requests_total", status="rejected")
             _obs.inc("serving.rejected_total", reason="shed")
@@ -365,7 +419,8 @@ class Engine:
                 f"{self.config.max_len}")
         if self._pages_needed(request) > self.kv.config.num_pages - 1:
             raise ValueError("request needs more pages than the pool holds")
-        fut = self.scheduler.submit(request, submit_time=time.monotonic())
+        fut = self.scheduler.submit(request, submit_time=time.monotonic(),
+                                    trace_ctx=ctx)
         if self._draining.is_set():
             # raced a concurrent stop(drain=True) past the check above: the
             # drain's queue resolution may already have run, in which case
@@ -401,6 +456,7 @@ class Engine:
         """One step boundary: evict cancellations, admit what fits, run
         ONE batched decode step. Returns False when there was nothing to
         do (the idle step — no program runs, no device touch)."""
+        _trace.heartbeat("serving.engine", ttl_s=_HEARTBEAT_TTL_S)
         progressed = self._process_cancellations()
         # draining latches out NEW admissions only: slots evicted by
         # crash-recovery mid-drain still re-admit, or the drain would
@@ -542,6 +598,11 @@ class Engine:
             self._watchdog.stop()
         if drain:
             self._resolve_stragglers(on_timeout)
+        # a cleanly stopped engine is not a liveness failure; and with
+        # PADDLE_TPU_TRACE=on + a TRACE_DIR, leave the operator a
+        # Perfetto-loadable trace of the run
+        _trace.heartbeat_clear("serving.engine")
+        _trace.maybe_export_chrome("serving")
 
     def _resolve_stragglers(self, on_timeout: str) -> None:
         """Terminal accounting for a drain: no Future may stay stranded
@@ -574,6 +635,9 @@ class Engine:
                     # decoding when crash-recovery requeued it, and the
                     # drain budget ran out before its re-admission
                     _obs.inc("serving.requests_total", status="failed")
+                    _trace.instant("serving.fault", parent=pend.trace_ctx,
+                                   rid=pend.request.request_id,
+                                   error="DrainTimeout")
                     pend.future.set_exception(DrainTimeout(
                         f"request {pend.request.request_id} evicted at "
                         f"drain timeout awaiting replay re-admission "
@@ -581,6 +645,9 @@ class Engine:
                     continue
                 _obs.inc("serving.requests_total", status="shed")
                 _obs.inc("serving.rejected_total", reason="shed")
+                _trace.instant("serving.shed", parent=pend.trace_ctx,
+                               rid=pend.request.request_id,
+                               reason="engine_stopped")
                 pend.future.set_exception(EngineStopped(
                     f"request {pend.request.request_id} never admitted: "
                     f"engine stopped"))
@@ -661,7 +728,10 @@ class Engine:
         if pages is None:
             return "noroom"
         try:
-            with self._deadline_ctx([pending]):
+            with _trace.span("serving.prefill", parent=pending.trace_ctx,
+                             rid=req.request_id, prompt=int(prompt.size),
+                             replay=len(pending.replay_tokens)), \
+                    self._deadline_ctx([pending]):
                 for attempt in (0, 1):
                     try:
                         _faults.fault_point("serving.admit")
@@ -670,6 +740,11 @@ class Engine:
                         if attempt:
                             raise exc
                         _obs.inc("serving.admit_retries_total")
+                        _trace.instant("serving.fault",
+                                       parent=pending.trace_ctx,
+                                       rid=req.request_id,
+                                       site="serving.admit", retried=True,
+                                       error=type(exc).__name__)
                 row = self.kv.table_row(pages)
                 outs = self._prefill_program(
                     _T(jnp.asarray(prompt[None, :], jnp.int32)),
@@ -679,6 +754,9 @@ class Engine:
         except Exception as exc:
             self.kv.free(pages)
             _obs.inc("serving.requests_total", status="failed")
+            _trace.instant("serving.fault", parent=pending.trace_ctx,
+                           rid=req.request_id, site="serving.admit",
+                           error=type(exc).__name__)
             pending.future.set_exception(exc)
             return "failed"
         self._set_pool(outs[1], outs[2] if self._quantized else None)
@@ -706,6 +784,11 @@ class Engine:
                     self._finish_error(slot, exc)
                 else:
                     _obs.inc("serving.step_retries_total")
+                    _trace.instant("serving.fault",
+                                   parent=slot.pending.trace_ctx,
+                                   rid=slot.request.request_id,
+                                   site="serving.step", retried=True,
+                                   error=type(exc).__name__)
                 continue
             included.append(slot)
         return included
@@ -717,6 +800,13 @@ class Engine:
         raise AssertionError(f"no bucket for batch {n}")  # __post_init__
 
     def _decode_step(self, included: List[_Slot]) -> None:
+        if _trace.enabled() and self._engine_trace is None:
+            self._engine_trace = _trace.new_trace("serving-engine")
+        with _trace.span("serving.decode", parent=self._engine_trace,
+                         batch=len(included)):
+            self._decode_step_traced(included)
+
+    def _decode_step_traced(self, included: List[_Slot]) -> None:
         from ..core.tensor import Tensor as _T
         bucket = self._bucket_for(len(included))
         S = self.kv.config.pages_per_slot
@@ -774,8 +864,16 @@ class Engine:
         next_np = np.asarray(outs[0]._data)        # the ONE host sync
         now = time.monotonic()
         _obs.inc("serving.steps_total")
+        traced = _trace.enabled()
         for i, slot in enumerate(included):
             slot.t += 1
+            if traced and len(slot.tokens) % _DECODE_TRACE_EVERY == 0:
+                # every Nth token: a point on the REQUEST's track, linked
+                # across threads via its carried context
+                _trace.instant("serving.decode_step",
+                               parent=slot.pending.trace_ctx,
+                               rid=slot.request.request_id, t=slot.t,
+                               tokens=len(slot.tokens))
             self._emit_token(slot, int(next_np[i, 0]), now)
 
     def _emit_token(self, slot: _Slot, token: int, now: float,
@@ -831,6 +929,9 @@ class Engine:
             return
         _obs.inc("serving.requests_total", status=(
             "completed" if reason in ("eos", "length") else reason))
+        _trace.instant("serving.complete", parent=slot.pending.trace_ctx,
+                       rid=slot.request.request_id, reason=reason,
+                       tokens=len(slot.tokens))
         n = len(slot.tokens)
         tpot = ((slot.last_token_time - slot.first_token_time) / (n - 1)
                 if n > 1 else None)
@@ -844,6 +945,11 @@ class Engine:
         if not self._release(slot):
             return
         _obs.inc("serving.requests_total", status="failed")
+        # the chaos-suite invariant: a faulted request's trace always
+        # carries the fault event, whatever path resolved it
+        _trace.instant("serving.fault", parent=slot.pending.trace_ctx,
+                       rid=slot.request.request_id,
+                       error=type(exc).__name__)
         slot.pending.future.set_exception(exc)
 
     def _recover_slots(self, included: List[_Slot],
@@ -856,6 +962,13 @@ class Engine:
         batchmates no longer share one slot's fate. Past ``max_replays``
         the slot's Future gets ``exc``."""
         requeue: List[_Pending] = []
+        # post-mortem first: the flight ring's tail already carries the
+        # fault/trip events that got us here — snapshot it to disk before
+        # recovery mutates anything (ISSUE 12: crash-recovery dump site)
+        _trace.record("serving.recover", error=type(exc).__name__,
+                      slots=len(included))
+        _trace.flight_dump("serving_recover", error=type(exc).__name__,
+                           slots=len(included))
         # cover the eviction->requeue gap for the drain-owed probe: these
         # slots leave _slots before their requeue lands in the queue
         self._in_transit += len(included)
@@ -873,6 +986,10 @@ class Engine:
                 pend.replays += 1
                 pend.replay_tokens = list(slot.tokens)
                 _obs.inc("serving.replays_total")
+                _trace.instant("serving.replay", parent=pend.trace_ctx,
+                               rid=pend.request.request_id,
+                               replays=pend.replays,
+                               error=type(exc).__name__)
                 requeue.append(pend)
             if requeue:
                 self.scheduler.requeue(requeue)

@@ -50,19 +50,34 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, Deque, Dict, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import trace as _trace
 from ..resilience import DeadlineExceeded
 
 __all__ = ["GenerationRequest", "GenerationResult", "QueueFull",
-           "DeadlineExceeded", "Scheduler"]
+           "DeadlineExceeded", "Scheduler", "QUEUE_WAIT_BUCKETS"]
 
 # EWMA smoothing for the admission drain interval (the shed-on-arrival
 # wait model): ~10 admissions of memory
 _EWMA_ALPHA = 0.3
+
+# SLO-shaped queue-wait boundaries (ISSUE 12): the generic latency grid
+# started at 10us with decade-ish steps, which collapsed the 1-25ms band
+# an admission-time SLO actually routes on (ROADMAP item 2's queue-wait
+# front-door signal) into two buckets. Registered at import so every
+# later observe joins THIS family.
+QUEUE_WAIT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 15.0, 60.0,
+)
+_obs.histogram("serving.queue_wait_seconds",
+               "queue wait of each admitted request (one stint)",
+               buckets=QUEUE_WAIT_BUCKETS)
 
 _req_ids = itertools.count()
 
@@ -141,6 +156,10 @@ class _Pending:
     replays: int = 0
     replay_tokens: List[int] = field(default_factory=list)
     ttft_done: bool = False
+    # ISSUE 12: the request's trace root (a trace.SpanContext, or None with
+    # tracing off) — the explicit cross-thread handoff that lets the trace
+    # follow the request from submit() through the engine step thread
+    trace_ctx: Any = None
 
 
 class Scheduler:
@@ -198,14 +217,16 @@ class Scheduler:
         self._last_pop_t = None
         self._ewma_interval = None
 
-    def submit(self, request: GenerationRequest,
-               submit_time: float = 0.0) -> "Future[GenerationResult]":
+    def submit(self, request: GenerationRequest, submit_time: float = 0.0,
+               trace_ctx: Any = None) -> "Future[GenerationResult]":
         fut: "Future[GenerationResult]" = Future()
         with self._lock:
             depth = len(self._queue)
             if depth >= self.max_queue:
                 _obs.inc("serving.requests_total", status="rejected")
                 _obs.inc("serving.rejected_total", reason="queue_full")
+                _trace.instant("serving.rejected", parent=trace_ctx,
+                               rid=request.request_id, reason="queue_full")
                 raise QueueFull(
                     f"serving queue full ({depth}/{self.max_queue} pending)")
             # reject-on-arrival: queueing work whose wait estimate already
@@ -219,14 +240,20 @@ class Scheduler:
             if submit_time and budget is not None and est > budget:
                 _obs.inc("serving.requests_total", status="rejected")
                 _obs.inc("serving.rejected_total", reason="shed")
+                _trace.instant("serving.rejected", parent=trace_ctx,
+                               rid=request.request_id, reason="shed",
+                               estimated_wait_s=round(est, 4))
                 raise DeadlineExceeded(
                     f"request {request.request_id} shed on arrival: "
                     f"estimated queue wait {est:.3f}s exceeds its "
                     f"{budget:.3f}s budget (queue depth {depth})")
             self._queue.append(_Pending(request, fut, submit_time,
-                                        queued_at=submit_time))
+                                        queued_at=submit_time,
+                                        trace_ctx=trace_ctx))
             depth += 1
         _obs.set_gauge("serving.queue_depth", depth)
+        _trace.instant("serving.queued", parent=trace_ctx,
+                       rid=request.request_id, depth=depth)
         return fut
 
     def _pop_queued_locked(self, request_id: int) -> Optional[_Pending]:
@@ -259,6 +286,8 @@ class Scheduler:
             depth = len(self._queue)
         _obs.set_gauge("serving.queue_depth", depth)
         _obs.inc("serving.requests_total", status="cancelled")
+        _trace.instant("serving.cancelled", parent=pend.trace_ctx,
+                       rid=request_id, queued=True)
         pend.future.set_result(GenerationResult(
             request_id, [], "cancelled"))
         return True
@@ -302,6 +331,9 @@ class Scheduler:
         for p, reason, waited, budget in shed:
             _obs.inc("serving.requests_total", status="shed")
             _obs.inc("serving.rejected_total", reason=reason)
+            _trace.instant("serving.shed", parent=p.trace_ctx,
+                           rid=p.request.request_id, reason=reason,
+                           waited_s=round(waited, 4))
             p.future.set_exception(DeadlineExceeded(
                 f"request {p.request.request_id} expired in queue: waited "
                 f"{waited:.3f}s against a {budget:.3f}s "

@@ -89,6 +89,21 @@ def metrics():
     obs.reset()
 
 
+@pytest.fixture()
+def tracing(tmp_path, monkeypatch):
+    """Tracing fully on with a clean buffer/ring and dumps routed to
+    tmp_path for one test (shared by the trace + chaos suites)."""
+    from paddle_tpu.observability import trace
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    trace.set_mode("on")
+    trace.clear()
+    trace.flight_recorder().clear()
+    yield trace
+    trace.set_mode("off")
+    trace.clear()
+    trace.flight_recorder().clear()
+
+
 # ---------------------------------------------------------------------------
 # Test tiers. The DEFAULT tier is the core loop: autograd, to_static,
 # optimizers, distributed/pipeline/ZeRO, checkpoint, quant, IO — the

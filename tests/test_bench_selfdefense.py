@@ -229,6 +229,47 @@ def test_eager_dispatch_bench_pins_captured_leg():
 
 
 # ---------------------------------------------------------------------------
+# tracing-overhead block (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_trace_overhead_detail_is_schema_stable():
+    # the row of record pins the off/flight/on captured-step p50s: the
+    # always-on flight recorder must be near-free on the hot path
+    block = bench._trace_overhead_detail(10.0, 10.1, 10.5)
+    assert set(block) == set(bench.TRACE_OVERHEAD_FIELDS)
+    assert set(bench.TRACE_OVERHEAD_FIELDS) == {
+        "step_ms_p50_off", "step_ms_p50_flight", "step_ms_p50_on",
+        "flight_overhead_pct", "on_overhead_pct"}
+    assert block["flight_overhead_pct"] == 1.0
+    assert block["on_overhead_pct"] == 5.0
+
+
+def test_trace_overhead_zero_off_p50_is_safe():
+    block = bench._trace_overhead_detail(0.0, 0.0, 0.0)
+    assert block["flight_overhead_pct"] == 0.0
+
+
+def test_flight_overhead_over_two_percent_is_suspect():
+    # >2% flight-vs-off p50 delta disqualifies the run: every number of
+    # record ships with the recorder on, so its cost must stay invisible
+    bad = bench._trace_overhead_detail(10.0, 10.3, 10.3)
+    reasons = bench._trace_suspect_reasons(bad)
+    assert reasons and "flight-recorder" in reasons[0]
+    good = bench._trace_overhead_detail(10.0, 10.1, 12.0)
+    assert bench._trace_suspect_reasons(good) == []   # "on" is debug tier
+
+
+def test_bench_main_emits_trace_overhead():
+    import inspect
+    src = inspect.getsource(bench.main)
+    assert "_trace_overhead_detail" in src and '"trace_overhead"' in src
+    assert "_trace_suspect_reasons" in src
+    assert "set_mode" in src      # measured under real mode switches
+    for m in ('"off"', '"flight"', '"on"'):
+        assert m in src, m
+
+
+# ---------------------------------------------------------------------------
 # eager-dispatch bench schema + dispatch fast-path hygiene (ISSUE 2)
 # ---------------------------------------------------------------------------
 
@@ -295,8 +336,10 @@ def test_serving_bench_pins_schema():
     # the --serving JSON row of record: per-batch rows + the aggregate
     # payload RESULTS.md keys on; drift must fail here, not in a diff
     mod = _load_bench_generation()
+    # queue_wait_ms joined in ISSUE 12 (the SLO-bucketed histogram the
+    # front door scrapes, surfaced per batch row)
     assert set(mod.SERVING_ROW_FIELDS) == {
-        "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms",
+        "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "queue_wait_ms",
         "scan_greedy_parity", "match_frac", "batch_utilization"}
     assert {"benchmark", "kv_dtype", "page_size",
             "single_stream_tokens_per_sec", "serving", "resilience",

@@ -38,7 +38,10 @@ EXPECTED_RULES = {"trace-impurity", "silent-swallow", "hot-path-import",
                   "naked-retry",
                   # PR 6 (backend fallback): placement belongs to
                   # device.py / core/fallback.py
-                  "device-access"}
+                  "device-access",
+                  # ISSUE 12 (tracing): spans only via the span() context
+                  # manager; guarded construction on the dispatch fast path
+                  "span-discipline"}
 
 
 def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
@@ -425,6 +428,83 @@ def test_naked_retry_nested_def_does_not_inherit_loop(tmp_path):
                 helper()
         """, "naked-retry")
     assert found == []
+
+
+# ---------------------------------------------------------------------------
+# span-discipline (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_span_discipline_flags_manual_pairing(tmp_path):
+    found = _lint_snippet(tmp_path, """\
+        from paddle_tpu.observability import trace
+
+        def f():
+            s = trace.begin_span("x")
+            trace.end_span(s)
+        """, "span-discipline")
+    assert len(found) == 2
+    assert "manual span pairing" in found[0].message
+
+
+def test_span_discipline_flags_span_outside_with(tmp_path):
+    found = _lint_snippet(tmp_path, """\
+        from paddle_tpu.observability import trace as _trace
+
+        def f():
+            s = _trace.span("x")
+            s.__enter__()
+        """, "span-discipline")
+    assert len(found) == 1 and "outside a `with`" in found[0].message
+
+
+def test_span_discipline_with_statement_is_clean(tmp_path):
+    found = _lint_snippet(tmp_path, """\
+        from paddle_tpu.observability import trace as _trace
+
+        def f(ctx):
+            with _trace.span("serving.prefill", parent=ctx, rid=1):
+                _trace.instant("tick")
+        """, "span-discipline")
+    assert found == []
+
+
+def test_span_discipline_hot_module_needs_enabled_guard(tmp_path):
+    hot = """\
+        from paddle_tpu.observability import trace as _trace
+
+        def dispatch():
+            with _trace.span("op"):
+                pass
+        """
+    cfg = {"span_hot_modules": ["hot.py"]}
+    found = _lint_snippet(tmp_path, hot, "span-discipline",
+                          filename="hot.py", config=cfg)
+    assert len(found) == 1 and "enabled() guard" in found[0].message
+    # the same file NOT in span_hot_modules is fine
+    assert _lint_snippet(tmp_path, hot, "span-discipline",
+                         filename="warm.py", config=cfg) == []
+
+
+def test_span_discipline_guarded_hot_module_is_clean(tmp_path):
+    found = _lint_snippet(tmp_path, """\
+        from paddle_tpu.observability import trace as _trace
+
+        def dispatch():
+            if _trace.enabled():
+                with _trace.span("op"):
+                    pass
+            else:
+                pass
+        """, "span-discipline", filename="hot.py",
+        config={"span_hot_modules": ["hot.py"]})
+    assert found == []
+
+
+def test_span_discipline_shipped_tree_is_clean():
+    # the acceptance pin: 0 findings over paddle_tpu/ with no baseline
+    # allowance — the step_capture fast-path span stays guarded
+    result = run_lint(rules=["span-discipline"])
+    assert [f.text() for f in result.new] == []
 
 
 # ---------------------------------------------------------------------------

@@ -339,11 +339,17 @@ class TestJsonlExport:
         w.write(2, loss=0.7)
         w.close()
         recs = obs.read_jsonl(path)
-        assert [r["step"] for r in recs] == [1, 2]
-        assert recs[0]["counters"]["jl.ops_total"] == 3
-        assert recs[1]["counters"]["jl.ops_total"] == 4  # DELTA, not total
-        assert recs[0]["gauges"]["jl.depth"] == 2
-        assert recs[0]["loss"] == pytest.approx(0.9)
+        # ISSUE 12: records are the shared trace envelope (ts/kind/name/
+        # attrs), the step payload inside attrs
+        for r in recs:
+            assert {"ts", "kind", "name", "attrs"} <= set(r)
+            assert r["kind"] == "step" and r["name"] == "telemetry"
+        assert [r["attrs"]["step"] for r in recs] == [1, 2]
+        assert recs[0]["attrs"]["counters"]["jl.ops_total"] == 3
+        # DELTA, not total
+        assert recs[1]["attrs"]["counters"]["jl.ops_total"] == 4
+        assert recs[0]["attrs"]["gauges"]["jl.depth"] == 2
+        assert recs[0]["attrs"]["loss"] == pytest.approx(0.9)
 
     def test_dispatch_counters_round_trip_via_jsonl(self, tmp_path):
         obs.enable()
@@ -354,9 +360,9 @@ class TestJsonlExport:
         w.write(1)
         w.close()
         rec = obs.read_jsonl(path)[0]
-        assert rec["counters"]["dispatch.ops_total"] >= 1
+        assert rec["attrs"]["counters"]["dispatch.ops_total"] >= 1
         # histogram rides along as .count/.sum samples
-        assert rec["counters"]["dispatch.latency_seconds.count"] >= 1
+        assert rec["attrs"]["counters"]["dispatch.latency_seconds.count"] >= 1
 
     def test_writer_accepts_file_object(self):
         obs.enable()
@@ -365,7 +371,7 @@ class TestJsonlExport:
         w = obs.StepTelemetryWriter(buf, baseline="zero")
         w.write(1)
         rec = json.loads(buf.getvalue())
-        assert rec["counters"]["fo.n_total"] == 1
+        assert rec["attrs"]["counters"]["fo.n_total"] == 1
 
 
 class TestScopedTimer:
@@ -437,8 +443,8 @@ class TestHapiStepTelemetry:
         recs = obs.read_jsonl(path)
         assert len(recs) == 2  # 16 samples / batch 8
         for rec in recs:
-            assert rec["counters"].get("dispatch.ops_total", 0) > 0
-            assert "loss" in rec
+            assert rec["attrs"]["counters"].get("dispatch.ops_total", 0) > 0
+            assert "loss" in rec["attrs"]
         # the callback turned metrics off again at train end (they were
         # off before fit)
         assert not obs.enabled()

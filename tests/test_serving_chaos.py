@@ -142,6 +142,63 @@ def test_chaos_same_seed_same_terminal_state(metrics):
     assert trace1 == trace2 and len(trace1) >= 1
 
 
+@pytest.mark.parametrize("seed", [0, 2])
+def test_chaos_sweep_trace_invariants(seed, metrics, tracing, tmp_path):
+    """ISSUE 12: under the same seeded sweep, every span is balanced (each
+    start has exactly one end — spans are context managers, so this holds
+    through faults, watchdog trips, replays, and the drain), every
+    request that RESOLVED with a fault carries the fault event on its own
+    trace, and any crash-recovery that fired left a parseable flight
+    dump whose tail names the fault site."""
+    import json
+    import os
+    sched = _chaos_schedule(seed)
+    eng = make_engine(max_batch=4, watchdog_s=0.2, max_replays=2,
+                      max_queue=16)
+    n_new = [4, 3, 5, 4, 3]
+    reqs, futs = [], []
+    with faults.installed(sched):
+        for i, (p, n) in enumerate(zip(PROMPTS, n_new)):
+            kw = {"deadline_s": 30.0} if i % 2 else {}
+            r = serving.GenerationRequest(p, max_new_tokens=n, **kw)
+            reqs.append(r)
+            futs.append(eng.submit(r))
+        eng.run()
+        eng.stop(drain=True, timeout=10)
+
+    evs = tracing.events()
+    # 1) every span balanced, tree well-formed, on every recovery path
+    assert tracing.span_problems(evs) == []
+
+    # 2) every fault-resolved request's trace carries the fault event
+    for r, f in zip(reqs, futs):
+        exc = f.exception(timeout=0)
+        if not isinstance(exc, (faults.FaultInjected,
+                                serving.WatchdogTimeout)):
+            continue
+        mine = [e for e in evs
+                if (e.get("attrs") or {}).get("rid") == r.request_id]
+        assert any(e["name"] == "serving.fault" for e in mine), \
+            f"request {r.request_id} failed with {type(exc).__name__} " \
+            f"but its trace has no fault event"
+
+    # 3) crash-recovery (unrecoverable batched step) left a parseable
+    #    dump whose tail names the fault site
+    recovered = any(e["name"] == "serving.recover" for e in evs)
+    dump = os.path.join(str(tmp_path),
+                        f"flight-{os.getpid()}-serving_recover.json")
+    assert recovered == os.path.exists(dump)
+    if recovered:
+        doc = json.load(open(dump))
+        assert doc["reason"] == "serving_recover"
+        sites = [e["attrs"].get("site") for e in doc["events"]
+                 if e["name"] == "fault"]
+        assert sites and sites[-1].startswith("serving.")
+
+    # 4) the chrome export of the whole chaos run still loads
+    json.dumps(tracing.export_chrome())
+
+
 def test_soak_continuous_load_with_faults(metrics):
     """Longer horizon: three waves of submissions against a live engine
     (background thread) with step/admit faults and replays enabled; the

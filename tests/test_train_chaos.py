@@ -365,6 +365,57 @@ def test_chaos_sweep_terminates_typed_and_deterministic(seed, tmp_path):
         assert first["losses"] == ref
 
 
+@pytest.mark.parametrize("seed", [0, 2])
+def test_chaos_sweep_trace_invariants(seed, tmp_path, tracing):
+    """ISSUE 12: the same seeded sweep with tracing on — every span
+    balanced through retries/restores/aborts, the retry/restore events
+    ride the step spans, and every abort path leaves a parseable flight
+    dump whose tail names a train.* fault site."""
+    import json
+
+    outcome = _chaos_run(seed, tmp_path, f"{seed}t")
+    evs = tracing.events()
+    assert tracing.span_problems(evs) == []
+    names = {e["name"] for e in evs}
+    assert {"train.run", "train.step", "train.fwd_bwd"} <= names
+    if any(site == "train.step" and kind == "error"
+           for site, _, kind in outcome["trace"]):
+        assert "train.retry" in names or "train.restore" in names
+    if outcome["kind"] == "aborted":
+        dump = os.path.join(
+            str(tmp_path), f"flight-{os.getpid()}-train_aborted.json")
+        assert os.path.exists(dump)
+        doc = json.load(open(dump))
+        sites = [e["attrs"].get("site") for e in doc["events"]
+                 if e["name"] == "fault"]
+        assert sites and sites[-1].startswith("train.")
+    # the chrome export of the whole chaos run still loads
+    json.dumps(tracing.export_chrome())
+
+
+def test_kill_at_step_leaves_parseable_dump_with_fault_site(tmp_path,
+                                                            tracing):
+    """ISSUE 12 acceptance: a killed run's flight dump tail matches the
+    injected fault site (here the kill itself at train.step)."""
+    import json
+
+    r = build_run()
+    sched = faults.FaultSchedule().kill("train.step", on=(3,))
+    with faults.installed(sched):
+        with pytest.raises(faults.KillPoint):
+            run_supervised(r, tmp_path / "ck", save_every=1)
+    dump = os.path.join(
+        str(tmp_path), f"flight-{os.getpid()}-supervisor_exit.json")
+    assert os.path.exists(dump)
+    doc = json.load(open(dump))
+    assert doc["info"]["error"] == "KillPoint"
+    fault_evs = [e for e in doc["events"] if e["name"] == "fault"]
+    assert fault_evs and fault_evs[-1]["attrs"]["site"] == "train.step"
+    assert fault_evs[-1]["attrs"]["injected"] == "kill"
+    # spans unwound (balanced) even through the BaseException kill
+    assert tracing.span_problems() == []
+
+
 # ---------------------------------------------------------------------------
 # TrainState: verified persistence + pointer-chain fallback
 # ---------------------------------------------------------------------------

@@ -168,6 +168,17 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         # baselined as the debug semantics)
         "paddle_tpu/core/step_capture.py::__call__",
     ],
+    # span-discipline (ISSUE 12): the tracing implementation module (the
+    # one place manual event emission is legal), and the fast-path modules
+    # where span construction must hide behind an enabled() guard — the
+    # same set that hosts fast_path_roots
+    "span_impl_paths": ["paddle_tpu/observability/trace.py"],
+    "span_hot_modules": [
+        "paddle_tpu/core/tensor.py",
+        "paddle_tpu/core/dispatch_cache.py",
+        "paddle_tpu/core/autograd.py",
+        "paddle_tpu/core/step_capture.py",
+    ],
     # import-layering: the declared layer DAG, base layers first; a module
     # may (module-scope) import same-or-lower layers only. Matching is by
     # most-specific prefix, so the bare package in the top layer covers

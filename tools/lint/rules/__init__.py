@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     lock_order,
     naked_retry,
     silent_swallow,
+    span_discipline,
     trace_impurity,
     unguarded_global,
 )
