@@ -46,7 +46,8 @@ import numpy as np
 SERVING_RESULT_FIELDS = (
     "benchmark", "params", "layers", "hidden", "dtype", "kv_dtype",
     "page_size", "prompt", "tokens", "single_stream_tokens_per_sec",
-    "serving", "resilience", "speedup_vs_single_stream", "device")
+    "serving", "paged_attention", "context_sweep", "resilience",
+    "speedup_vs_single_stream", "device")
 SERVING_ROW_FIELDS = (
     "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "queue_wait_ms",
     "scan_greedy_parity", "match_frac", "batch_utilization")
@@ -57,6 +58,60 @@ SERVING_ROW_FIELDS = (
 SERVING_RESILIENCE_FIELDS = (
     "rejected_queue_full", "rejected_deadline", "rejected_shed",
     "watchdog_trips", "replays")
+# the paged-attention decode tier (ISSUE 13): which tier the measured
+# steps actually ran (kernel = Pallas streaming over live pages, dense =
+# the gather-the-whole-cache debug path) plus the MODELED per-token
+# attention KV traffic of each — the structural claim of record is that
+# the live number scales with the context, the dense one with max_len
+PAGED_ATTENTION_FIELDS = (
+    "mode", "kernel_steps", "dense_steps", "attn_bytes_per_token_live",
+    "attn_bytes_per_token_dense", "suspect_reasons")
+CONTEXT_SWEEP_FIELDS = (
+    "context", "decode_tokens_per_sec", "attn_bytes_per_token_live",
+    "attn_bytes_per_token_dense")
+
+
+def _storage_bytes(kv_dtype: str, compute_dtype: str) -> int:
+    if kv_dtype == "int8":
+        return 1
+    if kv_dtype == "bf16":
+        return 2
+    return 4 if compute_dtype == "float32" else 2
+
+
+def _paged_attn_bytes_per_token(layers, heads, head_dim, max_len, page_size,
+                                storage_bytes, prompt, n_new):
+    """Modeled per-token attention KV READ traffic for one slot.
+
+    ``live``: the paged kernel streams ``ceil((t+1)/page_size)`` live
+    pages per step (K+V, every layer) — averaged over the decode steps
+    ``t = prompt .. prompt+n_new-1``, so it grows with the CONTEXT.
+    ``dense``: the legacy gather reconstructs the full stacked cache
+    every step, so it is ``max_len``-proportional regardless of context.
+    Returns ``(live, dense)`` bytes/token."""
+    page_row = layers * 2 * heads * page_size * head_dim * storage_bytes
+    dense = layers * 2 * heads * max_len * head_dim * storage_bytes
+    steps = [prompt + k for k in range(max(1, n_new))]
+    live = sum(-(-(t + 1) // page_size) * page_row for t in steps) \
+        / len(steps)
+    return int(round(live)), int(dense)
+
+
+def _paged_suspect_reasons(block, on_tpu: bool):
+    """All-dense-on-TPU disqualifies the number of record: with the
+    kernel available (mode != off) every measured decode step running the
+    dense tier means the run benchmarked the debug path — e.g. a test
+    env's PADDLE_TPU_PAGED_ATTENTION=off leaking in (the
+    _capture_suspect_reasons rule, for the serving tier)."""
+    reasons = []
+    if on_tpu and block["mode"] != "off" and block["kernel_steps"] == 0 \
+            and block["dense_steps"] > 0:
+        reasons.append(
+            "paged_attention: every decode step ran the dense gather tier "
+            "on TPU — the measured tok/s is the debug path, not the "
+            "kernel (check PADDLE_TPU_PAGED_ATTENTION and kernel "
+            "eligibility)")
+    return reasons
 
 
 def main() -> None:
@@ -79,6 +134,11 @@ def main() -> None:
     ap.add_argument("--kv-dtype", default="native",
                     choices=("native", "bf16", "int8"))
     ap.add_argument("--page-size", type=int, default=64)
+    ap.add_argument("--context-sweep", default="",
+                    help="comma list of context lengths (e.g. 512,2048,8192)"
+                         ": per-context decode tok/s through the engine "
+                         "plus the modeled live-vs-dense attention "
+                         "bytes/token (the paged-attention win of record)")
     args = ap.parse_args()
 
     import jax
@@ -367,6 +427,25 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
 
     top = rows[f"bs{max_bs}"]["aggregate_tokens_per_sec"]
     snap = obs.snapshot()
+    on_tpu = jax.devices()[0].platform != "cpu"
+    sbytes = _storage_bytes(args.kv_dtype, dtype)
+    live_b, dense_b = _paged_attn_bytes_per_token(
+        L, H, E // H, M, page_size, sbytes, args.prompt, n_new)
+    steps_by_path = snap.get("serving.paged_attention_steps_total", {}) or {}
+    from paddle_tpu.ops import paged_attention as _pa
+    paged_block = {
+        "mode": _pa.mode(),
+        "kernel_steps": int(steps_by_path.get("path=kernel", 0)),
+        "dense_steps": int(steps_by_path.get("path=dense", 0)),
+        "attn_bytes_per_token_live": live_b,
+        "attn_bytes_per_token_dense": dense_b,
+    }
+    paged_block["suspect_reasons"] = _paged_suspect_reasons(paged_block,
+                                                            on_tpu)
+    assert set(paged_block) == set(PAGED_ATTENTION_FIELDS), \
+        "paged_attention block drifted from PAGED_ATTENTION_FIELDS"
+    sweep = _context_sweep(args, serving, paddle, prefill_raw, lm_step,
+                           L=L, H=H, E=E, V=V, dtype=dtype)
     rejected = snap.get("serving.rejected_total", {}) or {}
     trips = snap.get("serving.watchdog_trips_total", {}) or {}
     fire = {
@@ -385,6 +464,8 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         "prompt": args.prompt, "tokens": n_new,
         "single_stream_tokens_per_sec": round(single_rate, 1),
         "serving": rows,
+        "paged_attention": paged_block,
+        "context_sweep": sweep,
         "resilience": fire,
         "speedup_vs_single_stream": round(top / single_rate, 2),
         "device": str(jax.devices()[0]),
@@ -395,6 +476,65 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
     if not parity_all:
         print(f"SERVING PARITY FAIL: {rows}", file=sys.stderr)
         sys.exit(1)
+    if paged_block["suspect_reasons"]:
+        # mirror bench.py's anomaly contract: the number still prints, the
+        # exit code says don't trust it as the number of record
+        print(f"PAGED SUSPECT: {paged_block['suspect_reasons']}",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+def _context_sweep(args, serving, paddle, prefill_raw, lm_step, *, L, H, E,
+                   V, dtype):
+    """Decode tok/s vs context length (``--context-sweep 512,2048,8192``):
+    one bs=1 engine drain per context, with the modeled live-vs-dense
+    attention bytes/token beside the measured rate — the long-context
+    claim of ROADMAP 3a made visible in the row of record. Each context
+    gets its own engine sized to ``context + tokens`` so max_len (and
+    with it the dense tier's traffic) GROWS with the sweep while the
+    kernel's live traffic tracks the context."""
+    if not args.context_sweep:
+        return []
+    import jax
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    contexts = sorted({int(c) for c in args.context_sweep.split(",") if c})
+    if not on_tpu:  # CPU CI smoke: keep each drain in seconds
+        contexts = sorted({min(c, 48) for c in contexts})
+    ps = args.page_size if on_tpu else min(args.page_size, 16)
+    n_new = 8
+    sbytes = _storage_bytes(args.kv_dtype, dtype)
+    rng = np.random.default_rng(1)
+    rows = []
+    for c in contexts:
+        max_len = -(-(c + n_new + 2) // ps) * ps
+        cfg = serving.ServingConfig(
+            num_layers=L, num_heads=H, head_dim=E // H, max_len=max_len,
+            max_batch=1, buckets=(1,), page_size=ps,
+            kv_dtype=args.kv_dtype, compute_dtype=dtype)
+        eng = serving.Engine(prefill_raw, lm_step, cfg)
+        prompt = rng.integers(0, V, (c,), dtype=np.int32)
+
+        def drain():
+            fut = eng.submit(serving.GenerationRequest(
+                prompt, max_new_tokens=n_new))
+            eng.run()
+            return fut.result()
+
+        drain()                              # compile pass
+        t0 = time.perf_counter()
+        drain()
+        elapsed = time.perf_counter() - t0
+        live_b, dense_b = _paged_attn_bytes_per_token(
+            L, H, E // H, max_len, ps, sbytes, c, n_new)
+        row = {"context": c,
+               "decode_tokens_per_sec": round(n_new / elapsed, 1),
+               "attn_bytes_per_token_live": live_b,
+               "attn_bytes_per_token_dense": dense_b}
+        assert set(row) == set(CONTEXT_SWEEP_FIELDS), \
+            "context sweep row drifted from CONTEXT_SWEEP_FIELDS"
+        rows.append(row)
+    return rows
 
 
 if __name__ == "__main__":

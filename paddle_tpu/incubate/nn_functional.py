@@ -468,6 +468,24 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
                          act_type)
 
 
+def _paged_mmha(x, cache):
+    """Fused-qkv decode attention over a :class:`PagedDecodeCache` view.
+
+    ``x`` is the (B, 3*H*D) fused qkv of ONE new token (fused layout ⇒
+    q heads == kv heads). Splits q/k/v, runs the paged kernel for the
+    view's layer, writes position ``t``'s K/V into its containing page,
+    and returns ``(out (B, H*D), cache')`` — the same contract the dense
+    branch serves from the stacked cache."""
+    from ..ops.manipulation import reshape
+    from ..ops.paged_attention import paged_decode_attention
+    nh, hd = cache.num_kv_heads, cache.head_dim
+    b = int(x.shape[0])
+    qkv = reshape(x, [b, 3, nh, hd])
+    q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]    # (B, H, D)
+    out, new_cache = paged_decode_attention(q, k_new, v_new, cache)
+    return reshape(out, [b, nh * hd]), new_cache
+
+
 def masked_multihead_attention(x, bias=None, src_mask=None,
                                sequence_lengths=None, rotary_tensor=None,
                                beam_cache_offset=None, cache_kv=None,
@@ -499,6 +517,22 @@ def masked_multihead_attention(x, bias=None, src_mask=None,
     x = ensure_tensor(x)
     if cache_kv is None:
         raise ValueError("masked_multihead_attention requires cache_kv")
+    from ..ops.paged_attention import PagedDecodeCache
+    if isinstance(cache_kv, PagedDecodeCache):
+        # paged-attention decode tier (ISSUE 13): the cache is a page-pool
+        # view, not the dense (2, B, H, max_len, D) buffer — attention
+        # streams the slot's live pages through the Pallas kernel and the
+        # token writes back into its containing page. ``sequence_lengths``
+        # already rides inside the view (``t``); an additive src_mask has
+        # no kernel leg (the span mask is the decode contract).
+        if src_mask is not None:
+            raise NotImplementedError(
+                "masked_multihead_attention: src_mask is not supported on "
+                "the paged-attention path (span masking to <= t is built "
+                "in; run the dense tier for additive masks)")
+        if bias is not None:
+            x = x + ensure_tensor(bias)
+        return _paged_mmha(x, cache_kv)
     cache = ensure_tensor(cache_kv)
     two, b, nh, max_len, hd = (int(s) for s in cache.shape)
     if bias is not None:

@@ -316,6 +316,141 @@ class LlamaForCausalLM(nn.Layer):
             top_k=top_k, top_p=top_p, do_sample=do_sample,
             eos_token_id=eos_token_id)
 
+    def serving_callables(self, max_len: int):
+        """``(prefill_fn, step_fn)`` over the serving engine's cache
+        contract — the bridge that lets Llama decode through
+        ``paddle_tpu.serving.Engine`` (continuous batching, paged KV)
+        instead of the per-request concat-cache ``generate`` loop.
+
+        * ``prefill_fn(ids (1, Lp), cache (L, 2, 1, H_kv, max_len, D))``
+          runs the normal full-sequence forward (flash SDPA) and packs the
+          per-layer K/V into the stacked layout at positions ``[0, Lp)``.
+        * ``step_fn(tok (B, 1), cache, t (B,))`` decodes one token per
+          slot. ``cache`` is EITHER the dense stacked cache (the debug
+          tier: write K/V at ``t``, span-masked attention) OR a
+          ``PagedDecodeCache`` view — then every layer's attention
+          streams its live pages through the paged-attention Pallas
+          kernel and writes position ``t`` into its containing page
+          (``PADDLE_TPU_PAGED_ATTENTION``; ISSUE 13). GQA stays a
+          kv-head broadcast on both tiers; RoPE gathers per-row rows at
+          each slot's own position.
+
+        Greedy (argmax) next-token, matching the engine's parity-oracle
+        contract. Wire up with ``ServingConfig(num_layers=L,
+        num_heads=num_key_value_heads, head_dim=D, max_len=max_len)`` —
+        the pool stores KV heads. The per-layer Python loop unrolls L
+        layers into the compiled step (llama layers are unshared objects;
+        the FusedMultiTransformer scan path covers the stacked-weight
+        case)."""
+        import jax
+
+        from ..ops.paged_attention import (PagedDecodeCache,
+                                           paged_decode_attention)
+
+        cfg = self.config
+        if cfg.scan_layers:
+            raise NotImplementedError(
+                "serving_callables needs the per-layer layout; rebuild "
+                "with scan_layers=False (scan_to_layered_state_dict "
+                "converts the checkpoint)")
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len {max_len} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}")
+        model = self.model
+        layers = list(model.layers)
+        nh = cfg.num_attention_heads
+        nkv = cfg.num_key_value_heads
+        hd = cfg.hidden_size // nh
+        rep = nh // nkv
+        inv_scale = 1.0 / math.sqrt(hd)
+
+        def _rope_rows(x, cos, sin, t):
+            """Rotary at PER-ROW positions: x (B, H, D), t (B,)."""
+            c = jnp.take(cos, t.astype(jnp.int32), axis=0)[:, None, :]
+            s = jnp.take(sin, t.astype(jnp.int32), axis=0)[:, None, :]
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                                   axis=-1)
+
+        def _dense_attn(i):
+            """One layer's cached decode attention on the dense stacked
+            cache (L, 2, B, H_kv, M, D): write K/V at t, span <= t."""
+            def f(qa, ka, va, ca, ta):
+                t32 = ta.astype(jnp.int32)
+                m = ca.shape[4]
+                kc, vc = ca[i, 0], ca[i, 1]          # (B, H_kv, M, D)
+                sel = jax.nn.one_hot(t32, m, dtype=jnp.bool_)[
+                    :, None, :, None]
+                kc = jnp.where(sel, ka[:, :, None, :].astype(kc.dtype), kc)
+                vc = jnp.where(sel, va[:, :, None, :].astype(vc.dtype), vc)
+                ca = ca.at[i, 0].set(kc)
+                ca = ca.at[i, 1].set(vc)
+                kr = jnp.repeat(kc, rep, axis=1) if rep > 1 else kc
+                vr = jnp.repeat(vc, rep, axis=1) if rep > 1 else vc
+                logits = jnp.einsum("bhd,bhld->bhl", qa.astype(jnp.float32),
+                                    kr.astype(jnp.float32)) * inv_scale
+                span = jnp.arange(m, dtype=jnp.int32)[None, :] <= \
+                    t32[:, None]
+                logits = jnp.where(span[:, None, :], logits, -1e30)
+                p = jax.nn.softmax(logits, axis=-1)
+                out = jnp.einsum("bhl,bhld->bhd", p,
+                                 vr.astype(jnp.float32))
+                return out.astype(qa.dtype), ca
+            return f
+
+        def step_fn(tok, cache, t):
+            paged = isinstance(cache, PagedDecodeCache)
+            b = int(tok.shape[0])
+            x = model.embed_tokens(tok)              # (B, 1, E)
+            for i, layer in enumerate(layers):
+                res = x
+                h = layer.input_layernorm(x)
+                att = layer.self_attn
+                q = reshape(att.q_proj(h), [b, nh, hd])
+                k = reshape(att.k_proj(h), [b, nkv, hd])
+                v = reshape(att.v_proj(h), [b, nkv, hd])
+                q = apply("llama_rope_rows", _rope_rows, q,
+                          model.rope_cos, model.rope_sin, t)
+                k = apply("llama_rope_rows", _rope_rows, k,
+                          model.rope_cos, model.rope_sin, t)
+                if paged:
+                    out, cache = paged_decode_attention(
+                        q, k, v, cache.at_layer(i))
+                else:
+                    out, cache = apply(f"llama_cached_attn_l{i}",
+                                       _dense_attn(i), q, k, v, cache, t)
+                x = res + att.o_proj(reshape(out, [b, 1, nh * hd]))
+                x = x + layer.mlp(layer.post_attention_layernorm(x))
+            h = model.norm(x)
+            from ..ops.reduce import argmax
+            nxt = argmax(self._logits(h), axis=-1)   # (B, 1) greedy
+            return nxt.astype("int32"), cache
+
+        def prefill_fn(ids, cache):
+            lp = int(ids.shape[1])
+            empty = jnp.zeros((1, 0, nkv, hd),
+                              model.embed_tokens.weight._data.dtype)
+            h, new_caches = model(
+                ids, caches=[(Tensor(empty), Tensor(empty))
+                             for _ in range(len(layers))])
+            from ..ops.reduce import argmax
+            nxt = argmax(self._logits(h[:, -1:]), axis=-1)
+
+            def pack(ca, *kvs):
+                for i in range(len(layers)):
+                    kt = jnp.swapaxes(kvs[2 * i], 1, 2)      # (1,Hkv,Lp,D)
+                    vt = jnp.swapaxes(kvs[2 * i + 1], 1, 2)
+                    ca = ca.at[i, 0, :, :, :lp, :].set(kt.astype(ca.dtype))
+                    ca = ca.at[i, 1, :, :, :lp, :].set(vt.astype(ca.dtype))
+                return ca
+
+            flat = [kv for pair in new_caches for kv in pair]
+            cache = apply("llama_pack_prefill", pack, cache, *flat)
+            return nxt.astype("int32"), cache
+
+        return prefill_fn, step_fn
+
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
 

@@ -1,0 +1,437 @@
+"""Paged-attention decode: a Pallas kernel that consumes the page pool +
+page tables directly — the dense stacked cache never exists in the decode
+program.
+
+Why (ROADMAP 3a): the serving decode program used to reconstruct the full
+dense ``(L, 2, B, H, max_len, D)`` cache inside the trace every step
+(``serving/kv_cache.py::gather_pages``), so per-token attention bandwidth
+scaled with ``max_len``, not with the live context. This module makes the
+decode step's KV traffic O(live pages) reads + O(1) page writes:
+
+* **Streaming kernel** (:func:`paged_attention`): one program per
+  (batch row, q head); the grid's innermost dimension walks the slot's
+  page-table row, and the ``PrefetchScalarGridSpec`` index maps resolve
+  each K/V block to ``pool[tables[b, s], layer, k/v, h // rep]`` — Pallas
+  double-buffers the page DMAs, and a repeated block index (the trailing
+  scratch-page entries of a short slot) skips the re-fetch, so HBM
+  traffic follows the LIVE page count. Online softmax (the
+  ``ops/flash_attention.py`` pattern) runs in fp32 VMEM scratch carried
+  across the page dimension; pages whose first position is ``>= t`` skip
+  compute entirely (``@pl.when``).
+* **In-kernel dequant**: the int8 leg multiplies each streamed page by
+  its per-(page, layer, K/V, head) absmax scale — the exact grid
+  ``serving/kv_cache.py::quantize_pages`` wrote — so the quantized pool
+  is never expanded outside VMEM. The bf16 leg upcasts in-register.
+* **Current token exact**: the position-``t`` K/V is passed to the kernel
+  unquantized and joins the softmax in fp32 — matching the dense path,
+  where the step writes the fresh token into the gathered cache *before*
+  attention and quantization happens only at write-back.
+* **In-place token write** (:func:`scatter_token_inplace`): K/V for
+  position ``t`` lands in the containing pool page by scatter — O(1)
+  pages per slot, no dense round-trip. The int8 leg re-quantizes the one
+  containing page under the kv_cache requantization contract (positions
+  ``> t`` masked to zero; same math as ``scatter_token_page``, sourced
+  from the pool instead of the dense cache).
+
+Tiering (the flash-SDPA / step-capture contract): the kernel is the TPU
+tier; off-TPU it runs under the Pallas interpreter when forced (tests)
+while ``auto`` keeps CPU on the existing dense-gather debug tier, which
+stays the parity reference (``PADDLE_TPU_PAGED_ATTENTION=auto|on|off``).
+:func:`paged_attention_dense` is that reference restricted to one layer —
+it gathers only the slot's pages for the layer being decoded, so even the
+debug tier of a paged program never rebuilds the L-stacked cache.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU-enabled jaxlib (always true here)
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["PagedDecodeCache", "mode", "decode_path", "kernel_eligible",
+           "paged_attention", "paged_attention_dense",
+           "scatter_token_inplace", "paged_decode_attention"]
+
+_NEG_INF = -1e30  # matches ops/flash_attention.py's mask fill
+
+_VALID_MODES = ("auto", "on", "off")
+
+
+def mode() -> str:
+    """Resolve ``PADDLE_TPU_PAGED_ATTENTION`` (default ``auto``).
+
+    ``auto`` — kernel on TPU, dense-gather debug tier on CPU (the same
+    device split as flash SDPA); ``on`` — kernel everywhere (Pallas
+    interpreter off-TPU: slow, for parity tests); ``off`` — dense tier
+    everywhere."""
+    m = os.environ.get("PADDLE_TPU_PAGED_ATTENTION", "auto").strip().lower()
+    if m in _VALID_MODES:
+        return m
+    if not m:                        # set-but-empty reads as unset
+        return "auto"
+    if m in ("0", "false", "no", "disable", "disabled"):
+        return "off"
+    if m in ("1", "true", "yes", "enable", "enabled", "kernel"):
+        return "on"
+    # a typo must not silently flip the decode tier (e.g. "dense" reading
+    # as auto -> kernel on TPU): fail like the config-field validation
+    raise ValueError(
+        f"PADDLE_TPU_PAGED_ATTENTION must be auto|on|off, got {m!r}")
+
+
+def decode_path(override: str = "") -> str:
+    """``"kernel"`` or ``"dense"`` for the current device + mode.
+
+    ``override`` (a ``ServingConfig.paged_attention`` value) wins over the
+    env knob when non-empty, mirroring the watchdog/queue-wait contract."""
+    m = (override or "").strip().lower() or mode()
+    if m not in _VALID_MODES:
+        raise ValueError(
+            f"paged_attention mode must be auto|on|off, got {m!r} "
+            "(env: PADDLE_TPU_PAGED_ATTENTION)")
+    if m == "off":
+        return "dense"
+    if m == "on":
+        return "kernel"
+    return "kernel" if jax.default_backend() not in ("cpu",) else "dense"
+
+
+def kernel_interpret() -> bool:
+    """Off-TPU the kernel runs under the Pallas interpreter (tests)."""
+    return jax.default_backend() in ("cpu",)
+
+
+def kernel_eligible(page_size: int, head_dim: int, storage_dtype) -> bool:
+    """Mosaic tiling constraints for the compiled (non-interpret) kernel:
+    the K/V block's sublane dimension is ``page_size`` (8/16/32-multiple
+    for f32/bf16/int8) and its lane dimension is ``head_dim`` (8-aligned,
+    the flash kernel's bound). Ineligible shapes stay on the per-layer
+    dense tier — correctness is never gated on tiling."""
+    dt = jnp.dtype(storage_dtype)
+    if dt == jnp.int8:
+        sublane = 32
+    elif dt.itemsize == 2:
+        sublane = 16
+    else:
+        sublane = 8
+    return page_size % sublane == 0 and head_dim % 8 == 0
+
+
+@dataclass
+class PagedDecodeCache:
+    """The traced handle that threads the page pool through a decode step
+    in place of the dense stacked cache.
+
+    The serving engine builds one per compiled decode call and passes it
+    as the ``step_fn``'s cache argument; models that understand it
+    (``FusedMultiTransformer``, ``LlamaForCausalLM.serving_callables``)
+    run their cached attention over the kernel and return an updated
+    handle. Fields are Tensors (traced inside the decode program):
+
+    * ``pool``    — ``(num_pages, L, 2, H_kv, page_size, D)`` storage dtype
+    * ``scales``  — ``(num_pages, L, 2, H_kv)`` fp32 (int8 leg only)
+    * ``tables``  — ``(B, pages_per_slot)`` int32 page-table rows
+    * ``t``       — ``(B,)`` int32 per-slot write position (the decode
+      step attends positions ``<= t`` and writes K/V at ``t``)
+    * ``layer``   — scalar int32 Tensor, set per layer by the model's
+      layer loop/scan (:meth:`at_layer`); ``None`` on the engine-level
+      handle
+    * ``impl``    — ``"kernel"`` | ``"dense"`` (the per-layer debug tier)
+    * ``interpret`` — run the kernel under the Pallas interpreter (CPU)
+    """
+
+    pool: object
+    tables: object
+    t: object
+    page_size: int
+    scales: Optional[object] = None
+    layer: Optional[object] = None
+    impl: str = "kernel"
+    interpret: bool = False
+
+    def at_layer(self, layer) -> "PagedDecodeCache":
+        return replace(self, layer=layer)
+
+    @property
+    def num_kv_heads(self) -> int:
+        return int(self.pool.shape[3])
+
+    @property
+    def head_dim(self) -> int:
+        return int(self.pool.shape[5])
+
+
+# ---------------------------------------------------------------------------
+# the streaming kernel
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(tables_ref, t_ref, layer_ref, q_ref, kn_ref, vn_ref,
+                   k_ref, v_ref, *rest, page_size: int, sm_scale: float,
+                   num_pages: int, quantized: bool):
+    """One (batch row, q head) program; grid dim 2 streams the slot's
+    page-table row. fp32 online softmax carried in VMEM scratch across
+    pages (TPU grids run sequentially, so scratch persists); the final
+    page step folds in the CURRENT token's unquantized K/V at position
+    ``t`` and writes the output block.
+
+    Refs: q/kn/vn ``(1, 1, D)``; k/v ``(1, 1, 1, 1, ps, D)`` — the page
+    the index map resolved via the prefetched table; int8 adds two
+    ``(1, 1, 1, 1)`` scale refs. Scratch: m/l ``(1, 1)``, acc ``(1, D)``.
+    """
+    rest = list(rest)
+    ks_ref = rest.pop(0) if quantized else None
+    vs_ref = rest.pop(0) if quantized else None
+    o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    s = pl.program_id(2)
+    ps = page_size
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    t = t_ref[b]
+    page_start = s * ps
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale        # (D,)
+
+    @pl.when(page_start < t)                 # live page: stream it
+    def _stream():
+        k_blk = k_ref[0, 0, 0, 0].astype(jnp.float32)     # (ps, D)
+        v_blk = v_ref[0, 0, 0, 0].astype(jnp.float32)
+        if quantized:
+            k_blk = k_blk * ks_ref[0, 0, 0, 0]
+            v_blk = v_blk * vs_ref[0, 0, 0, 0]
+        logits = jnp.dot(k_blk, q, preferred_element_type=jnp.float32)
+        pos = page_start + jax.lax.broadcasted_iota(jnp.int32, (ps,), 0)
+        logits = jnp.where(pos < t, logits, _NEG_INF)
+        m_prev = m_ref[0, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(logits))
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[0, 0] = alpha * l_ref[0, 0] + jnp.sum(p)
+        acc_ref[0, :] = alpha * acc_ref[0, :] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32)
+        m_ref[0, 0] = m_new
+
+    @pl.when(s == num_pages - 1)             # fold in position t, emit
+    def _finish():
+        kn = kn_ref[0, 0].astype(jnp.float32)
+        vn = vn_ref[0, 0].astype(jnp.float32)
+        logit_t = jnp.dot(q, kn, preferred_element_type=jnp.float32)
+        m_prev = m_ref[0, 0]
+        m_new = jnp.maximum(m_prev, logit_t)
+        p_t = jnp.exp(logit_t - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_fin = alpha * l_ref[0, 0] + p_t
+        acc = alpha * acc_ref[0, :] + p_t * vn
+        o_ref[0, 0] = (acc / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+
+
+def _kernel_call(q, k_new, v_new, pool, scales, tables, t, layer,
+                 page_size: int, interpret: bool):
+    """q ``(B, H, D)``, k/v_new ``(B, H_kv, D)``, pool
+    ``(P, L, 2, H_kv, ps, D)`` → out ``(B, H, D)`` in q.dtype. GQA via
+    ``rep = H // H_kv`` folded into the index maps (no repeat buffer)."""
+    b, h, d = q.shape
+    h_kv = pool.shape[3]
+    rep = h // h_kv
+    s = tables.shape[1]
+    ps = page_size
+    quantized = scales is not None
+    sm_scale = 1.0 / float(d) ** 0.5
+    kern = functools.partial(_decode_kernel, page_size=ps,
+                             sm_scale=sm_scale, num_pages=s,
+                             quantized=quantized)
+
+    def q_map(bi, hi, si, tabs, tt, lr):
+        return (bi, hi, 0)
+
+    def kvn_map(bi, hi, si, tabs, tt, lr):
+        return (bi, hi // rep, 0)
+
+    def page_map(kv):
+        def f(bi, hi, si, tabs, tt, lr):
+            return (tabs[bi, si], lr[0], kv, hi // rep, 0, 0)
+        return f
+
+    def scale_map(kv):
+        def f(bi, hi, si, tabs, tt, lr):
+            return (tabs[bi, si], lr[0], kv, hi // rep)
+        return f
+
+    in_specs = [
+        pl.BlockSpec((1, 1, d), q_map),
+        pl.BlockSpec((1, 1, d), kvn_map),
+        pl.BlockSpec((1, 1, d), kvn_map),
+        pl.BlockSpec((1, 1, 1, 1, ps, d), page_map(0)),
+        pl.BlockSpec((1, 1, 1, 1, ps, d), page_map(1)),
+    ]
+    inputs = [q, k_new, v_new, pool, pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1, 1, 1), scale_map(0)),
+                     pl.BlockSpec((1, 1, 1, 1), scale_map(1))]
+        inputs += [scales, scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, h, s),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running denominator
+            pltpu.VMEM((1, d), jnp.float32),   # weighted-V accumulator
+        ],
+    )
+    layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), t.astype(jnp.int32), layer_arr, *inputs)
+
+
+# ---------------------------------------------------------------------------
+# the per-layer dense tier (debug / parity reference / ineligible shapes)
+# ---------------------------------------------------------------------------
+
+def paged_attention_dense(q, k_new, v_new, pool, scales, tables, t, layer,
+                          page_size: int):
+    """Reference math for one layer: gather the slot's pages FOR THE
+    DECODED LAYER ONLY (a flat ``(page, layer)`` take — the L-stacked
+    dense cache still never exists), insert the current token, span-mask
+    to ``<= t``, softmax. The kernel is pinned against this."""
+    p_, l_, _, h_kv, ps, d = pool.shape
+    b, s = tables.shape
+    m = s * ps
+    rep = q.shape[1] // h_kv
+    idx = tables.astype(jnp.int32) * l_ + jnp.asarray(layer, jnp.int32)
+    taken = jnp.take(pool.reshape(p_ * l_, 2, h_kv, ps, d), idx, axis=0)
+    taken = taken.astype(jnp.float32)
+    if scales is not None:
+        sc = jnp.take(scales.reshape(p_ * l_, 2, h_kv), idx, axis=0)
+        taken = taken * sc[..., None, None]
+    # (B, S, 2, H_kv, ps, D) -> k/v (B, H_kv, M, D)
+    k = taken[:, :, 0].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, m, d)
+    v = taken[:, :, 1].transpose(0, 2, 1, 3, 4).reshape(b, h_kv, m, d)
+    t32 = t.astype(jnp.int32)
+    onehot = jax.nn.one_hot(t32, m, dtype=jnp.bool_)[:, None, :, None]
+    k = jnp.where(onehot, k_new.astype(jnp.float32)[:, :, None, :], k)
+    v = jnp.where(onehot, v_new.astype(jnp.float32)[:, :, None, :], v)
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    qf = q.astype(jnp.float32)
+    logits = jnp.einsum("bhd,bhld->bhl", qf, k) / float(d) ** 0.5
+    span = jnp.arange(m, dtype=jnp.int32)[None, :] <= t32[:, None]
+    logits = jnp.where(span[:, None, :], logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhl,bhld->bhd", p, v).astype(q.dtype)
+
+
+def paged_attention(q, k_new, v_new, pool, scales, tables, t, layer, *,
+                    page_size: int, impl: str = "kernel",
+                    interpret: bool = False):
+    """Decode attention for one layer over the page pool. Dispatches the
+    streaming kernel or the per-layer dense tier; the compiled TPU kernel
+    additionally requires :func:`kernel_eligible` tiling (interpret mode
+    has no tiling constraints)."""
+    if impl == "kernel" and (interpret or kernel_eligible(
+            page_size, int(pool.shape[-1]), pool.dtype)):
+        return _kernel_call(q, k_new, v_new, pool, scales, tables, t,
+                            layer, page_size, interpret)
+    return paged_attention_dense(q, k_new, v_new, pool, scales, tables, t,
+                                 layer, page_size)
+
+
+# ---------------------------------------------------------------------------
+# the in-place token write
+# ---------------------------------------------------------------------------
+
+def scatter_token_inplace(pool, scales, tables, t, layer, k_new, v_new,
+                          page_size: int):
+    """Write position ``t``'s K/V into the containing pool page for one
+    layer — no dense round-trip. Returns ``(pool', scales')``.
+
+    bf16/native: a single-position scatter (O(1) rows per slot). int8:
+    the kv_cache requantization contract — the containing page is
+    gathered, dequantized under its old scale, the token inserted,
+    positions ``> t`` zeroed, and the page re-quantized — the exact math
+    of ``scatter_token_page``, sourced from the pool."""
+    ps = page_size
+    t32 = t.astype(jnp.int32)
+    l32 = jnp.asarray(layer, jnp.int32)
+    pids = jnp.take_along_axis(tables.astype(jnp.int32),
+                               (t32 // ps)[:, None], axis=1)[:, 0]  # (B,)
+    off = t32 % ps
+    kv_new = jnp.stack([k_new, v_new], axis=1)          # (B, 2, H_kv, D)
+    if scales is None:
+        return pool.at[pids, l32, :, :, off, :].set(
+            kv_new.astype(pool.dtype)), None
+    from ..serving.kv_cache import quantize_pages
+    p_, l_ = pool.shape[0], pool.shape[1]
+    flat_idx = pids * l_ + l32
+    page = jnp.take(pool.reshape((p_ * l_,) + pool.shape[2:]), flat_idx,
+                    axis=0).astype(jnp.float32)          # (B, 2, H, ps, D)
+    old_sc = jnp.take(scales.reshape(p_ * l_, *scales.shape[2:]), flat_idx,
+                      axis=0)                            # (B, 2, H)
+    page = page * old_sc[..., None, None]
+    sel = jax.nn.one_hot(off, ps, dtype=jnp.bool_)[:, None, None, :, None]
+    page = jnp.where(sel, kv_new.astype(jnp.float32)[..., None, :], page)
+    pos = (t32 // ps * ps)[:, None] + jnp.arange(ps, dtype=jnp.int32)[None]
+    valid = pos <= t32[:, None]                          # (B, ps)
+    page = jnp.where(valid[:, None, None, :, None], page, 0.0)
+    q8, sc = quantize_pages(page)                        # (B,2,H,ps,D)/(B,2,H)
+    return (pool.at[pids, l32].set(q8.astype(pool.dtype)),
+            scales.at[pids, l32].set(sc))
+
+
+# ---------------------------------------------------------------------------
+# Tensor-level surface (the op models call)
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention(q, k_new, v_new, cache: PagedDecodeCache):
+    """One layer's cached decode attention over the paged pool.
+
+    ``q`` ``(B, H, D)``, ``k_new``/``v_new`` ``(B, H_kv, D)`` Tensors (the
+    CURRENT token's projections, attended unquantized at position ``t``);
+    ``cache`` must carry a ``layer``. Returns ``(out (B, H, D) Tensor,
+    cache')`` with the token written into the pool — the decode-step
+    sequence the dense path got from gather → step → scatter, now
+    page-local."""
+    from ..core.tensor import apply
+    from ._helpers import ensure_tensor
+    if cache.layer is None:
+        raise ValueError("paged_decode_attention: cache.layer is unset — "
+                         "derive a per-layer view with cache.at_layer(i)")
+    q, k_new, v_new = (ensure_tensor(x) for x in (q, k_new, v_new))
+    layer_t = ensure_tensor(cache.layer).astype("int32")
+    quantized = cache.scales is not None
+    ps, impl, interpret = cache.page_size, cache.impl, cache.interpret
+
+    def f(qa, kna, vna, pool, tables, t, layer, *maybe_scales):
+        sc = maybe_scales[0] if quantized else None
+        out = paged_attention(qa, kna, vna, pool, sc, tables, t, layer,
+                              page_size=ps, impl=impl, interpret=interpret)
+        pool2, sc2 = scatter_token_inplace(pool, sc, tables, t, layer,
+                                           kna, vna, page_size=ps)
+        return (out, pool2) + ((sc2,) if quantized else ())
+
+    args = [q, k_new, v_new, cache.pool, cache.tables, cache.t,
+            layer_t] + ([cache.scales] if quantized else [])
+    outs = apply("paged_attention_decode", f, *args, differentiable=False,
+                 amp=False)
+    new_cache = replace(cache, pool=outs[1],
+                        scales=outs[2] if quantized else None)
+    return outs[0], new_cache

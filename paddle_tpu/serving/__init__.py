@@ -10,7 +10,12 @@ package multiplies the missing factor. Three pieces:
 * :mod:`~paddle_tpu.serving.kv_cache` — a slot-paged KV cache: a
   preallocated page pool, per-slot page tables, and an int8 leg with
   per-page absmax scales (``PADDLE_TPU_KV_DTYPE=bf16|int8``), reusing
-  the q8 absmax grid the optimizer state already uses.
+  the q8 absmax grid the optimizer state already uses. On the
+  paged-attention kernel tier (``PADDLE_TPU_PAGED_ATTENTION``, ISSUE 13)
+  the decode step consumes the pool DIRECTLY through a
+  :class:`PagedDecodeCache` view — live pages stream through the Pallas
+  kernel in ``ops/paged_attention.py`` and the dense stacked cache never
+  exists in the decode program.
 * :mod:`~paddle_tpu.serving.scheduler` — the bounded request queue and
   iteration-level admission policies (FIFO, prefill-token budget).
 * :mod:`~paddle_tpu.serving.engine` — the step loop: one compiled
@@ -40,7 +45,8 @@ Quick start (see README "Serving")::
     print(fut.result().tokens)
 """
 
-from .kv_cache import KVCacheConfig, PagedKVCache  # noqa: F401
+from .kv_cache import (KVCacheConfig, PagedDecodeCache,  # noqa: F401
+                       PagedKVCache)
 from .scheduler import (DeadlineExceeded, GenerationRequest,  # noqa: F401
                         GenerationResult, QueueFull, Scheduler)
 from .engine import (DrainTimeout, Engine, EngineStopped,  # noqa: F401
@@ -48,7 +54,7 @@ from .engine import (DrainTimeout, Engine, EngineStopped,  # noqa: F401
 from .watchdog import StepWatchdog, WatchdogTimeout  # noqa: F401
 
 __all__ = [
-    "KVCacheConfig", "PagedKVCache",
+    "KVCacheConfig", "PagedKVCache", "PagedDecodeCache",
     "GenerationRequest", "GenerationResult", "QueueFull", "Scheduler",
     "DeadlineExceeded", "Engine", "ServingConfig",
     "EngineStopped", "DrainTimeout", "StepWatchdog", "WatchdogTimeout",

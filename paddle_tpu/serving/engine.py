@@ -71,7 +71,9 @@ queue time bounded; an admitted request's deadline becomes the ambient
 step it joins, so nested retry policies inherit the same budget.
 
 Metrics: ``serving.requests_total{status}``, ``serving.tokens_total``,
-``serving.steps_total``, ``serving.prefills_total``,
+``serving.steps_total``,
+``serving.paged_attention_steps_total{path=kernel|dense}`` (which decode
+tier ran — ISSUE 13), ``serving.prefills_total``,
 ``serving.step_retries_total``, ``serving.rejected_total{reason}``,
 ``serving.watchdog_trips_total{kind}``, ``serving.replays_total``,
 ``serving.queue_depth``, ``serving.active_slots``,
@@ -193,6 +195,13 @@ class ServingConfig:
     # hard cap on queue wait; None -> $PADDLE_TPU_SERVING_MAX_QUEUE_WAIT
     # (0/absent = unbounded). Pass 0 to force off regardless of env.
     max_queue_wait_s: Optional[float] = None
+    # paged-attention decode tier (ISSUE 13): "" -> the
+    # $PADDLE_TPU_PAGED_ATTENTION env knob (default auto). auto = Pallas
+    # kernel on TPU / dense-gather debug tier on CPU; on = kernel
+    # everywhere (Pallas interpreter off-TPU — parity tests); off = the
+    # dense tier everywhere. The config field wins when set, the
+    # watchdog/queue-wait contract.
+    paged_attention: str = ""
 
     def __post_init__(self):
         self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))
@@ -219,6 +228,14 @@ class ServingConfig:
                 "PADDLE_TPU_SERVING_MAX_QUEUE_WAIT")
         elif self.max_queue_wait_s <= 0:
             self.max_queue_wait_s = None
+        from ..ops import paged_attention as _pa
+        if not self.paged_attention:
+            self.paged_attention = _pa.mode()
+        self.paged_attention = self.paged_attention.strip().lower()
+        if self.paged_attention not in ("auto", "on", "off"):
+            raise ValueError(
+                f"paged_attention must be auto|on|off, got "
+                f"{self.paged_attention!r} (env: PADDLE_TPU_PAGED_ATTENTION)")
 
     def kv_config(self) -> _kv.KVCacheConfig:
         cfg = _kv.KVCacheConfig(
@@ -306,6 +323,7 @@ class Engine:
         from ..core.tensor import Tensor as _T, apply as _apply
         from ..core.tracing import no_grad
         from ..jit import to_static
+        from ..ops import paged_attention as _pa
 
         cfg = self.kv.config
         ps = cfg.page_size
@@ -314,6 +332,27 @@ class Engine:
         step_fn, prefill_fn = self._step_fn, self._prefill_fn
         L, H, M, D = (cfg.num_layers, cfg.num_heads, cfg.max_len,
                       cfg.head_dim)
+        # ISSUE 13: which decode program this engine compiles — "kernel"
+        # hands step_fn a PagedDecodeCache view (the dense stacked cache
+        # never exists in the program), "dense" keeps the PR 7
+        # gather -> step -> scatter debug tier (and stays the default on
+        # CPU under auto, where the toy/test callables consume the dense
+        # layout)
+        self._paged_path = _pa.decode_path(self.config.paged_attention)
+        paged_interpret = _pa.kernel_interpret()
+        if self._paged_path == "kernel" and not paged_interpret and \
+                not _pa.kernel_eligible(ps, D, cfg.storage_dtype):
+            # Mosaic tiling can't serve this shape: demote the WHOLE
+            # engine to the dense tier rather than silently running the
+            # per-layer fallback under a path=kernel label — the metric
+            # (and the bench's all-dense-on-TPU suspect rule) must tell
+            # the truth about which tier the measured steps ran
+            _log.warning(
+                "paged-attention kernel ineligible for page_size=%d "
+                "head_dim=%d kv storage %s (tiling floors: see "
+                "ops.paged_attention.kernel_eligible) — serving on the "
+                "dense decode tier", ps, D, cfg.storage_dtype)
+            self._paged_path = "dense"
 
         def decode_fn(tok_a, tables_a, t_a, pool_a, *maybe_scales):
             sc = maybe_scales[0] if quantized else None
@@ -325,6 +364,25 @@ class Engine:
                 tables_a, t_a, ps)
             out = (nxt._data.astype(jnp.int32), pool2)
             return out + ((sc2,) if quantized else ())
+
+        def paged_decode_fn(tok_a, tables_a, t_a, pool_a, *maybe_scales):
+            # same program signature as decode_fn (one compiled call per
+            # bucket; pool/scales thread through as functional state), but
+            # the cache argument is the page-pool VIEW: the step's
+            # attention streams live pages through the Pallas kernel and
+            # writes position t's K/V into its containing page in place
+            sc = maybe_scales[0] if quantized else None
+            view = _pa.PagedDecodeCache(
+                pool=_T(pool_a), tables=_T(tables_a), t=_T(t_a),
+                page_size=ps, scales=_T(sc) if quantized else None,
+                impl="kernel", interpret=paged_interpret)
+            with no_grad():
+                nxt, view2 = step_fn(_T(tok_a), view, _T(t_a))
+            out = (nxt._data.astype(jnp.int32), view2.pool._data)
+            return out + ((view2.scales._data,) if quantized else ())
+
+        if self._paged_path == "kernel":
+            decode_fn = paged_decode_fn
 
         def prefill_body(ids_a, row_a, len_a, pool_a, *maybe_scales):
             sc = maybe_scales[0] if quantized else None
@@ -864,6 +922,10 @@ class Engine:
         next_np = np.asarray(outs[0]._data)        # the ONE host sync
         now = time.monotonic()
         _obs.inc("serving.steps_total")
+        # which decode tier actually ran (ISSUE 13): the bench's
+        # all-dense-on-TPU suspect rule reads this split
+        _obs.inc("serving.paged_attention_steps_total",
+                 path=self._paged_path)
         traced = _trace.enabled()
         for i, slot in enumerate(included):
             slot.t += 1

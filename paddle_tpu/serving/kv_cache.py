@@ -47,8 +47,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["KVCacheConfig", "PagedKVCache", "gather_pages",
-           "scatter_token_page", "scatter_prefill_pages", "quantize_pages"]
+from ..ops.paged_attention import PagedDecodeCache  # noqa: F401  (re-export:
+# the paged-attention decode tier threads the pool through the step as this
+# handle instead of gathering the dense cache — see ops/paged_attention.py)
+
+__all__ = ["KVCacheConfig", "PagedKVCache", "PagedDecodeCache",
+           "gather_pages", "scatter_token_page", "scatter_prefill_pages",
+           "quantize_pages"]
 
 _Q8_MAX = 127.0  # symmetric absmax grid, same rule as the q8 optimizer state
 
@@ -115,15 +120,25 @@ def gather_pages(pool: jnp.ndarray, scales: Optional[jnp.ndarray],
 
     ``tables`` is ``(B, pages_per_slot)`` int32. Rows gathered through
     scratch entries carry garbage at positions the attention span mask
-    (``masked_multihead_attention``: span ``<= t``) never admits."""
+    (``masked_multihead_attention``: span ``<= t``) never admits.
+
+    Casts are conditional: the int8 leg dequantizes the gathered rows
+    directly into ``compute_dtype`` (one multiply, no fp32 detour when
+    compute is bf16), and the storage legs convert only when storage
+    dtype differs from compute dtype — on the bf16/bf16 and native legs
+    the gather emits the storage bytes untouched."""
+    compute_dtype = jnp.dtype(compute_dtype)
     taken = jnp.take(pool, tables, axis=0)          # (B, S, L, 2, H, ps, D)
     if scales is not None:
         sc = jnp.take(scales, tables, axis=0)       # (B, S, L, 2, H)
-        taken = taken.astype(jnp.float32) * sc[..., None, None]
+        taken = taken.astype(compute_dtype) * \
+            sc[..., None, None].astype(compute_dtype)
     b, s, l, two, h, ps, d = taken.shape
     dense = taken.transpose(2, 3, 0, 4, 1, 5, 6)    # (L, 2, B, H, S, ps, D)
     dense = dense.reshape(l, two, b, h, s * ps, d)
-    return dense.astype(compute_dtype)
+    if dense.dtype != compute_dtype:
+        dense = dense.astype(compute_dtype)
+    return dense
 
 
 def scatter_token_page(dense: jnp.ndarray, pool: jnp.ndarray,

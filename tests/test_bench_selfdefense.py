@@ -369,3 +369,60 @@ def test_serving_bench_wired_into_main():
     src = inspect.getsource(mod.main)
     assert "--serving" in src and "_run_serving" in src
     assert "--kv-dtype" in src        # the int8 leg is reachable from CLI
+    assert "--context-sweep" in src   # the long-context leg (ISSUE 13)
+
+
+# ---------------------------------------------------------------------------
+# paged-attention block + context sweep (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def test_paged_attention_block_schema():
+    mod = _load_bench_generation()
+    assert set(mod.PAGED_ATTENTION_FIELDS) == {
+        "mode", "kernel_steps", "dense_steps", "attn_bytes_per_token_live",
+        "attn_bytes_per_token_dense", "suspect_reasons"}
+    assert set(mod.CONTEXT_SWEEP_FIELDS) == {
+        "context", "decode_tokens_per_sec", "attn_bytes_per_token_live",
+        "attn_bytes_per_token_dense"}
+    # the paged block lands in the payload of record
+    assert "paged_attention" in mod.SERVING_RESULT_FIELDS
+    assert "context_sweep" in mod.SERVING_RESULT_FIELDS
+    import inspect
+    src = inspect.getsource(mod._run_serving)
+    assert "PAGED_ATTENTION_FIELDS" in src and "_paged_suspect_reasons" \
+        in src
+
+
+def test_paged_bytes_model_tracks_live_pages_not_max_len():
+    # the acceptance claim in miniature: the modeled kernel traffic grows
+    # with the CONTEXT, the dense traffic with max_len — at a short
+    # context in a long cache the two must diverge by ~max_len/context
+    mod = _load_bench_generation()
+    kw = dict(layers=2, heads=4, head_dim=64, page_size=64,
+              storage_bytes=2, n_new=8)
+    live_short, dense_short = mod._paged_attn_bytes_per_token(
+        max_len=8192, prompt=256, **kw)
+    live_long, dense_long = mod._paged_attn_bytes_per_token(
+        max_len=8192, prompt=4096, **kw)
+    assert dense_short == dense_long          # max_len-bound, context-blind
+    assert live_long > live_short * 10        # context-bound
+    assert live_short < dense_short / 10      # the short-context win
+    # at full context the kernel converges to the dense bound, never above
+    live_full, dense_full = mod._paged_attn_bytes_per_token(
+        max_len=8192, prompt=8192 - 9, **kw)
+    assert live_full <= dense_full
+
+
+def test_all_dense_on_tpu_is_suspect():
+    mod = _load_bench_generation()
+    block = {"mode": "auto", "kernel_steps": 0, "dense_steps": 40,
+             "attn_bytes_per_token_live": 1, "attn_bytes_per_token_dense": 2}
+    reasons = mod._paged_suspect_reasons(block, on_tpu=True)
+    assert reasons and "dense" in reasons[0]
+    # the same counters are healthy on CPU (auto = dense tier there), when
+    # the kernel actually ran, and when the operator forced mode=off
+    assert mod._paged_suspect_reasons(block, on_tpu=False) == []
+    assert mod._paged_suspect_reasons(
+        dict(block, kernel_steps=40, dense_steps=0), on_tpu=True) == []
+    assert mod._paged_suspect_reasons(
+        dict(block, mode="off"), on_tpu=True) == []

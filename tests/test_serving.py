@@ -819,10 +819,13 @@ class TestWatchdogRecovery:
         the full transcripts stay bit-identical, no future strands, the
         pool free-list returns to full."""
         # budget generous vs CPU scheduling noise (a GC pause must not
-        # look hung), delay 4x the budget so the trip is unambiguous
+        # look hung), delay 4x the budget so the trip is unambiguous;
+        # warmup precompiles the decode buckets so a cold compile (no
+        # shared disk cache since the conftest change) cannot read as a
+        # phantom hung step
         sched = faults.FaultSchedule().delay("serving.watchdog", on=(2,),
                                              seconds=1.0)
-        eng = make_engine(watchdog_s=0.25, max_replays=1)
+        eng = make_engine(watchdog_s=0.25, max_replays=1).warmup()
         with faults.installed(sched):
             futs = [eng.submit(serving.GenerationRequest(
                 p, max_new_tokens=4)) for p in PROMPTS[:2]]

@@ -65,8 +65,13 @@ def _chaos_schedule(seed: int) -> faults.FaultSchedule:
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
 def test_chaos_sweep_invariants(seed, metrics):
     sched = _chaos_schedule(seed)
+    # warmup() precompiles every decode bucket BEFORE the fault window:
+    # with no persistent compile cache (conftest stopped sharing one — it
+    # was unsound on CPU), a cold decode-program compile inside the run
+    # would trip the 0.2 s watchdog as a phantom hung step and distort
+    # the seeded accounting the invariants below pin
     eng = make_engine(max_batch=4, watchdog_s=0.2, max_replays=2,
-                      max_queue=16)
+                      max_queue=16).warmup()
     n_new = [4, 3, 5, 4, 3]
     futs = []
     with faults.installed(sched):
@@ -153,8 +158,10 @@ def test_chaos_sweep_trace_invariants(seed, metrics, tracing, tmp_path):
     import json
     import os
     sched = _chaos_schedule(seed)
+    # warmup before the fault window: a cold decode compile would trip
+    # the watchdog as a phantom hung step (see test_chaos_sweep_invariants)
     eng = make_engine(max_batch=4, watchdog_s=0.2, max_replays=2,
-                      max_queue=16)
+                      max_queue=16).warmup()
     n_new = [4, 3, 5, 4, 3]
     reqs, futs = [], []
     with faults.installed(sched):

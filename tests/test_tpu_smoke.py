@@ -108,12 +108,15 @@ _OPS = _load_ops()
 # + synthetic families for compiled SUBSYSTEM paths that no single ops.yaml
 # entry covers: the serving engine's paged gather->step->scatter decode
 # program is its own lowering surface (dynamic_slice/scatter over the page
-# pool fused with the decode step), and the online-shutdown contract
+# pool fused with the decode step), the online-shutdown contract
 # (stop(drain=True) against a live step loop) exercises the compiled path
 # from a background thread — host-sync + device-buffer lifetime behavior
-# the offline run() drain cannot see
+# the offline run() drain cannot see — and the paged-attention Pallas
+# decode kernel (ISSUE 13) has its own Mosaic lowering (scalar-prefetch
+# page streaming + in-kernel int8 dequant) that only a real chip compiles
 FAMILIES = sorted({family_of(o["op"], o["module"], o["arity"])
-                   for o in _OPS} | {"serving_decode", "serving_drain"})
+                   for o in _OPS}
+                  | {"serving_decode", "serving_drain", "paged_attention"})
 
 
 def _t(data, dtype="float32", stop_gradient=True):
@@ -414,6 +417,40 @@ def _smoke_serving_drain():
     assert eng.active_requests == 0 and eng.queue_depth == 0
 
 
+def _smoke_paged_attention():
+    # the paged-attention decode kernel COMPILED (not interpreted) on the
+    # real chip, pinned against the per-layer dense reference on both kv
+    # storage legs — bf16 near-ulp, int8 bit-identical dequant grid
+    import jax.numpy as jnp
+    from paddle_tpu.ops import paged_attention as pa
+    from paddle_tpu.serving.kv_cache import quantize_pages
+
+    rng = np.random.default_rng(0)
+    B, H, D, ps, S, L = 2, 2, 128, 32, 3, 2
+    P = 8
+    assert pa.kernel_eligible(ps, D, jnp.bfloat16)
+    assert pa.kernel_eligible(ps, D, jnp.int8)
+    poolf = jnp.asarray(rng.standard_normal((P, L, 2, H, ps, D)),
+                        jnp.float32)
+    tables = jnp.asarray([[1, 2, 3], [4, 5, 0]], jnp.int32)
+    t = jnp.asarray([2 * ps + 5, ps - 1], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    layer = jnp.asarray(1, jnp.int32)
+    q8, sc = quantize_pages(poolf)
+    for pool, scales, tol in ((poolf.astype(jnp.bfloat16), None, 2e-2),
+                              (q8, sc, 2e-2)):
+        got = pa.paged_attention(q, kn, vn, pool, scales, tables, t,
+                                 layer, page_size=ps, impl="kernel",
+                                 interpret=False)
+        want = pa.paged_attention_dense(q, kn, vn, pool, scales, tables,
+                                        t, layer, page_size=ps)
+        err = float(np.abs(np.asarray(got, np.float32)
+                           - np.asarray(want, np.float32)).max())
+        assert err <= tol, (pool.dtype, err)
+
+
 def _smoke_strided():
     import paddle_tpu as paddle
     t = _t(np.arange(12, dtype="float32").reshape(3, 4))
@@ -442,7 +479,7 @@ def test_smoke_covers_every_family():
     assert not missing, (
         f"op families with no on-chip smoke test: {missing} — add a "
         f"_smoke_<family>() fn to tests/test_tpu_smoke.py")
-    assert len(FAMILIES) >= 25, FAMILIES
+    assert len(FAMILIES) >= 26, FAMILIES
 
 
 @pytest.mark.tpu

@@ -167,6 +167,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         # eager-tier loss read lives behind the bypass seam and is
         # baselined as the debug semantics)
         "paddle_tpu/core/step_capture.py::__call__",
+        # ISSUE 13: the paged-attention decode entry — the kernel launch
+        # is pure-functional; a host sync reachable from here would stall
+        # every serving decode STEP (per token, per layer)
+        "paddle_tpu/ops/paged_attention.py::paged_decode_attention",
     ],
     # span-discipline (ISSUE 12): the tracing implementation module (the
     # one place manual event emission is legal), and the fast-path modules
