@@ -23,10 +23,12 @@ module provides:
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from ... import observability as _obs
 from ...core.tensor import Tensor
 from ...nn.layer import Layer
 from ...ops.manipulation import split as split_op
@@ -87,16 +89,38 @@ class PipelineLayer(Layer):
                 built.append((d.build_layer(), None))
             else:
                 built.append((d, None))
-        self._stage_bounds = self._segment(len(built), num_stages, seg_method)
+        self._stage_bounds = self._segment(built, num_stages, seg_method)
         from ...nn.container import LayerList
         self.run_function = LayerList([l for l, _ in built if isinstance(l, Layer)])
         self._entries = built
 
     @staticmethod
-    def _segment(n_layers: int, n_stages: int, method: str) -> List[int]:
+    def _segment(built: List[Any], n_stages: int, method: str) -> List[int]:
+        n_layers = len(built)
         if method.startswith("layer:"):
-            # paddle: split at layers whose class name matches
-            return list(np.linspace(0, n_layers, n_stages + 1, dtype=int))
+            # upstream parity: stages split AT the named block class —
+            # every stage starts on a Name block (stage 0 additionally
+            # owns the embedding-side prefix, the last runs to the end)
+            name = method.split(":", 1)[1]
+            idxs = [i for i, (layer, _f) in enumerate(built)
+                    if type(layer).__name__ == name]
+            if len(idxs) >= n_stages:
+                starts = [idxs[round(k * len(idxs) / n_stages)]
+                          for k in range(n_stages)]
+                starts[0] = 0
+                return starts + [n_layers]
+            # fewer named blocks than stages: upstream's placement
+            # contract cannot be honored — WARN + count instead of
+            # silently handing back even cuts that ignore the named
+            # blocks entirely (ADVICE r5; MIGRATING "seg_method
+            # semantics" documents the actual placement contract)
+            _obs.inc("pipeline.seg_method_fallbacks_total")
+            warnings.warn(
+                f"PipelineLayer: seg_method={method!r} found only "
+                f"{len(idxs)} {name!r} block(s) but {n_stages} pipeline "
+                f"stages need at least one each; falling back to "
+                f"count-balanced stage cuts (upstream would split at "
+                f"the named blocks)")
         base = n_layers // n_stages
         extra = n_layers % n_stages
         bounds = [0]

@@ -30,7 +30,8 @@ from .. import observability as _obs
 from .. import resilience as _resil
 from ..resilience import faults as _faults
 
-__all__ = ["PsClient", "serve_stats", "reset_server_state", "SparseTable"]
+__all__ = ["PsClient", "PushSparseError", "serve_stats",
+           "reset_server_state", "SparseTable"]
 
 _log = logging.getLogger(__name__)
 
@@ -227,6 +228,25 @@ class SparseTable:
             self.last_seen[fid] = int(z["last_seen"][i])
 
 
+class PushSparseError(RuntimeError):
+    """A logical ``push_sparse`` failed at one shard after EARLIER shards
+    may already have applied their slice (ADVICE r5's partial-failure
+    window). Carries the logical push's ``seq``: retry with
+    ``push_sparse(..., seq=err.seq)`` and the shards that already applied
+    recognize the duplicate server-side (their per-shard dedup stream saw
+    this seq) while the failed shard applies it for the first time — the
+    retry is idempotent instead of double-applying.
+
+    Retry BEFORE issuing further pushes from this client: a later push
+    advances every shard's watermark past ``seq`` and the retry would be
+    discarded as a duplicate (a silent drop)."""
+
+    def __init__(self, message: str, seq: int, failed_shard: int):
+        super().__init__(message)
+        self.seq = seq
+        self.failed_shard = failed_shard
+
+
 # ---------------------------------------------------------------------------
 # server-side state (lives in the PS SERVER process; reached via rpc)
 # ---------------------------------------------------------------------------
@@ -241,7 +261,7 @@ _SPARSE_CFG: Dict[str, Dict[str, Any]] = {}
 _PUSH_SEQ: Dict[str, int] = {}
 _LOCK = threading.Lock()
 _STATS = {"pushes": 0, "pulls": 0, "creates": 0, "evictions": 0,
-          "dup_pushes": 0}
+          "dup_pushes": 0, "load_skipped": 0}
 
 
 def reset_server_state() -> None:
@@ -251,7 +271,7 @@ def reset_server_state() -> None:
         _SPARSE_CFG.clear()
         _PUSH_SEQ.clear()
         _STATS.update(pushes=0, pulls=0, creates=0, evictions=0,
-                      dup_pushes=0)
+                      dup_pushes=0, load_skipped=0)
 
 
 def _srv_create(name: str, value_bytes: bytes, shape: Tuple[int, ...],
@@ -397,16 +417,24 @@ def _srv_save(dirname: str) -> List[str]:
 
 
 def _srv_load(dirname: str) -> List[str]:
-    """Restore a `_srv_save` snapshot (server-restart recovery)."""
+    """Restore a `_srv_save` snapshot (server-restart recovery).
+
+    A sparse ``.npz`` with no matching entry in ``sparse_cfg.json`` (file
+    missing, or table absent from it) is SKIPPED with a loud error — it
+    used to be restored with a guessed ``{"dim": 1}`` config, so the
+    wrong dim/accessor/lr only surfaced later as an opaque numpy
+    broadcast error on the first pull (ADVICE r5). The failure now
+    surfaces at load, where the operator can still fix the snapshot."""
     import json
     loaded = []
     with _LOCK:
         cfg_path = os.path.join(dirname, "sparse_cfg.json")
         cfgs = {}
-        if os.path.exists(cfg_path):
+        have_cfg_file = os.path.exists(cfg_path)
+        if have_cfg_file:
             with open(cfg_path) as f:
                 cfgs = json.load(f)
-        for fn in os.listdir(dirname):
+        for fn in sorted(os.listdir(dirname)):
             path = os.path.join(dirname, fn)
             if fn.startswith("dense_") and fn.endswith(".npy"):
                 name = fn[len("dense_"):-len(".npy")]
@@ -414,7 +442,20 @@ def _srv_load(dirname: str) -> List[str]:
                 loaded.append(name)
             elif fn.startswith("sparse_") and fn.endswith(".npz"):
                 name = fn[len("sparse_"):-len(".npz")]
-                cfg = cfgs.get(name, {"dim": 1})
+                if name not in cfgs:
+                    _STATS["load_skipped"] = \
+                        _STATS.get("load_skipped", 0) + 1
+                    _log.error(
+                        "ps: snapshot %s has no entry for table %r in "
+                        "sparse_cfg.json (%s) — SKIPPING the table "
+                        "instead of guessing its dim/accessor/lr; "
+                        "restore the config file (or re-snapshot with "
+                        "_srv_save) and reload",
+                        dirname, name,
+                        "file missing" if not have_cfg_file
+                        else "table absent")
+                    continue
+                cfg = dict(cfgs[name])
                 # json stringifies the slot keys; restore int slots
                 if "slot_params" in cfg:
                     cfg["slot_params"] = {int(k): v for k, v in
@@ -463,6 +504,14 @@ class PsClient:
         self._client_key = uuid.uuid4().hex
         self._seq = 0
         self._seq_lock = threading.Lock()
+        # serializes LOGICAL sparse pushes: one seq covers every shard of
+        # a push, and the per-shard dedup watermarks are monotonic — if a
+        # second push could interleave between one push's shard sends,
+        # the first push's later-shard slices would arrive below the
+        # advanced watermark and be discarded as duplicates (silent
+        # gradient loss). Lock order: _push_lock, then _seq_lock inside
+        # (never the reverse).
+        self._push_lock = threading.Lock()
         self._async_pool = None  # lazy single-thread executor for wait=False
         self._async_gen = 0  # bumps per drain-thread generation (see below)
         self._async_drop_throttle = _obs.LogThrottle()
@@ -744,21 +793,62 @@ class PsClient:
         for srv in self.servers:
             self._call(srv, _srv_create_sparse, (name, cfg))
 
-    def push_sparse(self, name: str, ids, grad, slots=None, lr=None) -> None:
+    def push_sparse(self, name: str, ids, grad, slots=None, lr=None,
+                    seq: Optional[int] = None) -> int:
+        """Shard-and-push one logical gradient batch; returns the logical
+        push's ``seq``.
+
+        ONE seq is drawn per LOGICAL push and reused for every shard
+        (ADVICE r5): each shard dedups on its own key stream
+        (``<client>/<shard>``), so when shard k fails after shards < k
+        applied, retrying the whole call with ``seq=err.seq`` (from the
+        raised :class:`PushSparseError`) re-sends the same seq everywhere
+        — applied shards skip it as a duplicate, the failed shard applies
+        it. Before this, each shard drew a fresh seq, so an application-
+        level retry after a partial failure double-applied the
+        already-applied shard slices.
+
+        Logical pushes are SERIALIZED per client (``_push_lock``): with
+        one seq spanning several shard sends, a second push interleaving
+        between them would advance the per-shard watermarks past the
+        first push's still-unsent slices, and the server would discard
+        those as duplicates — silent gradient loss reported as success."""
         ids = np.asarray(ids, np.int64).reshape(-1)
         g = np.asarray(grad, np.float32).reshape(ids.shape[0], -1)
         slots = None if slots is None else \
             np.asarray(slots, np.int64).reshape(-1)
-        shard = self._shard(ids)
-        for s, srv in enumerate(self.servers):
-            m = shard == s
-            if not m.any():
-                continue
-            self._call(srv, _srv_push_sparse,
-                       (name, ids[m].tobytes(), g[m].tobytes(),
-                        int(m.sum()),
-                        slots[m].tobytes() if slots is not None else None,
-                        lr, f"{self._client_key}/{s}", self._next_seq()))
+        rpc = self._rpc()
+        with self._push_lock:
+            if seq is None:
+                seq = self._next_seq()
+            shard = self._shard(ids)
+            for s, srv in enumerate(self.servers):
+                m = shard == s
+                if not m.any():
+                    continue
+                try:
+                    self._call(srv, _srv_push_sparse,
+                               (name, ids[m].tobytes(), g[m].tobytes(),
+                                int(m.sum()),
+                                slots[m].tobytes() if slots is not None
+                                else None,
+                                lr, f"{self._client_key}/{s}", seq))
+                except rpc.RpcTransportError as exc:
+                    # only TRANSPORT exhaustion gets the retry-with-seq
+                    # wrapper: a server-side exception (shipped back with
+                    # its original type) means the shard EXECUTED the
+                    # call — a deterministic application error, where
+                    # "retry the same seq" is wrong advice — so it
+                    # propagates unchanged
+                    _obs.inc("ps.partial_pushes_total")
+                    raise PushSparseError(
+                        f"push_sparse({name!r}) seq {seq} failed at "
+                        f"shard {s} ({srv}); earlier shards may have "
+                        f"applied — retry with push_sparse(..., "
+                        f"seq={seq}) BEFORE any other push so applied "
+                        f"shards dedup ({type(exc).__name__}: {exc})",
+                        seq, s) from exc
+        return seq
 
     def pull_sparse(self, name: str, ids, dim: int, slots=None,
                     dtype=np.float32) -> np.ndarray:

@@ -120,7 +120,11 @@ class StepWatchdog:
     def stop(self) -> None:
         """Stop the poll thread (idempotent; a later arm() restarts it)."""
         self._stop.set()
-        t = self._thread
+        # read under the lock: a concurrent arm() may be mid-restart in
+        # _start_thread, and the unlocked read could join a thread object
+        # already replaced (ISSUE 14: shared-state-race)
+        with self._lock:
+            t = self._thread
         if t is not None and t is not threading.current_thread():
             t.join(timeout=5.0 * self._poll_s + 1.0)
         _trace.heartbeat_clear(f"{self._label}.watchdog")

@@ -292,17 +292,20 @@ class Engine:
             prefill_token_budget=config.prefill_token_budget,
             max_queue_wait_s=config.max_queue_wait_s)
         self._slots: List[_Slot] = []    # admission order == batch row order
-        # serializes slot eviction only: normally the step loop is the
-        # single consumer, but a budgeted stop() that gave up on a wedged
-        # loop thread resolves stragglers from the CALLER's thread while
-        # the wedged call may return concurrently — _release must decide
-        # a slot's winner exactly once
+        # serializes slot admission/eviction and the in-transit counter:
+        # normally the step loop is the single consumer, but a budgeted
+        # stop() that gave up on a wedged loop thread resolves stragglers
+        # from the CALLER's thread while the wedged call may return
+        # concurrently — _release must decide a slot's winner exactly
+        # once, and the drain-owed probe must read a consistent
+        # slots/in-transit snapshot (ISSUE 14: shared-state-race)
         self._slot_lock = threading.Lock()
         # requests in transit between queue and slot at this step boundary
         # (popped by _admit but prefill not yet finished) or between slot
         # and queue (crash-recovery eviction before its requeue lands):
         # the drain-owed probe polls from another thread and must not
-        # mistake either window for "nothing left to finish"
+        # mistake either window for "nothing left to finish". Guarded by
+        # _slot_lock on every side.
         self._in_transit = 0
         self._wake = threading.Event()
         self._stop = threading.Event()
@@ -619,8 +622,12 @@ class Engine:
             # (popped-but-prefilling, evicted-but-not-yet-requeued) — NOT
             # new never-admitted requests
             def owed() -> bool:
-                return bool(self._slots) or self._in_transit > 0 or \
-                    self.scheduler.queued_replays() > 0
+                # consistent snapshot of the step thread's slot state; the
+                # scheduler probe stays OUTSIDE _slot_lock (it takes the
+                # scheduler's own lock — no nesting, no new lock order)
+                with self._slot_lock:
+                    busy = bool(self._slots) or self._in_transit > 0
+                return busy or self.scheduler.queued_replays() > 0
             if self._thread is not None:
                 # the loop thread keeps stepping (new admissions are
                 # latched off); poll until the last owed sequence evicts
@@ -741,14 +748,28 @@ class Engine:
             claimed += need
             return True
 
-        pending = self.scheduler.next_admissions(free_slots, can_fit,
-                                                 replay_only=replay_only)
+        # pop-in-progress guard: next_admissions removes replays from the
+        # queue BEFORE they are counted here, and the drain-owed probe
+        # must never observe that window as "nothing left to finish" —
+        # hold one unit of in-transit across the pop, then swap it for
+        # the real count under the same lock
+        with self._slot_lock:
+            self._in_transit += 1
+        try:
+            pending = self.scheduler.next_admissions(
+                free_slots, can_fit, replay_only=replay_only)
+        except BaseException:
+            with self._slot_lock:
+                self._in_transit -= 1
+            raise
         admitted = False
-        self._in_transit = len(pending)
+        with self._slot_lock:
+            self._in_transit += len(pending) - 1
         try:
             for i, p in enumerate(pending):
                 status = self._admit_one(p)
-                self._in_transit -= 1
+                with self._slot_lock:
+                    self._in_transit -= 1
                 admitted |= status == "ok"
                 if status == "noroom":
                     # pool raced out from under the reservation (defensive
@@ -757,7 +778,8 @@ class Engine:
                     self.scheduler.requeue(pending[i:])
                     break
         finally:
-            self._in_transit = 0
+            with self._slot_lock:
+                self._in_transit = 0
         return admitted
 
     def _deadline_ctx(self, pendings: Sequence[_Pending]):
@@ -825,7 +847,11 @@ class Engine:
                      t=int(prompt.size), last_tok=first_tok,
                      tokens=list(pending.replay_tokens),
                      first_token_time=now, last_token_time=now)
-        self._slots.append(slot)
+        # under the eviction lock: the append must be visible as one
+        # event to a concurrent budgeted stop() sweeping stragglers from
+        # the caller's thread (ISSUE 14: shared-state-race)
+        with self._slot_lock:
+            self._slots.append(slot)
         self._emit_token(slot, first_tok, now, first=True)
         return "ok"
 
@@ -1033,7 +1059,8 @@ class Engine:
                            slots=len(included))
         # cover the eviction->requeue gap for the drain-owed probe: these
         # slots leave _slots before their requeue lands in the queue
-        self._in_transit += len(included)
+        with self._slot_lock:
+            self._in_transit += len(included)
         try:
             for slot in list(included):
                 pend = slot.pending
@@ -1057,7 +1084,8 @@ class Engine:
                 self.scheduler.requeue(requeue)
                 self._wake.set()
         finally:
-            self._in_transit -= len(included)
+            with self._slot_lock:
+                self._in_transit -= len(included)
 
     def _publish_gauges(self, active: int, bucket: int) -> None:
         _obs.set_gauge("serving.active_slots", len(self._slots))

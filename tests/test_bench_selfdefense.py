@@ -320,6 +320,99 @@ def test_dispatch_fast_path_has_no_per_call_imports():
 
 
 # ---------------------------------------------------------------------------
+# graft-lint machine formats: --format=json (PR 3) + --format=sarif
+# (ISSUE 14) — CI consumers key on these schemas
+# ---------------------------------------------------------------------------
+
+def _lint_cli_doc(tmp_path, fmt):
+    import io
+    import contextlib
+    import json
+    import textwrap
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint.cli import main
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "w.py").write_text(textwrap.dedent("""\
+        import threading
+
+        class Worker:
+            def start(self):
+                threading.Thread(target=self._a, daemon=True).start()
+                threading.Thread(target=self._b, daemon=True).start()
+
+            def _a(self):
+                self.n = 1
+
+            def _b(self):
+                self.n = 2
+        """))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([str(pkg), f"--format={fmt}", "--no-baseline",
+                   "--no-cache"])
+    return rc, json.loads(buf.getvalue())
+
+
+def test_lint_json_format_schema_pin(tmp_path):
+    rc, doc = _lint_cli_doc(tmp_path, "json")
+    assert rc == 1 and doc["clean"] is False
+    assert {"files_checked", "findings", "counts_by_rule", "cache",
+            "run_seconds", "errors"} <= set(doc)
+    assert doc["counts_by_rule"] == {"shared-state-race": 1}
+    assert set(doc["findings"][0]) == {"path", "line", "rule", "message"}
+
+
+def test_lint_sarif_format_schema_pin(tmp_path):
+    # GitHub code scanning loads exactly this shape: version 2.1.0, one
+    # run, driver rule metadata for EVERY registered rule, results with
+    # ruleId/message/locations, witness paths as relatedLocations
+    from tools.lint import RULES
+    from tools.lint.cli import SARIF_VERSION
+    rc, doc = _lint_cli_doc(tmp_path, "sarif")
+    assert rc == 1
+    assert doc["version"] == SARIF_VERSION == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graft-lint"
+    assert [r["id"] for r in driver["rules"]] == sorted(RULES)
+    assert all({"id", "shortDescription", "defaultConfiguration"}
+               <= set(r) for r in driver["rules"])
+    (res,) = run["results"]
+    assert res["ruleId"] == "shared-state-race"
+    assert res["ruleIndex"] == sorted(RULES).index("shared-state-race")
+    assert res["level"] == "warning" and res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("pkg/w.py")
+    assert loc["region"]["startLine"] > 0
+    # the race finding's witness chain (root -> ... -> access), per side
+    rel = res["relatedLocations"]
+    assert len(rel) >= 2
+    for r in rel:
+        assert r["message"]["text"].startswith("witness:")
+        assert r["physicalLocation"]["region"]["startLine"] > 0
+
+
+def test_lint_sarif_clean_run_has_empty_results(tmp_path):
+    import io
+    import contextlib
+    import json
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint.cli import main
+    f = tmp_path / "clean.py"
+    f.write_text("x = 1\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([str(f), "--format=sarif", "--no-baseline", "--no-cache"])
+    doc = json.loads(buf.getvalue())
+    assert rc == 0 and doc["runs"][0]["results"] == []
+
+
+# ---------------------------------------------------------------------------
 # serving bench schema (ISSUE 7)
 # ---------------------------------------------------------------------------
 

@@ -289,6 +289,43 @@ def test_sharded_embedding_deepfm_step():
     assert float(loss) < first
 
 
+def test_pipeline_layer_seg_method_layer_name_splits_at_named_blocks():
+    # ISSUE 14 satellite (ADVICE r5): seg_method="layer:Name" must place
+    # stage starts AT the named blocks, not hand back even cuts
+    from paddle_tpu.distributed.fleet.pipeline_parallel import PipelineLayer
+    built = [(nn.Linear(2, 2), None),          # embedding side
+             (nn.Tanh(), None),
+             (nn.Linear(2, 2), None),
+             (nn.Tanh(), None),
+             (nn.Linear(2, 2), None),
+             (nn.Sigmoid(), None)]             # head side
+    bounds = PipelineLayer._segment(built, 3, "layer:Linear")
+    assert bounds[0] == 0 and bounds[-1] == len(built)
+    # stages 1.. start exactly on Linear blocks
+    for b in bounds[1:-1]:
+        assert type(built[b][0]).__name__ == "Linear"
+    assert sorted(bounds) == bounds and len(bounds) == 4
+
+
+def test_pipeline_layer_seg_method_too_few_named_blocks_warns():
+    # fewer named blocks than stages: loud warning + fallback counter +
+    # count-balanced cuts (the old code silently linspace'd ALWAYS)
+    import warnings as _warnings
+    from paddle_tpu import observability as obs
+    from paddle_tpu.distributed.fleet.pipeline_parallel import PipelineLayer
+    built = [(nn.Tanh(), None), (nn.Linear(2, 2), None),
+             (nn.Tanh(), None), (nn.Tanh(), None)]
+    obs.enable()
+    before = obs.snapshot().get("pipeline.seg_method_fallbacks_total", 0)
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        bounds = PipelineLayer._segment(built, 2, "layer:Linear")
+    assert bounds == [0, 2, 4]           # count-balanced fallback
+    assert any("found only 1 'Linear'" in str(x.message) for x in w)
+    assert obs.snapshot()["pipeline.seg_method_fallbacks_total"] \
+        == before + 1
+
+
 @pytest.mark.slow
 def test_pipeline_layer_microbatch_parity():
     from paddle_tpu.distributed import fleet

@@ -486,6 +486,181 @@ def test_sparse_table_slot_rule_late_binding_and_mixed_snapshot(tmp_path):
     assert "g2" in t2.state[6]
 
 
+def test_push_sparse_partial_failure_retry_is_idempotent(monkeypatch):
+    """ISSUE 14 satellite (ADVICE r5): ONE seq per logical push_sparse,
+    reused across shards. Shard 0 applies, shard 1's transport faults →
+    PushSparseError carries the seq; retrying the SAME logical push with
+    that seq dedups at shard 0 (no double-apply) and applies at shard 1."""
+    from paddle_tpu.distributed import ps_service as ps
+    from paddle_tpu.distributed import rpc as _rpc
+
+    ps.reset_server_state()
+    client = ps.PsClient(["s0", "s1"], retry_timeout=0.05)
+    fail = {"s1_pushes_to_fail": 1}
+
+    def fake_call(self, server, fn, args):
+        # in-process transport: the per-shard fault fires BEFORE the
+        # server applies (a connection that died mid-dial)
+        if fn is ps._srv_push_sparse and server == "s1" \
+                and fail["s1_pushes_to_fail"] > 0:
+            fail["s1_pushes_to_fail"] -= 1
+            raise _rpc.RpcTransportError("injected shard-1 transport fault")
+        return fn(*args)
+
+    monkeypatch.setattr(ps.PsClient, "_call", fake_call)
+    client.create_sparse_table("emb", 2, accessor="sgd", lr=1.0)
+
+    ids = np.array([0, 1], np.int64)       # id % 2 -> shard 0, shard 1
+    g = np.ones((2, 2), np.float32)
+    with pytest.raises(ps.PushSparseError) as ei:
+        client.push_sparse("emb", ids, g)
+    err = ei.value
+    assert err.failed_shard == 1 and err.seq > 0
+    # shard 0 applied its slice; shard 1 never saw it
+    np.testing.assert_allclose(ps._SPARSE["emb"].values[0], [-1.0, -1.0])
+    assert 1 not in ps._SPARSE["emb"].values
+
+    # the application-level retry: SAME seq -> shard 0 dedups instead of
+    # double-applying, shard 1 applies for the first time
+    seq2 = client.push_sparse("emb", ids, g, seq=err.seq)
+    assert seq2 == err.seq
+    np.testing.assert_allclose(ps._SPARSE["emb"].values[0], [-1.0, -1.0])
+    np.testing.assert_allclose(ps._SPARSE["emb"].values[1], [-1.0, -1.0])
+    assert ps.serve_stats()["dup_pushes"] == 1
+
+    # a SERVER-SIDE application error (the shard executed the call) is
+    # NOT a partial-transport failure: it propagates with its original
+    # type — "retry the same seq" would be wrong advice
+    with pytest.raises(KeyError):
+        client.push_sparse("no_such_table", ids, g)
+    ps.reset_server_state()
+
+
+def test_push_sparse_draws_one_seq_across_shards(monkeypatch):
+    """Every shard of one logical push carries the SAME seq (per-shard
+    key streams keep dedup correct); successive pushes advance it."""
+    from paddle_tpu.distributed import ps_service as ps
+
+    ps.reset_server_state()
+    seen = []
+
+    def fake_call(self, server, fn, args):
+        if fn is ps._srv_push_sparse:
+            seen.append((server, args[-2], args[-1]))  # (srv, key, seq)
+        return fn(*args)
+
+    monkeypatch.setattr(ps.PsClient, "_call", fake_call)
+    client = ps.PsClient(["s0", "s1", "s2"])
+    client.create_sparse_table("emb", 2)
+    seq1 = client.push_sparse("emb", np.arange(6), np.ones((6, 2)))
+    seq2 = client.push_sparse("emb", np.arange(6), np.ones((6, 2)))
+    first = [s for s in seen if s[2] == seq1]
+    assert len(first) == 3 and len({k for _s, k, _q in first}) == 3
+    assert seq2 > seq1
+    assert len({q for _s, _k, q in seen}) == 2  # one seq per logical push
+    ps.reset_server_state()
+
+
+def test_push_sparse_concurrent_pushers_lose_no_gradients(monkeypatch):
+    """Review regression: with ONE seq spanning a push's shard sends, a
+    second thread's push interleaving between them would advance the
+    per-shard watermark and the first push's later slice would be
+    discarded as a 'duplicate'. Logical pushes serialize per client —
+    N threads x M pushes must apply every single slice."""
+    import threading as _threading
+    from paddle_tpu.distributed import ps_service as ps
+
+    ps.reset_server_state()
+    barrier = _threading.Barrier(2)
+
+    def fake_call(self, server, fn, args):
+        if fn is ps._srv_push_sparse:
+            time.sleep(0.001)   # widen the shard-send window
+        return fn(*args)
+
+    monkeypatch.setattr(ps.PsClient, "_call", fake_call)
+    client = ps.PsClient(["s0", "s1"])
+    client.create_sparse_table("emb", 1, accessor="sgd", lr=1.0)
+    ids = np.array([0, 1], np.int64)       # one row per shard
+    g = np.ones((2, 1), np.float32)
+    N = 20
+    errs = []
+
+    def pusher():
+        try:
+            barrier.wait(timeout=10)
+            for _ in range(N):
+                client.push_sparse("emb", ids, g)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [_threading.Thread(target=pusher) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert errs == []
+    # every one of the 2*N logical pushes applied BOTH its slices:
+    # values = -(total applies), and not one was dropped as a duplicate
+    np.testing.assert_allclose(ps._SPARSE["emb"].values[0], [-2.0 * N])
+    np.testing.assert_allclose(ps._SPARSE["emb"].values[1], [-2.0 * N])
+    assert ps.serve_stats()["dup_pushes"] == 0
+    ps.reset_server_state()
+
+
+def test_srv_load_missing_cfg_file_skips_table_loudly(tmp_path, caplog):
+    """ISSUE 14 satellite (ADVICE r5): a sparse snapshot without
+    sparse_cfg.json must NOT be restored with a guessed {'dim': 1} — the
+    table is skipped with a loud error at load time."""
+    import logging
+    from paddle_tpu.distributed import ps_service as ps
+
+    ps.reset_server_state()
+    ps._srv_create_sparse("t", {"dim": 3, "accessor": "sgd", "lr": 1.0})
+    ps._srv_push_sparse("t", np.array([5], np.int64).tobytes(),
+                        np.ones((1, 3), np.float32).tobytes(), 1,
+                        None, None)
+    ps._srv_save(str(tmp_path))
+    os.remove(str(tmp_path / "sparse_cfg.json"))
+    ps.reset_server_state()
+    with caplog.at_level(logging.ERROR,
+                         logger="paddle_tpu.distributed.ps_service"):
+        loaded = ps._srv_load(str(tmp_path))
+    assert loaded == [] and "t" not in ps._SPARSE
+    assert "SKIPPING" in caplog.text and "sparse_cfg.json" in caplog.text
+    assert ps.serve_stats()["load_skipped"] == 1
+    ps.reset_server_state()
+
+
+def test_srv_load_cfg_missing_table_skips_only_that_table(tmp_path, caplog):
+    """sparse_cfg.json present but lacking ONE table: the configured
+    table restores with its true dim, the orphan is skipped loudly."""
+    import json
+    import logging
+    from paddle_tpu.distributed import ps_service as ps
+
+    ps.reset_server_state()
+    ps._srv_create_sparse("good", {"dim": 4})
+    ps._srv_create_sparse("orphan", {"dim": 2})
+    ps._srv_pull_sparse("good", np.array([1], np.int64).tobytes(), None)
+    ps._srv_pull_sparse("orphan", np.array([1], np.int64).tobytes(), None)
+    ps._srv_save(str(tmp_path))
+    cfg_path = str(tmp_path / "sparse_cfg.json")
+    with open(cfg_path) as f:
+        cfgs = json.load(f)
+    del cfgs["orphan"]
+    with open(cfg_path, "w") as f:
+        json.dump(cfgs, f)
+    ps.reset_server_state()
+    with caplog.at_level(logging.ERROR,
+                         logger="paddle_tpu.distributed.ps_service"):
+        loaded = ps._srv_load(str(tmp_path))
+    assert loaded == ["good"]
+    assert ps._SPARSE["good"].dim == 4 and "orphan" not in ps._SPARSE
+    assert "'orphan'" in caplog.text and "table absent" in caplog.text
+    ps.reset_server_state()
+
+
 def test_push_dedup_guard():
     """A retried push with the same (client, seq) must not re-apply."""
     from paddle_tpu.distributed import ps_service as ps

@@ -41,7 +41,10 @@ EXPECTED_RULES = {"trace-impurity", "silent-swallow", "hot-path-import",
                   "device-access",
                   # ISSUE 12 (tracing): spans only via the span() context
                   # manager; guarded construction on the dispatch fast path
-                  "span-discipline"}
+                  "span-discipline",
+                  # ISSUE 14 (graft-lint 3.0): whole-program race detector —
+                  # thread-root discovery + lock domination over shared state
+                  "shared-state-race"}
 
 
 def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
@@ -55,7 +58,8 @@ def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
 # rule registry
 # ---------------------------------------------------------------------------
 
-def test_all_eleven_rules_registered():
+def test_all_thirteen_rules_registered():
+    assert len(EXPECTED_RULES) == 13
     assert EXPECTED_RULES <= set(RULES)
 
 
@@ -748,5 +752,8 @@ def test_every_rule_is_exercised_by_tree_or_baseline():
     rules_in_baseline = {e["rule"]
                         for e in load_baseline(default_baseline_path())}
     assert {"hot-path-import", "host-sync", "unguarded-global",
-            "cross-host-sync", "import-layering",
-            "naked-retry"} <= rules_in_baseline
+            "cross-host-sync", "import-layering", "naked-retry",
+            # ISSUE 14: the race detector's reasoned survivors (lock-free
+            # flight ring, GIL-atomic endpoint refresh, the engine's
+            # single-consumer step state)
+            "shared-state-race"} <= rules_in_baseline

@@ -27,7 +27,8 @@ from tools.lint.wholeprogram import (  # noqa: E402
 from tools.lint.wholeprogram.summary import SUMMARY_FORMAT  # noqa: E402
 
 WHOLEPROGRAM_RULES = {"cross-trace-impurity", "cross-host-sync",
-                      "lock-order", "import-layering"}
+                      "lock-order", "import-layering",
+                      "shared-state-race"}
 
 
 def write_pkg(tmp_path, files):
@@ -456,6 +457,377 @@ def test_import_layering_cycle(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# shared-state-race (graft-lint 3.0)
+# ---------------------------------------------------------------------------
+
+RACE_HEAD = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.items = []
+            self._lock = threading.Lock()
+
+        def start(self):
+            threading.Thread(target=self._loop, daemon=True).start()
+            threading.Thread(target=self._drain, daemon=True).start()
+
+    """
+
+
+def test_race_two_thread_write_write(tmp_path):
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": RACE_HEAD + """\
+    def _loop(self):
+        self.items.append(1)
+
+    def _drain(self):
+        self.items.pop()
+    """.replace("\n    ", "\n        "),
+    })
+    assert len(res.new) == 1
+    msg = res.new[0].message
+    assert "'self.items'" in msg and "written in" in msg
+    # both witness paths name their thread roots
+    assert "Worker._loop" in msg and "Worker._drain" in msg
+    # structured witness chain rides the finding for the SARIF exporter
+    assert res.new[0].related and all(
+        r["path"] == "pkg/w.py" for r in res.new[0].related)
+
+
+def test_race_write_read(tmp_path):
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": RACE_HEAD + """\
+    def _loop(self):
+        self.items.append(1)
+
+    def _drain(self):
+        return len(self.items)
+    """.replace("\n    ", "\n        "),
+    })
+    assert len(res.new) == 1
+    assert "read in" in res.new[0].message
+
+
+def test_race_common_lock_negative_through_call_edge(tmp_path):
+    # the write side holds the lock around a CALL into the helper: lock
+    # domination must propagate through the call edge, not just lexically
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": RACE_HEAD + """\
+    def _loop(self):
+        with self._lock:
+            self._put()
+
+    def _put(self):
+        self.items.append(1)
+
+    def _drain(self):
+        with self._lock:
+            self.items.pop()
+    """.replace("\n    ", "\n        "),
+    })
+    assert res.new == []
+
+
+def test_race_unlocked_second_path_defeats_domination(tmp_path):
+    # the same helper ALSO called outside the lock: the meet over paths
+    # is empty and the conflict comes back
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": RACE_HEAD + """\
+    def _loop(self):
+        with self._lock:
+            self._put()
+        self._put()
+
+    def _put(self):
+        self.items.append(1)
+
+    def _drain(self):
+        with self._lock:
+            self.items.pop()
+    """.replace("\n    ", "\n        "),
+    })
+    assert len(res.new) == 1
+
+
+def test_race_locked_suffix_caller_holds_negative(tmp_path):
+    # accesses inside *_locked helpers are the caller-holds convention —
+    # trusted, same as unguarded-global/lock-order
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": RACE_HEAD + """\
+    def _loop(self):
+        with self._lock:
+            self._put_locked()
+
+    def _put_locked(self):
+        self.items.append(1)
+
+    def _drain(self):
+        with self._lock:
+            self.items.pop()
+    """.replace("\n    ", "\n        "),
+    })
+    assert res.new == []
+
+
+def test_race_config_thread_roots_seam(tmp_path):
+    # no Thread() anywhere: the config escape names the callback seams
+    # (caller-thread entry points) and a module global conflicts
+    files = {
+        "pkg/s.py": """\
+            _REG = {}
+
+            def produce(k, v):
+                _REG[k] = v
+
+            def consume(k):
+                return _REG.get(k)
+            """,
+    }
+    cfg = {"thread_roots": {"pkg/s.py": ["produce", "consume"]}}
+    res = lint_pkg(tmp_path, "shared-state-race", files, config=cfg)
+    assert len(res.new) == 1
+    assert "module global '_REG'" in res.new[0].message
+    # without the config roots the same tree is silent (< 2 roots)
+    tmp2 = tmp_path / "quiet"
+    tmp2.mkdir()
+    assert lint_pkg(tmp2, "shared-state-race", files).new == []
+
+
+def test_race_global_rebind_via_global_stmt_is_a_write(tmp_path):
+    # the classic global-swap race: `global X; X = {...}` on one thread
+    # vs `X[k] = v` on another — a plain-Name rebind must count as a
+    # write (review regression: only Attribute/Subscript targets did)
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/g.py": """\
+            import threading
+
+            _CACHE = {}
+
+            def _swap():
+                global _CACHE
+                _CACHE = {}
+
+            def _fill():
+                _CACHE["k"] = 1
+
+            def start():
+                threading.Thread(target=_swap, daemon=True).start()
+                threading.Thread(target=_fill, daemon=True).start()
+            """,
+    })
+    assert len(res.new) == 1
+    assert "module global '_CACHE'" in res.new[0].message
+
+
+def test_race_init_and_safe_primitives_excluded(tmp_path):
+    # __init__ writes happen-before the spawns; Event/Queue fields are
+    # internally synchronized — neither may conflict
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": """\
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.items = []
+                    self._wake = threading.Event()
+
+                def start(self):
+                    threading.Thread(target=self._a, daemon=True).start()
+                    threading.Thread(target=self._b, daemon=True).start()
+
+                def _a(self):
+                    self._wake.set()
+
+                def _b(self):
+                    self._wake.clear()
+                    return len(self.items)
+            """,
+    })
+    assert res.new == []
+
+
+def test_race_httpd_handler_methods_are_roots(tmp_path):
+    # ThreadingHTTPServer handler do_* methods run on server threads
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/h.py": """\
+            import threading
+            from http.server import (BaseHTTPRequestHandler,
+                                     ThreadingHTTPServer)
+
+            _CACHE = {}
+
+            class H(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    _CACHE["last"] = self.path
+
+            def refresh():
+                _CACHE.clear()
+
+            def serve():
+                httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+                threading.Thread(target=refresh, daemon=True).start()
+                return httpd
+            """,
+    })
+    assert len(res.new) == 1
+    assert "http handler" in res.new[0].message
+
+
+def test_race_httpd_handler_in_another_module_still_roots(tmp_path):
+    # review regression: the handler class moved out of the spawning
+    # module must still contribute its do_* thread roots (resolution
+    # follows the import binding, like every other cross-module seam)
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/handlers.py": """\
+            from http.server import BaseHTTPRequestHandler
+
+            CACHE = {}
+
+            class ScrapeHandler(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    CACHE["last"] = self.path
+
+            def refresh():
+                CACHE.clear()
+            """,
+        "pkg/server.py": """\
+            import threading
+            from http.server import ThreadingHTTPServer
+
+            from . import handlers
+
+            def serve():
+                httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                            handlers.ScrapeHandler)
+                threading.Thread(target=handlers.refresh,
+                                 daemon=True).start()
+                return httpd
+            """,
+    })
+    assert len(res.new) == 1
+    assert "http handler" in res.new[0].message
+    assert "do_GET" in res.new[0].message
+
+
+def test_race_ann_assign_write_and_safe_field(tmp_path):
+    # review regression: annotated assignments are writes too — both for
+    # the conflict itself and for the Event-field safety exemption
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": """\
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._wake: threading.Event = threading.Event()
+
+                def start(self):
+                    threading.Thread(target=self._a, daemon=True).start()
+                    threading.Thread(target=self._b, daemon=True).start()
+
+                def _a(self):
+                    self.count: int = 0
+                    self._wake.set()
+
+                def _b(self):
+                    self.count: int = 1
+                    self._wake.clear()
+            """,
+    })
+    assert len(res.new) == 1
+    assert "'self.count'" in res.new[0].message  # _wake stays exempt
+
+
+def test_race_pragma_on_one_write_does_not_silence_the_target(tmp_path):
+    # review regression: a pragma acknowledges ITS write only — the
+    # finding re-anchors on the next unacknowledged conflicting write
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": """\
+            import threading
+
+            class Worker:
+                def start(self):
+                    threading.Thread(target=self._a, daemon=True).start()
+                    threading.Thread(target=self._b, daemon=True).start()
+
+                def _a(self):
+                    self.n = 1  # graft-lint: disable=shared-state-race
+
+                def _b(self):
+                    self.n = 2
+            """,
+    })
+    assert len(res.new) == 1
+    assert "written in 'Worker._b'" in res.new[0].message
+
+
+def test_race_pragma_suppresses(tmp_path):
+    res = lint_pkg(tmp_path, "shared-state-race", {
+        "pkg/w.py": """\
+            import threading
+
+            class Worker:
+                def start(self):
+                    threading.Thread(target=self._a, daemon=True).start()
+                    threading.Thread(target=self._b, daemon=True).start()
+
+                def _a(self):
+                    self.n = 1  # graft-lint: disable=shared-state-race
+
+                def _b(self):
+                    self.n = 2  # graft-lint: disable=shared-state-race
+            """,
+    })
+    assert res.new == []
+
+
+def test_race_cache_warm_and_edit_invalidate(tmp_path):
+    # the new summary fields ride the same content-hash cache: a warm run
+    # parses nothing and still reports, an edit re-parses exactly one file
+    files = {
+        "pkg/w.py": RACE_HEAD + """\
+    def _loop(self):
+        self.items.append(1)
+
+    def _drain(self):
+        self.items.pop()
+    """.replace("\n    ", "\n        "),
+    }
+    write_pkg(tmp_path, files)
+    cache = tmp_path / "cache.json"
+    cold = lint_pkg(tmp_path, "shared-state-race", cache_path=str(cache))
+    assert len(cold.new) == 1 and cold.parsed_files == cold.total_files
+    warm = lint_pkg(tmp_path, "shared-state-race", cache_path=str(cache))
+    assert warm.parsed_files == 0
+    assert warm.summary_cache_hits == warm.total_files
+    assert [f.as_dict() for f in warm.new] == [f.as_dict() for f in cold.new]
+    # fix the race: one file re-parses, the finding disappears
+    src = (tmp_path / "pkg" / "w.py").read_text()
+    (tmp_path / "pkg" / "w.py").write_text(src.replace(
+        "        self.items.append(1)",
+        "        with self._lock:\n            self.items.append(1)")
+        .replace("        self.items.pop()",
+                 "        with self._lock:\n            self.items.pop()"))
+    fixed = lint_pkg(tmp_path, "shared-state-race", cache_path=str(cache))
+    assert fixed.parsed_files == 1 and fixed.new == []
+
+
+def test_race_shipped_tree_fixed_sites_stay_clean():
+    # the ISSUE 14 production fixes must hold: the engine's in-transit
+    # counter and the watchdog's thread handle are lock-dominated now, so
+    # no NEW finding may name them (the reasoned survivors are baselined)
+    from tools.lint import default_baseline_path, load_baseline
+    res = run_lint(rules=["shared-state-race"],
+                   baseline_entries=load_baseline(default_baseline_path()))
+    assert [f.text() for f in res.new] == []
+    assert not any("'self._in_transit'" in f.message or
+                   "'self._thread' of class 'StepWatchdog'" in f.message
+                   for f in res.baselined)
+    assert not any(f.path == "paddle_tpu/resilience/watchdog.py"
+                   for f in res.baselined)
+
+
+# ---------------------------------------------------------------------------
 # pragmas still apply to project-rule findings
 # ---------------------------------------------------------------------------
 
@@ -547,8 +919,9 @@ def test_cache_per_file_findings_served_without_parse(tmp_path):
 
 def test_summary_format_constant_is_pinned():
     # bump CACHE_FORMAT_VERSION whenever SUMMARY_FORMAT changes; this pin
-    # forces the bump to be a conscious, reviewed edit
-    assert (SUMMARY_FORMAT, CACHE_FORMAT_VERSION) == (1, 1)
+    # forces the bump to be a conscious, reviewed edit (2: graft-lint 3.0
+    # — call-site lock sets, access records, spawn roots)
+    assert (SUMMARY_FORMAT, CACHE_FORMAT_VERSION) == (2, 2)
 
 
 # ---------------------------------------------------------------------------

@@ -18,6 +18,21 @@ IMPURE_PREFIXES = ("np.random.", "numpy.random.")
 #: constructors that create a lock-like object (Condition wraps a Lock)
 LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
 
+#: method names that mutate their receiver in place (shared between the
+#: per-file unguarded-global rule and the whole-program race detector)
+MUTATORS = {"append", "extend", "insert", "pop", "popitem", "clear",
+            "update", "setdefault", "remove", "discard", "add",
+            "move_to_end", "appendleft", "extendleft"}
+
+#: constructors whose instances carry their own internal synchronization —
+#: calling .set()/.get()/.put()/.clear() on them is thread-safe by design,
+#: so ``self.<attr>`` fields holding one are NOT shared mutable state for
+#: the race detector (rebinding the field itself still is; only fields
+#: assigned nothing but these ctors are exempt)
+THREADSAFE_CTORS = {"Event", "Queue", "SimpleQueue", "LifoQueue",
+                    "PriorityQueue", "Semaphore", "BoundedSemaphore",
+                    "Barrier", "local", "Future"}
+
 
 def dotted_name(node: ast.AST) -> str:
     """``a.b.c`` for Name/Attribute chains, else ``""``."""
@@ -93,6 +108,18 @@ def module_lock_names(tree: ast.Module) -> Set[str]:
     """Names assigned ``threading.Lock()``/``RLock()`` at module scope."""
     return {n for n, kind in module_lock_defs(tree).items()
             if kind in ("Lock", "RLock")}
+
+
+def safe_ctor_in(expr: ast.AST) -> bool:
+    """True when ``expr`` constructs one of the internally-synchronized
+    stdlib objects (Event/Queue/…) anywhere in its subtree."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            fname = n.func.attr if isinstance(n.func, ast.Attribute) \
+                else getattr(n.func, "id", "")
+            if fname in THREADSAFE_CTORS:
+                return True
+    return False
 
 
 def lock_ctor_in(expr: ast.AST) -> Optional[str]:

@@ -9,6 +9,9 @@ Usage::
                                                # program rules still see the
                                                # full tree via the cache)
     python -m tools.lint --format=json         # machine-readable report
+    python -m tools.lint --format=sarif        # GitHub-code-scanning SARIF
+                                               # (witness paths become
+                                               # relatedLocations)
     python -m tools.lint --rules=silent-swallow,host-sync
     python -m tools.lint --list-rules
     python -m tools.lint --no-baseline         # show baselined findings too
@@ -45,7 +48,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="graft-lint: framework-aware static analysis")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to lint (default: paddle_tpu/)")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule names (default: all)")
     p.add_argument("--list-rules", action="store_true")
@@ -74,6 +78,59 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-cache", action="store_true",
                    help="disable the content-hash cache for this run")
     return p
+
+
+#: pinned in tests/test_bench_selfdefense.py next to the --format=json pin
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def sarif_report(result) -> dict:
+    """GitHub-code-scanning-loadable SARIF: every registered rule ships
+    its metadata, every NEW (non-baselined) finding becomes a result, and
+    a finding's structured witness chain (``Finding.related`` — the
+    shared-state-race root→access paths) becomes relatedLocations."""
+
+    def _loc(path, line, message=None):
+        loc = {"physicalLocation": {
+            "artifactLocation": {"uri": path, "uriBaseId": "%SRCROOT%"},
+            "region": {"startLine": int(line)}}}
+        if message:
+            loc["message"] = {"text": message}
+        return loc
+
+    rule_ids = sorted(RULES)
+    results = []
+    for f in result.new:
+        res = {"ruleId": f.rule,
+               "ruleIndex": rule_ids.index(f.rule),
+               "level": "warning",
+               "message": {"text": f.message},
+               "locations": [_loc(f.path, f.line)]}
+        if f.related:
+            res["relatedLocations"] = [
+                _loc(r["path"], r["line"], r.get("message"))
+                for r in f.related]
+        results.append(res)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graft-lint",
+                "informationUri":
+                    "https://github.com/paddle-tpu/paddle-tpu",
+                "rules": [{
+                    "id": name,
+                    "shortDescription": {"text": RULES[name].description},
+                    "defaultConfiguration": {"level": "warning"},
+                } for name in rule_ids],
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -175,7 +232,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"{result.summary_cache_hits} summary hits "
                   f"(of {result.total_files} files) "
                   f"in {result.run_seconds:.2f}s")
-    if args.format == "json":
+    if args.format == "sarif":
+        print(json.dumps(sarif_report(result), indent=2, sort_keys=True))
+    elif args.format == "json":
         report = result.as_dict()
         report["todo_baseline_entries"] = [
             {"path": e["path"], "rule": e["rule"], "message": e["message"]}

@@ -39,6 +39,10 @@ class Finding:
     line: int
     rule: str
     message: str
+    # optional structured witness locations ((path, line, message) dicts):
+    # the SARIF exporter renders them as relatedLocations; excluded from
+    # as_dict()/key() so cache round-trips and baselines are unchanged
+    related: Tuple = ()
 
     def key(self) -> Tuple[str, str, str]:
         """Line-free fingerprint used for baseline matching."""
@@ -136,6 +140,35 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # suffixes are assumed to run with the module lock already held by
     # their caller (the ``_locked`` convention used across core/)
     "lock_held_suffixes": ["_locked"],
+    # shared-state-race (ISSUE 14): thread roots the spawn-site discovery
+    # cannot see — public entry points that run on CALLER threads, and
+    # callback seams (stream callbacks fire on the engine step thread,
+    # Future resolution on whatever thread completes it). Discovery
+    # handles threading.Thread(target=…)/Timer and ThreadingHTTPServer
+    # handlers by itself; list here only what runs on OTHER threads.
+    "thread_roots": {
+        # any caller thread: submit/cancel/stop race the step loop thread
+        "paddle_tpu/serving/engine.py": [
+            "Engine.submit", "Engine.cancel", "Engine.stop"],
+        # the step/train thread arms and disarms around the compiled call
+        # while the poll daemon classifies the window
+        "paddle_tpu/resilience/watchdog.py": [
+            "StepWatchdog.arm", "StepWatchdog.disarm", "StepWatchdog.stop"],
+        # engine construction / supervisor run call the opt-in seam while
+        # scrape threads serve /metrics
+        "paddle_tpu/observability/http.py": ["maybe_serve_from_env"],
+        # the training thread saves and waits while async commit threads
+        # rotate the latest pointer
+        "paddle_tpu/distributed/checkpoint/__init__.py": [
+            "save_state_dict", "wait_async_saves"],
+        # worker threads push/pull against the same client whose async
+        # drain daemon replays; close() races the drain
+        "paddle_tpu/distributed/ps_service.py": [
+            "PsClient.push", "PsClient.push_sparse", "PsClient.close"],
+        # the trainer consumes batches while the prefetch thread produces
+        "paddle_tpu/io/__init__.py": [
+            "DataLoader._thread_prefetch", "DataLoader._native_prefetch"],
+    },
     # naked-retry: the module(s) allowed to own raw sleep-in-retry-loop
     # mechanics — everything else routes through their policies
     "retry_allowed_paths": ["paddle_tpu/resilience"],

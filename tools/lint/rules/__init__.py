@@ -14,6 +14,7 @@ from . import (  # noqa: F401
     import_layering,
     lock_order,
     naked_retry,
+    shared_state_race,
     silent_swallow,
     span_discipline,
     trace_impurity,

@@ -1,0 +1,174 @@
+"""shared-state-race: whole-program lock-domination over shared state.
+
+The per-file ``unguarded-global`` rule sees one module and one lock; the
+``lock-order`` rule sees acquisition ORDER. Neither answers the question
+every review keeps re-asking: is this field, written by the engine step
+thread and read by the watchdog poll thread, actually guarded by a
+COMMON lock on both sides? This rule does, whole-program:
+
+1. **Thread roots** — every ``threading.Thread(target=…)`` /
+   ``threading.Timer`` spawn site, the ``do_*`` methods of classes handed
+   to a ``ThreadingHTTPServer``-style ctor, plus the ``thread_roots``
+   config table for the seams discovery cannot see (public entry points
+   running on caller threads, stream callbacks, Future resolution).
+2. **Lock domination** — from each root, reachability carries the set of
+   locks provably held on EVERY path (meet = intersection, propagated
+   through call edges from the lexical ``with <lock>:`` structure), so
+   ``with self._lock: self._evict()`` guards the callee's accesses too.
+3. **Conflict** — a ``self.<attr>`` field or module-level mutable global
+   accessed from ≥ 2 roots, at least one access a write, where the two
+   sides' guarding lock sets do not intersect. The finding prints both
+   witness paths (root → … → access).
+
+Out of scope by design (the precision trades that keep this signal):
+
+* accesses in ``__init__``/``__post_init__``/``__new__``/``__del__`` —
+  construction happens-before any spawn, teardown after joins;
+* accesses in ``*_locked`` helpers (configurable suffixes) — the
+  caller-holds convention, same trust as the other lock rules;
+* fields only ever assigned an internally-synchronized object
+  (Event/Queue/Semaphore/…) — their methods synchronize themselves;
+* per-instance reasoning: all instances of a class share one node, and a
+  root that CAN reach an access is assumed to run concurrently with any
+  other root — both over-approximations that err toward reporting.
+
+Suppression: the usual ``# graft-lint: disable=shared-state-race`` pragma
+on the WRITE line, or a baseline entry whose reason says why the race is
+benign (GIL-atomic flag, single-consumer protocol, monotonic latch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Tuple
+
+from ..engine import Finding, ProjectRule, register_rule
+
+#: construction / teardown functions: happens-before (after) the threads
+_EXCLUDED_FNS = {"__init__", "__post_init__", "__new__", "__del__"}
+
+#: witness chains longer than this elide their middle
+_CHAIN_CAP = 6
+
+
+def _chain(parent: Dict, node: Tuple[str, str]) -> List[Tuple[str, str]]:
+    out = [node]
+    while parent.get(node) is not None:
+        node = parent[node]
+        out.append(node)
+    out.reverse()
+    return out
+
+
+def _chain_text(chain: List[Tuple[str, str]]) -> str:
+    names = [qn for _m, qn in chain]
+    if len(names) > _CHAIN_CAP:
+        names = names[:3] + ["…"] + names[-2:]
+    return " -> ".join(names)
+
+
+def _locks_text(guard: FrozenSet[str]) -> str:
+    return ", ".join(sorted(guard)) if guard else "no lock"
+
+
+class _Access:
+    __slots__ = ("root", "rw", "guard", "mod", "qual", "line", "chain")
+
+    def __init__(self, root, rw, guard, mod, qual, line, chain):
+        self.root, self.rw, self.guard = root, rw, guard
+        self.mod, self.qual, self.line = mod, qual, line
+        self.chain = chain
+
+    def sort_key(self):
+        return (self.rw != "w", self.root, self.mod, self.qual, self.line)
+
+
+@register_rule
+class SharedStateRaceRule(ProjectRule):
+    name = "shared-state-race"
+    description = ("shared mutable state reachable from two thread roots "
+                   "must be lock-dominated (common lock on every side "
+                   "that writes)")
+
+    def check_project(self, project):
+        suffixes = tuple(project.config.get("lock_held_suffixes",
+                                            ["_locked"]))
+        roots = project.thread_roots()
+        if len(roots) < 2:
+            return
+
+        # targets: ("self", mod, cls, attr) | ("glob", mod, name)
+        targets: Dict[tuple, List[_Access]] = {}
+        for mod, fi, label in roots:
+            held, parent = project.reachable_with_locks(mod, fi)
+            chain_memo: Dict[Tuple[str, str], List] = {}
+            for node in sorted(held):
+                m, _qn = node
+                f = project.fn_by_qual[node]
+                if f.name in _EXCLUDED_FNS or f.name.endswith(suffixes):
+                    continue
+                if not f.accesses:
+                    continue
+                chain = chain_memo.get(node)
+                if chain is None:
+                    chain = _chain(parent, node)
+                    chain_memo[node] = chain
+                for acc in f.accesses:
+                    if acc[0] == "self":
+                        _tag, cls, attr, rw, lrs, line = acc
+                        key = ("self", m, cls, attr)
+                    else:
+                        _tag, gname, rw, lrs, line = acc
+                        key = ("glob", m, gname)
+                    lex = frozenset(
+                        x for x in (project.lock_id(m, list(lr))
+                                    for lr in lrs) if x is not None)
+                    targets.setdefault(key, []).append(_Access(
+                        label, rw, held[node] | lex, m, f.qualname,
+                        line, chain))
+
+        for key in sorted(targets):
+            recs = sorted(targets[key], key=_Access.sort_key)
+            if len({r.root for r in recs}) < 2:
+                continue
+            pair = None
+            for w in recs:
+                if w.rw != "w":
+                    break  # sorted writes-first: no write, no race
+                # a pragma on THIS write's line acknowledges this write
+                # only — anchor the finding on the next conflicting
+                # write instead of letting one pragma silence the target
+                if project.modules[w.mod].suppressed(self.name, w.line):
+                    continue
+                for o in recs:
+                    if o.root != w.root and not (w.guard & o.guard):
+                        pair = (w, o)
+                        break
+                if pair is not None:
+                    break
+            if pair is None:
+                continue
+            w, o = pair
+            if key[0] == "self":
+                _k, m, cls, attr = key
+                what = f"'self.{attr}' of class '{cls}' ({m})"
+            else:
+                _k, m, gname = key
+                what = f"module global '{gname}' ({m})"
+            overb = "written" if o.rw == "w" else "read"
+            s = project.modules[w.mod]
+            related = tuple(
+                {"path": project.modules[cm].path,
+                 "line": project.fn_by_qual[(cm, cq)].line,
+                 "message": f"witness: '{cq}'"}
+                for cm, cq in (w.chain + o.chain))
+            yield Finding(
+                s.path, w.line, self.name,
+                f"possible data race on {what}: written in '{w.qual}' "
+                f"under {_locks_text(w.guard)} [{w.root}: "
+                f"{_chain_text(w.chain)}] and {overb} in '{o.qual}' "
+                f"under {_locks_text(o.guard)} [{o.root}: "
+                f"{_chain_text(o.chain)}] — no common lock dominates "
+                f"both sides; guard them with one lock, route the "
+                f"access through a *_locked helper, or baseline with "
+                f"the reason the race is benign",
+                related=related)

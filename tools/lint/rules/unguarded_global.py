@@ -23,12 +23,9 @@ from __future__ import annotations
 import ast
 from typing import Set
 
-from ..astutil import module_lock_names, module_mutable_globals, root_name
+from ..astutil import (MUTATORS, module_lock_names, module_mutable_globals,
+                       root_name)
 from ..engine import FileContext, Rule, register_rule
-
-MUTATORS = {"append", "extend", "insert", "pop", "popitem", "clear",
-            "update", "setdefault", "remove", "discard", "add",
-            "move_to_end", "appendleft", "extendleft"}
 
 
 def _is_lock_expr(node: ast.AST, locks: Set[str]) -> bool:

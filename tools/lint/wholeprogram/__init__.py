@@ -14,7 +14,11 @@ into the two graphs the interprocedural rules query:
   ``import-layering``;
 * the **call graph** (module-qualified function nodes; ``import`` /
   ``from-import`` aliases and one-hop re-exports resolved) for
-  ``cross-trace-impurity``, ``cross-host-sync``, and ``lock-order``.
+  ``cross-trace-impurity``, ``cross-host-sync``, and ``lock-order``;
+* the **thread-root partition** (graft-lint 3.0): discovered spawn sites
+  + configured entry points, with per-root reachability carrying the
+  must-hold lock set (meet-over-paths intersection) for
+  ``shared-state-race``.
 
 Resolution is deliberately pragmatic — the same one-level alias tracking
 as the per-file rules, extended across files.  Unresolvable calls (params,

@@ -32,7 +32,9 @@ from typing import Any, Dict, Optional
 from .summary import SUMMARY_FORMAT
 
 #: bump to invalidate every existing cache file (format/semantic changes)
-CACHE_FORMAT_VERSION = 1
+#: (2: graft-lint 3.0 summary schema — call-site lock sets, access
+#: records, spawn roots — and the shared-state-race rule)
+CACHE_FORMAT_VERSION = 2
 
 
 def default_cache_path() -> str:

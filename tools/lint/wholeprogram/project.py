@@ -183,6 +183,170 @@ class Project:
             return None
         return None
 
+    # -- thread roots + lock domination (graft-lint 3.0) --------------------
+
+    def resolve_class(self, mod: str, dotted: str, depth: int = 0
+                      ) -> Optional[Tuple[str, str]]:
+        """Resolve a possibly-imported class reference from inside ``mod``
+        to ``(defining module, class name)`` — the same binding walk as
+        :meth:`resolve_call`, stopping at a CLASS instead of a function
+        (handler classes handed to server ctors may have no ``__init__``
+        of their own, so the call-resolution path cannot find them)."""
+        if depth > _RESOLVE_DEPTH:
+            return None
+        s = self.modules.get(mod)
+        if s is None:
+            return None
+        parts = dotted.split(".")
+        head, tail = parts[0], parts[1:]
+        if not tail and head in self.classes.get(mod, ()):
+            return (mod, head)
+        target = s.bindings.get(head)
+        if target and target != head:
+            return self._resolve_class_qualified(
+                ".".join([target] + tail), depth + 1)
+        if target:  # plain `import pkg` binding: head == target
+            return self._resolve_class_qualified(dotted, depth + 1)
+        return None
+
+    def _resolve_class_qualified(self, dotted: str, depth: int
+                                 ) -> Optional[Tuple[str, str]]:
+        if depth > _RESOLVE_DEPTH:
+            return None
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.modules:
+                rest = parts[i:]
+                if len(rest) == 1 and rest[0] in self.classes.get(mod, ()):
+                    return (mod, rest[0])
+                target = self.modules[mod].bindings.get(rest[0])
+                if target:  # re-export: follow the __init__ binding
+                    return self._resolve_class_qualified(
+                        ".".join([target] + rest[1:]), depth + 1)
+                return None
+        return None
+
+    def thread_roots(self) -> List[Tuple[str, "FunctionInfo", str]]:
+        """Every discovered or configured thread entry point, as
+        ``(module, function, label)`` — one per distinct function.
+
+        Discovered roots: ``threading.Thread(target=…)`` /
+        ``threading.Timer`` targets resolved through the normal call
+        resolution (``self.<m>`` included), and the ``do_*`` methods of
+        any class handed to a ``ThreadingHTTPServer``-style ctor (each
+        request runs them on a server thread). Configured roots (the
+        ``thread_roots`` config table, path -> ["Class.method", "fn"])
+        name the callback seams discovery cannot see: public entry points
+        that run on CALLER threads, stream callbacks, Future resolution.
+        First label wins for a function reachable both ways."""
+        out: List[Tuple[str, FunctionInfo, str]] = []
+        seen: Set[Node] = set()
+
+        def add(m2: str, fi: FunctionInfo, label: str) -> None:
+            node = (m2, fi.qualname)
+            if node not in seen:
+                seen.add(node)
+                out.append((m2, fi, label))
+
+        for mod in sorted(self.modules):
+            s = self.modules[mod]
+            for kind, target, cls, _line in s.spawn_roots:
+                if kind == "thread":
+                    for m2, fi in self.resolve_call(mod, cls, target):
+                        add(m2, fi, f"thread '{m2}.{fi.qualname}'")
+                elif kind == "httpd":
+                    hit = self.resolve_class(mod, target)
+                    if hit is None:
+                        continue
+                    hm, hc = hit
+                    for (m2, c2, name), fi in sorted(self.methods.items()):
+                        if m2 == hm and c2 == hc and \
+                                name.startswith("do_"):
+                            add(m2, fi,
+                                f"http handler '{m2}.{fi.qualname}'")
+        from ..astutil import path_matches
+        cfg = self.config.get("thread_roots", {})
+        for cfg_path in sorted(cfg):
+            for mod in sorted(self.modules):
+                s = self.modules[mod]
+                if not path_matches(s.path, [cfg_path]):
+                    continue
+                for spec in cfg[cfg_path]:
+                    if "." in spec:
+                        c2, meth = spec.split(".", 1)
+                        fi = self.methods.get((mod, c2, meth))
+                        if fi is not None:
+                            add(mod, fi, f"entry '{mod}.{spec}'")
+                    else:
+                        for fi in self.fn_by_simple.get((mod, spec), []):
+                            add(mod, fi, f"entry '{mod}.{spec}'")
+        return out
+
+    def reachable_with_locks(self, mod: str, fi: "FunctionInfo"
+                             ) -> Tuple[Dict[Node, frozenset],
+                                        Dict[Node, Optional[Node]]]:
+        """Call-graph reachability from one thread root carrying the
+        MUST-HOLD lock set: ``held[node]`` is the set of locks provably
+        held on EVERY discovered path from the root to ``node`` (meet =
+        intersection, so a callee reached both locked and unlocked gets
+        the conservative empty set). ``parent`` keeps the first-discovery
+        edge for witness-path reconstruction (deterministic: sorted
+        callee iteration, FIFO worklist). Per-function call-site locks
+        come from ``call_locks`` — intersected per callee NAME first, so
+        a function calling ``g()`` under the lock and again outside it
+        propagates the unlocked set."""
+        if not hasattr(self, "_resolve_memo"):
+            self._resolve_memo: Dict[Tuple[str, str, str], list] = {}
+        if not hasattr(self, "_site_memo"):
+            self._site_memo: Dict[Node, List[Tuple[str, frozenset]]] = {}
+        memo = self._resolve_memo
+
+        def resolve(m: str, f: FunctionInfo, dn: str):
+            key = (m, f.cls or "", dn)
+            hit = memo.get(key)
+            if hit is None:
+                hit = self.resolve_call(m, f.cls, dn)
+                memo[key] = hit
+            return hit
+
+        start: Node = (mod, fi.qualname)
+        held: Dict[Node, frozenset] = {start: frozenset()}
+        parent: Dict[Node, Optional[Node]] = {start: None}
+        work: List[Node] = [start]
+        i = 0
+        while i < len(work):
+            node = work[i]
+            i += 1
+            m, _qn = node
+            f = self.fn_by_qual[node]
+            sites = self._site_memo.get(node)
+            if sites is None:
+                per_dn: Dict[str, frozenset] = {}
+                for dn, lrs, _line in f.call_locks:
+                    ids = frozenset(
+                        x for x in (self.lock_id(m, lr) for lr in lrs)
+                        if x is not None)
+                    per_dn[dn] = ids if dn not in per_dn \
+                        else (per_dn[dn] & ids)
+                sites = sorted(per_dn.items())
+                self._site_memo[node] = sites
+            cur = held[node]
+            for dn, site_locks in sites:
+                out_held = cur | site_locks
+                for m2, f2 in resolve(m, f, dn):
+                    n2 = (m2, f2.qualname)
+                    if n2 not in held:
+                        held[n2] = out_held
+                        parent[n2] = node
+                        work.append(n2)
+                    else:
+                        narrowed = held[n2] & out_held
+                        if narrowed != held[n2]:
+                            held[n2] = narrowed
+                            work.append(n2)
+        return held, parent
+
     # -- import graph -------------------------------------------------------
 
     def import_edges(self) -> List[Tuple[str, str, int]]:

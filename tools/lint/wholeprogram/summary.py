@@ -30,13 +30,15 @@ import ast
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ..astutil import (IMPURE_MODULES, IMPURE_PREFIXES, dotted_name,
-                       lock_ctor_in, mentions_device_value,
+from ..astutil import (IMPURE_MODULES, IMPURE_PREFIXES, MUTATORS,
+                       dotted_name, lock_ctor_in, mentions_device_value,
                        module_lock_defs, module_mutable_globals,
-                       path_matches, snippet)
+                       path_matches, root_name, safe_ctor_in, snippet)
 
 #: bump when the extracted shape changes so cached summaries self-invalidate
-SUMMARY_FORMAT = 1
+#: (2: graft-lint 3.0 — per-call held-lock sets, attribute-level access
+#: records, and spawn-root discovery for the shared-state-race rule)
+SUMMARY_FORMAT = 2
 
 _NP_CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
 
@@ -82,6 +84,16 @@ class FunctionInfo:
     nest_edges: List[Tuple[list, list, int]] = field(default_factory=list)
     calls_under_lock: List[Tuple[list, str, int]] = field(
         default_factory=list)
+    # graft-lint 3.0: one entry per call OCCURRENCE with the full lexical
+    # held-lock stack at that site — (dotted name, [lockrefs], line). The
+    # race rule intersects these per callee so a function called both
+    # locked and unlocked propagates the conservative (empty) set.
+    call_locks: List[Tuple[str, list, int]] = field(default_factory=list)
+    # attribute-level shared-state accesses with the lexical lock set held
+    # at each: ["self", Class, attr, "r"|"w", [lockrefs], line] for
+    # ``self.<attr>`` fields, ["glob", name, "r"|"w", [lockrefs], line]
+    # for module-level mutable globals (one-level alias tracked)
+    accesses: List[list] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"q": self.qualname, "n": self.name, "c": self.cls,
@@ -90,7 +102,9 @@ class FunctionInfo:
                 "sync": [list(x) for x in self.host_syncs],
                 "acq": [list(x) for x in self.acquires],
                 "nest": [list(x) for x in self.nest_edges],
-                "cul": [list(x) for x in self.calls_under_lock]}
+                "cul": [list(x) for x in self.calls_under_lock],
+                "cl": [list(x) for x in self.call_locks],
+                "acc": [list(x) for x in self.accesses]}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "FunctionInfo":
@@ -102,7 +116,10 @@ class FunctionInfo:
                    nest_edges=[(list(x[0]), list(x[1]), x[2])
                                for x in d["nest"]],
                    calls_under_lock=[(list(x[0]), x[1], x[2])
-                                     for x in d["cul"]])
+                                     for x in d["cul"]],
+                   call_locks=[(x[0], [list(lr) for lr in x[1]], x[2])
+                               for x in d["cl"]],
+                   accesses=[list(x) for x in d["acc"]])
 
 
 @dataclass
@@ -116,6 +133,12 @@ class ModuleSummary:
     locks: Dict[str, str] = field(default_factory=dict)
     class_locks: Dict[str, Dict[str, str]] = field(default_factory=dict)
     trace_roots: List[str] = field(default_factory=list)
+    # graft-lint 3.0 thread-root discovery: ["thread", target, cls, line]
+    # for ``threading.Thread(target=…)`` spawns (``cls`` = enclosing class,
+    # so ``self._loop`` targets resolve), ["httpd", HandlerClass, None,
+    # line] for ``ThreadingHTTPServer((…), Handler)`` — the handler's
+    # ``do_*`` methods run on per-request server threads
+    spawn_roots: List[list] = field(default_factory=list)
     pragmas: Dict[str, List[str]] = field(default_factory=dict)  # line -> names
     file_pragmas: List[str] = field(default_factory=list)
 
@@ -127,6 +150,7 @@ class ModuleSummary:
                 "mutable_globals": self.mutable_globals,
                 "locks": self.locks, "class_locks": self.class_locks,
                 "trace_roots": self.trace_roots,
+                "spawn_roots": [list(x) for x in self.spawn_roots],
                 "pragmas": self.pragmas,
                 "file_pragmas": self.file_pragmas}
 
@@ -142,6 +166,7 @@ class ModuleSummary:
                    class_locks={k: dict(v)
                                 for k, v in d["class_locks"].items()},
                    trace_roots=list(d["trace_roots"]),
+                   spawn_roots=[list(x) for x in d["spawn_roots"]],
                    pragmas={k: list(v) for k, v in d["pragmas"].items()},
                    file_pragmas=list(d["file_pragmas"]))
 
@@ -210,23 +235,96 @@ def _module_scope_imports(tree: ast.Module, module: str, is_pkg: bool
     return out
 
 
+def _self_assignments(node: ast.AST):
+    """Yield ``(attr, value)`` for every ``self.<attr> = value`` /
+    annotated-with-value assignment in ``node``'s subtree."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Assign):
+            targets, value = sub.targets, sub.value
+        elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+            targets, value = [sub.target], sub.value
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                yield t.attr, value
+
+
 def _class_lock_table(tree: ast.Module) -> Dict[str, Dict[str, str]]:
     out: Dict[str, Dict[str, str]] = {}
     for node in ast.walk(tree):
         if not isinstance(node, ast.ClassDef):
             continue
         d: Dict[str, str] = {}
-        for sub in ast.walk(node):
-            if isinstance(sub, ast.Assign):
-                for t in sub.targets:
-                    if isinstance(t, ast.Attribute) and \
-                            isinstance(t.value, ast.Name) and \
-                            t.value.id == "self":
-                        kind = lock_ctor_in(sub.value)
-                        if kind:
-                            d[t.attr] = kind
+        for attr, value in _self_assignments(node):
+            kind = lock_ctor_in(value)
+            if kind:
+                d[attr] = kind
         if d:
             out.setdefault(node.name, {}).update(d)
+    return out
+
+
+def _class_safe_attr_table(tree: ast.Module) -> Dict[str, Set[str]]:
+    """Per class: ``self.<attr>`` fields ONLY ever assigned an internally-
+    synchronized object (Event/Queue/…) — out of scope for the race rule.
+    An attr that is ALSO assigned something else anywhere in the class
+    (e.g. rebound to None on teardown) stays in scope."""
+    safe: Dict[str, Set[str]] = {}
+    unsafe: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        s = safe.setdefault(node.name, set())
+        u = unsafe.setdefault(node.name, set())
+        for attr, value in _self_assignments(node):
+            if safe_ctor_in(value) or lock_ctor_in(value):
+                s.add(attr)
+            else:
+                u.add(attr)
+    return {cls: attrs - unsafe.get(cls, set())
+            for cls, attrs in safe.items()}
+
+
+_THREAD_CTORS = ("Thread", "Timer")
+_HTTPD_CTORS = ("HTTPServer", "TCPServer", "UDPServer")
+
+
+def _spawn_sites(tree: ast.Module) -> List[list]:
+    """Thread-root spawn sites, with the enclosing class tracked so
+    ``target=self._loop`` resolves at project time."""
+    out: List[list] = []
+
+    def scan_call(node: ast.Call, cls: Optional[str]) -> None:
+        dn = dotted_name(node.func)
+        if not dn:
+            return
+        last = dn.split(".")[-1]
+        if last in _THREAD_CTORS:
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = dotted_name(kw.value)
+            if last == "Timer" and target is None and len(node.args) >= 2:
+                target = dotted_name(node.args[1])
+            if target:
+                out.append(["thread", target, cls, node.lineno])
+        elif last.endswith(_HTTPD_CTORS) and len(node.args) >= 2:
+            # full dotted name: the handler class may live in another
+            # module (resolved through bindings at project time)
+            handler = dotted_name(node.args[1])
+            if handler:
+                out.append(["httpd", handler, None, node.lineno])
+
+    def rec(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            c2 = child.name if isinstance(child, ast.ClassDef) else cls
+            if isinstance(child, ast.Call):
+                scan_call(child, cls)
+            rec(child, c2)
+
+    rec(tree, None)
     return out
 
 
@@ -314,7 +412,9 @@ def _local_names(fn: ast.AST) -> Set[str]:
 def _scan_function(fn: ast.AST, cls: Optional[str],
                    mutables: Set[str], bindings: Dict[str, str],
                    module_locks: Dict[str, str],
-                   class_locks: Dict[str, Dict[str, str]]) -> Dict[str, list]:
+                   class_locks: Dict[str, Dict[str, str]],
+                   safe_attrs: Optional[Dict[str, Set[str]]] = None
+                   ) -> Dict[str, list]:
     calls: List[Tuple[str, int]] = []
     seen_calls: Set[str] = set()
     impure: List[Tuple[str, str, int]] = []
@@ -381,6 +481,53 @@ def _scan_function(fn: ast.AST, cls: Optional[str],
     acquires: List[Tuple[list, int]] = []
     nest_edges: List[Tuple[list, list, int]] = []
     calls_under_lock: List[Tuple[list, str, int]] = []
+    call_locks: List[Tuple[str, list, int]] = []
+    accesses: List[list] = []
+    # shared-state access tracking (graft-lint 3.0): which self.<attr>
+    # fields are in scope (not locks, not Event/Queue-style primitives),
+    # and one-level aliases of module mutable globals
+    skip_attrs: Set[str] = set()
+    if cls is not None:
+        skip_attrs |= set(class_locks.get(cls, {}))
+        skip_attrs |= (safe_attrs or {}).get(cls, set())
+    galias = {g: g for g in mutables}
+    gdecls: Set[str] = set()   # `global X` names: rebinds hit the module
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Global):
+            gdecls.update(sub.names)
+        elif isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name) and \
+                isinstance(sub.value, (ast.Name, ast.Subscript,
+                                       ast.Attribute)):
+            src = root_name(sub.value)
+            if src in galias and sub.targets[0].id not in mutables:
+                galias[sub.targets[0].id] = galias[src]
+
+    def self_attr(expr) -> Optional[str]:
+        """The first attribute of a ``self.<attr>…`` chain, else None."""
+        node = expr
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                return node.attr
+            node = node.value
+        return None
+
+    def add_access(expr, rw: str, held: list, line: int) -> None:
+        attr = self_attr(expr) if cls is not None else None
+        if attr is not None:
+            if attr not in skip_attrs:
+                accesses.append(["self", cls, attr, rw,
+                                 [list(h) for h in held], line])
+            return
+        root = root_name(expr)
+        if root is None or root not in galias:
+            return
+        if root in mutables and root in locals_ and root not in gdecls:
+            return  # the global name is shadowed by a local here
+        accesses.append(["glob", galias[root], rw,
+                         [list(h) for h in held], line])
 
     def lockref(expr):
         if isinstance(expr, ast.Name):
@@ -413,11 +560,51 @@ def _scan_function(fn: ast.AST, cls: Optional[str],
             for child in node.body:
                 rec(child, new)
             return
-        if isinstance(node, ast.Call) and held:
+        if isinstance(node, ast.Call):
             dn = dotted_name(node.func)
             if dn:
+                call_locks.append((dn, [list(h) for h in held],
+                                   node.lineno))
                 for h in held:
                     calls_under_lock.append((h, dn, node.lineno))
+            # in-place mutation through a method: self.attr.append(...)
+            # or GLOBAL.setdefault(...) — a WRITE to the container
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in MUTATORS:
+                add_access(node.func.value, "w", held, node.lineno)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if isinstance(node, ast.AnnAssign):
+                # annotation WITHOUT a value binds nothing — not a write
+                targets = [node.target] if node.value is not None else []
+            else:
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    add_access(t, "w", held, node.lineno)
+                elif isinstance(t, ast.Name) and t.id in gdecls and \
+                        t.id in mutables:
+                    # `global X; X = ...` — the classic global-swap write
+                    add_access(t, "w", held, node.lineno)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        if isinstance(el, (ast.Attribute, ast.Subscript)):
+                            add_access(el, "w", held, node.lineno)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    add_access(t, "w", held, node.lineno)
+                elif isinstance(t, ast.Name) and t.id in gdecls and \
+                        t.id in mutables:
+                    add_access(t, "w", held, node.lineno)
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            add_access(node, "r", held, node.lineno)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                and node.id in galias:
+            add_access(node, "r", held, node.lineno)
         for child in ast.iter_child_nodes(node):
             rec(child, held)
 
@@ -426,7 +613,8 @@ def _scan_function(fn: ast.AST, cls: Optional[str],
 
     return {"calls": calls, "impure": impure, "host_syncs": host_syncs,
             "acquires": acquires, "nest_edges": nest_edges,
-            "calls_under_lock": calls_under_lock}
+            "calls_under_lock": calls_under_lock,
+            "call_locks": call_locks, "accesses": accesses}
 
 
 def build_summary(path: str, tree: ast.Module, lines: List[str],
@@ -442,18 +630,20 @@ def build_summary(path: str, tree: ast.Module, lines: List[str],
     mutables = module_mutable_globals(tree)
     module_locks = module_lock_defs(tree)
     class_locks = _class_lock_table(tree)
+    safe_attrs = _class_safe_attr_table(tree)
     per_line, file_level = _pragma_tables(lines)
 
     functions: List[FunctionInfo] = []
     for qualname, name, cls, node in _walk_functions(tree):
         data = _scan_function(node, cls, mutables, bindings, module_locks,
-                              class_locks)
+                              class_locks, safe_attrs)
         functions.append(FunctionInfo(
             qualname=qualname, name=name, cls=cls, line=node.lineno,
             calls=data["calls"], impure=data["impure"],
             host_syncs=data["host_syncs"], acquires=data["acquires"],
             nest_edges=data["nest_edges"],
-            calls_under_lock=data["calls_under_lock"]))
+            calls_under_lock=data["calls_under_lock"],
+            call_locks=data["call_locks"], accesses=data["accesses"]))
 
     return ModuleSummary(
         path=path, module=module, bindings=bindings,
@@ -462,5 +652,6 @@ def build_summary(path: str, tree: ast.Module, lines: List[str],
         mutable_globals=sorted(mutables),
         locks=module_locks, class_locks=class_locks,
         trace_roots=sorted(_trace_root_names(tree, path, config)),
+        spawn_roots=_spawn_sites(tree),
         pragmas={str(k): sorted(v) for k, v in per_line.items()},
         file_pragmas=sorted(file_level))
