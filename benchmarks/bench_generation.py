@@ -46,7 +46,7 @@ import numpy as np
 SERVING_RESULT_FIELDS = (
     "benchmark", "params", "layers", "hidden", "dtype", "kv_dtype",
     "page_size", "prompt", "tokens", "single_stream_tokens_per_sec",
-    "serving", "paged_attention", "context_sweep", "resilience",
+    "serving", "paged_attention", "context_sweep", "resilience", "http",
     "speedup_vs_single_stream", "device")
 SERVING_ROW_FIELDS = (
     "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "queue_wait_ms",
@@ -69,6 +69,18 @@ PAGED_ATTENTION_FIELDS = (
 CONTEXT_SWEEP_FIELDS = (
     "context", "decode_tokens_per_sec", "attn_bytes_per_token_live",
     "attn_bytes_per_token_dense")
+# the HTTP front-door leg (ISSUE 15, --serving --http): end-to-end
+# request latency THROUGH the router + streaming front door vs the same
+# workload through in-process Router.submit — the per-request front-door
+# overhead of record — plus the router's resilience counters, which a
+# healthy run reports all-zero (any nonzero in a bench diff means the
+# measured run itself degraded: a replica failed over, a request was
+# hedged or rejected)
+HTTP_RESULT_FIELDS = (
+    "replicas", "requests", "clients", "aggregate_tokens_per_sec",
+    "e2e_p50_ms", "e2e_p99_ms", "inproc_p50_ms", "overhead_p50_ms",
+    "router")
+HTTP_ROUTER_FIELDS = ("retries", "failovers", "hedges", "rejected")
 
 
 def _storage_bytes(kv_dtype: str, compute_dtype: str) -> int:
@@ -131,6 +143,10 @@ def main() -> None:
                          "--serving-batches with greedy parity vs the bs=1 "
                          "per-token loop")
     ap.add_argument("--serving-batches", default="1,4,16")
+    ap.add_argument("--http", action="store_true",
+                    help="with --serving: add the front-door leg — e2e "
+                         "p50/p99 and tok/s through the K=2 router + "
+                         "streaming HTTP tier vs in-process submit()")
     ap.add_argument("--kv-dtype", default="native",
                     choices=("native", "bf16", "int8"))
     ap.add_argument("--page-size", type=int, default=64)
@@ -446,6 +462,9 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         "paged_attention block drifted from PAGED_ATTENTION_FIELDS"
     sweep = _context_sweep(args, serving, paddle, prefill_raw, lm_step,
                            L=L, H=H, E=E, V=V, dtype=dtype)
+    http_block = _run_http(args, serving, obs, prefill_raw, lm_step,
+                           n_new=n_new, L=L, H=H, E=E, V=V, M=M,
+                           dtype=dtype) if args.http else None
     rejected = snap.get("serving.rejected_total", {}) or {}
     trips = snap.get("serving.watchdog_trips_total", {}) or {}
     fire = {
@@ -467,6 +486,7 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         "paged_attention": paged_block,
         "context_sweep": sweep,
         "resilience": fire,
+        "http": http_block,
         "speedup_vs_single_stream": round(top / single_rate, 2),
         "device": str(jax.devices()[0]),
     }
@@ -482,6 +502,128 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         print(f"PAGED SUSPECT: {paged_block['suspect_reasons']}",
               file=sys.stderr)
         sys.exit(1)
+
+
+def _run_http(args, serving, obs, prefill_raw, lm_step, *, n_new, L, H, E,
+              V, M, dtype):
+    """The front-door leg (ISSUE 15): the SAME workload through (a)
+    in-process ``Router.submit`` over K=2 replicas and (b) the streaming
+    HTTP front door over that router, from ``clients`` concurrent client
+    threads. Reports e2e p50/p99 and aggregate tok/s for the HTTP leg,
+    the in-process p50, and their difference — the per-request front-door
+    overhead of record — plus the router's resilience counters (all-zero
+    is the healthy-run claim, pinned in test_bench_selfdefense)."""
+    import http.client
+    import json as _json
+    import threading
+
+    replicas, clients, per_client = 2, 4, 2
+    n_req = clients * per_client
+    page_size = min(args.page_size, M)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, V, (args.prompt,), dtype=np.int32)
+               for _ in range(n_req)]
+
+    engines = []
+    for i in range(replicas):
+        cfg = serving.ServingConfig(
+            num_layers=L, num_heads=H, head_dim=E // H, max_len=M,
+            max_batch=4, buckets=(1, 4), page_size=page_size,
+            kv_dtype=args.kv_dtype, compute_dtype=dtype, name=f"r{i}")
+        engines.append((f"r{i}", serving.Engine(prefill_raw, lm_step, cfg)
+                        .warmup(prompt_lens=[args.prompt])))
+    router = serving.Router(engines).start()
+    fd = serving.FrontDoor(router)
+
+    def run_clients(fn):
+        """fn(prompt) -> token count; returns (per-request seconds,
+        wall seconds). A failed request fails the BENCH, not just its
+        worker thread — numbers from a degraded run must never print."""
+        lat, errors, lock = [], [], threading.Lock()
+
+        def worker(chunk):
+            for p in chunk:
+                try:
+                    t0 = time.perf_counter()
+                    ntok = fn(p)
+                    dt = time.perf_counter() - t0
+                    if ntok != n_new:
+                        raise AssertionError(
+                            f"short response: {ntok}/{n_new} tokens")
+                except Exception as e:
+                    with lock:
+                        errors.append(e)
+                    return
+                with lock:
+                    lat.append(dt)
+
+        chunks = [prompts[c::clients] for c in range(clients)]
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(c,))
+                   for c in chunks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors or len(lat) != n_req:
+            raise RuntimeError(
+                f"http bench leg degraded: {len(lat)}/{n_req} requests "
+                f"completed; first error: {errors[0] if errors else None}")
+        return lat, time.perf_counter() - t0
+
+    def inproc(p):
+        fut = router.submit(serving.GenerationRequest(
+            p, max_new_tokens=n_new))
+        return len(fut.result(timeout=300).tokens)
+
+    def via_http(p):
+        conn = http.client.HTTPConnection(fd.host, fd.port, timeout=300)
+        try:
+            conn.request("POST", "/v1/generate", body=_json.dumps({
+                "prompt": p.tolist(), "max_new_tokens": n_new,
+                "stream": True}).encode())
+            resp = conn.getresponse()
+            raw = resp.read().decode("utf-8")
+            toks = sum(1 for ln in raw.splitlines()
+                       if ln.startswith('data: {"token"'))
+            assert resp.status == 200 and "event: done" in raw
+            return toks
+        finally:
+            conn.close()
+
+    try:
+        run_clients(inproc)                      # warm both paths
+        inproc_lat, _ = run_clients(inproc)
+        http_lat, http_wall = run_clients(via_http)
+    finally:
+        router.stop(drain=True, timeout=60)
+        fd.close()
+
+    snap = obs.snapshot()
+    rejected = snap.get("serving.router.rejected_total", {}) or {}
+    block = {
+        "replicas": replicas, "requests": n_req, "clients": clients,
+        "aggregate_tokens_per_sec": round(n_req * n_new / http_wall, 1),
+        "e2e_p50_ms": round(1e3 * float(np.percentile(http_lat, 50)), 2),
+        "e2e_p99_ms": round(1e3 * float(np.percentile(http_lat, 99)), 2),
+        "inproc_p50_ms": round(
+            1e3 * float(np.percentile(inproc_lat, 50)), 2),
+        "overhead_p50_ms": round(
+            1e3 * float(np.percentile(http_lat, 50)
+                        - np.percentile(inproc_lat, 50)), 2),
+        "router": {
+            "retries": snap.get("serving.router.retries_total", 0) or 0,
+            "failovers": snap.get(
+                "serving.router.failovers_total", 0) or 0,
+            "hedges": snap.get("serving.router.hedges_total", 0) or 0,
+            "rejected": sum(rejected.values()),
+        },
+    }
+    assert set(block) == set(HTTP_RESULT_FIELDS), \
+        "http block drifted from HTTP_RESULT_FIELDS"
+    assert set(block["router"]) == set(HTTP_ROUTER_FIELDS), \
+        "http router block drifted from HTTP_ROUTER_FIELDS"
+    return block
 
 
 def _context_sweep(args, serving, paddle, prefill_raw, lm_step, *, L, H, E,

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import functools
+import threading
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -25,6 +26,13 @@ _ENABLED = True
 _FALLBACK = object()  # cache sentinel: this signature graph-breaks to eager
 _SEGMENTED = object()  # cache sentinel: run via lazy compiled segments
 
+# serializes trace/invoke/rebind across threads (ISSUE 15: in-process
+# multi-replica serving runs one step thread per engine): the global state
+# registry is threaded through every compiled call, so interleaved calls
+# would capture each other's tracers. RLock — a dead-state rebuild or a
+# nested fallback re-enters on the same thread.
+_INVOKE_LOCK = threading.RLock()
+
 
 def _is_trace_failure(e: BaseException) -> bool:
     """Graph breaks are TRACE/LOWERING failures only (tensor-dependent Python
@@ -43,10 +51,12 @@ _pretrace_refs: List = []
 
 
 def register_pretrace_hook(obj) -> None:
-    _pretrace_refs.append(weakref.ref(obj))
+    with _INVOKE_LOCK:
+        _pretrace_refs.append(weakref.ref(obj))
 
 
-def _run_pretrace_hooks() -> None:
+def _run_pretrace_hooks_locked() -> None:
+    """Caller holds ``_INVOKE_LOCK`` (the ``_call_locked`` path)."""
     alive = []
     for r in _pretrace_refs:
         o = r()
@@ -144,12 +154,22 @@ class StaticFunction:
             if self._iters > 1:
                 return self._run_iters_eager(args, kwargs)
             return self._fn(*args, **kwargs)
+        # one compiled call at a time, PROCESS-WIDE (ISSUE 15): every
+        # StaticFunction threads the same global state registry (params,
+        # RNG key) through trace + post-call rebinding — two threads (e.g.
+        # two serving replicas in one process) interleaving here leak each
+        # other's tracers into the registry. Reentrant, so a rebuild
+        # recursion or a nested eager fallback on the SAME thread is fine;
+        # uncontended for every single-threaded caller.
+        with _INVOKE_LOCK:
+            return self._call_locked(*args, **kwargs)
 
+    def _call_locked(self, *args, **kwargs):
         # runs on every call (not just cache misses): a state_dict load after
         # compilation must be reconciled into derived state (fp32 masters)
         # BEFORE the compiled step reads it — masters are carried state, so a
         # data refresh needs no retrace
-        _run_pretrace_hooks()
+        _run_pretrace_hooks_locked()
 
         leaves, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=_is_tensor)

@@ -59,7 +59,7 @@ __all__ = [
     "events", "clear", "dropped", "make_event", "span_problems",
     "export_chrome", "trace_dir",
     "flight_recorder", "flight_dump",
-    "heartbeat", "heartbeat_clear", "health",
+    "heartbeat", "heartbeat_clear", "health", "beacon_detail",
 ]
 
 _log = logging.getLogger(__name__)
@@ -609,7 +609,15 @@ def heartbeat_clear(name: str) -> None:
 
 def health() -> Dict[str, Any]:
     """The /healthz document: per-component age vs ttl; overall ``ok``
-    only when every registered beacon is fresh and ok."""
+    only when every registered beacon is fresh and ok.
+
+    Each component carries the full per-beacon detail (ISSUE 15 — the
+    router and the front door route on it, a multi-replica process
+    registers one ``serving.engine.<replica>`` beacon per engine):
+    ``age_s`` since the last beat, the beacon's ``ttl_s``, an explicit
+    ``stale`` bit (age past ttl — a loop thread wedged in a compiled call
+    stops beating), and ``ok`` (fresh AND the last beat reported
+    healthy) — not just one process-global staleness bit."""
     now = time.monotonic()
     comps: Dict[str, Any] = {}
     healthy = True
@@ -617,13 +625,31 @@ def health() -> Dict[str, Any]:
     # threads (an engine's first beat racing a scrape), and iterating the
     # live dict would raise mid-/healthz
     for name, b in sorted(dict(_HEALTH.beats).items()):
-        age = now - b["at"]
-        alive = b["ok"] and age <= b["ttl_s"]
-        healthy = healthy and alive
-        comps[name] = {"age_s": round(age, 3), "ttl_s": b["ttl_s"],
-                       "ok": alive}
+        comps[name] = c = _beacon_component(b, now)
+        healthy = healthy and c["ok"]
     return {"status": "ok" if healthy else "unhealthy",
             "components": comps, "pid": os.getpid()}
+
+
+def _beacon_component(b: Dict[str, Any], now: float) -> Dict[str, Any]:
+    """One beacon's component document — the single definition of the
+    stale/ok semantics both :func:`health` and :func:`beacon_detail`
+    report (they must never drift: the router's rotation signal IS the
+    /healthz document)."""
+    age = now - b["at"]
+    stale = age > b["ttl_s"]
+    return {"age_s": round(age, 3), "ttl_s": b["ttl_s"], "stale": stale,
+            "ok": b["ok"] and not stale}
+
+
+def beacon_detail(name: str) -> Optional[Dict[str, Any]]:
+    """One beacon's /healthz component (or None when it never beat):
+    the router's per-replica liveness probe — a replica whose engine
+    beacon is ``stale`` leaves the rotation without an HTTP scrape."""
+    b = dict(_HEALTH.beats).get(name)
+    if b is None:
+        return None
+    return _beacon_component(b, time.monotonic())
 
 
 _sync_op_hook()

@@ -52,6 +52,20 @@ Sites threaded through the framework (exact-match tags):
                       error degrades the graceful drain to an immediate
                       stop (stragglers still resolve; the no-stranded-
                       futures invariant outranks graceful finish)
+``router.pick``       ``serving.router`` placement attempt, before the
+                      pick-2 sample — an injected error burns one of the
+                      request's bounded placement attempts
+``router.forward``    before a replica ``submit`` attempt — an injected
+                      error is a transport failure BEFORE admission
+                      (never admitted, so trying another replica keeps
+                      the at-most-once contract), counted against the
+                      replica's circuit breaker
+``http.write``        ``serving.http`` before every streamed write — an
+                      injected error is retried once with the identical
+                      payload (the bytes never left the process); a
+                      second consecutive fault is a client disconnect
+                      (the request is cancelled upstream, its pages
+                      free)
 ``train.step``        ``resilience.trainer`` step attempt entry, inside
                       the armed train watchdog window, before the step
                       closure runs — ``error`` drives the per-step retry

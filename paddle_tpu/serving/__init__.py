@@ -52,10 +52,15 @@ from .scheduler import (DeadlineExceeded, GenerationRequest,  # noqa: F401
 from .engine import (DrainTimeout, Engine, EngineStopped,  # noqa: F401
                      ServingConfig)
 from .watchdog import StepWatchdog, WatchdogTimeout  # noqa: F401
+from .router import (NoHealthyReplica, Replica, Router,  # noqa: F401
+                     RouterConfig)
+from .http import FrontDoor, retry_after_s, status_for  # noqa: F401
 
 __all__ = [
     "KVCacheConfig", "PagedKVCache", "PagedDecodeCache",
     "GenerationRequest", "GenerationResult", "QueueFull", "Scheduler",
     "DeadlineExceeded", "Engine", "ServingConfig",
     "EngineStopped", "DrainTimeout", "StepWatchdog", "WatchdogTimeout",
+    "NoHealthyReplica", "Replica", "Router", "RouterConfig",
+    "FrontDoor", "status_for", "retry_after_s",
 ]

@@ -176,6 +176,11 @@ class ServingConfig:
     num_heads: int
     head_dim: int
     max_len: int
+    # replica identity (ISSUE 15): names this engine's liveness beacon
+    # ``serving.engine.<name>`` so a multi-replica process reports one
+    # per-replica /healthz component (the router's rotation signal);
+    # empty keeps the single-engine beacon name ``serving.engine``
+    name: str = ""
     max_batch: int = 16
     buckets: Tuple[int, ...] = (1, 4, 16)
     max_queue: int = 64
@@ -310,9 +315,19 @@ class Engine:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._draining = threading.Event()
+        # how the ACTIVE drain resolves stragglers ("fail"|"requeue"):
+        # written by stop() under _slot_lock before the straggler sweep,
+        # read by a late-returning _admit_one that landed after the sweep
+        # (ISSUE 15: the wedged-mid-admission window)
+        self._drain_on_timeout = "fail"
         self._thread: Optional[threading.Thread] = None
         self._watchdog: Optional[StepWatchdog] = (
             StepWatchdog(config.watchdog_s) if config.watchdog_s else None)
+        # per-replica beacon name (ISSUE 15): one /healthz component per
+        # engine, so the router can take ONE wedged replica out of
+        # rotation instead of reading a process-global staleness bit
+        self._beacon = (f"serving.engine.{config.name}" if config.name
+                        else "serving.engine")
         # ISSUE 12: one trace track for the engine's own batched steps
         # (requests carry their own), and the opt-in scrape endpoint
         self._engine_trace = None
@@ -510,6 +525,22 @@ class Engine:
     def queue_depth(self) -> int:
         return self.scheduler.queue_depth
 
+    @property
+    def name(self) -> str:
+        """Replica name ("" for a single-engine process)."""
+        return self.config.name
+
+    @property
+    def beacon(self) -> str:
+        """This engine's /healthz component name (ISSUE 15)."""
+        return self._beacon
+
+    @property
+    def draining(self) -> bool:
+        """True once ``stop(drain=...)`` latched new admissions off: the
+        router's marks-out-of-rotation-before-the-drain signal."""
+        return self._draining.is_set()
+
     # ------------------------------------------------------------------
     # the step loop
     # ------------------------------------------------------------------
@@ -517,7 +548,7 @@ class Engine:
         """One step boundary: evict cancellations, admit what fits, run
         ONE batched decode step. Returns False when there was nothing to
         do (the idle step — no program runs, no device touch)."""
-        _trace.heartbeat("serving.engine", ttl_s=_HEARTBEAT_TTL_S)
+        _trace.heartbeat(self._beacon, ttl_s=_HEARTBEAT_TTL_S)
         progressed = self._process_cancellations()
         # draining latches out NEW admissions only: slots evicted by
         # crash-recovery mid-drain still re-admit, or the drain would
@@ -662,11 +693,26 @@ class Engine:
         if self._watchdog is not None:
             self._watchdog.stop()
         if drain:
+            with self._slot_lock:
+                self._drain_on_timeout = on_timeout
+            # a wedged loop thread may be MID-ADMISSION (pending popped
+            # from the queue, prefill in flight): give that short window
+            # one bounded grace to land, or the pending would be in
+            # neither the queue nor the slots when the sweep runs. If it
+            # still lands later, _admit_one's late-admission guard
+            # resolves it per _drain_on_timeout — no Future is stranded
+            # either way.
+            grace = time.monotonic() + _JOIN_GRACE_S
+            while time.monotonic() < grace:
+                with self._slot_lock:
+                    if self._in_transit == 0:
+                        break
+                jitter_sleep(0.002)
             self._resolve_stragglers(on_timeout)
         # a cleanly stopped engine is not a liveness failure; and with
         # PADDLE_TPU_TRACE=on + a TRACE_DIR, leave the operator a
         # Perfetto-loadable trace of the run
-        _trace.heartbeat_clear("serving.engine")
+        _trace.heartbeat_clear(self._beacon)
         _trace.maybe_export_chrome("serving")
 
     def _resolve_stragglers(self, on_timeout: str) -> None:
@@ -852,6 +898,25 @@ class Engine:
         # the caller's thread (ISSUE 14: shared-state-race)
         with self._slot_lock:
             self._slots.append(slot)
+            late_dead = self._stop.is_set() and self._draining.is_set()
+            mode = self._drain_on_timeout
+        if late_dead:
+            # ISSUE 15: this admission was in flight on a wedged loop
+            # thread when a budgeted drain gave up and swept stragglers —
+            # nobody will ever step this slot, so resolve it NOW per the
+            # drain's mode (concurrent sweep is fine: _release decides
+            # each slot's winner exactly once). No token was emitted yet,
+            # so a requeue re-prefills bit-identically on restart.
+            if mode == "requeue":
+                if self._release(slot):
+                    pending.replay_tokens = list(slot.tokens)
+                    self.scheduler.requeue([pending])
+            else:
+                self._finish_error(slot, DrainTimeout(
+                    f"request {req.request_id} admitted after the drain "
+                    f"resolved its stragglers — evicted with "
+                    f"{len(slot.tokens)} tokens"))
+            return "ok"
         self._emit_token(slot, first_tok, now, first=True)
         return "ok"
 

@@ -83,7 +83,20 @@ _req_ids = itertools.count()
 
 
 class QueueFull(RuntimeError):
-    """submit() on a queue already holding ``max_queue`` requests."""
+    """submit() on a queue already holding ``max_queue`` requests.
+
+    Carries the backpressure detail the HTTP tier maps to a 429 +
+    ``Retry-After`` (ISSUE 15): the queue ``depth``/``capacity`` at
+    rejection and the scheduler's EWMA ``estimated_wait_s`` at that
+    instant — the honest "come back in N seconds" number, derived from
+    the measured admission drain rate rather than a fixed constant."""
+
+    def __init__(self, msg: str, *, depth: int = 0, capacity: int = 0,
+                 estimated_wait_s: float = 0.0):
+        super().__init__(msg)
+        self.depth = depth
+        self.capacity = capacity
+        self.estimated_wait_s = estimated_wait_s
 
 
 @dataclass(eq=False)   # identity equality: ``prompt`` is an ndarray, and a
@@ -228,7 +241,9 @@ class Scheduler:
                 _trace.instant("serving.rejected", parent=trace_ctx,
                                rid=request.request_id, reason="queue_full")
                 raise QueueFull(
-                    f"serving queue full ({depth}/{self.max_queue} pending)")
+                    f"serving queue full ({depth}/{self.max_queue} pending)",
+                    depth=depth, capacity=self.max_queue,
+                    estimated_wait_s=self._estimated_wait_locked())
             # reject-on-arrival: queueing work whose wait estimate already
             # blows its budget only delays the DeadlineExceeded and steals
             # drain rate from requests that can still make theirs
@@ -243,10 +258,16 @@ class Scheduler:
                 _trace.instant("serving.rejected", parent=trace_ctx,
                                rid=request.request_id, reason="shed",
                                estimated_wait_s=round(est, 4))
-                raise DeadlineExceeded(
+                exc = DeadlineExceeded(
                     f"request {request.request_id} shed on arrival: "
                     f"estimated queue wait {est:.3f}s exceeds its "
                     f"{budget:.3f}s budget (queue depth {depth})")
+                # the same backpressure detail QueueFull carries: the HTTP
+                # tier derives Retry-After from it (ISSUE 15)
+                exc.depth = depth
+                exc.capacity = self.max_queue
+                exc.estimated_wait_s = est
+                raise exc
             self._queue.append(_Pending(request, fut, submit_time,
                                         queued_at=submit_time,
                                         trace_ctx=trace_ctx))

@@ -463,6 +463,37 @@ def test_serving_bench_wired_into_main():
     assert "--serving" in src and "_run_serving" in src
     assert "--kv-dtype" in src        # the int8 leg is reachable from CLI
     assert "--context-sweep" in src   # the long-context leg (ISSUE 13)
+    assert "--http" in src            # the front-door leg (ISSUE 15)
+
+
+def test_http_bench_pins_schema():
+    # the --serving --http front-door leg (ISSUE 15): e2e latency through
+    # the router + streaming HTTP tier vs in-process submit(), with the
+    # router's resilience counters — all-zero-on-healthy is the claim of
+    # record, so a bench diff showing retries/failovers/hedges/rejections
+    # means the measured run itself degraded
+    mod = _load_bench_generation()
+    assert set(mod.HTTP_RESULT_FIELDS) == {
+        "replicas", "requests", "clients", "aggregate_tokens_per_sec",
+        "e2e_p50_ms", "e2e_p99_ms", "inproc_p50_ms", "overhead_p50_ms",
+        "router"}
+    assert set(mod.HTTP_ROUTER_FIELDS) == {
+        "retries", "failovers", "hedges", "rejected"}
+    assert "http" in mod.SERVING_RESULT_FIELDS
+    import inspect
+    src = inspect.getsource(mod._run_http)
+    # the block is asserted against the pinned schema at emit time, and
+    # every pinned field is actually emitted
+    assert "HTTP_RESULT_FIELDS" in src and "HTTP_ROUTER_FIELDS" in src
+    for field in mod.HTTP_RESULT_FIELDS + mod.HTTP_ROUTER_FIELDS:
+        assert f'"{field}"' in src, field
+    # the front-door overhead is DERIVED from the two measured p50s, and
+    # the leg measures both paths over the same router + prompts
+    assert "overhead_p50_ms" in src and "inproc" in src
+    assert "FrontDoor" in src and "Router" in src
+    # wired: _run_serving emits the block (None without --http)
+    serving_src = inspect.getsource(mod._run_serving)
+    assert "_run_http" in serving_src and "args.http" in serving_src
 
 
 # ---------------------------------------------------------------------------

@@ -1195,3 +1195,66 @@ def test_shipped_tree_layer_dag_has_no_back_edges():
     assert any("paddle_tpu.sparse" in m for m in cycles)
     assert any("paddle_tpu.distribution" in m for m in cycles)
     assert DEFAULT_CONFIG["import_layers"][0]["name"] == "foundation"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: the HTTP serving tier's lint-config membership is pinned — the
+# front door and the router must stay in the strict poll tier, in the api
+# import layer, and in the race detector's thread-root table
+# ---------------------------------------------------------------------------
+
+def test_http_serving_tier_lint_config_membership():
+    from tools.lint.engine import DEFAULT_CONFIG
+
+    # naked-retry strict tier: any in-loop time.sleep in the HTTP tier is
+    # a finding (serving-side threads poll via resilience.jitter_sleep)
+    poll = DEFAULT_CONFIG["poll_loop_paths"]
+    assert "paddle_tpu/serving" in poll
+    assert "paddle_tpu/serving/http.py" in poll
+    assert "paddle_tpu/serving/router.py" in poll
+
+    # import layering: the serving tier (front door included) is api-layer
+    api = next(layer for layer in DEFAULT_CONFIG["import_layers"]
+               if layer["name"] == "api")
+    assert "paddle_tpu.serving" in api["prefixes"]
+
+    # shared-state-race roots: the router's caller-thread surface, its
+    # health-poll thread, and the Future-resolution seam are registered
+    roots = DEFAULT_CONFIG["thread_roots"]
+    router_roots = roots["paddle_tpu/serving/router.py"]
+    for entry in ("Router.submit", "Router.stop", "Router.drain_replica",
+                  "Router._poll_loop", "Router._on_replica_done"):
+        assert entry in router_roots, entry
+    # the shared scaffolding's shutdown path covers BOTH endpoints
+    assert "ServerHost.close" in roots["paddle_tpu/observability/http.py"]
+
+
+def test_http_serving_tier_thread_roots_resolve_on_shipped_tree():
+    """The registered router roots and the front door's discovered do_*
+    handler methods all resolve to real functions on the shipped tree —
+    a rename breaks THIS test, not silently the race analysis."""
+    import ast
+    import os
+
+    from tools.lint.engine import (DEFAULT_CONFIG, REPO_ROOT,
+                                   iter_python_files)
+    from tools.lint.wholeprogram.project import Project
+    from tools.lint.wholeprogram.summary import build_summary
+
+    summaries = {}
+    for abspath in iter_python_files(["paddle_tpu/serving",
+                                      "paddle_tpu/observability"]):
+        rel = os.path.relpath(abspath, REPO_ROOT).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as fh:
+            src = fh.read()
+        summaries[rel] = build_summary(
+            rel, ast.parse(src), src.splitlines(), DEFAULT_CONFIG)
+    project = Project(summaries, DEFAULT_CONFIG)
+    labels = {label for _m, _fi, label in project.thread_roots()}
+    for needle in ("Router.submit", "Router._poll_loop",
+                   "Router._on_replica_done", "ServerHost.close"):
+        assert any(needle in lab for lab in labels), (needle, labels)
+    # the front door's handler threads are discovered via the literal
+    # ThreadingHTTPServer ctor (the ServerHost refactor must not hide it)
+    assert any("do_POST" in lab for lab in labels), labels
+    assert any("do_GET" in lab for lab in labels), labels

@@ -1,0 +1,598 @@
+"""The HTTP serving tier (ISSUE 15): front door + router, no chip.
+
+Driven with the toy LM from ``test_serving`` over real loopback sockets.
+Covers the tentpole acceptance surface outside the chaos storms (those
+live in ``test_serving_http_chaos.py``):
+
+* the exception → status mapping and the ``Retry-After`` derivation
+  (EWMA drain interval from the detail the rejection carries);
+* deadline/TTFT header semantics end to end (headers become
+  ``GenerationRequest`` budgets; expiry answers 504, shed answers 429);
+* SSE streaming parity: the streamed tokens are exactly the dense
+  reference, terminated by exactly one typed terminal event;
+* router placement (pick-2 by queue wait), per-replica breakers,
+  at-most-once failover (never after a token was emitted), hedging
+  (off by default, withdraw-proof when on);
+* shutdown under load: ``stop(drain=...)`` with live HTTP streams ends
+  every stream with a typed terminal event — no hung sockets, no
+  stranded futures, no leaked pages — and a draining replica leaves the
+  rotation BEFORE its drain begins.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (backend pin via conftest)
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.observability import trace
+from paddle_tpu.resilience import DeadlineExceeded, faults
+from paddle_tpu.resilience.breaker import BreakerOpen
+from paddle_tpu.serving.http import retry_after_s, status_for
+
+from test_serving import PROMPTS, dense_reference, make_engine
+
+# the shared ``metrics`` fixture (fresh enabled obs registry) lives in
+# tests/conftest.py
+
+
+def make_router(k=2, max_batch=4, seed=0, hedge_s=0, poll_s=0.02,
+                router_kw=None, **eng_kw):
+    names = [chr(ord("a") + i) for i in range(k)]
+    engines = [(n, make_engine(max_batch=max_batch, name=n, **eng_kw))
+               for n in names]
+    cfg = serving.RouterConfig(seed=seed, hedge_s=hedge_s, poll_s=poll_s,
+                               **(router_kw or {}))
+    return serving.Router(engines, cfg), dict(engines)
+
+
+def post_generate(fd, prompt, *, max_new_tokens=4, stream=False,
+                  headers=None, timeout=30.0, raw_body=None):
+    """One POST /v1/generate over a real socket; returns the closed-over
+    (status, headers, parsed-JSON-or-None, raw bytes)."""
+    conn = http.client.HTTPConnection(fd.host, fd.port, timeout=timeout)
+    try:
+        body = raw_body if raw_body is not None else json.dumps({
+            "prompt": np.asarray(prompt).tolist(),
+            "max_new_tokens": max_new_tokens, "stream": stream}).encode()
+        conn.request("POST", "/v1/generate", body=body,
+                     headers=headers or {})
+        resp = conn.getresponse()
+        raw = resp.read()
+        doc = None
+        if resp.headers.get("Content-Type", "").startswith(
+                "application/json"):
+            doc = json.loads(raw)
+        return resp.status, dict(resp.headers), doc, raw
+    finally:
+        conn.close()
+
+
+def read_sse(raw: bytes):
+    """Parse an SSE byte stream: returns (tokens, terminals) where each
+    terminal is ("done"|"error", doc). EOF without a terminal yields
+    ``terminals == []`` — the disconnect case the chaos suite probes."""
+    tokens, terminals = [], []
+    event = "message"
+    for line in raw.decode("utf-8").splitlines():
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+        elif line.startswith("data: "):
+            doc = json.loads(line[len("data: "):])
+            if event in ("done", "error"):
+                terminals.append((event, doc))
+            else:
+                tokens.append(doc["token"])
+        elif not line:
+            event = "message"
+    return tokens, terminals
+
+
+def stream_generate(fd, prompt, *, max_new_tokens=4, headers=None,
+                    timeout=30.0):
+    status, hdrs, _doc, raw = post_generate(
+        fd, prompt, max_new_tokens=max_new_tokens, stream=True,
+        headers=headers, timeout=timeout)
+    assert status == 200   # stream errors arrive as the terminal event
+    return read_sse(raw)
+
+
+# ---------------------------------------------------------------------------
+# the mapping itself (pure units)
+# ---------------------------------------------------------------------------
+
+class TestStatusMapping:
+    def test_typed_surface(self):
+        assert status_for(serving.QueueFull("full")) == 429
+        assert status_for(DeadlineExceeded("expired")) == 504
+        assert status_for(serving.EngineStopped("draining")) == 503
+        assert status_for(serving.DrainTimeout("evicted")) == 503
+        assert status_for(serving.NoHealthyReplica("none")) == 503
+        assert status_for(BreakerOpen("open")) == 503
+        assert status_for(serving.WatchdogTimeout("hung")) == 503
+        assert status_for(faults.FaultInjected("boom")) == 503
+        assert status_for(ValueError("bad")) == 400
+        assert status_for(RuntimeError("bug")) == 500
+
+    def test_shed_on_arrival_is_backpressure_not_expiry(self):
+        # the shed rejection carries the EWMA estimate -> 429 (try later);
+        # a deadline that actually expired is 504 (the request is dead)
+        shed = DeadlineExceeded("shed on arrival")
+        shed.estimated_wait_s = 0.75
+        shed.depth = 3
+        shed.capacity = 8
+        assert status_for(shed) == 429
+        assert retry_after_s(shed) == pytest.approx(0.25)  # est / depth
+
+    def test_retry_after_derivation(self):
+        full = serving.QueueFull("full", depth=8, capacity=8,
+                                 estimated_wait_s=2.0)
+        assert retry_after_s(full) == pytest.approx(0.25)
+        cold = serving.QueueFull("full", depth=8, capacity=8,
+                                 estimated_wait_s=0.0)
+        assert retry_after_s(cold) == 1.0          # cold EWMA fallback
+        assert retry_after_s(DeadlineExceeded("expired")) is None
+        assert retry_after_s(ValueError("bad")) is None
+
+
+# ---------------------------------------------------------------------------
+# front door over one engine
+# ---------------------------------------------------------------------------
+
+class TestFrontDoor:
+    def test_unary_parity_and_metrics(self, metrics):
+        eng = make_engine().warmup()
+        fd = serving.FrontDoor(eng)
+        eng.start()
+        try:
+            status, _h, doc, _raw = post_generate(fd, PROMPTS[0],
+                                                  max_new_tokens=5)
+            assert status == 200
+            assert doc["tokens"] == dense_reference(PROMPTS[0], 5)
+            assert doc["finish_reason"] in ("length", "eos")
+            assert doc["ttft_s"] is not None
+        finally:
+            eng.stop(drain=True, timeout=10)
+            fd.close()
+        snap = obs.snapshot()
+        assert snap["serving.http.requests_total"].get("status=200") == 1
+
+    def test_stream_parity_single_terminal(self, metrics):
+        eng = make_engine().warmup()
+        fd = serving.FrontDoor(eng)
+        eng.start()
+        try:
+            tokens, terminals = stream_generate(fd, PROMPTS[1],
+                                                max_new_tokens=6)
+        finally:
+            eng.stop(drain=True, timeout=10)
+            fd.close()
+        ref = dense_reference(PROMPTS[1], 6)
+        assert tokens == ref
+        assert len(terminals) == 1            # exactly one typed terminal
+        kind, doc = terminals[0]
+        assert kind == "done" and doc["tokens"] == ref
+
+    def test_bad_request_maps_400(self, metrics):
+        eng = make_engine()
+        fd = serving.FrontDoor(eng)
+        try:
+            status, _h, doc, _raw = post_generate(
+                fd, PROMPTS[0], raw_body=b"{not json")
+            assert status == 400
+            status, _h, doc, _raw = post_generate(
+                fd, PROMPTS[0], raw_body=b'{"nope": 1}')
+            assert status == 400 and doc["error"] == "ValueError"
+            status, _h, doc, _raw = post_generate(
+                fd, PROMPTS[0], headers={"X-Deadline-S": "banana"})
+            assert status == 400
+            status, _h, doc, _raw = post_generate(
+                fd, PROMPTS[0], headers={"X-Deadline-S": "-1"})
+            assert status == 400
+            # NaN passes a naive `<= 0` guard and would poison every
+            # downstream timeout comparison; inf never expires
+            for bad in ("nan", "inf"):
+                status, _h, doc, _raw = post_generate(
+                    fd, PROMPTS[0], headers={"X-Deadline-S": bad})
+                assert status == 400, bad
+        finally:
+            fd.close()
+
+    def test_queue_full_maps_429_with_retry_after(self, metrics):
+        # a paused engine (no step loop) with a 1-deep queue: the second
+        # request rejects with the structured QueueFull -> 429
+        eng = make_engine(max_queue=1)
+        fd = serving.FrontDoor(eng)
+        try:
+            first = threading.Thread(
+                target=post_generate, args=(fd, PROMPTS[0]),
+                kwargs={"timeout": 20.0}, daemon=True)
+            first.start()
+            deadline = time.monotonic() + 5.0
+            while eng.queue_depth < 1 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            status, hdrs, doc, _raw = post_generate(fd, PROMPTS[1])
+            assert status == 429
+            assert doc["error"] == "QueueFull"
+            assert doc["retry_after_s"] > 0
+            assert int(hdrs["Retry-After"]) >= 1
+        finally:
+            eng.run()          # drain the parked request, join the client
+            first.join(timeout=10)
+            eng.stop(drain=True, timeout=5)
+            fd.close()
+
+    def test_draining_maps_503(self, metrics):
+        eng = make_engine()
+        eng.stop(drain=True, timeout=1)
+        fd = serving.FrontDoor(eng)
+        try:
+            status, _h, doc, _raw = post_generate(fd, PROMPTS[0])
+            assert status == 503 and doc["error"] == "EngineStopped"
+        finally:
+            fd.close()
+
+    def test_deadline_header_expiry_maps_504(self, metrics):
+        # one busy slot; the probe request's X-Deadline-S expires in the
+        # queue -> the admission-boundary sweep sheds it -> 504
+        eng = make_engine(max_batch=1).warmup()
+        fd = serving.FrontDoor(eng)
+        eng.start()
+        try:
+            blocker = eng.submit(serving.GenerationRequest(
+                PROMPTS[0], max_new_tokens=30))
+            status, _h, doc, _raw = post_generate(
+                fd, PROMPTS[1], max_new_tokens=4,
+                headers={"X-Deadline-S": "0.05"}, timeout=30.0)
+            assert status == 504
+            assert doc["error"] == "DeadlineExceeded"
+            assert "retry_after_s" not in doc
+            blocker.result(timeout=30)
+        finally:
+            eng.stop(drain=True, timeout=10)
+            fd.close()
+
+    def test_healthz_reports_per_replica_beacons(self, metrics):
+        router, engines = make_router(k=2)
+        fd = serving.FrontDoor(router)
+        router.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                doc = trace.health()
+                if "serving.engine.a" in doc["components"] and \
+                        "serving.engine.b" in doc["components"]:
+                    break
+                time.sleep(0.005)
+            conn = http.client.HTTPConnection(fd.host, fd.port, timeout=10)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                doc = json.loads(resp.read())
+            finally:
+                conn.close()
+            assert resp.status == 200 and doc["status"] == "ok"
+            for name in ("serving.engine.a", "serving.engine.b",
+                         "serving.router"):
+                comp = doc["components"][name]
+                assert comp["ok"] and not comp["stale"]
+                assert "age_s" in comp and "ttl_s" in comp
+            assert doc["router"]["in_rotation"] == ["a", "b"]
+        finally:
+            router.stop(drain=True, timeout=10)
+            fd.close()
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class TestRouter:
+    def test_pick2_spreads_by_queue_depth(self, metrics):
+        # paused engines: depth is the tie-breaker, so sequential submits
+        # alternate replicas instead of piling onto one
+        router, engines = make_router(k=2)
+        futs = [router.submit(serving.GenerationRequest(
+            PROMPTS[i % len(PROMPTS)], max_new_tokens=3))
+            for i in range(4)]
+        assert engines["a"].queue_depth == 2
+        assert engines["b"].queue_depth == 2
+        for eng in engines.values():
+            eng.run()
+        for i, f in enumerate(futs):
+            assert f.result(timeout=10).tokens == \
+                dense_reference(PROMPTS[i % len(PROMPTS)], 3)
+        router.stop(drain=True, timeout=5)
+
+    def test_hedging_defaults_off(self):
+        assert serving.RouterConfig().hedge_s is None
+        assert serving.RouterConfig(hedge_s=0).hedge_s is None
+
+    def test_drain_replica_leaves_rotation_before_drain(self, metrics):
+        router, engines = make_router(k=2)
+        # park work on BOTH replicas (paused engines), then drain 'a':
+        # its queued-never-admitted work must fail over to 'b'
+        futs = [router.submit(serving.GenerationRequest(
+            PROMPTS[i], max_new_tokens=3)) for i in range(4)]
+        assert engines["a"].queue_depth == 2
+        router.drain_replica("a", timeout=0.0, on_timeout="fail")
+        assert router.in_rotation() == ["b"]
+        # the out-latch precedes the drain in the decision log
+        out_at = router.trace.index(("out", "a"))
+        fails = [i for i, t in enumerate(router.trace)
+                 if t[0] == "failover"]
+        assert fails and all(i > out_at for i in fails)
+        # every new submission lands on 'b' only
+        futs.append(router.submit(serving.GenerationRequest(
+            PROMPTS[4], max_new_tokens=3)))
+        assert engines["a"].queue_depth == 0
+        engines["b"].run()
+        for i, f in enumerate(futs):
+            assert f.result(timeout=10).tokens == \
+                dense_reference(PROMPTS[i], 3)
+        snap = obs.snapshot()
+        assert snap.get("serving.router.failovers_total", 0) == 2
+        for eng in engines.values():
+            assert eng.kv.outstanding_pages == 0
+        router.stop(drain=True, timeout=5)
+
+    def test_no_failover_after_token_emitted(self, metrics):
+        # at-most-once: an ADMITTED request (it streamed tokens) on a
+        # killed replica resolves with the typed DrainTimeout — it is
+        # never re-sent even though a healthy replica is free
+        router, engines = make_router(k=2, max_batch=1)
+        for eng in engines.values():
+            eng.warmup()
+        got = []
+        first_token = threading.Event()
+
+        def stream(rid, tok):
+            got.append(tok)
+            first_token.set()
+            time.sleep(0.005)   # throttle decode: the kill must land
+            # while the stream is provably mid-flight
+
+        router.start()
+        try:
+            fut = router.submit(serving.GenerationRequest(
+                PROMPTS[0], max_new_tokens=40, stream=stream))
+            assert first_token.wait(timeout=20)
+            victim = router.trace[0][2]        # ("pick", rid, replica)
+            router.drain_replica(victim, timeout=0.0, on_timeout="fail")
+            with pytest.raises(serving.DrainTimeout):
+                fut.result(timeout=10)
+            assert not any(t[0] == "failover" for t in router.trace)
+            assert obs.snapshot().get(
+                "serving.router.failovers_total", 0) == 0
+            # the client saw every token exactly once, then the typed end
+            assert got == dense_reference(PROMPTS[0], 40)[:len(got)]
+            assert engines[victim].kv.outstanding_pages == 0
+        finally:
+            router.stop(drain=True, timeout=10)
+
+    def test_hedge_reroutes_queued_request(self, metrics):
+        # replica 'a' is busy with a long request; the probe request sits
+        # queued (never admitted) past hedge_s -> withdrawn and re-routed
+        # to 'b' exactly once, no token ever duplicated
+        router, engines = make_router(k=2, max_batch=1,
+                                      hedge_s=0.05, poll_s=0.01)
+        for eng in engines.values():
+            eng.warmup()
+        got = []
+        router.start()
+        try:
+            long_fut = router.submit(serving.GenerationRequest(
+                PROMPTS[0], max_new_tokens=40,
+                stream=lambda rid, tok: time.sleep(0.01)))  # hold 'a' busy
+            # wait for the long request to hold 'a''s only slot, so the
+            # probe ties onto 'a' (depth 0 both) and then sits QUEUED
+            deadline = time.monotonic() + 10.0
+            while engines["a"].active_requests < 1 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert engines["a"].active_requests == 1
+            probe = serving.GenerationRequest(
+                PROMPTS[1], max_new_tokens=4,
+                stream=lambda rid, tok: got.append(tok))
+            fut = router.submit(probe)
+            res = fut.result(timeout=20)
+            assert res.tokens == dense_reference(PROMPTS[1], 4)
+            assert got == res.tokens            # streamed exactly once
+            long_fut.result(timeout=20)
+        finally:
+            router.stop(drain=True, timeout=10)
+        snap = obs.snapshot()
+        assert snap.get("serving.router.hedges_total", 0) == 1
+        hedges = [t for t in router.trace if t[0] == "hedge"]
+        assert hedges == [("hedge", probe.request_id, "a")]
+        picks = [t for t in router.trace
+                 if t[0] == "pick" and t[1] == probe.request_id]
+        assert [p[2] for p in picks] == ["a", "b"]
+
+    def test_breaker_opens_on_forward_faults(self, metrics):
+        # an injected transport fault at router.forward opens replica
+        # 'a''s breaker (threshold 1); the next request short-circuits
+        # past 'a' (breaker_open in the trace, no engine touch) onto 'b'
+        router, engines = make_router(
+            k=2, router_kw={"breaker_threshold": 1,
+                            "breaker_cooldown": 60.0})
+        sched = faults.FaultSchedule()
+        sched.error("router.forward", on=[1])
+        with faults.installed(sched):
+            f1 = router.submit(serving.GenerationRequest(
+                PROMPTS[0], max_new_tokens=3))
+            f2 = router.submit(serving.GenerationRequest(
+                PROMPTS[1], max_new_tokens=3))
+        rep_a = next(r for r in router.replicas if r.name == "a")
+        assert rep_a.breaker.state == "open"
+        assert any(t[0] == "forward_fault" and t[2] == "a"
+                   for t in router.trace)
+        assert any(t[0] == "breaker_open" and t[2] == "a"
+                   for t in router.trace)
+        assert engines["a"].queue_depth == 0       # never touched again
+        assert engines["b"].queue_depth == 2
+        engines["b"].run()
+        assert f1.result(timeout=10).tokens == \
+            dense_reference(PROMPTS[0], 3)
+        assert f2.result(timeout=10).tokens == \
+            dense_reference(PROMPTS[1], 3)
+        snap = obs.snapshot()
+        assert snap.get("serving.router.retries_total", 0) >= 1
+        router.stop(drain=True, timeout=5)
+
+    def test_router_stopped_rejects_typed(self, metrics):
+        router, _engines = make_router(k=2)
+        router.stop(drain=True, timeout=1)
+        with pytest.raises(serving.EngineStopped):
+            router.submit(serving.GenerationRequest(
+                PROMPTS[0], max_new_tokens=2))
+
+    def test_start_after_stop_restores_rotation(self, metrics):
+        # stop() latches every replica out; start() is its inverse — a
+        # restarted router must not answer 503 forever
+        router, engines = make_router(k=2)
+        router.stop(drain=True, timeout=1)
+        assert router.in_rotation() == []
+        router.start()
+        try:
+            assert router.in_rotation() == ["a", "b"]
+            fut = router.submit(serving.GenerationRequest(
+                PROMPTS[0], max_new_tokens=3))
+            assert fut.result(timeout=20).tokens == \
+                dense_reference(PROMPTS[0], 3)
+        finally:
+            router.stop(drain=True, timeout=10)
+
+    def test_expired_budget_is_504_not_failover(self, metrics):
+        # a TTFT-only request whose budget died while queued on a killed
+        # replica must resolve DeadlineExceeded WITHOUT backpressure
+        # detail (504, no Retry-After) — never be re-routed to a healthy
+        # replica or answered 503-retry-later
+        from paddle_tpu.serving.http import retry_after_s, status_for
+        router, engines = make_router(k=2)
+        fut = router.submit(serving.GenerationRequest(
+            PROMPTS[0], max_new_tokens=3, ttft_budget_s=0.02))
+        picked = router.trace[0][2]
+        time.sleep(0.05)                     # the TTFT budget expires
+        router.drain_replica(picked, timeout=0.0, on_timeout="fail")
+        with pytest.raises(DeadlineExceeded) as ei:
+            fut.result(timeout=10)
+        assert status_for(ei.value) == 504
+        assert retry_after_s(ei.value) is None
+        assert not any(t[0] == "failover" for t in router.trace)
+        other = next(n for n in ("a", "b") if n != picked)
+        assert engines[other].queue_depth == 0
+        router.stop(drain=True, timeout=5)
+
+    def test_duplicate_beacons_rejected(self):
+        # two UNNAMED engines share the process-global "serving.engine"
+        # beacon — one wedging would be masked by the other's beats, so
+        # construction refuses the ambiguity outright
+        with pytest.raises(ValueError, match="beacon"):
+            serving.Router([("a", make_engine()), ("b", make_engine())])
+
+
+# ---------------------------------------------------------------------------
+# shutdown under load (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+class TestShutdownUnderLoad:
+    def _stream_worker(self, fd, prompt, n_new, out, first_token_evt):
+        conn = http.client.HTTPConnection(fd.host, fd.port, timeout=60)
+        try:
+            conn.request("POST", "/v1/generate", body=json.dumps({
+                "prompt": np.asarray(prompt).tolist(),
+                "max_new_tokens": n_new, "stream": True}).encode())
+            resp = conn.getresponse()
+            first = resp.readline()       # first SSE line: stream is live
+            first_token_evt.set()
+            raw = first + resp.read()     # EOF == the server finished it
+            out.append((resp.status, read_sse(raw)))
+        finally:
+            conn.close()
+
+    @pytest.mark.parametrize("graceful", [True, False])
+    def test_drain_ends_every_stream_typed(self, graceful, metrics):
+        router, engines = make_router(k=2, max_batch=4)
+        for eng in engines.values():
+            eng.warmup()
+        fd = serving.FrontDoor(router)
+        router.start()
+        outs = [[] for _ in range(4)]
+        evts = [threading.Event() for _ in range(4)]
+        threads = [threading.Thread(
+            target=self._stream_worker,
+            args=(fd, PROMPTS[i], 40, outs[i], evts[i]), daemon=True)
+            for i in range(4)]
+        # throttle decode (an injected per-slot delay, not an error) so
+        # the stop() provably lands while every stream is mid-flight
+        sched = faults.FaultSchedule()
+        sched.delay("serving.step", seconds=0.005)
+        try:
+            with faults.installed(sched):
+                for t in threads:
+                    t.start()
+                for e in evts:
+                    assert e.wait(timeout=30)  # every stream mid-flight
+                # graceful: generous budget, streams finish with `done`;
+                # abrupt: zero budget, in-flight streams end with the
+                # typed DrainTimeout error event — never a hung socket
+                router.stop(drain=True,
+                            timeout=(30.0 if graceful else 0.0),
+                            on_timeout="fail")
+                for t in threads:
+                    t.join(timeout=30)
+                    assert not t.is_alive(), "stream never terminated"
+        finally:
+            fd.close()
+        statuses = []
+        for i, out in enumerate(outs):
+            assert out, "client thread died without a response"
+            status, (tokens, terminals) = out[0]
+            assert status == 200
+            assert len(terminals) == 1, "stream must end exactly once"
+            kind, doc = terminals[0]
+            if kind == "done":
+                assert tokens == doc["tokens"]
+                assert doc["tokens"] == dense_reference(PROMPTS[i], 40)
+                statuses.append(200)
+            else:
+                assert doc["status"] in (503, 504)
+                assert doc["error"] in ("DrainTimeout", "EngineStopped")
+                statuses.append(doc["status"])
+        if graceful:
+            assert statuses == [200, 200, 200, 200]
+        else:
+            assert 503 in statuses
+        for eng in engines.values():
+            assert eng.kv.outstanding_pages == 0
+            assert eng.active_requests == 0 and eng.queue_depth == 0
+
+    def test_wedged_admission_during_zero_budget_drain_resolves_typed(
+            self, metrics):
+        # the stranded-future window a loaded host exposed: the loop
+        # thread is wedged MID-ADMISSION (popped from the queue, prefill
+        # not yet landed — here a delay fault longer than the join grace)
+        # when a zero-budget drain sweeps stragglers; the late admission
+        # must resolve the Future typed instead of stranding it in a
+        # stopped engine
+        eng = make_engine(max_batch=1).warmup()
+        sched = faults.FaultSchedule()
+        sched.delay("serving.admit", on=[1], seconds=1.6)
+        with faults.installed(sched):
+            eng.start()
+            fut = eng.submit(serving.GenerationRequest(
+                PROMPTS[0], max_new_tokens=4))
+            deadline = time.monotonic() + 5.0
+            while eng.queue_depth > 0 and time.monotonic() < deadline:
+                time.sleep(0.005)      # popped: the admission is in flight
+            eng.stop(drain=True, timeout=0.0, on_timeout="fail")
+        with pytest.raises(serving.DrainTimeout):
+            fut.result(timeout=10)
+        assert eng.kv.outstanding_pages == 0
+        assert eng.active_requests == 0 and eng.queue_depth == 0

@@ -1,0 +1,339 @@
+"""Seeded chaos for the HTTP serving tier (ISSUE 15 acceptance).
+
+Storms over the new fault sites — ``router.pick`` / ``router.forward`` /
+``http.write`` — composed with the PR 8 serving sites, driving K=3 toy-LM
+replicas behind the router and the streaming front door, pinning the
+tier's contract:
+
+* **exactly one typed outcome per HTTP request** — every request
+  terminates as exactly one of {complete(200), 429, 503, 504} (a
+  double-injected ``http.write`` fault is the deliberate client
+  disconnect: those are bounded by the schedule's write-fault fires and
+  are cancelled upstream);
+* **at-most-once admission witness** — no token is ever emitted twice
+  for one request: a completed stream's bytes are exactly its result's
+  tokens, which are exactly the no-fault dense reference;
+* **no leaks** — after the storm + drain, ``outstanding_pages == 0`` on
+  every replica, zero active slots, zero queued requests;
+* **determinism** — same seed ⇒ same router decision trace (and the same
+  per-request outcomes), with rids normalized to submission order;
+* **replica-kill failover proof** — kill one of three replicas mid-batch:
+  its queued (never-admitted) work fails over and completes bit-identical
+  to the no-fault reference on the survivors, its in-flight streams end
+  with the typed :class:`DrainTimeout` well inside the deadline budget.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (backend pin via conftest)
+from paddle_tpu import observability as obs
+from paddle_tpu import serving
+from paddle_tpu.resilience import DeadlineExceeded, faults
+
+from test_serving import PROMPTS, V, dense_reference, make_engine
+from test_serving_http import make_router, read_sse
+
+EXPECTED_ERRORS = (faults.FaultInjected, serving.WatchdogTimeout,
+                   DeadlineExceeded, serving.DrainTimeout,
+                   serving.EngineStopped, serving.NoHealthyReplica,
+                   serving.QueueFull)
+
+_REF_CACHE = {}
+
+
+def reference(prompt, n_new):
+    key = (tuple(int(t) for t in prompt), n_new)
+    if key not in _REF_CACHE:
+        _REF_CACHE[key] = dense_reference(np.asarray(prompt, np.int32),
+                                          n_new)
+    return _REF_CACHE[key]
+
+
+def _storm_schedule(seed: int) -> faults.FaultSchedule:
+    sched = faults.FaultSchedule(seed)
+    sched.error("router.pick", prob=0.05)
+    sched.error("router.forward", prob=0.08)
+    sched.error("http.write", prob=0.02)
+    sched.error("serving.admit", prob=0.08)
+    sched.error("serving.step", prob=0.04)
+    return sched
+
+
+def _stream_request(fd, prompt, n_new, deadline_s=None, timeout=60.0):
+    """One streamed generate; returns (status, tokens, terminals)."""
+    conn = http.client.HTTPConnection(fd.host, fd.port, timeout=timeout)
+    try:
+        headers = {}
+        if deadline_s is not None:
+            headers["X-Deadline-S"] = str(deadline_s)
+        conn.request("POST", "/v1/generate", body=json.dumps({
+            "prompt": np.asarray(prompt).tolist(),
+            "max_new_tokens": n_new, "stream": True}).encode(),
+            headers=headers)
+        resp = conn.getresponse()
+        raw = resp.read()
+        if resp.status != 200:      # typed sync rejection: JSON error doc
+            return resp.status, [], [("error", json.loads(raw))]
+        tokens, terminals = read_sse(raw)
+        return 200, tokens, terminals
+    finally:
+        conn.close()
+
+
+# the shared ``metrics`` fixture (fresh enabled obs registry) lives in
+# tests/conftest.py
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chaos_storm_every_request_one_typed_outcome(seed, metrics):
+    rng = np.random.default_rng(seed)
+    router, engines = make_router(k=3, max_batch=4, seed=seed,
+                                  max_queue=16)
+    for eng in engines.values():
+        eng.warmup()
+    fd = serving.FrontDoor(router)
+    router.start()
+    sched = _storm_schedule(seed)
+    n_req = 12
+    jobs = [(rng.integers(0, V, (int(rng.integers(3, 11)),),
+                          dtype=np.int32),
+             int(rng.integers(3, 8)),
+             30.0 if i % 2 else None) for i in range(n_req)]
+    results = [None] * n_req
+    try:
+        with faults.installed(sched):
+            def worker(i):
+                p, n, dl = jobs[i]
+                results[i] = _stream_request(fd, p, n, deadline_s=dl)
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        daemon=True) for i in range(n_req)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+                assert not t.is_alive(), "request never terminated"
+            router.stop(drain=True, timeout=20)
+    finally:
+        fd.close()
+
+    write_faults = sum(1 for s, _i, k in sched.trace if s == "http.write")
+    disconnects = 0
+    for i, res in enumerate(results):
+        assert res is not None, "client thread died"
+        status, tokens, terminals = res
+        p, n, _dl = jobs[i]
+        if not terminals:
+            # EOF without a terminal event: the double-write-fault client
+            # disconnect — allowed ONLY when the schedule actually fired
+            # at http.write; the request was cancelled upstream
+            disconnects += 1
+            continue
+        assert len(terminals) == 1, "stream must terminate exactly once"
+        kind, doc = terminals[0]
+        if kind == "done":
+            ref = reference(p, n)
+            # at-most-once witness: the streamed bytes are exactly the
+            # result, which is exactly the no-fault reference — no token
+            # emitted twice, no corruption under any recovery path
+            assert tokens == doc["tokens"] == ref
+        else:
+            assert doc["status"] in (429, 503, 504), doc
+            # a failed stream's tokens are a clean prefix of the
+            # reference: faults delay or kill a request, never corrupt
+            # or duplicate its emission
+            assert tokens == reference(p, n)[:len(tokens)]
+    assert disconnects <= max(0, write_faults)
+
+    # no leaks on any replica, whatever the storm did
+    for eng in engines.values():
+        assert eng.kv.outstanding_pages == 0
+        assert eng.active_requests == 0 and eng.queue_depth == 0
+
+    # the front door counted one terminal status per request (a
+    # double-faulted TERMINAL write can leave a counted-but-disconnected
+    # stream, so the lower bound subtracts the disconnects)
+    snap = obs.snapshot()
+    by_status = snap.get("serving.http.requests_total", {})
+    assert n_req - disconnects <= sum(by_status.values()) <= n_req
+
+
+def test_same_seed_same_router_trace(metrics):
+    """The determinism acceptance: identical seeds (router pick-2 RNG +
+    fault schedule) produce identical router decision traces and
+    identical per-request outcomes, rids normalized to submission
+    order. Offline engines: every router decision runs on this thread."""
+
+    def run_once():
+        sched = faults.FaultSchedule(11)
+        sched.error("router.pick", on=[3])
+        sched.error("router.forward", on=[2, 7], prob=None)
+        sched.error("router.forward", prob=0.1)
+        router, engines = make_router(k=3, max_batch=4, seed=42)
+        ridmap = {}
+        outcomes = []
+        futs = []
+        with faults.installed(sched):
+            for i in range(8):
+                req = serving.GenerationRequest(
+                    PROMPTS[i % len(PROMPTS)], max_new_tokens=3)
+                ridmap[req.request_id] = i
+                try:
+                    futs.append((i, router.submit(req)))
+                except EXPECTED_ERRORS as exc:
+                    outcomes.append((i, "reject", type(exc).__name__))
+        for eng in engines.values():
+            eng.run()
+        router.stop(drain=True, timeout=10)
+        for i, f in enumerate_sorted(futs):
+            try:
+                outcomes.append((i, "ok", tuple(f.result(timeout=0).tokens)))
+            except EXPECTED_ERRORS as exc:
+                outcomes.append((i, "err", type(exc).__name__))
+        norm_trace = [tuple(ridmap.get(x, x) for x in t)
+                      for t in router.trace]
+        return sorted(outcomes), norm_trace, list(sched.trace)
+
+    def enumerate_sorted(futs):
+        return sorted(futs, key=lambda p: p[0])
+
+    first = run_once()
+    second = run_once()
+    assert first[0] == second[0]
+    assert first[1] == second[1]
+    assert first[2] == second[2]
+    # the storm actually exercised the router sites
+    assert any(s.startswith("router.") for s, _i, _k in first[2])
+    assert any(t[0] in ("pick_fault", "forward_fault")
+               for t in first[1])
+
+
+def test_replica_kill_failover_proof(metrics):
+    """K=3, one replica killed mid-batch: queued work fails over and
+    completes bit-identical to the no-fault reference on the survivors;
+    the killed replica's in-flight requests end with the typed
+    DrainTimeout well inside their deadline budget; zero leaked pages
+    anywhere; no token ever reaches a client twice."""
+    router, engines = make_router(k=3, max_batch=4, max_queue=32)
+    for eng in engines.values():
+        eng.warmup()
+    router.start()
+    n_req, n_new = 18, 20
+    streams = {i: [] for i in range(n_req)}
+    reqs, futs = [], []
+
+    def mk_stream(i):
+        def cb(rid, tok):
+            streams[i].append(tok)
+            time.sleep(0.002)   # throttle decode: the kill must land
+            # while queues are still populated on every replica
+        return cb
+
+    t_kill = None
+    try:
+        for i in range(n_req):
+            req = serving.GenerationRequest(
+                PROMPTS[i % len(PROMPTS)], max_new_tokens=n_new,
+                deadline_s=30.0, stream=mk_stream(i))
+            reqs.append(req)
+            futs.append(router.submit(req))
+        # wait until the victim provably holds BOTH in-flight slots and
+        # queued work: the kill then exercises both recovery paths
+        victim = "a"
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if engines[victim].active_requests > 0 and \
+                    engines[victim].queue_depth > 0:
+                break
+            time.sleep(0.002)
+        assert engines[victim].active_requests > 0
+        assert engines[victim].queue_depth > 0
+        t_kill = time.monotonic()
+        router.drain_replica(victim, timeout=0.0, on_timeout="fail")
+        assert victim not in router.in_rotation()
+
+        killed_inflight, completed = 0, 0
+        for i, f in enumerate(futs):
+            try:
+                res = f.result(timeout=60)
+            except serving.DrainTimeout:
+                killed_inflight += 1
+                # typed, and resolved well inside the 30 s deadline
+                assert time.monotonic() - t_kill < 30.0
+                continue
+            completed += 1
+            ref = reference(reqs[i].prompt, n_new)
+            assert res.tokens == ref                   # bit-identical
+            assert streams[i] == res.tokens            # at-most-once
+        assert killed_inflight > 0, "kill missed every in-flight slot"
+        assert completed > 0
+        assert completed + killed_inflight == n_req
+        # the queued-on-victim work DID fail over (trace + metric agree)
+        fails = [t for t in router.trace if t[0] == "failover"]
+        assert fails
+        assert obs.snapshot().get("serving.router.failovers_total", 0) \
+            == len(fails)
+        # failover happened only after the victim left the rotation
+        out_at = router.trace.index(("out", victim))
+        assert all(router.trace.index(t) > out_at for t in fails)
+    finally:
+        router.stop(drain=True, timeout=30)
+    for eng in engines.values():
+        assert eng.kv.outstanding_pages == 0
+        assert eng.active_requests == 0 and eng.queue_depth == 0
+    # terminal accounting: every submitted request resolved exactly once
+    assert all(f.done() for f in futs)
+
+
+class TestWriteFaultSeam:
+    def _serve_one(self, metrics, sched, n_new=6):
+        eng = make_engine().warmup()
+        fd = serving.FrontDoor(eng)
+        eng.start()
+        try:
+            with faults.installed(sched):
+                status, tokens, terminals = _stream_request(
+                    fd, PROMPTS[0], n_new)
+        finally:
+            eng.stop(drain=True, timeout=10)
+            fd.close()
+        return eng, status, tokens, terminals
+
+    def test_single_write_fault_retried_invisibly(self, metrics):
+        sched = faults.FaultSchedule()
+        sched.error("http.write", on=[2])
+        eng, status, tokens, terminals = self._serve_one(metrics, sched)
+        assert status == 200
+        assert tokens == dense_reference(PROMPTS[0], 6)
+        assert terminals == [("done", terminals[0][1])]
+        assert terminals[0][1]["tokens"] == tokens
+        snap = obs.snapshot()
+        assert snap.get("serving.http.write_retries_total", 0) == 1
+        assert snap.get("serving.http.disconnects_total", 0) == 0
+
+    def test_double_write_fault_is_client_disconnect(self, metrics):
+        sched = faults.FaultSchedule()
+        sched.error("http.write", on=[3, 4])
+        eng, status, tokens, terminals = self._serve_one(
+            metrics, sched, n_new=12)
+        assert status == 200
+        assert terminals == []                 # stream cut, no terminal
+        assert tokens == dense_reference(PROMPTS[0], 12)[:2]
+        # the request was cancelled upstream: slot + pages free, counted
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            snap = obs.snapshot()
+            if snap.get("serving.requests_total", {}).get(
+                    "status=cancelled", 0) >= 1:
+                break
+            time.sleep(0.01)
+        snap = obs.snapshot()
+        assert snap["serving.requests_total"].get("status=cancelled") == 1
+        assert snap.get("serving.http.disconnects_total", 0) == 1
+        assert eng.kv.outstanding_pages == 0

@@ -150,13 +150,25 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         # any caller thread: submit/cancel/stop race the step loop thread
         "paddle_tpu/serving/engine.py": [
             "Engine.submit", "Engine.cancel", "Engine.stop"],
+        # ISSUE 15: the router's public surface runs on caller threads
+        # while its health-poll thread (Router._poll_loop — also listed so
+        # the root survives a spawn-site refactor) hedges and the replica
+        # engines' step threads resolve Futures into _on_replica_done;
+        # the stream-counting callback fires on the engine step thread
+        "paddle_tpu/serving/router.py": [
+            "Router.submit", "Router.cancel", "Router.stop",
+            "Router.drain_replica", "Router.restore_replica",
+            "Router._on_replica_done", "Router._poll_loop"],
         # the step/train thread arms and disarms around the compiled call
         # while the poll daemon classifies the window
         "paddle_tpu/resilience/watchdog.py": [
             "StepWatchdog.arm", "StepWatchdog.disarm", "StepWatchdog.stop"],
         # engine construction / supervisor run call the opt-in seam while
-        # scrape threads serve /metrics
-        "paddle_tpu/observability/http.py": ["maybe_serve_from_env"],
+        # scrape threads serve /metrics; ServerHost.close (the scaffolding
+        # shared with the serving front door, ISSUE 15) runs on whatever
+        # thread shuts an endpoint down
+        "paddle_tpu/observability/http.py": ["maybe_serve_from_env",
+                                             "ServerHost.close"],
         # the training thread saves and waits while async commit threads
         # rotate the latest pointer
         "paddle_tpu/distributed/checkpoint/__init__.py": [
@@ -179,6 +191,11 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # the extracted watchdog stays strict inside paddle_tpu/resilience.
     "poll_loop_paths": [
         "paddle_tpu/serving",
+        # ISSUE 15: the HTTP tier is covered by the package prefix above;
+        # named explicitly so the strict-tier membership survives a
+        # package split (pinned in test_lint_wholeprogram.py)
+        "paddle_tpu/serving/http.py",
+        "paddle_tpu/serving/router.py",
         "paddle_tpu/resilience/watchdog.py",
         "paddle_tpu/resilience/trainer.py",
     ],
