@@ -232,6 +232,67 @@ def _telemetry_detail(snap: dict) -> dict:
     return {k: int(snap.get(k, 0)) for k in TELEMETRY_FIELDS}
 
 
+# program cost accounting (ISSUE 16): the row of record carries XLA's own
+# cost/memory analysis of the measured step program — flops and bytes as
+# the compiler modeled them, the modeled MFU recomputed from the measured
+# per-call step time, and the HBM ledger's peak/headroom. model_source
+# records whether XLA's cost model or the analytic flops counter produced
+# the figure; an all-null cost block means the registry never saw the
+# measured program and the MFU claim has no model behind it.
+COST_FIELDS = ("model_source", "step_flops", "step_bytes", "mfu_modeled",
+               "peak_hbm_bytes", "hbm_headroom_bytes")
+
+
+def _cost_detail(doc: dict, analytic_step_flops: float,
+                 step_seconds: float, peak_flops: float) -> dict:
+    """Build the pinned cost block (schema: COST_FIELDS) from one
+    ``cost.debug_doc()`` snapshot plus the measured per-CALL seconds of
+    the captured step program (the same program the train.step record
+    describes — both cover ``scan_k`` scanned steps).
+
+    Prefers the XLA-measured train.step record; falls back to the analytic
+    estimate (model_source="analytic") when the compiler returned no cost
+    model, and to all-null (model_source="none") when the registry never
+    saw the step program at all."""
+    rec = None
+    for r in doc.get("records", []):
+        if r.get("site") == "train.step":
+            rec = r
+            break
+    flops = rec.get("flops") if rec else None
+    nbytes = rec.get("bytes_accessed") if rec else None
+    source = rec.get("model_source") if rec else None
+    if flops is None and analytic_step_flops:
+        flops, source = float(analytic_step_flops), "analytic"
+    mfu_modeled = None
+    if flops and step_seconds and peak_flops:
+        mfu_modeled = round(flops / (step_seconds * peak_flops), 4)
+    hbm = doc.get("hbm") or {}
+    out = {
+        "model_source": source or "none",
+        "step_flops": flops,
+        "step_bytes": nbytes,
+        "mfu_modeled": mfu_modeled,
+        "peak_hbm_bytes": hbm.get("peak_hbm_bytes"),
+        "hbm_headroom_bytes": hbm.get("headroom_bytes"),
+    }
+    assert set(out) == set(COST_FIELDS)
+    return out
+
+
+def _cost_suspect_reasons(block: dict) -> list[str]:
+    """Why the cost block disqualifies this run ([] = healthy): an
+    entirely empty cost accounting means the registry never captured the
+    measured program AND the analytic fallback was unavailable — the MFU
+    of record has no cost model behind it."""
+    if (block["step_flops"] is None and block["step_bytes"] is None
+            and block["peak_hbm_bytes"] is None):
+        return ["cost accounting empty: no program record and no analytic "
+                "fallback (PADDLE_TPU_COST=off inherited into the bench "
+                "env?)"]
+    return []
+
+
 def _dispatch_probe(jax) -> float:
     """Median round-trip latency (ms) of a trivial compiled dispatch.
 
@@ -456,7 +517,18 @@ def main() -> None:
     cap_detail = _step_capture_detail(snap, cap_mode)
     out["detail"]["step_capture"] = cap_detail
     out["detail"]["trace_overhead"] = trace_block
+    # cost accounting (ISSUE 16): one debug_doc() snapshot, same point in
+    # time as `snap`; the step program's record joins the measured per-call
+    # p50 into the modeled MFU (both cover one scan_k-step call)
+    from paddle_tpu.observability import cost as _cost_mod
+    cost_detail = _cost_detail(
+        _cost_mod.debug_doc(),
+        flops_per_token * batch * seq * scan_k,
+        float(np.percentile(call_ms, 50)) / 1e3,
+        peak_flops)
+    out["detail"]["cost"] = cost_detail
     suspect_reasons = suspect_reasons + _capture_suspect_reasons(cap_detail)
+    suspect_reasons = suspect_reasons + _cost_suspect_reasons(cost_detail)
     if suspect_reasons:
         out["suspect"] = True
         out["detail"]["suspect_reasons"] = suspect_reasons

@@ -60,12 +60,19 @@ SERVING_RESILIENCE_FIELDS = (
     "watchdog_trips", "replays")
 # the paged-attention decode tier (ISSUE 13): which tier the measured
 # steps actually ran (kernel = Pallas streaming over live pages, dense =
-# the gather-the-whole-cache debug path) plus the MODELED per-token
-# attention KV traffic of each — the structural claim of record is that
-# the live number scales with the context, the dense one with max_len
+# the gather-the-whole-cache debug path) plus the per-token attention KV
+# traffic of each — the structural claim of record is that the live
+# number scales with the context, the dense one with max_len. Since
+# ISSUE 16 the tier that actually ran reports the cost registry's
+# MEASURED per-token bytes (XLA's bytes-accessed for the warmed bucket
+# program, / bucket) instead of the hand formula, with
+# attn_bytes_source = "measured"; the formula stays as the modeled
+# number for the tier that did not run and as a one-sided cross-check
+# (attention-only model must not exceed measured whole-program traffic
+# by >10%).
 PAGED_ATTENTION_FIELDS = (
     "mode", "kernel_steps", "dense_steps", "attn_bytes_per_token_live",
-    "attn_bytes_per_token_dense", "suspect_reasons")
+    "attn_bytes_per_token_dense", "attn_bytes_source", "suspect_reasons")
 CONTEXT_SWEEP_FIELDS = (
     "context", "decode_tokens_per_sec", "attn_bytes_per_token_live",
     "attn_bytes_per_token_dense")
@@ -109,12 +116,33 @@ def _paged_attn_bytes_per_token(layers, heads, head_dim, max_len, page_size,
     return int(round(live)), int(dense)
 
 
-def _paged_suspect_reasons(block, on_tpu: bool):
+def _measured_decode_bytes_per_token(bucket_records) -> int | None:
+    """Per-token bytes of the largest warmed decode bucket program, from
+    the cost registry (ISSUE 16): XLA's whole-program bytes-accessed for
+    one decode step / bucket slots (one token per slot per step). None
+    when the registry has no measured bucket (cost accounting off, or
+    the backend returned no cost model)."""
+    if not bucket_records:
+        return None
+    bucket = max(bucket_records)
+    nbytes = (bucket_records[bucket] or {}).get("bytes_accessed")
+    if not nbytes:
+        return None
+    return int(round(nbytes / bucket))
+
+
+def _paged_suspect_reasons(block, on_tpu: bool, formula_live=None,
+                           formula_dense=None):
     """All-dense-on-TPU disqualifies the number of record: with the
     kernel available (mode != off) every measured decode step running the
     dense tier means the run benchmarked the debug path — e.g. a test
     env's PADDLE_TPU_PAGED_ATTENTION=off leaking in (the
-    _capture_suspect_reasons rule, for the serving tier)."""
+    _capture_suspect_reasons rule, for the serving tier).
+
+    The formula cross-check (ISSUE 16) is one-sided: the hand formula
+    models attention-only KV reads, a strict subset of the measured
+    whole-program traffic — a modeled number above measured+10% means
+    the formula or the measurement is wrong."""
     reasons = []
     if on_tpu and block["mode"] != "off" and block["kernel_steps"] == 0 \
             and block["dense_steps"] > 0:
@@ -123,6 +151,18 @@ def _paged_suspect_reasons(block, on_tpu: bool):
             "on TPU — the measured tok/s is the debug path, not the "
             "kernel (check PADDLE_TPU_PAGED_ATTENTION and kernel "
             "eligibility)")
+    if block.get("attn_bytes_source") == "measured":
+        ran_kernel = block["kernel_steps"] >= block["dense_steps"] \
+            and block["kernel_steps"] > 0
+        formula = formula_live if ran_kernel else formula_dense
+        measured = block["attn_bytes_per_token_live"] if ran_kernel \
+            else block["attn_bytes_per_token_dense"]
+        if formula is not None and measured and formula > 1.10 * measured:
+            reasons.append(
+                f"paged_attention: modeled attention-only bytes/token "
+                f"{formula} exceed the measured whole-program "
+                f"{measured} by >10% — byte formula and cost registry "
+                f"disagree")
     return reasons
 
 
@@ -449,15 +489,33 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         L, H, E // H, M, page_size, sbytes, args.prompt, n_new)
     steps_by_path = snap.get("serving.paged_attention_steps_total", {}) or {}
     from paddle_tpu.ops import paged_attention as _pa
+    kernel_steps = int(steps_by_path.get("path=kernel", 0))
+    dense_steps = int(steps_by_path.get("path=dense", 0))
+    # ISSUE 16: the tier that ran reports the cost registry's MEASURED
+    # per-token bytes for the last engine's largest warmed bucket program
+    # (earlier engines' records retired when their programs died); the
+    # other tier keeps the modeled formula, and the formula cross-checks
+    # the measurement inside _paged_suspect_reasons
+    from paddle_tpu.observability import cost as _cost_mod
+    measured_b = _measured_decode_bytes_per_token(
+        _cost_mod.decode_bucket_records())
+    live_rep, dense_rep, source = live_b, dense_b, "model"
+    if measured_b is not None:
+        source = "measured"
+        if kernel_steps >= dense_steps and kernel_steps > 0:
+            live_rep = measured_b
+        else:
+            dense_rep = measured_b
     paged_block = {
         "mode": _pa.mode(),
-        "kernel_steps": int(steps_by_path.get("path=kernel", 0)),
-        "dense_steps": int(steps_by_path.get("path=dense", 0)),
-        "attn_bytes_per_token_live": live_b,
-        "attn_bytes_per_token_dense": dense_b,
+        "kernel_steps": kernel_steps,
+        "dense_steps": dense_steps,
+        "attn_bytes_per_token_live": live_rep,
+        "attn_bytes_per_token_dense": dense_rep,
+        "attn_bytes_source": source,
     }
-    paged_block["suspect_reasons"] = _paged_suspect_reasons(paged_block,
-                                                            on_tpu)
+    paged_block["suspect_reasons"] = _paged_suspect_reasons(
+        paged_block, on_tpu, formula_live=live_b, formula_dense=dense_b)
     assert set(paged_block) == set(PAGED_ATTENTION_FIELDS), \
         "paged_attention block drifted from PAGED_ATTENTION_FIELDS"
     sweep = _context_sweep(args, serving, paddle, prefill_raw, lm_step,

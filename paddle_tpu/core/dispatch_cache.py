@@ -96,6 +96,13 @@ _STATS: Dict[str, Any] = {
 # None => the cache pays nothing beyond the is-None probe.
 _obs_hook: Optional[Callable[[str, Optional[str]], None]] = None
 
+# ISSUE 16: compile-time cost capture (observability.cost), same is-None
+# contract. Called as hook(event, key, **kw): "store" fires from
+# core.tensor._apply_cached with the entry + run arrays still in scope
+# (spec building needs them), "evict"/"clear" fire here so the cost
+# registry retires records for entries the LRU dropped.
+_cost_hook: Optional[Callable] = None
+
 NEEDS_COMPILE = object()  # lookup() verdict: signature is warm, build an entry
 _UNCACHEABLE = object()   # poisoned signature: fn untraceable, never retry
 
@@ -341,36 +348,44 @@ def lookup(key):
     return result
 
 
-def _insert_locked(key, value) -> bool:
-    """Put a compiled/poisoned entry; returns True if the LRU evicted."""
+def _insert_locked(key, value):
+    """Put a compiled/poisoned entry; returns the key the LRU evicted to
+    make room (None when nothing was displaced) — the cost registry
+    retires the evicted program's record by that key."""
     _CACHE[key] = value
     _CACHE.move_to_end(key)
     _PENDING.pop(key, None)
     _FAILS.pop(key, None)
     if len(_CACHE) > _MAXSIZE:
-        _CACHE.popitem(last=False)
+        old_key, _old = _CACHE.popitem(last=False)
         _STATS["evictions"] += 1
-        return True
-    return False
+        return old_key
+    return None
 
 
 def store(key, entry: CachedOp) -> None:
     hook = _obs_hook
+    cost_hook = _cost_hook
     with _LOCK:
         evicted = _insert_locked(key, entry)
         _STATS["compiles"] += 1
     if hook is not None:
         hook("compile", None)
-        if evicted:
+        if evicted is not None:
             hook("evict", None)
+    if cost_hook is not None and evicted is not None:
+        cost_hook("evict", evicted)
 
 
 def mark_uncacheable(key) -> None:
     """Poison a signature whose fn failed to trace/compile (e.g. it branches
     on concrete array values, legal eagerly but not under jit). Later calls
     take the uncached path immediately instead of re-tracing every time."""
+    cost_hook = _cost_hook
     with _LOCK:
-        _insert_locked(key, _UNCACHEABLE)
+        evicted = _insert_locked(key, _UNCACHEABLE)
+    if cost_hook is not None and evicted is not None:
+        cost_hook("evict", evicted)
 
 
 def note_compile_failure(key) -> None:
@@ -379,10 +394,12 @@ def note_compile_failure(key) -> None:
     — ONCE or twice; a key that keeps failing gets poisoned so dispatch
     stops paying a doomed re-trace on every call. Each attempt is counted
     (``bypass{compile_retry}``) so the retry loop is diagnosable."""
+    cost_hook = _cost_hook
+    displaced = []
     with _LOCK:
         n = _FAILS.get(key, 0) + 1
         if n >= _MAX_COMPILE_RETRIES:
-            _insert_locked(key, _UNCACHEABLE)
+            displaced.append(_insert_locked(key, _UNCACHEABLE))
         else:
             _FAILS[key] = n
             _FAILS.move_to_end(key)
@@ -393,7 +410,11 @@ def note_compile_failure(key) -> None:
                 # the retry cap (poisoning early is always safe, it only
                 # costs that signature the cached fast path)
                 old_key, _n = _FAILS.popitem(last=False)
-                _insert_locked(old_key, _UNCACHEABLE)
+                displaced.append(_insert_locked(old_key, _UNCACHEABLE))
+    if cost_hook is not None:
+        for k in displaced:
+            if k is not None:
+                cost_hook("evict", k)
     note_bypass("compile_retry")
 
 
@@ -419,19 +440,25 @@ def configure(enabled: Optional[bool] = None, maxsize: Optional[int] = None,
               warmup: Optional[int] = None) -> None:
     """Runtime override of the env-derived settings (tests, tuning)."""
     global _ENABLED, _MAXSIZE, _WARMUP
+    cost_hook = _cost_hook
+    shrunk = []
     with _LOCK:
         if enabled is not None:
             _ENABLED = bool(enabled)
         if maxsize is not None:
             _MAXSIZE = max(1, int(maxsize))
             while len(_CACHE) > _MAXSIZE:
-                _CACHE.popitem(last=False)
+                old_key, _old = _CACHE.popitem(last=False)
+                shrunk.append(old_key)
                 _STATS["evictions"] += 1
             while len(_PENDING) > _MAXSIZE:
                 _PENDING.popitem(last=False)
                 _STATS["pending_drops"] += 1
         if warmup is not None:
             _WARMUP = max(1, int(warmup))
+    if cost_hook is not None:
+        for k in shrunk:
+            cost_hook("evict", k)
 
 
 def enabled() -> bool:
@@ -439,6 +466,7 @@ def enabled() -> bool:
 
 
 def cache_clear(reset_stats: bool = True) -> None:
+    cost_hook = _cost_hook
     with _LOCK:
         _CACHE.clear()
         _PENDING.clear()
@@ -446,6 +474,8 @@ def cache_clear(reset_stats: bool = True) -> None:
         if reset_stats:
             _STATS.update(hits=0, misses=0, compiles=0, evictions=0,
                           pending_drops=0, bypass={})
+    if cost_hook is not None:
+        cost_hook("clear", None)
 
 
 def stats_clear() -> None:

@@ -303,8 +303,14 @@ class CapturedStep:
             from ..jit.to_static import StaticFunction
             sf = StaticFunction(self._program_fn, donate_states=self._donate,
                                 iters_per_call=self._iters)
+            # ISSUE 16: file this program's cost record under the training
+            # step, not a generic "jit" entry
+            sf.cost_site = "train.step"
+            sf.cost_label = self._label
             self._programs[key] = sf
             if len(self._programs) > self._MAX_PROGRAMS:
+                # the popped StaticFunction's weakref finalizer retires its
+                # cost records with it
                 self._programs.popitem(last=False)
             self._set_donated_bytes()
         else:

@@ -687,6 +687,13 @@ def _apply_cached(op_name, fn, tensor_inputs, differentiable, amp,
         return _UNCACHED
     if fresh:
         _dcache.store(key, entry)
+        # ISSUE 16: compile-time cost capture — once per fresh signature,
+        # with the run arrays still in scope for spec building; is-None
+        # when observability.cost is not installed
+        cost_hook = _dcache._cost_hook
+        if cost_hook is not None:
+            cost_hook("store", key, entry=entry, op=op_name,
+                      arrays=run_arrays)
     if finite is not None and not bool(finite):
         raise FloatingPointError(f"op {op_name} produced nan/inf")
 

@@ -1,6 +1,13 @@
 """``paddle.flops``: per-layer FLOPs profiler (reference:
 python/paddle/hapi/dynamic_flops.py — forward hooks count multiply-adds per
-registered layer type, summed over a dummy forward)."""
+registered layer type, summed over a dummy forward).
+
+Since ISSUE 16 this analytic estimate is unified with the program cost
+registry (:mod:`paddle_tpu.observability.cost`): each ``flops()`` call
+files its per-network total as a ``model_source="analytic"`` record, and
+the registry uses the same analytic figure as the fallback when XLA
+returns no cost model for a compiled program
+(``StaticFunction.cost_analytic_flops``)."""
 
 from __future__ import annotations
 
@@ -97,6 +104,11 @@ def flops(net: "nn.Layer", input_size: List[int], custom_ops: Optional[Dict] = N
             net.train()
 
     total = sum(totals.values())
+    from .observability import cost as _cost
+    if _cost.installed():
+        # the cost registry's analytic leg: the same number XLA-less
+        # programs fall back to, labeled model_source="analytic"
+        _cost.record_analytic(type(net).__name__, total)
     if print_detail:
         print(f"{'Layer':<40}{'FLOPs':>16}")
         for lid, v in totals.items():

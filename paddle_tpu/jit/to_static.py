@@ -33,6 +33,29 @@ _SEGMENTED = object()  # cache sentinel: run via lazy compiled segments
 # nested fallback re-enters on the same thread.
 _INVOKE_LOCK = threading.RLock()
 
+# ISSUE 16: compile-time cost capture. observability.cost installs a
+# callable here while enabled (the _op_metrics_hook is-None contract: the
+# build path pays one probe when off, and analysis — a second AOT
+# compile — runs only for fresh builds while the hook is live).
+# Signature: hook("build", sf, jitted=, state_specs=, arg_specs=, key=)
+# on a fresh successful build; hook("retire", sf, key=) when a dead-state
+# entry is dropped before its retrace.
+_cost_hook: Optional[Callable] = None
+
+
+def _lower_spec(a):
+    """ShapeDtypeStruct for lowering outside the live call. Single-device
+    shardings mean "uncommitted" here — passing them into lower() would
+    conflict with in-step mesh constraints, which the real call
+    (uncommitted arrays) never does."""
+    sh = getattr(a, "sharding", None)
+    if not isinstance(sh, jax.sharding.NamedSharding):
+        sh = None
+    try:
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+    except TypeError:
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
 
 def _is_trace_failure(e: BaseException) -> bool:
     """Graph breaks are TRACE/LOWERING failures only (tensor-dependent Python
@@ -123,6 +146,19 @@ class StaticFunction:
         self._cache: Dict[Any, Tuple] = {}
         self.concrete_program = None  # parity attribute
         self._last_lowered = None  # (jitted, arg shape/sharding specs)
+        # ISSUE 16: cost-record identity. Owners that know what this
+        # program IS (step_capture, the serving engine) set site/label so
+        # the cost registry files its records under the right name;
+        # unset means a generic "jit" program. cost_analytic_flops is the
+        # flops_counter-style fallback used when XLA has no cost model.
+        self.cost_site: Optional[str] = None
+        self.cost_label: Optional[str] = None
+        self.cost_analytic_flops: Optional[float] = None
+        # (cache key, arg aval signature) pairs already captured: one
+        # cache entry's jax.jit respecializes per input shape (the
+        # serving engine's batch buckets), so "fresh build" alone would
+        # miss every executable after the first
+        self._cost_captured: set = set()
 
     @property
     def program_cache(self):
@@ -226,6 +262,11 @@ class StaticFunction:
         state_tensors = [r() for r in state_refs]
         if any(t is None for t in state_tensors):
             # a state tensor died between building and calling (rare): rebuild
+            cost_hook = _cost_hook
+            if cost_hook is not None:
+                cost_hook("retire", self, key=key)
+            self._cost_captured = {c for c in self._cost_captured
+                                   if c[0] != key}
             del self._cache[key]
             return self.__call__(*args, **kwargs)
         if not fresh_build:
@@ -234,6 +275,19 @@ class StaticFunction:
             # plus a miss
             _obs.inc("jit.cache_hits_total")
 
+        # cost capture needs the argument specs from BEFORE the call —
+        # donation deletes the very buffers the specs describe. Keyed on
+        # (cache key, arg aval signature), not fresh_build: one entry's
+        # jax.jit compiles a NEW executable per input shape (serving
+        # batch buckets), and each deserves its own cost record.
+        cost_hook = _cost_hook
+        cost_specs = cost_key = None
+        if cost_hook is not None:
+            sig = tuple((tuple(a.shape), str(a.dtype)) for a in arg_arrays)
+            if (key, sig) not in self._cost_captured:
+                cost_key = (key, sig)
+                cost_specs = ([_lower_spec(t._data) for t in state_tensors],
+                              [_lower_spec(a) for a in arg_arrays])
         try:
             result = self._invoke(jitted, holder, state_tensors, arg_arrays,
                                   leaves, key)
@@ -242,6 +296,12 @@ class StaticFunction:
                 # graph-breaks discards the executable without XLA ever
                 # compiling it, and must not read as a compile
                 _obs.inc("jit.compiles_total")
+            if cost_specs is not None:
+                self._cost_captured.add(cost_key)
+                cost_hook("build", self, jitted=jitted,
+                          state_specs=cost_specs[0],
+                          arg_specs=cost_specs[1], key=key,
+                          sig=cost_key[1])
             return result
         except Exception as e:
             if self._full_graph or not _is_trace_failure(e):
@@ -303,21 +363,9 @@ class StaticFunction:
                 key):
         state_arrays = [t._data for t in state_tensors]
         if _flags.flag("to_static_capture_lowered"):
-            def _spec(a):
-                # single-device shardings mean "uncommitted" here — passing
-                # them into lower() would conflict with in-step mesh
-                # constraints, which the real call (uncommitted arrays)
-                # never does
-                sh = getattr(a, "sharding", None)
-                if not isinstance(sh, jax.sharding.NamedSharding):
-                    sh = None
-                try:
-                    return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
-                except TypeError:
-                    return jax.ShapeDtypeStruct(a.shape, a.dtype)
             self._last_lowered = (jitted,
-                                  [_spec(a) for a in state_arrays],
-                                  [_spec(a) for a in arg_arrays])
+                                  [_lower_spec(a) for a in state_arrays],
+                                  [_lower_spec(a) for a in arg_arrays])
         if self._donate:
             # donated buffers must be unique: two state tensors aliasing one
             # jax.Array (or a state array that is also a plain argument) make

@@ -63,12 +63,19 @@ __all__ = [
     "inc", "set_gauge", "observe", "scoped_timer",
     "snapshot", "reset", "prometheus_text", "parse_prometheus_text",
     "read_jsonl",
-    "trace",
+    "trace", "cost",
 ]
 
 _REGISTRY = Registry()
 _ENABLED = False
 _LOCK = threading.Lock()
+
+# ISSUE 16: the program cost registry (submodule `cost`) shares this
+# registry's metric families. It is imported lazily inside
+# enable()/disable()/reset() — a module-scope `from . import cost` here
+# would be a load-bearing import cycle (cost reads _REGISTRY back out of
+# this package at ITS import time), and nothing needs the submodule
+# before the first enable().
 
 
 def default_registry() -> Registry:
@@ -134,6 +141,10 @@ def enable() -> None:
         from ..core import dispatch_cache as _dcache_mod
         _tensor_mod._op_metrics_hook = _dispatch_hook
         _dcache_mod._obs_hook = _cache_hook
+    # compile-time cost capture rides the same switch (its own is-None
+    # hooks in to_static/dispatch_cache; no-op under PADDLE_TPU_COST=off)
+    from . import cost
+    cost.install()
 
 
 def disable() -> None:
@@ -145,6 +156,8 @@ def disable() -> None:
         from ..core import dispatch_cache as _dcache_mod
         _tensor_mod._op_metrics_hook = None
         _dcache_mod._obs_hook = None
+    from . import cost
+    cost.uninstall()
 
 
 # -- family accessors (get-or-create on the default registry) ----------------
@@ -216,8 +229,11 @@ def snapshot() -> Dict[str, Any]:
 
 
 def reset() -> None:
-    """Zero every series (metric families survive); test isolation seam."""
+    """Zero every series (metric families survive) and drop cost
+    records; test isolation seam."""
     _REGISTRY.reset()
+    from . import cost
+    cost.clear()
 
 
 def prometheus_text(registry: Optional[Registry] = None) -> str:

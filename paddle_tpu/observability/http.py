@@ -128,10 +128,13 @@ class _Handler(QuietJSONHandler):
                     "events": _trace.flight_recorder().snapshot()})
             elif path == "/debug/trace":
                 self._send_json(200, _trace.export_chrome())
+            elif path == "/debug/cost":
+                from . import cost as _cost
+                self._send_json(200, _cost.debug_doc())
             else:
                 self._send_json(404, {"error": "not found", "routes": [
                     "/metrics", "/healthz", "/debug/flight",
-                    "/debug/trace"]})
+                    "/debug/trace", "/debug/cost"]})
         except (BrokenPipeError, ConnectionResetError):
             pass  # why: the scraper hung up mid-response; nothing to serve
         except Exception:

@@ -543,6 +543,14 @@ class FlightRecorder:
                "dumped_at": time.time(),
                "dumped_perf_ts": time.perf_counter(),
                "info": dict(info), "events": evs}
+        try:
+            # ISSUE 16: the post-mortem names the programs that were live
+            # AND what they should have cost (records + HBM ledger);
+            # flight_snapshot itself never raises, the guard covers import
+            from . import cost as _cost
+            doc["cost"] = _cost.flight_snapshot()
+        except Exception:
+            doc["cost"] = None
         if path is None:
             slug = "".join(c if c.isalnum() or c in "-_" else "_"
                            for c in reason)
@@ -627,6 +635,19 @@ def health() -> Dict[str, Any]:
     for name, b in sorted(dict(_HEALTH.beats).items()):
         comps[name] = c = _beacon_component(b, now)
         healthy = healthy and c["ok"]
+    # ISSUE 16: HBM ledger detail rides along 503-INDEPENDENTLY — low
+    # headroom warns (once, in the cost module) but never flips the
+    # routing status; the component's ok is always True by contract
+    try:
+        from . import cost as _cost
+        hbm = _cost.healthz_component()
+        if hbm is not None:
+            comps["hbm"] = hbm
+    except Exception:
+        # why silent: the hbm component is advisory detail — a ledger
+        # walk failing mid-scrape must not turn /healthz into a 500,
+        # and the failure is already counted by the cost module
+        _log.debug("healthz: hbm component unavailable", exc_info=True)
     return {"status": "ok" if healthy else "unhealthy",
             "components": comps, "pid": os.getpid()}
 

@@ -103,6 +103,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import cost as _cost
 from ..observability import http as _obs_http
 from ..observability import trace as _trace
 from ..resilience import deadline_scope, faults as _faults, jitter_sleep
@@ -291,6 +292,9 @@ class Engine:
         self._prefill_fn = prefill_fn
         self._step_fn = step_fn
         self.kv = _kv.PagedKVCache(config.kv_config())
+        # ISSUE 16: the HBM ledger tracks this pool's bytes (weakly — a
+        # dropped engine drops its pool from the ledger)
+        _cost.register_kv_cache(self.kv)
         self._quantized = self.kv.config.quantized
         self.scheduler = Scheduler(
             max_queue=config.max_queue, policy=config.policy,
@@ -424,6 +428,14 @@ class Engine:
 
         self._decode_program = to_static(decode_program)
         self._prefill_program = to_static(prefill_program)
+        # ISSUE 16: the cost registry files one record per warmed batch
+        # bucket under serving.decode (bucket inferred from the compiled
+        # tok spec) and one per prefill length under serving.prefill
+        name = self.config.name or "engine"
+        self._decode_program.cost_site = "serving.decode"
+        self._decode_program.cost_label = f"{name}.decode"
+        self._prefill_program.cost_site = "serving.prefill"
+        self._prefill_program.cost_label = f"{name}.prefill"
 
     def _scales_args(self):
         from ..core.tensor import Tensor as _T

@@ -31,6 +31,12 @@ os.environ.setdefault("PADDLE_TPU_EAGER_CACHE", "0")
 # per-test and pins the captured tier's own invariants.
 os.environ.setdefault("PADDLE_TPU_STEP_CAPTURE", "off")
 
+# Program cost accounting (ISSUE 16) captures XLA cost/memory analysis by
+# AOT-lowering every fresh executable a second time — once per compile,
+# which is exactly what this compile-dominated suite is made of. Off
+# suite-wide; test_cost.py opts in per-test, as does the bench row.
+os.environ.setdefault("PADDLE_TPU_COST", "off")
+
 import jax  # noqa: E402
 
 # The on-chip smoke tier (`PADDLE_TPU_TIER=1 pytest -m tpu`) must run

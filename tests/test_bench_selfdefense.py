@@ -504,7 +504,8 @@ def test_paged_attention_block_schema():
     mod = _load_bench_generation()
     assert set(mod.PAGED_ATTENTION_FIELDS) == {
         "mode", "kernel_steps", "dense_steps", "attn_bytes_per_token_live",
-        "attn_bytes_per_token_dense", "suspect_reasons"}
+        "attn_bytes_per_token_dense", "attn_bytes_source",
+        "suspect_reasons"}
     assert set(mod.CONTEXT_SWEEP_FIELDS) == {
         "context", "decode_tokens_per_sec", "attn_bytes_per_token_live",
         "attn_bytes_per_token_dense"}
@@ -550,3 +551,118 @@ def test_all_dense_on_tpu_is_suspect():
         dict(block, kernel_steps=40, dense_steps=0), on_tpu=True) == []
     assert mod._paged_suspect_reasons(
         dict(block, mode="off"), on_tpu=True) == []
+
+
+def test_paged_measured_bytes_come_from_cost_registry():
+    # ISSUE 16: the tier that ran reports the cost registry's measured
+    # per-token bytes (largest warmed bucket's bytes_accessed / bucket);
+    # no measured record -> None -> the block stays on the model
+    mod = _load_bench_generation()
+    recs = {1: {"bytes_accessed": 1000.0}, 4: {"bytes_accessed": 8000.0}}
+    assert mod._measured_decode_bytes_per_token(recs) == 2000
+    assert mod._measured_decode_bytes_per_token({}) is None
+    assert mod._measured_decode_bytes_per_token(
+        {4: {"bytes_accessed": None}}) is None
+    import inspect
+    src = inspect.getsource(mod._run_serving)
+    assert "_measured_decode_bytes_per_token" in src
+    assert "decode_bucket_records" in src and '"attn_bytes_source"' in src
+
+
+def test_paged_formula_cross_checks_measurement():
+    # one-sided 10% cross-check: the modeled attention-only bytes of the
+    # tier that ran must not exceed the measured whole-program traffic
+    mod = _load_bench_generation()
+    base = {"mode": "auto", "kernel_steps": 0, "dense_steps": 40,
+            "attn_bytes_per_token_live": 100,
+            "attn_bytes_per_token_dense": 5000,
+            "attn_bytes_source": "measured"}
+    # formula (6000) > measured dense (5000) * 1.10 -> flagged
+    reasons = mod._paged_suspect_reasons(base, on_tpu=False,
+                                         formula_live=100,
+                                         formula_dense=6000)
+    assert reasons and "disagree" in reasons[0]
+    # formula within the one-sided envelope -> clean
+    assert mod._paged_suspect_reasons(base, on_tpu=False, formula_live=100,
+                                      formula_dense=4000) == []
+    # source=model (no measurement): no cross-check to run
+    assert mod._paged_suspect_reasons(
+        dict(base, attn_bytes_source="model"), on_tpu=False,
+        formula_live=100, formula_dense=6000) == []
+    # kernel tier ran -> the live formula is the one checked
+    kblock = dict(base, kernel_steps=40, dense_steps=0,
+                  attn_bytes_per_token_live=5000)
+    assert mod._paged_suspect_reasons(kblock, on_tpu=False,
+                                      formula_live=6000,
+                                      formula_dense=100) != []
+
+
+# ---------------------------------------------------------------------------
+# program cost accounting block (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def test_cost_detail_is_schema_stable():
+    # the row of record pins the cost block: XLA's modeled step
+    # flops/bytes, the modeled MFU from the measured step time, and the
+    # HBM ledger's peak/headroom
+    assert set(bench.COST_FIELDS) == {
+        "model_source", "step_flops", "step_bytes", "mfu_modeled",
+        "peak_hbm_bytes", "hbm_headroom_bytes"}
+    doc = {"records": [
+        {"site": "dispatch", "flops": 1.0, "bytes_accessed": 2.0,
+         "model_source": "xla"},
+        {"site": "train.step", "flops": 2e12, "bytes_accessed": 1e10,
+         "model_source": "xla"}],
+        "hbm": {"peak_hbm_bytes": 8 << 30, "headroom_bytes": 8 << 30}}
+    block = bench._cost_detail(doc, analytic_step_flops=9e9,
+                               step_seconds=0.5, peak_flops=1e13)
+    assert set(block) == set(bench.COST_FIELDS)
+    assert block["model_source"] == "xla"
+    assert block["step_flops"] == 2e12 and block["step_bytes"] == 1e10
+    # mfu = flops / (seconds * peak): 2e12 / (0.5 * 1e13) = 0.4
+    assert block["mfu_modeled"] == 0.4
+    assert block["peak_hbm_bytes"] == 8 << 30
+
+
+def test_cost_detail_analytic_fallback_and_all_null_suspect():
+    # no train.step record -> the analytic flops estimate stands in,
+    # labeled as such; nothing at all -> all-null block -> suspect
+    block = bench._cost_detail({"records": [], "hbm": {}},
+                               analytic_step_flops=1e12,
+                               step_seconds=0.5, peak_flops=1e13)
+    assert block["model_source"] == "analytic"
+    assert block["step_flops"] == 1e12 and block["step_bytes"] is None
+    assert block["mfu_modeled"] == 0.2
+    assert bench._cost_suspect_reasons(block) == []
+
+    empty = bench._cost_detail({"records": [], "hbm": {}},
+                               analytic_step_flops=0.0,
+                               step_seconds=0.5, peak_flops=1e13)
+    assert empty["model_source"] == "none"
+    assert all(empty[k] is None for k in
+               ("step_flops", "step_bytes", "mfu_modeled",
+                "peak_hbm_bytes", "hbm_headroom_bytes"))
+    reasons = bench._cost_suspect_reasons(empty)
+    assert reasons and "cost accounting empty" in reasons[0]
+
+
+def test_bench_main_emits_cost_block():
+    import inspect
+    src = inspect.getsource(bench.main)
+    assert "_cost_detail" in src and '"cost"' in src
+    assert "_cost_suspect_reasons" in src
+    assert "debug_doc" in src
+
+
+def test_cross_host_sync_roots_cover_cost_hooks():
+    # the cost hook call-sites join the fast-path reachability roots: a
+    # host sync reachable from capture would stall every dispatch/compile
+    import sys
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint.engine import DEFAULT_CONFIG
+    roots = DEFAULT_CONFIG["fast_path_roots"]
+    assert "paddle_tpu/observability/cost.py::_on_static_build" in roots
+    assert "paddle_tpu/observability/cost.py::_on_dispatch_event" in roots
+    assert "paddle_tpu/observability/cost.py" in \
+        DEFAULT_CONFIG["span_hot_modules"]

@@ -221,6 +221,12 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         # is pure-functional; a host sync reachable from here would stall
         # every serving decode STEP (per token, per layer)
         "paddle_tpu/ops/paged_attention.py::paged_decode_attention",
+        # ISSUE 16: the cost-registry hook call-sites — fired from the
+        # dispatch fast path and the captured-step/serving build paths;
+        # a host sync reachable from either would turn one-time compile
+        # accounting into a per-dispatch stall
+        "paddle_tpu/observability/cost.py::_on_static_build",
+        "paddle_tpu/observability/cost.py::_on_dispatch_event",
     ],
     # span-discipline (ISSUE 12): the tracing implementation module (the
     # one place manual event emission is legal), and the fast-path modules
@@ -232,6 +238,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         "paddle_tpu/core/dispatch_cache.py",
         "paddle_tpu/core/autograd.py",
         "paddle_tpu/core/step_capture.py",
+        # ISSUE 16: the cost hooks run inside the dispatch/build paths —
+        # any trace emission here must hide behind an enabled() guard so
+        # PADDLE_TPU_COST=off (and disabled obs) stays zero-overhead
+        "paddle_tpu/observability/cost.py",
     ],
     # import-layering: the declared layer DAG, base layers first; a module
     # may (module-scope) import same-or-lower layers only. Matching is by
