@@ -47,7 +47,7 @@ SERVING_RESULT_FIELDS = (
     "benchmark", "params", "layers", "hidden", "dtype", "kv_dtype",
     "page_size", "prompt", "tokens", "single_stream_tokens_per_sec",
     "serving", "paged_attention", "context_sweep", "resilience", "http",
-    "speedup_vs_single_stream", "device")
+    "prefix_sharing", "speedup_vs_single_stream", "device")
 SERVING_ROW_FIELDS = (
     "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "queue_wait_ms",
     "scan_greedy_parity", "match_frac", "batch_utilization")
@@ -88,6 +88,45 @@ HTTP_RESULT_FIELDS = (
     "e2e_p50_ms", "e2e_p99_ms", "inproc_p50_ms", "overhead_p50_ms",
     "router")
 HTTP_ROUTER_FIELDS = ("retries", "failovers", "hedges", "rejected")
+# the prefix-sharing leg (ISSUE 17, --serving --prompt-overlap): one row
+# per seeded shared-prefix mix (0/50/90% of each prompt is a common
+# page-aligned prefix), sharing ON vs the same workload with sharing OFF.
+# The claims of record: prefill tokens COMPUTED collapse toward the
+# unshared tail as overlap grows, TTFT follows, aggregate tok/s never
+# regresses, and the transcripts stay bit-identical across the two modes
+# (the COW numerics contract). Both modes run the CAUSAL prefill
+# (seq_offset=0 vs seq_offset=start) so the parity comparison is
+# apples-to-apples — the legacy bidirectional FMT prefill is semantically
+# incompatible with chunked prefix reuse.
+PREFIX_SHARING_FIELDS = (
+    "page_size", "prompt", "tokens", "requests", "legs", "suspect_reasons")
+PREFIX_SHARING_LEG_FIELDS = (
+    "overlap_pct", "shared_prefix_tokens",
+    "aggregate_tokens_per_sec", "baseline_tokens_per_sec",
+    "ttft_ms_p50", "ttft_ms_p99",
+    "prefill_tokens_requested", "prefill_tokens_computed",
+    "pages_shared_ratio", "prefix_hit_rate", "transcripts_match")
+
+
+def _prefix_suspect_reasons(legs: dict) -> list[str]:
+    """Why the prefix_sharing block disqualifies this run ([] = healthy):
+    the 90% leg sharing NOTHING means the measured run never exercised
+    the feature the block claims to price (index disabled, prompts not
+    page-aligned, or the chain hash broke), and a transcript mismatch
+    means copy-on-write leaked one request's K/V into another's."""
+    reasons = []
+    hi = legs.get("overlap90")
+    if hi is not None and hi["pages_shared_ratio"] == 0:
+        reasons.append(
+            "prefix_sharing: the 90% overlap leg shared ZERO pages — the "
+            "run never exercised prefix reuse (check "
+            "PADDLE_TPU_PREFIX_SHARING and page alignment)")
+    for name, leg in legs.items():
+        if not leg["transcripts_match"]:
+            reasons.append(
+                f"prefix_sharing: {name} transcripts differ between "
+                "sharing on and off — COW isolation is broken")
+    return reasons
 
 
 def _storage_bytes(kv_dtype: str, compute_dtype: str) -> int:
@@ -187,6 +226,11 @@ def main() -> None:
                     help="with --serving: add the front-door leg — e2e "
                          "p50/p99 and tok/s through the K=2 router + "
                          "streaming HTTP tier vs in-process submit()")
+    ap.add_argument("--prompt-overlap", action="store_true",
+                    help="with --serving: add the prefix-sharing leg — a "
+                         "seeded 0/50/90%% shared-prefix prompt mix, "
+                         "sharing on vs off (tok/s, TTFT, prefill tokens "
+                         "computed vs requested, pages shared)")
     ap.add_argument("--kv-dtype", default="native",
                     choices=("native", "bf16", "int8"))
     ap.add_argument("--page-size", type=int, default=64)
@@ -247,6 +291,19 @@ def main() -> None:
         nxt = paddle.argmax(logits, axis=-1)
         return nxt.astype("int32"), cache
 
+    def prefill_causal_raw(ids, cache, start=0):
+        """3-arg causal prefill for the prefix-sharing leg (ISSUE 17):
+        ``seq_offset`` makes the FMT prefill causal and chunk-resumable —
+        positions [start, start+len) attend the resident cache prefix plus
+        themselves, so a shared-prefix admission computes only its tail
+        and the start=0 run is the exact full-prompt reference."""
+        x = embed(ids)
+        x, cache = fmt(x, caches=cache, time_step=None, seq_offset=start)
+        x = final_ln(x)
+        logits = head(x[:, -1:])
+        nxt = paddle.argmax(logits, axis=-1)
+        return nxt.astype("int32"), cache
+
     prefill = paddle.jit.to_static(prefill_raw)
 
     @paddle.jit.to_static
@@ -276,7 +333,8 @@ def main() -> None:
 
     if args.serving:
         _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
-                     n_params, L=L, H=H, E=E, V=V, M=M, dtype=dtype)
+                     n_params, prefill_causal_raw=prefill_causal_raw,
+                     L=L, H=H, E=E, V=V, M=M, dtype=dtype)
         return
 
     rng = np.random.default_rng(0)
@@ -359,7 +417,7 @@ def main() -> None:
 
 
 def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
-                 n_params, *, L, H, E, V, M, dtype):
+                 n_params, *, prefill_causal_raw, L, H, E, V, M, dtype):
     """Continuous-batching throughput: aggregate tok/s per batch size with
     per-request greedy parity against the bs=1 per-token compiled loop."""
     import jax
@@ -523,6 +581,9 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
     http_block = _run_http(args, serving, obs, prefill_raw, lm_step,
                            n_new=n_new, L=L, H=H, E=E, V=V, M=M,
                            dtype=dtype) if args.http else None
+    prefix_block = _run_prefix_sharing(
+        args, serving, prefill_causal_raw, lm_step, L=L, H=H, E=E, V=V,
+        dtype=dtype, on_tpu=on_tpu) if args.prompt_overlap else None
     rejected = snap.get("serving.rejected_total", {}) or {}
     trips = snap.get("serving.watchdog_trips_total", {}) or {}
     fire = {
@@ -545,6 +606,7 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         "context_sweep": sweep,
         "resilience": fire,
         "http": http_block,
+        "prefix_sharing": prefix_block,
         "speedup_vs_single_stream": round(top / single_rate, 2),
         "device": str(jax.devices()[0]),
     }
@@ -558,6 +620,10 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         # mirror bench.py's anomaly contract: the number still prints, the
         # exit code says don't trust it as the number of record
         print(f"PAGED SUSPECT: {paged_block['suspect_reasons']}",
+              file=sys.stderr)
+        sys.exit(1)
+    if prefix_block and prefix_block["suspect_reasons"]:
+        print(f"PREFIX SHARING SUSPECT: {prefix_block['suspect_reasons']}",
               file=sys.stderr)
         sys.exit(1)
 
@@ -681,6 +747,107 @@ def _run_http(args, serving, obs, prefill_raw, lm_step, *, n_new, L, H, E,
         "http block drifted from HTTP_RESULT_FIELDS"
     assert set(block["router"]) == set(HTTP_ROUTER_FIELDS), \
         "http router block drifted from HTTP_ROUTER_FIELDS"
+    return block
+
+
+def _run_prefix_sharing(args, serving, prefill_causal_raw, lm_step, *,
+                        L, H, E, V, dtype, on_tpu):
+    """The prefix-sharing leg (ISSUE 17, --prompt-overlap): for each
+    seeded overlap mix (0/50/90% of every prompt is one common
+    page-aligned prefix) drain the SAME workload through an engine with
+    prefix sharing ON and one with it OFF, both on the causal prefill.
+    Each leg reports aggregate tok/s for both modes, the sharing-mode
+    TTFT p50/p99, prefill tokens computed vs requested over the measured
+    drain, the fraction of mapped pages that were shared instead of
+    prefilled, the prefix-index hit rate, and whether the two modes'
+    transcripts matched bit-for-bit. A warm drain precedes measurement so
+    compile time (including the tail-prefill program) never lands in a
+    TTFT, and its published chains stay resident on the idle list — the
+    measured 90% leg exercises cross-drain reuse too."""
+    n_req, overlaps = 8, (0, 50, 90)
+    ps = args.page_size if on_tpu else 4
+    plen = args.prompt if on_tpu else 32
+    n_new = min(args.tokens, 8)
+    max_len = -(-(plen + n_new + 2) // ps) * ps
+    pages_per_req = -(-(plen + n_new) // ps)
+    rng = np.random.default_rng(3)
+    legs = {}
+    for pct in overlaps:
+        shared_len = int(pct / 100.0 * plen) // ps * ps
+        base = rng.integers(0, V, (shared_len,), dtype=np.int32)
+
+        def make_prompts():
+            return [np.concatenate([
+                base,
+                rng.integers(0, V, (plen - shared_len,), dtype=np.int32)])
+                for _ in range(n_req)]
+
+        # fresh tails per drain, same shared base: the warm drain seeds
+        # the index (and compiles the tail program for this leg's start
+        # offset), the measured drain then shares exactly the base chain
+        # per request — self-resubmission hits would otherwise make every
+        # overlap level look like a 100% cache hit. Both modes replay the
+        # SAME two prompt sets so the transcript comparison is exact.
+        warm_prompts, measured_prompts = make_prompts(), make_prompts()
+        out = {}
+        for mode in ("on", "off"):
+            cfg = serving.ServingConfig(
+                num_layers=L, num_heads=H, head_dim=E // H,
+                max_len=max_len, max_batch=4, buckets=(1, 4),
+                page_size=ps, kv_dtype=args.kv_dtype, compute_dtype=dtype,
+                prefix_sharing=mode)
+            eng = serving.Engine(prefill_causal_raw, lm_step, cfg)
+            eng.warmup(prompt_lens=[plen])
+
+            def drain(prompts):
+                futs = [eng.submit(serving.GenerationRequest(
+                    p, max_new_tokens=n_new)) for p in prompts]
+                eng.run()
+                return [f.result() for f in futs]
+
+            drain(warm_prompts)          # compiles + seeds the index
+            req0, comp0 = eng.prefill_token_stats()
+            shared0 = eng.kv.prefix_stats()["prefix_pages_shared_total"]
+            t0 = time.perf_counter()
+            results = drain(measured_prompts)
+            elapsed = time.perf_counter() - t0
+            req1, comp1 = eng.prefill_token_stats()
+            stats = eng.kv.prefix_stats()
+            out[mode] = {
+                "tok_s": round(n_req * n_new / elapsed, 1),
+                "ttft": [r.ttft_s for r in results],
+                "tokens": [r.tokens for r in results],
+                "requested": req1 - req0, "computed": comp1 - comp0,
+                "shared_pages": stats["prefix_pages_shared_total"] - shared0,
+                "hit_rate": stats["prefix_hit_rate"],
+            }
+        on = out["on"]
+        leg = {
+            "overlap_pct": pct,
+            "shared_prefix_tokens": shared_len,
+            "aggregate_tokens_per_sec": on["tok_s"],
+            "baseline_tokens_per_sec": out["off"]["tok_s"],
+            "ttft_ms_p50": round(
+                1e3 * float(np.percentile(on["ttft"], 50)), 2),
+            "ttft_ms_p99": round(
+                1e3 * float(np.percentile(on["ttft"], 99)), 2),
+            "prefill_tokens_requested": int(on["requested"]),
+            "prefill_tokens_computed": int(on["computed"]),
+            "pages_shared_ratio": round(
+                on["shared_pages"] / (n_req * pages_per_req), 3),
+            "prefix_hit_rate": round(on["hit_rate"], 3),
+            "transcripts_match": on["tokens"] == out["off"]["tokens"],
+        }
+        assert set(leg) == set(PREFIX_SHARING_LEG_FIELDS), \
+            "prefix sharing leg drifted from PREFIX_SHARING_LEG_FIELDS"
+        legs[f"overlap{pct}"] = leg
+    block = {
+        "page_size": ps, "prompt": plen, "tokens": n_new,
+        "requests": n_req, "legs": legs,
+        "suspect_reasons": _prefix_suspect_reasons(legs),
+    }
+    assert set(block) == set(PREFIX_SHARING_FIELDS), \
+        "prefix sharing block drifted from PREFIX_SHARING_FIELDS"
     return block
 
 

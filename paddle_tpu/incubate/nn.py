@@ -570,7 +570,7 @@ class FusedMultiTransformer(nn.Layer):
 
     def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
                 rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
-                time_step=None):
+                time_step=None, seq_offset=None):
         from . import nn_functional as IF
         from ..ops.manipulation import reshape
         for unsupported, label in ((rotary_embs, "rotary_embs"),
@@ -582,6 +582,25 @@ class FusedMultiTransformer(nn.Layer):
                     f"FusedMultiTransformer: {label} is not supported on "
                     "this path (apply RoPE via "
                     "fused_rotary_position_embedding before the stack)")
+        # ``seq_offset`` (ISSUE 17) selects the CAUSAL chunked-prefill
+        # contract against the stacked cache: ``src`` holds positions
+        # [seq_offset, seq_offset + s), each layer's attention runs
+        # causally over [cache prefix at [0, seq_offset)] + src (SDPA's
+        # bottom-right-aligned is_causal gives query i the span
+        # <= seq_offset + i), and K/V land in the cache at src's own
+        # positions — shared prefix pages are read, never written. The
+        # default ``None`` keeps the legacy full-sequence prefill
+        # (mask-free = bidirectional) byte-identical; prefix sharing needs
+        # causal prefill on BOTH legs, so 0 means "full prefill, causal".
+        if seq_offset is not None and time_step is not None:
+            raise ValueError(
+                "FusedMultiTransformer: seq_offset is a prefill-only "
+                "contract (time_step must be None)")
+        if seq_offset is not None and (caches is None or isinstance(
+                caches, (list, tuple))):
+            raise ValueError(
+                "FusedMultiTransformer: seq_offset needs the STACKED "
+                "cache (L, 2, B, H, max_len, D)")
         x = src
         new_caches = [] if caches is not None else None
         decode = time_step is not None
@@ -620,6 +639,8 @@ class FusedMultiTransformer(nn.Layer):
             caches, (list, tuple))
         cache_list = [caches[i] for i in range(self.num_layers)] \
             if prefill_stacked else caches
+        causal = seq_offset is not None
+        off = int(seq_offset) if causal else 0
         for i in range(self.num_layers):
             residual = x
             h = F.layer_norm(x, [self.embed_dim], weight=self.ln_scales[i],
@@ -633,20 +654,35 @@ class FusedMultiTransformer(nn.Layer):
             qkv = qkv + reshape(self.qkv_biases[i], [3 * E])
             qkv = reshape(qkv, [b, s, 3, nh, hd])
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            k_in, v_in = k, v
+            if off:
+                # shared-prefix continuation: keys/values start with the
+                # resident prefix K/V read from the cache
+                def _take_pre(c):
+                    # (2, B, H, M, D) -> K, V as (B, off, H, D)
+                    pre = jnp.swapaxes(c[:, :, :, :off, :], 2, 3)
+                    return pre[0], pre[1]
+
+                kpre, vpre = apply("fmt_take_prefix", _take_pre,
+                                   cache_list[i])
+                from ..ops.manipulation import concat
+                k_in = concat([kpre.astype(k.dtype), k], axis=1)
+                v_in = concat([vpre.astype(v.dtype), v], axis=1)
             attn = F.scaled_dot_product_attention(
-                q, k, v, attn_mask=attn_mask,
+                q, k_in, v_in, attn_mask=attn_mask,
                 dropout_p=self.dropout_rate if self.training else 0.0,
+                is_causal=causal and attn_mask is None,
                 training=self.training)
             attn = IF.fused_linear(reshape(attn, [b, s, E]),
                                    self.linear_weights[i],
                                    bias=self.linear_biases[i])
             if new_caches is not None:
-                # prefill the pre-allocated cache at positions [0, s)
+                # prefill the pre-allocated cache at positions [off, off+s)
                 def _prefill(c, kk, vv):
                     kt = jnp.swapaxes(kk, 1, 2)  # (B, H, S, D)
                     vt = jnp.swapaxes(vv, 1, 2)
-                    c = c.at[0, :, :, :kt.shape[2], :].set(kt)
-                    return c.at[1, :, :, :vt.shape[2], :].set(vt)
+                    c = c.at[0, :, :, off:off + kt.shape[2], :].set(kt)
+                    return c.at[1, :, :, off:off + vt.shape[2], :].set(vt)
 
                 new_caches.append(apply("fmt_prefill_cache", _prefill,
                                         cache_list[i], k, v))

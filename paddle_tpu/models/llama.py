@@ -427,22 +427,47 @@ class LlamaForCausalLM(nn.Layer):
             nxt = argmax(self._logits(h), axis=-1)   # (B, 1) greedy
             return nxt.astype("int32"), cache
 
-        def prefill_fn(ids, cache):
-            lp = int(ids.shape[1])
-            empty = jnp.zeros((1, 0, nkv, hd),
-                              model.embed_tokens.weight._data.dtype)
-            h, new_caches = model(
-                ids, caches=[(Tensor(empty), Tensor(empty))
-                             for _ in range(len(layers))])
+        def prefill_fn(ids, cache, start=0):
+            """3-arg form (ISSUE 17): with ``start > 0`` the leading
+            ``start`` cache positions are a shared prefix already resident
+            in ``cache`` — slice them into per-layer concat caches and run
+            the incremental forward over the TAIL only. Correct by the
+            same machinery the generate loop uses: RoPE applies at
+            ``offset = start`` and SDPA's causal mask is bottom-right
+            aligned (tail query i attends keys ``<= start + i`` on both
+            the XLA and flash paths), so the tail K/V and next-token
+            logits match a full prefill bit-for-bit given identical prefix
+            K/V bytes."""
+            lp = int(ids.shape[1])               # tail length when start>0
+            dt = model.embed_tokens.weight._data.dtype
+            if start:
+                def take_prefix(ca):
+                    # (L, 2, 1, Hkv, M, D) -> 2L arrays (1, start, Hkv, D)
+                    pre = jnp.swapaxes(ca[:, :, :, :, :start, :], 3, 4)
+                    return tuple(pre[i, kv].astype(dt)
+                                 for i in range(len(layers))
+                                 for kv in (0, 1))
+                flat_pre = apply("llama_take_prefix", take_prefix, cache)
+                caches_in = [(flat_pre[2 * i], flat_pre[2 * i + 1])
+                             for i in range(len(layers))]
+            else:
+                empty = jnp.zeros((1, 0, nkv, hd), dt)
+                caches_in = [(Tensor(empty), Tensor(empty))
+                             for _ in range(len(layers))]
+            h, new_caches = model(ids, caches=caches_in)
             from ..ops.reduce import argmax
             nxt = argmax(self._logits(h[:, -1:]), axis=-1)
 
             def pack(ca, *kvs):
                 for i in range(len(layers)):
-                    kt = jnp.swapaxes(kvs[2 * i], 1, 2)      # (1,Hkv,Lp,D)
-                    vt = jnp.swapaxes(kvs[2 * i + 1], 1, 2)
-                    ca = ca.at[i, 0, :, :, :lp, :].set(kt.astype(ca.dtype))
-                    ca = ca.at[i, 1, :, :, :lp, :].set(vt.astype(ca.dtype))
+                    # new_caches concat prefix+tail; store the tail at its
+                    # own positions — shared-prefix pages are not written
+                    kt = jnp.swapaxes(kvs[2 * i][:, start:], 1, 2)
+                    vt = jnp.swapaxes(kvs[2 * i + 1][:, start:], 1, 2)
+                    ca = ca.at[i, 0, :, :, start:start + lp, :].set(
+                        kt.astype(ca.dtype))
+                    ca = ca.at[i, 1, :, :, start:start + lp, :].set(
+                        vt.astype(ca.dtype))
                 return ca
 
             flat = [kv for pair in new_caches for kv in pair]

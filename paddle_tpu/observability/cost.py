@@ -64,7 +64,7 @@ __all__ = [
     "ProgramCostRecord", "mode", "installed", "install", "uninstall",
     "clear", "records", "record_analytic", "device_model", "hbm_ledger",
     "utilization", "debug_doc", "flight_snapshot", "healthz_component",
-    "register_kv_cache", "decode_bucket_records",
+    "register_kv_cache", "decode_bucket_records", "prefix_sharing_stats",
 ]
 
 # ---------------------------------------------------------------------------
@@ -472,6 +472,29 @@ def register_kv_cache(kv) -> None:
         _KV_CACHES.append(weakref.ref(kv))
 
 
+def prefix_sharing_stats() -> List[Dict[str, Any]]:
+    """Per-live-pool prefix-sharing counters (ISSUE 17): pages in use /
+    idle / high-water, the shared-page ratio, and the prefix-index hit
+    rate — one row per registered :class:`PagedKVCache`. A page mapped by
+    N slots appears here as sharing, never as N× bytes: the HBM ledger
+    prices ``pool.nbytes`` (physical pages), so refcounts cannot inflate
+    it."""
+    with _LOCK:
+        kvs = [r() for r in _KV_CACHES]
+    rows: List[Dict[str, Any]] = []
+    for kv in kvs:
+        if kv is None:
+            continue
+        stats = getattr(kv, "prefix_stats", None)
+        if stats is None:
+            continue
+        try:
+            rows.append(stats())
+        except Exception as e:             # pragma: no cover - defensive
+            rows.append({"error": str(e)})
+    return rows
+
+
 # ---------------------------------------------------------------------------
 # HBM ledger
 # ---------------------------------------------------------------------------
@@ -619,6 +642,7 @@ def debug_doc() -> Dict[str, Any]:
         "pid": os.getpid(), "mode": mode(), "installed": installed(),
         "device": device_model(), "records": records(),
         "hbm": hbm, "utilization": utilization(),
+        "prefix_sharing": prefix_sharing_stats(),
     }
 
 
@@ -627,10 +651,14 @@ def flight_snapshot() -> Dict[str, Any]:
     a post-mortem must not die collecting its own context."""
     if not installed():
         # chaos paths dump a lot; don't walk the live-tensor registry
-        # per dump unless the operator opted into cost accounting
-        return {"mode": "off"}
+        # per dump unless the operator opted into cost accounting —
+        # but the prefix-index counters are cheap dict reads and a
+        # post-mortem of an eviction storm needs them, so they ride
+        # along in the dump tail unconditionally
+        return {"mode": "off", "prefix_sharing": prefix_sharing_stats()}
     try:
-        return {"records": records(), "hbm": hbm_ledger()}
+        return {"records": records(), "hbm": hbm_ledger(),
+                "prefix_sharing": prefix_sharing_stats()}
     except Exception as e:
         return {"error": str(e)}
 

@@ -15,7 +15,15 @@ package multiplies the missing factor. Three pieces:
   the decode step consumes the pool DIRECTLY through a
   :class:`PagedDecodeCache` view — live pages stream through the Pallas
   kernel in ``ops/paged_attention.py`` and the dense stacked cache never
-  exists in the decode program.
+  exists in the decode program. Since ISSUE 17 the pool also does
+  refcounted copy-on-write prefix sharing
+  (``PADDLE_TPU_PREFIX_SHARING=auto|on|off``): fully-prompt pages are
+  published under page-aligned chain digests, an admission whose prompt
+  prefix is resident maps those pages read-only and prefills only the
+  unshared tail, ``free()`` decrements instead of releasing shared
+  pages (double frees raise + count
+  ``serving.kv.double_free_total``), and refcount-0 published pages
+  park on an idle LRU reclaimed only under allocation pressure.
 * :mod:`~paddle_tpu.serving.scheduler` — the bounded request queue and
   iteration-level admission policies (FIFO, prefill-token budget).
 * :mod:`~paddle_tpu.serving.engine` — the step loop: one compiled

@@ -168,6 +168,24 @@ def _env_seconds(name: str) -> Optional[float]:
     return val if val > 0 else None
 
 
+def _prefill_accepts_start(fn: Callable) -> bool:
+    """Whether a prefill callable takes the ISSUE 17 start offset —
+    ``prefill_fn(ids, cache, start)`` — and can therefore prefill only the
+    unshared tail of a prefix-shared admission. 2-arg callables (the PR 7
+    contract) keep working unchanged: sharing just stays off for them."""
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    params = list(sig.parameters.values())
+    if any(p.kind == p.VAR_POSITIONAL for p in params):
+        return True
+    pos = [p for p in params
+           if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    return len(pos) >= 3
+
+
 @dataclass
 class ServingConfig:
     """Engine sizing + policy. Model-shape fields must match the cache
@@ -208,6 +226,17 @@ class ServingConfig:
     # dense tier everywhere. The config field wins when set, the
     # watchdog/queue-wait contract.
     paged_attention: str = ""
+    # prefix-cache page sharing (ISSUE 17): "" -> the
+    # $PADDLE_TPU_PREFIX_SHARING env knob (default auto). Sharing needs a
+    # prefill callable that accepts a start offset (``prefill_fn(ids,
+    # cache, start)`` — the 3-arg form); auto = share when the callable is
+    # tail-capable and fall back to full prefill otherwise, on = require a
+    # tail-capable callable (Engine raises at build if 2-arg), off = never
+    # share. Pure host-side bookkeeping: no hardware dependency.
+    prefix_sharing: str = ""
+    # shortest resident prefix chain worth mapping, in pages; None ->
+    # $PADDLE_TPU_PREFIX_MIN_PAGES (default 1)
+    min_shared_pages: Optional[int] = None
 
     def __post_init__(self):
         self.buckets = tuple(sorted(set(int(b) for b in self.buckets)))
@@ -242,13 +271,29 @@ class ServingConfig:
             raise ValueError(
                 f"paged_attention must be auto|on|off, got "
                 f"{self.paged_attention!r} (env: PADDLE_TPU_PAGED_ATTENTION)")
+        if not self.prefix_sharing:
+            self.prefix_sharing = os.environ.get(
+                "PADDLE_TPU_PREFIX_SHARING", "auto").strip().lower() \
+                or "auto"
+        self.prefix_sharing = self.prefix_sharing.strip().lower()
+        if self.prefix_sharing not in ("auto", "on", "off"):
+            raise ValueError(
+                f"prefix_sharing must be auto|on|off, got "
+                f"{self.prefix_sharing!r} (env: PADDLE_TPU_PREFIX_SHARING)")
+        if self.min_shared_pages is None:
+            raw = os.environ.get("PADDLE_TPU_PREFIX_MIN_PAGES", "").strip()
+            self.min_shared_pages = int(raw) if raw else 1
+        if self.min_shared_pages < 1:
+            raise ValueError(f"min_shared_pages must be >= 1, got "
+                             f"{self.min_shared_pages}")
 
     def kv_config(self) -> _kv.KVCacheConfig:
         cfg = _kv.KVCacheConfig(
             num_layers=self.num_layers, num_heads=self.num_heads,
             head_dim=self.head_dim, max_len=self.max_len,
             page_size=self.page_size, num_pages=self.num_pages,
-            compute_dtype=self.compute_dtype, kv_dtype=self.kv_dtype)
+            compute_dtype=self.compute_dtype, kv_dtype=self.kv_dtype,
+            min_shared_pages=self.min_shared_pages)
         if cfg.num_pages is None:
             # every slot fully resident + the scratch page; requests with
             # short prompt+max_new claim fewer pages, freeing pool for a
@@ -272,6 +317,9 @@ class _Slot:                             # ndarray-bearing request, and
     faults: int = 0
     first_token_time: float = 0.0
     last_token_time: float = 0.0
+    # leading pages mapped read-only from the prefix index (ISSUE 17):
+    # this slot holds one refcount on each; free() hands them back
+    shared_pages: int = 0
 
     @property
     def request(self) -> GenerationRequest:
@@ -296,10 +344,25 @@ class Engine:
         # dropped engine drops its pool from the ledger)
         _cost.register_kv_cache(self.kv)
         self._quantized = self.kv.config.quantized
+        # ISSUE 17: prefix-cache page sharing — on only when the prefill
+        # callable can start from a page-aligned offset (3-arg form)
+        capable = _prefill_accepts_start(prefill_fn)
+        if config.prefix_sharing == "on" and not capable:
+            raise ValueError(
+                "prefix_sharing=on requires a tail-capable prefill "
+                "callable (prefill_fn(ids, cache, start)); this one takes "
+                "2 args — pass auto/off, or extend the callable")
+        self._share_prefix = config.prefix_sharing != "off" and capable
+        # prefill tokens requested vs actually computed (the sharing win;
+        # guarded by _slot_lock — written on the step thread, read by the
+        # bench/router threads)
+        self._prefill_tokens_requested = 0
+        self._prefill_tokens_computed = 0
         self.scheduler = Scheduler(
             max_queue=config.max_queue, policy=config.policy,
             prefill_token_budget=config.prefill_token_budget,
-            max_queue_wait_s=config.max_queue_wait_s)
+            max_queue_wait_s=config.max_queue_wait_s,
+            prefill_cost=self._prefill_cost if self._share_prefix else None)
         self._slots: List[_Slot] = []    # admission order == batch row order
         # serializes slot admission/eviction and the in-transit counter:
         # normally the step loop is the single consumer, but a budgeted
@@ -437,6 +500,51 @@ class Engine:
         self._prefill_program.cost_site = "serving.prefill"
         self._prefill_program.cost_label = f"{name}.prefill"
 
+        # ISSUE 17: tail prefill — one program per static page-aligned
+        # start offset (bounded by pages_per_slot). The dense cache enters
+        # populated with the shared prefix (gathered from the mapped
+        # pages), the 3-arg prefill callable computes K/V for tail
+        # positions [start, prompt_len) only, and the scatter writes ONLY
+        # tail pages — the shared pages are never store targets (COW by
+        # construction).
+        def build_tail_program(start: int):
+            def tail_body(ids_a, row_a, len_a, pool_a, *maybe_scales):
+                sc = maybe_scales[0] if quantized else None
+                dense = _kv.gather_pages(pool_a, sc, row_a[None, :],
+                                         compute_dtype)
+                with no_grad():
+                    nxt, dense2 = prefill_fn(_T(ids_a), _T(dense), start)
+                pool2, sc2 = _kv.scatter_prefill_pages(
+                    dense2._data.astype(compute_dtype), pool_a, sc,
+                    row_a[start // ps:], len_a, ps, start=start)
+                out = (nxt._data.astype(jnp.int32), pool2)
+                return out + ((sc2,) if quantized else ())
+
+            def tail_program(ids, row, true_len, pool, *scales):
+                return _apply("serving_prefill", tail_body, ids, row,
+                              true_len, pool, *scales,
+                              differentiable=False, amp=False)
+
+            prog = to_static(tail_program)
+            prog.cost_site = "serving.prefill"
+            prog.cost_label = f"{name}.prefill_tail{start}"
+            return prog
+
+        self._build_tail_program = build_tail_program
+        self._tail_programs: Dict[int, Callable] = {}
+        self._program_lock = threading.Lock()
+
+    def _tail_program(self, start: int) -> Callable:
+        """The compiled tail-prefill program for a static ``start`` offset
+        (built on first use; admission normally runs on the single step
+        thread, but the lock keeps a warmup-from-caller race harmless)."""
+        with self._program_lock:
+            prog = self._tail_programs.get(start)
+            if prog is None:
+                prog = self._build_tail_program(start)
+                self._tail_programs[start] = prog
+        return prog
+
     def _scales_args(self):
         from ..core.tensor import Tensor as _T
         return (_T(self.kv.scales),) if self._quantized else ()
@@ -476,6 +584,33 @@ class Engine:
         last = min(self.config.max_len,
                    int(request.prompt.size) + request.max_new_tokens)
         return self.kv.pages_for(last)
+
+    def _prefill_cost(self, request: GenerationRequest) -> int:
+        """The scheduler's admission cost for a request: prompt tokens the
+        prefill will actually COMPUTE — the full prompt minus whatever
+        prefix chain is resident right now (ISSUE 17). A peek, not a
+        claim: the admission itself re-resolves (and refcounts) the chain
+        under the kv lock."""
+        full = int(request.prompt.size)
+        shared = self.kv.peek_prefix_pages(request.prompt) \
+            * self.config.page_size
+        return max(1, full - shared)
+
+    def prefix_summary(self) -> frozenset:
+        """The kv pool's advertised prefix index (chain digests) — the
+        router's prefix-affine placement signal (ISSUE 17)."""
+        return self.kv.prefix_summary()
+
+    @property
+    def prefix_sharing_enabled(self) -> bool:
+        return self._share_prefix
+
+    def prefill_token_stats(self) -> Tuple[int, int]:
+        """(requested, computed) prompt tokens across all admissions so
+        far — the bench's prefix-sharing win of record."""
+        with self._slot_lock:
+            return (self._prefill_tokens_requested,
+                    self._prefill_tokens_computed)
 
     def submit(self, request: GenerationRequest):
         """Enqueue; returns a Future resolving to GenerationResult.
@@ -862,12 +997,24 @@ class Engine:
         if pending.replay_tokens:
             prompt = np.concatenate([
                 prompt, np.asarray(pending.replay_tokens, np.int32)])
-        pages = self.kv.alloc(self._pages_needed(req))
+        # ISSUE 17: map whatever prefix chain is resident read-only (a
+        # replayed slot re-acquires its shared prefix here too, or
+        # re-prefills in full if the chain was evicted), then claim
+        # private pages for the rest of the request's lifetime
+        shared: List[int] = []
+        if self._share_prefix:
+            shared = self.kv.acquire_prefix(prompt)
+        start = len(shared) * self.config.page_size
+        pages = self.kv.alloc(self._pages_needed(req) - len(shared))
         if pages is None:
+            if shared:
+                self.kv.free(shared)
             return "noroom"
+        pages = shared + pages
         try:
             with _trace.span("serving.prefill", parent=pending.trace_ctx,
                              rid=req.request_id, prompt=int(prompt.size),
+                             shared_pages=len(shared),
                              replay=len(pending.replay_tokens)), \
                     self._deadline_ctx([pending]):
                 for attempt in (0, 1):
@@ -884,13 +1031,21 @@ class Engine:
                                        site="serving.admit", retried=True,
                                        error=type(exc).__name__)
                 row = self.kv.table_row(pages)
-                outs = self._prefill_program(
-                    _T(jnp.asarray(prompt[None, :], jnp.int32)),
-                    _T(jnp.asarray(row)),
-                    _T(jnp.asarray(prompt.size, jnp.int32)),
-                    _T(self.kv.pool), *self._scales_args())
+                if start:
+                    outs = self._tail_program(start)(
+                        _T(jnp.asarray(prompt[None, start:], jnp.int32)),
+                        _T(jnp.asarray(row)),
+                        _T(jnp.asarray(prompt.size, jnp.int32)),
+                        _T(self.kv.pool), *self._scales_args())
+                else:
+                    outs = self._prefill_program(
+                        _T(jnp.asarray(prompt[None, :], jnp.int32)),
+                        _T(jnp.asarray(row)),
+                        _T(jnp.asarray(prompt.size, jnp.int32)),
+                        _T(self.kv.pool), *self._scales_args())
         except Exception as exc:
-            self.kv.free(pages)
+            self.kv.free(pages)                 # refcount-aware: shared
+            # pages are decremented, private ones actually released
             _obs.inc("serving.requests_total", status="failed")
             _trace.instant("serving.fault", parent=pending.trace_ctx,
                            rid=req.request_id, site="serving.admit",
@@ -901,15 +1056,28 @@ class Engine:
         first_tok = int(np.asarray(outs[0]._data)[0, 0])
         now = time.monotonic()
         _obs.inc("serving.prefills_total")
+        _obs.inc("serving.prefill_tokens_requested_total",
+                 float(prompt.size))
+        _obs.inc("serving.prefill_tokens_computed_total",
+                 float(prompt.size - start))
+        if self._share_prefix:
+            # publish this slot's fully-prompt pages (content now frozen:
+            # decode writes land at t >= prompt_len, past every published
+            # page). Over the ORIGINAL prompt only — a replay's appended
+            # tokens are generated content, not a shareable prompt.
+            self.kv.publish(req.prompt, pages)
         slot = _Slot(pending=pending, page_ids=pages, table_row=row,
                      t=int(prompt.size), last_tok=first_tok,
                      tokens=list(pending.replay_tokens),
-                     first_token_time=now, last_token_time=now)
+                     first_token_time=now, last_token_time=now,
+                     shared_pages=len(shared))
         # under the eviction lock: the append must be visible as one
         # event to a concurrent budgeted stop() sweeping stragglers from
         # the caller's thread (ISSUE 14: shared-state-race)
         with self._slot_lock:
             self._slots.append(slot)
+            self._prefill_tokens_requested += int(prompt.size)
+            self._prefill_tokens_computed += int(prompt.size) - start
             late_dead = self._stop.is_set() and self._draining.is_set()
             mode = self._drain_on_timeout
         if late_dead:

@@ -32,28 +32,42 @@ smaller scale are re-gridded at most ``page_size`` times, each bounded by
 half a quantization step — the dense-vs-int8 logits-tolerance test in
 ``tests/test_serving.py`` pins the accumulated effect.
 
-Host-side accounting (:class:`PagedKVCache`) is deliberately dumb: a free
-list over page ids with page 0 reserved. Admission policy (whether a
-request may claim pages at all) lives in ``serving.scheduler``.
+Host-side accounting (:class:`PagedKVCache`) is a refcounted free list
+over page ids with page 0 reserved, plus a prompt-prefix hash index
+(ISSUE 17): pages holding fully-prompt content are published under a
+page-aligned chain hash, a later admission whose prompt walks the same
+chain maps those pages read-only into its table (``acquire_prefix``
+bumps refcounts), and ``free()`` decrements instead of releasing a page
+other slots still reference. Copy-on-write holds by construction: the
+decode step's in-place token write targets the page containing position
+``t >= prompt_len``, which is never a published (fully-prompt) page, so
+shared pages are only ever read. Published pages whose refcount drops to
+zero are retained on an idle LRU (still indexed, still reclaimable by
+``alloc`` under pressure) so a later identical prompt reuses them even
+with no concurrent sharer. Admission policy (whether a request may claim
+pages at all) lives in ``serving.scheduler``.
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _obs
 from ..ops.paged_attention import PagedDecodeCache  # noqa: F401  (re-export:
 # the paged-attention decode tier threads the pool through the step as this
 # handle instead of gathering the dense cache — see ops/paged_attention.py)
 
 __all__ = ["KVCacheConfig", "PagedKVCache", "PagedDecodeCache",
            "gather_pages", "scatter_token_page", "scatter_prefill_pages",
-           "quantize_pages"]
+           "quantize_pages", "prefix_chain_digests"]
 
 _Q8_MAX = 127.0  # symmetric absmax grid, same rule as the q8 optimizer state
 
@@ -70,12 +84,15 @@ class KVCacheConfig:
     num_pages: Optional[int] = None   # default set by PagedKVCache
     compute_dtype: str = "float32"    # dtype the decode step consumes
     kv_dtype: str = "native"          # "native" | "bf16" | "int8"
+    min_shared_pages: int = 1         # shortest prefix chain worth sharing
 
     def __post_init__(self):
         if self.max_len % self.page_size != 0:
             raise ValueError(
                 f"max_len ({self.max_len}) must be a multiple of page_size "
                 f"({self.page_size})")
+        if self.min_shared_pages < 1:
+            raise ValueError("min_shared_pages must be >= 1")
 
     @property
     def pages_per_slot(self) -> int:
@@ -175,21 +192,27 @@ def scatter_token_page(dense: jnp.ndarray, pool: jnp.ndarray,
 def scatter_prefill_pages(dense: jnp.ndarray, pool: jnp.ndarray,
                           scales: Optional[jnp.ndarray],
                           page_ids: jnp.ndarray, true_len: jnp.ndarray,
-                          page_size: int):
+                          page_size: int, start: int = 0):
     """Store a freshly prefilled single-slot dense cache into the pool.
 
-    ``dense`` is ``(L, 2, 1, H, Lp, D)`` with positions ``[0, true_len)``
-    holding the prompt's K/V (right padding beyond ``true_len`` is masked
-    to zero — padded prompt positions never reach the pool). ``page_ids``
-    is ``(Lp // page_size,)``; entries past the prompt's last page are 0
-    and harmlessly overwrite the scratch page. Returns ``(pool',
-    scales')``."""
+    ``dense`` is ``(L, 2, 1, H, Lp, D)`` with positions ``[start,
+    true_len)`` holding freshly computed K/V (right padding beyond
+    ``true_len`` is masked to zero — padded prompt positions never reach
+    the pool). ``start`` is a static, page-aligned offset: only pages
+    covering positions ``>= start`` are written, so a prefix-shared
+    admission scatters ONLY its unshared tail and the shared pages it
+    mapped read-only are never touched (ISSUE 17). ``page_ids`` is
+    ``((Lp - start) // page_size,)`` — the tail pages only; entries past
+    the prompt's last page are 0 and harmlessly overwrite the scratch
+    page. Returns ``(pool', scales')``."""
     ps = page_size
+    if start % ps != 0:
+        raise ValueError(f"start ({start}) must be page-aligned ({ps})")
     l, two, _, h, lp, d = dense.shape
-    n = lp // ps
-    x = dense[:, :, 0]                               # (L, 2, H, Lp, D)
+    n = (lp - start) // ps
+    x = dense[:, :, 0, :, start:, :]                 # (L, 2, H, Lp-start, D)
     x = x.reshape(l, two, h, n, ps, d).transpose(3, 0, 1, 2, 4, 5)
-    pos = jnp.arange(lp, dtype=jnp.int32).reshape(n, ps)
+    pos = start + jnp.arange(lp - start, dtype=jnp.int32).reshape(n, ps)
     valid = pos < true_len.astype(jnp.int32).reshape(())
     x = jnp.where(valid[:, None, None, None, :, None], x, 0)
     if scales is not None:
@@ -199,16 +222,63 @@ def scatter_prefill_pages(dense: jnp.ndarray, pool: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# prefix chain hashing (host side, pure)
+# ---------------------------------------------------------------------------
+
+def prefix_chain_digests(tokens, page_size: int,
+                         limit: Optional[int] = None) -> List[bytes]:
+    """Page-aligned chain hashes of a prompt: ``h_i = blake2b(h_{i-1} ||
+    tokens[i*ps:(i+1)*ps])`` over FULL pages only. A prefix match between
+    two prompts is a chain of leading digest equalities, so the index can
+    be a flat ``digest -> page`` dict and a lookup is a walk that stops at
+    the first miss. Shared by :class:`PagedKVCache` and the router's
+    prefix-affine placement (``serving/router.py``)."""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32).reshape(-1))
+    n = toks.size // page_size
+    if limit is not None:
+        n = min(n, limit)
+    out: List[bytes] = []
+    h = b""
+    for i in range(n):
+        h = hashlib.blake2b(
+            h + toks[i * page_size:(i + 1) * page_size].tobytes(),
+            digest_size=16).digest()
+        out.append(h)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # host-side pool accounting
 # ---------------------------------------------------------------------------
 
 class PagedKVCache:
-    """The preallocated page pool plus a free list over page ids.
+    """The preallocated page pool plus refcounted accounting and the
+    prompt-prefix hash index.
 
     Holds the pool/scales as raw jnp arrays (the engine threads them
     through its compiled programs as explicit inputs/outputs — functional
     state, so a faulted step that is retried or abandoned cannot leave the
-    pool half-written). Thread-safe: alloc/free take the instance lock."""
+    pool half-written). Thread-safe: every accounting surface (free list,
+    refcount table ``_ref``, prefix index ``_index``, idle LRU) is guarded
+    by the single instance lock ``_lock``.
+
+    Page lifecycle::
+
+        alloc()            rc=1, private
+        publish()          page enters the prefix index (content frozen)
+        acquire_prefix()   rc+=1 per sharer (read-only mapping)
+        free()             rc-=1; at rc==0 a published page parks on the
+                           idle LRU (still indexed, reclaimable), an
+                           unpublished page returns to the free list
+        alloc() pressure   idle pages are evicted LRU-first (index entries
+                           removed) when the free list alone can't cover
+
+    ``free()`` raises loudly (and counts ``serving.kv.double_free_total``)
+    on any free that would corrupt the accounting: freeing scratch,
+    freeing an id already on the free list, or freeing a page whose
+    refcount is already 0 — i.e. releasing more claims than were ever
+    handed out, which with sharing enabled means some other slot's table
+    still references the page."""
 
     def __init__(self, config: KVCacheConfig):
         if config.num_pages is None:
@@ -230,20 +300,52 @@ class PagedKVCache:
         # runs on the step thread's critical path at every eviction.
         self._free: List[int] = list(range(config.num_pages - 1, 0, -1))
         self._free_set = set(self._free)
+        # refcounts for claimed pages (entries exist only while rc > 0)
+        self._ref: Dict[int, int] = {}
+        # prefix index: chain digest -> page id, and its reverse
+        self._index: Dict[bytes, int] = {}
+        self._page_hash: Dict[int, bytes] = {}
+        # published pages with rc == 0, LRU order (reclaimed under pressure)
+        self._idle: "OrderedDict[int, None]" = OrderedDict()
+        # stats
+        self._high_water = 0
+        self._double_free_total = 0
+        self._prefix_queries = 0
+        self._prefix_query_hits = 0
+        self._prefix_pages_shared_total = 0
 
     # -- accounting ---------------------------------------------------------
     @property
     def free_pages(self) -> int:
+        """Allocatable pages: the free list plus idle (published, rc==0)
+        pages that ``alloc`` may reclaim under pressure."""
         with self._lock:
-            return len(self._free)
+            return len(self._free) + len(self._idle)
 
     @property
     def outstanding_pages(self) -> int:
-        """Pages currently claimed by slots (scratch excluded). The drain
-        and chaos invariants pin this to 0 after shutdown: a nonzero value
-        with no active slots is a page leak."""
+        """Pages currently claimed by slots (rc > 0; scratch and idle
+        cached pages excluded). The drain and chaos invariants pin this to
+        0 after shutdown: a nonzero value with no active slots is a page
+        leak."""
         with self._lock:
-            return self.config.num_pages - 1 - len(self._free)
+            return len(self._ref)
+
+    @property
+    def idle_pages(self) -> int:
+        """Published pages retained with rc == 0 (prefix cache residue)."""
+        with self._lock:
+            return len(self._idle)
+
+    @property
+    def double_free_total(self) -> int:
+        with self._lock:
+            return self._double_free_total
+
+    def refcounts(self) -> Dict[int, int]:
+        """Snapshot of nonzero refcounts (chaos suites pin this empty)."""
+        with self._lock:
+            return dict(self._ref)
 
     def pages_for(self, positions: int) -> int:
         """Pages needed to cover logical positions ``[0, positions)``."""
@@ -251,22 +353,173 @@ class PagedKVCache:
         return min(self.config.pages_per_slot, -(-positions // ps))
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Claim ``n`` pages, or None if the pool cannot cover them (the
-        caller must not admit — partial claims never escape)."""
+        """Claim ``n`` private pages (rc=1 each), or None if the pool
+        cannot cover them (the caller must not admit — partial claims
+        never escape). Takes from the free list first, then reclaims idle
+        prefix-cache pages LRU-first, dropping their index entries."""
         with self._lock:
-            if n > len(self._free):
+            if n > len(self._free) + len(self._idle):
                 return None
-            ids = [self._free.pop() for _ in range(n)]
-            self._free_set.difference_update(ids)
+            ids: List[int] = []
+            for _ in range(n):
+                if self._free:
+                    pid = self._free.pop()
+                    self._free_set.discard(pid)
+                else:
+                    pid, _ = self._idle.popitem(last=False)
+                    self._unpublish_locked(pid)
+                self._ref[pid] = 1
+                ids.append(pid)
+            self._note_usage_locked()
         return ids
 
     def free(self, ids: Sequence[int]) -> None:
+        """Release one claim on each page. A shared page (rc > 1) is
+        decremented, not released; at rc == 0 a published page parks on
+        the idle LRU and an unpublished page returns to the free list.
+        Raises ValueError on double free (see class docstring)."""
         with self._lock:
             for pid in ids:
-                if pid == 0 or pid in self._free_set:
-                    raise ValueError(f"double free / scratch free: page {pid}")
-                self._free.append(pid)
-                self._free_set.add(pid)
+                rc = self._ref.get(pid, 0)
+                if pid == 0 or pid in self._free_set or pid in self._idle \
+                        or rc <= 0:
+                    self._double_free_total += 1
+                    _obs.inc("serving.kv.double_free_total")
+                    raise ValueError(
+                        f"double free / scratch free: page {pid} (rc={rc})")
+                if rc > 1:
+                    self._ref[pid] = rc - 1
+                    continue
+                del self._ref[pid]
+                if pid in self._page_hash:
+                    self._idle[pid] = None      # retained: still indexed
+                else:
+                    self._free.append(pid)
+                    self._free_set.add(pid)
+            self._note_usage_locked()
+
+    # -- prefix sharing ------------------------------------------------------
+    def acquire_prefix(self, tokens) -> List[int]:
+        """Map the longest resident prefix chain of ``tokens`` read-only:
+        walk the page-aligned chain digests through the index, bump each
+        hit page's refcount, and return the page ids in chain order (empty
+        on no useful match). At most ``(len(tokens) - 1) // page_size``
+        pages are shareable — the unshared tail always keeps at least one
+        prompt token, so the admission still has a position to prefill and
+        emit the first output token from. Matches shorter than
+        ``config.min_shared_pages`` are rejected without bumping."""
+        ps = self.config.page_size
+        toks = np.asarray(tokens).reshape(-1)
+        cap = max(0, (toks.size - 1) // ps)
+        digests = prefix_chain_digests(toks, ps, limit=cap)
+        with self._lock:
+            self._prefix_queries += 1
+            got: List[int] = []
+            for h in digests:
+                pid = self._index.get(h)
+                if pid is None:
+                    break
+                got.append(pid)
+            if len(got) < self.config.min_shared_pages:
+                return []
+            for pid in got:
+                if pid in self._idle:
+                    del self._idle[pid]         # revive from the idle LRU
+                self._ref[pid] = self._ref.get(pid, 0) + 1
+            self._prefix_query_hits += 1
+            self._prefix_pages_shared_total += len(got)
+            _obs.inc("serving.kv.prefix_pages_shared_total", float(len(got)))
+            self._note_usage_locked()
+        return got
+
+    def peek_prefix_pages(self, tokens) -> int:
+        """Length of the resident prefix chain for ``tokens`` WITHOUT
+        bumping refcounts — the scheduler's admission cost model uses this
+        to charge only the unshared tail. Subject to the same shareable
+        cap and ``min_shared_pages`` threshold as :meth:`acquire_prefix`."""
+        ps = self.config.page_size
+        toks = np.asarray(tokens).reshape(-1)
+        cap = max(0, (toks.size - 1) // ps)
+        digests = prefix_chain_digests(toks, ps, limit=cap)
+        with self._lock:
+            depth = 0
+            for h in digests:
+                if h not in self._index:
+                    break
+                depth += 1
+        return depth if depth >= self.config.min_shared_pages else 0
+
+    def publish(self, tokens, page_ids: Sequence[int]) -> int:
+        """Register a freshly prefilled slot's fully-prompt pages in the
+        prefix index. Only pages ``k < len(tokens) // page_size`` are
+        publishable (the page holding the prompt tail also receives decoded
+        tokens and is NOT content-frozen). First publisher of a digest
+        wins; duplicate content on another page is left unindexed. Returns
+        the number of pages newly indexed."""
+        ps = self.config.page_size
+        toks = np.asarray(tokens).reshape(-1)
+        digests = prefix_chain_digests(toks, ps)
+        added = 0
+        with self._lock:
+            for h, pid in zip(digests, page_ids):
+                if h in self._index or pid in self._page_hash:
+                    continue
+                if self._ref.get(pid, 0) <= 0:
+                    continue                    # never index an unclaimed page
+                self._index[h] = pid
+                self._page_hash[pid] = h
+                added += 1
+            if added:
+                _obs.set_gauge("serving.kv.prefix_index_pages",
+                               float(len(self._index)))
+        return added
+
+    def prefix_summary(self) -> frozenset:
+        """The advertised prefix index: the set of resident chain digests.
+        The router's prefix-affine placement walks a prompt's chain
+        through each replica's summary to find where the pages live."""
+        with self._lock:
+            return frozenset(self._index)
+
+    def prefix_stats(self) -> Dict[str, float]:
+        """Point-in-time sharing stats for /metrics, /debug/cost and the
+        flight-recorder dump tail."""
+        with self._lock:
+            claims = sum(self._ref.values())
+            shared_extra = claims - len(self._ref)
+            return {
+                "pages_in_use": float(len(self._ref)),
+                "pages_idle": float(len(self._idle)),
+                "pages_high_water": float(self._high_water),
+                "pages_shared_ratio":
+                    shared_extra / claims if claims else 0.0,
+                "prefix_index_pages": float(len(self._index)),
+                "prefix_queries": float(self._prefix_queries),
+                "prefix_query_hits": float(self._prefix_query_hits),
+                "prefix_hit_rate":
+                    self._prefix_query_hits / self._prefix_queries
+                    if self._prefix_queries else 0.0,
+                "prefix_pages_shared_total":
+                    float(self._prefix_pages_shared_total),
+                "double_free_total": float(self._double_free_total),
+            }
+
+    # -- internals ----------------------------------------------------------
+    def _unpublish_locked(self, pid: int) -> None:
+        h = self._page_hash.pop(pid, None)
+        if h is not None and self._index.get(h) == pid:
+            del self._index[h]
+
+    def _note_usage_locked(self) -> None:
+        in_use = len(self._ref)
+        if in_use > self._high_water:
+            self._high_water = in_use
+        claims = sum(self._ref.values())
+        shared_extra = claims - in_use
+        _obs.set_gauge("serving.kv.pages_in_use", float(in_use))
+        _obs.set_gauge("serving.kv.pages_high_water", float(self._high_water))
+        _obs.set_gauge("serving.kv.pages_shared_ratio",
+                       shared_extra / claims if claims else 0.0)
 
     def table_row(self, page_ids: Sequence[int]) -> np.ndarray:
         """A slot's page-table row: allocated ids then scratch padding."""

@@ -75,6 +75,7 @@ from .. import observability as _obs
 from ..observability import trace as _trace
 from ..resilience import DeadlineExceeded, faults as _faults, jitter_sleep
 from ..resilience.breaker import BreakerOpen, CircuitBreaker
+from . import kv_cache as _kv
 from .engine import DrainTimeout, Engine, EngineStopped
 from .scheduler import GenerationRequest, GenerationResult, QueueFull
 
@@ -112,6 +113,14 @@ class RouterConfig:
     # and the open-state cooldown before the single half-open probe
     breaker_threshold: int = 3
     breaker_cooldown: float = 0.5
+    # prefix-affine placement (ISSUE 17): when a replica's advertised
+    # prefix index already holds the prompt's leading page chain, that
+    # replica is forced into the pick-2 candidate set and its queue-wait
+    # score is discounted by this factor (0..1; 1 = always prefer the
+    # affine replica, 0 = off). None -> $PADDLE_TPU_ROUTER_PREFIX_AFFINITY
+    # (absent = 0.75). With no resident chains the pick is byte-identical
+    # to the legacy pick-2, so existing trace pins hold.
+    prefix_affinity_bias: Optional[float] = None
 
     def __post_init__(self):
         if self.hedge_s is None:
@@ -120,6 +129,15 @@ class RouterConfig:
             self.hedge_s = None
         if self.poll_s <= 0:
             raise ValueError(f"poll_s must be > 0, got {self.poll_s}")
+        if self.prefix_affinity_bias is None:
+            raw = os.environ.get(
+                "PADDLE_TPU_ROUTER_PREFIX_AFFINITY", "").strip()
+            self.prefix_affinity_bias = float(raw) if raw else 0.75
+        if not 0.0 <= self.prefix_affinity_bias <= 1.0:
+            raise ValueError(
+                f"prefix_affinity_bias must be in [0, 1], got "
+                f"{self.prefix_affinity_bias} "
+                "(env: PADDLE_TPU_ROUTER_PREFIX_AFFINITY)")
 
 
 class Replica:
@@ -147,6 +165,29 @@ class Replica:
         stay routable."""
         detail = _trace.beacon_detail(self.engine.beacon)
         return bool(detail and detail["stale"])
+
+    def prefix_depth(self, request: GenerationRequest) -> int:
+        """How many leading prompt pages are resident in this replica's
+        prefix index (0 when the engine doesn't share prefixes). Walks
+        the page-aligned chain digests through the engine's advertised
+        summary and stops at the first miss — depth is the length of the
+        longest resident chain, i.e. the pages an admission here would
+        map instead of re-prefilling."""
+        eng = self.engine
+        if not getattr(eng, "prefix_sharing_enabled", False):
+            return 0
+        summary = eng.prefix_summary()
+        if not summary:
+            return 0
+        prompt = request.prompt
+        ps = eng.kv.config.page_size
+        limit = max(0, (int(prompt.size) - 1) // ps)
+        depth = 0
+        for digest in _kv.prefix_chain_digests(prompt, ps, limit=limit):
+            if digest not in summary:
+                break
+            depth += 1
+        return depth
 
 
 @dataclass(eq=False)
@@ -362,13 +403,46 @@ class Router:
                 and not self._replicas[n].engine.draining
                 and not self._replicas[n].stale()]
 
-    def _pick_locked(self, tried: Set[str]) -> Optional[str]:
+    def _pick_locked(self, tried: Set[str],
+                     request: Optional[GenerationRequest] = None,
+                     rid: Optional[str] = None) -> Optional[str]:
         """Weighted pick-2 by queue wait among in-rotation, untried
         replicas. Deterministic given the RNG state: candidates are
-        sampled in sorted order, ties break (wait, depth, name)."""
+        sampled in sorted order, ties break (wait, depth, name).
+
+        Prefix affinity (ISSUE 17): when some candidate's prefix index
+        holds a non-empty chain of the prompt's leading pages, that best
+        affine replica (deepest chain; ties by wait/depth/name) is forced
+        into the candidate pair and its queue-wait score is discounted by
+        ``prefix_affinity_bias`` — a warm prefix saves the whole shared
+        prefill, so a moderately longer queue is still the faster TTFT.
+        When no candidate holds the prefix (or bias is 0) the legacy
+        pick-2 runs byte-identically, consuming the same RNG stream."""
         cands = [n for n in self._rotation_locked() if n not in tried]
         if not cands:
             return None
+        bias = self.config.prefix_affinity_bias
+        if bias and request is not None:
+            depths = {n: self._replicas[n].prefix_depth(request)
+                      for n in cands}
+            if any(depths.values()):
+                affine = min(cands, key=lambda n: (
+                    -depths[n],
+                    self._replicas[n].queue_wait_estimate(),
+                    self._replicas[n].engine.queue_depth, n))
+                others = [n for n in cands if n != affine]
+                if len(others) > 1:
+                    others = self._rng.sample(others, 1)
+                cands = [affine] + others
+                self.trace.append(("affinity", rid, affine, depths[affine]))
+                # ties (idle cluster: every score is 0) go to the affine
+                # replica — a warm prefix always beats an equally-idle
+                # cold one, name order must not route away from the pages
+                return min(cands, key=lambda n: (
+                    self._replicas[n].queue_wait_estimate()
+                    * ((1.0 - bias) if n == affine else 1.0),
+                    self._replicas[n].engine.queue_depth,
+                    n != affine, n))
         if len(cands) > 2:
             cands = self._rng.sample(cands, 2)
         return min(cands, key=lambda n: (
@@ -415,7 +489,7 @@ class Router:
                     self.trace.append(("pick_fault", rid))
                 continue
             with self._lock:
-                name = self._pick_locked(entry.tried)
+                name = self._pick_locked(entry.tried, entry.request, rid)
                 if name is not None:
                     self.trace.append(("pick", rid, name))
             if name is None:

@@ -182,7 +182,9 @@ class Scheduler:
 
     def __init__(self, max_queue: int = 64, policy: str = "fifo",
                  prefill_token_budget: Optional[int] = None,
-                 max_queue_wait_s: Optional[float] = None):
+                 max_queue_wait_s: Optional[float] = None,
+                 prefill_cost: Optional[
+                     Callable[[GenerationRequest], int]] = None):
         if policy not in ("fifo", "budget"):
             raise ValueError(f"unknown admission policy: {policy!r}")
         if policy == "budget" and not prefill_token_budget:
@@ -193,6 +195,11 @@ class Scheduler:
         self.max_queue = max_queue
         self.policy = policy
         self.prefill_token_budget = prefill_token_budget
+        # ISSUE 17: the admission cost model — prompt tokens the prefill
+        # will actually compute. A prefix-sharing engine passes a callable
+        # that subtracts the resident shared chain, so the budget policy
+        # charges only the unshared tail; None keeps the full prompt size.
+        self.prefill_cost = prefill_cost
         # the operator's hard cap on queue wait (0/None = off); requests
         # queued past it shed with DeadlineExceeded even with no deadline
         self.max_queue_wait_s = max_queue_wait_s or None
@@ -420,7 +427,9 @@ class Scheduler:
                     break
                 if not can_fit(head.request):
                     break
-                cost = int(head.request.prompt.size)
+                cost = (int(self.prefill_cost(head.request))
+                        if self.prefill_cost is not None
+                        else int(head.request.prompt.size))
                 if budget is not None and taken and spent + cost > budget:
                     break
                 spent += cost

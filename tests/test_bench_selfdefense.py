@@ -666,3 +666,56 @@ def test_cross_host_sync_roots_cover_cost_hooks():
     assert "paddle_tpu/observability/cost.py::_on_dispatch_event" in roots
     assert "paddle_tpu/observability/cost.py" in \
         DEFAULT_CONFIG["span_hot_modules"]
+
+
+def test_prefix_sharing_block_schema():
+    # the --prompt-overlap leg (ISSUE 17): prefill-savings-of-record for
+    # refcounted COW page sharing; schema drift must fail here
+    mod = _load_bench_generation()
+    assert "prefix_sharing" in mod.SERVING_RESULT_FIELDS
+    assert set(mod.PREFIX_SHARING_FIELDS) == {
+        "page_size", "prompt", "tokens", "requests", "legs",
+        "suspect_reasons"}
+    assert set(mod.PREFIX_SHARING_LEG_FIELDS) == {
+        "overlap_pct", "shared_prefix_tokens",
+        "aggregate_tokens_per_sec", "baseline_tokens_per_sec",
+        "ttft_ms_p50", "ttft_ms_p99",
+        "prefill_tokens_requested", "prefill_tokens_computed",
+        "pages_shared_ratio", "prefix_hit_rate", "transcripts_match"}
+    import inspect
+    src = inspect.getsource(mod._run_prefix_sharing)
+    assert "PREFIX_SHARING_FIELDS" in src
+    assert "PREFIX_SHARING_LEG_FIELDS" in src
+    for field in mod.PREFIX_SHARING_FIELDS + mod.PREFIX_SHARING_LEG_FIELDS:
+        assert f'"{field}"' in src, field
+    # the leg must compare bit-exact transcripts between sharing modes
+    assert "_prefix_suspect_reasons" in src
+
+
+def test_prefix_sharing_zero_sharing_at_90_is_suspect():
+    mod = _load_bench_generation()
+    healthy = {"overlap_pct": 90, "pages_shared_ratio": 0.7,
+               "transcripts_match": True}
+    legs = {"overlap0": dict(healthy, overlap_pct=0, pages_shared_ratio=0),
+            "overlap90": dict(healthy)}
+    assert mod._prefix_suspect_reasons(legs) == []
+    # all-zero sharing at 90% overlap = the feature never ran: suspect
+    broken = dict(legs, overlap90=dict(healthy, pages_shared_ratio=0))
+    reasons = mod._prefix_suspect_reasons(broken)
+    assert reasons and "ZERO pages" in reasons[0]
+    # a transcript mismatch on ANY leg means COW leaked K/V: suspect
+    leaked = dict(legs, overlap0=dict(
+        healthy, overlap_pct=0, pages_shared_ratio=0,
+        transcripts_match=False))
+    reasons = mod._prefix_suspect_reasons(leaked)
+    assert reasons and "COW" in reasons[0]
+
+
+def test_prefix_sharing_wired_into_main():
+    mod = _load_bench_generation()
+    import inspect
+    assert "--prompt-overlap" in inspect.getsource(mod.main)
+    src = inspect.getsource(mod._run_serving)
+    assert "_run_prefix_sharing" in src and "prompt_overlap" in src
+    # a suspect prefix-sharing block is a hard exit, like greedy parity
+    assert "PREFIX SHARING SUSPECT" in src and "sys.exit(1)" in src

@@ -1258,3 +1258,36 @@ def test_http_serving_tier_thread_roots_resolve_on_shipped_tree():
     # ThreadingHTTPServer ctor (the ServerHost refactor must not hide it)
     assert any("do_POST" in lab for lab in labels), labels
     assert any("do_GET" in lab for lab in labels), labels
+
+
+def test_prefix_sharing_kv_pool_thread_roots(tmp_path):
+    """ISSUE 17: the prefix index + refcount table stay under the race
+    detector's locked domains — the pool's public sharing surface is
+    registered as thread roots and every entry resolves to a real method
+    on the shipped tree (a rename breaks THIS test, not silently the
+    analysis)."""
+    import ast
+    import os
+
+    from tools.lint.engine import (DEFAULT_CONFIG, REPO_ROOT,
+                                   iter_python_files)
+    from tools.lint.wholeprogram.project import Project
+    from tools.lint.wholeprogram.summary import build_summary
+
+    kv_roots = DEFAULT_CONFIG["thread_roots"]["paddle_tpu/serving/kv_cache.py"]
+    for entry in ("PagedKVCache.acquire_prefix", "PagedKVCache.publish",
+                  "PagedKVCache.prefix_summary", "PagedKVCache.free",
+                  "PagedKVCache.alloc"):
+        assert entry in kv_roots, entry
+
+    summaries = {}
+    for abspath in iter_python_files(["paddle_tpu/serving"]):
+        rel = os.path.relpath(abspath, REPO_ROOT).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as fh:
+            src = fh.read()
+        summaries[rel] = build_summary(
+            rel, ast.parse(src), src.splitlines(), DEFAULT_CONFIG)
+    project = Project(summaries, DEFAULT_CONFIG)
+    labels = {label for _m, _fi, label in project.thread_roots()}
+    for needle in kv_roots:
+        assert any(needle in lab for lab in labels), (needle, labels)

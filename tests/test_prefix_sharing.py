@@ -1,0 +1,450 @@
+"""Prefix-cache page sharing — refcounted copy-on-write KV pages (ISSUE 17).
+
+CPU-deterministic, no chip. Four surfaces:
+
+* kv_cache unit behavior: the page-aligned chain index
+  (publish/acquire/peek), refcount lifecycle incl. the idle-LRU retention
+  tier and pressure reclaim, the double-free guard (raises loudly and
+  counts ``serving.kv.double_free_total``), the high-water mark;
+* engine end-to-end over the 3-arg toy prefill: shared-vs-unshared
+  transcripts BIT-identical on both kv storage legs, COW isolation at the
+  pool-byte level (a sibling's admission+decode never rewrites a shared
+  page), the scheduler's admission cost charging only the unshared tail;
+* refcount chaos: injected admit/step faults and watchdog replay storms
+  end with zero outstanding pages and an empty refcount table — shared
+  mappings never leak through error paths;
+* router prefix affinity: placement prefers the replica whose advertised
+  prefix index holds the prompt's chain (with the ``affinity`` trace
+  event), and the no-affinity path consumes the SAME rng stream as the
+  legacy pick-2 so traces stay deterministic under a fixed seed.
+
+The real-model leg (GQA llama, kernel + dense decode tiers) pins the same
+transcript parity through ``LlamaForCausalLM.serving_callables`` — the
+causal bottom-right-aligned SDPA mask makes the chunked tail prefill
+exact, which is the COW numerics contract of record (see MIGRATING.md).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle  # noqa: F401  (backend pin via conftest)
+from paddle_tpu import serving
+from paddle_tpu.core.tensor import Tensor as T
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving import kv_cache as kvc
+
+from test_serving import D, H, L, M, V, _kv_of, _readout, toy_step
+
+PS = 4  # small pages so short prompts span several
+
+
+# ---------------------------------------------------------------------------
+# 3-arg toy prefill: chunk-consistent by construction (per-token K/V), so
+# chunked tail prefill over a resident prefix is exact — the same property
+# the causal seq_offset path gives the real models
+# ---------------------------------------------------------------------------
+
+def toy_prefill3(ids, cache, start=0):
+    """(1, Lp) int32, (L, 2, 1, H, M, D) with [0, start) resident."""
+    idsd, c = ids._data, cache._data
+    lp = idsd.shape[1]
+    kv = jnp.transpose(_kv_of(idsd[0].astype(jnp.float32)), (1, 0, 2))
+    c = c.at[:, :, 0, :, start:start + lp, :].set(
+        jnp.broadcast_to(kv[None, None], (L, 2, H, lp, D)).astype(c.dtype))
+    valid = (jnp.arange(M) < start + lp)[None, :]
+    logits = _readout(c[0, 0], valid)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+    return T(nxt), T(c)
+
+
+def make_engine3(prefix_sharing="auto", page_size=PS, max_batch=2, **kw):
+    cfg = serving.ServingConfig(
+        num_layers=L, num_heads=H, head_dim=D, max_len=M,
+        max_batch=max_batch, buckets=(1, max_batch), page_size=page_size,
+        prefix_sharing=prefix_sharing, **kw)
+    return serving.Engine(toy_prefill3, toy_step, cfg)
+
+
+_RNG = np.random.default_rng(17)
+BASE = _RNG.integers(0, V, (3 * PS,), dtype=np.int32)     # 3 full pages
+SHARED_PROMPTS = [np.concatenate([BASE, _RNG.integers(0, V, (k,),
+                                                      dtype=np.int32)])
+                  for k in (3, 5, 2)]
+
+
+def _drain(eng, prompts, n_new=4):
+    futs = [eng.submit(serving.GenerationRequest(p, max_new_tokens=n_new))
+            for p in prompts]
+    eng.run()
+    return [f.result(timeout=30).tokens for f in futs]
+
+
+def _pool(num_pages=12, page_size=PS, **kw):
+    return kvc.PagedKVCache(kvc.KVCacheConfig(
+        num_layers=L, num_heads=H, head_dim=D, max_len=M,
+        page_size=page_size, num_pages=num_pages, **kw))
+
+
+# ---------------------------------------------------------------------------
+# chain hashing + index lifecycle
+# ---------------------------------------------------------------------------
+
+class TestChainIndex:
+    def test_chain_digests_prefix_property(self):
+        a = np.arange(10, dtype=np.int32)
+        b = np.concatenate([a[:4], np.asarray([99, 98], np.int32), a[6:]])
+        da, db = (kvc.prefix_chain_digests(x, 4) for x in (a, b))
+        assert len(da) == 2                    # partial third page excluded
+        assert da[0] == db[0]                  # shared first page
+        assert da[1] != db[1]                  # diverged second page
+        # chained, not per-page: same page content at a different depth
+        # hashes differently
+        c = np.concatenate([a[4:8], a[4:8]]).astype(np.int32)
+        dc = kvc.prefix_chain_digests(c, 4)
+        assert dc[0] != dc[1]
+        assert kvc.prefix_chain_digests(a, 4, limit=1) == da[:1]
+
+    def test_publish_acquire_free_lifecycle(self):
+        pool = _pool()
+        prompt = SHARED_PROMPTS[0]
+        owner = pool.alloc(4)
+        pool.publish(prompt, owner)
+        # 3 full-prompt pages published (the 4th page holds non-prompt
+        # positions and never enters the index)
+        assert len(pool.prefix_summary()) == 3
+        assert pool.peek_prefix_pages(SHARED_PROMPTS[1]) == 3
+        shared = pool.acquire_prefix(SHARED_PROMPTS[1])
+        assert shared == owner[:3]
+        assert pool.refcounts()[owner[0]] == 2
+        pool.free(shared)                      # consumer: decrement only
+        assert pool.refcounts()[owner[0]] == 1
+        pool.free(owner)                       # owner: rc 0 -> idle, not free
+        assert pool.outstanding_pages == 0
+        assert pool.idle_pages == 3            # published pages park on LRU
+        assert pool.free_pages == pool.config.num_pages - 1
+        # re-acquire revives the idle chain with content intact
+        again = pool.acquire_prefix(SHARED_PROMPTS[2])
+        assert again == owner[:3]
+        assert pool.refcounts()[owner[0]] == 1
+        pool.free(again)
+
+    def test_tail_page_keeps_at_least_one_prompt_token(self):
+        # a prompt of exactly N full pages shares at most N-1: the prefill
+        # must still compute >= 1 token to emit the first output
+        pool = _pool()
+        prompt = BASE                          # exactly 3 pages
+        owner = pool.alloc(4)
+        pool.publish(prompt, owner)
+        assert pool.peek_prefix_pages(prompt) == 2
+        got = pool.acquire_prefix(prompt)
+        assert got == owner[:2]
+        pool.free(got)
+        pool.free(owner)
+
+    def test_min_shared_pages_threshold(self):
+        pool = _pool(min_shared_pages=2)
+        owner = pool.alloc(4)
+        pool.publish(SHARED_PROMPTS[0], owner)
+        short = SHARED_PROMPTS[0][:PS + 2]     # only 1 full page matches
+        assert pool.acquire_prefix(short) == []
+        assert pool.refcounts()[owner[0]] == 1  # rejected without bumping
+        long = SHARED_PROMPTS[1]
+        assert len(pool.acquire_prefix(long)) == 3
+        pool.free(owner)
+
+    def test_double_free_guard_raises_and_counts(self, metrics):
+        pool = _pool()
+        ids = pool.alloc(2)
+        pool.free(ids)
+        with pytest.raises(ValueError, match="free"):
+            pool.free(ids[:1])
+        assert pool.prefix_stats()["double_free_total"] == 1.0
+        from paddle_tpu import observability as obs
+        assert obs.snapshot().get("serving.kv.double_free_total") == 1.0
+        # an idle (published, rc=0) page is not freeable either: its
+        # refcount already hit zero, so a second free means some slot's
+        # table still points at a page the pool no longer charges to it
+        owner = pool.alloc(3)
+        pool.publish(SHARED_PROMPTS[0][:2 * PS], owner[:2])
+        pool.free(owner)
+        with pytest.raises(ValueError, match="free"):
+            pool.free([owner[0]])
+        assert pool.prefix_stats()["double_free_total"] == 2.0
+
+    def test_pressure_reclaims_idle_lru_first(self):
+        pool = _pool(num_pages=8)              # 7 usable
+        a = pool.alloc(3)
+        pool.publish(SHARED_PROMPTS[0][:3 * PS], a)
+        pool.free(a)                           # 3 idle (indexed), 4 free
+        grab = pool.alloc(6)                   # needs 2 reclaimed
+        assert grab is not None and len(grab) == 6
+        # oldest idle pages were reclaimed and unpublished
+        assert pool.idle_pages == 1
+        assert len(pool.prefix_summary()) <= 1
+        pool.free(grab)
+
+    def test_high_water_and_stats_schema(self):
+        pool = _pool()
+        a = pool.alloc(5)
+        pool.free(a[:2])
+        stats = pool.prefix_stats()
+        assert stats["pages_high_water"] == 5.0
+        assert stats["pages_in_use"] == 3.0
+        assert set(stats) == {
+            "pages_in_use", "pages_idle", "pages_high_water",
+            "pages_shared_ratio", "prefix_index_pages", "prefix_queries",
+            "prefix_query_hits", "prefix_hit_rate",
+            "prefix_pages_shared_total", "double_free_total"}
+        pool.free(a[2:])
+
+
+# ---------------------------------------------------------------------------
+# engine: parity, COW isolation, tail-only admission cost
+# ---------------------------------------------------------------------------
+
+class TestEngineSharing:
+    @pytest.mark.parametrize("kv_dtype", ["native", "int8"])
+    def test_shared_transcripts_bit_identical(self, kv_dtype):
+        ref = _drain(make_engine3("off", kv_dtype=kv_dtype),
+                     SHARED_PROMPTS)
+        eng = make_engine3("on", kv_dtype=kv_dtype)
+        got = _drain(eng, SHARED_PROMPTS)
+        assert got == ref
+        stats = eng.kv.prefix_stats()
+        assert stats["prefix_pages_shared_total"] >= 3.0
+        req, comp = eng.prefill_token_stats()
+        assert comp < req
+        assert eng.kv.outstanding_pages == 0
+        assert eng.kv.refcounts() == {}
+
+    @pytest.mark.parametrize("kv_dtype", ["native", "int8"])
+    def test_cow_shared_page_bytes_never_rewritten(self, kv_dtype):
+        eng = make_engine3("on", kv_dtype=kv_dtype)
+        _drain(eng, SHARED_PROMPTS[:1])        # publish the base chain
+        digests = kvc.prefix_chain_digests(BASE, PS)
+        page_ids = [eng.kv._index[d] for d in digests]
+        before = np.asarray(eng.kv.pool)[page_ids].copy()
+        scales0 = (np.asarray(eng.kv.scales)[page_ids].copy()
+                   if eng.kv.scales is not None else None)
+        # the sibling maps those pages, tail-prefills, and decodes
+        _drain(eng, SHARED_PROMPTS[1:2])
+        after = np.asarray(eng.kv.pool)[page_ids]
+        np.testing.assert_array_equal(before, after)
+        if scales0 is not None:
+            np.testing.assert_array_equal(
+                scales0, np.asarray(eng.kv.scales)[page_ids])
+
+    def test_concurrent_shared_batch_matches_reference(self):
+        # both requests in flight at once: the second maps the first's
+        # pages while the first is still decoding into its private tail
+        ref = _drain(make_engine3("off"), SHARED_PROMPTS[:2])
+        eng = make_engine3("on")
+        futs = [eng.submit(serving.GenerationRequest(p, max_new_tokens=4))
+                for p in SHARED_PROMPTS[:2]]
+        eng.run()
+        assert [f.result(timeout=30).tokens for f in futs] == ref
+        assert eng.kv.prefix_stats()["prefix_pages_shared_total"] >= 3.0
+
+    def test_scheduler_charges_unshared_tail_only(self):
+        eng = make_engine3("on")
+        _drain(eng, SHARED_PROMPTS[:1])
+        req = serving.GenerationRequest(SHARED_PROMPTS[1],
+                                        max_new_tokens=2)
+        full = int(SHARED_PROMPTS[1].size)
+        assert eng._prefill_cost(req) == full - 3 * PS
+        assert eng.scheduler.prefill_cost is not None
+        # sharing off: the scheduler keeps the legacy full-prompt cost
+        off = make_engine3("off")
+        assert off.scheduler.prefill_cost is None
+
+    def test_two_arg_prefill_keeps_sharing_off(self):
+        from test_serving import make_engine
+        eng = make_engine(page_size=PS)        # legacy 2-arg toy prefill
+        assert not eng.prefix_sharing_enabled
+        with pytest.raises(ValueError, match="prefix"):
+            make_engine(page_size=PS, prefix_sharing="on")
+
+
+# ---------------------------------------------------------------------------
+# refcount chaos: storms must end with a clean table
+# ---------------------------------------------------------------------------
+
+class TestRefcountChaos:
+    def _assert_clean(self, eng):
+        assert eng.kv.outstanding_pages == 0
+        assert eng.kv.refcounts() == {}
+        assert eng.kv.free_pages == eng.kv.config.num_pages - 1
+
+    def test_admit_fault_storm_leaks_nothing(self, metrics):
+        sched = faults.FaultSchedule().error("serving.admit",
+                                             on=(1, 2, 4, 5))
+        eng = make_engine3("on")
+        with faults.installed(sched):
+            futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=3)) for p in SHARED_PROMPTS]
+            eng.run()
+        done = sum(1 for f in futs if f.exception(timeout=10) is None)
+        assert done >= 1                       # storm didn't kill everything
+        self._assert_clean(eng)
+
+    def test_step_fault_storm_leaks_nothing(self, metrics):
+        ref = _drain(make_engine3("off"), SHARED_PROMPTS)
+        sched = faults.FaultSchedule().error("serving.step", on=(2, 5))
+        eng = make_engine3("on")
+        with faults.installed(sched):
+            futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=4)) for p in SHARED_PROMPTS]
+            eng.run()
+        outcomes = [f.exception(timeout=10) for f in futs]
+        # survivors stay bit-identical to the fault-free shared run
+        for i, exc in enumerate(outcomes):
+            if exc is None:
+                assert futs[i].result().tokens == ref[i]
+        self._assert_clean(eng)
+
+    def test_watchdog_replay_reacquires_prefix(self, metrics):
+        from paddle_tpu import observability as obs
+        ref = _drain(make_engine3("off"), SHARED_PROMPTS[:2])
+        sched = faults.FaultSchedule().error("serving.watchdog", on=(2, 3))
+        eng = make_engine3("on", max_replays=1)
+        with faults.installed(sched):
+            futs = [eng.submit(serving.GenerationRequest(
+                p, max_new_tokens=4)) for p in SHARED_PROMPTS[:2]]
+            eng.run()
+        assert [f.result(timeout=10).tokens for f in futs] == ref
+        assert obs.snapshot()["serving.replays_total"] == 2
+        self._assert_clean(eng)
+
+
+class TestObservabilitySurfaces:
+    def test_debug_doc_and_flight_dump_carry_prefix_stats(self, metrics):
+        # satellite: the prefix-index hit rate rides /debug/cost and the
+        # flight-recorder dump tail for every engine-registered pool
+        from paddle_tpu.observability import cost
+        eng = make_engine3("on")
+        _drain(eng, SHARED_PROMPTS[:2])
+        # 3 base pages from the first request + the second's own 4th
+        # full-prompt page (17 tokens = 4 full pages)
+        rows = cost.debug_doc()["prefix_sharing"]
+        mine = [r for r in rows if r.get("prefix_index_pages") == 4.0]
+        assert mine and mine[-1]["prefix_hit_rate"] > 0
+        assert "prefix_sharing" in cost.flight_snapshot()
+
+
+# ---------------------------------------------------------------------------
+# llama through the engine: both kv legs x both decode tiers
+# ---------------------------------------------------------------------------
+
+class TestLlamaSharing:
+    @pytest.fixture(scope="class")
+    def llama(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(11)
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               kv_heads=2, inter=48, max_pos=64)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        yield model
+        import gc
+        del model
+        gc.collect()
+
+    # one pair per decode tier, alternating kv legs: covers the
+    # kernel/dense x native/int8 grid in two engine pairs, not four
+    @pytest.mark.parametrize("paged,kv_dtype", [("off", "native"),
+                                                ("on", "int8")])
+    def test_shared_vs_unshared_bit_identical(self, llama, paged, kv_dtype):
+        cfg = llama.config
+        prefill_fn, step_fn = llama.serving_callables(64)
+        rng = np.random.default_rng(23)
+        base = rng.integers(0, 64, (2 * PS + 1,), dtype=np.int32)
+        prompts = [np.concatenate([base, rng.integers(0, 64, (k,),
+                                                      dtype=np.int32)])
+                   for k in (2, 4)]
+
+        def run(mode):
+            scfg = serving.ServingConfig(
+                num_layers=cfg.num_hidden_layers,
+                num_heads=cfg.num_key_value_heads,
+                head_dim=cfg.hidden_size // cfg.num_attention_heads,
+                max_len=64, max_batch=2, buckets=(1, 2), page_size=PS,
+                kv_dtype=kv_dtype, paged_attention=paged,
+                prefix_sharing=mode)
+            eng = serving.Engine(prefill_fn, step_fn, scfg)
+            return _drain(eng, prompts), eng
+
+        ref, _ = run("off")
+        got, eng = run("on")
+        assert got == ref
+        assert eng.kv.prefix_stats()["prefix_pages_shared_total"] >= 2.0
+        assert eng.kv.outstanding_pages == 0
+
+
+# ---------------------------------------------------------------------------
+# router: prefix-affine placement + trace determinism
+# ---------------------------------------------------------------------------
+
+class TestRouterAffinity:
+    def test_affine_pick_prefers_resident_replica(self, metrics):
+        engines = [("r0", make_engine3("on", name="r0")),
+                   ("r1", make_engine3("on", name="r1"))]
+        # seed r1's index offline: the chain is resident (idle) there
+        _drain(engines[1][1], SHARED_PROMPTS[:1])
+        assert len(engines[1][1].prefix_summary()) == 3
+        router = serving.Router(engines,
+                                serving.RouterConfig(seed=0)).start()
+        try:
+            fut = router.submit(serving.GenerationRequest(
+                SHARED_PROMPTS[1], max_new_tokens=3))
+            assert len(fut.result(timeout=30).tokens) == 3
+            aff = [e for e in router.trace if e[0] == "affinity"]
+            assert aff and aff[0][2] == "r1" and aff[0][3] == 3
+            picks = [e for e in router.trace if e[0] == "pick"]
+            assert picks[0][2] == "r1"
+        finally:
+            router.stop(drain=True, timeout=30)
+
+    def test_replica_prefix_depth(self):
+        eng = make_engine3("on")
+        _drain(eng, SHARED_PROMPTS[:1])
+        rep = serving.Replica("x", eng)
+        deep = serving.GenerationRequest(SHARED_PROMPTS[1],
+                                         max_new_tokens=1)
+        assert rep.prefix_depth(deep) == 3
+        miss = serving.GenerationRequest(
+            np.arange(20, dtype=np.int32) % V, max_new_tokens=1)
+        assert rep.prefix_depth(miss) == 0
+        # sharing-off engines advertise nothing
+        off = serving.Replica("y", make_engine3("off"))
+        assert off.prefix_depth(deep) == 0
+
+    def test_no_resident_prefix_keeps_legacy_rng_stream(self, metrics):
+        # with zero prefix depth everywhere the affinity-aware pick must
+        # consume the SAME rng draws as the legacy pick-2: identical
+        # seeds + identical workloads => identical pick traces whether
+        # the bias knob is on (default) or forced off
+        prompts = [_RNG.integers(0, V, (6,), dtype=np.int32)
+                   for _ in range(4)]
+
+        def picks(bias):
+            engines = [(f"e{i}", make_engine3("off", name=f"e{i}-{bias}"))
+                       for i in range(3)]
+            router = serving.Router(
+                engines, serving.RouterConfig(
+                    seed=7, prefix_affinity_bias=bias)).start()
+            try:
+                for p in prompts:
+                    router.submit(serving.GenerationRequest(
+                        p, max_new_tokens=2)).result(timeout=30)
+                return [e for e in router.trace if e[0] == "pick"]
+            finally:
+                router.stop(drain=True, timeout=30)
+
+        with_bias, without = picks(0.75), picks(0.0)
+        assert [p[2] for p in with_bias] == [p[2] for p in without]
+
+    def test_affinity_bias_validation(self):
+        with pytest.raises(ValueError, match="prefix_affinity_bias"):
+            serving.RouterConfig(prefix_affinity_bias=1.5)

@@ -159,6 +159,18 @@ DEFAULT_CONFIG: Dict[str, Any] = {
             "Router.submit", "Router.cancel", "Router.stop",
             "Router.drain_replica", "Router.restore_replica",
             "Router._on_replica_done", "Router._poll_loop"],
+        # ISSUE 17: the prefix index + refcount table are shared mutable
+        # state with THREE writer/reader populations — the engine step
+        # thread (admission acquires/publishes, completion frees), router
+        # caller threads (Replica.prefix_depth walks prefix_summary during
+        # placement), and offline bench/test drivers; every access must be
+        # dominated by the pool lock, so the public sharing surface is
+        # rooted explicitly and survives spawn-site refactors
+        "paddle_tpu/serving/kv_cache.py": [
+            "PagedKVCache.alloc", "PagedKVCache.free",
+            "PagedKVCache.acquire_prefix", "PagedKVCache.peek_prefix_pages",
+            "PagedKVCache.publish", "PagedKVCache.prefix_summary",
+            "PagedKVCache.prefix_stats"],
         # the step/train thread arms and disarms around the compiled call
         # while the poll daemon classifies the window
         "paddle_tpu/resilience/watchdog.py": [
