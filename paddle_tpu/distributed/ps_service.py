@@ -550,8 +550,14 @@ class PsClient:
             for attempt in policy.start():
                 try:
                     breaker.before_call()
-                    _faults.fault_point("ps.call")
                     try:
+                        # the injected-fault seam sits INSIDE the
+                        # record_success/record_failure try: an injected
+                        # non-transport fault used to escape between
+                        # before_call() and this try with the half-open
+                        # probe still out, wedging the breaker half-open
+                        # forever (found by the resource-discipline lint)
+                        _faults.fault_point("ps.call")
                         # only SUCCESSFUL attempts land in the latency
                         # histogram — timing failed attempts would fill
                         # ps.rpc_seconds with connect-timeout durations
@@ -578,43 +584,56 @@ class PsClient:
                         raise
                     breaker.record_success()
                     return result
-                except (rpc.RpcTransportError, _resil.BreakerOpen) as e:
-                    if isinstance(e, rpc.RpcTransportError):
-                        breaker.record_failure()
-                        last_transport_err = e
-                    try:
-                        # backoff-sleeps, or re-raises on a spent budget;
-                        # exhaustion surfaces the last REAL transport
-                        # error (callers pin on RpcTransportError), never
-                        # a BreakerOpen short-circuit
-                        attempt.fail(last_transport_err or e)
-                    except _resil.BreakerOpen as bo:
-                        # budget spent while this call only ever saw the
-                        # breaker (opened by PREVIOUS calls): surface the
-                        # documented transport type, not a third one
-                        _obs.inc("ps.rpc_failures_total")
-                        raise rpc.RpcTransportError(
-                            f"rpc to {server} failed: retry budget spent "
-                            f"while circuit breaker open") from bo
-                    except BaseException:
-                        _obs.inc("ps.rpc_failures_total")
-                        raise
-                    _obs.inc("ps.rpc_retries_total")
-                    try:
-                        old = rpc.get_worker_info(server)
-                        fresh = rpc.refresh_worker_info(server)
-                        # a FAILOVER is an endpoint change (respawned
-                        # server re-registered); a same-endpoint refresh
-                        # is just a retry and must not inflate the
-                        # failover count
-                        if (fresh.ip, fresh.port) != (old.ip, old.port):
-                            _obs.inc("ps.rpc_failovers_total")
-                            # new address: the old failure run says
-                            # nothing about it — close the breaker so the
-                            # respawned server is probed immediately
-                            breaker.reset()
-                    except Exception:
-                        pass  # store briefly unreachable: keep backing off
+                except rpc.RpcTransportError as e:
+                    # separate arms (not one `except (Transport, Open)`
+                    # with an isinstance dispatch) so record_failure is
+                    # unconditional here — a held half-open probe is
+                    # returned on EVERY path out of this handler
+                    breaker.record_failure()
+                    last_transport_err = e
+                    self._retry_backoff(rpc, server, breaker, attempt, e)
+                except _resil.BreakerOpen as e:
+                    # before_call short-circuited: no probe was taken,
+                    # nothing to record — keep backing off until the
+                    # deadline, exactly like a transport failure
+                    self._retry_backoff(rpc, server, breaker, attempt,
+                                        last_transport_err or e)
+
+    def _retry_backoff(self, rpc, server: str, breaker, attempt,
+                       err: BaseException) -> None:
+        """One failed ``_call`` attempt's bookkeeping: backoff-sleep (or
+        re-raise on a spent budget), then endpoint re-resolution."""
+        try:
+            # backoff-sleeps, or re-raises on a spent budget; exhaustion
+            # surfaces the last REAL transport error (callers pin on
+            # RpcTransportError), never a BreakerOpen short-circuit
+            attempt.fail(err)
+        except _resil.BreakerOpen as bo:
+            # budget spent while this call only ever saw the breaker
+            # (opened by PREVIOUS calls): surface the documented
+            # transport type, not a third one
+            _obs.inc("ps.rpc_failures_total")
+            raise rpc.RpcTransportError(
+                f"rpc to {server} failed: retry budget spent "
+                f"while circuit breaker open") from bo
+        except BaseException:
+            _obs.inc("ps.rpc_failures_total")
+            raise
+        _obs.inc("ps.rpc_retries_total")
+        try:
+            old = rpc.get_worker_info(server)
+            fresh = rpc.refresh_worker_info(server)
+            # a FAILOVER is an endpoint change (respawned server
+            # re-registered); a same-endpoint refresh is just a retry
+            # and must not inflate the failover count
+            if (fresh.ip, fresh.port) != (old.ip, old.port):
+                _obs.inc("ps.rpc_failovers_total")
+                # new address: the old failure run says nothing about
+                # it — close the breaker so the respawned server is
+                # probed immediately
+                breaker.reset()
+        except Exception:
+            pass  # store briefly unreachable: keep backing off
 
     def create_table(self, name: str, value) -> None:
         arr = np.asarray(value)

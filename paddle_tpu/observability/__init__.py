@@ -184,7 +184,11 @@ def _check_labels(labels) -> None:
     # name=... is always an intentional label, e.g. the profiler bridge's
     # record_event_seconds{name=...}.)
     if "value" in labels:
-        raise TypeError(
+        # every production call site passes a literal label set, so this
+        # TypeError is unreachable at runtime from the serving/training
+        # entry roots — it exists to fail developer mistakes loudly in
+        # tier-1, not as part of any typed failure surface
+        raise TypeError(  # graft-lint: disable=exception-contract
             "'value' is positional-only — obs.inc(name, amount, **labels); "
             "pass the amount positionally, not as a label")
 

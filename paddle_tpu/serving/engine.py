@@ -888,27 +888,32 @@ class Engine:
             self.scheduler.requeue(requeue)
         if on_timeout == "fail":
             for pend in self.scheduler.drain_queue():
+                # settle the Future BEFORE the telemetry calls: this
+                # method's contract is "no Future may stay stranded", so
+                # a counter/trace hook raising must not leave this pend —
+                # or the untouched rest of the drained queue — unresolved
+                # (found by the resource-discipline lint)
                 if pend.replays or pend.replay_tokens:
                     # NOT overload shed: this request was admitted and
                     # decoding when crash-recovery requeued it, and the
                     # drain budget ran out before its re-admission
-                    _obs.inc("serving.requests_total", status="failed")
-                    _trace.instant("serving.fault", parent=pend.trace_ctx,
-                                   rid=pend.request.request_id,
-                                   error="DrainTimeout")
                     pend.future.set_exception(DrainTimeout(
                         f"request {pend.request.request_id} evicted at "
                         f"drain timeout awaiting replay re-admission "
                         f"after {len(pend.replay_tokens)} tokens"))
+                    _obs.inc("serving.requests_total", status="failed")
+                    _trace.instant("serving.fault", parent=pend.trace_ctx,
+                                   rid=pend.request.request_id,
+                                   error="DrainTimeout")
                     continue
+                pend.future.set_exception(EngineStopped(
+                    f"request {pend.request.request_id} never admitted: "
+                    f"engine stopped"))
                 _obs.inc("serving.requests_total", status="shed")
                 _obs.inc("serving.rejected_total", reason="shed")
                 _trace.instant("serving.shed", parent=pend.trace_ctx,
                                rid=pend.request.request_id,
                                reason="engine_stopped")
-                pend.future.set_exception(EngineStopped(
-                    f"request {pend.request.request_id} never admitted: "
-                    f"engine stopped"))
 
     # -- step phases ----------------------------------------------------
     def _process_cancellations(self) -> bool:
@@ -970,6 +975,17 @@ class Engine:
                     # THIS request and everything behind it back in order
                     self.scheduler.requeue(pending[i:])
                     break
+        except BaseException as exc:
+            # ISSUE 18: _admit_one raising (it returns ok/failed/noroom on
+            # every scheduling outcome, so this is a bug surfacing) used
+            # to strand the whole popped batch — futures never resolved,
+            # requests gone from the queue. Put the untouched tail back in
+            # order and fail THIS request (unless _admit_one already
+            # resolved it before raising), then let the error surface.
+            self.scheduler.requeue(pending[i + 1:])
+            if not p.future.done():
+                p.future.set_exception(exc)
+            raise
         finally:
             with self._slot_lock:
                 self._in_transit = 0
@@ -1004,8 +1020,16 @@ class Engine:
         shared: List[int] = []
         if self._share_prefix:
             shared = self.kv.acquire_prefix(prompt)
-        start = len(shared) * self.config.page_size
-        pages = self.kv.alloc(self._pages_needed(req) - len(shared))
+        try:
+            start = len(shared) * self.config.page_size
+            pages = self.kv.alloc(self._pages_needed(req) - len(shared))
+        except BaseException:
+            # alloc REFUSING is the None return below; alloc (or the
+            # sizing arithmetic) RAISING must not strand the prefix
+            # references just acquired
+            if shared:
+                self.kv.free(shared)
+            raise
         if pages is None:
             if shared:
                 self.kv.free(shared)
@@ -1052,20 +1076,35 @@ class Engine:
                            error=type(exc).__name__)
             pending.future.set_exception(exc)
             return "failed"
-        self._set_pool(outs[1], outs[2] if self._quantized else None)
-        first_tok = int(np.asarray(outs[0]._data)[0, 0])
-        now = time.monotonic()
-        _obs.inc("serving.prefills_total")
-        _obs.inc("serving.prefill_tokens_requested_total",
-                 float(prompt.size))
-        _obs.inc("serving.prefill_tokens_computed_total",
-                 float(prompt.size - start))
-        if self._share_prefix:
-            # publish this slot's fully-prompt pages (content now frozen:
-            # decode writes land at t >= prompt_len, past every published
-            # page). Over the ORIGINAL prompt only — a replay's appended
-            # tokens are generated content, not a shareable prompt.
-            self.kv.publish(req.prompt, pages)
+        try:
+            # ISSUE 18: the pool swap, first-token host read and prefix
+            # publish belong to the guarded region too — the host sync
+            # raising here (wedged device, watchdog replay) used to leak
+            # the slot's pages AND strand the future; now it is just
+            # another "failed" admission
+            self._set_pool(outs[1], outs[2] if self._quantized else None)
+            first_tok = int(np.asarray(outs[0]._data)[0, 0])
+            now = time.monotonic()
+            _obs.inc("serving.prefills_total")
+            _obs.inc("serving.prefill_tokens_requested_total",
+                     float(prompt.size))
+            _obs.inc("serving.prefill_tokens_computed_total",
+                     float(prompt.size - start))
+            if self._share_prefix:
+                # publish this slot's fully-prompt pages (content now
+                # frozen: decode writes land at t >= prompt_len, past
+                # every published page). Over the ORIGINAL prompt only —
+                # a replay's appended tokens are generated content, not a
+                # shareable prompt.
+                self.kv.publish(req.prompt, pages)
+        except Exception as exc:
+            self.kv.free(pages)
+            _obs.inc("serving.requests_total", status="failed")
+            _trace.instant("serving.fault", parent=pending.trace_ctx,
+                           rid=req.request_id, site="serving.admit",
+                           error=type(exc).__name__)
+            pending.future.set_exception(exc)
+            return "failed"
         slot = _Slot(pending=pending, page_ids=pages, table_row=row,
                      t=int(prompt.size), last_tok=first_tok,
                      tokens=list(pending.replay_tokens),

@@ -86,24 +86,40 @@ _log = logging.getLogger(__name__)
 _TERMINAL_GRACE_S = 5.0
 
 
+#: the typed failure surface, as data: first ``isinstance`` match wins, so
+#: subclasses that answer differently from their base sit EARLIER in the
+#: table (``NoHealthyReplica`` before ``ConnectionError``;
+#: ``DeadlineExceeded`` — a ``TimeoutError`` — is special-cased in
+#: :func:`status_for` above its ``FutureTimeout`` alias).  This table is
+#: what the lint's ``exception_contracts`` config (tools/lint) is seeded
+#: from: a NEW typed exception escaping the serving entry roots must land
+#: here AND in that contract in the same change, or the
+#: ``exception-contract`` rule fails the tree (MIGRATING: "Failure-surface
+#: invariants").
+_STATUS_MAP: Tuple[Tuple[type, int], ...] = (
+    (QueueFull, 429),
+    (FutureTimeout, 504),
+    (EngineStopped, 503),
+    (NoHealthyReplica, 503),
+    (BreakerOpen, 503),
+    (WatchdogTimeout, 503),
+    (ConnectionError, 503),
+    (ValueError, 400),
+)
+
+
 def status_for(exc: BaseException) -> int:
-    """The typed failure surface → HTTP status (table in the module
-    docstring). Overload is 429, expiry 504, unavailability 503 — a 500
-    can only mean a bug, never backpressure."""
-    if isinstance(exc, QueueFull):
-        return 429
+    """The typed failure surface → HTTP status (``_STATUS_MAP``).
+    Overload is 429, expiry 504, unavailability 503 — a 500 can only
+    mean a bug, never backpressure."""
     if isinstance(exc, DeadlineExceeded):
         # shed-on-arrival carries the backpressure detail: overload (429,
         # retry later), not an expired budget (504, the request is dead)
         return 429 if getattr(exc, "estimated_wait_s", None) is not None \
             else 504
-    if isinstance(exc, FutureTimeout):
-        return 504
-    if isinstance(exc, (EngineStopped, NoHealthyReplica, BreakerOpen,
-                        WatchdogTimeout, ConnectionError)):
-        return 503
-    if isinstance(exc, ValueError):
-        return 400
+    for typ, status in _STATUS_MAP:
+        if isinstance(exc, typ):
+            return status
     return 500
 
 

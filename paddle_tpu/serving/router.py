@@ -525,7 +525,12 @@ class Router:
                     self.trace.append(("shed", rid, name))
                 continue
             except ValueError:
-                raise          # malformed request: no replica can fix it
+                # malformed request: no replica can fix it. The replica
+                # ANSWERED (it validated and rejected) — return its
+                # half-open probe like the QueueFull arm does, or the
+                # breaker wedges half-open on a client mistake
+                rep.breaker.record_success()
+                raise
             except Exception as exc:
                 # EngineStopped (replica dying under us) or an injected/
                 # real transport fault before admission: never admitted,

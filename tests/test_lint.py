@@ -44,7 +44,11 @@ EXPECTED_RULES = {"trace-impurity", "silent-swallow", "hot-path-import",
                   "span-discipline",
                   # ISSUE 14 (graft-lint 3.0): whole-program race detector —
                   # thread-root discovery + lock domination over shared state
-                  "shared-state-race"}
+                  "shared-state-race",
+                  # ISSUE 18 (graft-lint 4.0): CFG-backed exception/resource
+                  # flow — typed failure surfaces at declared entry roots,
+                  # and all-paths release of configured acquire/release pairs
+                  "exception-contract", "resource-discipline"}
 
 
 def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
@@ -58,8 +62,8 @@ def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
 # rule registry
 # ---------------------------------------------------------------------------
 
-def test_all_thirteen_rules_registered():
-    assert len(EXPECTED_RULES) == 13
+def test_all_fifteen_rules_registered():
+    assert len(EXPECTED_RULES) == 15
     assert EXPECTED_RULES <= set(RULES)
 
 
@@ -715,6 +719,45 @@ def test_cli_update_baseline_flow(tmp_path):
     assert p.returncode == 1 and "TODO" in p.stderr
     p = _cli(str(bad), f"--baseline={bl}", "--allow-todo")
     assert p.returncode == 0  # baselined + drafting escape hatch -> clean
+
+
+def test_cli_prune_baseline_removes_only_dead_entries(tmp_path, capsys):
+    # ISSUE 18: --prune-baseline deletes entries that no longer fire and
+    # lowers over-counted ones, leaving live entries (and their reasons).
+    # Doctor a copy of the SHIPPED baseline — it is exactly-firing (the
+    # tier-1 gate asserts zero stale entries), so the one inflated count
+    # and the one fabricated entry are the only prunable budget.
+    from tools.lint.cli import main
+    real = load_baseline(default_baseline_path())
+    assert real
+    doctored = [dict(e) for e in real]
+    doctored[0]["count"] = int(doctored[0].get("count", 1)) + 2
+    doctored.append({"path": "paddle_tpu/no_such_file.py",
+                     "rule": "host-sync", "message": "never fires",
+                     "count": 1, "reason": "reviewed: dead"})
+    bl = tmp_path / "baseline.json"
+    save_baseline(str(bl), doctored)
+    assert main([f"--baseline={bl}", "--prune-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "pruned:" in out and "lowered:" in out
+    after = load_baseline(str(bl))
+    key = lambda e: (e["path"], e["rule"], e["message"])  # noqa: E731
+    assert {key(e) for e in after} == {key(e) for e in real}
+    by_key = {key(e): e for e in after}
+    k0 = key(real[0])
+    assert by_key[k0]["count"] == int(real[0].get("count", 1))
+    assert by_key[k0].get("reason") == real[0].get("reason")
+
+
+def test_cli_prune_baseline_requires_full_run(tmp_path, capsys):
+    # a narrowed run cannot tell "fixed" from "not scanned": usage error
+    from tools.lint.cli import main
+    assert main(["--prune-baseline", str(tmp_path)]) == 2
+    assert main(["--prune-baseline", "--changed-only"]) == 2
+    assert main(["--prune-baseline", "--rules=host-sync"]) == 2
+    assert main(["--prune-baseline", "--no-baseline"]) == 2
+    assert main(["--prune-baseline", "--update-baseline"]) == 2
+    assert "full default run" in capsys.readouterr().err
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,340 @@
+"""graft-lint 4.0 CFG builder (tools/lint/cfg.py).
+
+Fixture matrix over the constructs the exception/resource rules lean on —
+branches, loops, nested try, finally cloning, with, early return, raise
+inside a handler (typed bare-raise targets) — plus the shipped-tree
+property pin: every function in ``paddle_tpu/serving/`` builds a CFG with
+no orphan blocks.
+"""
+
+import ast
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.lint.cfg import build_cfg, iter_cfgs  # noqa: E402
+from tools.lint.engine import iter_python_files  # noqa: E402
+
+
+def cfg_of(src):
+    tree = ast.parse(textwrap.dedent(src))
+    return build_cfg(tree.body[0])
+
+
+def edge_kinds(cfg):
+    return {kind for _s, _t, kind in cfg.edges()}
+
+
+def kind_targets(cfg, kind):
+    return {t for _s, t, k in cfg.edges() if k == kind}
+
+
+def call_block(cfg, name):
+    """The block whose own statement list holds the bare call ``name()``."""
+    for b in cfg.blocks.values():
+        for s in b.stmts:
+            if (isinstance(s, ast.Expr) and isinstance(s.value, ast.Call)
+                    and isinstance(s.value.func, ast.Name)
+                    and s.value.func.id == name):
+                return b
+    raise AssertionError(f"no block calls {name}()")
+
+
+# ---------------------------------------------------------------------------
+# the construct matrix
+# ---------------------------------------------------------------------------
+
+def test_straight_line_single_block():
+    cfg = cfg_of("""
+        def f(x):
+            y = x + 1
+            return y
+    """)
+    assert cfg.orphan_blocks() == []
+    # one statement-bearing block; every such block also carries the
+    # blanket uncaught-exception edge to raise_exit
+    code = [b for b in cfg.blocks.values() if b.stmts]
+    assert len(code) == 1
+    assert kind_targets(cfg, "return") == {cfg.exit}
+    assert kind_targets(cfg, "except") == {cfg.raise_exit}
+
+
+def test_branch_true_false_join():
+    cfg = cfg_of("""
+        def f(x):
+            if x:
+                a = 1
+            else:
+                a = 2
+            return a
+    """)
+    assert cfg.orphan_blocks() == []
+    assert {"true", "false"} <= edge_kinds(cfg)
+    # both arms exist as statement-bearing blocks and rejoin
+    (src,) = [b for b in cfg.blocks.values()
+              if b.stmts and isinstance(b.stmts[-1], ast.If)]
+    arms = {t for t, k in src.succs if k in ("true", "false")}
+    assert len(arms) == 2
+    # both arms rejoin at the same block
+    joins = {t for a in arms for t, k in cfg.blocks[a].succs if k == "next"}
+    assert len(joins) == 1
+
+
+def test_branch_without_else_falls_through():
+    cfg = cfg_of("""
+        def f(x):
+            if x:
+                x = 0
+            return x
+    """)
+    (src,) = [b for b in cfg.blocks.values()
+              if b.stmts and isinstance(b.stmts[-1], ast.If)]
+    assert {k for _t, k in src.succs
+            if k in ("true", "false")} == {"true", "false"}
+    assert cfg.orphan_blocks() == []
+
+
+def test_loop_back_break_continue_edges():
+    cfg = cfg_of("""
+        def f(xs):
+            for x in xs:
+                if x < 0:
+                    continue
+                if x > 9:
+                    break
+                use(x)
+            return xs
+    """)
+    assert cfg.orphan_blocks() == []
+    assert {"back", "break", "continue", "true", "false"} <= edge_kinds(cfg)
+    # the loop header holds the For node and owns the body/after split
+    (hdr,) = [b for b in cfg.blocks.values() if b.label == "loop"]
+    assert isinstance(hdr.stmts[0], ast.For)
+    assert {k for _t, k in hdr.succs} >= {"true", "false"}
+    # continue re-enters the header; break does not
+    assert hdr.bid in kind_targets(cfg, "continue")
+    assert hdr.bid not in kind_targets(cfg, "break")
+
+
+def test_while_true_has_no_false_exit():
+    cfg = cfg_of("""
+        def f(q):
+            while True:
+                if q.done():
+                    break
+                q.step()
+    """)
+    (hdr,) = [b for b in cfg.blocks.values() if b.label == "loop"]
+    assert "false" not in {k for _t, k in hdr.succs}
+    assert cfg.orphan_blocks() == []
+
+
+def test_try_block_level_except_edges_and_propagation():
+    cfg = cfg_of("""
+        def f(x):
+            try:
+                risky(x)
+            except ValueError:
+                return -1
+            return 0
+    """)
+    handlers = [b for b in cfg.blocks.values() if b.label == "handler"]
+    assert len(handlers) == 1
+    assert handlers[0].handler_types == ("ValueError",)
+    # the protected suite wires except edges to the handler AND (no
+    # catch-all) outward to raise_exit
+    body = call_block(cfg, "risky")
+    tgt = {t for t, k in body.succs if k == "except"}
+    assert handlers[0].bid in tgt and cfg.raise_exit in tgt
+
+
+def test_catch_all_handler_stops_propagation():
+    cfg = cfg_of("""
+        def f(x):
+            try:
+                risky(x)
+            except Exception:
+                return -1
+            return 0
+    """)
+    body = call_block(cfg, "risky")
+    assert cfg.raise_exit not in {t for t, k in body.succs if k == "except"}
+
+
+def test_nested_try_inner_handlers_then_outer():
+    cfg = cfg_of("""
+        def f(x):
+            try:
+                try:
+                    risky(x)
+                except KeyError:
+                    inner()
+                other(x)
+            except ValueError:
+                outer()
+    """)
+    assert cfg.orphan_blocks() == []
+    types = {b.handler_types for b in cfg.blocks.values()
+             if b.handler_types is not None}
+    assert types == {("KeyError",), ("ValueError",)}
+    # risky(x)'s block targets the inner handler, the outer handler and
+    # (neither is a catch-all) the raise exit
+    body = call_block(cfg, "risky")
+    tgt = {t for t, k in body.succs if k == "except"}
+    assert cfg.raise_exit in tgt
+    assert {cfg.blocks[t].handler_types
+            for t in tgt if t != cfg.raise_exit} == \
+        {("KeyError",), ("ValueError",)}
+
+
+def test_bare_raise_in_handler_takes_typed_targets():
+    # `except T: ...; raise` re-raises exactly T: an enclosing handler
+    # naming T exactly catches it FOR SURE — no blind raise_exit edge
+    cfg = cfg_of("""
+        def f(x):
+            try:
+                try:
+                    risky(x)
+                except KeyError:
+                    raise
+            except KeyError:
+                return -1
+    """)
+    inner = [b for b in cfg.blocks.values()
+             if b.handler_types == ("KeyError",) and b.stmts
+             and isinstance(b.stmts[-1], ast.Raise)][0]
+    raise_tgts = {t for t, k in inner.succs if k == "raise"}
+    assert cfg.raise_exit not in raise_tgts
+    assert all(cfg.blocks[t].handler_types == ("KeyError",)
+               for t in raise_tgts)
+
+
+def test_bare_raise_propagates_past_unrelated_handler():
+    # the outer handler names a DIFFERENT type: it stays a possible
+    # target (subclassing is invisible here) but so does raise_exit
+    cfg = cfg_of("""
+        def f(x):
+            try:
+                try:
+                    risky(x)
+                except KeyError:
+                    raise
+            except ValueError:
+                return -1
+    """)
+    inner = [b for b in cfg.blocks.values()
+             if b.handler_types == ("KeyError",)][0]
+    raise_tgts = {t for t, k in inner.succs if k == "raise"}
+    assert cfg.raise_exit in raise_tgts
+
+
+def test_explicit_raise_edges_to_handler_and_exit():
+    cfg = cfg_of("""
+        def f(x):
+            try:
+                raise ValueError(x)
+            except ValueError:
+                return -1
+    """)
+    raiser = [b for b in cfg.blocks.values()
+              if b.stmts and isinstance(b.stmts[-1], ast.Raise)][0]
+    tgts = {t for t, k in raiser.succs if k == "raise"}
+    handler = [b for b in cfg.blocks.values()
+               if b.handler_types == ("ValueError",)][0]
+    assert handler.bid in tgts and cfg.raise_exit in tgts
+
+
+def test_finally_cloned_per_continuation():
+    fn = ast.parse(textwrap.dedent("""
+        def f(x):
+            try:
+                if x:
+                    return 1
+                risky(x)
+            finally:
+                cleanup()
+            return 0
+    """)).body[0]
+    cfg = build_cfg(fn)
+    cleanup_stmt = fn.body[0].finalbody[0]
+    clones = cfg.blocks_with(cleanup_stmt)
+    # one copy each for: the return unwind, the exceptional unwind, and
+    # the normal fall-through continuation
+    assert len(clones) >= 3
+    # the exceptional clone ends at raise_exit; the return clone at exit
+    ends = set()
+    for c in clones:
+        for t, _k in c.succs:
+            ends.add(t)
+    assert cfg.exit in ends or any(
+        t == cfg.exit for c in clones for t, k in c.succs)
+    assert any(t == cfg.raise_exit for c in clones for t, _k in c.succs)
+    assert cfg.orphan_blocks() == []
+
+
+def test_with_statement_sits_in_preceding_block():
+    cfg = cfg_of("""
+        def f(x):
+            with lock() as h:
+                use(h)
+            return x
+    """)
+    assert cfg.orphan_blocks() == []
+    withers = [b for b in cfg.blocks.values()
+               if any(isinstance(s, ast.With) for s in b.stmts)]
+    assert len(withers) == 1
+    # the body is a separate block reached by a next edge
+    assert any(k == "next" for _t, k in withers[0].succs)
+
+
+def test_early_return_and_visible_dead_code():
+    cfg = cfg_of("""
+        def f(x):
+            return x
+            unreachable()
+    """)
+    # the return reaches exit; the trailing statement stays visible as
+    # an orphan block rather than silently vanishing
+    assert kind_targets(cfg, "return") == {cfg.exit}
+    orphans = cfg.orphan_blocks()
+    assert len(orphans) == 1 and orphans[0].label == "dead"
+
+
+def test_iter_cfgs_qualnames():
+    tree = ast.parse(textwrap.dedent("""
+        def top():
+            def inner():
+                pass
+
+        class C:
+            def m(self):
+                pass
+    """))
+    quals = [q for q, _fn, _cfg in iter_cfgs(tree)]
+    assert quals == ["top", "top.inner", "C.m"]
+
+
+# ---------------------------------------------------------------------------
+# shipped-tree property pin
+# ---------------------------------------------------------------------------
+
+def test_every_serving_function_builds_an_orphan_free_cfg():
+    """ISSUE 18: the serving tier is what the resource/exception rules
+    walk — every function there must build, and a well-formed build of
+    live code has no orphan blocks (an orphan means the builder lost an
+    edge, which would silently hide leak paths)."""
+    checked = 0
+    for abspath in iter_python_files(["paddle_tpu/serving"]):
+        with open(abspath, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        for qual, _fn, cfg in iter_cfgs(tree):
+            orphans = cfg.orphan_blocks()
+            assert orphans == [], (abspath, qual, orphans)
+            # exits are consistent too: some path reaches exit or raise
+            assert cfg.reachable() - {cfg.entry}, (abspath, qual)
+            checked += 1
+    assert checked > 100  # the tier is not empty / the glob still works

@@ -28,7 +28,9 @@ from tools.lint.wholeprogram.summary import SUMMARY_FORMAT  # noqa: E402
 
 WHOLEPROGRAM_RULES = {"cross-trace-impurity", "cross-host-sync",
                       "lock-order", "import-layering",
-                      "shared-state-race"}
+                      "shared-state-race",
+                      # ISSUE 18 (graft-lint 4.0)
+                      "exception-contract", "resource-discipline"}
 
 
 def write_pkg(tmp_path, files):
@@ -919,9 +921,28 @@ def test_cache_per_file_findings_served_without_parse(tmp_path):
 
 def test_summary_format_constant_is_pinned():
     # bump CACHE_FORMAT_VERSION whenever SUMMARY_FORMAT changes; this pin
-    # forces the bump to be a conscious, reviewed edit (2: graft-lint 3.0
-    # — call-site lock sets, access records, spawn roots)
-    assert (SUMMARY_FORMAT, CACHE_FORMAT_VERSION) == (2, 2)
+    # forces the bump to be a conscious, reviewed edit (3: graft-lint 4.0
+    # — per-function raise-sets, catch contexts, resource events)
+    assert (SUMMARY_FORMAT, CACHE_FORMAT_VERSION) == (3, 3)
+
+
+def test_stale_v2_cache_is_resummarized_not_crashed(tmp_path):
+    # ISSUE 18: a cache written by the graft-lint 3.0 layout (format 2 —
+    # no raise-sets/resource events) must be discarded whole and rebuilt,
+    # never half-read into the new summary shape
+    write_pkg(tmp_path, CACHE_FILES)
+    cache = tmp_path / "cache.json"
+    first = lint_pkg(tmp_path, "cross-trace-impurity",
+                     cache_path=str(cache))
+    data = json.loads(cache.read_text())
+    data["format"] = 2
+    cache.write_text(json.dumps(data))
+    res = lint_pkg(tmp_path, "cross-trace-impurity", cache_path=str(cache))
+    assert res.errors == []
+    assert res.parsed_files == res.total_files > 0  # full re-summarize
+    assert [f.as_dict() for f in res.new] == \
+        [f.as_dict() for f in first.new]
+    assert json.loads(cache.read_text())["format"] == CACHE_FORMAT_VERSION
 
 
 # ---------------------------------------------------------------------------
@@ -1291,3 +1312,383 @@ def test_prefix_sharing_kv_pool_thread_roots(tmp_path):
     labels = {label for _m, _fi, label in project.thread_roots()}
     for needle in kv_roots:
         assert any(needle in lab for lab in labels), (needle, labels)
+
+
+# ---------------------------------------------------------------------------
+# exception-contract (ISSUE 18, graft-lint 4.0)
+# ---------------------------------------------------------------------------
+
+EC_CONFIG = {"exception_contracts": {
+    "pkg/serving/entry.py": {"Door.do_call": ["ValueError"]}}}
+
+EC_INNER = """
+    class Boom(RuntimeError):
+        pass
+
+    def work():
+        raise Boom("kaboom")
+"""
+
+
+def test_exception_contract_flags_escaping_type(tmp_path):
+    res = lint_pkg(tmp_path, "exception-contract", files={
+        "pkg/serving/entry.py": """
+            from pkg.inner import work
+
+            class Door:
+                def do_call(self):
+                    return work()
+        """,
+        "pkg/inner.py": EC_INNER,
+    }, config=EC_CONFIG)
+    assert len(res.new) == 1
+    f = res.new[0]
+    assert f.path == "pkg/serving/entry.py"
+    assert "'pkg.inner.Boom'" in f.message or "'Boom'" in f.message
+    assert "Door.do_call" in f.message
+    # the witness chain walks root -> callee -> raise site
+    quals = [r["message"] for r in f.related]
+    assert any("Door.do_call" in q for q in quals)
+    assert any("work" in q for q in quals)
+    assert f.related[-1]["path"] == "pkg/inner.py"
+
+
+def test_exception_contract_allows_declared_and_subclasses(tmp_path):
+    # the contract names the BASE; the raised subclass is admitted via
+    # the project class-base table
+    res = lint_pkg(tmp_path, "exception-contract", files={
+        "pkg/serving/entry.py": """
+            from pkg.inner import work
+
+            class Door:
+                def do_call(self):
+                    return work()
+        """,
+        "pkg/inner.py": EC_INNER,
+    }, config={"exception_contracts": {
+        "pkg/serving/entry.py": {"Door.do_call": ["RuntimeError"]}}})
+    assert res.new == []
+
+
+def test_exception_contract_subtracts_caught_along_chain(tmp_path):
+    res = lint_pkg(tmp_path, "exception-contract", files={
+        "pkg/serving/entry.py": """
+            from pkg.inner import work
+
+            class Door:
+                def do_call(self):
+                    try:
+                        return work()
+                    except RuntimeError:
+                        return None
+        """,
+        "pkg/inner.py": EC_INNER,
+    }, config=EC_CONFIG)
+    assert res.new == []
+
+
+def test_exception_contract_transparent_handler_ordering(tmp_path):
+    # CPython handler order: the FIRST matching arm decides — here it
+    # re-raises, and the later catch-all arm of the SAME try never runs
+    res = lint_pkg(tmp_path, "exception-contract", files={
+        "pkg/serving/entry.py": """
+            from pkg.inner import work, Boom
+
+            class Door:
+                def do_call(self):
+                    try:
+                        return work()
+                    except Boom:
+                        raise
+                    except Exception:
+                        return None
+        """,
+        "pkg/inner.py": EC_INNER,
+    }, config=EC_CONFIG)
+    assert len(res.new) == 1
+
+
+def test_exception_contract_pragma_at_raise_site(tmp_path):
+    res = lint_pkg(tmp_path, "exception-contract", files={
+        "pkg/serving/entry.py": """
+            from pkg.inner import work
+
+            class Door:
+                def do_call(self):
+                    return work()
+        """,
+        "pkg/inner.py": """
+            class Boom(RuntimeError):
+                pass
+
+            def work():
+                raise Boom("x")  # graft-lint: disable=exception-contract
+        """,
+    }, config=EC_CONFIG)
+    assert res.new == []
+
+
+def test_exception_contract_assertion_error_always_allowed(tmp_path):
+    # invariant violations should crash loudly, not be status-mapped
+    res = lint_pkg(tmp_path, "exception-contract", files={
+        "pkg/serving/entry.py": """
+            class Door:
+                def do_call(self):
+                    raise AssertionError("unreachable")
+        """,
+    }, config=EC_CONFIG)
+    assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# resource-discipline (ISSUE 18, graft-lint 4.0)
+# ---------------------------------------------------------------------------
+
+RD_CONFIG = {"resource_pairs": [
+    {"name": "pages", "acquire": ["Pool.alloc"],
+     "release": ["Pool.free"], "transfer": ["publish"]}]}
+
+
+def test_resource_discipline_flags_exception_path_leak(tmp_path):
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            def leaky(pool, work, n):
+                h = pool.alloc(n)
+                work(h)
+                pool.free(h)
+        """,
+    }, config=RD_CONFIG)
+    assert len(res.new) == 1
+    f = res.new[0]
+    assert "'pages'" in f.message and "an exception path" in f.message
+    assert any("acquired here" in r["message"] for r in f.related)
+
+
+def test_resource_discipline_discarded_result_always_leaks(tmp_path):
+    # calling the acquirer without binding the handle leaks on the
+    # normal path too
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            def drop(pool, n):
+                pool.alloc(n)
+                return n
+        """,
+    }, config=RD_CONFIG)
+    assert len(res.new) == 1
+    assert "a normal path" in res.new[0].message
+
+
+def test_resource_discipline_finally_and_with_are_all_paths(tmp_path):
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            def fin(pool, work, n):
+                h = pool.alloc(n)
+                try:
+                    work(h)
+                finally:
+                    pool.free(h)
+
+            def ctx(pool, work, n):
+                with pool.alloc(n) as h:
+                    work(h)
+        """,
+    }, config=RD_CONFIG)
+    assert res.new == []
+
+
+def test_resource_discipline_handler_release_covers_raise(tmp_path):
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            def guarded(pool, work, n):
+                h = pool.alloc(n)
+                try:
+                    work(h)
+                except Exception:
+                    pool.free(h)
+                    raise
+                pool.free(h)
+        """,
+    }, config=RD_CONFIG)
+    assert res.new == []
+
+
+def test_resource_discipline_ownership_escape_negatives(tmp_path):
+    # return, attribute store, transfer callee and constructor capture
+    # all hand the obligation to someone else
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            class Slot:
+                def __init__(self, pages):
+                    self.pages = pages
+
+            def ret(pool, n):
+                h = pool.alloc(n)
+                return h
+
+            def store(obj, pool, n):
+                obj.h = pool.alloc(n)
+
+            def share(pool, cache, key, n):
+                h = pool.alloc(n)
+                cache.publish(key, h)
+
+            def wrap(pool, n):
+                h = pool.alloc(n)
+                return Slot(h)
+        """,
+    }, config=RD_CONFIG)
+    assert res.new == []
+
+
+def test_resource_discipline_none_guard_refines_branch(tmp_path):
+    # alloc refusing returns None: the proven-empty branch owes nothing
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            def maybe(pool, work, n):
+                h = pool.alloc(n)
+                if h is None:
+                    return "noroom"
+                try:
+                    work(h)
+                finally:
+                    pool.free(h)
+        """,
+    }, config=RD_CONFIG)
+    assert res.new == []
+
+
+def test_resource_discipline_caller_owns_suffix_exempt(tmp_path):
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            def grab_locked(pool, work, n):
+                h = pool.alloc(n)
+                work(h)
+                return None
+        """,
+    }, config=dict(RD_CONFIG,
+                   resource_caller_owns_suffixes=["_locked"]))
+    assert res.new == []
+
+
+def test_resource_discipline_loop_dispenses_collection(tmp_path):
+    # iterating the acquired collection hands each element to the loop
+    # body (checked per element); loop exit owes nothing
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            def drain(pool, n):
+                for h in pool.alloc(n):
+                    pool.free(h)
+        """,
+    }, config=RD_CONFIG)
+    assert res.new == []
+
+
+def test_resource_discipline_fork_transfer_owns_on_success_only(tmp_path):
+    files = {
+        "pkg/a.py": """
+            def feed(pool, sink, n):
+                h = pool.alloc(n)
+                sink.push(h)
+        """,
+    }
+    cfg = {"resource_pairs": [
+        {"name": "pages", "acquire": ["Pool.alloc"],
+         "release": ["Pool.free"], "fork_transfers": ["push"]}]}
+    res = lint_pkg(tmp_path, "resource-discipline", files=files, config=cfg)
+    assert len(res.new) == 1  # push raising leaves the handle held
+    write_pkg(tmp_path, {"pkg/a.py": """
+        def feed(pool, sink, n):
+            h = pool.alloc(n)
+            try:
+                sink.push(h)
+            except BaseException:
+                pool.free(h)
+                raise
+    """})
+    res = lint_pkg(tmp_path, "resource-discipline", config=cfg)
+    assert res.new == []
+
+
+def test_resource_discipline_acquire_raises_handler_infeasible(tmp_path):
+    # handleless pair (breaker-probe shape): before_call raises INSTEAD
+    # of taking the probe, so the except arm for that type can never be
+    # entered with the probe held
+    files = {
+        "pkg/a.py": """
+            def probe(gate, work):
+                gate.enter()
+                try:
+                    work()
+                except GateClosed:
+                    return None
+                except BaseException:
+                    gate.leave()
+                    raise
+                gate.leave()
+        """,
+    }
+    base = {"name": "probe", "acquire": ["Gate.enter"],
+            "release": ["Gate.leave"], "handleless": True}
+    res = lint_pkg(
+        tmp_path, "resource-discipline", files=files,
+        config={"resource_pairs": [
+            dict(base, acquire_raises=["GateClosed"])]})
+    assert res.new == []
+    res = lint_pkg(
+        tmp_path, "resource-discipline",
+        config={"resource_pairs": [dict(base)]})
+    assert len(res.new) == 1  # without the declaration the arm leaks
+
+
+def test_resource_discipline_pragma_on_acquire_line(tmp_path):
+    res = lint_pkg(tmp_path, "resource-discipline", files={
+        "pkg/a.py": """
+            def leaky(pool, work, n):
+                h = pool.alloc(n)  # graft-lint: disable=resource-discipline
+                work(h)
+                pool.free(h)
+        """,
+    }, config=RD_CONFIG)
+    assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# shipped-tree contract/config cross-pins
+# ---------------------------------------------------------------------------
+
+def test_default_config_declares_serving_contracts_and_pairs():
+    from tools.lint.engine import DEFAULT_CONFIG
+    contracts = DEFAULT_CONFIG["exception_contracts"]
+    assert "Router.submit" in contracts["paddle_tpu/serving/router.py"]
+    assert "Engine.submit" in contracts["paddle_tpu/serving/engine.py"]
+    assert "TrainingSupervisor.run" in \
+        contracts["paddle_tpu/resilience/trainer.py"]
+    assert any(spec.startswith("_srv_") for spec in
+               contracts["paddle_tpu/distributed/ps_service.py"])
+    pairs = {p["name"]: p for p in DEFAULT_CONFIG["resource_pairs"]}
+    assert {"kv-pages", "sched-pending", "breaker-probe"} <= set(pairs)
+    assert pairs["breaker-probe"].get("handleless") is True
+    assert "_locked" in DEFAULT_CONFIG["resource_caller_owns_suffixes"]
+
+
+def test_router_contract_types_are_status_mapped():
+    # MIGRATING "Failure-surface invariants": every type the lint
+    # contract allows out of Router.submit must map to an honest status
+    # through http._STATUS_MAP (or its DeadlineExceeded special case),
+    # never fall through to the generic 500
+    from tools.lint.engine import DEFAULT_CONFIG
+    from paddle_tpu.serving import http as hs
+    from paddle_tpu.serving.engine import EngineStopped
+    from paddle_tpu.serving.router import NoHealthyReplica
+    from paddle_tpu.serving.scheduler import QueueFull
+    from paddle_tpu.resilience.policy import DeadlineExceeded
+
+    ns = {"QueueFull": QueueFull, "DeadlineExceeded": DeadlineExceeded,
+          "EngineStopped": EngineStopped,
+          "NoHealthyReplica": NoHealthyReplica,
+          "ConnectionError": ConnectionError, "ValueError": ValueError}
+    allowed = DEFAULT_CONFIG["exception_contracts"][
+        "paddle_tpu/serving/router.py"]["Router.submit"]
+    assert set(allowed) == set(ns)
+    for name in allowed:
+        assert hs.status_for(ns[name]("x")) != 500, name

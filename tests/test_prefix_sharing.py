@@ -304,6 +304,29 @@ class TestRefcountChaos:
                 assert futs[i].result().tokens == ref[i]
         self._assert_clean(eng)
 
+    def test_alloc_raise_does_not_strand_prefix_refs(
+            self, metrics, monkeypatch):
+        # ISSUE 18 (resource-discipline lint): admission acquires the
+        # shared prefix BEFORE claiming private pages — alloc (or the
+        # sizing arithmetic) raising used to strand those read-only
+        # refcounts forever, pinning the chain against eviction
+        eng = make_engine3("on")
+        _drain(eng, [SHARED_PROMPTS[0]])       # publish the BASE chain
+        before = eng.kv.refcounts()
+
+        def pool_fault(n):
+            raise RuntimeError("pool fault")
+
+        monkeypatch.setattr(eng.kv, "alloc", pool_fault)
+        fut = eng.submit(serving.GenerationRequest(SHARED_PROMPTS[1],
+                                                   max_new_tokens=3))
+        with pytest.raises(RuntimeError, match="pool fault"):
+            eng._admit()
+        with pytest.raises(RuntimeError, match="pool fault"):
+            fut.result(timeout=1)
+        # the acquired chain's refcounts rolled back to published-idle
+        assert eng.kv.refcounts() == before == {}
+
     def test_watchdog_replay_reacquires_prefix(self, metrics):
         from paddle_tpu import observability as obs
         ref = _drain(make_engine3("off"), SHARED_PROMPTS[:2])

@@ -356,6 +356,26 @@ class TestPsFaultInjection:
         assert br.state == "closed"
         client.create_table("t", np.zeros((2, 2), np.float32))  # admitted
 
+    def test_injected_fault_during_probe_frees_breaker(self, monkeypatch):
+        ps = _fake_rpc_client(monkeypatch)
+
+        # ISSUE 18: the ps.call fault seam sits INSIDE the breaker's
+        # record try now — a non-transport injected fault used to escape
+        # between before_call() and the rpc with the half-open probe
+        # still out, wedging the breaker half-open forever (found by the
+        # resource-discipline lint)
+        br = resil.breaker_for("ps/srv", failure_threshold=1, cooldown=0.0)
+        br.before_call(); br.record_failure()
+        assert br.state == "open"
+        client = ps.PsClient("srv", retry_timeout=5.0)
+        sched = resil.FaultSchedule().error("ps.call", on=(1,),
+                                            error=RuntimeError)
+        with resil.installed(sched):
+            with pytest.raises(RuntimeError):
+                client.create_table("t", np.zeros((2, 2), np.float32))
+        assert br.state == "closed"
+        client.create_table("t", np.zeros((2, 2), np.float32))  # admitted
+
     def test_breaker_only_exhaustion_raises_transport_error(
             self, monkeypatch):
         ps = _fake_rpc_client(monkeypatch)

@@ -600,6 +600,85 @@ class TestFaults:
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 18: admission/drain containment — fixes found by the
+# resource-discipline lint pass. An unexpected raise cutting through
+# admission or drain must not strand futures, leak pages, or drop
+# queued requests.
+# ---------------------------------------------------------------------------
+
+class TestAdmissionContainment:
+    def test_admit_one_raise_fails_current_and_requeues_tail(self, metrics):
+        eng = make_engine()
+        futs = [eng.submit(serving.GenerationRequest(p, max_new_tokens=3))
+                for p in PROMPTS[:3]]
+        real = eng._admit_one
+        calls = []
+
+        def flaky(pending):
+            calls.append(pending)
+            if len(calls) == 2:
+                raise RuntimeError("admission bug")
+            return real(pending)
+
+        eng._admit_one = flaky
+        try:
+            with pytest.raises(RuntimeError, match="admission bug"):
+                eng._admit()
+        finally:
+            eng._admit_one = real
+        # first admitted, second's future carries the bug, third went
+        # back in order — nothing stranded, nothing dropped
+        assert len(eng._slots) == 1 and not futs[0].done()
+        with pytest.raises(RuntimeError, match="admission bug"):
+            futs[1].result(timeout=1)
+        assert not futs[2].done()
+        assert eng._admit() is True
+        assert len(eng._slots) == 2
+
+    def test_host_tail_raise_is_contained_as_failed_admission(
+            self, metrics, monkeypatch):
+        eng = make_engine()
+        free0 = eng.kv.free_pages
+
+        def wedged(*a, **k):
+            raise RuntimeError("host sync wedged")
+
+        monkeypatch.setattr(eng, "_set_pool", wedged)
+        fut = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                   max_new_tokens=3))
+        # the pool swap / first-token host read raising is just another
+        # failed admission: pages freed, future resolved, no slot
+        assert eng._admit() is False
+        with pytest.raises(RuntimeError, match="host sync wedged"):
+            fut.result(timeout=1)
+        assert eng.kv.free_pages == free0 and eng._slots == []
+        assert obs.snapshot()["serving.requests_total"][
+            "status=failed"] == 1.0
+
+    def test_drain_fail_settles_futures_before_telemetry(
+            self, metrics, monkeypatch):
+        from paddle_tpu.serving import engine as engine_mod
+        eng = make_engine()
+        fut = eng.submit(serving.GenerationRequest(PROMPTS[0],
+                                                   max_new_tokens=3))
+
+        class _DownObs:
+            def __getattr__(self, name):
+                return getattr(obs, name)
+
+            def inc(self, *a, **k):
+                raise RuntimeError("metrics sink down")
+
+        monkeypatch.setattr(engine_mod, "_obs", _DownObs())
+        # the straggler sweep's contract is "no Future stays stranded":
+        # the queued request's future is settled even though the very
+        # first telemetry call blows up
+        with pytest.raises(RuntimeError, match="metrics sink down"):
+            eng._resolve_stragglers("fail")
+        assert isinstance(fut.exception(timeout=1), serving.EngineStopped)
+
+
+# ---------------------------------------------------------------------------
 # ISSUE 8: deadlines, load shedding, queue-wait accounting
 # ---------------------------------------------------------------------------
 

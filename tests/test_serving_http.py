@@ -489,6 +489,32 @@ class TestRouter:
         assert engines[other].queue_depth == 0
         router.stop(drain=True, timeout=5)
 
+    def test_malformed_request_returns_half_open_probe(self, metrics):
+        # ISSUE 18 (resource-discipline lint): a ValueError out of
+        # Engine.submit means the replica ANSWERED — validated and
+        # rejected. The breaker's half-open probe must come back on that
+        # arm like QueueFull's, or one malformed client request against
+        # a recovering replica wedges it half-open forever
+        router, _engines = make_router(
+            k=1, router_kw={"breaker_threshold": 1,
+                            "breaker_cooldown": 0.0})
+        br = router._replicas["a"].breaker
+        br.before_call(); br.record_failure()
+        assert br.state == "open"
+        import test_serving as ts
+        with pytest.raises(ValueError, match="max_len"):
+            router.submit(serving.GenerationRequest(
+                np.zeros(ts.M, np.int32), max_new_tokens=1))
+        assert br.state == "closed"
+        fut = router.submit(serving.GenerationRequest(
+            PROMPTS[0], max_new_tokens=3))     # rotation is live again
+        router.start()
+        try:
+            assert fut.result(timeout=20).tokens == \
+                dense_reference(PROMPTS[0], 3)
+        finally:
+            router.stop(drain=True, timeout=10)
+
     def test_duplicate_beacons_rejected(self):
         # two UNNAMED engines share the process-global "serving.engine"
         # beacon — one wedging would be masked by the other's beats, so

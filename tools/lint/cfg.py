@@ -1,0 +1,537 @@
+"""Intraprocedural control-flow graphs over Python ASTs (graft-lint 4.0).
+
+Why a CFG layer
+---------------
+graft-lint 1.0-3.0 reason about *what* a function mentions (calls, locks,
+globals) but not *in which order along which path*.  Exception-flow and
+resource-ownership questions ("is every allocated KV page freed on every
+path, including the path where the prefill program raises?") are inherently
+path questions, so PR 18 adds this small, reusable CFG builder.  It is a
+lint-grade CFG, not an interpreter:
+
+- Every function body becomes a graph of :class:`Block`\\ s.  A block holds a
+  list of ``ast.stmt`` nodes (compound statements appear in the block where
+  their header/test evaluates; their suites get their own blocks).
+- Edges carry a ``kind`` string: ``next``, ``true``/``false`` (branches),
+  ``case`` (match arms), ``back`` (loop back-edge), ``break``/``continue``,
+  ``except`` (a statement in the source block may raise and control lands at
+  the target), ``raise`` (an explicit ``raise`` statement), ``return``
+  (explicit *and* implicit fall-off-the-end return).
+- Three synthetic blocks exist on every CFG: ``entry``, ``exit`` (normal
+  return) and ``raise_exit`` (exception leaves the function).
+- ``try``/``except``/``else`` is modelled with block-level ``except`` edges
+  from every statement-bearing block of the protected suite to each handler
+  entry; if no handler is a catch-all (bare / ``Exception`` /
+  ``BaseException``) the exception may also propagate outward.
+- A bare ``raise`` inside a handler re-raises exactly the types that handler
+  caught, so its ``raise`` edges are *typed*: an enclosing handler naming one
+  of those types exactly (or catching everything) definitely stops it, and
+  the blind propagate-outward edge is dropped.  Handlers with other names
+  stay targets (they may catch a subclass relation this layer cannot see).
+- ``finally`` suites are *cloned* per continuation (normal, exceptional,
+  and each ``return``/``break``/``continue`` that unwinds through them), the
+  way compilers lower them.  This keeps paths real: a normal-path traversal
+  never exits through the exceptional copy of a ``finally``.
+- ``with`` bodies are ordinary blocks (``__exit__`` is assumed to re-raise);
+  the ``with`` statement itself sits in the preceding block, so analyses can
+  special-case context-managed acquisitions (all-paths release).
+
+Invariant relied on by analyses: the enclosing frame stack (try/finally/
+loop) is constant across all statements of any single block, so block-level
+``except`` edges are sound for every statement in the block.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["Block", "CFG", "build_cfg", "iter_cfgs"]
+
+EDGE_KINDS = frozenset({
+    "next", "true", "false", "case", "back",
+    "break", "continue", "except", "raise", "return",
+})
+
+_CATCH_ALL_NAMES = ("Exception", "BaseException")
+
+
+class Block:
+    """A run of statements with a single frame context.
+
+    ``stmts`` holds the original ``ast.stmt`` nodes (never copies), so every
+    block keys straight back into the tree the caller parsed.
+    """
+
+    __slots__ = ("bid", "label", "stmts", "succs", "handler_types")
+
+    def __init__(self, bid: int, label: str = "") -> None:
+        self.bid = bid
+        self.label = label
+        self.stmts: List[ast.stmt] = []
+        self.succs: List[Tuple[int, str]] = []
+        #: for handler-entry blocks: the caught exception names (last
+        #: dotted components; ("*",) for bare except). None elsewhere.
+        #: Analyses use it to skip edges into handlers that can only
+        #: catch exceptions the modelled state cannot be carrying.
+        self.handler_types: Optional[Tuple[str, ...]] = None
+
+    def edge(self, target: int, kind: str) -> None:
+        assert kind in EDGE_KINDS, kind
+        if (target, kind) not in self.succs:
+            self.succs.append((target, kind))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        lines = [getattr(s, "lineno", "?") for s in self.stmts]
+        return (f"Block({self.bid}{':' + self.label if self.label else ''}"
+                f" lines={lines} succs={self.succs})")
+
+
+class CFG:
+    """Control-flow graph of one function body."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.blocks: Dict[int, Block] = {}
+        self._next = 0
+        self.entry = self.new_block("entry").bid
+        self.exit = self.new_block("exit").bid
+        self.raise_exit = self.new_block("raise").bid
+
+    # -- construction --------------------------------------------------
+    def new_block(self, label: str = "") -> Block:
+        b = Block(self._next, label)
+        self._next += 1
+        self.blocks[b.bid] = b
+        return b
+
+    # -- queries -------------------------------------------------------
+    def block(self, bid: int) -> Block:
+        return self.blocks[bid]
+
+    def edges(self) -> Iterator[Tuple[int, int, str]]:
+        for b in self.blocks.values():
+            for tgt, kind in b.succs:
+                yield (b.bid, tgt, kind)
+
+    def preds(self, bid: int) -> List[Tuple[int, str]]:
+        return [(b.bid, kind) for b in self.blocks.values()
+                for tgt, kind in b.succs if tgt == bid]
+
+    def reachable(self) -> frozenset:
+        """Block ids reachable from ``entry`` over any edge kind."""
+        seen = {self.entry}
+        stack = [self.entry]
+        while stack:
+            for tgt, _ in self.blocks[stack.pop()].succs:
+                if tgt not in seen:
+                    seen.add(tgt)
+                    stack.append(tgt)
+        return frozenset(seen)
+
+    def orphan_blocks(self) -> List[Block]:
+        """Blocks not reachable from entry (exit blocks excluded).
+
+        A well-formed build of a function without dead code has none; this
+        is the property pinned over ``paddle_tpu/serving/`` in tier-1.
+        """
+        live = self.reachable()
+        return [b for b in self.blocks.values()
+                if b.bid not in live
+                and b.bid not in (self.exit, self.raise_exit)]
+
+    def blocks_with(self, node: ast.stmt) -> List[Block]:
+        """Blocks whose statement list contains ``node`` (clones included)."""
+        return [b for b in self.blocks.values() if node in b.stmts]
+
+    # -- cleanup -------------------------------------------------------
+    def prune(self) -> None:
+        """Drop empty, predecessor-less utility blocks (dead joins).
+
+        Join/after blocks are created eagerly during the build; when both
+        branches of an ``if`` return, or a ``while True`` has no ``break``,
+        the join is never wired.  Statement-bearing blocks are never pruned
+        (genuinely dead code stays visible as an orphan).
+        """
+        changed = True
+        while changed:
+            changed = False
+            has_pred = {tgt for b in self.blocks.values() for tgt, _ in b.succs}
+            for bid in list(self.blocks):
+                b = self.blocks[bid]
+                if bid in (self.entry, self.exit, self.raise_exit):
+                    continue
+                if not b.stmts and bid not in has_pred:
+                    del self.blocks[bid]
+                    changed = True
+
+
+class _LoopFrame:
+    __slots__ = ("cont", "brk")
+
+    def __init__(self, cont: int, brk: int) -> None:
+        self.cont = cont
+        self.brk = brk
+
+
+class _TryFrame:
+    __slots__ = ("handler_bids", "catch_all")
+
+    def __init__(self, handler_bids: List[int], catch_all: bool) -> None:
+        self.handler_bids = handler_bids
+        self.catch_all = catch_all
+
+
+class _FinallyFrame:
+    __slots__ = ("stmts", "exc_clone")
+
+    def __init__(self, stmts: List[ast.stmt]) -> None:
+        self.stmts = stmts
+        self.exc_clone: Optional[int] = None
+
+
+def _handler_type_names(handler: ast.ExceptHandler) -> Tuple[str, ...]:
+    t = handler.type
+    if t is None:
+        return ("*",)
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = []
+    # last dotted component is enough: analyses match simple names
+    for e in elts:
+        if isinstance(e, ast.Name):
+            names.append(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.append(e.attr)
+        else:
+            names.append("*")  # computed type: match anything
+    return tuple(names)
+
+
+def _is_catch_all(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in _CATCH_ALL_NAMES:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _CATCH_ALL_NAMES
+                   for e in t.elts)
+    return False
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST) -> None:
+        self.cfg = CFG(getattr(fn, "name", "<lambda>"))
+        self.frames: list = []
+        #: caught-type names of the handler bodies currently being visited
+        #: (innermost last); lets bare ``raise`` take typed targets
+        self.handler_ctx: List[Tuple[str, ...]] = []
+        first = self.cfg.new_block()
+        self.cfg.block(self.cfg.entry).edge(first.bid, "next")
+        self.cur: Optional[Block] = first
+
+    # -- plumbing ------------------------------------------------------
+    def _ensure_cur(self) -> Block:
+        if self.cur is None:
+            # dead code after an abrupt exit: give it a home so it stays
+            # visible (it will show up as an orphan block).
+            self.cur = self.cfg.new_block("dead")
+        return self.cur
+
+    def _append(self, node: ast.stmt) -> Block:
+        b = self._ensure_cur()
+        if not b.stmts:
+            for tgt in self._exc_targets(len(self.frames) - 1):
+                b.edge(tgt, "except")
+        b.stmts.append(node)
+        return b
+
+    def _exc_targets(self, i: int) -> List[int]:
+        """Where an exception raised under ``frames[:i+1]`` can land."""
+        while i >= 0:
+            f = self.frames[i]
+            if isinstance(f, _TryFrame):
+                out = list(f.handler_bids)
+                if not f.catch_all:
+                    out.extend(self._exc_targets(i - 1))
+                return out
+            if isinstance(f, _FinallyFrame):
+                if f.exc_clone is None:
+                    f.exc_clone = self._clone_suite(
+                        f.stmts, i, self._exc_targets(i - 1), "raise")
+                return [f.exc_clone]
+            i -= 1
+        return [self.cfg.raise_exit]
+
+    def _typed_exc_targets(self, i: int, types: Tuple[str, ...]) -> List[int]:
+        """Where a re-raise of exactly ``types`` can land.
+
+        Used for a bare ``raise`` in a handler body, where the in-flight
+        types are known.  An enclosing handler naming a type exactly — or
+        catching everything — definitely stops that type.  A handler with a
+        different name may still catch it through a subclass relation this
+        layer cannot see, so it stays a target but propagation continues.
+        """
+        pending = list(types)
+        out: List[int] = []
+        while i >= 0 and pending:
+            f = self.frames[i]
+            if isinstance(f, _TryFrame):
+                still: List[str] = []
+                for t in pending:
+                    stopped = False
+                    for hb in f.handler_bids:
+                        names = self.cfg.block(hb).handler_types or ("*",)
+                        if ("*" in names or t in names or
+                                any(n in _CATCH_ALL_NAMES for n in names)):
+                            if hb not in out:
+                                out.append(hb)
+                            stopped = True
+                            break
+                        if hb not in out:  # possible subclass catch
+                            out.append(hb)
+                    if not stopped:
+                        still.append(t)
+                pending = still
+            elif isinstance(f, _FinallyFrame):
+                # type information does not survive a finally clone — the
+                # clone's continuation was built with blind targets
+                if f.exc_clone is None:
+                    f.exc_clone = self._clone_suite(
+                        f.stmts, i, self._exc_targets(i - 1), "raise")
+                return out + [f.exc_clone]
+            i -= 1
+        if pending:
+            out.append(self.cfg.raise_exit)
+        return out
+
+    def _clone_suite(self, stmts: List[ast.stmt], context_len: int,
+                     targets: List[int], kind: str) -> int:
+        """Build a copy of a ``finally`` suite for one continuation."""
+        saved_cur, saved_frames = self.cur, self.frames
+        self.frames = list(self.frames[:context_len])
+        entry = self.cfg.new_block("finally")
+        self.cur = entry
+        for s in stmts:
+            self._visit(s)
+        if self.cur is not None:
+            for t in targets:
+                self.cur.edge(t, kind)
+        self.cur, self.frames = saved_cur, saved_frames
+        return entry.bid
+
+    def _unwind(self, final_target: int, kind: str,
+                stop_at_loop: bool = False) -> int:
+        """Chain ``finally`` clones for an abrupt exit; return first hop."""
+        lo = 0
+        if stop_at_loop:
+            for i in range(len(self.frames) - 1, -1, -1):
+                if isinstance(self.frames[i], _LoopFrame):
+                    lo = i + 1
+                    break
+        target = final_target
+        for i in range(lo, len(self.frames)):
+            f = self.frames[i]
+            if isinstance(f, _FinallyFrame):
+                target = self._clone_suite(f.stmts, i, [target], kind)
+        return target
+
+    # -- statement dispatch -------------------------------------------
+    def _visit(self, node: ast.stmt) -> None:
+        meth = getattr(self, "visit_" + type(node).__name__, None)
+        if meth is not None:
+            meth(node)
+        else:
+            self._append(node)
+
+    def visit_body(self, stmts: List[ast.stmt]) -> None:
+        for s in stmts:
+            self._visit(s)
+
+    # -- simple abrupt statements -------------------------------------
+    def visit_Return(self, node: ast.Return) -> None:
+        b = self._append(node)
+        b.edge(self._unwind(self.cfg.exit, "return"), "return")
+        self.cur = None
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        b = self._append(node)
+        ctx = self.handler_ctx[-1] if self.handler_ctx else None
+        if node.exc is None and ctx and "*" not in ctx:
+            targets = self._typed_exc_targets(len(self.frames) - 1, ctx)
+        else:
+            targets = self._exc_targets(len(self.frames) - 1)
+        for t in targets:
+            b.edge(t, "raise")
+        self.cur = None
+
+    def visit_Break(self, node: ast.Break) -> None:
+        b = self._append(node)
+        brk = next((f.brk for f in reversed(self.frames)
+                    if isinstance(f, _LoopFrame)), self.cfg.exit)
+        b.edge(self._unwind(brk, "break", stop_at_loop=True), "break")
+        self.cur = None
+
+    def visit_Continue(self, node: ast.Continue) -> None:
+        b = self._append(node)
+        cont = next((f.cont for f in reversed(self.frames)
+                     if isinstance(f, _LoopFrame)), self.cfg.exit)
+        b.edge(self._unwind(cont, "continue", stop_at_loop=True), "continue")
+        self.cur = None
+
+    # -- branches ------------------------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        src = self._append(node)
+        join = self.cfg.new_block()
+        then_b = self.cfg.new_block()
+        src.edge(then_b.bid, "true")
+        self.cur = then_b
+        self.visit_body(node.body)
+        if self.cur is not None:
+            self.cur.edge(join.bid, "next")
+        if node.orelse:
+            else_b = self.cfg.new_block()
+            src.edge(else_b.bid, "false")
+            self.cur = else_b
+            self.visit_body(node.orelse)
+            if self.cur is not None:
+                self.cur.edge(join.bid, "next")
+        else:
+            src.edge(join.bid, "false")
+        self.cur = join
+
+    def visit_Match(self, node: ast.stmt) -> None:
+        src = self._append(node)
+        join = self.cfg.new_block()
+        for case in node.cases:
+            cb = self.cfg.new_block()
+            src.edge(cb.bid, "case")
+            self.cur = cb
+            self.visit_body(case.body)
+            if self.cur is not None:
+                self.cur.edge(join.bid, "next")
+        src.edge(join.bid, "false")  # no arm matched
+        self.cur = join
+
+    # -- loops ---------------------------------------------------------
+    def _loop(self, node: ast.stmt, const_true: bool) -> None:
+        header = self.cfg.new_block("loop")
+        self._ensure_cur().edge(header.bid, "next")
+        self.cur = header
+        self._append(node)  # header/test evaluates here (wires except edges)
+        after = self.cfg.new_block()
+        body = self.cfg.new_block()
+        header.edge(body.bid, "true")
+        self.frames.append(_LoopFrame(header.bid, after.bid))
+        self.cur = body
+        self.visit_body(node.body)
+        if self.cur is not None:
+            self.cur.edge(header.bid, "back")
+        self.frames.pop()
+        if not const_true:
+            if node.orelse:
+                eb = self.cfg.new_block()
+                header.edge(eb.bid, "false")
+                self.cur = eb
+                self.visit_body(node.orelse)
+                if self.cur is not None:
+                    self.cur.edge(after.bid, "next")
+            else:
+                header.edge(after.bid, "false")
+        self.cur = after
+
+    def visit_While(self, node: ast.While) -> None:
+        const_true = isinstance(node.test, ast.Constant) and bool(node.test.value)
+        self._loop(node, const_true)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._loop(node, False)
+
+    visit_AsyncFor = visit_For
+
+    # -- with ----------------------------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        self._append(node)  # context managers evaluate here
+        body = self.cfg.new_block()
+        self._ensure_cur().edge(body.bid, "next")
+        self.cur = body
+        self.visit_body(node.body)
+        if self.cur is not None:
+            after = self.cfg.new_block()
+            self.cur.edge(after.bid, "next")
+            self.cur = after
+        else:
+            self.cur = None
+
+    visit_AsyncWith = visit_With
+
+    # -- try -----------------------------------------------------------
+    def visit_Try(self, node: ast.Try) -> None:
+        fin = _FinallyFrame(node.finalbody) if node.finalbody else None
+        if fin is not None:
+            self.frames.append(fin)
+        h_blocks = [self.cfg.new_block("handler") for _ in node.handlers]
+        for handler, hb in zip(node.handlers, h_blocks):
+            hb.handler_types = _handler_type_names(handler)
+        catch_all = any(_is_catch_all(h) for h in node.handlers)
+        body_entry = self.cfg.new_block()
+        self._ensure_cur().edge(body_entry.bid, "next")
+        self.frames.append(_TryFrame([b.bid for b in h_blocks], catch_all))
+        self.cur = body_entry
+        self.visit_body(node.body)
+        self.frames.pop()  # the handlers no longer cover else/handler suites
+        if self.cur is not None and node.orelse:
+            eb = self.cfg.new_block()
+            self.cur.edge(eb.bid, "next")
+            self.cur = eb
+            self.visit_body(node.orelse)
+        ends = [self.cur] if self.cur is not None else []
+        for handler, hb in zip(node.handlers, h_blocks):
+            self.cur = hb
+            self.handler_ctx.append(hb.handler_types or ("*",))
+            self.visit_body(handler.body)
+            self.handler_ctx.pop()
+            if self.cur is not None:
+                ends.append(self.cur)
+        join = self.cfg.new_block()
+        for e in ends:
+            e.edge(join.bid, "next")
+        self.cur = join if ends else None
+        if fin is not None:
+            self.frames.pop()
+            if self.cur is not None:
+                # the normal-continuation copy of the finally suite runs
+                # inline on the join path
+                self.visit_body(node.finalbody)
+
+    visit_TryStar = visit_Try
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """Build the CFG of one ``FunctionDef``/``AsyncFunctionDef`` body.
+
+    Nested function/class definitions are single statements of the enclosing
+    graph (their bodies are separate CFGs via :func:`iter_cfgs`).
+    """
+    builder = _Builder(fn)
+    builder.visit_body(fn.body)
+    if builder.cur is not None:  # implicit `return None` off the end
+        builder.cur.edge(builder.cfg.exit, "return")
+    builder.cfg.prune()
+    return builder.cfg
+
+
+def iter_cfgs(tree: ast.AST) -> Iterator[Tuple[str, ast.AST, CFG]]:
+    """Yield ``(qualname, fn_node, cfg)`` for every def in a module tree.
+
+    Qualnames follow the summary layer's convention: ``Class.method`` for
+    methods, ``outer.inner`` for nested defs.
+    """
+    def rec(node: ast.AST, prefix: str) -> Iterator[Tuple[str, ast.AST, CFG]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield (qual, child, build_cfg(child))
+                yield from rec(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, f"{prefix}{child.name}.")
+    yield from rec(tree, "")

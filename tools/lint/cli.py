@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rewrite the baseline from current findings "
                         "(preserves existing reasons; new entries get a "
                         "TODO reason to force review)")
+    p.add_argument("--prune-baseline", action="store_true",
+                   help="delete baseline entries that no longer fire (and "
+                        "lower over-counted ones), printing each removal. "
+                        "Full default runs only: a run narrowed by paths/"
+                        "--changed-only/--rules cannot tell a fixed "
+                        "finding from one it never looked at")
     p.add_argument("--cache-file", default=None,
                    help=f"summary/findings cache "
                         f"(default: {default_cache_path()})")
@@ -150,6 +156,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   f"(see --list-rules)", file=sys.stderr)
             return 2
 
+    if args.prune_baseline and (args.paths or args.changed_only or
+                                args.rules or args.no_baseline or
+                                args.update_baseline):
+        print("--prune-baseline requires a full default run: with paths, "
+              "--changed-only, --rules, --no-baseline or --update-baseline "
+              "in play, a non-firing entry may just be one this run never "
+              "looked at", file=sys.stderr)
+        return 2
+
     for p in args.paths:
         if not iter_python_files([p]):
             # a renamed/typo'd path must not silently go green — that is
@@ -175,6 +190,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       changed_only=args.changed_only,
                       diff_base=args.diff_base,
                       cache_path=cache_path)
+
+    if args.prune_baseline:
+        # result.stale is exactly the non-firing budget of this (full)
+        # run; entries for files that failed to read/parse produced no
+        # findings for the wrong reason and are never pruned
+        failed = set(result.failed_files)
+        stale_by_key = {(e["path"], e["rule"], e["message"]): e["unused"]
+                        for e in result.stale if e["path"] not in failed}
+        kept, removed, lowered = [], 0, 0
+        for e in load_baseline(baseline_path):
+            k = (e["path"], e["rule"], e["message"])
+            unused = stale_by_key.pop(k, 0)
+            count = int(e.get("count", 1))
+            if unused >= count:
+                print(f"pruned: {e['path']}: {e['rule']} x{count}: "
+                      f"{e['message'][:70]}")
+                removed += 1
+                continue
+            if unused:
+                print(f"lowered: {e['path']}: {e['rule']} "
+                      f"x{count} -> x{count - unused}")
+                e = dict(e, count=count - unused)
+                lowered += 1
+            kept.append(e)
+        if removed or lowered:
+            save_baseline(baseline_path, kept)
+        print(f"pruned {removed}, lowered {lowered}, kept {len(kept)} "
+              f"baseline entr{'y' if len(kept) == 1 else 'ies'}")
+        return 0 if result.clean else 1
 
     if args.update_baseline:
         # regenerate only what this run could SEE: entries for unscanned

@@ -40,8 +40,10 @@ class Finding:
     rule: str
     message: str
     # optional structured witness locations ((path, line, message) dicts):
-    # the SARIF exporter renders them as relatedLocations; excluded from
-    # as_dict()/key() so cache round-trips and baselines are unchanged
+    # rendered as SARIF relatedLocations, indented lines in text, and a
+    # "related" list in JSON; excluded from key() so baselines match on
+    # the finding alone and an edit that shifts a witness line does not
+    # orphan the entry
     related: Tuple = ()
 
     def key(self) -> Tuple[str, str, str]:
@@ -49,11 +51,17 @@ class Finding:
         return (self.path, self.rule, self.message)
 
     def text(self) -> str:
-        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+        out = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        for r in self.related:
+            out += f"\n    {r['path']}:{r['line']}: {r.get('message', '')}"
+        return out
 
     def as_dict(self) -> Dict[str, Any]:
-        return {"path": self.path, "line": self.line, "rule": self.rule,
-                "message": self.message}
+        d: Dict[str, Any] = {"path": self.path, "line": self.line,
+                             "rule": self.rule, "message": self.message}
+        if self.related:
+            d["related"] = [dict(r) for r in self.related]
+        return d
 
 
 class FileContext:
@@ -282,6 +290,91 @@ DEFAULT_CONFIG: Dict[str, Any] = {
             "paddle_tpu.static", "paddle_tpu.inference", "paddle_tpu.onnx",
             "paddle_tpu.hub", "paddle_tpu"]},
     ],
+    # exception-contract (ISSUE 18): the declared failure surface of every
+    # entry root — path pattern -> {qualname: [allowed exception types]}.
+    # A type is allowed if ANY listed name is among its ancestors, so
+    # "EngineStopped" admits DrainTimeout and "ConnectionError" admits
+    # BreakerOpen/NoHealthyReplica. The serving tables are the lint-side
+    # mirror of http.py::_STATUS_MAP: adding a typed exception to one
+    # without the other is a finding (MIGRATING, "Failure-surface
+    # invariants").
+    "exception_contracts": {
+        "paddle_tpu/serving/http.py": {
+            # the HTTP handlers map EVERYTHING through _STATUS_MAP; a raise
+            # escaping them tears down the connection thread instead of
+            # answering, so their contract is empty
+            "_Handler.do_GET": [],
+            "_Handler.do_POST": [],
+        },
+        "paddle_tpu/serving/router.py": {
+            "Router.submit": [
+                "QueueFull", "DeadlineExceeded", "EngineStopped",
+                "NoHealthyReplica", "ConnectionError", "ValueError",
+            ],
+        },
+        "paddle_tpu/serving/engine.py": {
+            "Engine.submit": [
+                "QueueFull", "DeadlineExceeded", "EngineStopped",
+                "ValueError",
+            ],
+            # stop() raises on caller mistakes (bad on_timeout, calling
+            # from the step thread) besides the documented DrainTimeout
+            "Engine.stop": ["DrainTimeout", "ValueError", "RuntimeError"],
+        },
+        "paddle_tpu/distributed/ps_service.py": {
+            # RPC service handlers: a raise here is serialized back to the
+            # client as a typed error envelope; KeyError covers unknown
+            # table names (mapped, not a transport fault)
+            "_srv_create": ["KeyError", "ValueError"],
+            "_srv_push": ["KeyError", "ValueError"],
+            "_srv_pull": ["KeyError", "ValueError"],
+            "_srv_stats": [],
+            "_srv_table_snapshot": ["KeyError", "ValueError"],
+            "_srv_create_sparse": ["KeyError", "ValueError"],
+            "_srv_push_sparse": ["KeyError", "ValueError"],
+            "_srv_pull_sparse": ["KeyError", "ValueError"],
+            "_srv_shrink": ["KeyError", "ValueError"],
+            "_srv_sparse_rows": ["KeyError", "ValueError"],
+            "_srv_save": ["KeyError", "ValueError", "OSError"],
+            "_srv_load": ["KeyError", "ValueError", "OSError"],
+        },
+        "paddle_tpu/resilience/trainer.py": {
+            "TrainingSupervisor.run": [
+                "TrainAborted", "NonFiniteLossError", "ValueError",
+            ],
+        },
+    },
+    # resource-discipline (ISSUE 18): acquire/release pairs whose pairing
+    # is verified per CFG path. "transfer" names callees that take over
+    # the obligation; "handleless" pairs match acquire/release on the
+    # receiver expression instead of a handle variable.
+    "resource_pairs": [
+        {"name": "kv-pages",
+         "acquire": ["PagedKVCache.alloc", "PagedKVCache.acquire_prefix"],
+         "release": ["PagedKVCache.free"],
+         # publish() moves pages into the shared prefix index (refcounted
+         # there); admission hands them to the slot table
+         "transfer": ["publish"]},
+        {"name": "sched-pending",
+         "acquire": ["Scheduler.next_admissions", "Scheduler.drain_queue"],
+         "release": ["Scheduler.requeue"],
+         # popped requests are discharged by resolving their futures
+         # (error or result); _admit_one takes ownership only on
+         # successful return, so its exception edge still holds the batch
+         "transfer": ["set_exception", "set_result"],
+         "fork_transfers": ["_admit_one"]},
+        {"name": "breaker-probe",
+         "acquire": ["CircuitBreaker.before_call"],
+         "release": ["CircuitBreaker.record_success",
+                     "CircuitBreaker.record_failure"],
+         "handleless": True,
+         # before_call raises BreakerOpen INSTEAD of taking the probe, so
+         # a handler catching only BreakerOpen can never hold one
+         "acquire_raises": ["BreakerOpen"]},
+    ],
+    # functions whose name ends with one of these own no obligations of
+    # their own — the caller holds the handle (mirrors lock_held_suffixes)
+    "resource_caller_owns_suffixes": ["_locked"],
 }
 
 
@@ -635,7 +728,7 @@ def run_lint(paths: Optional[Sequence[str]] = None,
             summaries[rel] = s
             if cache is not None:
                 cache.put_summary(rel, sha, s.to_dict())
-        project = Project(summaries, cfg)
+        project = Project(summaries, cfg, root=root)
         for rule in project_rules:
             for f in rule.check_project(project) or ():
                 s = summaries.get(f.path)

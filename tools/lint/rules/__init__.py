@@ -9,11 +9,13 @@ from . import (  # noqa: F401
     cross_host_sync,
     cross_trace_impurity,
     device_access,
+    exception_contract,
     hot_path_import,
     host_sync,
     import_layering,
     lock_order,
     naked_retry,
+    resource_discipline,
     shared_state_race,
     silent_swallow,
     span_discipline,

@@ -1,0 +1,187 @@
+"""exception-contract: typed failure surfaces, verified statically.
+
+PR 15 built the serving tier's typed-failure contract by hand: every
+exception escaping ``Router.submit``/``Engine.submit`` is mapped by
+``http.py::status_for`` (through ``_STATUS_MAP``) to an honest 429/503/504,
+and anything unmapped falls to a generic 500. That contract only held
+because every raise site had been read. This rule re-derives it on every
+lint run: per-function raise-sets (graft-lint 4.0 summaries) are propagated
+interprocedurally through the call graph — enclosing try/except handlers
+subtract the types they swallow, in CPython handler order, with bare
+``except``/``Exception`` widening to everything and re-raising handlers
+transparent — and every type that can escape a *declared entry root* must
+appear in that root's contract table (``exception_contracts`` in the lint
+config, seeded from ``_STATUS_MAP`` and the documented typed surfaces).
+
+A raise added three layers down (say ``kv_cache.py``) that would surface as
+an unexplained HTTP 500 becomes a lint finding with a witness call chain,
+not a chaos-test postmortem.
+
+Scope/soundness: only explicit ``raise`` statements count (implicit
+builtin exceptions — KeyError from a subscript, ZeroDivisionError — are
+out of scope); unresolved callees (stdlib, jax) contribute nothing.
+Subclass matching uses the summaries' class-base tables plus a small
+builtin hierarchy, so a contract naming ``EngineStopped`` also admits
+``DrainTimeout`` and catching ``OSError`` subtracts ``ConnectionError``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Iterator, List, Set, Tuple
+
+from ..astutil import path_matches
+from ..engine import Finding, ProjectRule, register_rule
+from ..wholeprogram.project import Project
+
+_CHAIN_CAP = 8
+
+#: never part of a typed failure surface: assertion-style invariant
+#: violations are programming errors that SHOULD crash loudly, not
+#: conditions a contract maps to a status code
+_ALWAYS_ALLOWED = frozenset({"AssertionError"})
+
+#: the slice of the builtin exception hierarchy this codebase raises/catches
+_BUILTIN_BASES: Dict[str, Tuple[str, ...]] = {
+    "BrokenPipeError": ("ConnectionError",),
+    "ConnectionAbortedError": ("ConnectionError",),
+    "ConnectionRefusedError": ("ConnectionError",),
+    "ConnectionResetError": ("ConnectionError",),
+    "ConnectionError": ("OSError",),
+    "TimeoutError": ("OSError",),
+    "FileNotFoundError": ("OSError",),
+    "FileExistsError": ("OSError",),
+    "PermissionError": ("OSError",),
+    "IsADirectoryError": ("OSError",),
+    "NotADirectoryError": ("OSError",),
+    "InterruptedError": ("OSError",),
+    "KeyError": ("LookupError",),
+    "IndexError": ("LookupError",),
+    "ZeroDivisionError": ("ArithmeticError",),
+    "OverflowError": ("ArithmeticError",),
+    "FloatingPointError": ("ArithmeticError",),
+    "RecursionError": ("RuntimeError",),
+    "NotImplementedError": ("RuntimeError",),
+    "UnicodeDecodeError": ("UnicodeError",),
+    "UnicodeEncodeError": ("UnicodeError",),
+    "UnicodeError": ("ValueError",),
+    "ModuleNotFoundError": ("ImportError",),
+}
+
+
+def _ancestry(project: Project, type_name: str) -> Set[str]:
+    """Simple names of ``type_name`` and every base reachable through the
+    project class tables and the builtin table."""
+    out: Set[str] = set()
+    stack = [type_name.split(".")[-1]]
+    while stack:
+        n = stack.pop()
+        if n in out:
+            continue
+        out.add(n)
+        for b in project.class_bases.get(n, ()):
+            stack.append(b.split(".")[-1])
+        stack.extend(_BUILTIN_BASES.get(n, ()))
+    return out
+
+
+def _caught(project: Project, context: Iterable, type_name: str) -> bool:
+    """Does the catch context swallow ``type_name``?
+
+    ``context`` is a list of try-groups innermost-first; each group is the
+    ordered handler list ``[[names], swallows]``. Within a group the FIRST
+    matching handler decides: swallowing -> caught; transparent (re-raise)
+    -> the exception skips the rest of the group and continues outward.
+    """
+    anc = _ancestry(project, type_name)
+    for group in context:
+        for names, swallows in group:
+            if names == ["*"] or \
+                    any(n.split(".")[-1] in anc for n in names):
+                if swallows:
+                    return True
+                break  # transparent: re-raised past this group
+    return False
+
+
+@register_rule
+class ExceptionContractRule(ProjectRule):
+    name = "exception-contract"
+    description = ("an exception type escaping a declared entry root "
+                   "(serving/training/RPC surface) is not in that root's "
+                   "declared contract")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        contracts = project.config.get("exception_contracts", {})
+        if not contracts:
+            return
+
+        # escaping-set propagation, memoized over the call graph. Values:
+        # simple type name -> (full name, witness chain of
+        # (module, qualname, line) from the queried function to the raise).
+        memo: Dict[Tuple[str, str], Dict[str, tuple]] = {}
+        on_stack: Set[Tuple[str, str]] = set()
+
+        def esc(mod: str, fi) -> Dict[str, tuple]:
+            key = (mod, fi.qualname)
+            if key in memo:
+                return memo[key]
+            if key in on_stack:   # recursion: cut the cycle conservatively
+                return {}
+            on_stack.add(key)
+            out: Dict[str, tuple] = {}
+            for rname, ctx, line in fi.raises:
+                t = rname.split(".")[-1]
+                if t in out or _caught(project, ctx, t):
+                    continue
+                out[t] = (rname, ((mod, fi.qualname, line),))
+            for dn, ctx, line in fi.call_catches:
+                for cm, cfi in project.resolve_call(mod, fi.cls, dn):
+                    for t, (full, chain) in esc(cm, cfi).items():
+                        if t in out or _caught(project, ctx, t):
+                            continue
+                        out[t] = (full, ((mod, fi.qualname, line),) + chain)
+            on_stack.discard(key)
+            memo[key] = out
+            return out
+
+        roots: List[tuple] = []
+        for s in sorted(project.by_path.values(), key=lambda s: s.path):
+            for pat, table in contracts.items():
+                if not path_matches(s.path, [pat]):
+                    continue
+                for spec, allowed in sorted(table.items()):
+                    fi = project.fn_by_qual.get((s.module, spec))
+                    if fi is not None:
+                        roots.append((s, spec, allowed, fi))
+
+        for s, spec, allowed, fi in roots:
+            escaping = esc(s.module, fi)
+            for t in sorted(escaping):
+                full, chain = escaping[t]
+                anc = _ancestry(project, t)
+                if anc & _ALWAYS_ALLOWED:
+                    continue
+                if any(a.split(".")[-1] in anc for a in allowed):
+                    continue
+                raise_mod, raise_qual, raise_line = chain[-1]
+                if project.modules[s.module].suppressed(self.name, fi.line):
+                    continue
+                raise_summary = project.modules.get(raise_mod)
+                if raise_summary is not None and \
+                        raise_summary.suppressed(self.name, raise_line):
+                    continue
+                shown = chain if len(chain) <= _CHAIN_CAP else (
+                    chain[:_CHAIN_CAP - 1] + (chain[-1],))
+                related = tuple(
+                    {"path": project.modules[cm].path, "line": cl,
+                     "message": f"witness: '{cq}'"}
+                    for cm, cq, cl in shown if cm in project.modules)
+                yield Finding(
+                    path=s.path, line=fi.line, rule=self.name,
+                    message=(
+                        f"'{full}' raised in '{raise_qual}' can escape the "
+                        f"declared entry root '{spec}' but is not in that "
+                        f"root's exception contract — catch/map it along "
+                        f"the chain, or add it to 'exception_contracts' "
+                        f"(and any paired status table) in the same "
+                        f"change"),
+                    related=related)

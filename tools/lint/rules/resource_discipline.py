@@ -1,0 +1,507 @@
+"""resource-discipline: acquire/release pairing verified across exception edges.
+
+The serving tier hands real resources around: KV pages come out of
+``PagedKVCache.alloc``/``acquire_prefix`` and must go back through ``free``
+(or move into a ``_Slot``/the prefix index), scheduler admissions popped by
+``next_admissions`` must be requeued or resolved, and a circuit breaker's
+half-open probe taken by ``before_call`` is only returned by
+``record_success``/``record_failure`` — leak that one and the breaker wedges
+half-open forever. PRs 7/8/17 police these only at runtime (double-free
+counters, chaos ``outstanding_pages == 0`` pins); this rule checks the
+discipline statically, per path.
+
+For every function that calls a configured acquire (``resource_pairs`` in
+the lint config; the whole-program summaries index which files acquire so a
+warm-cache run re-parses only those), the rule builds the function's CFG
+(:mod:`tools.lint.cfg`) and searches for a path from the acquire site to a
+function exit — the ``raise`` exit especially — on which the handle neither
+reaches a release call nor escapes ownership. Ownership escapes are:
+``return`` of the handle, storing it into an attribute/subscript, passing
+it to a constructor (capitalized callee) or a configured ``transfer``
+callee, appending it into a container (mutator methods), or capture by a
+nested ``def``. Aliases propagate through assignment/concatenation/
+``for``-targets; ``if h is None``-style guards kill the obligation on the
+branch where nothing was acquired. ``with ... as h`` acquisitions and
+``finally``-based releases are all-paths by construction (the CFG clones
+``finally`` suites per continuation). Functions matching
+``resource_caller_owns_suffixes`` (the ``*_locked`` convention) hand the
+obligation to their caller and are skipped, as are the methods of the
+classes that implement the pairs themselves.
+
+Pairs with ``"handleless": true`` (the breaker probe) have no handle
+variable; acquire and release are matched by receiver expression text
+(``rep.breaker.before_call()`` ... ``rep.breaker.record_failure()``).
+
+Witness paths come out as ``Finding.related`` (SARIF relatedLocations):
+the acquire site, the statement whose exception starts the leaking path,
+and the frontier where the path leaves the function.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from ..astutil import MUTATORS, dotted_name
+from ..cfg import CFG, iter_cfgs
+from ..engine import REPO_ROOT, Finding, ProjectRule, register_rule
+from ..wholeprogram.project import Project
+
+_PATH_CAP = 6
+#: calls through which a value keeps referring to the same elements
+_ALIAS_CALLS = ("list", "sorted", "tuple", "reversed")
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _alias_sources(v: ast.AST) -> Set[str]:
+    """Names whose value flows wholesale into ``v`` (alias-extending forms
+    only — ``len(h)`` is NOT an alias of ``h``, ``h + extra`` is)."""
+    if isinstance(v, ast.Name):
+        return {v.id}
+    if isinstance(v, ast.BinOp):
+        return _alias_sources(v.left) | _alias_sources(v.right)
+    if isinstance(v, (ast.List, ast.Tuple, ast.Set)):
+        out: Set[str] = set()
+        for e in v.elts:
+            out |= _alias_sources(e)
+        return out
+    if isinstance(v, ast.IfExp):
+        return _alias_sources(v.body) | _alias_sources(v.orelse)
+    if isinstance(v, (ast.Subscript, ast.Starred)):
+        return _alias_sources(v.value)
+    if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) and \
+            v.func.id in _ALIAS_CALLS:
+        out = set()
+        for a in v.args:
+            out |= _alias_sources(a)
+        return out
+    return set()
+
+
+def _headers(st: ast.stmt) -> List[ast.AST]:
+    """The expressions of ``st`` that execute in the block holding it.
+
+    Compound statements sit in the block where their header/test evaluates;
+    their suites live in other blocks, so only the header may have effects
+    here.
+    """
+    if isinstance(st, (ast.If, ast.While)):
+        return [st.test]
+    if isinstance(st, (ast.For, ast.AsyncFor)):
+        return [st.iter]
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in st.items]
+    if isinstance(st, ast.Match):
+        return [st.subject]
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return []
+    return [st]
+
+
+def _may_raise(st: ast.stmt) -> bool:
+    """Can executing ``st``'s header realistically raise?  Calls (the
+    dominant case), subscripts (KeyError/IndexError) and awaits; pure
+    name/arithmetic shuffling is treated as non-raising so that e.g.
+    ``pages = shared + pages`` between an acquire and its guarded region
+    does not manufacture an unfixable leak path."""
+    if isinstance(st, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(st, (ast.Pass, ast.Break, ast.Continue, ast.Global,
+                       ast.Nonlocal, ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+        return False
+    for e in _headers(st):
+        for n in ast.walk(e):
+            if isinstance(n, (ast.Call, ast.Subscript, ast.Await)):
+                return True
+    return False
+
+
+def _last_comp(func: ast.AST) -> Optional[str]:
+    dn = dotted_name(func)
+    if dn:
+        return dn.split(".")[-1]
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on real trees
+        return ""
+
+
+def _guard(test: ast.AST) -> Tuple[Optional[str], bool]:
+    """(guarded name, is-held-on-true-branch) for None/truthiness guards."""
+    if isinstance(test, ast.Name):
+        return test.id, True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) and \
+            isinstance(test.operand, ast.Name):
+        return test.operand.id, False
+    if isinstance(test, ast.Compare) and isinstance(test.left, ast.Name) \
+            and len(test.ops) == 1 and \
+            isinstance(test.comparators[0], ast.Constant) and \
+            test.comparators[0].value is None:
+        if isinstance(test.ops[0], ast.Is):
+            return test.left.id, False
+        if isinstance(test.ops[0], ast.IsNot):
+            return test.left.id, True
+    return None, True
+
+
+class _Site:
+    __slots__ = ("pair", "bid", "idx", "line", "aliases", "receiver", "acq")
+
+    def __init__(self, pair: dict, bid: int, idx: int, line: int,
+                 aliases: FrozenSet[str], receiver: Optional[str],
+                 acq: str) -> None:
+        self.pair = pair
+        self.bid = bid
+        self.idx = idx
+        self.line = line
+        self.aliases = aliases
+        self.receiver = receiver
+        self.acq = acq  # last component of the acquiring call, for messages
+
+
+def _apply(st: ast.stmt, aliases: FrozenSet[str], receiver: Optional[str],
+           pair: dict) -> Tuple[FrozenSet[str], bool, bool]:
+    """Effect of one statement: (new alias set, obligation discharged?,
+    discharged by a fork_transfers callee?).
+
+    The third flag marks discharges through callees configured as taking
+    ownership only on SUCCESSFUL return — the caller still forks the
+    held state down the statement's exception edge. Releases, plain
+    transfers, constructors and container stores are atomic: attempting
+    them discharges the obligation on every outcome.
+    """
+    rel = pair["_rel_last"]
+    transfer = pair.get("transfer", ())
+    fork_transfer = pair.get("fork_transfers", ())
+    handleless = pair.get("handleless", False)
+    for e in _headers(st):
+        for c in (n for n in ast.walk(e) if isinstance(n, ast.Call)):
+            last = _last_comp(c.func)
+            if last is None:
+                continue
+            if handleless:
+                if last in rel and isinstance(c.func, ast.Attribute) and \
+                        _expr_text(c.func.value) == receiver:
+                    return aliases, True, False
+                continue
+            arg_names: Set[str] = set()
+            for a in list(c.args) + [kw.value for kw in c.keywords]:
+                arg_names |= _names_in(a)
+            if last in rel and (arg_names & aliases):
+                return aliases, True, False
+            if last in transfer and (_names_in(c) & aliases):
+                return aliases, True, False
+            if last in fork_transfer and (_names_in(c) & aliases):
+                return aliases, True, True
+            if last.lstrip("_")[:1].isupper() and (arg_names & aliases):
+                return aliases, True, False  # constructor takes ownership
+            if last in MUTATORS and isinstance(c.func, ast.Attribute) and \
+                    (arg_names & aliases):
+                return aliases, True, False  # stored into a container
+    if handleless:
+        return aliases, False, False
+    if isinstance(st, ast.Return):
+        if st.value is not None and (_names_in(st.value) & aliases):
+            return aliases, True, False
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        if _names_in(st) & aliases:
+            return aliases, True, False  # closure capture escapes ownership
+    if isinstance(st, ast.Assign):
+        vnames = _names_in(st.value)
+        for t in st.targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                    (vnames & aliases):
+                return aliases, True, False  # stored on an object: escapes
+        src = _alias_sources(st.value)
+        new = set(aliases)
+        for t in st.targets:
+            elts = [t] if isinstance(t, ast.Name) else (
+                t.elts if isinstance(t, (ast.Tuple, ast.List)) else [])
+            for nt in elts:
+                if isinstance(nt, ast.Name):
+                    if src & aliases:
+                        new.add(nt.id)
+                    else:
+                        new.discard(nt.id)  # rebound away from the handle
+        aliases = frozenset(new)
+    elif isinstance(st, ast.AugAssign):
+        if isinstance(st.target, (ast.Attribute, ast.Subscript)) and \
+                (_names_in(st.value) & aliases):
+            return aliases, True, False
+        if isinstance(st.target, ast.Name) and \
+                (_alias_sources(st.value) & aliases):
+            aliases = aliases | {st.target.id}
+    elif isinstance(st, ast.AnnAssign) and st.value is not None:
+        if isinstance(st.target, (ast.Attribute, ast.Subscript)) and \
+                (_names_in(st.value) & aliases):
+            return aliases, True, False
+        if isinstance(st.target, ast.Name):
+            new = set(aliases)
+            if _alias_sources(st.value) & aliases:
+                new.add(st.target.id)
+            else:
+                new.discard(st.target.id)
+            aliases = frozenset(new)
+    elif isinstance(st, (ast.For, ast.AsyncFor)):
+        if isinstance(st.target, ast.Name) and \
+                (_alias_sources(st.iter) & aliases):
+            aliases = aliases | {st.target.id}
+    elif isinstance(st, ast.Delete):
+        new = set(aliases)
+        for t in st.targets:
+            if isinstance(t, ast.Name):
+                new.discard(t.id)
+        aliases = frozenset(new)
+    return aliases, False, False
+
+
+def _find_leak(cfg: CFG, site: _Site
+               ) -> Optional[Tuple[str, List[Tuple[int, str]]]]:
+    """BFS from just after the acquire; first path reaching an exit while
+    the obligation is still live wins (shortest witness). Returns
+    (exit kind, [(line, note), ...]) or None."""
+    seen: Set[Tuple[int, int, FrozenSet[str]]] = set()
+    queue: List[Tuple[int, int, FrozenSet[str], tuple]] = [
+        (site.bid, site.idx + 1, site.aliases, ())]
+    qi = 0
+    while qi < len(queue):
+        bid, idx, aliases, path = queue[qi]
+        qi += 1
+        key = (bid, idx, aliases)
+        if key in seen:
+            continue
+        seen.add(key)
+        if bid == cfg.raise_exit:
+            return "an exception path", list(path)
+        if bid == cfg.exit:
+            return "a normal path", list(path)
+        b = cfg.blocks[bid]
+        acq_raises = set(site.pair.get("acquire_raises", ()))
+
+        def infeasible(tgt: int) -> bool:
+            # a handler catching ONLY the exception the acquire itself
+            # raises on failure can never be entered with the resource
+            # held (the acquire raising means nothing was acquired)
+            ht = cfg.blocks[tgt].handler_types
+            return bool(acq_raises) and ht is not None and \
+                all(t.split(".")[-1] in acq_raises for t in ht)
+
+        discharged = False
+        i = idx
+        while i < len(b.stmts):
+            st = b.stmts[i]
+            pre = aliases
+            aliases, discharged, risky = _apply(st, aliases, site.receiver,
+                                                site.pair)
+            if _may_raise(st) and (not discharged or risky) and \
+                    not isinstance(st, ast.Raise):
+                # a Raise statement's flow is the block-end ``raise``
+                # edges (typed for a bare re-raise), not the blind
+                # block-level except wiring — forking both would send
+                # the held state straight past handlers that do catch
+                note = (getattr(st, "lineno", site.line),
+                        "still held if this statement raises")
+                for tgt, kind in b.succs:
+                    if kind == "except" and not infeasible(tgt):
+                        queue.append((tgt, 0, pre, path + (note,)))
+            if discharged:
+                break
+            i += 1
+        if discharged:
+            continue
+        if b.stmts:
+            note = (getattr(b.stmts[-1], "lineno", site.line),
+                    "path continues past here")
+            out_path = path + (note,)
+        else:
+            out_path = path
+        for tgt, kind in b.succs:
+            if kind == "except":
+                continue  # mid-statement forks were taken above
+            if kind == "raise" and infeasible(tgt):
+                continue
+            refined = aliases
+            if b.stmts and kind in ("true", "false"):
+                last = b.stmts[-1]
+                if isinstance(last, (ast.If, ast.While)):
+                    name, held_on_true = _guard(last.test)
+                    if name is not None and name in aliases:
+                        if (kind == "true") != held_on_true:
+                            continue  # guard proves nothing was acquired
+                if kind == "false" and \
+                        isinstance(last, (ast.For, ast.AsyncFor)):
+                    # loop exit: a loop over the handle has dispensed its
+                    # elements to the loop target (per-element obligations
+                    # were checked along the body's paths); an empty
+                    # collection never held anything
+                    srcs = _alias_sources(last.iter) & aliases
+                    tnames = {last.target.id} \
+                        if isinstance(last.target, ast.Name) else set()
+                    if srcs or (tnames & aliases):
+                        refined = aliases - srcs - tnames
+                        if not refined:
+                            continue  # fully dispensed
+            queue.append((tgt, 0, refined, out_path))
+    return None
+
+
+def _acquire_pair(expr: ast.AST, acq_last: Dict[str, dict]
+                  ) -> Optional[Tuple[ast.Call, dict]]:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            last = _last_comp(n.func)
+            if last is not None and last in acq_last:
+                return n, acq_last[last]
+    return None
+
+
+def _collect_sites(cfg: CFG, acq_last: Dict[str, dict]) -> List[_Site]:
+    sites: List[_Site] = []
+    seen: Set[Tuple[str, int]] = set()
+
+    def add(site: _Site) -> None:
+        k = (site.pair["name"], site.line)
+        if k not in seen:
+            seen.add(k)
+            sites.append(site)
+
+    for b in cfg.blocks.values():
+        for i, st in enumerate(b.stmts):
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                continue  # context-managed: released on all paths
+            if isinstance(st, ast.Return):
+                continue  # acquired-and-returned: caller owns
+            hit = None
+            for e in _headers(st):
+                hit = _acquire_pair(e, acq_last)
+                if hit:
+                    break
+            if not hit:
+                continue
+            call, pair = hit
+            line = getattr(call, "lineno", getattr(st, "lineno", 1))
+            acq = _last_comp(call.func) or "?"
+            if pair.get("handleless"):
+                if isinstance(call.func, ast.Attribute):
+                    add(_Site(pair, b.bid, i, line, frozenset(),
+                              _expr_text(call.func.value), acq))
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                if isinstance(st.target, ast.Name):
+                    # ``for h in acquire():`` dispenses the collection to
+                    # the loop target one element at a time
+                    add(_Site(pair, b.bid, i, line,
+                              frozenset({st.target.id}), None, acq))
+                continue
+            if isinstance(st, ast.Assign):
+                names: Set[str] = set()
+                stored = False
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names |= {e.id for e in t.elts
+                                  if isinstance(e, ast.Name)}
+                    else:
+                        stored = True  # self.x = alloc(): escapes at birth
+                if names and not stored:
+                    add(_Site(pair, b.bid, i, line, frozenset(names),
+                              None, acq))
+                continue
+            if isinstance(st, ast.AnnAssign) and \
+                    isinstance(st.target, ast.Name):
+                add(_Site(pair, b.bid, i, line,
+                          frozenset({st.target.id}), None, acq))
+                continue
+            if isinstance(st, ast.Expr) and st.value is hit[0]:
+                # handle-producing acquire whose result is discarded:
+                # nothing can ever free it
+                add(_Site(pair, b.bid, i, line, frozenset(), None, acq))
+            # acquire nested in another call/expression: the surrounding
+            # expression takes ownership (argument-pass escape)
+    return sites
+
+
+@register_rule
+class ResourceDisciplineRule(ProjectRule):
+    name = "resource-discipline"
+    description = ("a path (usually an exception edge) on which an acquired "
+                   "resource neither reaches its release nor escapes "
+                   "ownership")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        pairs = [dict(p) for p in project.config.get("resource_pairs", [])]
+        if not pairs:
+            return
+        suffixes = tuple(project.config.get(
+            "resource_caller_owns_suffixes", []))
+        acq_last: Dict[str, dict] = {}
+        exempt_quals: Set[str] = set()
+        exempt_classes: Set[str] = set()
+        for p in pairs:
+            p["_rel_last"] = {s.split(".")[-1] for s in p["release"]}
+            for spec in list(p["acquire"]) + list(p["release"]):
+                exempt_quals.add(spec)
+                if "." in spec:
+                    exempt_classes.add(spec.split(".")[0])
+            for s in p["acquire"]:
+                acq_last[s.split(".")[-1]] = p
+
+        root = project.root or REPO_ROOT
+        for s in sorted(project.by_path.values(), key=lambda s: s.path):
+            if not any(ev[0] == "acq"
+                       for fi in s.functions for ev in fi.resources):
+                continue
+            path = s.path if os.path.isabs(s.path) else \
+                os.path.join(root, s.path)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read())
+            except (OSError, SyntaxError):
+                continue
+            for qual, fn_node, cfg in iter_cfgs(tree):
+                name = qual.split(".")[-1]
+                if suffixes and name.endswith(suffixes):
+                    continue  # *_locked convention: caller owns the handle
+                if qual in exempt_quals or \
+                        qual.split(".")[0] in exempt_classes:
+                    continue  # implements the pair itself
+                for site in _collect_sites(cfg, acq_last):
+                    if s.suppressed(self.name, site.line):
+                        continue
+                    leak = _find_leak(cfg, site)
+                    if leak is None:
+                        continue
+                    kind, steps = leak
+                    related = [{"path": s.path, "line": site.line,
+                                "message": f"witness: '{site.acq}()' "
+                                           f"acquired here"}]
+                    shown = steps if len(steps) <= _PATH_CAP - 2 else \
+                        steps[:_PATH_CAP - 3] + [steps[-1]]
+                    prev = site.line
+                    for line, note in shown:
+                        if line != prev:
+                            related.append({"path": s.path, "line": line,
+                                            "message": f"witness: {note}"})
+                            prev = line
+                    rel_names = "/".join(sorted(site.pair["_rel_last"]))
+                    yield Finding(
+                        path=s.path, line=site.line, rule=self.name,
+                        message=(
+                            f"'{site.pair['name']}' resource acquired via "
+                            f"'{site.acq}()' in '{qual}' can reach {kind} "
+                            f"out of the function without release "
+                            f"('{rel_names}') or ownership transfer — "
+                            f"release in a finally/handler or hand the "
+                            f"handle off before the path escapes"),
+                        related=tuple(related))

@@ -33,8 +33,12 @@ _RESOLVE_DEPTH = 6  # max re-export hops before giving up
 
 class Project:
     def __init__(self, summaries: Dict[str, ModuleSummary],
-                 config: Dict[str, Any]):
+                 config: Dict[str, Any], root: Optional[str] = None):
         self.config = config
+        #: filesystem root the summary paths are relative to — rules that
+        #: need per-path precision (resource-discipline re-walks the AST of
+        #: acquiring functions) resolve files through it
+        self.root = root
         self.by_path: Dict[str, ModuleSummary] = dict(summaries)
         self.modules: Dict[str, ModuleSummary] = {}
         for s in summaries.values():
@@ -60,6 +64,31 @@ class Project:
             for cls, attrs in s.class_locks.items():
                 for attr, kind in attrs.items():
                     self.lock_kinds[f"{mod}.{cls}.{attr}"] = kind
+
+        # class -> resolved base names, merged over modules (simple-name
+        # keyed; exception hierarchies are simple-name unique in practice)
+        self.class_bases: Dict[str, List[str]] = {}
+        for s in self.modules.values():
+            for cls_name, bases in s.class_bases.items():
+                self.class_bases.setdefault(cls_name, [])
+                for b in bases:
+                    if b not in self.class_bases[cls_name]:
+                        self.class_bases[cls_name].append(b)
+
+    def exc_ancestry(self, type_name: str) -> Set[str]:
+        """Transitive base SIMPLE names of an exception type (project
+        classes only; builtin bases are the rule's concern), including the
+        type itself."""
+        out: Set[str] = set()
+        stack = [type_name.split(".")[-1]]
+        while stack:
+            n = stack.pop()
+            if n in out:
+                continue
+            out.add(n)
+            for b in self.class_bases.get(n, ()):
+                stack.append(b.split(".")[-1])
+        return out
 
     # -- resolution ---------------------------------------------------------
 

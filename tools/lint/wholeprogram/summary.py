@@ -37,8 +37,11 @@ from ..astutil import (IMPURE_MODULES, IMPURE_PREFIXES, MUTATORS,
 
 #: bump when the extracted shape changes so cached summaries self-invalidate
 #: (2: graft-lint 3.0 — per-call held-lock sets, attribute-level access
-#: records, and spawn-root discovery for the shared-state-race rule)
-SUMMARY_FORMAT = 2
+#: records, and spawn-root discovery for the shared-state-race rule;
+#: 3: graft-lint 4.0 — per-function raise-sets with enclosing catch sets,
+#: caught-and-swallowed handler records, resource acquire/release events,
+#: and per-module class base tables for exception-hierarchy matching)
+SUMMARY_FORMAT = 3
 
 _NP_CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
 
@@ -94,6 +97,31 @@ class FunctionInfo:
     # ``self.<attr>`` fields, ["glob", name, "r"|"w", [lockrefs], line]
     # for module-level mutable globals (one-level alias tracked)
     accesses: List[list] = field(default_factory=list)
+    # graft-lint 4.0 exception flow. ``raises``: one entry per explicit
+    # ``raise`` statement — [resolved type name, catch context, line]. The
+    # type name is resolved one level through imports/aliases ("QueueFull"
+    # -> "paddle_tpu.serving.scheduler.QueueFull"). The catch context is a
+    # list of enclosing try-groups, innermost first; each group is the
+    # try's ordered handler list ``[[caught names], swallows]`` where
+    # ``["*"]`` = bare except / Exception / BaseException and a handler
+    # that re-raises (bare ``raise`` or ``raise <as-name>``) has
+    # swallows=0 (transparent): it claims its types but lets them
+    # propagate past the REST of its group, exactly like CPython handler
+    # matching.
+    raises: List[list] = field(default_factory=list)
+    # one entry per call occurrence: [dotted callee, catch context, line]
+    # (same context shape as ``raises``) — deduped on (callee, context).
+    # The exception-contract rule filters the callee's transitive
+    # raise-set through the context.
+    call_catches: List[list] = field(default_factory=list)
+    # caught-and-swallowed record per try/except handler:
+    # [[caught names], swallows (0|1), line]
+    handlers: List[list] = field(default_factory=list)
+    # resource events for configured acquire/release pairs:
+    # [kind ("acq"|"rel"|"esc"), pair name, detail, line]. These index which
+    # functions the resource-discipline rule must CFG-analyze; the rule
+    # re-walks the AST of acquiring functions for path precision.
+    resources: List[list] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"q": self.qualname, "n": self.name, "c": self.cls,
@@ -104,7 +132,11 @@ class FunctionInfo:
                 "nest": [list(x) for x in self.nest_edges],
                 "cul": [list(x) for x in self.calls_under_lock],
                 "cl": [list(x) for x in self.call_locks],
-                "acc": [list(x) for x in self.accesses]}
+                "acc": [list(x) for x in self.accesses],
+                "rs": [list(x) for x in self.raises],
+                "cc": [list(x) for x in self.call_catches],
+                "hx": [list(x) for x in self.handlers],
+                "res": [list(x) for x in self.resources]}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "FunctionInfo":
@@ -119,7 +151,12 @@ class FunctionInfo:
                                      for x in d["cul"]],
                    call_locks=[(x[0], [list(lr) for lr in x[1]], x[2])
                                for x in d["cl"]],
-                   accesses=[list(x) for x in d["acc"]])
+                   accesses=[list(x) for x in d["acc"]],
+                   raises=[[x[0], list(x[1]), x[2]] for x in d["rs"]],
+                   call_catches=[[x[0], list(x[1]), x[2]]
+                                 for x in d["cc"]],
+                   handlers=[[list(x[0]), x[1], x[2]] for x in d["hx"]],
+                   resources=[list(x) for x in d["res"]])
 
 
 @dataclass
@@ -139,6 +176,10 @@ class ModuleSummary:
     # line] for ``ThreadingHTTPServer((…), Handler)`` — the handler's
     # ``do_*`` methods run on per-request server threads
     spawn_roots: List[list] = field(default_factory=list)
+    # graft-lint 4.0: class -> resolved base names (one level through
+    # bindings), so the exception-contract rule can match a raised subclass
+    # against a contract/handler naming its base (DrainTimeout -> EngineStopped)
+    class_bases: Dict[str, List[str]] = field(default_factory=dict)
     pragmas: Dict[str, List[str]] = field(default_factory=dict)  # line -> names
     file_pragmas: List[str] = field(default_factory=list)
 
@@ -151,6 +192,7 @@ class ModuleSummary:
                 "locks": self.locks, "class_locks": self.class_locks,
                 "trace_roots": self.trace_roots,
                 "spawn_roots": [list(x) for x in self.spawn_roots],
+                "class_bases": self.class_bases,
                 "pragmas": self.pragmas,
                 "file_pragmas": self.file_pragmas}
 
@@ -167,6 +209,8 @@ class ModuleSummary:
                                 for k, v in d["class_locks"].items()},
                    trace_roots=list(d["trace_roots"]),
                    spawn_roots=[list(x) for x in d["spawn_roots"]],
+                   class_bases={k: list(v)
+                                for k, v in d["class_bases"].items()},
                    pragmas={k: list(v) for k, v in d["pragmas"].items()},
                    file_pragmas=list(d["file_pragmas"]))
 
@@ -617,6 +661,221 @@ def _scan_function(fn: ast.AST, cls: Optional[str],
             "call_locks": call_locks, "accesses": accesses}
 
 
+# ---------------------------------------------------------------------------
+# graft-lint 4.0: exception flow + resource events
+# ---------------------------------------------------------------------------
+
+_WIDE_CATCHES = ("Exception", "BaseException")
+
+
+def _class_bases_table(tree: ast.Module, bindings: Dict[str, str],
+                       module: str) -> Dict[str, List[str]]:
+    """class name -> resolved base names (``object`` and keywords dropped)."""
+    local_classes = {n.name for n in ast.walk(tree)
+                     if isinstance(n, ast.ClassDef)}
+    out: Dict[str, List[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = []
+        for b in node.bases:
+            dn = dotted_name(b)
+            if not dn or dn == "object":
+                continue
+            bases.append(_resolve_exc_name(dn, bindings, module,
+                                           local_classes))
+        if bases:
+            out[node.name] = bases
+    return out
+
+
+def _resolve_exc_name(dotted: str, bindings: Dict[str, str], module: str,
+                      local_classes: Set[str]) -> str:
+    """One-level alias/import resolution of an exception (or base) name."""
+    first, _, rest = dotted.partition(".")
+    if first in bindings:
+        target = bindings[first]
+        return f"{target}.{rest}" if rest else target
+    if first in local_classes:
+        return f"{module}.{dotted}"
+    return dotted
+
+
+def _handler_names(handler: ast.ExceptHandler, bindings: Dict[str, str],
+                   module: str, local_classes: Set[str]) -> List[str]:
+    """Caught type names of one handler; ``["*"]`` when it catches
+    everything (bare ``except``, ``Exception``, ``BaseException``)."""
+    t = handler.type
+    if t is None:
+        return ["*"]
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    names: List[str] = []
+    for e in exprs:
+        dn = dotted_name(e)
+        if not dn:
+            continue
+        if dn.split(".")[-1] in _WIDE_CATCHES:
+            return ["*"]
+        names.append(_resolve_exc_name(dn, bindings, module, local_classes))
+    return sorted(set(names))
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises what it caught (bare ``raise`` or
+    ``raise <as-name>`` anywhere in its body, nested defs excluded) —
+    such a handler is transparent: it swallows nothing."""
+    for sub in _own_nodes(handler):
+        if isinstance(sub, ast.Raise):
+            if sub.exc is None:
+                return True
+            if handler.name and isinstance(sub.exc, ast.Name) and \
+                    sub.exc.id == handler.name:
+                return True
+    return False
+
+
+def _scan_exceptions(fn: ast.AST, bindings: Dict[str, str], module: str,
+                     local_classes: Set[str]) -> Dict[str, list]:
+    """Per-function raise-set, per-call catch sets, and handler records."""
+    raises: List[list] = []
+    call_catches: List[list] = []
+    seen_calls: Set[Tuple[str, tuple]] = set()
+    handlers_out: List[list] = []
+
+    # one-level local exception variables: `exc = QueueFull(...)` followed
+    # by `raise exc` resolves to QueueFull
+    var_types: Dict[str, str] = {}
+    for sub in _own_nodes(fn):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name) and \
+                isinstance(sub.value, ast.Call):
+            dn = dotted_name(sub.value.func)
+            if dn and dn.split(".")[-1][:1].isupper():
+                var_types[sub.targets[0].id] = _resolve_exc_name(
+                    dn, bindings, module, local_classes)
+
+    def scan(node: ast.AST, catches: List[list],
+             as_names: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.Try):
+            group: List[list] = []
+            for h in node.handlers:
+                names = _handler_names(h, bindings, module, local_classes)
+                sw = not _handler_reraises(h)
+                handlers_out.append([names, 1 if sw else 0, h.lineno])
+                group.append([names, 1 if sw else 0])
+            body_catches = ([group] + catches) if group else catches
+            for s in node.body:
+                scan(s, body_catches, as_names)
+            for s in node.orelse:
+                scan(s, catches, as_names)
+            for h in node.handlers:
+                inner = as_names | {h.name} if h.name else as_names
+                for s in h.body:
+                    scan(s, catches, inner)
+            for s in node.finalbody:
+                scan(s, catches, as_names)
+            return
+        if isinstance(node, ast.Raise):
+            exc = node.exc
+            name: Optional[str] = None
+            if exc is None:
+                name = None            # bare re-raise: transparent handler
+            elif isinstance(exc, ast.Name):
+                if exc.id in as_names:
+                    name = None        # `raise exc` re-raise of the caught
+                else:
+                    name = var_types.get(exc.id)
+            else:
+                target = exc.func if isinstance(exc, ast.Call) else exc
+                dn = dotted_name(target)
+                if dn:
+                    name = _resolve_exc_name(dn, bindings, module,
+                                             local_classes)
+            if name is not None:
+                raises.append([name, list(catches), node.lineno])
+        elif isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn:
+                key = (dn, repr(catches))
+                if key not in seen_calls:
+                    seen_calls.add(key)
+                    call_catches.append([dn, list(catches), node.lineno])
+        for child in ast.iter_child_nodes(node):
+            scan(child, catches, as_names)
+
+    for child in ast.iter_child_nodes(fn):
+        scan(child, [], frozenset())
+    return {"raises": raises, "call_catches": call_catches,
+            "handlers": handlers_out}
+
+
+def _scan_resources(fn: ast.AST, config: Dict[str, Any]) -> List[list]:
+    """Acquire/release/escape events for the configured resource pairs.
+
+    Matching is by the call's last dotted component ("free" matches
+    ``self.kv.free``); the class part of a configured
+    ``"PagedKVCache.alloc"`` spec is documentation. Escape events are the
+    naive ownership transfers (return / attribute store / argument pass of
+    a name bound straight from an acquire call); the resource-discipline
+    rule re-derives the precise per-path story from the CFG.
+    """
+    pairs = config.get("resource_pairs", ())
+    if not pairs:
+        return []
+    acq: Dict[str, str] = {}
+    rel: Dict[str, str] = {}
+    for p in pairs:
+        for spec in p.get("acquire", ()):
+            acq[spec.split(".")[-1]] = p["name"]
+        for spec in p.get("release", ()):
+            rel[spec.split(".")[-1]] = p["name"]
+
+    events: List[list] = []
+    owned: Dict[str, str] = {}   # name -> pair, bound straight from acquire
+
+    def acquire_call_in(expr) -> Optional[str]:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                dn = dotted_name(sub.func)
+                if dn and dn.split(".")[-1] in acq:
+                    return acq[dn.split(".")[-1]]
+        return None
+
+    for sub in _own_nodes(fn):
+        if isinstance(sub, ast.Call):
+            dn = dotted_name(sub.func)
+            last = dn.split(".")[-1] if dn else ""
+            if last in acq:
+                events.append(["acq", acq[last], dn, sub.lineno])
+            elif last in rel:
+                events.append(["rel", rel[last], dn, sub.lineno])
+                continue
+            for a in list(sub.args) + [kw.value for kw in sub.keywords]:
+                for n in ast.walk(a):
+                    if isinstance(n, ast.Name) and n.id in owned:
+                        events.append(["esc", owned[n.id],
+                                       f"arg {n.id}", sub.lineno])
+        elif isinstance(sub, ast.Assign):
+            pair = acquire_call_in(sub.value)
+            for t in sub.targets:
+                if pair and isinstance(t, ast.Name):
+                    owned[t.id] = pair
+                elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                    for n in ast.walk(sub.value):
+                        if isinstance(n, ast.Name) and n.id in owned:
+                            events.append(["esc", owned[n.id],
+                                           f"store {n.id}", sub.lineno])
+        elif isinstance(sub, ast.Return) and sub.value is not None:
+            for n in ast.walk(sub.value):
+                if isinstance(n, ast.Name) and n.id in owned:
+                    events.append(["esc", owned[n.id], f"return {n.id}",
+                                   sub.lineno])
+    return events
+
+
 def build_summary(path: str, tree: ast.Module, lines: List[str],
                   config: Dict[str, Any]) -> ModuleSummary:
     """Distill one parsed module into its JSON-serializable summary."""
@@ -633,17 +892,24 @@ def build_summary(path: str, tree: ast.Module, lines: List[str],
     safe_attrs = _class_safe_attr_table(tree)
     per_line, file_level = _pragma_tables(lines)
 
+    local_classes = {n.name for n in ast.walk(tree)
+                     if isinstance(n, ast.ClassDef)}
+
     functions: List[FunctionInfo] = []
     for qualname, name, cls, node in _walk_functions(tree):
         data = _scan_function(node, cls, mutables, bindings, module_locks,
                               class_locks, safe_attrs)
+        exc = _scan_exceptions(node, bindings, module, local_classes)
         functions.append(FunctionInfo(
             qualname=qualname, name=name, cls=cls, line=node.lineno,
             calls=data["calls"], impure=data["impure"],
             host_syncs=data["host_syncs"], acquires=data["acquires"],
             nest_edges=data["nest_edges"],
             calls_under_lock=data["calls_under_lock"],
-            call_locks=data["call_locks"], accesses=data["accesses"]))
+            call_locks=data["call_locks"], accesses=data["accesses"],
+            raises=exc["raises"], call_catches=exc["call_catches"],
+            handlers=exc["handlers"],
+            resources=_scan_resources(node, config)))
 
     return ModuleSummary(
         path=path, module=module, bindings=bindings,
@@ -653,5 +919,6 @@ def build_summary(path: str, tree: ast.Module, lines: List[str],
         locks=module_locks, class_locks=class_locks,
         trace_roots=sorted(_trace_root_names(tree, path, config)),
         spawn_roots=_spawn_sites(tree),
+        class_bases=_class_bases_table(tree, bindings, module),
         pragmas={str(k): sorted(v) for k, v in per_line.items()},
         file_pragmas=sorted(file_level))
