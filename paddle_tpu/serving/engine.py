@@ -121,6 +121,14 @@ _log = logging.getLogger(__name__)
 # back from its in-flight compiled call before stop() proceeds without it
 _JOIN_GRACE_S = 1.0
 
+# join bound for a stop() WITHOUT a drain budget (timeout=None): the loop
+# thread normally exits within one step, but one wedged inside a hung
+# compiled call (the watchdog's zombie case) must not turn stop() into
+# the very unbounded hang it promises to avoid — past this, the zombie
+# is abandoned exactly as in the budgeted case. PADDLE_TPU_STOP_JOIN_S
+# overrides for programs whose single step legitimately runs longer.
+_STOP_JOIN_S = 30.0
+
 # SLO-shaped latency boundaries (ISSUE 12). The generic 10us..10s decade
 # grid clipped exactly the bands a serving SLO routes on: sub-10ms decode
 # steps all fell into two buckets, and TTFT targets (100ms/250ms/500ms)
@@ -768,10 +776,12 @@ class Engine:
         ``stop()`` would be asking the loop to drain itself (raises
         ``RuntimeError``; use :meth:`cancel`, or stop from another
         thread). Signal handlers are fine: flag-set + a join bounded by
-        the drain budget (+1 s grace — if the loop thread is wedged
+        the drain budget +1 s grace — or by ``PADDLE_TPU_STOP_JOIN_S``
+        (default 30 s) when no budget was given, so a wedged loop thread
+        never makes stop() itself hang — if the loop thread is wedged
         inside a compiled call past that, stop() logs it, resolves the
-        stragglers anyway, and abandons the zombie step's late return),
-        and a second concurrent call finds nothing left to resolve."""
+        stragglers anyway, and abandons the zombie step's late return;
+        a second concurrent call finds nothing left to resolve."""
         if on_timeout not in ("fail", "requeue"):
             raise ValueError(f"on_timeout must be fail|requeue, "
                              f"got {on_timeout!r}")
@@ -824,11 +834,17 @@ class Engine:
         self._wake.set()
         t = self._thread
         if t is not None and t is not threading.current_thread():
-            # bounded by the caller's budget: a loop thread wedged inside
-            # a hung compiled call (the watchdog's zombie case) must not
-            # turn stop() into a second unbounded hang
-            t.join(timeout=None if deadline is None else max(
-                0.0, deadline - time.monotonic()) + _JOIN_GRACE_S)
+            # bounded by the caller's budget — or by _STOP_JOIN_S when no
+            # budget was given: a loop thread wedged inside a hung
+            # compiled call (the watchdog's zombie case) must not turn
+            # stop() into a second unbounded hang either way
+            if deadline is None:
+                join_s = _env_seconds("PADDLE_TPU_STOP_JOIN_S") \
+                    or _STOP_JOIN_S
+            else:
+                join_s = max(0.0, deadline - time.monotonic()) \
+                    + _JOIN_GRACE_S
+            t.join(timeout=join_s)
             if t.is_alive():
                 _log.warning(
                     "serving stop(): loop thread still wedged in a "

@@ -523,6 +523,161 @@ def test_lint_sarif_exception_contract_witness_chain(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# graft-lint 5.0 (ISSUE 19): the blocking rules in the machine formats —
+# witness chains name the root, the acquire site, and the blocking call,
+# and the latency-invariant config tables are pinned against silent edits
+# ---------------------------------------------------------------------------
+
+def test_lint_json_blocking_under_lock_carries_witnesses(tmp_path):
+    import io
+    import contextlib
+    import json
+    import textwrap
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint.cli import main
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "w.py").write_text(textwrap.dedent("""\
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.jobs = None
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with self._lock:
+                    return self.jobs.get()
+        """))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main([str(pkg), "--format=json", "--no-baseline", "--no-cache"])
+    doc = json.loads(buf.getvalue())
+    assert rc == 1
+    assert doc["counts_by_rule"] == {"blocking-under-lock": 1}
+    (f,) = doc["findings"]
+    assert set(f) == {"path", "line", "rule", "message", "related"}
+    assert "while holding" in f["message"]
+    msgs = [r["message"] for r in f["related"]]
+    # root -> ... witness hops, then the acquire site, then the block
+    assert msgs[0].startswith("witness:")
+    assert any(m.startswith("acquires") for m in msgs)
+    assert msgs[-1].startswith("blocks: queue")
+    assert all(r["line"] > 0 for r in f["related"])
+
+
+def test_lint_sarif_unbounded_wait_related_locations(tmp_path):
+    # unbounded-wait is config-scoped, so drive sarif_report() off a
+    # run with explicit bounded_wait tables
+    import textwrap
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint import run_lint
+    from tools.lint.cli import sarif_report
+    pkg = tmp_path / "pkg" / "srv"
+    pkg.mkdir(parents=True)
+    (tmp_path / "pkg" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "loop.py").write_text(textwrap.dedent("""\
+        class Pump:
+            def __init__(self, q):
+                self.jobs = q
+
+            def _poll_loop(self):
+                return self._pull()
+
+            def _pull(self):
+                return self.jobs.get()
+        """))
+    res = run_lint(paths=["."], rules=["unbounded-wait"],
+                   root=str(tmp_path),
+                   config={"bounded_wait_paths": ["pkg/srv"],
+                           "bounded_wait_roots": {
+                               "pkg/srv/loop.py": ["Pump._poll_loop"]}})
+    (f,) = res.new
+    assert f.rule == "unbounded-wait" and "poll thread" in f.message
+    doc = sarif_report(res)
+    (sres,) = doc["runs"][0]["results"]
+    assert sres["ruleId"] == "unbounded-wait"
+    rel = sres["relatedLocations"]
+    # the chain walks root -> waiting function, then names the wait
+    assert [r["message"]["text"] for r in rel] == \
+        ["witness: 'Pump._poll_loop'", "witness: 'Pump._pull'",
+         "waits: queue 'self.jobs.get'"]
+    assert rel[-1]["physicalLocation"]["region"]["startLine"] == 9
+
+
+def test_lint_sarif_hot_path_stall_related_locations(tmp_path):
+    import textwrap
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint import run_lint
+    from tools.lint.cli import sarif_report
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "hot.py").write_text(textwrap.dedent("""\
+        import time
+
+        def dispatch(x):
+            return _helper(x)
+
+        def _helper(x):
+            time.sleep(0.01)
+            return x
+        """))
+    res = run_lint(paths=["."], rules=["hot-path-stall"],
+                   root=str(tmp_path),
+                   config={"fast_path_roots": ["pkg/hot.py::dispatch"]})
+    (f,) = res.new
+    assert f.rule == "hot-path-stall"
+    doc = sarif_report(res)
+    (sres,) = doc["runs"][0]["results"]
+    assert sres["ruleId"] == "hot-path-stall"
+    rel = sres["relatedLocations"]
+    assert [r["message"]["text"] for r in rel] == \
+        ["witness: 'dispatch'", "witness: '_helper'",
+         "stalls: sleep 'time.sleep'"]
+    assert rel[-1]["physicalLocation"]["region"]["startLine"] == 7
+
+
+def test_default_config_pins_latency_invariant_tables():
+    # MIGRATING "Latency invariants": the strict bounded-wait tier and
+    # the reviewed fast-path lock exemptions are part of the contract of
+    # record — membership drift must be a conscious, reviewed edit here
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.lint.engine import DEFAULT_CONFIG
+    assert {"paddle_tpu/serving", "paddle_tpu/serving/http.py",
+            "paddle_tpu/serving/router.py",
+            "paddle_tpu/resilience/watchdog.py",
+            "paddle_tpu/resilience/trainer.py",
+            "paddle_tpu/distributed/ps_service.py"} <= \
+        set(DEFAULT_CONFIG["bounded_wait_paths"])
+    # the bounded-wait poll roots name real long-lived threads
+    roots = DEFAULT_CONFIG["bounded_wait_roots"]
+    assert roots["paddle_tpu/serving/router.py"] == ["Router._poll_loop"]
+    assert roots["paddle_tpu/resilience/watchdog.py"] == \
+        ["StepWatchdog._loop"]
+    # every fast-path lock exemption is a reviewed short-critical-section
+    # lock, spelled as the analysis' dotted lock id
+    exempt = DEFAULT_CONFIG["hot_path_lock_exempt"]
+    assert {"paddle_tpu.core.dispatch_cache._LOCK",
+            "paddle_tpu.core.fallback._LOCK"} <= set(exempt)
+    assert all(e.split(".")[-1].startswith("_") for e in exempt)
+    # and the strict wait tier rides the SAME modules the poll-loop tier
+    # already guards — the two latency tiers cannot silently diverge
+    poll = set(DEFAULT_CONFIG["poll_loop_paths"])
+    assert {"paddle_tpu/serving", "paddle_tpu/resilience/watchdog.py",
+            "paddle_tpu/resilience/trainer.py"} <= poll
+
+
+# ---------------------------------------------------------------------------
 # serving bench schema (ISSUE 7)
 # ---------------------------------------------------------------------------
 

@@ -48,7 +48,11 @@ EXPECTED_RULES = {"trace-impurity", "silent-swallow", "hot-path-import",
                   # ISSUE 18 (graft-lint 4.0): CFG-backed exception/resource
                   # flow — typed failure surfaces at declared entry roots,
                   # and all-paths release of configured acquire/release pairs
-                  "exception-contract", "resource-discipline"}
+                  "exception-contract", "resource-discipline",
+                  # ISSUE 19 (graft-lint 5.0): interprocedural blocking —
+                  # lock-hold stalls, unbounded waits at serving roots, and
+                  # stall classes reachable from the dispatch fast path
+                  "blocking-under-lock", "unbounded-wait", "hot-path-stall"}
 
 
 def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
@@ -62,8 +66,8 @@ def _lint_snippet(tmp_path, code, rule, filename="snippet.py", config=None):
 # rule registry
 # ---------------------------------------------------------------------------
 
-def test_all_fifteen_rules_registered():
-    assert len(EXPECTED_RULES) == 15
+def test_all_eighteen_rules_registered():
+    assert len(EXPECTED_RULES) == 18
     assert EXPECTED_RULES <= set(RULES)
 
 
@@ -799,4 +803,35 @@ def test_every_rule_is_exercised_by_tree_or_baseline():
             # ISSUE 14: the race detector's reasoned survivors (lock-free
             # flight ring, GIL-atomic endpoint refresh, the engine's
             # single-consumer step state)
-            "shared-state-race"} <= rules_in_baseline
+            "shared-state-race",
+            # ISSUE 19: the blocking analysis' reasoned survivors (the
+            # native-build lock, the by-design serialized push RPCs, the
+            # cache-miss jit under the dispatch root, the resolved-by-
+            # protocol future waits in http/router)
+            "blocking-under-lock", "unbounded-wait",
+            "hot-path-stall"} <= rules_in_baseline
+
+
+# ---------------------------------------------------------------------------
+# dogfood (ISSUE 19): the linter lints itself
+# ---------------------------------------------------------------------------
+
+def test_linter_tree_lints_itself_clean():
+    # tools/lint under its own rules, no baseline allowance: no silent
+    # except-pass, no unlocked module-global mutation, and no function-
+    # level imports in the scan hot loop (the one reviewed cycle-break in
+    # build_summary carries a pragma). Scoped to the three rules that are
+    # meaningful for a stdlib-only single-threaded tool — thread/device
+    # rules have nothing to bite on here.
+    res = run_lint(paths=["tools/lint"],
+                   rules=["silent-swallow", "unguarded-global",
+                          "hot-path-import"],
+                   config={"hot_path_modules": [
+                       "tools/lint/wholeprogram/summary.py",
+                       "tools/lint/wholeprogram/project.py",
+                       "tools/lint/astutil.py"]},
+                   baseline_entries=[])
+    assert res.errors == []
+    assert [f.text() for f in res.new] == []
+    # a renamed tree must fail loudly, not lint zero files to green
+    assert res.files_checked >= 20

@@ -30,7 +30,10 @@ WHOLEPROGRAM_RULES = {"cross-trace-impurity", "cross-host-sync",
                       "lock-order", "import-layering",
                       "shared-state-race",
                       # ISSUE 18 (graft-lint 4.0)
-                      "exception-contract", "resource-discipline"}
+                      "exception-contract", "resource-discipline",
+                      # ISSUE 19 (graft-lint 5.0): interprocedural blocking
+                      "blocking-under-lock", "unbounded-wait",
+                      "hot-path-stall"}
 
 
 def write_pkg(tmp_path, files):
@@ -921,9 +924,9 @@ def test_cache_per_file_findings_served_without_parse(tmp_path):
 
 def test_summary_format_constant_is_pinned():
     # bump CACHE_FORMAT_VERSION whenever SUMMARY_FORMAT changes; this pin
-    # forces the bump to be a conscious, reviewed edit (3: graft-lint 4.0
-    # — per-function raise-sets, catch contexts, resource events)
-    assert (SUMMARY_FORMAT, CACHE_FORMAT_VERSION) == (3, 3)
+    # forces the bump to be a conscious, reviewed edit (4: graft-lint 5.0
+    # — per-function may-block events, kind + boundedness + held locks)
+    assert (SUMMARY_FORMAT, CACHE_FORMAT_VERSION) == (4, 4)
 
 
 def test_stale_v2_cache_is_resummarized_not_crashed(tmp_path):
@@ -936,6 +939,25 @@ def test_stale_v2_cache_is_resummarized_not_crashed(tmp_path):
                      cache_path=str(cache))
     data = json.loads(cache.read_text())
     data["format"] = 2
+    cache.write_text(json.dumps(data))
+    res = lint_pkg(tmp_path, "cross-trace-impurity", cache_path=str(cache))
+    assert res.errors == []
+    assert res.parsed_files == res.total_files > 0  # full re-summarize
+    assert [f.as_dict() for f in res.new] == \
+        [f.as_dict() for f in first.new]
+    assert json.loads(cache.read_text())["format"] == CACHE_FORMAT_VERSION
+
+
+def test_stale_v3_cache_is_resummarized_not_crashed(tmp_path):
+    # ISSUE 19: a cache written by the graft-lint 4.0 layout (format 3 —
+    # no may-block events) must be discarded whole and rebuilt; reading
+    # its summaries into the v4 shape would KeyError on "blk"
+    write_pkg(tmp_path, CACHE_FILES)
+    cache = tmp_path / "cache.json"
+    first = lint_pkg(tmp_path, "cross-trace-impurity",
+                     cache_path=str(cache))
+    data = json.loads(cache.read_text())
+    data["format"] = 3
     cache.write_text(json.dumps(data))
     res = lint_pkg(tmp_path, "cross-trace-impurity", cache_path=str(cache))
     assert res.errors == []
@@ -1692,3 +1714,496 @@ def test_router_contract_types_are_status_mapped():
     assert set(allowed) == set(ns)
     for name in allowed:
         assert hs.status_for(ns[name]("x")) != 500, name
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (ISSUE 19, graft-lint 5.0)
+# ---------------------------------------------------------------------------
+
+BUL_HEAD = """\
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition()
+            self.jobs = None
+
+        def start(self):
+            threading.Thread(target=self._loop, daemon=True).start()
+
+    """
+
+
+def test_blocking_under_lock_queue_wait_in_critical_section(tmp_path):
+    res = lint_pkg(tmp_path, "blocking-under-lock", {
+        "pkg/w.py": BUL_HEAD + """\
+    def _loop(self):
+        with self._lock:
+            item = self.jobs.get()
+    """.replace("\n    ", "\n        "),
+    })
+    assert len(res.new) == 1
+    msg = res.new[0].message
+    assert "unbounded queue 'self.jobs.get'" in msg
+    assert "while holding" in msg and "_lock" in msg
+    # the witness narrative ends at the blocking site
+    assert res.new[0].related[-1]["message"].startswith("blocks: queue")
+
+
+def test_blocking_under_lock_propagates_through_call_edge(tmp_path):
+    # the lock is taken in the root, the block happens in a callee: the
+    # per-call-site held set carries across the edge, and the witness
+    # chain names both hops
+    res = lint_pkg(tmp_path, "blocking-under-lock", {
+        "pkg/w.py": BUL_HEAD + """\
+    def _loop(self):
+        with self._lock:
+            self._pull()
+
+    def _pull(self):
+        return self.jobs.get()
+    """.replace("\n    ", "\n        "),
+    })
+    assert len(res.new) == 1
+    assert "Worker._pull" in res.new[0].message
+    assert "Worker._loop" in res.new[0].message
+
+
+def test_blocking_under_lock_snapshot_then_block_is_clean(tmp_path):
+    # the sanctioned fix: snapshot under the lock, block after releasing
+    # — and a bounded sleep under a lock is the poll-jitter idiom, exempt
+    res = lint_pkg(tmp_path, "blocking-under-lock", {
+        "pkg/w.py": BUL_HEAD + """\
+    def _loop(self):
+        import time
+        with self._lock:
+            jobs = self.jobs
+        item = jobs.get()
+        with self._lock:
+            time.sleep(0.01)
+    """.replace("\n    ", "\n        "),
+    })
+    assert res.new == []
+
+
+def test_blocking_under_lock_condition_wait_releases_own_lock(tmp_path):
+    # Condition.wait RELEASES the condition's lock while waiting: waiting
+    # under only the condition itself is clean, waiting while ALSO
+    # holding an unrelated lock still fires
+    clean = lint_pkg(tmp_path, "blocking-under-lock", {
+        "pkg/w.py": BUL_HEAD + """\
+    def _loop(self):
+        with self._cond:
+            self._cond.wait()
+    """.replace("\n    ", "\n        "),
+    })
+    assert clean.new == []
+    tmp2 = tmp_path / "dirty"
+    tmp2.mkdir()
+    dirty = lint_pkg(tmp2, "blocking-under-lock", {
+        "pkg/w.py": BUL_HEAD + """\
+    def _loop(self):
+        with self._lock:
+            with self._cond:
+                self._cond.wait()
+    """.replace("\n    ", "\n        "),
+    })
+    assert len(dirty.new) == 1
+    assert "_lock" in dirty.new[0].message
+
+
+def test_blocking_under_lock_locked_suffix_caller_holds(tmp_path):
+    # a *_locked helper blocking with NO resolvable lock on the chain:
+    # the convention says the caller holds one — still a finding, with
+    # the synthetic marker instead of a lock id
+    res = lint_pkg(tmp_path, "blocking-under-lock", {
+        "pkg/w.py": BUL_HEAD + """\
+    def _loop(self):
+        self._flush_locked()
+
+    def _flush_locked(self):
+        return self.jobs.get()
+    """.replace("\n    ", "\n        "),
+    })
+    assert len(res.new) == 1
+    assert "<caller-held lock>" in res.new[0].message
+
+
+def test_blocking_under_lock_pragma_suppresses(tmp_path):
+    res = lint_pkg(tmp_path, "blocking-under-lock", {
+        "pkg/w.py": BUL_HEAD + """\
+    def _loop(self):
+        with self._lock:
+            item = self.jobs.get()  # graft-lint: disable=blocking-under-lock
+    """.replace("\n    ", "\n        "),
+    })
+    assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# unbounded-wait (ISSUE 19, graft-lint 5.0)
+# ---------------------------------------------------------------------------
+
+UW_CFG = {"bounded_wait_paths": ["pkg/srv"],
+          "bounded_wait_roots": {"pkg/srv/loop.py": ["Pump._poll_loop"]}}
+
+UW_HEAD = """\
+    import queue
+
+    class Pump:
+        def __init__(self):
+            self.jobs = queue.Queue()
+
+    """
+
+
+def test_unbounded_wait_untimed_queue_get(tmp_path):
+    res = lint_pkg(tmp_path, "unbounded-wait", {
+        "pkg/srv/loop.py": UW_HEAD + """\
+    def _poll_loop(self):
+        while True:
+            item = self.jobs.get()
+    """.replace("\n    ", "\n        "),
+    }, config=UW_CFG)
+    assert len(res.new) == 1
+    msg = res.new[0].message
+    assert "unbounded queue 'self.jobs.get'" in msg
+    assert "poll thread" in msg and "Pump._poll_loop" in msg
+    assert res.new[0].related[-1]["message"].startswith("waits: queue")
+
+
+def test_unbounded_wait_env_float_timeout_is_bounded(tmp_path):
+    # a computed timeout — env_float(...) directly or through a local —
+    # is the author stating a bound; both forms pass
+    res = lint_pkg(tmp_path, "unbounded-wait", {
+        "pkg/srv/loop.py": UW_HEAD + """\
+    def _poll_loop(self):
+        t = env_float("PUMP_POLL_S", 0.5)
+        while True:
+            a = self.jobs.get(timeout=t)
+            b = self.jobs.get(timeout=env_float("PUMP_POLL_S", 0.5))
+    """.replace("\n    ", "\n        "),
+    }, config=UW_CFG)
+    assert res.new == []
+
+
+def test_unbounded_wait_none_default_timeout_is_unbounded(tmp_path):
+    # a timeout threaded through a parameter whose default is None is
+    # unbounded in the worst case — exactly the Engine.stop bug shape
+    res = lint_pkg(tmp_path, "unbounded-wait", {
+        "pkg/srv/loop.py": UW_HEAD + """\
+    def _poll_loop(self, timeout=None):
+        item = self.jobs.get(timeout=timeout)
+    """.replace("\n    ", "\n        "),
+    }, config=UW_CFG)
+    assert len(res.new) == 1
+
+
+def test_unbounded_wait_deadline_scope_bounds_lexically(tmp_path):
+    # an untimed wait under `with deadline_scope(...)` rides the ambient
+    # deadline — the resilience-sanctioned alternative to a timeout arg
+    res = lint_pkg(tmp_path, "unbounded-wait", {
+        "pkg/srv/loop.py": UW_HEAD + """\
+    def _poll_loop(self):
+        with deadline_scope(2.0):
+            item = self.jobs.get()
+    """.replace("\n    ", "\n        "),
+    }, config=UW_CFG)
+    assert res.new == []
+
+
+def test_unbounded_wait_only_fires_inside_strict_paths(tmp_path):
+    # the same untimed wait OUTSIDE bounded_wait_paths (a CLI launcher
+    # may wait on its child forever) is out of scope
+    res = lint_pkg(tmp_path, "unbounded-wait", {
+        "pkg/cli/loop.py": UW_HEAD + """\
+    def _poll_loop(self):
+        item = self.jobs.get()
+    """.replace("\n    ", "\n        "),
+    }, config={"bounded_wait_paths": ["pkg/srv"],
+               "bounded_wait_roots": {"pkg/cli/loop.py":
+                                      ["Pump._poll_loop"]}})
+    assert res.new == []
+
+
+def test_unbounded_wait_exception_contract_entries_are_roots(tmp_path):
+    # the declared failure surface doubles as the root set: an entry
+    # point from exception_contracts reaches the untimed wait
+    res = lint_pkg(tmp_path, "unbounded-wait", {
+        "pkg/srv/door.py": UW_HEAD + """\
+    def handle(self, req):
+        return self.jobs.get()
+    """.replace("\n    ", "\n        "),
+    }, config={"bounded_wait_paths": ["pkg/srv"],
+               "exception_contracts": {"pkg/srv/door.py":
+                                       {"Pump.handle": ["ValueError"]}}})
+    assert len(res.new) == 1
+    assert "entry" in res.new[0].message
+
+
+def test_unbounded_wait_pragma_suppresses(tmp_path):
+    res = lint_pkg(tmp_path, "unbounded-wait", {
+        "pkg/srv/loop.py": UW_HEAD + """\
+    def _poll_loop(self):
+        item = self.jobs.get()  # graft-lint: disable=unbounded-wait
+    """.replace("\n    ", "\n        "),
+    }, config=UW_CFG)
+    assert res.new == []
+
+
+# ---------------------------------------------------------------------------
+# hot-path-stall (ISSUE 19, graft-lint 5.0)
+# ---------------------------------------------------------------------------
+
+HPS_CFG = {"fast_path_roots": ["pkg/hot.py::dispatch"]}
+
+
+def test_hot_path_stall_sleep_through_helper(tmp_path):
+    res = lint_pkg(tmp_path, "hot-path-stall", {
+        "pkg/hot.py": """\
+            import time
+
+            def dispatch(x):
+                return _helper(x)
+
+            def _helper(x):
+                time.sleep(0.01)
+                return x
+            """,
+    }, config=HPS_CFG)
+    assert len(res.new) == 1
+    msg = res.new[0].message
+    assert "sleep 'time.sleep'" in msg and "dispatch fast path" in msg
+    assert res.new[0].related[-1]["message"].startswith("stalls:")
+
+
+def test_hot_path_stall_contended_lock_only(tmp_path):
+    # a lock acquired by a SECOND function is contended — dispatch can
+    # queue behind it; the same acquisition with no other holder is not
+    contended = {
+        "pkg/hot.py": """\
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def dispatch(x):
+                with _LOCK:
+                    return x
+
+            def other():
+                with _LOCK:
+                    return 1
+            """,
+    }
+    res = lint_pkg(tmp_path, "hot-path-stall", contended, config=HPS_CFG)
+    assert len(res.new) == 1
+    assert "contended lock 'pkg.hot._LOCK'" in res.new[0].message
+    # sole holder: not contended, clean
+    tmp2 = tmp_path / "sole"
+    tmp2.mkdir()
+    sole = dict(contended)
+    sole["pkg/hot.py"] = contended["pkg/hot.py"].replace(
+        "def other():\n                with _LOCK:\n                    "
+        "return 1", "def other():\n                return 1")
+    assert lint_pkg(tmp2, "hot-path-stall", sole, config=HPS_CFG).new == []
+
+
+def test_hot_path_stall_lock_exempt_list(tmp_path):
+    # the reviewed short-critical-section locks stay allowed on the fast
+    # path via hot_path_lock_exempt
+    res = lint_pkg(tmp_path, "hot-path-stall", {
+        "pkg/hot.py": """\
+            import threading
+
+            _LOCK = threading.Lock()
+
+            def dispatch(x):
+                with _LOCK:
+                    return x
+
+            def other():
+                with _LOCK:
+                    return 1
+            """,
+    }, config=dict(HPS_CFG, hot_path_lock_exempt=["pkg.hot._LOCK"]))
+    assert res.new == []
+
+
+def test_hot_path_stall_warmup_chain_exempts_jit(tmp_path):
+    # deliberate pre-compilation through a *warmup* hop is the point;
+    # the same jax.jit on a plain dispatch chain is a compile stall
+    res = lint_pkg(tmp_path, "hot-path-stall", {
+        "pkg/hot.py": """\
+            import jax
+
+            def dispatch(x):
+                _warmup(x)
+                return _compile(x)
+
+            def _warmup(x):
+                return jax.jit(x)
+
+            def _compile(x):
+                return jax.jit(x)
+            """,
+    }, config=HPS_CFG)
+    assert len(res.new) == 1
+    assert "_compile" in res.new[0].message
+    assert "jit-compile" in res.new[0].message
+
+
+def test_hot_path_stall_shipped_config_membership():
+    # the exemption list covers exactly the reviewed program-cache /
+    # bookkeeping locks, and the strict wait tier covers the serving +
+    # supervisor surfaces (MIGRATING, "Latency invariants")
+    from tools.lint.engine import DEFAULT_CONFIG
+    exempt = DEFAULT_CONFIG["hot_path_lock_exempt"]
+    assert "paddle_tpu.core.dispatch_cache._LOCK" in exempt
+    assert "paddle_tpu.core.fallback._LOCK" in exempt
+    bw = DEFAULT_CONFIG["bounded_wait_paths"]
+    assert "paddle_tpu/serving" in bw
+    assert "paddle_tpu/resilience/watchdog.py" in bw
+    assert "paddle_tpu/distributed/ps_service.py" in bw
+    roots = DEFAULT_CONFIG["bounded_wait_roots"]
+    assert "Router._poll_loop" in roots["paddle_tpu/serving/router.py"]
+    assert "StepWatchdog._loop" in \
+        roots["paddle_tpu/resilience/watchdog.py"]
+
+
+# ---------------------------------------------------------------------------
+# may-block summaries on the shipped tree (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_serving_blocking_events_are_well_formed():
+    """Every may-block event harvested over paddle_tpu/serving/ is
+    orphan-free: a pinned 7-slot shape, a registered kind, a real line,
+    and lock refs that are themselves well-formed ref tuples — the three
+    blocking rules consume these fields blindly."""
+    import ast
+
+    from tools.lint.engine import (DEFAULT_CONFIG, REPO_ROOT,
+                                   iter_python_files)
+    from tools.lint.wholeprogram.summary import (BLOCKING_KINDS,
+                                                 build_summary)
+
+    total = 0
+    for abspath in iter_python_files(["paddle_tpu/serving"]):
+        rel = os.path.relpath(abspath, REPO_ROOT).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as fh:
+            src = fh.read()
+        summary = build_summary(rel, ast.parse(src), src.splitlines(),
+                                DEFAULT_CONFIG)
+        for fi in summary.functions:
+            for ev in fi.blocking:
+                kind, detail, bounded, ds, lrs, recv, line = ev
+                total += 1
+                assert kind in BLOCKING_KINDS, (rel, fi.qualname, ev)
+                assert detail and isinstance(detail, str)
+                assert bounded in (0, 1, True, False)
+                assert ds in (0, 1, True, False)
+                assert isinstance(line, int) and line > 0
+                for lr in lrs:
+                    assert lr and all(isinstance(p, str) for p in lr)
+                if recv is not None:
+                    assert all(isinstance(p, str) for p in recv)
+        # and the events survive the cache round-trip bit-for-bit
+        again = type(summary).from_dict(summary.to_dict())
+        assert [fi.blocking for fi in again.functions] == \
+            [fi.blocking for fi in summary.functions]
+    # the serving tier genuinely waits — an empty harvest means the
+    # scanner regressed, not that serving went lock-free
+    assert total >= 10
+
+
+# ---------------------------------------------------------------------------
+# --jobs: parallel cold pass (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+JOBS_FILES = {
+    "pkg/a.py": """\
+        import threading
+
+        _LOCK = threading.Lock()
+
+        class Worker:
+            def __init__(self):
+                self.jobs = None
+
+            def start(self):
+                threading.Thread(target=self._loop, daemon=True).start()
+
+            def _loop(self):
+                with _LOCK:
+                    return self.jobs.get()
+        """,
+    "pkg/b.py": """\
+        try:
+            import fancy
+        except Exception:
+            pass
+        """,
+    "pkg/c.py": """\
+        def use():
+            with _LOCK:
+                return 1
+
+        from pkg.a import _LOCK
+        """,
+}
+
+
+def test_jobs_parallel_cold_run_is_byte_identical(tmp_path):
+    # the determinism pin: same tree, cold, jobs=1 vs jobs=2 — identical
+    # findings (order included), identical scan bookkeeping
+    write_pkg(tmp_path, JOBS_FILES)
+    serial = run_lint(paths=["."], root=str(tmp_path))
+    par = run_lint(paths=["."], root=str(tmp_path), jobs=2)
+    assert [f.as_dict() for f in par.new] == \
+        [f.as_dict() for f in serial.new]
+    assert par.new != []          # the fixture does produce findings
+    assert par.scanned == serial.scanned
+    assert par.errors == serial.errors
+    assert par.parsed_files == serial.parsed_files > 0
+
+
+def test_jobs_parallel_populates_cache_for_serial_warm_run(tmp_path):
+    # a parallel cold run must leave the SAME cache a serial run would:
+    # the following serial warm run parses nothing and reports equal
+    # findings
+    write_pkg(tmp_path, JOBS_FILES)
+    cache = tmp_path / "cache.json"
+    cold = run_lint(paths=["."], root=str(tmp_path),
+                    cache_path=str(cache), jobs=2)
+    warm = run_lint(paths=["."], root=str(tmp_path),
+                    cache_path=str(cache))
+    assert warm.parsed_files == 0
+    assert warm.findings_cache_hits == warm.total_files
+    assert [f.as_dict() for f in warm.new] == \
+        [f.as_dict() for f in cold.new]
+
+
+def test_jobs_warm_path_is_untouched(tmp_path):
+    # with a hot cache, --jobs must not spin up workers or re-parse:
+    # the warm run with jobs=4 behaves exactly like the serial warm run
+    write_pkg(tmp_path, JOBS_FILES)
+    cache = tmp_path / "cache.json"
+    run_lint(paths=["."], root=str(tmp_path), cache_path=str(cache))
+    warm = run_lint(paths=["."], root=str(tmp_path),
+                    cache_path=str(cache), jobs=4)
+    assert warm.parsed_files == 0
+    assert warm.findings_cache_hits == warm.total_files
+    assert warm.summary_cache_hits == warm.total_files
+
+
+def test_jobs_syntax_error_reported_identically(tmp_path):
+    # a worker hitting a SyntaxError must surface the same error row the
+    # serial path would, not crash the pool
+    write_pkg(tmp_path, dict(JOBS_FILES, **{
+        "pkg/broken.py": "def oops(:\n    pass\n"}))
+    serial = run_lint(paths=["."], root=str(tmp_path))
+    par = run_lint(paths=["."], root=str(tmp_path), jobs=2)
+    assert par.errors == serial.errors
+    assert len(par.errors) == 1 and "broken.py" in par.errors[0]
+    assert [f.as_dict() for f in par.new] == \
+        [f.as_dict() for f in serial.new]

@@ -1032,6 +1032,28 @@ class TestDrain:
         assert snap["serving.requests_total"]["status=failed"] == 1
         assert snap["serving.requests_total"]["status=shed"] == 1
 
+    def test_stop_without_budget_is_bounded_despite_wedged_loop(
+            self, monkeypatch):
+        """ISSUE 19 regression (surfaced by the unbounded-wait lint rule):
+        ``stop()`` with NO drain budget must still return when the loop
+        thread is wedged inside a hung compiled call — the join is
+        bounded by PADDLE_TPU_STOP_JOIN_S and the zombie abandoned,
+        exactly as the budgeted path always promised."""
+        import threading as _th
+        import time as _t
+        monkeypatch.setenv("PADDLE_TPU_STOP_JOIN_S", "0.2")
+        eng = make_engine()
+        release = _th.Event()
+        wedged = _th.Thread(target=release.wait, daemon=True)
+        wedged.start()
+        eng._thread = wedged        # stands in for a wedged loop thread
+        t0 = _t.monotonic()
+        eng.stop()                  # timeout=None: used to join forever
+        assert _t.monotonic() - t0 < 5.0
+        assert eng._thread is None  # the zombie was abandoned
+        release.set()
+        wedged.join(timeout=1)
+
     def test_run_after_requeue_drain_resumes_not_spins(self):
         """run() clears the draining latch like start() does: the offline
         drive mode after stop(drain=True, on_timeout='requeue') must

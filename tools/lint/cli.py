@@ -17,6 +17,9 @@ Usage::
     python -m tools.lint --no-baseline         # show baselined findings too
     python -m tools.lint --no-cache            # ignore + don't write the
                                                # content-hash summary cache
+    python -m tools.lint --jobs 4              # parallel COLD pass (cache-
+                                               # miss files); byte-identical
+                                               # findings, warm path untouched
     python -m tools.lint --update-baseline     # regenerate the grandfather
                                                # list (reviewed diff!)
 
@@ -83,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
                         f"(default: {default_cache_path()})")
     p.add_argument("--no-cache", action="store_true",
                    help="disable the content-hash cache for this run")
+    p.add_argument("--jobs", type=int, default=1, metavar="N",
+                   help="fan the cold pass (parse + per-file rules + "
+                        "summary build for cache-miss files) over N "
+                        "processes; findings are byte-identical to a "
+                        "serial run and the warm-cache path is untouched")
     return p
 
 
@@ -189,7 +197,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                       baseline_entries=entries,
                       changed_only=args.changed_only,
                       diff_base=args.diff_base,
-                      cache_path=cache_path)
+                      cache_path=cache_path,
+                      jobs=args.jobs)
 
     if args.prune_baseline:
         # result.stale is exactly the non-firing budget of this (full)

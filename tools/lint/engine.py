@@ -375,6 +375,44 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # functions whose name ends with one of these own no obligations of
     # their own — the caller holds the handle (mirrors lock_held_suffixes)
     "resource_caller_owns_suffixes": ["_locked"],
+    # unbounded-wait (ISSUE 19): the strict tier mirroring poll_loop_paths
+    # — modules where every blocking primitive reachable from the serving/
+    # supervisor entry roots must be bounded by a timeout argument or run
+    # lexically under resilience.deadline_scope. A wedge inside these is a
+    # permanently hung request/supervisor, exactly what the PR 8/10
+    # watchdogs exist to paper over at runtime.
+    "bounded_wait_paths": [
+        "paddle_tpu/serving",
+        # named explicitly so the strict-tier membership survives a
+        # package split (same convention as poll_loop_paths)
+        "paddle_tpu/serving/http.py",
+        "paddle_tpu/serving/router.py",
+        "paddle_tpu/resilience/watchdog.py",
+        "paddle_tpu/resilience/trainer.py",
+        "paddle_tpu/distributed/ps_service.py",
+    ],
+    # unbounded-wait roots beyond the exception_contracts table: the
+    # long-lived poll threads whose wedge a bounded wait is supposed to
+    # make impossible (path -> ["Class.method", "fn"])
+    "bounded_wait_roots": {
+        "paddle_tpu/serving/router.py": ["Router._poll_loop"],
+        "paddle_tpu/resilience/watchdog.py": ["StepWatchdog._loop"],
+    },
+    # hot-path-stall: contended locks the dispatch fast path legitimately
+    # takes — short critical sections by design, reviewed; everything else
+    # acquired on the fast path AND somewhere off it is a stall finding
+    "hot_path_lock_exempt": [
+        # program-cache lookup/insert: dict ops only, never held across
+        # build/compile (PR 5 moved builds outside the lock)
+        "paddle_tpu.core.dispatch_cache._LOCK",
+        # fallback decision memo: dict get/set only
+        "paddle_tpu.core.fallback._LOCK",
+        # capture-cache lookup: dict ops only, compile happens outside
+        "paddle_tpu.core.step_capture._LOCK",
+        # cost-registry hooks: RLock around dict bookkeeping only (PR 16
+        # pinned zero-overhead-when-disabled)
+        "paddle_tpu.observability.cost._LOCK",
+    ],
 }
 
 
@@ -594,6 +632,31 @@ def _git_changed_files(root: str, base: str = "main") -> Optional[Set[str]]:
         return None
 
 
+def _parallel_scan_worker(payload):
+    """ProcessPoolExecutor worker for ``--jobs``: parse one file, run the
+    per-file rules, build the project summary. Returns plain dicts only
+    (picklable); the PARENT merges results in deterministic serial order,
+    so parallel findings are byte-identical to a serial run."""
+    rel, src, cfg, rule_names = payload
+    import tools.lint.rules  # noqa: F401  (register under spawn start)
+    from .wholeprogram.summary import build_summary
+    out: Dict[str, Any] = {"rel": rel, "error": None, "findings": {},
+                           "summary": None}
+    try:
+        ctx = FileContext(rel, src, cfg)
+    except SyntaxError as e:
+        out["error"] = f"{rel}: {e.__class__.__name__}: {e}"
+        return out
+    per_line, file_level = _pragma_tables(ctx.lines)
+    for name in rule_names:
+        rule = RULES[name]
+        fs = [f for f in (rule.check(ctx) or ())
+              if not _suppressed(f, per_line, file_level)]
+        out["findings"][name] = [f.as_dict() for f in fs]
+    out["summary"] = build_summary(rel, ctx.tree, ctx.lines, cfg).to_dict()
+    return out
+
+
 def run_lint(paths: Optional[Sequence[str]] = None,
              rules: Optional[Sequence[str]] = None,
              config: Optional[Dict[str, Any]] = None,
@@ -601,7 +664,8 @@ def run_lint(paths: Optional[Sequence[str]] = None,
              root: str = REPO_ROOT,
              changed_only: bool = False,
              diff_base: str = "main",
-             cache_path: Optional[str] = None) -> LintResult:
+             cache_path: Optional[str] = None,
+             jobs: int = 1) -> LintResult:
     """Run the engine. ``paths`` may be absolute or ``root``-relative;
     findings always report ``root``-relative paths.
 
@@ -611,6 +675,13 @@ def run_lint(paths: Optional[Sequence[str]] = None,
     the summary cache. ``cache_path`` enables the content-hash cache —
     per-file findings and project summaries keyed by file sha, so warm
     runs skip parsing.
+
+    ``jobs > 1`` fans the COLD work (parse + per-file rules + summary
+    build for cache-miss files) over a process pool; cache-hit files and
+    the whole-program pass stay on the serial path, results are merged
+    in the serial order, and any pool failure falls back to serial — so
+    findings are byte-identical to ``jobs=1`` and the warm-cache path is
+    untouched.
     """
     t_start = time.perf_counter()
     cfg = dict(DEFAULT_CONFIG)
@@ -662,6 +733,52 @@ def run_lint(paths: Optional[Sequence[str]] = None,
     findings: List[Finding] = []
     failed: Set[str] = set()
 
+    # ---- optional parallel cold pass (--jobs) ----
+    # fan the cache-miss files (per-file pass AND summary build) over a
+    # process pool; the serial loops below consume `precomputed` in their
+    # usual deterministic order, so findings match jobs=1 byte for byte
+    precomputed: Dict[str, dict] = {}
+    if jobs and jobs > 1:
+        scan_set = set(scan_files)
+        check = list(scan_files)
+        if project_rules:
+            check = list(dict.fromkeys(list(scan_files) + list(all_files)))
+        need: List[str] = []
+        for abspath in check:
+            rel = rels[abspath]
+            try:
+                sha, _src = read(abspath, rel)
+            except (UnicodeDecodeError, OSError):
+                continue   # the serial loop reports the read error
+            ent = cache.get(rel, sha) if cache else None
+            findings_hit = ent is not None and all(
+                r.name in ent["findings"] for r in file_rules)
+            summary_hit = ent is not None and \
+                ent.get("summary") is not None
+            if (abspath in scan_set and not findings_hit) or \
+                    (project_rules and not summary_hit):
+                need.append(rel)
+        if need:
+            import concurrent.futures as _cf
+            import multiprocessing as _mp
+            rule_names = [r.name for r in file_rules]
+            payloads = [(rel, sources[rel][1], cfg, rule_names)
+                        for rel in need]
+            try:
+                # spawn, not fork: the caller may have threads (pytest,
+                # jax) and a forked child inherits their locks mid-flight
+                with _cf.ProcessPoolExecutor(
+                        max_workers=jobs,
+                        mp_context=_mp.get_context("spawn")) as pool:
+                    for res in pool.map(
+                            _parallel_scan_worker, payloads,
+                            chunksize=max(1, len(payloads) // (jobs * 4))):
+                        precomputed[res["rel"]] = res
+                        if res["error"] is None:
+                            result.parsed_files += 1
+            except Exception:
+                precomputed = {}   # pool failure: plain serial run
+
     # ---- per-file pass over the (possibly narrowed) scan set ----
     for abspath in scan_files:
         rel = rels[abspath]
@@ -680,6 +797,20 @@ def run_lint(paths: Optional[Sequence[str]] = None,
             for r in file_rules:
                 findings.extend(Finding(**d) for d in ent["findings"][r.name])
             continue
+        pre = precomputed.get(rel)
+        if pre is not None:
+            if pre["error"] is not None:
+                result.errors.append(pre["error"])
+                failed.add(rel)
+                continue
+            result.scanned.append(rel)
+            result.files_checked += 1
+            per_rule = pre["findings"]
+            for r in file_rules:
+                findings.extend(Finding(**d) for d in per_rule[r.name])
+            if cache is not None:
+                cache.put_findings(rel, sha, per_rule)
+            continue
         try:
             ctx = parse(rel, src)
         except SyntaxError as e:
@@ -689,7 +820,7 @@ def run_lint(paths: Optional[Sequence[str]] = None,
         result.scanned.append(rel)
         result.files_checked += 1
         per_line, file_level = _pragma_tables(ctx.lines)
-        per_rule: Dict[str, list] = {}
+        per_rule = {}
         for rule in file_rules:
             fs = [f for f in (rule.check(ctx) or ())
                   if not _suppressed(f, per_line, file_level)]
@@ -717,6 +848,14 @@ def run_lint(paths: Optional[Sequence[str]] = None,
             if ent is not None and ent.get("summary") is not None:
                 summaries[rel] = ModuleSummary.from_dict(ent["summary"])
                 result.summary_cache_hits += 1
+                continue
+            pre = precomputed.get(rel)
+            if pre is not None and pre["error"] is None and \
+                    pre["summary"] is not None:
+                s = ModuleSummary.from_dict(pre["summary"])
+                summaries[rel] = s
+                if cache is not None:
+                    cache.put_summary(rel, sha, s.to_dict())
                 continue
             try:
                 ctx = parse(rel, src)

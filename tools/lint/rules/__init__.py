@@ -6,11 +6,13 @@ subclasses and run once per invocation over the whole-program graphs.
 """
 
 from . import (  # noqa: F401
+    blocking_under_lock,
     cross_host_sync,
     cross_trace_impurity,
     device_access,
     exception_contract,
     hot_path_import,
+    hot_path_stall,
     host_sync,
     import_layering,
     lock_order,
@@ -20,5 +22,6 @@ from . import (  # noqa: F401
     silent_swallow,
     span_discipline,
     trace_impurity,
+    unbounded_wait,
     unguarded_global,
 )

@@ -1,0 +1,145 @@
+"""blocking-under-lock: no blocking event on a path from a thread root
+that holds a module / ``self.<attr>`` lock across it.
+
+The lock-order rule sees acquisition ORDER; the race rule sees lock
+DOMINATION. Neither answers the latency question: a lock held across an
+RPC / sleep / queue wait / device sync serializes every thread behind
+one slow call — the replica that stalls all its siblings, the metrics
+scrape that blocks dispatch. This rule propagates the graft-lint 5.0
+may-block events (``FunctionInfo.blocking``) through PR 14's per-call-
+site held-lock reachability: from each thread root, any function reached
+with a non-empty MUST-HOLD lock set whose body blocks is a finding, with
+the full root → … → blocking-site witness chain.
+
+Precision trades (all err toward staying quiet on disciplined code):
+
+* ``lock-acquire`` events are skipped — nested acquisition order is
+  lock-order's domain, and acquiring B under A is only a stall if B is
+  itself held across something slow (which fires at B's site);
+* ``Condition.wait`` RELEASES its own lock while waiting — the waited
+  condition's lock id is subtracted from the held set before judging;
+* bounded sleeps (``jitter_sleep``/``time.sleep`` with a literal) under
+  a lock are flagged only when the held lock is not the sleeping
+  function's own shutdown/poll jitter — concretely: a bounded ``sleep``
+  event is exempt, an unbounded one never is;
+* ``*_locked`` helpers (``lock_held_suffixes``) blocking by design are
+  the CALLER's finding: the event is attributed where the lock was
+  actually taken, so the helper itself is skipped only when nothing in
+  the chain holds a resolvable lock;
+* ``__init__``/``__del__``-style construction/teardown is excluded.
+
+Suppression: pragma on the blocking line, or a baseline entry whose
+reason says why holding across the block is the semantics (e.g. the
+ps_service push lock that serializes RPCs by design).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..engine import Finding, ProjectRule, register_rule
+from .shared_state_race import (_EXCLUDED_FNS, _chain, _chain_text,
+                                _locks_text)
+
+#: kinds that count as "blocking" while a lock is held. lock-acquire is
+#: lock-order's domain; file-io is only a hot-path concern; bounded
+#: sleeps are the shutdown/poll jitter idiom (exempt, see module doc).
+_KINDS = ("sleep", "condition-wait", "queue", "future-wait", "thread-join",
+          "rpc", "subprocess", "device-sync", "jit-compile")
+
+
+def _acquire_site(project, chain, lock_ids):
+    """(module, line) of the first acquisition of any of ``lock_ids``
+    along the witness chain, for the root→acquire→…→site narrative."""
+    for node in chain:
+        m, _qn = node
+        fi = project.fn_by_qual[node]
+        for lr, line in fi.acquires:
+            if project.lock_id(m, list(lr)) in lock_ids:
+                return m, line
+    return None
+
+
+@register_rule
+class BlockingUnderLockRule(ProjectRule):
+    name = "blocking-under-lock"
+    description = ("no sleep/RPC/wait/device-sync reachable from a thread "
+                   "root while a module or self.<attr> lock is held")
+
+    def check_project(self, project):
+        suffixes = tuple(project.config.get("lock_held_suffixes",
+                                            ["_locked"]))
+        roots = project.thread_roots()
+        seen: set = set()
+        for mod, rfi, label in roots:
+            held, parent = project.reachable_with_locks(mod, rfi)
+            chain_memo: Dict[Tuple[str, str], List] = {}
+            for node in sorted(held):
+                m, _qn = node
+                fi = project.fn_by_qual[node]
+                if fi.name in _EXCLUDED_FNS or not fi.blocking:
+                    continue
+                caller_holds = fi.name.endswith(suffixes)
+                for ev in fi.blocking:
+                    kind, detail, bounded, _ds, lrs, recv, line = ev
+                    if kind not in _KINDS:
+                        continue
+                    if kind == "sleep" and bounded:
+                        continue
+                    lex = frozenset(
+                        x for x in (project.lock_id(m, list(lr))
+                                    for lr in lrs) if x is not None)
+                    eff = held[node] | lex
+                    if kind == "condition-wait" and recv is not None:
+                        cid = project.lock_id(m, list(recv))
+                        if cid is not None:
+                            # Condition.wait releases its own lock — and
+                            # the Condition IS that lock when built from
+                            # one (threading.Condition(self._lock) shares
+                            # the id only in source, so drop both names)
+                            eff = eff - {cid}
+                    if not eff and caller_holds:
+                        # the *_locked convention: the caller provably
+                        # holds A lock we cannot resolve here — still a
+                        # blocking call under it
+                        eff = frozenset(["<caller-held lock>"])
+                    if not eff:
+                        continue
+                    key = (m, fi.qualname, line, kind)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    s = project.modules[m]
+                    if s.suppressed(self.name, line):
+                        continue
+                    chain = chain_memo.get(node)
+                    if chain is None:
+                        chain = _chain(parent, node)
+                        chain_memo[node] = chain
+                    related = [
+                        {"path": project.modules[cm].path,
+                         "line": project.fn_by_qual[(cm, cq)].line,
+                         "message": f"witness: '{cq}'"}
+                        for cm, cq in chain]
+                    acq = _acquire_site(project, chain, eff)
+                    if acq is not None:
+                        am, aline = acq
+                        related.append(
+                            {"path": project.modules[am].path,
+                             "line": aline,
+                             "message": f"acquires {_locks_text(eff)}"})
+                    related.append({"path": s.path, "line": line,
+                                    "message": f"blocks: {kind} "
+                                               f"'{detail}'"})
+                    bnd = "bounded" if bounded else "unbounded"
+                    yield Finding(
+                        s.path, line, self.name,
+                        f"{bnd} {kind} '{detail}' in '{fi.qualname}' runs "
+                        f"while holding {_locks_text(eff)} [{label}: "
+                        f"{_chain_text(chain)}] — every thread taking "
+                        f"that lock stalls behind this call; move the "
+                        f"blocking work outside the critical section, "
+                        f"snapshot state under the lock and block after "
+                        f"releasing it, or baseline with the reason "
+                        f"holding across the block IS the semantics",
+                        related=tuple(related))

@@ -1,0 +1,128 @@
+"""hot-path-stall: the dispatch fast path must not sleep, take contended
+locks, do file/socket IO, or trigger non-warmup jit compiles.
+
+cross-host-sync already rejects device→host transfers reachable from
+``fast_path_roots`` — the per-op budget PR 2 bought. This rule extends
+the same reachability to the rest of the stall taxonomy carried by the
+graft-lint 5.0 blocking events:
+
+* ``sleep`` — any sleep on a dispatch chain is a per-op latency cliff;
+* ``lock-acquire`` — only when the lock is CONTENDED (acquired in ≥ 2
+  distinct functions project-wide) and not in ``hot_path_lock_exempt``
+  (the reviewed short-critical-section locks: program-cache lookups,
+  cost-hook bookkeeping);
+* ``file-io`` / ``rpc`` / ``subprocess`` — the OS round-trip classes;
+* ``jit-compile`` — unless a function named ``*warmup*`` is on the
+  chain: deliberate pre-compilation is the point of warmup paths.
+
+Waits (queue/future/condition) are unbounded-wait's domain and locks
+held ACROSS blocking work are blocking-under-lock's; this rule is about
+what the fast path does at all, not how long it could block.
+
+Suppression: pragma on the stalling line, or a baseline entry whose
+reason says the stall is the semantics (debug/bypass seams).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+from ..astutil import path_matches
+from ..engine import Finding, ProjectRule, register_rule
+from .shared_state_race import _chain, _chain_text
+
+_KINDS = ("sleep", "lock-acquire", "file-io", "rpc", "subprocess",
+          "jit-compile")
+
+
+def _contended_locks(project) -> Set[str]:
+    """Lock ids acquired (``with <lock>:``) in ≥ 2 distinct functions
+    anywhere in the project — the locks a fast-path acquisition can
+    actually queue behind."""
+    holders: Dict[str, Set] = {}
+    for mod in sorted(project.modules):
+        for fi in project.modules[mod].functions:
+            for lr, _line in fi.acquires:
+                lid = project.lock_id(mod, list(lr))
+                if lid is not None:
+                    holders.setdefault(lid, set()).add((mod, fi.qualname))
+    return {lid for lid, fns in holders.items() if len(fns) >= 2}
+
+
+@register_rule
+class HotPathStallRule(ProjectRule):
+    name = "hot-path-stall"
+    description = ("no sleeps, contended-lock acquisitions, file/socket "
+                   "IO, or non-warmup jit compiles reachable from the "
+                   "dispatch fast path")
+
+    def check_project(self, project):
+        specs = project.config.get("fast_path_roots", [])
+        roots = []
+        for spec in specs:
+            path, _, fname = spec.partition("::")
+            for mod in sorted(project.modules):
+                s = project.modules[mod]
+                if not path_matches(s.path, [path]):
+                    continue
+                for fi in project.fn_by_simple.get((mod, fname), []):
+                    roots.append((mod, fi, f"{mod}.{fname}"))
+        if not roots:
+            return
+        exempt = set(project.config.get("hot_path_lock_exempt", []))
+        contended = _contended_locks(project)
+        seen: set = set()
+        for mod, rfi, label in roots:
+            _held, parent = project.reachable_with_locks(mod, rfi)
+            chain_memo: Dict = {}
+            for node in sorted(parent):
+                m, _qn = node
+                fi = project.fn_by_qual[node]
+                if not fi.blocking:
+                    continue
+                chain = None
+                for ev in fi.blocking:
+                    kind, detail, _bounded, _ds, _lrs, recv, line = ev
+                    if kind not in _KINDS:
+                        continue
+                    if kind == "lock-acquire":
+                        lid = project.lock_id(m, list(recv)) \
+                            if recv is not None else None
+                        if lid is None or lid in exempt or \
+                                lid not in contended:
+                            continue
+                        what = f"acquisition of contended lock '{lid}'"
+                    else:
+                        what = f"{kind} '{detail}'"
+                    if chain is None:
+                        chain = chain_memo.get(node)
+                        if chain is None:
+                            chain = _chain(parent, node)
+                            chain_memo[node] = chain
+                    if kind == "jit-compile" and any(
+                            "warmup" in cq.lower() for _cm, cq in chain):
+                        continue
+                    key = (m, fi.qualname, line, kind)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    s = project.modules[m]
+                    if s.suppressed(self.name, line):
+                        continue
+                    related = tuple(
+                        {"path": project.modules[cm].path,
+                         "line": project.fn_by_qual[(cm, cq)].line,
+                         "message": f"witness: '{cq}'"}
+                        for cm, cq in chain) + (
+                        {"path": s.path, "line": line,
+                         "message": f"stalls: {what}"},)
+                    yield Finding(
+                        s.path, line, self.name,
+                        f"{what} in '{fi.qualname}' is reachable from "
+                        f"the dispatch fast path (root '{label}') "
+                        f"[{_chain_text(chain)}]: every op dispatch can "
+                        f"pay this stall — move it off the fast path, "
+                        f"guard it behind a slow-path branch, or "
+                        f"baseline with the reason the stall is the "
+                        f"semantics",
+                        related=related)

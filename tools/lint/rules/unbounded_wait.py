@@ -1,0 +1,139 @@
+"""unbounded-wait: every blocking primitive reachable from a serving /
+supervisor entry root must be bounded — a timeout argument the local
+constant reasoning can see, or a lexical ``resilience.deadline_scope``.
+
+A single untimed ``Future.result()`` / ``queue.get()`` / ``Event.wait()``
+/ ``thread.join()`` turns a replica crash into a permanently wedged
+supervisor: the caller blocks forever on an event that will never
+arrive. The PR 8/10 watchdogs catch that wedge at runtime; this rule
+makes it unrepresentable at review time inside the strict tier.
+
+Roots are the declared failure surface (the PR 18 ``exception_contracts``
+table — HTTP handlers, ``Router.submit``, ``Engine.submit``/``stop``,
+the ps RPC handlers, ``TrainingSupervisor.run``) plus the long-lived
+poll threads (``bounded_wait_roots``). Only events inside modules
+matching ``bounded_wait_paths`` fire (strict tier mirroring
+``poll_loop_paths``): a CLI launcher may wait on its child forever, a
+serving thread may not.
+
+An event passes when its boundedness bit is set (literal / env_float-
+derived / computed timeout, ``block=False``) or it runs lexically under
+``deadline_scope`` (``ds``). ``sleep`` (inherently bounded),
+``lock-acquire`` (blocking-under-lock's domain when it matters),
+``device-sync``/``jit-compile``/``file-io`` (bounded by the device/OS,
+hot-path-stall's concern) are not checked here.
+
+Suppression: pragma on the waiting line, or a baseline entry whose
+reason says why the wait must be unbounded (MIGRATING, "Latency
+invariants").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..astutil import path_matches
+from ..engine import Finding, ProjectRule, register_rule
+from .shared_state_race import _chain, _chain_text
+
+_KINDS = ("condition-wait", "queue", "future-wait", "thread-join", "rpc",
+          "subprocess")
+
+
+def _in_paths(path: str, patterns) -> bool:
+    """Directory-prefix-aware membership, same idiom as naked-retry's
+    poll_loop_paths tier."""
+    return any(path == p or path.startswith(p + "/")
+               or path_matches(path, [p]) for p in patterns)
+
+
+def _config_roots(project):
+    """(module, FunctionInfo, label) for exception_contracts +
+    bounded_wait_roots — the same spec resolution as thread_roots."""
+    out = []
+    seen = set()
+
+    def add(mod, fi, label):
+        node = (mod, fi.qualname)
+        if node not in seen:
+            seen.add(node)
+            out.append((mod, fi, label))
+
+    def add_specs(cfg_path, specs, what):
+        for mod in sorted(project.modules):
+            s = project.modules[mod]
+            if not path_matches(s.path, [cfg_path]):
+                continue
+            for spec in specs:
+                if "." in spec:
+                    c2, meth = spec.split(".", 1)
+                    fi = project.methods.get((mod, c2, meth))
+                    if fi is not None:
+                        add(mod, fi, f"{what} '{mod}.{spec}'")
+                else:
+                    for fi in project.fn_by_simple.get((mod, spec), []):
+                        add(mod, fi, f"{what} '{mod}.{spec}'")
+
+    contracts = project.config.get("exception_contracts", {})
+    for cfg_path in sorted(contracts):
+        add_specs(cfg_path, sorted(contracts[cfg_path]), "entry")
+    extra = project.config.get("bounded_wait_roots", {})
+    for cfg_path in sorted(extra):
+        add_specs(cfg_path, extra[cfg_path], "poll thread")
+    return out
+
+
+@register_rule
+class UnboundedWaitRule(ProjectRule):
+    name = "unbounded-wait"
+    description = ("blocking primitives reachable from serving/supervisor "
+                   "roots must carry a timeout or run under "
+                   "resilience.deadline_scope (bounded_wait_paths tier)")
+
+    def check_project(self, project):
+        strict = project.config.get("bounded_wait_paths", [])
+        if not strict:
+            return
+        seen: set = set()
+        for mod, rfi, label in _config_roots(project):
+            _held, parent = project.reachable_with_locks(mod, rfi)
+            chain_memo: Dict[Tuple[str, str], List] = {}
+            for node in sorted(parent):
+                m, _qn = node
+                fi = project.fn_by_qual[node]
+                if not fi.blocking:
+                    continue
+                s = project.modules[m]
+                if not _in_paths(s.path, strict):
+                    continue
+                for ev in fi.blocking:
+                    kind, detail, bounded, ds, _lrs, _recv, line = ev
+                    if kind not in _KINDS or bounded or ds:
+                        continue
+                    key = (m, fi.qualname, line)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    if s.suppressed(self.name, line):
+                        continue
+                    chain = chain_memo.get(node)
+                    if chain is None:
+                        chain = _chain(parent, node)
+                        chain_memo[node] = chain
+                    related = tuple(
+                        {"path": project.modules[cm].path,
+                         "line": project.fn_by_qual[(cm, cq)].line,
+                         "message": f"witness: '{cq}'"}
+                        for cm, cq in chain) + (
+                        {"path": s.path, "line": line,
+                         "message": f"waits: {kind} '{detail}'"},)
+                    yield Finding(
+                        s.path, line, self.name,
+                        f"unbounded {kind} '{detail}' in '{fi.qualname}' "
+                        f"is reachable from {label} "
+                        f"[{_chain_text(chain)}]: a peer that never "
+                        f"answers wedges this entry point forever — pass "
+                        f"a timeout, wrap the call in "
+                        f"resilience.deadline_scope, or baseline with "
+                        f"the reason the wait must be unbounded",
+                        related=related)

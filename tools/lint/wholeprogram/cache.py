@@ -34,7 +34,8 @@ from .summary import SUMMARY_FORMAT
 #: bump to invalidate every existing cache file (format/semantic changes)
 #: (2: graft-lint 3.0 summary schema — call-site lock sets, access
 #: records, spawn roots — and the shared-state-race rule)
-CACHE_FORMAT_VERSION = 3  # 3: graft-lint 4.0 summary fields (raise-sets, resources)
+#: (3: graft-lint 4.0 summary fields — raise-sets, resources)
+CACHE_FORMAT_VERSION = 4  # 4: graft-lint 5.0 blocking events ("blk")
 
 
 def default_cache_path() -> str:

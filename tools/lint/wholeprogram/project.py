@@ -23,6 +23,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
+from ..astutil import path_matches
 from .summary import FunctionInfo, ModuleSummary
 
 #: function node identity in the project call graph
@@ -294,7 +295,6 @@ class Project:
                                 name.startswith("do_"):
                             add(m2, fi,
                                 f"http handler '{m2}.{fi.qualname}'")
-        from ..astutil import path_matches
         cfg = self.config.get("thread_roots", {})
         for cfg_path in sorted(cfg):
             for mod in sorted(self.modules):
@@ -329,6 +329,14 @@ class Project:
             self._resolve_memo: Dict[Tuple[str, str, str], list] = {}
         if not hasattr(self, "_site_memo"):
             self._site_memo: Dict[Node, List[Tuple[str, frozenset]]] = {}
+        # the three may-block rules and the race rule all walk from the
+        # same roots — memoize the full result per root so the warm-cache
+        # runtime does not scale with the rule count
+        if not hasattr(self, "_rwl_memo"):
+            self._rwl_memo: Dict[Node, tuple] = {}
+        cached = self._rwl_memo.get((mod, fi.qualname))
+        if cached is not None:
+            return cached
         memo = self._resolve_memo
 
         def resolve(m: str, f: FunctionInfo, dn: str):
@@ -374,6 +382,7 @@ class Project:
                         if narrowed != held[n2]:
                             held[n2] = narrowed
                             work.append(n2)
+        self._rwl_memo[start] = (held, parent)
         return held, parent
 
     # -- import graph -------------------------------------------------------

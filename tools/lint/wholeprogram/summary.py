@@ -27,6 +27,7 @@ trivial and the summary is exactly what ``SummaryCache`` persists.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
@@ -40,8 +41,11 @@ from ..astutil import (IMPURE_MODULES, IMPURE_PREFIXES, MUTATORS,
 #: records, and spawn-root discovery for the shared-state-race rule;
 #: 3: graft-lint 4.0 — per-function raise-sets with enclosing catch sets,
 #: caught-and-swallowed handler records, resource acquire/release events,
-#: and per-module class base tables for exception-hierarchy matching)
-SUMMARY_FORMAT = 3
+#: and per-module class base tables for exception-hierarchy matching;
+#: 4: graft-lint 5.0 — per-function blocking events, kind-classified with
+#: a timeout-boundedness bit, the lexical held-lock stack and
+#: deadline_scope flag at each site, for the may-block rules)
+SUMMARY_FORMAT = 4
 
 _NP_CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
 
@@ -122,6 +126,16 @@ class FunctionInfo:
     # functions the resource-discipline rule must CFG-analyze; the rule
     # re-walks the AST of acquiring functions for path precision.
     resources: List[list] = field(default_factory=list)
+    # graft-lint 5.0 may-block events, one per call occurrence:
+    # [kind, detail, bounded (0|1), ds (0|1), [held lockrefs], recv, line]
+    # where ``kind`` is one of BLOCKING_KINDS, ``bounded`` comes from local
+    # constant reasoning over the timeout argument (literal number /
+    # env_float-derived / block=False -> 1; absent / literal-None-derived
+    # -> 0), ``ds`` marks sites lexically under resilience.deadline_scope,
+    # the lockref list is the lexical held-lock stack at the site, and
+    # ``recv`` is the receiver's lockref when it resolves to a known lock/
+    # condition object (Condition.wait-releases-its-own-lock exemption).
+    blocking: List[list] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"q": self.qualname, "n": self.name, "c": self.cls,
@@ -136,7 +150,8 @@ class FunctionInfo:
                 "rs": [list(x) for x in self.raises],
                 "cc": [list(x) for x in self.call_catches],
                 "hx": [list(x) for x in self.handlers],
-                "res": [list(x) for x in self.resources]}
+                "res": [list(x) for x in self.resources],
+                "blk": [list(x) for x in self.blocking]}
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "FunctionInfo":
@@ -156,7 +171,11 @@ class FunctionInfo:
                    call_catches=[[x[0], list(x[1]), x[2]]
                                  for x in d["cc"]],
                    handlers=[[list(x[0]), x[1], x[2]] for x in d["hx"]],
-                   resources=[list(x) for x in d["res"]])
+                   resources=[list(x) for x in d["res"]],
+                   blocking=[[x[0], x[1], x[2], x[3],
+                              [list(lr) for lr in x[4]],
+                              list(x[5]) if x[5] else None, x[6]]
+                             for x in d["blk"]])
 
 
 @dataclass
@@ -453,6 +472,189 @@ def _local_names(fn: ast.AST) -> Set[str]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# graft-lint 5.0: may-block events
+# ---------------------------------------------------------------------------
+
+#: every kind a blocking event may carry (pinned by tests; rules subset it)
+BLOCKING_KINDS = ("sleep", "lock-acquire", "condition-wait", "queue",
+                  "future-wait", "thread-join", "rpc", "subprocess",
+                  "device-sync", "jit-compile", "file-io")
+
+_SOCKET_ATTRS = {"recv", "recvfrom", "recv_into", "accept", "sendall",
+                 "connect", "makefile"}
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output"}
+_PATH_MODULES = {"os.path", "posixpath", "ntpath", "path", "osp"}
+_FILE_IO_CALLS = {"open", "os.replace", "os.fsync", "os.rename"}
+#: receiver names that mark a bare ``.get()`` as a queue, not a dict
+_QUEUE_NAME_RE = re.compile(r"(^|_)(q\d*|queue|queues|events|jobs|inbox|"
+                            r"outbox|work|results?)$")
+
+
+def _blocking_consts(fn: ast.AST) -> Dict[str, str]:
+    """One-level local constant kinds for timeout reasoning: name ->
+    "unbounded" when the binding is known literal-None-derived (a ``None``
+    default or an assignment whose value can be ``None``), else "bounded".
+    Conflicting rebinds resolve to "unbounded" — flagging a maybe-untimed
+    wait costs a baseline entry, missing one costs a wedge."""
+    def kind_of(expr) -> str:
+        if isinstance(expr, ast.Constant):
+            return "unbounded" if expr.value is None else "bounded"
+        if isinstance(expr, ast.IfExp):
+            if "unbounded" in (kind_of(expr.body), kind_of(expr.orelse)):
+                return "unbounded"
+            return "bounded"
+        # calls (env_float(...), max(...)), names, arithmetic: the author
+        # computed a bound — trust it
+        return "bounded"
+
+    out: Dict[str, str] = {}
+    args = getattr(fn, "args", None)
+    if args is not None:
+        pos = args.posonlyargs + args.args
+        for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            out[a.arg] = kind_of(d)
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None:
+                out[a.arg] = kind_of(d)
+    for sub in _own_nodes(fn):
+        if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                isinstance(sub.targets[0], ast.Name):
+            name, k = sub.targets[0].id, kind_of(sub.value)
+            out[name] = "unbounded" if out.get(name, k) != k else k
+    return out
+
+
+def _timeout_kind(expr, consts: Dict[str, str]) -> str:
+    """"bounded" | "unbounded" for a timeout argument expression. Absent
+    (None node) and literal ``None`` are unbounded; literal numbers,
+    ``env_float``/``env_int``-derived values, and any computed expression
+    are bounded; a plain name resolves through ``_blocking_consts``."""
+    if expr is None:
+        return "unbounded"
+    if isinstance(expr, ast.Constant):
+        return "unbounded" if expr.value is None else "bounded"
+    if isinstance(expr, ast.Name):
+        return consts.get(expr.id, "bounded")
+    if isinstance(expr, ast.IfExp):
+        if "unbounded" in (_timeout_kind(expr.body, consts),
+                           _timeout_kind(expr.orelse, consts)):
+            return "unbounded"
+        return "bounded"
+    return "bounded"
+
+
+def _classify_blocking(node: ast.Call, dn: str, consts: Dict[str, str],
+                       sock_bounded: bool
+                       ) -> Optional[Tuple[str, bool]]:
+    """``(kind, bounded)`` when the call may block, else ``None``.
+
+    ``dn`` is the dotted callee name ("" when the callee is not a plain
+    dotted chain). Boundedness is one-level constant reasoning over the
+    timeout argument; for every kind that accepts a timeout, absence
+    means unbounded. ``block=False``/``blocking=False`` count as bounded.
+    ``sock_bounded`` marks functions that call ``.settimeout(<non-None>)``
+    somewhere — their raw socket ops inherit the deadline.
+    """
+    f = node.func
+    last = dn.split(".")[-1] if dn else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+
+    def kw(name):
+        for k in node.keywords:
+            if k.arg == name:
+                return k.value
+        return None
+
+    def bounded(expr) -> bool:
+        return _timeout_kind(expr, consts) == "bounded"
+
+    def false_const(expr) -> bool:
+        return isinstance(expr, ast.Constant) and expr.value is False
+
+    tmo = kw("timeout")
+
+    if dn in ("time.sleep", "sleep") or \
+            last in ("jitter_sleep", "_jitter_sleep"):
+        return "sleep", True
+    if isinstance(f, ast.Attribute):
+        recv = f.value
+        if last == "acquire":
+            if false_const(kw("blocking")) or false_const(kw("block")) or \
+                    (node.args and false_const(node.args[0])):
+                return "lock-acquire", True
+            return "lock-acquire", tmo is not None and bounded(tmo)
+        if last in ("wait", "wait_for"):
+            arg = tmo
+            if arg is None:
+                if last == "wait" and node.args:
+                    arg = node.args[0]
+                elif last == "wait_for" and len(node.args) > 1:
+                    arg = node.args[1]
+            return "condition-wait", arg is not None and bounded(arg)
+        if last == "join":
+            base = dotted_name(recv) or ""
+            if base in _PATH_MODULES or isinstance(recv, ast.Constant) or \
+                    len(node.args) >= 2:
+                return None                       # path/str join
+            if node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Constant):
+                    if a.value is None:
+                        return "thread-join", False
+                    if isinstance(a.value, (int, float)) and \
+                            not isinstance(a.value, bool):
+                        return "thread-join", True
+                    return None                   # "sep".join-style
+                if not isinstance(a, ast.Name):
+                    return None                   # iterable arg: str.join
+                return "thread-join", bounded(a)
+            return "thread-join", tmo is not None and bounded(tmo)
+        if last in ("get", "put"):
+            blk = kw("block")
+            nm = recv.attr if isinstance(recv, ast.Attribute) else (
+                recv.id if isinstance(recv, ast.Name) else "")
+            queue_like = bool(_QUEUE_NAME_RE.search(nm.lower()))
+            if last == "put":
+                if tmo is None and blk is None:
+                    return None    # unbounded-capacity put never blocks
+            else:
+                if tmo is None and blk is None and not queue_like:
+                    return None    # dict.get(...)
+                if node.args and not isinstance(node.args[0], ast.Constant):
+                    return None    # positional key: dict.get(key, default)
+                if node.args and not isinstance(node.args[0].value, bool):
+                    return None
+            if false_const(blk) or \
+                    (node.args and false_const(node.args[0])):
+                return "queue", True
+            return "queue", tmo is not None and bounded(tmo)
+        if last == "result":
+            arg = tmo if tmo is not None else (
+                node.args[0] if node.args else None)
+            return "future-wait", arg is not None and bounded(arg)
+        if last in _SOCKET_ATTRS:
+            if tmo is not None:
+                return "rpc", bounded(tmo)
+            return "rpc", sock_bounded
+        if last == "communicate":
+            return "subprocess", tmo is not None and bounded(tmo)
+        if last == "block_until_ready":
+            return "device-sync", True
+        if last in ("item", "numpy") and not node.args:
+            return "device-sync", True
+    if dn.startswith("subprocess.") and last in _SUBPROCESS_FNS:
+        return "subprocess", tmo is not None and bounded(tmo)
+    if dn in ("socket.create_connection", "urllib.request.urlopen",
+              "urlopen"):
+        return "rpc", tmo is not None and bounded(tmo)
+    if dn in ("jax.jit", "jax.pmap"):
+        return "jit-compile", True
+    if dn in _FILE_IO_CALLS:
+        return "file-io", True
+    return None
+
+
 def _scan_function(fn: ast.AST, cls: Optional[str],
                    mutables: Set[str], bindings: Dict[str, str],
                    module_locks: Dict[str, str],
@@ -527,6 +729,14 @@ def _scan_function(fn: ast.AST, cls: Optional[str],
     calls_under_lock: List[Tuple[list, str, int]] = []
     call_locks: List[Tuple[str, list, int]] = []
     accesses: List[list] = []
+    blocking: List[list] = []
+    consts = _blocking_consts(fn)
+    sock_bounded = any(
+        isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)
+        and sub.func.attr == "settimeout" and sub.args
+        and not (isinstance(sub.args[0], ast.Constant)
+                 and sub.args[0].value is None)
+        for sub in _own_nodes(fn))
     # shared-state access tracking (graft-lint 3.0): which self.<attr>
     # fields are in scope (not locks, not Event/Queue-style primitives),
     # and one-level aliases of module mutable globals
@@ -587,22 +797,35 @@ def _scan_function(fn: ast.AST, cls: Optional[str],
                 return ["ext", base, attr]
         return None
 
-    def rec(node, held):
+    def rec(node, held, ds):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             return
         if isinstance(node, (ast.With, ast.AsyncWith)):
-            new = held
+            new, nds = held, ds
             for item in node.items:
-                lr = lockref(item.context_expr)
+                ce = item.context_expr
+                lr = lockref(ce)
                 if lr is not None:
-                    line = item.context_expr.lineno
+                    line = ce.lineno
                     acquires.append((lr, line))
+                    # a ``with <lock>:`` IS a blocking acquire (no timeout
+                    # form exists) — recorded for the hot-path rule; the
+                    # under-lock and unbounded-wait rules skip this kind
+                    blocking.append(["lock-acquire",
+                                     dotted_name(ce) or "lock", 0,
+                                     1 if nds else 0,
+                                     [list(h) for h in new], list(lr),
+                                     line])
                     for h in new:
                         nest_edges.append((h, lr, line))
                     new = new + [lr]
+                elif isinstance(ce, ast.Call):
+                    cdn = dotted_name(ce.func) or ""
+                    if cdn.split(".")[-1] == "deadline_scope":
+                        nds = True
             for child in node.body:
-                rec(child, new)
+                rec(child, new, nds)
             return
         if isinstance(node, ast.Call):
             dn = dotted_name(node.func)
@@ -611,6 +834,18 @@ def _scan_function(fn: ast.AST, cls: Optional[str],
                                    node.lineno))
                 for h in held:
                     calls_under_lock.append((h, dn, node.lineno))
+            blk = _classify_blocking(node, dn or "", consts, sock_bounded)
+            if blk is not None:
+                kind, bnd = blk
+                recv = lockref(node.func.value) \
+                    if isinstance(node.func, ast.Attribute) else None
+                detail = dn or (node.func.attr
+                                if isinstance(node.func, ast.Attribute)
+                                else "")
+                blocking.append([kind, detail, 1 if bnd else 0,
+                                 1 if ds else 0, [list(h) for h in held],
+                                 list(recv) if recv else None,
+                                 node.lineno])
             # in-place mutation through a method: self.attr.append(...)
             # or GLOBAL.setdefault(...) — a WRITE to the container
             if isinstance(node.func, ast.Attribute) and \
@@ -650,15 +885,16 @@ def _scan_function(fn: ast.AST, cls: Optional[str],
                 and node.id in galias:
             add_access(node, "r", held, node.lineno)
         for child in ast.iter_child_nodes(node):
-            rec(child, held)
+            rec(child, held, ds)
 
     for child in ast.iter_child_nodes(fn):
-        rec(child, [])
+        rec(child, [], False)
 
     return {"calls": calls, "impure": impure, "host_syncs": host_syncs,
             "acquires": acquires, "nest_edges": nest_edges,
             "calls_under_lock": calls_under_lock,
-            "call_locks": call_locks, "accesses": accesses}
+            "call_locks": call_locks, "accesses": accesses,
+            "blocking": blocking}
 
 
 # ---------------------------------------------------------------------------
@@ -881,7 +1117,7 @@ def build_summary(path: str, tree: ast.Module, lines: List[str],
     """Distill one parsed module into its JSON-serializable summary."""
     # imported here (not at module top) to avoid an import cycle:
     # engine -> wholeprogram (at run time) -> engine (pragma parsing)
-    from ..engine import _pragma_tables
+    from ..engine import _pragma_tables  # graft-lint: disable=hot-path-import
 
     is_pkg = path.endswith("__init__.py")
     module = module_name_for(path)
@@ -909,7 +1145,8 @@ def build_summary(path: str, tree: ast.Module, lines: List[str],
             call_locks=data["call_locks"], accesses=data["accesses"],
             raises=exc["raises"], call_catches=exc["call_catches"],
             handlers=exc["handlers"],
-            resources=_scan_resources(node, config)))
+            resources=_scan_resources(node, config),
+            blocking=data["blocking"]))
 
     return ModuleSummary(
         path=path, module=module, bindings=bindings,
