@@ -47,7 +47,7 @@ SERVING_RESULT_FIELDS = (
     "benchmark", "params", "layers", "hidden", "dtype", "kv_dtype",
     "page_size", "prompt", "tokens", "single_stream_tokens_per_sec",
     "serving", "paged_attention", "context_sweep", "resilience", "http",
-    "prefix_sharing", "speedup_vs_single_stream", "device")
+    "fleet", "prefix_sharing", "speedup_vs_single_stream", "device")
 SERVING_ROW_FIELDS = (
     "aggregate_tokens_per_sec", "ttft_ms", "tpot_ms", "queue_wait_ms",
     "scan_greedy_parity", "match_frac", "batch_utilization")
@@ -88,6 +88,22 @@ HTTP_RESULT_FIELDS = (
     "e2e_p50_ms", "e2e_p99_ms", "inproc_p50_ms", "overhead_p50_ms",
     "router")
 HTTP_ROUTER_FIELDS = ("retries", "failovers", "hedges", "rejected")
+# the fleet-tier leg (ISSUE 20, --serving --fleet): the SAME workload
+# through in-process Router.submit vs a 2-worker OUT-OF-PROCESS
+# FleetSupervisor — the per-request cost of process isolation + RPC +
+# crash supervision, the fleet tier's overhead of record. Workers are
+# forced onto CPU (one accelerator cannot be shared by N processes), so
+# on a TPU host the honest read is the supervisor counters and the fleet
+# leg's own latencies, not the inproc delta. A healthy run reports
+# respawns / worker_deaths / failovers / rejected all ZERO — any nonzero
+# in a bench diff means the measured run itself degraded (a worker died
+# and was respawned mid-measurement).
+FLEET_RESULT_FIELDS = (
+    "workers", "requests", "clients", "aggregate_tokens_per_sec",
+    "e2e_p50_ms", "e2e_p99_ms", "inproc_p50_ms", "overhead_p50_ms",
+    "supervisor")
+FLEET_SUPERVISOR_FIELDS = (
+    "respawns", "worker_deaths", "failovers", "rejected")
 # the prefix-sharing leg (ISSUE 17, --serving --prompt-overlap): one row
 # per seeded shared-prefix mix (0/50/90% of each prompt is a common
 # page-aligned prefix), sharing ON vs the same workload with sharing OFF.
@@ -226,6 +242,12 @@ def main() -> None:
                     help="with --serving: add the front-door leg — e2e "
                          "p50/p99 and tok/s through the K=2 router + "
                          "streaming HTTP tier vs in-process submit()")
+    ap.add_argument("--fleet", action="store_true",
+                    help="with --serving: add the fleet-tier leg — e2e "
+                         "p50/p99 and tok/s through a 2-worker "
+                         "out-of-process FleetSupervisor vs in-process "
+                         "submit(), plus the supervisor's crash counters "
+                         "(all-zero on a healthy run)")
     ap.add_argument("--prompt-overlap", action="store_true",
                     help="with --serving: add the prefix-sharing leg — a "
                          "seeded 0/50/90%% shared-prefix prompt mix, "
@@ -581,6 +603,9 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
     http_block = _run_http(args, serving, obs, prefill_raw, lm_step,
                            n_new=n_new, L=L, H=H, E=E, V=V, M=M,
                            dtype=dtype) if args.http else None
+    fleet_block = _run_fleet(args, serving, obs, prefill_raw, lm_step,
+                             n_new=n_new, L=L, H=H, E=E, V=V, M=M,
+                             dtype=dtype) if args.fleet else None
     prefix_block = _run_prefix_sharing(
         args, serving, prefill_causal_raw, lm_step, L=L, H=H, E=E, V=V,
         dtype=dtype, on_tpu=on_tpu) if args.prompt_overlap else None
@@ -606,6 +631,7 @@ def _run_serving(args, paddle, prefill_raw, prefill, lm_step, decode_one,
         "context_sweep": sweep,
         "resilience": fire,
         "http": http_block,
+        "fleet": fleet_block,
         "prefix_sharing": prefix_block,
         "speedup_vs_single_stream": round(top / single_rate, 2),
         "device": str(jax.devices()[0]),
@@ -747,6 +773,195 @@ def _run_http(args, serving, obs, prefill_raw, lm_step, *, n_new, L, H, E,
         "http block drifted from HTTP_RESULT_FIELDS"
     assert set(block["router"]) == set(HTTP_ROUTER_FIELDS), \
         "http router block drifted from HTTP_ROUTER_FIELDS"
+    return block
+
+
+def make_fleet_engine(*, name, hidden, inter, layers, heads, vocab,
+                      max_len, page_size, kv_dtype, dtype, max_batch=4):
+    """Fleet-worker factory (``--serving --fleet``): imported by
+    ``paddle_tpu.serving.fleet_worker`` inside each worker process as
+    ``bench_generation:make_fleet_engine``. Rebuilds the bench model
+    under ``paddle.seed(0)`` — the identical seed and layer order the
+    parent used — so every worker (and the parent's in-process
+    comparison engines) carries bit-identical weights and the fleet leg
+    measures transport, not model drift."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, serving
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    paddle.seed(0)
+    with paddle.amp.auto_cast(False):
+        embed = nn.Embedding(vocab, hidden)
+        fmt = FusedMultiTransformer(hidden, heads, inter, num_layers=layers,
+                                    activation="gelu")
+        final_ln = nn.LayerNorm(hidden)
+        head = nn.Linear(hidden, vocab, bias_attr=False)
+    for layer in (embed, fmt, final_ln, head):
+        layer.to(dtype=dtype)
+        layer.eval()
+    fmt.prepare_decode()
+
+    def lm_step(tok, cache, t):
+        x = embed(tok)
+        x, cache = fmt(x, caches=cache, time_step=t)
+        x = final_ln(x)
+        logits = head(x)
+        nxt = paddle.argmax(logits, axis=-1)
+        return nxt.astype("int32"), cache
+
+    def prefill_raw(ids, cache):
+        x = embed(ids)
+        x, cache = fmt(x, caches=cache, time_step=None)
+        x = final_ln(x)
+        logits = head(x[:, -1:])
+        nxt = paddle.argmax(logits, axis=-1)
+        return nxt.astype("int32"), cache
+
+    cfg = serving.ServingConfig(
+        num_layers=layers, num_heads=heads, head_dim=hidden // heads,
+        max_len=max_len, max_batch=max_batch, buckets=(1, 4),
+        page_size=page_size, kv_dtype=kv_dtype, compute_dtype=dtype,
+        name=name)
+    return serving.Engine(prefill_raw, lm_step, cfg)
+
+
+def _run_fleet(args, serving, obs, prefill_raw, lm_step, *, n_new, L, H, E,
+               V, M, dtype):
+    """The fleet-tier leg (ISSUE 20): the SAME workload through (a)
+    in-process ``Router.submit`` over K=2 replicas and (b) a 2-worker
+    OUT-OF-PROCESS ``FleetSupervisor`` (each worker a separate Python
+    process serving the engine over the MAC'd RPC framing), from
+    ``clients`` concurrent client threads. Reports e2e p50/p99 and
+    aggregate tok/s for the fleet leg, the in-process p50, and their
+    difference — the process-isolation + RPC + supervision overhead of
+    record — plus the supervisor's crash counters (all-zero is the
+    healthy-run claim, pinned in test_bench_selfdefense). Workers run
+    with JAX_PLATFORMS=cpu: one accelerator cannot be shared by N
+    processes, so on a TPU host read the supervisor counters and the
+    fleet leg's own numbers, not the inproc delta."""
+    import threading
+
+    workers, clients, per_client = 2, 4, 2
+    n_req = clients * per_client
+    page_size = min(args.page_size, M)
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, V, (args.prompt,), dtype=np.int32)
+               for _ in range(n_req)]
+
+    engines = []
+    for i in range(workers):
+        cfg = serving.ServingConfig(
+            num_layers=L, num_heads=H, head_dim=E // H, max_len=M,
+            max_batch=4, buckets=(1, 4), page_size=page_size,
+            kv_dtype=args.kv_dtype, compute_dtype=dtype, name=f"ip{i}")
+        engines.append((f"ip{i}", serving.Engine(prefill_raw, lm_step, cfg)
+                        .warmup(prompt_lens=[args.prompt])))
+    router = serving.Router(engines).start()
+
+    bench_dir = os.path.dirname(os.path.abspath(__file__))
+    repo_root = os.path.dirname(bench_dir)
+    worker_env = {
+        "JAX_PLATFORMS": "cpu",
+        # the child imports paddle_tpu at interpreter startup (python -m),
+        # BEFORE the spec's pythonpath is applied — the repo root has to
+        # ride in on PYTHONPATH, not on spec.pythonpath
+        "PYTHONPATH": os.pathsep.join(
+            [repo_root] + [p for p in (os.environ.get("PYTHONPATH"),) if p]),
+    }
+    specs = [serving.FleetWorkerSpec(
+        name=f"w{i}",
+        factory="bench_generation:make_fleet_engine",
+        config={"name": f"w{i}", "hidden": E, "inter": args.inter,
+                "layers": L, "heads": H, "vocab": V, "max_len": M,
+                "page_size": page_size, "kv_dtype": args.kv_dtype,
+                "dtype": dtype},
+        pythonpath=[bench_dir],
+        env=worker_env,
+        warmup=[args.prompt]) for i in range(workers)]
+    sup = serving.FleetSupervisor(specs)
+
+    def run_clients(fn):
+        """fn(prompt) -> token count; returns (per-request seconds,
+        wall seconds). A failed request fails the BENCH, not just its
+        worker thread — numbers from a degraded run must never print."""
+        lat, errors, lock = [], [], threading.Lock()
+
+        def worker(chunk):
+            for p in chunk:
+                try:
+                    t0 = time.perf_counter()
+                    ntok = fn(p)
+                    dt = time.perf_counter() - t0
+                    if ntok != n_new:
+                        raise AssertionError(
+                            f"short response: {ntok}/{n_new} tokens")
+                except Exception as e:
+                    with lock:
+                        errors.append(e)
+                    return
+                with lock:
+                    lat.append(dt)
+
+        chunks = [prompts[c::clients] for c in range(clients)]
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(c,))
+                   for c in chunks]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors or len(lat) != n_req:
+            raise RuntimeError(
+                f"fleet bench leg degraded: {len(lat)}/{n_req} requests "
+                f"completed; first error: {errors[0] if errors else None}")
+        return lat, time.perf_counter() - t0
+
+    def inproc(p):
+        fut = router.submit(serving.GenerationRequest(
+            p, max_new_tokens=n_new))
+        return len(fut.result(timeout=300).tokens)
+
+    def via_fleet(p):
+        fut = sup.submit(serving.GenerationRequest(
+            p, max_new_tokens=n_new))
+        return len(fut.result(timeout=300).tokens)
+
+    try:
+        run_clients(inproc)                      # warm the inproc path
+        inproc_lat, _ = run_clients(inproc)
+        sup.start()
+        run_clients(via_fleet)                   # warm worker programs
+        fleet_lat, fleet_wall = run_clients(via_fleet)
+    finally:
+        router.stop(drain=True, timeout=60)
+        sup.stop(drain=True, timeout=60)
+
+    snap = obs.snapshot()
+    deaths = snap.get("fleet.worker_deaths_total", {}) or {}
+    rejected = snap.get("serving.router.rejected_total", {}) or {}
+    block = {
+        "workers": workers, "requests": n_req, "clients": clients,
+        "aggregate_tokens_per_sec": round(n_req * n_new / fleet_wall, 1),
+        "e2e_p50_ms": round(1e3 * float(np.percentile(fleet_lat, 50)), 2),
+        "e2e_p99_ms": round(1e3 * float(np.percentile(fleet_lat, 99)), 2),
+        "inproc_p50_ms": round(
+            1e3 * float(np.percentile(inproc_lat, 50)), 2),
+        "overhead_p50_ms": round(
+            1e3 * float(np.percentile(fleet_lat, 50)
+                        - np.percentile(inproc_lat, 50)), 2),
+        "supervisor": {
+            "respawns": snap.get("fleet.respawns_total", 0) or 0,
+            "worker_deaths": sum(deaths.values())
+            if isinstance(deaths, dict) else deaths,
+            "failovers": snap.get(
+                "serving.router.failovers_total", 0) or 0,
+            "rejected": sum(rejected.values()),
+        },
+    }
+    assert set(block) == set(FLEET_RESULT_FIELDS), \
+        "fleet block drifted from FLEET_RESULT_FIELDS"
+    assert set(block["supervisor"]) == set(FLEET_SUPERVISOR_FIELDS), \
+        "fleet supervisor block drifted from FLEET_SUPERVISOR_FIELDS"
     return block
 
 

@@ -29,6 +29,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from collections import namedtuple
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Optional
@@ -38,7 +39,8 @@ from ..resilience import faults as _faults
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos",
-           "get_current_worker_info", "RpcTransportError"]
+           "get_current_worker_info", "RpcTransportError",
+           "send_msg", "recv_msg"]
 
 
 class RpcTransportError(ConnectionError):
@@ -89,19 +91,28 @@ class FutureWrapper:
 _MAC_LEN = 32  # sha256 digest
 
 
-def _mac(payload: bytes) -> bytes:
-    secret = _state.get("secret")
+def _mac(payload: bytes, secret: Optional[bytes] = None) -> bytes:
+    if secret is None:
+        secret = _state.get("secret")
     if not secret:
         raise RuntimeError("rpc not initialized (no job secret)")
     return _hmac.new(secret, payload, hashlib.sha256).digest()
 
 
-def _send_msg(sock: socket.socket, payload: bytes) -> None:
-    payload = _mac(payload) + payload
+def send_msg(sock: socket.socket, payload: bytes,
+             secret: Optional[bytes] = None) -> None:
+    """One length-prefixed, MAC'd frame. ``secret=None`` uses the job
+    secret ``init_rpc`` installed; an explicit ``secret`` lets transports
+    that distribute their key out-of-band (the serving fleet tier) reuse
+    this framing without the rendezvous store."""
+    payload = _mac(payload, secret) + payload
     sock.sendall(struct.pack("<Q", len(payload)) + payload)
 
 
-def _recv_msg(sock: socket.socket) -> bytes:
+def recv_msg(sock: socket.socket, secret: Optional[bytes] = None) -> bytes:
+    """Inverse of :func:`send_msg`: reads one frame, verifies its MAC.
+    A peer hanging up mid-frame raises ``ConnectionError`` as soon as the
+    kernel reports the closed stream — never a silent short read."""
     header = b""
     while len(header) < 8:
         chunk = sock.recv(8 - len(header))
@@ -118,9 +129,14 @@ def _recv_msg(sock: socket.socket) -> bytes:
             raise ConnectionError("rpc peer closed mid-message")
         buf.extend(chunk)
     mac, payload = bytes(buf[:_MAC_LEN]), bytes(buf[_MAC_LEN:])
-    if not _hmac.compare_digest(mac, _mac(payload)):
+    if not _hmac.compare_digest(mac, _mac(payload, secret)):
         raise ConnectionError("rpc message failed authentication")
     return payload
+
+
+# job-secret shorthands (the in-package callers)
+_send_msg = send_msg
+_recv_msg = recv_msg
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -240,11 +256,33 @@ def _dial(info, timeout):
             attempt.fail(e)  # re-raises the OSError once the budget is spent
 
 
+def _effective_timeout(timeout) -> Optional[float]:
+    """The call's TOTAL budget in seconds: an explicit positive ``timeout``
+    wins; the paddle ``-1``/``None`` sentinel inherits what remains of the
+    ambient :class:`resilience.deadline_scope` (None = unbounded). A fleet/
+    serving call made under a request deadline is therefore bounded end to
+    end without every call site re-plumbing the number."""
+    if timeout is not None and timeout > 0:
+        return float(timeout)
+    ambient = _resil.current_deadline()
+    if ambient is None:
+        return None
+    return max(1e-3, ambient - time.monotonic())
+
+
 def _call(to: str, fn, args, kwargs, timeout):
     info = get_worker_info(to)
     _faults.fault_point("rpc.call")
+    total = _effective_timeout(timeout)
+    deadline = None if total is None else time.monotonic() + total
     try:
-        with _dial(info, timeout) as sock:
+        with _dial(info, total) as sock:
+            # bound the wire phase by what remains of the budget: a peer
+            # that dies mid-reply surfaces ECONNRESET/EOF promptly through
+            # recv_msg, and a peer that WEDGES (accepts, never answers)
+            # trips socket.timeout instead of hanging the caller forever
+            if deadline is not None:
+                sock.settimeout(max(1e-3, deadline - time.monotonic()))
             _send_msg(sock, pickle.dumps((fn, args or (), kwargs or {})))
             ok, payload = pickle.loads(_recv_msg(sock))
         # lost-reply seam: the peer EXECUTED the call but the reply
@@ -260,7 +298,9 @@ def _call(to: str, fn, args, kwargs, timeout):
 
 
 def rpc_sync(to: str, fn, args=None, kwargs=None, timeout=-1):
-    """Run ``fn`` on worker ``to``; block for the result."""
+    """Run ``fn`` on worker ``to``; block for the result. ``timeout=-1``
+    (the paddle sentinel) bounds the call by the ambient
+    ``resilience.deadline_scope`` when one is installed."""
     return _call(to, fn, args, kwargs, timeout)
 
 

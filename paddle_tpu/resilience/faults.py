@@ -80,6 +80,21 @@ Sites threaded through the framework (exact-match tags):
                       writer runs (compose with ``checkpoint.write`` /
                       ``checkpoint.commit`` to kill deeper); a killed
                       save leaves the previous checkpoint loadable
+``fleet.spawn``       ``serving.fleet`` supervisor, before each worker
+                      ``Popen`` (first spawn and every respawn) — an
+                      injected error burns one respawn attempt against
+                      the ``PADDLE_TPU_FLEET_MAX_RESPAWNS`` cap
+``fleet.heartbeat``   before each monitor-thread heartbeat RPC — an
+                      injected error is a missed beat; enough
+                      consecutive misses cross the staleness threshold
+                      and latch the replica out of rotation (a later
+                      good beat restores it). Separate from
+                      ``fleet.rpc`` so background beats never perturb
+                      the data-plane call indices (determinism)
+``fleet.rpc``         before each data-plane RPC to a worker (submit /
+                      cancel / withdraw / drain / prefix_summary) — an
+                      injected error before admission is a transport
+                      failure the router fails over (never admitted)
 ====================  =====================================================
 
 Kinds: ``delay`` sleeps; ``error`` raises a fresh instance of the

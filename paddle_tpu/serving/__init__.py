@@ -63,6 +63,8 @@ from .watchdog import StepWatchdog, WatchdogTimeout  # noqa: F401
 from .router import (NoHealthyReplica, Replica, Router,  # noqa: F401
                      RouterConfig)
 from .http import FrontDoor, retry_after_s, status_for  # noqa: F401
+from .fleet import (FleetSupervisor, FleetWorkerLost,  # noqa: F401
+                    FleetWorkerSpec, ProcessReplica, RemoteEngine)
 
 __all__ = [
     "KVCacheConfig", "PagedKVCache", "PagedDecodeCache",
@@ -71,4 +73,6 @@ __all__ = [
     "EngineStopped", "DrainTimeout", "StepWatchdog", "WatchdogTimeout",
     "NoHealthyReplica", "Replica", "Router", "RouterConfig",
     "FrontDoor", "status_for", "retry_after_s",
+    "FleetSupervisor", "FleetWorkerSpec", "FleetWorkerLost",
+    "ProcessReplica", "RemoteEngine",
 ]

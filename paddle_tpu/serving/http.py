@@ -35,6 +35,11 @@ The exception → status mapping (pinned in README/MIGRATING):
 :class:`BreakerOpen`,                  failure before admission
 :class:`WatchdogTimeout`,
 ``ConnectionError``
+:class:`RpcTransportError`      503    fleet worker died AFTER admitting
+                                       (tokens already streamed) —
+                                       at-most-once forbids a silent
+                                       re-send; ``Retry-After`` tells
+                                       the client to resubmit
 ``ValueError``                  400    malformed request
 anything else                   500    bug — never mapped to overload
 ==============================  =====  ==================================
@@ -72,6 +77,9 @@ from ..observability import trace as _trace
 from ..observability.http import QuietJSONHandler, ServerHost
 from ..resilience import DeadlineExceeded, faults as _faults
 from ..resilience.breaker import BreakerOpen
+# pinned into the api import layer (tools/lint import_layers): the rpc
+# transport is a leaf shared with the fleet tier
+from ..distributed.rpc import RpcTransportError
 from .engine import EngineStopped
 from .router import NoHealthyReplica, Router
 from .scheduler import GenerationRequest, QueueFull
@@ -103,6 +111,11 @@ _STATUS_MAP: Tuple[Tuple[type, int], ...] = (
     (NoHealthyReplica, 503),
     (BreakerOpen, 503),
     (WatchdogTimeout, 503),
+    # a fleet worker that died AFTER admitting (tokens streamed): the
+    # at-most-once contract forbids a silent re-send, so the client gets
+    # an honest 503 + Retry-After and decides. Sits above its
+    # ConnectionError base only for documentation — both answer 503.
+    (RpcTransportError, 503),
     (ConnectionError, 503),
     (ValueError, 400),
 )

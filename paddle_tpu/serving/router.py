@@ -69,7 +69,7 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set
 
 from .. import observability as _obs
 from ..observability import trace as _trace
@@ -216,14 +216,22 @@ class Router:
     spins up every replica engine plus the health-poll thread, ``stop``
     reverses both."""
 
-    def __init__(self, replicas: Sequence[Tuple[str, Engine]],
+    def __init__(self, replicas: Sequence,
                  config: Optional[RouterConfig] = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.config = config or RouterConfig()
         self._replicas: Dict[str, Replica] = {}
         beacons = set()
-        for name, eng in replicas:
+        for item in replicas:
+            # two spellings: (name, Engine) pairs get wrapped into the
+            # default in-process Replica; a pre-built Replica (the fleet
+            # tier's ProcessReplica subclass, carrying its own breaker and
+            # health signal) is adopted as-is
+            if isinstance(item, Replica):
+                name, eng = item.name, item.engine
+            else:
+                name, eng = item
             if name in self._replicas:
                 raise ValueError(f"duplicate replica name {name!r}")
             if eng.beacon in beacons:
@@ -237,9 +245,11 @@ class Router:
                     f"{eng.beacon!r} with another replica — give each "
                     f"engine a distinct ServingConfig.name")
             beacons.add(eng.beacon)
-            self._replicas[name] = Replica(
-                name, eng, breaker_threshold=self.config.breaker_threshold,
-                breaker_cooldown=self.config.breaker_cooldown)
+            self._replicas[name] = item if isinstance(item, Replica) \
+                else Replica(
+                    name, eng,
+                    breaker_threshold=self.config.breaker_threshold,
+                    breaker_cooldown=self.config.breaker_cooldown)
         self._order = sorted(self._replicas)
         self._rng = random.Random(self.config.seed)
         self._lock = threading.Lock()
@@ -319,6 +329,19 @@ class Router:
                 self._out.add(name)
                 self.trace.append(("out", name))
         rep.engine.stop(drain=True, timeout=timeout, on_timeout=on_timeout)
+
+    def latch_out(self, name: str) -> None:
+        """Take ONE replica out of rotation WITHOUT draining it — the
+        supervisor's dead-worker latch (ISSUE 20): the process behind the
+        replica is already gone, so there is nothing to drain, but no
+        failover or hedge may target it until :meth:`restore_replica`
+        puts the respawned worker back."""
+        if name not in self._replicas:
+            raise KeyError(name)
+        with self._lock:
+            if name not in self._out:
+                self._out.add(name)
+                self.trace.append(("out", name))
 
     def restore_replica(self, name: str) -> None:
         """Put a drained replica back in rotation (after its engine was

@@ -729,6 +729,7 @@ def test_serving_bench_wired_into_main():
     assert "--kv-dtype" in src        # the int8 leg is reachable from CLI
     assert "--context-sweep" in src   # the long-context leg (ISSUE 13)
     assert "--http" in src            # the front-door leg (ISSUE 15)
+    assert "--fleet" in src           # the fleet-tier leg (ISSUE 20)
 
 
 def test_http_bench_pins_schema():
@@ -759,6 +760,45 @@ def test_http_bench_pins_schema():
     # wired: _run_serving emits the block (None without --http)
     serving_src = inspect.getsource(mod._run_serving)
     assert "_run_http" in serving_src and "args.http" in serving_src
+
+
+def test_fleet_bench_pins_schema():
+    # the --serving --fleet leg (ISSUE 20): e2e latency through a
+    # 2-worker OUT-OF-PROCESS FleetSupervisor vs in-process submit(),
+    # with the supervisor's crash counters — all-zero-on-healthy is the
+    # claim of record, so a bench diff showing respawns/worker_deaths/
+    # failovers/rejections means the measured run itself degraded (a
+    # worker died and was respawned mid-measurement)
+    mod = _load_bench_generation()
+    assert set(mod.FLEET_RESULT_FIELDS) == {
+        "workers", "requests", "clients", "aggregate_tokens_per_sec",
+        "e2e_p50_ms", "e2e_p99_ms", "inproc_p50_ms", "overhead_p50_ms",
+        "supervisor"}
+    assert set(mod.FLEET_SUPERVISOR_FIELDS) == {
+        "respawns", "worker_deaths", "failovers", "rejected"}
+    assert "fleet" in mod.SERVING_RESULT_FIELDS
+    import inspect
+    src = inspect.getsource(mod._run_fleet)
+    # the block is asserted against the pinned schema at emit time, and
+    # every pinned field is actually emitted
+    assert "FLEET_RESULT_FIELDS" in src and "FLEET_SUPERVISOR_FIELDS" in src
+    for field in mod.FLEET_RESULT_FIELDS + mod.FLEET_SUPERVISOR_FIELDS:
+        assert f'"{field}"' in src, field
+    # the overhead is DERIVED from the two measured p50s over the same
+    # prompts, and the fleet path really is the out-of-process tier
+    assert "overhead_p50_ms" in src and "inproc" in src
+    assert "FleetSupervisor" in src and "FleetWorkerSpec" in src
+    # a degraded leg (short response, dead worker) fails the bench run
+    # instead of printing numbers
+    assert "degraded" in src
+    # the worker factory ships in the bench module itself, importable as
+    # bench_generation:make_fleet_engine by the worker process, and
+    # rebuilds under the parent's seed so weights are bit-identical
+    factory_src = inspect.getsource(mod.make_fleet_engine)
+    assert "seed(0)" in factory_src and "ServingConfig" in factory_src
+    # wired: _run_serving emits the block (None without --fleet)
+    serving_src = inspect.getsource(mod._run_serving)
+    assert "_run_fleet" in serving_src and "args.fleet" in serving_src
 
 
 # ---------------------------------------------------------------------------

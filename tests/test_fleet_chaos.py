@@ -1,0 +1,190 @@
+"""ISSUE 20 kill-storm soak: seeded fault storms + a real SIGKILL.
+
+Slow tier (``-m slow``): the acceptance proof for the fleet tier's
+at-most-once contract under composed chaos —
+
+* **Part A (deterministic storm):** a scripted ``fleet.rpc``
+  ``FaultSchedule`` drives transport faults into a fixed sequence of
+  sequential submits. The SAME storm replayed against the SAME fleet
+  (fresh schedule, counters restart) yields an identical rid-normalized
+  outcome map and an identical fault trace — the determinism witness.
+  Replica NAMES are normalized away: the pick RNG and heartbeat-cached
+  scores may place work differently between runs, but outcomes (which
+  requests complete, with which tokens, which fail with which type)
+  may not differ.
+* **Part B (real SIGKILL):** the fleet is loaded past one worker's
+  batch capacity, then a live worker is SIGKILLed mid-flight. Every
+  submitted request resolves with exactly one typed outcome: completed
+  requests are bit-identical to the dense reference (zero-token victims
+  of the dead worker failed over — never-admitted proof), mid-stream
+  victims raise ``RpcTransportError`` (admitted: a silent re-send is
+  forbidden). Afterwards no survivor leaks pages
+  (``outstanding_pages == 0`` over the heartbeat), and the respawned
+  worker rejoins rotation and serves.
+
+Process budget: one module-scoped 2-worker fleet + exactly one respawn —
+3 worker boots total (the 1-core CI host pays a fresh jax import + toy
+compile per boot).
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import paddle_tpu  # noqa: F401  (backend pin via conftest)
+from paddle_tpu.distributed.rpc import RpcTransportError
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving.router import RouterConfig
+
+from test_fleet import (N_NEW, PROMPTS, _make_fleet, _submit,
+                        _wait_rotation, dense_reference)
+
+pytestmark = pytest.mark.slow
+
+_REFS = None
+
+
+def _refs():
+    global _REFS
+    if _REFS is None:
+        _REFS = [dense_reference(p, N_NEW) for p in PROMPTS]
+    return _REFS
+
+
+@pytest.fixture(scope="module")
+def chaos_fleet():
+    sup = _make_fleet(
+        ["c0", "c1"],
+        # high threshold: the storm's scripted faults must exercise the
+        # failover path, not collapse into breaker fast-fails whose
+        # placement depends on which replica absorbed the faults
+        router_config=RouterConfig(breaker_threshold=10, seed=0),
+        max_respawns=3)
+    sup.start()
+    yield sup
+    faults.uninstall()
+    sup.stop(drain=True, timeout=60)
+
+
+# the scripted storm: 10 sequential submits; fleet.rpc call indices run
+# 1,2,... with one call per placement attempt. on=[2,5,6,9] makes
+# submit #2 fault once and fail over, submit #4 fault on BOTH replicas
+# (typed ConnectionError rejection), submit #7 fault once and fail over.
+_STORM_ON = [2, 5, 6, 9]
+_STORM_SUBMITS = 10
+_EXPECTED_FAULT_TRACE = [("fleet.rpc", i, "error") for i in _STORM_ON]
+
+
+def _run_storm(sup):
+    """One storm pass: fresh scripted schedule, sequential submits,
+    rid-normalized outcomes (submission index -> typed outcome)."""
+    sched = faults.FaultSchedule(seed=0).error("fleet.rpc", on=_STORM_ON)
+    faults.install(sched)
+    outcomes = {}
+    try:
+        for i in range(_STORM_SUBMITS):
+            prompt = PROMPTS[i % len(PROMPTS)]
+            try:
+                fut, toks = _submit(sup, prompt)
+                res = fut.result(timeout=120)
+                outcomes[i] = ("ok", tuple(res.tokens))
+            except Exception as exc:
+                outcomes[i] = ("err", type(exc).__name__)
+    finally:
+        faults.uninstall()
+    return outcomes, list(sched.trace)
+
+
+class TestSeededStorm:
+    def test_storm_is_deterministic_and_typed(self, chaos_fleet):
+        refs = _refs()
+        out1, trace1 = _run_storm(chaos_fleet)
+        out2, trace2 = _run_storm(chaos_fleet)
+
+        # the determinism witness: same storm, same normalized outcomes
+        assert out1 == out2
+        assert trace1 == trace2 == _EXPECTED_FAULT_TRACE
+
+        # every outcome is the TYPED one the script predicts: submit #3
+        # (0-based) burns both replicas -> typed transport rejection;
+        # everything else completes bit-identical to the dense oracle
+        for i, outcome in out1.items():
+            if i == 3:
+                assert outcome == ("err", "FaultInjected"), outcome
+            else:
+                assert outcome == ("ok", tuple(refs[i % len(PROMPTS)])), i
+
+    def test_storm_left_no_pages_behind(self, chaos_fleet):
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            stats = [chaos_fleet.worker_stats(n) for n in ("c0", "c1")]
+            if all(s.get("outstanding_pages") == 0 and
+                   s.get("active_requests") == 0 for s in stats):
+                return
+            time.sleep(0.2)
+        raise AssertionError(f"pages leaked after the storm: {stats}")
+
+
+class TestRealSigkill:
+    def test_sigkill_under_load_every_future_typed(self, chaos_fleet):
+        """Load past one worker's batch capacity, SIGKILL it live, and
+        hold the acceptance invariants over EVERY submitted request."""
+        refs = _refs()
+        n_req = 6
+        streams = {i: [] for i in range(n_req)}
+        futs = {}
+        for i in range(n_req):
+            fut, toks = _submit(chaos_fleet, PROMPTS[i % len(PROMPTS)])
+            futs[i] = fut
+            streams[i] = toks
+        victim = "c0"
+        os.kill(chaos_fleet.worker_pids()[victim], signal.SIGKILL)
+
+        outcomes = {}
+        for i, fut in futs.items():
+            try:
+                # exactly one typed outcome per request — a timeout here
+                # is a stranded future, the cardinal failure
+                res = fut.result(timeout=180)
+                outcomes[i] = ("ok", tuple(res.tokens))
+            except RpcTransportError:
+                outcomes[i] = ("err", "RpcTransportError")
+            except Exception as exc:
+                outcomes[i] = ("err", type(exc).__name__)
+
+        for i, outcome in outcomes.items():
+            ref = tuple(refs[i % len(PROMPTS)])
+            if outcome[0] == "ok":
+                # completed work — including zero-token victims failed
+                # over off the corpse — is bit-identical to the oracle
+                assert outcome[1] == ref, (i, outcome)
+            else:
+                # the only allowed typed failure is the admitted-victim
+                # classification: tokens already streamed, so a silent
+                # re-send is forbidden (at-most-once)
+                assert outcome == ("err", "RpcTransportError"), (i, outcome)
+                assert len(streams[i]) > 0, \
+                    f"request {i}: zero-token death must fail over, " \
+                    f"not surface transport error"
+
+        # the supervisor noticed, classified, and respawned
+        _wait_rotation(chaos_fleet, ["c0", "c1"], timeout=120)
+
+        # no survivor leaks pages once the dust settles
+        deadline = time.monotonic() + 60.0
+        stats = {}
+        while time.monotonic() < deadline:
+            stats = {n: chaos_fleet.worker_stats(n) for n in ("c0", "c1")}
+            if all(s.get("outstanding_pages") == 0 and
+                   s.get("active_requests") == 0 for s in stats.values()):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"pages leaked after the kill: {stats}")
+
+        # ... and the fresh incarnation serves, bit-identically
+        fut, toks = _submit(chaos_fleet, PROMPTS[0])
+        assert list(fut.result(timeout=120).tokens) == refs[0]
+        assert toks == refs[0]

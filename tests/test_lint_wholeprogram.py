@@ -1272,6 +1272,85 @@ def test_http_serving_tier_lint_config_membership():
     assert "ServerHost.close" in roots["paddle_tpu/observability/http.py"]
 
 
+def test_fleet_tier_lint_config_membership():
+    # ISSUE 20: the fleet tier joins every strict lint tier the rest of
+    # serving lives in — a package split or rename breaks THIS test, not
+    # silently the analyses
+    from tools.lint.engine import DEFAULT_CONFIG
+
+    # naked-retry strict tier + unbounded-wait strict tier
+    for key in ("poll_loop_paths", "bounded_wait_paths"):
+        tier = DEFAULT_CONFIG[key]
+        assert "paddle_tpu/serving/fleet.py" in tier, key
+        assert "paddle_tpu/serving/fleet_worker.py" in tier, key
+
+    # the long-lived loops are unbounded-wait roots
+    bw_roots = DEFAULT_CONFIG["bounded_wait_roots"]
+    assert "FleetSupervisor._monitor_loop" in \
+        bw_roots["paddle_tpu/serving/fleet.py"]
+    assert "main" in bw_roots["paddle_tpu/serving/fleet_worker.py"]
+
+    # import layering: the rpc transport submodule is carved into the
+    # api layer (most-specific prefix wins) so serving/fleet may import
+    # it at module scope; the REST of distributed stays a higher layer
+    layers = DEFAULT_CONFIG["import_layers"]
+    api = next(layer for layer in layers if layer["name"] == "api")
+    assert "paddle_tpu.distributed.rpc" in api["prefixes"]
+    dist = next(layer for layer in layers if layer["name"] == "distributed")
+    assert "paddle_tpu.distributed" in dist["prefixes"]
+
+    # shared-state-race roots: supervisor caller surface + monitor
+    # thread + reader threads, and the worker-side handler surface
+    roots = DEFAULT_CONFIG["thread_roots"]
+    fleet_roots = roots["paddle_tpu/serving/fleet.py"]
+    for entry in ("FleetSupervisor.start", "FleetSupervisor.stop",
+                  "FleetSupervisor._monitor_loop", "RemoteEngine.submit",
+                  "RemoteEngine._read_stream"):
+        assert entry in fleet_roots, entry
+    worker_roots = roots["paddle_tpu/serving/fleet_worker.py"]
+    for entry in ("_Handler.handle", "_srv_submit", "main"):
+        assert entry in worker_roots, entry
+
+    # exception contracts: the worker-side handlers mirror the PS
+    # service convention; the supervisor's spawn-failure surface is typed
+    contracts = DEFAULT_CONFIG["exception_contracts"]
+    fw = contracts["paddle_tpu/serving/fleet_worker.py"]
+    assert {"_srv_submit", "_srv_cancel", "_srv_withdraw", "_srv_drain",
+            "_srv_prefix_summary", "_srv_beat"} <= set(fw)
+    assert "QueueFull" in fw["_srv_submit"]
+    assert "DrainTimeout" in fw["_srv_drain"]
+    assert "FleetWorkerLost" in contracts[
+        "paddle_tpu/serving/fleet.py"]["FleetSupervisor.start"]
+
+
+def test_fleet_tier_thread_roots_resolve_on_shipped_tree():
+    """Every registered fleet thread root resolves to a real function on
+    the shipped tree — a rename breaks THIS test, not silently the race
+    analysis."""
+    import ast
+    import os
+
+    from tools.lint.engine import (DEFAULT_CONFIG, REPO_ROOT,
+                                   iter_python_files)
+    from tools.lint.wholeprogram.project import Project
+    from tools.lint.wholeprogram.summary import build_summary
+
+    summaries = {}
+    for abspath in iter_python_files(["paddle_tpu/serving"]):
+        rel = os.path.relpath(abspath, REPO_ROOT).replace(os.sep, "/")
+        with open(abspath, encoding="utf-8") as fh:
+            src = fh.read()
+        summaries[rel] = build_summary(
+            rel, ast.parse(src), src.splitlines(), DEFAULT_CONFIG)
+    project = Project(summaries, DEFAULT_CONFIG)
+    labels = {label for _m, _fi, label in project.thread_roots()}
+    for needle in ("FleetSupervisor.start", "FleetSupervisor.stop",
+                   "FleetSupervisor._monitor_loop", "RemoteEngine.submit",
+                   "RemoteEngine._read_stream", "_Handler.handle",
+                   "_srv_submit"):
+        assert any(needle in lab for lab in labels), (needle, labels)
+
+
 def test_http_serving_tier_thread_roots_resolve_on_shipped_tree():
     """The registered router roots and the front door's discovered do_*
     handler methods all resolve to real functions on the shipped tree —
@@ -1704,11 +1783,14 @@ def test_router_contract_types_are_status_mapped():
     from paddle_tpu.serving.router import NoHealthyReplica
     from paddle_tpu.serving.scheduler import QueueFull
     from paddle_tpu.resilience.policy import DeadlineExceeded
+    from paddle_tpu.distributed.rpc import RpcTransportError
 
     ns = {"QueueFull": QueueFull, "DeadlineExceeded": DeadlineExceeded,
           "EngineStopped": EngineStopped,
           "NoHealthyReplica": NoHealthyReplica,
-          "ConnectionError": ConnectionError, "ValueError": ValueError}
+          "ConnectionError": ConnectionError, "ValueError": ValueError,
+          # ISSUE 20: a fleet worker dying before admission
+          "RpcTransportError": RpcTransportError}
     allowed = DEFAULT_CONFIG["exception_contracts"][
         "paddle_tpu/serving/router.py"]["Router.submit"]
     assert set(allowed) == set(ns)

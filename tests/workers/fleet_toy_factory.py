@@ -1,0 +1,98 @@
+"""Engine factory the fleet worker processes load (ISSUE 20 tests).
+
+A self-contained copy of ``tests/test_serving.py``'s toy LM — the fleet
+worker imports this by name (``fleet_toy_factory:make_engine``) in a
+FRESH process, so it cannot reach into the pytest module; the two copies
+must stay numerically identical (the parity test in ``test_fleet.py``
+compares streamed tokens against the in-process ``dense_reference``).
+
+Greedy argmax over a cache-dependent, position-weighted readout: paging
+or streaming mistakes change the decoded SEQUENCE, not just some hidden
+state — bit-identical token streams across the process boundary are the
+proof the wire protocol is transparent.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# the worker process runs headless: pin the backend the same way the
+# pytest conftest does for the parent
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu import serving                              # noqa: E402
+from paddle_tpu.core.tensor import Tensor as T              # noqa: E402
+
+V = 31
+L, H, D, M = 2, 2, 4, 64
+
+_W = jnp.asarray(np.linspace(-1.0, 1.0, D * V).reshape(D, V)
+                 .astype(np.float32))
+_POSW = (jnp.arange(M, dtype=jnp.float32) + 1.0) / M
+
+
+def _kv_of(tok_f):
+    ramp_d = (jnp.arange(D, dtype=jnp.float32) + 1.0) / D
+    ramp_h = (jnp.arange(H, dtype=jnp.float32) + 1.0) / H
+    base = (tok_f[..., None, None] + 1.0) / V
+    return base * ramp_h[:, None] * ramp_d[None, :]
+
+
+def _readout(cache00, valid):
+    feat = jnp.einsum("...hmd,...m,m->...d", cache00.astype(jnp.float32),
+                      valid.astype(jnp.float32), _POSW)
+    return feat @ _W
+
+
+def toy_step(tok, cache, t):
+    tok_d, c, td = tok._data, cache._data, t._data.astype(jnp.int32)
+    kv = _kv_of(tok_d[:, 0].astype(jnp.float32))
+
+    def wr(cb, kvb, tb):
+        page = jnp.broadcast_to(kvb[None, None, :, None, :],
+                                (L, 2, H, 1, D)).astype(cb.dtype)
+        return jax.lax.dynamic_update_slice(cb, page, (0, 0, 0, tb, 0))
+
+    c2 = jax.vmap(wr, in_axes=(2, 0, 0), out_axes=2)(c, kv, td)
+    valid = jnp.arange(M)[None, :] <= td[:, None]
+    logits = _readout(c2[0, 0], valid)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+    return T(nxt), T(c2)
+
+
+def toy_prefill(ids, cache):
+    idsd, c = ids._data, cache._data
+    lp = idsd.shape[1]
+    kv = jnp.transpose(_kv_of(idsd[0].astype(jnp.float32)), (1, 0, 2))
+    c = c.at[:, :, 0, :, :lp, :].set(
+        jnp.broadcast_to(kv, (L, 2, H, lp, D)).astype(c.dtype))
+    valid = (jnp.arange(M) < lp)[None, :]
+    logits = _readout(c[0, 0], valid)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+    return T(nxt), T(c)
+
+
+def dense_reference(prompt, n_new):
+    """The bs=1 dense loop — same callables, no paging. Greedy oracle the
+    parent-side parity tests compare the streamed tokens against."""
+    cache = T(jnp.zeros((L, 2, 1, H, M, D), jnp.float32))
+    tok, cache = toy_prefill(T(jnp.asarray(prompt[None, :], jnp.int32)),
+                             cache)
+    toks = [int(np.asarray(tok._data)[0, 0])]
+    t = int(prompt.size)
+    for _ in range(n_new - 1):
+        tok, cache = toy_step(tok, cache, T(jnp.asarray([t], jnp.int32)))
+        toks.append(int(np.asarray(tok._data)[0, 0]))
+        t += 1
+    return toks
+
+
+def make_engine(max_batch=4, page_size=16, kv_dtype="native", **kw):
+    cfg = serving.ServingConfig(
+        num_layers=L, num_heads=H, head_dim=D, max_len=M,
+        max_batch=max_batch,
+        buckets=tuple(b for b in (1, 4, 16) if b <= max_batch)
+        or (max_batch,),
+        page_size=page_size, kv_dtype=kv_dtype, **kw)
+    return serving.Engine(toy_prefill, toy_step, cfg)

@@ -179,6 +179,22 @@ DEFAULT_CONFIG: Dict[str, Any] = {
             "PagedKVCache.acquire_prefix", "PagedKVCache.peek_prefix_pages",
             "PagedKVCache.publish", "PagedKVCache.prefix_summary",
             "PagedKVCache.prefix_stats"],
+        # ISSUE 20: the fleet tier — supervisor public surface runs on
+        # caller threads while the monitor thread respawns/latches and
+        # per-request reader threads resolve Futures; the worker-side
+        # _srv_* handlers run on connection handler threads against the
+        # engine step thread (listed explicitly: ThreadingTCPServer
+        # handler discovery is best-effort, the roots must survive it)
+        "paddle_tpu/serving/fleet.py": [
+            "FleetSupervisor.start", "FleetSupervisor.stop",
+            "FleetSupervisor.submit", "FleetSupervisor.drain_worker",
+            "FleetSupervisor._monitor_loop",
+            "RemoteEngine.submit", "RemoteEngine.cancel",
+            "RemoteEngine.stop", "RemoteEngine.beat",
+            "RemoteEngine._read_stream"],
+        "paddle_tpu/serving/fleet_worker.py": [
+            "_Handler.handle", "_srv_submit", "_srv_cancel",
+            "_srv_withdraw", "_srv_drain", "_srv_beat", "main"],
         # the step/train thread arms and disarms around the compiled call
         # while the poll daemon classifies the window
         "paddle_tpu/resilience/watchdog.py": [
@@ -216,6 +232,9 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         # package split (pinned in test_lint_wholeprogram.py)
         "paddle_tpu/serving/http.py",
         "paddle_tpu/serving/router.py",
+        # ISSUE 20: the fleet supervisor/worker loops, same convention
+        "paddle_tpu/serving/fleet.py",
+        "paddle_tpu/serving/fleet_worker.py",
         "paddle_tpu/resilience/watchdog.py",
         "paddle_tpu/resilience/trainer.py",
     ],
@@ -283,7 +302,13 @@ DEFAULT_CONFIG: Dict[str, Any] = {
             "paddle_tpu.sparse", "paddle_tpu.geometric",
             "paddle_tpu.quantization", "paddle_tpu.text", "paddle_tpu.audio",
             "paddle_tpu.flops_counter", "paddle_tpu.vision",
-            "paddle_tpu.serving"]},
+            "paddle_tpu.serving",
+            # ISSUE 20: the rpc transport is a leaf over foundation only
+            # (resilience + stdlib at module scope); the serving fleet
+            # tier shares its framing with the distributed tier above, so
+            # the SUBMODULE sits in the api layer (most-specific prefix
+            # wins) while the rest of paddle_tpu.distributed stays higher
+            "paddle_tpu.distributed.rpc"]},
         {"name": "distributed", "prefixes": ["paddle_tpu.distributed"]},
         {"name": "apps", "prefixes": [
             "paddle_tpu.hapi", "paddle_tpu.models", "paddle_tpu.incubate",
@@ -310,7 +335,34 @@ DEFAULT_CONFIG: Dict[str, Any] = {
             "Router.submit": [
                 "QueueFull", "DeadlineExceeded", "EngineStopped",
                 "NoHealthyReplica", "ConnectionError", "ValueError",
+                # ISSUE 20: a fleet worker dying before admission — named
+                # explicitly (its ConnectionError base already admits it)
+                # because it is a distinct row in http.py::_STATUS_MAP
+                "RpcTransportError",
             ],
+        },
+        # ISSUE 20: the fleet tier's failure surfaces. The worker-side
+        # _srv_* handlers mirror the PS service convention (a raise is
+        # serialized back as a typed envelope); the supervisor's start is
+        # the spawn-failure surface.
+        "paddle_tpu/serving/fleet.py": {
+            "FleetSupervisor.start": [
+                "FleetWorkerLost", "ValueError", "OSError",
+            ],
+        },
+        "paddle_tpu/serving/fleet_worker.py": {
+            "_srv_submit": [
+                "QueueFull", "DeadlineExceeded", "EngineStopped",
+                "ValueError", "OSError",
+                # rpc.send_msg raises RuntimeError on a missing/empty
+                # secret — a misconfigured worker, mapped 500-equivalent
+                "RuntimeError",
+            ],
+            "_srv_cancel": [],
+            "_srv_withdraw": [],
+            "_srv_drain": ["DrainTimeout", "ValueError", "RuntimeError"],
+            "_srv_prefix_summary": [],
+            "_srv_beat": [],
         },
         "paddle_tpu/serving/engine.py": {
             "Engine.submit": [
@@ -387,6 +439,9 @@ DEFAULT_CONFIG: Dict[str, Any] = {
         # package split (same convention as poll_loop_paths)
         "paddle_tpu/serving/http.py",
         "paddle_tpu/serving/router.py",
+        # ISSUE 20: the fleet supervisor/worker, same convention
+        "paddle_tpu/serving/fleet.py",
+        "paddle_tpu/serving/fleet_worker.py",
         "paddle_tpu/resilience/watchdog.py",
         "paddle_tpu/resilience/trainer.py",
         "paddle_tpu/distributed/ps_service.py",
@@ -396,6 +451,10 @@ DEFAULT_CONFIG: Dict[str, Any] = {
     # make impossible (path -> ["Class.method", "fn"])
     "bounded_wait_roots": {
         "paddle_tpu/serving/router.py": ["Router._poll_loop"],
+        # ISSUE 20: the fleet monitor thread and the worker's main
+        # wait-for-SIGTERM loop
+        "paddle_tpu/serving/fleet.py": ["FleetSupervisor._monitor_loop"],
+        "paddle_tpu/serving/fleet_worker.py": ["main"],
         "paddle_tpu/resilience/watchdog.py": ["StepWatchdog._loop"],
     },
     # hot-path-stall: contended locks the dispatch fast path legitimately
